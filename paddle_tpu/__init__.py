@@ -1,0 +1,21 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle ~v1.8 "Fluid" (reference at /root/reference), built
+on JAX/XLA/pallas/pjit.
+
+Architecture (see SURVEY.md §7):
+  * fluid/   — Program/Block/Op IR, compile-and-run Executor, program-rewrite
+               autodiff, layers, optimizers (reference layers 3-7).
+  * dygraph/ — imperative mode with taped autograd (reference layer 5).
+  * parallel/— mesh + GSPMD sharding, collective op surface, fleet API
+               (reference layer 8; NCCL/gRPC/gloo replaced by XLA collectives).
+  * models/  — model-family zoo used by the book-test milestones.
+  * ops/     — pallas TPU kernels for hot paths.
+"""
+
+__version__ = "0.1.0"
+
+from . import fluid  # noqa: F401
+
+CPUPlace = fluid.CPUPlace
+TPUPlace = fluid.TPUPlace
+CUDAPlace = fluid.CUDAPlace

@@ -1,0 +1,46 @@
+"""fluid-compatible API surface of the TPU-native framework.
+
+Mirrors `python/paddle/fluid/__init__.py` of the reference: Program/Executor/
+layers/optimizer/backward/io exposed at package level.
+"""
+
+from . import ops  # registers every operator  # noqa: F401
+from . import (  # noqa: F401
+    backward,
+    clip,
+    initializer,
+    layers,
+    optimizer,
+    regularizer,
+    unique_name,
+)
+from .backward import append_backward, gradients  # noqa: F401
+from .core.place import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    TPUPlace,
+    default_place,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+)
+from .core.scope import Scope, global_scope  # noqa: F401
+from .executor import Executor, scope_guard  # noqa: F401
+from .framework import (  # noqa: F401
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    in_dygraph_mode,
+    program_guard,
+)
+from .layer_helper import ParamAttr  # noqa: F401
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """fluid.data — no implicit batch dim (cf. reference fluid/data.py)."""
+    return layers.tensor.data(
+        name, shape, dtype=dtype, append_batch_size=False
+    )

@@ -1,0 +1,155 @@
+"""Gradient clipping (cf. reference python/paddle/fluid/clip.py:
+GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm)."""
+
+import math
+
+from . import framework, unique_name
+
+
+class GradientClipBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+def _new_var_like(block, base, name_hint):
+    name = unique_name.generate(name_hint)
+    return block.create_var(
+        name=name, shape=base.shape, dtype=base.dtype, stop_gradient=True
+    )
+
+
+class GradientClipByValue(GradientClipBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            block = g.block
+            clipped = _new_var_like(block, g, g.name + "@CLIP")
+            block.append_op(
+                "clip", inputs={"X": [g.name]}, outputs={"Out": [clipped.name]},
+                attrs={"min": self.min, "max": self.max}, infer=False,
+            )
+            out.append((p, clipped))
+        return out
+
+
+class GradientClipByNorm(GradientClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        from .layers.common import append_simple_op
+
+        out = []
+        for p, g in params_grads:
+            if not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            block = g.block
+            # norm = sqrt(sum(g^2)); g *= clip_norm / max(norm, clip_norm)
+            sq = _new_var_like(block, g, g.name + "@SQN")
+            sq.shape = (1,)
+            block.append_op(
+                "squared_l2_norm", inputs={"X": [g.name]}, outputs={"Out": [sq.name]},
+                infer=False,
+            )
+            clipped = _new_var_like(block, g, g.name + "@CLIP")
+            block.append_op(
+                "clip_by_norm_apply",
+                inputs={"X": [g.name], "SquaredNorm": [sq.name]},
+                outputs={"Out": [clipped.name]},
+                attrs={"clip_norm": self.clip_norm},
+                infer=False,
+            )
+            out.append((p, clipped))
+        return out
+
+
+class GradientClipByGlobalNorm(GradientClipBase):
+    """cf. reference clip.py GradientClipByGlobalNorm: scale all grads by
+    clip_norm / max(global_norm, clip_norm)."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        block = None
+        sq_names = []
+        for p, g in params_grads:
+            if not getattr(p, "need_clip", True):
+                continue
+            block = g.block
+            sq = _new_var_like(block, g, g.name + "@SQN")
+            sq.shape = (1,)
+            block.append_op(
+                "squared_l2_norm", inputs={"X": [g.name]}, outputs={"Out": [sq.name]},
+                infer=False,
+            )
+            sq_names.append(sq.name)
+        if block is None:
+            return params_grads
+        total = block.create_var(
+            name=unique_name.generate("global_norm_sq"), shape=(1,),
+            dtype="float32", stop_gradient=True,
+        )
+        block.append_op(
+            "sum", inputs={"X": sq_names}, outputs={"Out": [total.name]}, infer=False
+        )
+        out = []
+        for p, g in params_grads:
+            if not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            clipped = _new_var_like(block, g, g.name + "@CLIP")
+            block.append_op(
+                "global_norm_clip_apply",
+                inputs={"X": [g.name], "GlobalNormSq": [total.name]},
+                outputs={"Out": [clipped.name]},
+                attrs={"clip_norm": self.clip_norm},
+                infer=False,
+            )
+            out.append((p, clipped))
+        return out
+
+
+# the two helper apply-ops
+import jax.numpy as jnp  # noqa: E402
+
+from .core.registry import register_op  # noqa: E402
+
+
+@register_op("clip_by_norm_apply", inputs=["X", "SquaredNorm"], outputs=["Out"], grad=None)
+def _clip_by_norm_apply(ctx, ins, attrs):
+    g = ins["X"][0]
+    norm = jnp.sqrt(ins["SquaredNorm"][0][0])
+    clip_norm = attrs["clip_norm"]
+    scale = clip_norm / jnp.maximum(norm, clip_norm)
+    return {"Out": [(g * scale).astype(g.dtype)]}
+
+
+@register_op("global_norm_clip_apply", inputs=["X", "GlobalNormSq"], outputs=["Out"], grad=None)
+def _global_norm_clip_apply(ctx, ins, attrs):
+    g = ins["X"][0]
+    gn = jnp.sqrt(ins["GlobalNormSq"][0][0])
+    clip_norm = attrs["clip_norm"]
+    scale = clip_norm / jnp.maximum(gn, clip_norm)
+    return {"Out": [(g * scale.astype(g.dtype)).astype(g.dtype)]}
+
+
+# legacy API names
+ClipByValue = GradientClipByValue
+ClipByNorm = GradientClipByNorm
+ClipByGlobalNorm = GradientClipByGlobalNorm
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    raise NotImplementedError(
+        "set_gradient_clip is deprecated in the reference too — pass "
+        "grad_clip= to the optimizer instead"
+    )

@@ -1,0 +1,50 @@
+"""Dtype registry: string names <-> jax/numpy dtypes.
+
+Capability parity: reference `paddle/fluid/framework/framework.proto:104`
+(VarType.Type enum) and `python/paddle/fluid/data_feeder.py` dtype conversion.
+TPU-first: bfloat16 is a first-class citizen (reference used float16 via
+`platform/float16.h`).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+_STR2JNP = {
+    "bool": jnp.bool_,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+}
+
+_CANON = {v: k for k, v in _STR2JNP.items()}
+
+
+def to_jnp(dtype):
+    """Convert any dtype spec (str, np.dtype, jnp dtype) to a jnp dtype."""
+    if isinstance(dtype, str):
+        if dtype in _STR2JNP:
+            return _STR2JNP[dtype]
+        return jnp.dtype(dtype).type
+    return jnp.dtype(dtype).type
+
+
+def to_str(dtype):
+    """Canonical string name for a dtype."""
+    j = to_jnp(dtype)
+    if j in _CANON:
+        return _CANON[j]
+    return str(np.dtype(j))
+
+
+def is_floating(dtype):
+    return jnp.issubdtype(to_jnp(dtype), jnp.floating)
+
+
+def is_integer(dtype):
+    return jnp.issubdtype(to_jnp(dtype), jnp.integer)

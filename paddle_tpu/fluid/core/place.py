@@ -1,0 +1,98 @@
+"""Place: typed device identity.
+
+Capability parity: reference `paddle/fluid/platform/place.h:26-98` defines
+CPUPlace / CUDAPlace / CUDAPinnedPlace as a boost::variant and
+`DeviceContextPool` (`device_context.h:513`) maps Place -> per-device context.
+
+TPU-first design: a Place wraps a `jax.Device` (or is a symbolic request like
+TPUPlace(0) resolved lazily).  There is no per-place stream/handle bundle —
+XLA owns streams — so the "device context" collapses to the jax device plus
+the executor's compiled-executable cache.
+"""
+
+import functools
+
+
+class Place:
+    """Base class for device identities."""
+
+    _kind = "undefined"
+    _jax_platform = None
+
+    def __init__(self, device_id=0):
+        self.device_id = int(device_id)
+
+    # -- resolution ---------------------------------------------------------
+    def get_device(self):
+        """Resolve to a concrete jax.Device (best effort, may fall back)."""
+        import jax
+
+        devs = _devices_by_platform(self._jax_platform)
+        if not devs:
+            devs = jax.devices()  # fall back to the default backend
+        return devs[self.device_id % len(devs)]
+
+    # -- identity -----------------------------------------------------------
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self._kind == other._kind
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self._kind, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self.device_id)
+
+
+@functools.lru_cache(maxsize=None)
+def _devices_by_platform(platform):
+    import jax
+
+    if platform is None:
+        return tuple(jax.devices())
+    try:
+        return tuple(jax.devices(platform))
+    except RuntimeError:
+        return ()
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+    _jax_platform = "cpu"
+
+
+class TPUPlace(Place):
+    _kind = "tpu"
+    _jax_platform = "tpu"
+
+
+# Alias kept so code written against the reference API keeps working; on this
+# framework "the accelerator place" is a TPU.
+CUDAPlace = TPUPlace
+
+
+def default_place():
+    """Accelerator if present, else CPU (cf. reference get_device logic)."""
+    import jax
+
+    d = jax.devices()[0]
+    if d.platform == "cpu":
+        return CPUPlace(0)
+    return TPUPlace(0)
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def tpu_device_count():
+    import jax
+
+    return len([d for d in jax.devices() if d.platform != "cpu"])

@@ -1,0 +1,145 @@
+"""Operator registry: op definitions carry a JAX lowering + grad rule.
+
+Capability parity: reference `paddle/fluid/framework/op_registry.h:223-269`
+(REGISTER_OPERATOR / REGISTER_OP_*_KERNEL macros populating OpInfoMap with
+creator, proto, GradOpMaker, InferShape).  TPU-first redesign: instead of a
+per-(dtype, place, layout) kernel map dispatched at interpreter time
+(`operator.cc:1032` ChooseKernel), every op registers ONE pure JAX lowering.
+XLA is the kernel library; shape/dtype inference is derived from the lowering
+itself via `jax.eval_shape`, so there is no hand-written InferShape for most
+ops.  Gradients default to an auto-VJP maker (see backward.py) replacing the
+per-op C++ GradOpMaker (`grad_op_desc_maker.h`).
+
+Lowering signature::
+
+    def lower(ctx, ins, attrs):  # -> {out_slot: [jax.Array, ...]}
+        ...
+
+where ``ins`` is ``{in_slot: [jax.Array, ...]}`` and ``ctx`` is a
+:class:`LowerContext` giving deterministic RNG keys and compile-time info.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class LowerContext:
+    """Per-trace context handed to op lowerings.
+
+    - ``rng()`` returns a fresh deterministic PRNG key (random ops).  The
+      executor threads a single key into the traced program; each call splits
+      a counter-indexed subkey so programs stay reproducible under jit.
+    - ``is_test`` mirrors the reference's global train/eval switch.
+    """
+
+    def __init__(self, base_key=None, is_test=False, mesh=None):
+        self._base_key = base_key
+        self._counter = 0
+        self.is_test = is_test
+        self.mesh = mesh
+
+    def rng(self):
+        if self._base_key is None:
+            raise RuntimeError(
+                "This op needs randomness but no PRNG key was provided "
+                "to the lowering context."
+            )
+        self._counter += 1
+        return jax.random.fold_in(self._base_key, self._counter)
+
+
+class OpDef:
+    """A registered operator.
+
+    Attributes:
+      type: op type string (e.g. ``"matmul"``).
+      lower: the pure JAX lowering function.
+      input_slots / output_slots: declared slot names, in canonical order.
+        Order matters: it defines the flat argument layout used by the
+        auto-VJP grad path.
+      grad_maker: None => non-differentiable; "auto" => generic VJP grad op;
+        or a callable(op, block, grad_map) -> list of grad Operator specs
+        (see backward.py for the calling convention).
+      no_grad_slots: input slots that never receive a gradient (e.g. integer
+        index inputs).
+      stateful_out_slots: output slots that alias/update persistable state
+        (e.g. batch_norm's MeanOut) — excluded from autodiff paths.
+    """
+
+    def __init__(
+        self,
+        type,
+        lower,
+        input_slots,
+        output_slots,
+        grad_maker="auto",
+        no_grad_slots=(),
+        stateful_out_slots=(),
+        needs_rng=False,
+    ):
+        self.type = type
+        self.lower = lower
+        self.input_slots = tuple(input_slots)
+        self.output_slots = tuple(output_slots)
+        self.grad_maker = grad_maker
+        self.no_grad_slots = frozenset(no_grad_slots)
+        self.stateful_out_slots = frozenset(stateful_out_slots)
+        self.needs_rng = needs_rng
+
+
+_OP_REGISTRY: dict[str, OpDef] = {}
+
+
+def register_op(
+    type,
+    inputs,
+    outputs,
+    grad="auto",
+    no_grad_slots=(),
+    stateful_out_slots=(),
+    needs_rng=False,
+):
+    """Decorator registering a lowering as op ``type``.
+
+    Example::
+
+        @register_op("relu", inputs=["X"], outputs=["Out"])
+        def _relu(ctx, ins, attrs):
+            return {"Out": [jax.nn.relu(ins["X"][0])]}
+    """
+
+    def deco(fn):
+        if type in _OP_REGISTRY:
+            raise ValueError("op '%s' registered twice" % type)
+        _OP_REGISTRY[type] = OpDef(
+            type,
+            fn,
+            inputs,
+            outputs,
+            grad_maker=grad,
+            no_grad_slots=no_grad_slots,
+            stateful_out_slots=stateful_out_slots,
+            needs_rng=needs_rng,
+        )
+        return fn
+
+    return deco
+
+
+def get_op_def(type) -> OpDef:
+    try:
+        return _OP_REGISTRY[type]
+    except KeyError:
+        raise KeyError(
+            "operator '%s' is not registered (registered: %s...)"
+            % (type, sorted(_OP_REGISTRY)[:20])
+        ) from None
+
+
+def has_op(type):
+    return type in _OP_REGISTRY
+
+
+def registered_ops():
+    return sorted(_OP_REGISTRY)

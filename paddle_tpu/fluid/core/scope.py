@@ -1,0 +1,72 @@
+"""Scope: hierarchical name -> value store for persistable variables.
+
+Capability parity: reference `paddle/fluid/framework/scope.h:46` (NewScope /
+FindVar with parent fallback) and `variable.h:26`.  In the TPU build, only
+*persistable* state (parameters, optimizer accumulators, running stats) lives
+in a Scope between runs — intermediates never materialize by name because the
+whole block compiles into one XLA computation.  Values are jax Arrays (or
+numpy on feed).
+"""
+
+from __future__ import annotations
+
+
+class Scope:
+    def __init__(self, parent: "Scope" = None):
+        self._vars = {}
+        self._parent = parent
+        self._kids = []
+
+    def new_scope(self) -> "Scope":
+        s = Scope(self)
+        self._kids.append(s)
+        return s
+
+    def var(self, name):
+        """Find-or-declare a slot in THIS scope (cf. Scope::Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return name
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def find_var(self, name):
+        """Lookup with parent fallback (cf. Scope::FindVar). None if absent."""
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s._parent
+        return None
+
+    def has(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars and s._vars[name] is not None:
+                return True
+            s = s._parent
+        return False
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def local_names(self):
+        return list(self._vars)
+
+    def drop_kids(self):
+        self._kids = []
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    """cf. python/paddle/fluid/executor.py:41 global_scope()."""
+    return _global_scope
+
+
+def _reset_global_scope_for_tests():
+    global _global_scope
+    _global_scope = Scope()
+    return _global_scope

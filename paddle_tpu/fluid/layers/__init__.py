@@ -1,0 +1,7 @@
+"""Layer (op wrapper) API — cf. reference python/paddle/fluid/layers/."""
+
+from . import loss, nn, ops, tensor  # noqa: F401
+from .loss import *  # noqa: F401,F403
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403

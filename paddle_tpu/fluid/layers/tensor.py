@@ -1,0 +1,295 @@
+"""Tensor-creation / manipulation layers.
+
+Capability parity: reference `python/paddle/fluid/layers/tensor.py` and
+`layers/io.py` (`data`).
+"""
+
+import numpy as np
+
+from .. import framework, unique_name
+from ..core import dtypes as dtypes_mod
+from .common import append_simple_op, to_var_list
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True):
+    """Declare a feed variable (cf. reference layers/io.py data / fluid.data).
+
+    fluid.layers.data prepends a -1 batch dim by default; fluid.data does not
+    (pass append_batch_size=False for that behavior).
+    """
+    shape = list(shape)
+    if append_batch_size and (not shape or shape[0] != -1):
+        shape = [-1] + shape
+    block = framework.default_main_program().global_block
+    return block.create_var(
+        name=name, shape=shape, dtype=dtype, is_data=True, stop_gradient=True
+    )
+
+
+def fill_constant(shape, dtype, value, name=None):
+    return append_simple_op(
+        "fill_constant",
+        {},
+        {"shape": list(shape), "dtype": dtypes_mod.to_str(dtype), "value": float(value)},
+        dtype=dtypes_mod.to_str(dtype),
+        stop_gradient=True,
+    )
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value, input_dim_idx=0, output_dim_idx=0):
+    return append_simple_op(
+        "fill_constant_batch_size_like",
+        {"Input": input},
+        {
+            "shape": list(shape),
+            "dtype": dtypes_mod.to_str(dtype),
+            "value": float(value),
+            "input_dim_idx": input_dim_idx,
+            "output_dim_idx": output_dim_idx,
+        },
+        dtype=dtypes_mod.to_str(dtype),
+        stop_gradient=True,
+    )
+
+
+def cast(x, dtype):
+    return append_simple_op(
+        "cast", {"X": x}, {"out_dtype": dtypes_mod.to_str(dtype)},
+        dtype=dtypes_mod.to_str(dtype),
+    )
+
+
+def concat(input, axis=0, name=None):
+    return append_simple_op("concat", {"X": list(input)}, {"axis": axis})
+
+
+def assign(input, output=None):
+    if isinstance(input, np.ndarray):
+        from ..initializer import NumpyArrayInitializer
+
+        helper_out = output
+        block = framework.default_main_program().current_block()
+        if helper_out is None:
+            helper_out = block.create_var(
+                name=unique_name.generate("assign.tmp"),
+                shape=list(input.shape),
+                dtype=str(input.dtype),
+            )
+        block.append_op(
+            "assign_value",
+            outputs={"Out": [helper_out.name]},
+            attrs={
+                "shape": list(input.shape),
+                "dtype": helper_out.dtype,
+                "values": input.ravel().tolist(),
+            },
+            infer=False,
+        )
+        return helper_out
+    if output is None:
+        return append_simple_op("assign", {"X": input})
+    block = framework.default_main_program().current_block()
+    block.append_op(
+        "assign", inputs={"X": [input.name]}, outputs={"Out": [output.name]}
+    )
+    return output
+
+
+def zeros(shape, dtype="float32"):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones(shape, dtype="float32"):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros_like(x):
+    return append_simple_op("fill_zeros_like", {"X": x})
+
+
+def ones_like(x):
+    return append_simple_op("fill_any_like", {"X": x}, {"value": 1.0})
+
+
+def full_like(x, fill_value, dtype=None):
+    attrs = {"value": float(fill_value)}
+    if dtype:
+        attrs["dtype"] = dtypes_mod.to_str(dtype)
+    return append_simple_op("fill_any_like", {"X": x}, attrs)
+
+
+def reshape(x, shape, name=None, **kw):
+    return append_simple_op("reshape2", {"X": x}, {"shape": list(shape)})
+
+
+def transpose(x, perm, name=None):
+    return append_simple_op("transpose2", {"X": x}, {"axis": list(perm)})
+
+
+def flatten(x, axis=1, name=None):
+    return append_simple_op("flatten2", {"X": x}, {"axis": axis})
+
+
+def squeeze(input, axes, name=None):
+    return append_simple_op("squeeze2", {"X": input}, {"axes": list(axes)})
+
+
+def unsqueeze(input, axes, name=None):
+    return append_simple_op("unsqueeze2", {"X": input}, {"axes": list(axes)})
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    x = input
+    ndim = len(x.shape)
+    axis = dim if dim >= 0 else dim + ndim
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "axis": axis}
+    else:
+        n = len(num_or_sections)
+        attrs = {"sections": list(num_or_sections), "axis": axis}
+    out = append_simple_op("split", {"X": x}, attrs, n_outs={"Out": n})
+    return out if isinstance(out, list) else [out]
+
+
+def stack(x, axis=0):
+    return append_simple_op("stack", {"X": list(x)}, {"axis": axis}, out_slots=("Y",))
+
+
+def unstack(x, axis=0, num=None):
+    n = num if num is not None else x.shape[axis]
+    out = append_simple_op("unstack", {"X": x}, {"axis": axis}, out_slots=("Y",), n_outs={"Y": n})
+    return out if isinstance(out, list) else [out]
+
+
+def slice(input, axes, starts, ends):
+    return append_simple_op(
+        "slice",
+        {"Input": input},
+        {"axes": list(axes), "starts": list(starts), "ends": list(ends)},
+    )
+
+
+def gather(input, index, axis=0):
+    return append_simple_op("gather", {"X": input, "Index": index}, {"axis": axis})
+
+
+def gather_nd(input, index):
+    return append_simple_op("gather_nd", {"X": input, "Index": index})
+
+
+def scatter(input, index, updates, overwrite=True):
+    return append_simple_op(
+        "scatter", {"X": input, "Ids": index, "Updates": updates}, {"overwrite": overwrite}
+    )
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    return append_simple_op("one_hot", {"X": input}, {"depth": depth}, dtype="float32")
+
+
+def expand(x, expand_times):
+    return append_simple_op("expand", {"X": x}, {"expand_times": list(expand_times)})
+
+
+def tile(x, repeat_times):
+    return append_simple_op("tile", {"X": x}, {"repeat_times": list(repeat_times)})
+
+
+def range(start, end, step, dtype):
+    return append_simple_op(
+        "arange",
+        {},
+        {"start": float(start), "end": float(end), "step": float(step),
+         "dtype": dtypes_mod.to_str(dtype)},
+        dtype=dtypes_mod.to_str(dtype),
+        stop_gradient=True,
+    )
+
+
+arange = range
+
+
+def linspace(start, stop, num, dtype="float32"):
+    return append_simple_op(
+        "linspace",
+        {},
+        {"start": float(start), "stop": float(stop), "num": int(num),
+         "dtype": dtypes_mod.to_str(dtype)},
+        dtype=dtypes_mod.to_str(dtype),
+        stop_gradient=True,
+    )
+
+
+def where(condition, x, y):
+    return append_simple_op("where", {"Condition": condition, "X": x, "Y": y})
+
+
+def shape(input):
+    return append_simple_op("shape", {"Input": input}, dtype="int32", stop_gradient=True)
+
+
+def pad(x, paddings, pad_value=0.0):
+    return append_simple_op("pad", {"X": x}, {"paddings": list(paddings), "pad_value": pad_value})
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False):
+    return append_simple_op(
+        "cumsum", {"X": x}, {"axis": axis, "exclusive": exclusive, "reverse": reverse}
+    )
+
+
+def increment(x, value=1.0, in_place=True):
+    block = framework.default_main_program().current_block()
+    if in_place:
+        block.append_op(
+            "increment",
+            inputs={"X": [x.name]},
+            outputs={"Out": [x.name]},
+            attrs={"step": float(value)},
+            infer=False,
+        )
+        return x
+    return append_simple_op("increment", {"X": x}, {"step": float(value)})
+
+
+def argmax(x, axis=-1, keepdims=False):
+    return append_simple_op(
+        "arg_max", {"X": x}, {"axis": axis, "keepdims": keepdims},
+        dtype="int64", stop_gradient=True,
+    )
+
+
+def argmin(x, axis=-1):
+    return append_simple_op("arg_min", {"X": x}, {"axis": axis}, dtype="int64", stop_gradient=True)
+
+
+def argsort(x, axis=-1, descending=False):
+    return append_simple_op(
+        "argsort", {"X": x}, {"axis": axis, "descending": descending},
+        out_slots=("Out", "Indices"),
+    )
+
+
+def equal(x, y):
+    return append_simple_op("equal", {"X": x, "Y": y}, dtype="bool", stop_gradient=True)
+
+
+def not_equal(x, y):
+    return append_simple_op("not_equal", {"X": x, "Y": y}, dtype="bool", stop_gradient=True)
+
+
+def less_than(x, y):
+    return append_simple_op("less_than", {"X": x, "Y": y}, dtype="bool", stop_gradient=True)
+
+
+def greater_than(x, y):
+    return append_simple_op("greater_than", {"X": x, "Y": y}, dtype="bool", stop_gradient=True)
+
+
+def logical_and(x, y):
+    return append_simple_op("logical_and", {"X": x, "Y": y}, dtype="bool", stop_gradient=True)
+
+
+def logical_not(x):
+    return append_simple_op("logical_not", {"X": x}, dtype="bool", stop_gradient=True)

@@ -1,0 +1,18 @@
+"""Op library: importing this package registers every operator's JAX lowering.
+
+This is the TPU-native equivalent of the reference's static-registrar op
+library (`paddle/fluid/operators/`, 588 REGISTER_OPERATOR sites): one pure
+JAX lowering per op instead of per-(place,dtype,layout) kernels, with XLA as
+the kernel backend and fuser.
+"""
+
+from . import (  # noqa: F401
+    math_ops,
+    metric_ops,
+    nn_ops,
+    optimizer_ops,
+    random_ops,
+    reduce_ops,
+    tensor_ops,
+)
+from .optimizer_ops import OPTIMIZER_OP_TYPES  # noqa: F401

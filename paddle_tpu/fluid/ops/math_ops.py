@@ -1,0 +1,226 @@
+"""Dense math ops: elementwise (w/ axis broadcast), activations, matmul.
+
+Capability parity: reference `paddle/fluid/operators/elementwise/`,
+`activation_op.cc`, `matmul_op.cc`, `mul_op.cc`.  Each op here is ONE pure
+JAX lowering — XLA supplies the CPU/TPU kernels and the fusion that the
+reference implemented by hand (elementwise CUDA kernels, fused activations).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _paddle_bcast(x, y, axis):
+    """Reference broadcast rule (elementwise_op.h): align Y to X at `axis`."""
+    if x.ndim == y.ndim:
+        return x, y
+    if y.ndim > x.ndim:  # numpy-style fallback
+        return x, y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = (1,) * axis + y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return x, y.reshape(new_shape)
+
+
+def _register_elementwise(name, fn):
+    @register_op(
+        "elementwise_" + name, inputs=["X", "Y"], outputs=["Out"]
+    )
+    def _lower(ctx, ins, attrs, fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        x, y = _paddle_bcast(x, y, attrs.get("axis", -1))
+        return {"Out": [fn(x, y)]}
+
+
+_register_elementwise("add", jnp.add)
+_register_elementwise("sub", jnp.subtract)
+_register_elementwise("mul", jnp.multiply)
+_register_elementwise("div", jnp.divide)
+_register_elementwise("pow", jnp.power)
+_register_elementwise("max", jnp.maximum)
+_register_elementwise("min", jnp.minimum)
+_register_elementwise("mod", jnp.mod)
+_register_elementwise("floordiv", jnp.floor_divide)
+
+
+# -- activations (cf. activation_op.cc) --------------------------------------
+
+_ACTIVATIONS = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt,
+    "abs": jnp.abs,
+    "square": jnp.square,
+    "reciprocal": lambda x: 1.0 / x,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "silu": jax.nn.silu,
+    "erf": jax.lax.erf,
+    "sign": jnp.sign,
+    "logsigmoid": jax.nn.log_sigmoid,
+}
+
+
+def _register_activation(name, fn):
+    @register_op(name, inputs=["X"], outputs=["Out"])
+    def _lower(ctx, ins, attrs, fn=fn):
+        return {"Out": [fn(ins["X"][0])]}
+
+
+for _name, _fn in _ACTIVATIONS.items():
+    _register_activation(_name, _fn)
+
+
+@register_op("leaky_relu", inputs=["X"], outputs=["Out"])
+def _leaky_relu(ctx, ins, attrs):
+    alpha = attrs.get("alpha", 0.02)
+    x = ins["X"][0]
+    return {"Out": [jnp.where(x >= 0, x, alpha * x)]}
+
+
+@register_op("elu", inputs=["X"], outputs=["Out"])
+def _elu(ctx, ins, attrs):
+    return {"Out": [jax.nn.elu(ins["X"][0], alpha=attrs.get("alpha", 1.0))]}
+
+
+@register_op("gelu", inputs=["X"], outputs=["Out"])
+def _gelu(ctx, ins, attrs):
+    approx = attrs.get("approximate", False)
+    return {"Out": [jax.nn.gelu(ins["X"][0], approximate=approx)]}
+
+
+@register_op("hard_sigmoid", inputs=["X"], outputs=["Out"])
+def _hard_sigmoid(ctx, ins, attrs):
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    return {"Out": [jnp.clip(ins["X"][0] * slope + offset, 0.0, 1.0)]}
+
+
+@register_op("swish", inputs=["X"], outputs=["Out"])
+def _swish(ctx, ins, attrs):
+    beta = attrs.get("beta", 1.0)
+    x = ins["X"][0]
+    return {"Out": [x * jax.nn.sigmoid(beta * x)]}
+
+
+@register_op("relu6", inputs=["X"], outputs=["Out"])
+def _relu6(ctx, ins, attrs):
+    return {"Out": [jnp.clip(ins["X"][0], 0.0, attrs.get("threshold", 6.0))]}
+
+
+@register_op("pow", inputs=["X"], outputs=["Out"])
+def _pow(ctx, ins, attrs):
+    return {"Out": [jnp.power(ins["X"][0], attrs.get("factor", 1.0))]}
+
+
+@register_op("scale", inputs=["X"], outputs=["Out"])
+def _scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        out = x * s + b
+    else:
+        out = (x + b) * s
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("clip", inputs=["X"], outputs=["Out"])
+def _clip(ctx, ins, attrs):
+    return {"Out": [jnp.clip(ins["X"][0], attrs["min"], attrs["max"])]}
+
+
+@register_op("softmax", inputs=["X"], outputs=["Out"])
+def _softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+
+
+@register_op("log_softmax", inputs=["X"], outputs=["Out"])
+def _log_softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+
+
+# -- matmul family -----------------------------------------------------------
+
+
+@register_op("matmul", inputs=["X", "Y"], outputs=["Out"])
+def _matmul(ctx, ins, attrs):
+    """cf. matmul_op.cc: optional transposes + alpha, batched by leading dims.
+
+    TPU note: this is the MXU path; executor-level precision policy decides
+    bf16 accumulation (see amp).  We keep the contraction in one jnp.matmul
+    so XLA tiles it onto the systolic array.
+    """
+    x, y = ins["X"][0], ins["Y"][0]
+    tx = attrs.get("transpose_X", attrs.get("transpose_x", False))
+    ty = attrs.get("transpose_Y", attrs.get("transpose_y", False))
+    alpha = attrs.get("alpha", 1.0)
+    if tx:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if ty:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@register_op("mul", inputs=["X", "Y"], outputs=["Out"])
+def _mul(ctx, ins, attrs):
+    """cf. mul_op.cc: flatten X to 2D at x_num_col_dims, Y at y_num_col_dims."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    x2 = x.reshape((-1, _prod(x.shape[xn:])))
+    y2 = y.reshape((int(_prod(y.shape[:yn])), -1))
+    out2 = jnp.matmul(x2, y2)
+    out_shape = x.shape[:xn] + y.shape[yn:]
+    return {"Out": [out2.reshape(out_shape)]}
+
+
+def _prod(xs):
+    r = 1
+    for v in xs:
+        r *= int(v)
+    return r
+
+
+@register_op("dot", inputs=["X", "Y"], outputs=["Out"])
+def _dot(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.sum(x * y, axis=-1, keepdims=True)]}
+
+
+@register_op("bmm", inputs=["X", "Y"], outputs=["Out"])
+def _bmm(ctx, ins, attrs):
+    return {"Out": [jnp.matmul(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("addmm", inputs=["Input", "X", "Y"], outputs=["Out"])
+def _addmm(ctx, ins, attrs):
+    alpha = attrs.get("Alpha", 1.0)
+    beta = attrs.get("Beta", 1.0)
+    return {
+        "Out": [beta * ins["Input"][0] + alpha * jnp.matmul(ins["X"][0], ins["Y"][0])]
+    }
+
+
+@register_op("sum", inputs=["X"], outputs=["Out"])
+def _sum(ctx, ins, attrs):
+    """Multi-input elementwise add (grad accumulation; cf. sum_op.cc)."""
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}

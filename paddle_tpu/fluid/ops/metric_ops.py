@@ -1,0 +1,24 @@
+"""Metric ops (cf. paddle/fluid/operators/metrics/accuracy_op.cc, auc_op.cc)."""
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op(
+    "accuracy",
+    inputs=["Out", "Indices", "Label"],
+    outputs=["Accuracy", "Correct", "Total"],
+    grad=None,
+)
+def _accuracy(ctx, ins, attrs):
+    """cf. accuracy_op.cc: fraction of rows whose top-k indices contain label."""
+    indices = ins["Indices"][0]
+    label = ins["Label"][0]
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label[:, 0]
+    hit = jnp.any(indices == label[:, None], axis=1)
+    correct = jnp.sum(hit.astype(jnp.int32))
+    total = jnp.array(indices.shape[0], dtype=jnp.int32)
+    acc = correct.astype(jnp.float32) / total.astype(jnp.float32)
+    return {"Accuracy": [acc], "Correct": [correct], "Total": [total]}

@@ -1,0 +1,371 @@
+"""NN ops: conv, pool, norms, dropout, embedding, losses.
+
+Capability parity: reference `paddle/fluid/operators/` conv_op.cc (cudnn +
+im2col paths), pool_op.cc, batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc,
+lookup_table_op.cc, softmax_with_cross_entropy_op.cc.  TPU-first: convs lower
+to lax.conv_general_dilated (XLA picks the MXU tiling — the reference's
+cudnn-algorithm search is subsumed by the compiler), norms are fused by XLA,
+dropout uses counter-based stateless PRNG.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+@register_op("conv2d", inputs=["Input", "Filter"], outputs=["Output"])
+def _conv2d(ctx, ins, attrs):
+    """NCHW conv (cf. conv_op.cc).  groups>1 -> feature_group_count."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = attrs.get("paddings", [0, 0])
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1))
+    if len(pads) == 2:
+        padding = [(pads[0], pads[0]), (pads[1], pads[1])]
+    else:  # [top, bottom, left, right]
+        padding = [(pads[0], pads[1]), (pads[2], pads[3])]
+    if isinstance(attrs.get("padding_algorithm"), str):
+        alg = attrs["padding_algorithm"]
+        if alg == "SAME":
+            padding = "SAME"
+        elif alg == "VALID":
+            padding = "VALID"
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=padding,
+        rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d", inputs=["Input", "Filter"], outputs=["Output"])
+def _depthwise_conv2d(ctx, ins, attrs):
+    attrs = dict(attrs)
+    attrs["groups"] = int(ins["Input"][0].shape[1])
+    from ..core.registry import get_op_def
+
+    return get_op_def("conv2d").lower(ctx, ins, attrs)
+
+
+@register_op(
+    "conv2d_transpose", inputs=["Input", "Filter"], outputs=["Output"]
+)
+def _conv2d_transpose(ctx, ins, attrs):
+    """cf. conv_transpose_op.cc.  Filter layout IOHW (paddle convention:
+    [Cin, Cout/groups, kh, kw]).  Implemented as the standard fractionally-
+    strided conv: lhs_dilation=stride, spatially-flipped kernel with I/O
+    swapped, padding d*(k-1)-p — giving Paddle's output size
+    (H-1)*stride - 2*pad + dilation*(kh-1) + 1.
+    """
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1))
+    if groups != 1:
+        raise NotImplementedError("grouped conv2d_transpose")
+    kh, kw = int(w.shape[2]), int(w.shape[3])
+    # IOHW -> OIHW with spatial flip
+    w_t = jnp.flip(jnp.swapaxes(w, 0, 1), axis=(2, 3))
+    padding = [
+        (dilations[0] * (kh - 1) - pads[0], dilations[0] * (kh - 1) - pads[0]),
+        (dilations[1] * (kw - 1) - pads[1], dilations[1] * (kw - 1) - pads[1]),
+    ]
+    out = jax.lax.conv_general_dilated(
+        x,
+        w_t,
+        window_strides=(1, 1),
+        padding=padding,
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return {"Output": [out]}
+
+
+@register_op("pool2d", inputs=["X"], outputs=["Out"])
+def _pool2d(ctx, ins, attrs):
+    """max/avg pooling via reduce_window (cf. pool_op.cc)."""
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", ksize))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = (x.shape[2], x.shape[3])
+        strides = ksize
+        pads = (0, 0)
+    if attrs.get("adaptive", False):
+        oh, ow = ksize
+        ih, iw = x.shape[2], x.shape[3]
+        if ih % oh or iw % ow:
+            raise NotImplementedError("adaptive pool with non-divisible sizes")
+        ksize = (ih // oh, iw // ow)
+        strides = ksize
+        pads = (0, 0)
+    window = (1, 1) + ksize
+    strides4 = (1, 1) + strides
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, padding)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4, padding)
+        if attrs.get("exclusive", True) and pads != (0, 0):
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add, window, strides4, padding
+            )
+            out = summed / counts
+        else:
+            out = summed / (ksize[0] * ksize[1])
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op(
+    "batch_norm",
+    inputs=["X", "Scale", "Bias", "Mean", "Variance"],
+    outputs=["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+    no_grad_slots=("Mean", "Variance"),
+    stateful_out_slots=("MeanOut", "VarianceOut"),
+)
+def _batch_norm(ctx, ins, attrs):
+    """cf. batch_norm_op.cc.  Training: batch stats + EMA update of running
+    stats (MeanOut/VarianceOut alias the Mean/Variance persistables)."""
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    momentum = attrs.get("momentum", 0.9)
+    eps = attrs.get("epsilon", 1e-5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = tuple(x.shape[c_axis] if i == c_axis else 1 for i in range(x.ndim))
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        cf = x.astype(jnp.float32)
+        use_mean = jnp.mean(cf, axis=reduce_axes)
+        use_var = jnp.var(cf, axis=reduce_axes)
+        mean_out = mean * momentum + use_mean * (1 - momentum)
+        var_out = var * momentum + use_var * (1 - momentum)
+        saved_mean = use_mean
+        saved_var = use_var
+    inv_std = jax.lax.rsqrt(use_var.astype(jnp.float32) + eps)
+    xh = (x.astype(jnp.float32) - use_mean.reshape(bshape)) * inv_std.reshape(bshape)
+    y = xh * scale.reshape(bshape) + bias.reshape(bshape)
+    return {
+        "Y": [y.astype(x.dtype)],
+        "MeanOut": [mean_out.astype(mean.dtype)],
+        "VarianceOut": [var_out.astype(var.dtype)],
+        "SavedMean": [saved_mean.astype(jnp.float32)],
+        "SavedVariance": [inv_std.astype(jnp.float32)],
+    }
+
+
+@register_op(
+    "layer_norm",
+    inputs=["X", "Scale", "Bias"],
+    outputs=["Y", "Mean", "Variance"],
+)
+def _layer_norm(ctx, ins, attrs):
+    """cf. layer_norm_op.cc: normalize over dims >= begin_norm_axis."""
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    bna = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(bna, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape((1,) * bna + x.shape[bna:]).astype(jnp.float32)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape((1,) * bna + x.shape[bna:]).astype(jnp.float32)
+    flat = (int(_prod(x.shape[:bna])),)
+    return {
+        "Y": [y.astype(x.dtype)],
+        "Mean": [mean.reshape(flat)],
+        "Variance": [var.reshape(flat)],
+    }
+
+
+def _prod(xs):
+    r = 1
+    for v in xs:
+        r *= int(v)
+    return r
+
+
+@register_op(
+    "dropout",
+    inputs=["X"],
+    outputs=["Out", "Mask"],
+    grad="dropout_grad_maker",
+    needs_rng=True,
+)
+def _dropout(ctx, ins, attrs):
+    """cf. dropout_op.cc.  Stateless threefry key per op instance."""
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x * (1.0 - p) if impl == "downgrade_in_infer" else x
+        return {"Out": [out.astype(x.dtype)], "Mask": [jnp.ones_like(x, dtype=jnp.uint8)]}
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0)
+    else:
+        out = jnp.where(keep, x, 0.0)
+    return {"Out": [out.astype(x.dtype)], "Mask": [keep.astype(jnp.uint8)]}
+
+
+@register_op("dropout_grad", inputs=["Mask", "Out@GRAD"], outputs=["X@GRAD"], grad=None)
+def _dropout_grad(ctx, ins, attrs):
+    g = ins["Out@GRAD"][0]
+    mask = ins["Mask"][0].astype(g.dtype)
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if impl == "upscale_in_train":
+        gx = g * mask / (1.0 - p)
+    else:
+        gx = g * mask
+    return {"X@GRAD": [gx]}
+
+
+@register_op(
+    "lookup_table",
+    inputs=["W", "Ids"],
+    outputs=["Out"],
+    no_grad_slots=("Ids",),
+)
+def _lookup_table(ctx, ins, attrs):
+    """Embedding gather (cf. lookup_table_op.cc).  padding_idx rows zeroed."""
+    w, ids = ins["W"][0], ins["Ids"][0]
+    padding_idx = attrs.get("padding_idx", -1)
+    squeeze = False
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+        squeeze = True
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    return {"Out": [out]}
+
+
+register_op("lookup_table_v2", inputs=["W", "Ids"], outputs=["Out"], no_grad_slots=("Ids",))(
+    _lookup_table
+)
+
+
+@register_op(
+    "softmax_with_cross_entropy",
+    inputs=["Logits", "Label"],
+    outputs=["Softmax", "Loss"],
+    no_grad_slots=("Label",),
+)
+def _softmax_with_cross_entropy(ctx, ins, attrs):
+    """cf. softmax_with_cross_entropy_op.cc — numerically-stable fused path;
+    XLA fuses log_softmax+gather into one kernel, grad via auto-VJP is the
+    canonical (softmax - onehot) form after simplification."""
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    axis = attrs.get("axis", -1)
+    soft_label = attrs.get("soft_label", False)
+    ignore_index = attrs.get("ignore_index", -100)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    softmax = jnp.exp(logp)
+    if soft_label:
+        loss = -jnp.sum(label.astype(jnp.float32) * logp, axis=axis, keepdims=True)
+    else:
+        lab = label
+        squeezed = False
+        if lab.ndim == logits.ndim and lab.shape[axis] == 1:
+            lab = jnp.squeeze(lab, axis=axis)
+            squeezed = True
+        loss = -jnp.take_along_axis(
+            logp, jnp.expand_dims(lab, axis), axis=axis
+        )
+        valid = (lab != ignore_index)
+        loss = jnp.where(jnp.expand_dims(valid, axis), loss, 0.0)
+    return {"Softmax": [softmax.astype(logits.dtype)], "Loss": [loss.astype(logits.dtype)]}
+
+
+@register_op(
+    "cross_entropy", inputs=["X", "Label"], outputs=["Y"], no_grad_slots=("Label",)
+)
+def _cross_entropy(ctx, ins, attrs):
+    """cf. cross_entropy_op.cc: input is a probability distribution."""
+    x, label = ins["X"][0], ins["Label"][0]
+    soft_label = attrs.get("soft_label", False)
+    eps = 1e-8
+    logp = jnp.log(jnp.clip(x, eps, 1.0))
+    if soft_label:
+        y = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lab = label
+        if lab.ndim == x.ndim and lab.shape[-1] == 1:
+            lab = jnp.squeeze(lab, -1)
+        y = -jnp.take_along_axis(logp, jnp.expand_dims(lab, -1), axis=-1)
+    return {"Y": [y]}
+
+
+@register_op("mse_loss", inputs=["X", "Y"], outputs=["Out"])
+def _mse(ctx, ins, attrs):
+    d = ins["X"][0] - ins["Y"][0]
+    return {"Out": [jnp.mean(jnp.square(d))]}
+
+
+@register_op("square_error_cost", inputs=["X", "Y"], outputs=["Out"])
+def _square_error_cost(ctx, ins, attrs):
+    d = ins["X"][0] - ins["Y"][0]
+    return {"Out": [jnp.square(d)]}
+
+
+@register_op(
+    "huber_loss", inputs=["X", "Y"], outputs=["Out", "Residual"]
+)
+def _huber(ctx, ins, attrs):
+    delta = attrs.get("delta", 1.0)
+    r = ins["Y"][0] - ins["X"][0]
+    absr = jnp.abs(r)
+    out = jnp.where(absr <= delta, 0.5 * r * r, delta * (absr - 0.5 * delta))
+    return {"Out": [out], "Residual": [r]}
+
+
+@register_op(
+    "sigmoid_cross_entropy_with_logits",
+    inputs=["X", "Label"],
+    outputs=["Out"],
+    no_grad_slots=("Label",),
+)
+def _sce_logits(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    out = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": [out]}
+
+
+@register_op("prelu", inputs=["X", "Alpha"], outputs=["Out"])
+def _prelu(ctx, ins, attrs):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(x >= 0, x, alpha * x)]}

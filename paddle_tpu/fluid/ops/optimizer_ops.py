@@ -1,0 +1,381 @@
+"""Optimizer update ops (cf. paddle/fluid/operators/optimizers/: sgd_op.cc,
+momentum_op.cc, adam_op.cc, lamb_op.cc, adagrad_op.cc, rmsprop_op.cc, ...).
+
+Reference semantics: each op reads Param/Grad/accumulators and writes
+ParamOut/...Out IN PLACE (output var name == input var name).  Here the
+in-place convention is preserved at the IR level; functionally the lowering
+returns new arrays and the executor's sequential env makes later ops see the
+update, with XLA donating buffers so updates really are in-place on device.
+
+All update math runs in the accumulator dtype (fp32 master weights for AMP
+come from the amp layer keeping Param fp32).
+"""
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op(
+    "sgd",
+    inputs=["Param", "Grad", "LearningRate"],
+    outputs=["ParamOut"],
+    grad=None,
+)
+def _sgd(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    return {"ParamOut": [(p - lr * g.astype(p.dtype)).astype(p.dtype)]}
+
+
+@register_op(
+    "momentum",
+    inputs=["Param", "Grad", "Velocity", "LearningRate"],
+    outputs=["ParamOut", "VelocityOut"],
+    grad=None,
+)
+def _momentum(ctx, ins, attrs):
+    p, g, v, lr = (
+        ins["Param"][0],
+        ins["Grad"][0],
+        ins["Velocity"][0],
+        ins["LearningRate"][0],
+    )
+    mu = attrs.get("mu", 0.9)
+    use_nesterov = attrs.get("use_nesterov", False)
+    g = g.astype(p.dtype)
+    v_out = mu * v + g
+    if use_nesterov:
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register_op(
+    "adam",
+    inputs=[
+        "Param",
+        "Grad",
+        "LearningRate",
+        "Moment1",
+        "Moment2",
+        "Beta1Pow",
+        "Beta2Pow",
+    ],
+    outputs=["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+    grad=None,
+)
+def _adam(ctx, ins, attrs):
+    p = ins["Param"][0]
+    g = ins["Grad"][0].astype(jnp.float32)
+    lr = ins["LearningRate"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p.astype(jnp.float32) - lr_t * m1o / (jnp.sqrt(m2o) + eps)
+    return {
+        "ParamOut": [p_out.astype(p.dtype)],
+        "Moment1Out": [m1o],
+        "Moment2Out": [m2o],
+        "Beta1PowOut": [b1p * b1],
+        "Beta2PowOut": [b2p * b2],
+    }
+
+
+@register_op(
+    "adamw",
+    inputs=[
+        "Param",
+        "Grad",
+        "LearningRate",
+        "Moment1",
+        "Moment2",
+        "Beta1Pow",
+        "Beta2Pow",
+    ],
+    outputs=["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+    grad=None,
+)
+def _adamw(ctx, ins, attrs):
+    """Decoupled weight decay Adam (2.0-era op, included for BERT recipes)."""
+    p = ins["Param"][0]
+    lr = ins["LearningRate"][0]
+    wd = attrs.get("coeff", 0.01)
+    out = _adam(ctx, ins, attrs)
+    p_out = out["ParamOut"][0] - lr * wd * p.astype(jnp.float32)
+    out["ParamOut"] = [p_out.astype(p.dtype)]
+    return out
+
+
+@register_op(
+    "lamb",
+    inputs=[
+        "Param",
+        "Grad",
+        "LearningRate",
+        "Moment1",
+        "Moment2",
+        "Beta1Pow",
+        "Beta2Pow",
+    ],
+    outputs=["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut", "Beta2PowOut"],
+    grad=None,
+)
+def _lamb(ctx, ins, attrs):
+    """cf. lamb_op.cc: layer-adaptive trust ratio on top of Adam."""
+    p = ins["Param"][0]
+    g = ins["Grad"][0].astype(jnp.float32)
+    lr = ins["LearningRate"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    pf = p.astype(jnp.float32)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * g * g
+    m1h = m1o / (1 - b1p)
+    m2h = m2o / (1 - b2p)
+    r = m1h / (jnp.sqrt(m2h) + eps) + wd * pf
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where(
+        (p_norm > 0) & (r_norm > 0), p_norm / r_norm, jnp.ones_like(p_norm)
+    )
+    p_out = pf - lr * trust * r
+    return {
+        "ParamOut": [p_out.astype(p.dtype)],
+        "Moment1Out": [m1o],
+        "Moment2Out": [m2o],
+        "Beta1PowOut": [b1p * b1],
+        "Beta2PowOut": [b2p * b2],
+    }
+
+
+@register_op(
+    "adagrad",
+    inputs=["Param", "Grad", "Moment", "LearningRate"],
+    outputs=["ParamOut", "MomentOut"],
+    grad=None,
+)
+def _adagrad(ctx, ins, attrs):
+    p, g, m, lr = (
+        ins["Param"][0],
+        ins["Grad"][0].astype(jnp.float32),
+        ins["Moment"][0],
+        ins["LearningRate"][0],
+    )
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = m + g * g
+    p_out = p.astype(jnp.float32) - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out.astype(p.dtype)], "MomentOut": [m_out]}
+
+
+@register_op(
+    "adadelta",
+    inputs=["Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate"],
+    outputs=["ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"],
+    grad=None,
+)
+def _adadelta(ctx, ins, attrs):
+    p = ins["Param"][0]
+    g = ins["Grad"][0].astype(jnp.float32)
+    g2 = ins["AvgSquaredGrad"][0]
+    u2 = ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g2o = rho * g2 + (1 - rho) * g * g
+    upd = -jnp.sqrt((u2 + eps) / (g2o + eps)) * g
+    u2o = rho * u2 + (1 - rho) * upd * upd
+    return {
+        "ParamOut": [(p.astype(jnp.float32) + upd).astype(p.dtype)],
+        "AvgSquaredGradOut": [g2o],
+        "AvgSquaredUpdateOut": [u2o],
+    }
+
+
+@register_op(
+    "rmsprop",
+    inputs=["Param", "Grad", "LearningRate", "Moment", "MeanSquare", "MeanGrad"],
+    outputs=["ParamOut", "MomentOut", "MeanSquareOut", "MeanGradOut"],
+    grad=None,
+)
+def _rmsprop(ctx, ins, attrs):
+    p = ins["Param"][0]
+    g = ins["Grad"][0].astype(jnp.float32)
+    lr = ins["LearningRate"][0]
+    mom = ins["Moment"][0]
+    ms = ins["MeanSquare"][0]
+    mg = ins["MeanGrad"][0]
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    momentum = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    ms_out = rho * ms + (1 - rho) * g * g
+    if centered:
+        mg_out = rho * mg + (1 - rho) * g
+        denom = ms_out - mg_out * mg_out + eps
+    else:
+        mg_out = mg
+        denom = ms_out + eps
+    mom_out = momentum * mom + lr * g / jnp.sqrt(denom)
+    p_out = p.astype(jnp.float32) - mom_out
+    return {
+        "ParamOut": [p_out.astype(p.dtype)],
+        "MomentOut": [mom_out],
+        "MeanSquareOut": [ms_out],
+        "MeanGradOut": [mg_out],
+    }
+
+
+@register_op(
+    "adamax",
+    inputs=["Param", "Grad", "LearningRate", "Moment", "InfNorm", "Beta1Pow"],
+    outputs=["ParamOut", "MomentOut", "InfNormOut"],
+    grad=None,
+)
+def _adamax(ctx, ins, attrs):
+    p = ins["Param"][0]
+    g = ins["Grad"][0].astype(jnp.float32)
+    lr = ins["LearningRate"][0]
+    m, inf, b1p = ins["Moment"][0], ins["InfNorm"][0], ins["Beta1Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g))
+    p_out = p.astype(jnp.float32) - (lr / (1 - b1p)) * (m_out / (inf_out + eps))
+    return {
+        "ParamOut": [p_out.astype(p.dtype)],
+        "MomentOut": [m_out],
+        "InfNormOut": [inf_out],
+    }
+
+
+@register_op(
+    "decayed_adagrad",
+    inputs=["Param", "Grad", "Moment", "LearningRate"],
+    outputs=["ParamOut", "MomentOut"],
+    grad=None,
+)
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, m, lr = (
+        ins["Param"][0],
+        ins["Grad"][0].astype(jnp.float32),
+        ins["Moment"][0],
+        ins["LearningRate"][0],
+    )
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = decay * m + (1 - decay) * g * g
+    p_out = p.astype(jnp.float32) - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out.astype(p.dtype)], "MomentOut": [m_out]}
+
+
+@register_op(
+    "ftrl",
+    inputs=["Param", "SquaredAccumulator", "LinearAccumulator", "Grad", "LearningRate"],
+    outputs=["ParamOut", "SquaredAccumOut", "LinearAccumOut"],
+    grad=None,
+)
+def _ftrl(ctx, ins, attrs):
+    p = ins["Param"][0].astype(jnp.float32)
+    sq = ins["SquaredAccumulator"][0]
+    lin = ins["LinearAccumulator"][0]
+    g = ins["Grad"][0].astype(jnp.float32)
+    lr = ins["LearningRate"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    new_sq = sq + g * g
+    sigma = (new_sq**-lr_power - sq**-lr_power) / lr
+    lin_out = lin + g - sigma * p
+    y = new_sq**-lr_power / lr + 2 * l2
+    p_out = jnp.where(
+        jnp.abs(lin_out) > l1,
+        (jnp.sign(lin_out) * l1 - lin_out) / y,
+        jnp.zeros_like(p),
+    )
+    return {
+        "ParamOut": [p_out.astype(ins["Param"][0].dtype)],
+        "SquaredAccumOut": [new_sq],
+        "LinearAccumOut": [lin_out],
+    }
+
+
+@register_op(
+    "lars_momentum",
+    inputs=["Param", "Grad", "Velocity", "LearningRate"],
+    outputs=["ParamOut", "VelocityOut"],
+    grad=None,
+)
+def _lars_momentum(ctx, ins, attrs):
+    """cf. lars_momentum_op.cc: local LR = lars_coeff * ||p|| / (||g|| + wd*||p||)."""
+    p = ins["Param"][0].astype(jnp.float32)
+    g = ins["Grad"][0].astype(jnp.float32)
+    v = ins["Velocity"][0]
+    lr = ins["LearningRate"][0]
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        coeff * p_norm / (g_norm + wd * p_norm + eps),
+        jnp.ones_like(p_norm),
+    )
+    v_out = mu * v + lr * local_lr * (g + wd * p)
+    p_out = p - v_out
+    return {
+        "ParamOut": [p_out.astype(ins["Param"][0].dtype)],
+        "VelocityOut": [v_out],
+    }
+
+
+@register_op(
+    "dpsgd",
+    inputs=["Param", "Grad", "LearningRate"],
+    outputs=["ParamOut"],
+    grad=None,
+    needs_rng=True,
+)
+def _dpsgd(ctx, ins, attrs):
+    """Differentially-private SGD (cf. dpsgd_op.cc): clip + gaussian noise."""
+    import jax
+
+    p, g, lr = ins["Param"][0], ins["Grad"][0].astype(jnp.float32), ins["LearningRate"][0]
+    clip = attrs.get("clip", 10.0)
+    batch_size = attrs.get("batch_size", 16.0)
+    sigma = attrs.get("sigma", 1.0)
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    scale = jnp.minimum(jnp.ones_like(g_norm), clip / jnp.maximum(g_norm, 1e-10))
+    noise = sigma * clip * jax.random.normal(ctx.rng(), g.shape, dtype=jnp.float32)
+    g_priv = (g * scale + noise) / batch_size
+    return {"ParamOut": [(p.astype(jnp.float32) - lr * g_priv).astype(p.dtype)]}
+
+
+OPTIMIZER_OP_TYPES = frozenset(
+    {
+        "sgd",
+        "momentum",
+        "adam",
+        "adamw",
+        "lamb",
+        "adagrad",
+        "adadelta",
+        "rmsprop",
+        "adamax",
+        "decayed_adagrad",
+        "ftrl",
+        "lars_momentum",
+        "dpsgd",
+    }
+)

@@ -1,0 +1,79 @@
+"""Random/initializer ops (cf. operators/gaussian_random_op.cc,
+uniform_random_op.cc, truncated_gaussian_random_op.cc, randperm_op.cc).
+
+TPU-first: stateless threefry PRNG.  If an op carries a nonzero `seed` attr it
+derives its own key (reproducible op, reference semantics); otherwise keys come
+from the executor-threaded program key via ctx.rng().
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtypes import to_jnp
+from ..core.registry import register_op
+
+
+def _key(ctx, attrs):
+    seed = attrs.get("seed", 0)
+    if seed:
+        return jax.random.PRNGKey(seed)
+    return ctx.rng()
+
+
+@register_op("gaussian_random", inputs=[], outputs=["Out"], grad=None, needs_rng=True)
+def _gaussian_random(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dtype = to_jnp(attrs.get("dtype", "float32"))
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.normal(
+        _key(ctx, attrs), shape, dtype=jnp.float32
+    )
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op("uniform_random", inputs=[], outputs=["Out"], grad=None, needs_rng=True)
+def _uniform_random(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dtype = to_jnp(attrs.get("dtype", "float32"))
+    out = jax.random.uniform(
+        _key(ctx, attrs),
+        shape,
+        minval=attrs.get("min", -1.0),
+        maxval=attrs.get("max", 1.0),
+        dtype=jnp.float32,
+    )
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op(
+    "truncated_gaussian_random", inputs=[], outputs=["Out"], grad=None, needs_rng=True
+)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dtype = to_jnp(attrs.get("dtype", "float32"))
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * jax.random.truncated_normal(
+        _key(ctx, attrs), -2.0, 2.0, shape, dtype=jnp.float32
+    )
+    return {"Out": [out.astype(dtype)]}
+
+
+@register_op("randint", inputs=[], outputs=["Out"], grad=None, needs_rng=True)
+def _randint(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    out = jax.random.randint(
+        _key(ctx, attrs), shape, attrs.get("low", 0), attrs["high"]
+    )
+    return {"Out": [out.astype(to_jnp(attrs.get("dtype", "int64")))]}
+
+
+@register_op("randperm", inputs=[], outputs=["Out"], grad=None, needs_rng=True)
+def _randperm(ctx, ins, attrs):
+    n = attrs["n"]
+    out = jax.random.permutation(_key(ctx, attrs), n)
+    return {"Out": [out.astype(to_jnp(attrs.get("dtype", "int64")))]}
+
+
+@register_op("bernoulli", inputs=["X"], outputs=["Out"], grad=None, needs_rng=True)
+def _bernoulli(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = jax.random.bernoulli(_key(ctx, attrs), x)
+    return {"Out": [out.astype(x.dtype)]}

@@ -1,0 +1,115 @@
+"""Reduction ops (cf. paddle/fluid/operators/reduce_ops/, mean_op.cc,
+arg_min_max ops, top_k_op.cc, argsort_op.cc)."""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _axes(attrs, ndim):
+    if attrs.get("reduce_all", False):
+        return None
+    dim = attrs.get("dim", [0])
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(d if d >= 0 else d + ndim for d in dim)
+
+
+def _register_reduce(name, fn):
+    @register_op("reduce_" + name, inputs=["X"], outputs=["Out"])
+    def _lower(ctx, ins, attrs, fn=fn):
+        x = ins["X"][0]
+        out = fn(x, axis=_axes(attrs, x.ndim), keepdims=attrs.get("keep_dim", False))
+        return {"Out": [out]}
+
+
+_register_reduce("sum", jnp.sum)
+_register_reduce("mean", jnp.mean)
+_register_reduce("max", jnp.max)
+_register_reduce("min", jnp.min)
+_register_reduce("prod", jnp.prod)
+
+
+@register_op("reduce_any", inputs=["X"], outputs=["Out"], grad=None)
+def _reduce_any(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.any(x, axis=_axes(attrs, x.ndim), keepdims=attrs.get("keep_dim", False))]}
+
+
+@register_op("reduce_all", inputs=["X"], outputs=["Out"], grad=None)
+def _reduce_all(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.all(x, axis=_axes(attrs, x.ndim), keepdims=attrs.get("keep_dim", False))]}
+
+
+@register_op("mean", inputs=["X"], outputs=["Out"])
+def _mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(ins["X"][0])]}
+
+
+@register_op("arg_max", inputs=["X"], outputs=["Out"], grad=None)
+def _arg_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    out = jnp.argmax(x, axis=axis)
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": [out.astype(jnp.int64)]}
+
+
+@register_op("arg_min", inputs=["X"], outputs=["Out"], grad=None)
+def _arg_min(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = jnp.argmin(x, axis=attrs.get("axis", -1))
+    return {"Out": [out.astype(jnp.int64)]}
+
+
+@register_op("top_k", inputs=["X"], outputs=["Out", "Indices"], grad=None)
+def _top_k(ctx, ins, attrs):
+    vals, idx = jax.lax.top_k(ins["X"][0], attrs.get("k", 1))
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+register_op("top_k_v2", inputs=["X"], outputs=["Out", "Indices"], grad=None)(_top_k)
+
+
+@register_op("argsort", inputs=["X"], outputs=["Out", "Indices"], grad=None)
+def _argsort(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("max", inputs=["X"], outputs=["Out"])
+def _max(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.max(x, axis=_axes(attrs, x.ndim), keepdims=attrs.get("keep_dim", False))]}
+
+
+@register_op("norm", inputs=["X"], outputs=["Out", "Norm"])
+def _norm(ctx, ins, attrs):
+    """L2-normalize along axis (cf. norm_op.cc)."""
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / n], "Norm": [n]}
+
+
+@register_op("p_norm", inputs=["X"], outputs=["Out"])
+def _p_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", -1)
+    keepdim = attrs.get("keepdim", False)
+    out = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+    return {"Out": [out]}
+
+
+@register_op("squared_l2_norm", inputs=["X"], outputs=["Out"])
+def _squared_l2_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.square(ins["X"][0])).reshape((1,))]}

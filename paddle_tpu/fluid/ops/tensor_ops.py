@@ -1,0 +1,349 @@
+"""Tensor manipulation ops: reshape/transpose/concat/..., fill, cast, compare.
+
+Capability parity: reference `paddle/fluid/operators/` reshape_op.cc,
+transpose_op.cc, concat_op.cc, split_op.cc, slice_op.cc, cast_op.cc,
+fill_constant_op.cc, gather_op.cc, one_hot_op.cc, compare ops in
+controlflow/, assign_op.cc, expand_op.cc, stack_op.cc.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtypes import to_jnp
+from ..core.registry import register_op
+
+
+@register_op("reshape2", inputs=["X"], outputs=["Out"])
+def _reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    # paddle semantics: 0 means "copy input dim", -1 inferred
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return {"Out": [x.reshape(shape)]}
+
+
+register_op("reshape", inputs=["X"], outputs=["Out"])(_reshape)
+
+
+@register_op("transpose2", inputs=["X"], outputs=["Out"])
+def _transpose(ctx, ins, attrs):
+    return {"Out": [jnp.transpose(ins["X"][0], attrs["axis"])]}
+
+
+register_op("transpose", inputs=["X"], outputs=["Out"])(_transpose)
+
+
+@register_op("flatten2", inputs=["X"], outputs=["Out"])
+def _flatten(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    lead = 1
+    for s in x.shape[:axis]:
+        lead *= int(s)
+    return {"Out": [x.reshape((lead, -1))]}
+
+
+register_op("flatten", inputs=["X"], outputs=["Out"])(_flatten)
+
+
+@register_op("flatten_contiguous_range", inputs=["X"], outputs=["Out"])
+def _flatten_range(ctx, ins, attrs):
+    x = ins["X"][0]
+    start = attrs.get("start_axis", 1)
+    stop = attrs.get("stop_axis", -1)
+    if stop < 0:
+        stop += x.ndim
+    mid = 1
+    for s in x.shape[start : stop + 1]:
+        mid *= int(s)
+    return {"Out": [x.reshape(x.shape[:start] + (mid,) + x.shape[stop + 1 :])]}
+
+
+@register_op("squeeze2", inputs=["X"], outputs=["Out"])
+def _squeeze(ctx, ins, attrs):
+    axes = attrs.get("axes", [])
+    x = ins["X"][0]
+    if not axes:
+        return {"Out": [jnp.squeeze(x)]}
+    axes = tuple(a if a >= 0 else a + x.ndim for a in axes)
+    return {"Out": [jnp.squeeze(x, axis=axes)]}
+
+
+register_op("squeeze", inputs=["X"], outputs=["Out"])(_squeeze)
+
+
+@register_op("unsqueeze2", inputs=["X"], outputs=["Out"])
+def _unsqueeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    for a in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": [x]}
+
+
+register_op("unsqueeze", inputs=["X"], outputs=["Out"])(_unsqueeze)
+
+
+@register_op("concat", inputs=["X"], outputs=["Out"])
+def _concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("split", inputs=["X"], outputs=["Out"])
+def _split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = []
+        acc = 0
+        for s in sections[:-1]:
+            acc += s
+            idx.append(acc)
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack", inputs=["X"], outputs=["Y"])
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("unstack", inputs=["X"], outputs=["Y"])
+def _unstack(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    n = x.shape[axis]
+    outs = [jnp.squeeze(a, axis) for a in jnp.split(x, n, axis=axis)]
+    return {"Y": outs}
+
+
+@register_op("slice", inputs=["Input"], outputs=["Out"])
+def _slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    for a in sorted(attrs.get("decrease_axis", []), reverse=True):
+        out = jnp.squeeze(out, a)
+    return {"Out": [out]}
+
+
+@register_op("strided_slice", inputs=["Input"], outputs=["Out"])
+def _strided_slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"], attrs["strides"]):
+        idx[a] = slice(s, e, st)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("cast", inputs=["X"], outputs=["Out"])
+def _cast(ctx, ins, attrs):
+    return {"Out": [ins["X"][0].astype(to_jnp(attrs["out_dtype"]))]}
+
+
+@register_op("assign", inputs=["X"], outputs=["Out"])
+def _assign(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("fill_constant", inputs=[], outputs=["Out"])
+def _fill_constant(ctx, ins, attrs):
+    shape = tuple(attrs.get("shape", []))
+    return {"Out": [jnp.full(shape, attrs["value"], dtype=to_jnp(attrs.get("dtype", "float32")))]}
+
+
+@register_op("assign_value", inputs=[], outputs=["Out"], grad=None)
+def _assign_value(ctx, ins, attrs):
+    import numpy as np
+
+    arr = np.array(attrs["values"], dtype=to_jnp(attrs.get("dtype", "float32"))).reshape(
+        attrs["shape"]
+    )
+    return {"Out": [jnp.asarray(arr)]}
+
+
+@register_op("fill_constant_batch_size_like", inputs=["Input"], outputs=["Out"], grad=None)
+def _fill_cbsl(ctx, ins, attrs):
+    x = ins["Input"][0]
+    shape = list(attrs["shape"])
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = x.shape[in_idx]
+    return {"Out": [jnp.full(tuple(shape), attrs["value"], dtype=to_jnp(attrs.get("dtype", "float32")))]}
+
+
+@register_op("fill_zeros_like", inputs=["X"], outputs=["Out"])
+def _fill_zeros_like(ctx, ins, attrs):
+    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+
+
+@register_op("fill_any_like", inputs=["X"], outputs=["Out"])
+def _fill_any_like(ctx, ins, attrs):
+    dtype = attrs.get("dtype")
+    out = jnp.full_like(ins["X"][0], attrs["value"], dtype=to_jnp(dtype) if dtype else None)
+    return {"Out": [out]}
+
+
+@register_op("gather", inputs=["X", "Index"], outputs=["Out"], no_grad_slots=("Index",))
+def _gather(ctx, ins, attrs):
+    return {"Out": [jnp.take(ins["X"][0], ins["Index"][0], axis=attrs.get("axis", 0))]}
+
+
+@register_op("gather_nd", inputs=["X", "Index"], outputs=["Out"], no_grad_slots=("Index",))
+def _gather_nd(ctx, ins, attrs):
+    x, index = ins["X"][0], ins["Index"][0]
+    return {"Out": [x[tuple(index[..., i] for i in range(index.shape[-1]))]]}
+
+
+@register_op("scatter", inputs=["X", "Ids", "Updates"], outputs=["Out"], no_grad_slots=("Ids",))
+def _scatter(ctx, ins, attrs):
+    x, ids, upd = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    if attrs.get("overwrite", True):
+        return {"Out": [x.at[ids].set(upd)]}
+    return {"Out": [x.at[ids].add(upd)]}
+
+
+@register_op("index_select", inputs=["X", "Index"], outputs=["Out"], no_grad_slots=("Index",))
+def _index_select(ctx, ins, attrs):
+    return {"Out": [jnp.take(ins["X"][0], ins["Index"][0], axis=attrs.get("dim", 0))]}
+
+
+@register_op("one_hot", inputs=["X"], outputs=["Out"], grad=None)
+def _one_hot(ctx, ins, attrs):
+    x = ins["X"][0]
+    depth = attrs["depth"]
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = jnp.squeeze(x, -1)
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+register_op("one_hot_v2", inputs=["X"], outputs=["Out"], grad=None)(_one_hot)
+
+
+@register_op("expand", inputs=["X"], outputs=["Out"])
+def _expand(ctx, ins, attrs):
+    x = ins["X"][0]
+    times = attrs["expand_times"]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register_op("expand_as", inputs=["X", "Y"], outputs=["Out"], no_grad_slots=("Y",))
+def _expand_as(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.broadcast_to(x, y.shape)]}
+
+
+@register_op("tile", inputs=["X"], outputs=["Out"])
+def _tile(ctx, ins, attrs):
+    return {"Out": [jnp.tile(ins["X"][0], attrs["repeat_times"])]}
+
+
+@register_op("pad", inputs=["X"], outputs=["Out"])
+def _pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("arange", inputs=[], outputs=["Out"], grad=None)
+def _arange(ctx, ins, attrs):
+    return {
+        "Out": [
+            jnp.arange(
+                attrs["start"], attrs["end"], attrs.get("step", 1),
+                dtype=to_jnp(attrs.get("dtype", "int64")),
+            )
+        ]
+    }
+
+
+@register_op("linspace", inputs=[], outputs=["Out"], grad=None)
+def _linspace(ctx, ins, attrs):
+    return {
+        "Out": [
+            jnp.linspace(
+                attrs["start"], attrs["stop"], attrs["num"],
+                dtype=to_jnp(attrs.get("dtype", "float32")),
+            )
+        ]
+    }
+
+
+# -- comparison / logical (cf. operators/controlflow/compare_op.cc) ----------
+
+def _register_compare(name, fn):
+    @register_op(name, inputs=["X", "Y"], outputs=["Out"], grad=None)
+    def _lower(ctx, ins, attrs, fn=fn):
+        return {"Out": [fn(ins["X"][0], ins["Y"][0])]}
+
+
+_register_compare("equal", jnp.equal)
+_register_compare("not_equal", jnp.not_equal)
+_register_compare("less_than", jnp.less)
+_register_compare("less_equal", jnp.less_equal)
+_register_compare("greater_than", jnp.greater)
+_register_compare("greater_equal", jnp.greater_equal)
+_register_compare("logical_and", jnp.logical_and)
+_register_compare("logical_or", jnp.logical_or)
+_register_compare("logical_xor", jnp.logical_xor)
+
+
+@register_op("logical_not", inputs=["X"], outputs=["Out"], grad=None)
+def _logical_not(ctx, ins, attrs):
+    return {"Out": [jnp.logical_not(ins["X"][0])]}
+
+
+@register_op("isfinite", inputs=["X"], outputs=["Out"], grad=None)
+def _isfinite(ctx, ins, attrs):
+    return {"Out": [jnp.all(jnp.isfinite(ins["X"][0]))]}
+
+
+@register_op("isnan", inputs=["X"], outputs=["Out"], grad=None)
+def _isnan(ctx, ins, attrs):
+    return {"Out": [jnp.isnan(ins["X"][0])]}
+
+
+@register_op("where", inputs=["Condition", "X", "Y"], outputs=["Out"], no_grad_slots=("Condition",))
+def _where(ctx, ins, attrs):
+    return {"Out": [jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("shape", inputs=["Input"], outputs=["Out"], grad=None)
+def _shape(ctx, ins, attrs):
+    return {"Out": [jnp.array(ins["Input"][0].shape, dtype=jnp.int32)]}
+
+
+@register_op("triu", inputs=["X"], outputs=["Out"])
+def _triu(ctx, ins, attrs):
+    return {"Out": [jnp.triu(ins["X"][0], k=attrs.get("diagonal", 0))]}
+
+
+@register_op("tril", inputs=["X"], outputs=["Out"])
+def _tril(ctx, ins, attrs):
+    return {"Out": [jnp.tril(ins["X"][0], k=attrs.get("diagonal", 0))]}
+
+
+@register_op("cumsum", inputs=["X"], outputs=["Out"])
+def _cumsum(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    return {"Out": [out]}
+
+
+@register_op("increment", inputs=["X"], outputs=["Out"], grad=None)
+def _increment(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}

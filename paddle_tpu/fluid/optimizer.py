@@ -1,0 +1,458 @@
+"""Optimizers: minimize() = append_backward + optimization pass.
+
+Capability parity: reference `python/paddle/fluid/optimizer.py` — base
+Optimizer:55 (minimize = append_backward + _create_optimization_pass, global
+LR var, per-param accumulators as persistable vars), SGD:918, Momentum:1012,
+LarsMomentum:1562, Adagrad:1676, Adam:1792, Adamax:2058, Dpsgd:2230,
+DecayedAdagrad:2325, Adadelta:2435, RMSProp:2554, Ftrl:2742, Lamb:2901.
+
+The update math itself is in ops/optimizer_ops.py; state (accumulators) are
+persistable vars initialized by the startup program, so checkpoint/resume of
+optimizer state is automatic (reference behavior).
+"""
+
+from __future__ import annotations
+
+from . import framework, unique_name
+from .backward import append_backward
+from .framework import Variable, default_startup_program
+from .initializer import ConstantInitializer
+from .layer_helper import LayerHelper
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name or unique_name.generate(type(self).__name__.lower())
+        self._accumulators = {}  # acc_name -> {param_name: Variable}
+        self._lr_var = None
+        self.helper = None
+
+    # -- learning rate ------------------------------------------------------
+    def _global_learning_rate(self):
+        if self._lr_var is not None:
+            return self._lr_var
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return self._lr_var
+        block = framework.default_main_program().global_block
+        name = unique_name.generate("learning_rate")
+        self._lr_var = block.create_var(
+            name=name, shape=(1,), dtype="float32", persistable=True,
+            stop_gradient=True,
+        )
+        sb = default_startup_program().global_block
+        sb.create_var(name=name, shape=(1,), dtype="float32", persistable=True,
+                      stop_gradient=True)
+        sb.append_op(
+            "fill_constant",
+            outputs={"Out": [name]},
+            attrs={"shape": [1], "value": float(self._learning_rate),
+                   "dtype": "float32"},
+            infer=False,
+        )
+        return self._lr_var
+
+    def current_step_lr(self):
+        from .core.scope import global_scope
+
+        v = global_scope().find_var(self._global_learning_rate().name)
+        return float(v[0]) if v is not None else float(self._learning_rate)
+
+    # -- accumulators -------------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None, dtype="float32"):
+        if name in self._accumulators and param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        shape = list(shape if shape is not None else param.shape)
+        var_name = unique_name.generate(param.name + "_" + name)
+        mb = framework.default_main_program().global_block
+        v = mb.create_var(
+            name=var_name, shape=shape, dtype=dtype, persistable=True,
+            stop_gradient=True,
+        )
+        sb = default_startup_program().global_block
+        sb.create_var(name=var_name, shape=shape, dtype=dtype, persistable=True,
+                      stop_gradient=True)
+        sb.append_op(
+            "fill_constant",
+            outputs={"Out": [var_name]},
+            attrs={"shape": shape, "value": float(fill_value), "dtype": dtype},
+            infer=False,
+        )
+        self._accumulators.setdefault(name, {})[param.name] = v
+        return v
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- the per-op hook subclasses implement --------------------------------
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    # -- public API ---------------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        return append_backward(loss, parameter_list, no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        block = framework.default_main_program().global_block
+        first_op_idx = len(block.ops)
+        # reference order (optimizer.py apply_gradients): clip the raw
+        # gradients FIRST, then append weight-decay regularization unclipped
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        from .regularizer import append_regularization_ops
+
+        params_grads = append_regularization_ops(params_grads, self.regularization)
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        for pg in params_grads:
+            self._append_optimize_op(block, pg)
+        self._finish_update(block, params_grads)
+        # tag for clone(for_test) pruning (cf. OpRole.Optimize)
+        for op in block.ops[first_op_idx:]:
+            op.attrs.setdefault("op_role", "optimize")
+        return params_grads
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        params_grads = self.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        self.apply_gradients(params_grads)
+        return [], params_grads
+
+    # helper for emitting update ops with the in-place convention
+    def _emit(self, block, type, param, grad, extra_inputs, extra_outputs, attrs):
+        inputs = {
+            "Param": [param.name],
+            "Grad": [grad.name],
+            "LearningRate": [self._global_learning_rate().name],
+        }
+        for k, v in extra_inputs.items():
+            inputs[k] = [v.name if isinstance(v, Variable) else v]
+        outputs = {"ParamOut": [param.name]}
+        for k, v in extra_outputs.items():
+            outputs[k] = [v.name if isinstance(v, Variable) else v]
+        block.append_op(type, inputs=inputs, outputs=outputs, attrs=attrs, infer=False)
+
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        self._emit(block, "sgd", p, g, {}, {}, {})
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        self._emit(
+            block, "momentum", p, g,
+            {"Velocity": v}, {"VelocityOut": v},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        self._emit(
+            block, "lars_momentum", p, g,
+            {"Velocity": v}, {"VelocityOut": v},
+            {"mu": self._momentum, "lars_coeff": self._lars_coeff,
+             "lars_weight_decay": self._lars_weight_decay},
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        self._emit(
+            block, "adagrad", p, g, {"Moment": m}, {"MomentOut": m},
+            {"epsilon": self._epsilon},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    _op_type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2, shape=[1])
+
+    def _extra_attrs(self):
+        return {}
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        attrs = {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon}
+        attrs.update(self._extra_attrs())
+        self._emit(
+            block, self._op_type, p, g,
+            {"Moment1": m1, "Moment2": m2, "Beta1Pow": b1p, "Beta2Pow": b2p},
+            {"Moment1Out": m1, "Moment2Out": m2, "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+            attrs,
+        )
+
+
+class AdamWOptimizer(AdamOptimizer):
+    """Decoupled weight decay (2.0-era paddle.optimizer.AdamW parity)."""
+
+    _op_type = "adamw"
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self._coeff = weight_decay
+
+    def _extra_attrs(self):
+        return {"coeff": self._coeff}
+
+
+class LambOptimizer(AdamOptimizer):
+    """cf. reference optimizer.py Lamb:2901."""
+
+    _op_type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2, epsilon=epsilon, **kw)
+        self._weight_decay = lamb_weight_decay
+
+    def _extra_attrs(self):
+        return {"weight_decay": self._weight_decay}
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        self._emit(
+            block, "adamax", p, g,
+            {
+                "Moment": self._get_accumulator("moment", p),
+                "InfNorm": self._get_accumulator("inf_norm", p),
+                "Beta1Pow": self._get_accumulator("beta1_pow_acc", p),
+            },
+            {
+                "MomentOut": self._get_accumulator("moment", p),
+                "InfNormOut": self._get_accumulator("inf_norm", p),
+            },
+            {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon},
+        )
+
+    def _finish_update(self, block, params_grads):
+        # beta1_pow *= beta1 each step (reference does this with a scale op)
+        for p, _ in params_grads:
+            b1p = self._get_accumulator("beta1_pow_acc", p)
+            block.append_op(
+                "scale",
+                inputs={"X": [b1p.name]},
+                outputs={"Out": [b1p.name]},
+                attrs={"scale": self._beta1},
+                infer=False,
+            )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        self._emit(
+            block, "decayed_adagrad", p, g, {"Moment": m}, {"MomentOut": m},
+            {"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        g2 = self._get_accumulator("avg_squared_grad", p)
+        u2 = self._get_accumulator("avg_squared_update", p)
+        block.append_op(
+            "adadelta",
+            inputs={
+                "Param": [p.name], "Grad": [g.name],
+                "AvgSquaredGrad": [g2.name], "AvgSquaredUpdate": [u2.name],
+            },
+            outputs={
+                "ParamOut": [p.name],
+                "AvgSquaredGradOut": [g2.name],
+                "AvgSquaredUpdateOut": [u2.name],
+            },
+            attrs={"rho": self._rho, "epsilon": self._epsilon},
+            infer=False,
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("momentum", p)
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        self._emit(
+            block, "rmsprop", p, g,
+            {
+                "Moment": self._get_accumulator("momentum", p),
+                "MeanSquare": self._get_accumulator("mean_square", p),
+                "MeanGrad": self._get_accumulator("mean_grad", p),
+            },
+            {
+                "MomentOut": self._get_accumulator("momentum", p),
+                "MeanSquareOut": self._get_accumulator("mean_square", p),
+                "MeanGradOut": self._get_accumulator("mean_grad", p),
+            },
+            {"decay": self._rho, "epsilon": self._epsilon,
+             "momentum": self._momentum, "centered": self._centered},
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        sq = self._get_accumulator("squared", p)
+        lin = self._get_accumulator("linear", p)
+        block.append_op(
+            "ftrl",
+            inputs={
+                "Param": [p.name],
+                "SquaredAccumulator": [sq.name],
+                "LinearAccumulator": [lin.name],
+                "Grad": [g.name],
+                "LearningRate": [self._global_learning_rate().name],
+            },
+            outputs={
+                "ParamOut": [p.name],
+                "SquaredAccumOut": [sq.name],
+                "LinearAccumOut": [lin.name],
+            },
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+            infer=False,
+        )
+
+
+class DpsgdOptimizer(Optimizer):
+    def __init__(self, learning_rate, clip=10.0, batch_size=16.0, sigma=1.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        self._emit(
+            block, "dpsgd", p, g, {}, {},
+            {"clip": self._clip, "batch_size": self._batch_size, "sigma": self._sigma},
+        )
+
+
+# reference-style lowercase aliases (cf. optimizer.py bottom: SGD = SGDOptimizer)
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+Dpsgd = DpsgdOptimizer
+LarsMomentum = LarsMomentumOptimizer

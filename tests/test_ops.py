@@ -1,0 +1,405 @@
+"""Op-level golden tests vs numpy oracles + finite-difference grad checks.
+
+Mirrors the reference's per-op test files (tests/unittests/test_*_op.py):
+outputs pinned by numpy, analytic grads (auto-VJP path) pinned by central
+finite differences.
+"""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, check_output
+
+
+def _rand(*shape, seed=0):
+    return np.random.RandomState(seed).uniform(-1, 1, shape).astype(np.float32)
+
+
+class TestElementwise:
+    def test_add_same_shape(self):
+        x, y = _rand(3, 4), _rand(3, 4, seed=1)
+        check_output("elementwise_add", {"X": x, "Y": y}, {}, {"Out": x + y})
+
+    def test_add_broadcast_axis(self):
+        x, y = _rand(2, 3, 4), _rand(3, seed=1)
+        check_output(
+            "elementwise_add", {"X": x, "Y": y}, {"axis": 1},
+            {"Out": x + y.reshape(1, 3, 1)},
+        )
+
+    def test_sub_grad(self):
+        x, y = _rand(3, 4), _rand(3, 4, seed=1)
+        check_grad("elementwise_sub", {"X": x, "Y": y}, {}, ["Out"], ["X", "Y"])
+
+    def test_mul_grad(self):
+        x, y = _rand(3, 4), _rand(3, 4, seed=1)
+        check_grad("elementwise_mul", {"X": x, "Y": y}, {}, ["Out"], ["X", "Y"])
+
+    def test_div(self):
+        x = _rand(3, 4)
+        y = _rand(3, 4, seed=1) + 2.0
+        check_output("elementwise_div", {"X": x, "Y": y}, {}, {"Out": x / y})
+
+
+class TestActivations:
+    def test_relu(self):
+        x = _rand(4, 5)
+        check_output("relu", {"X": x}, {}, {"Out": np.maximum(x, 0)})
+
+    def test_sigmoid_grad(self):
+        x = _rand(3, 4)
+        check_grad("sigmoid", {"X": x}, {}, ["Out"], ["X"])
+
+    def test_tanh(self):
+        x = _rand(3, 4)
+        check_output("tanh", {"X": x}, {}, {"Out": np.tanh(x)})
+        check_grad("tanh", {"X": x}, {}, ["Out"], ["X"])
+
+    def test_gelu(self):
+        from scipy.stats import norm
+
+        x = _rand(3, 4)
+        check_output(
+            "gelu", {"X": x}, {}, {"Out": x * norm.cdf(x)}, rtol=1e-4, atol=1e-5
+        )
+
+    def test_square_grad(self):
+        x = _rand(3, 4)
+        check_grad("square", {"X": x}, {}, ["Out"], ["X"])
+
+
+class TestMatmul:
+    def test_matmul(self):
+        x, y = _rand(3, 4), _rand(4, 5, seed=1)
+        check_output("matmul", {"X": x, "Y": y}, {}, {"Out": x @ y})
+
+    def test_matmul_transpose(self):
+        x, y = _rand(4, 3), _rand(5, 4, seed=1)
+        check_output(
+            "matmul", {"X": x, "Y": y},
+            {"transpose_X": True, "transpose_Y": True},
+            {"Out": x.T @ y.T},
+        )
+
+    def test_matmul_batched(self):
+        x, y = _rand(2, 3, 4), _rand(2, 4, 5, seed=1)
+        check_output("matmul", {"X": x, "Y": y}, {}, {"Out": x @ y})
+
+    def test_matmul_grad(self):
+        x, y = _rand(3, 4), _rand(4, 5, seed=1)
+        check_grad("matmul", {"X": x, "Y": y}, {}, ["Out"], ["X", "Y"])
+
+    def test_mul_flatten(self):
+        x, y = _rand(2, 3, 4), _rand(12, 5, seed=1)
+        check_output(
+            "mul", {"X": x, "Y": y}, {"x_num_col_dims": 1, "y_num_col_dims": 1},
+            {"Out": x.reshape(2, 12) @ y},
+        )
+
+
+class TestConvPool:
+    def test_conv2d(self):
+        import scipy.signal
+
+        x = _rand(1, 1, 5, 5)
+        w = _rand(1, 1, 3, 3, seed=1)
+        ref = scipy.signal.correlate2d(x[0, 0], w[0, 0], mode="valid")
+        check_output(
+            "conv2d", {"Input": x, "Filter": w},
+            {"strides": [1, 1], "paddings": [0, 0]},
+            {"Output": ref[None, None]}, rtol=1e-4, atol=1e-5,
+        )
+
+    def test_conv2d_grad(self):
+        x = _rand(2, 2, 4, 4)
+        w = _rand(3, 2, 3, 3, seed=1)
+        check_grad(
+            "conv2d", {"Input": x, "Filter": w},
+            {"strides": [1, 1], "paddings": [1, 1]},
+            ["Output"], ["Input", "Filter"], rtol=1e-2, atol=1e-3,
+        )
+
+    def test_pool2d_max(self):
+        x = _rand(1, 1, 4, 4)
+        ref = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+        check_output(
+            "pool2d", {"X": x},
+            {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2]},
+            {"Out": ref},
+        )
+
+    def test_pool2d_avg(self):
+        x = _rand(1, 1, 4, 4)
+        ref = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+        check_output(
+            "pool2d", {"X": x},
+            {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2]},
+            {"Out": ref},
+        )
+
+
+class TestNorms:
+    def test_layer_norm(self):
+        x = _rand(4, 10)
+        mean = x.mean(axis=1, keepdims=True)
+        var = x.var(axis=1, keepdims=True)
+        ref = (x - mean) / np.sqrt(var + 1e-5)
+        check_output(
+            "layer_norm", {"X": x}, {"begin_norm_axis": 1, "epsilon": 1e-5},
+            {"Y": ref}, rtol=1e-4, atol=1e-5,
+        )
+
+    def test_layer_norm_grad(self):
+        x = _rand(3, 6)
+        s = _rand(6, seed=1)
+        b = _rand(6, seed=2)
+        check_grad(
+            "layer_norm", {"X": x, "Scale": s, "Bias": b},
+            {"begin_norm_axis": 1}, ["Y"], ["X", "Scale", "Bias"],
+            rtol=1e-2, atol=1e-3,
+        )
+
+    def test_batch_norm_train(self):
+        x = _rand(4, 3, 2, 2)
+        scale = np.ones(3, np.float32)
+        bias = np.zeros(3, np.float32)
+        mean = np.zeros(3, np.float32)
+        var = np.ones(3, np.float32)
+        mu = x.mean(axis=(0, 2, 3))
+        v = x.var(axis=(0, 2, 3))
+        ref = (x - mu.reshape(1, 3, 1, 1)) / np.sqrt(v.reshape(1, 3, 1, 1) + 1e-5)
+        check_output(
+            "batch_norm",
+            {"X": x, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": var},
+            {"momentum": 0.9, "epsilon": 1e-5},
+            {"Y": ref}, rtol=1e-4, atol=1e-4,
+        )
+
+
+class TestSoftmaxXent:
+    def test_softmax(self):
+        x = _rand(3, 5)
+        e = np.exp(x - x.max(axis=-1, keepdims=True))
+        check_output("softmax", {"X": x}, {}, {"Out": e / e.sum(-1, keepdims=True)})
+
+    def test_softmax_with_cross_entropy(self):
+        logits = _rand(4, 6)
+        label = np.array([[0], [2], [5], [1]], dtype=np.int64)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(4), label[:, 0]])[:, None]
+        outs, _ = None, None
+        from op_test import run_single_op
+
+        outs, _ = run_single_op(
+            "softmax_with_cross_entropy",
+            {"Logits": logits, "Label": label},
+            {}, ["Softmax", "Loss"],
+        )
+        np.testing.assert_allclose(outs["Softmax"], sm, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(outs["Loss"], loss, rtol=1e-5, atol=1e-6)
+
+    def test_xent_grad_is_softmax_minus_onehot(self):
+        logits = _rand(3, 4)
+        label = np.array([[1], [0], [3]], dtype=np.int64)
+        from op_test import run_single_op
+
+        _, grads = run_single_op(
+            "softmax_with_cross_entropy",
+            {"Logits": logits, "Label": label},
+            {}, ["Loss", "Softmax"],  # loss first => sum(Loss) differentiated
+            grad_of=[("Logits", 0)],
+        )
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        onehot = np.eye(4, dtype=np.float32)[label[:, 0]]
+        np.testing.assert_allclose(
+            grads["logits_0@GRAD"], sm - onehot, rtol=1e-4, atol=1e-5
+        )
+
+
+class TestReduce:
+    def test_reduce_sum_dims(self):
+        x = _rand(2, 3, 4)
+        check_output(
+            "reduce_sum", {"X": x}, {"dim": [1]}, {"Out": x.sum(axis=1)}
+        )
+
+    def test_reduce_mean_all(self):
+        x = _rand(2, 3)
+        check_output(
+            "reduce_mean", {"X": x}, {"reduce_all": True},
+            {"Out": np.array(x.mean(), dtype=np.float32)},
+        )
+
+    def test_reduce_max_grad(self):
+        x = np.array([[1.0, 5.0], [7.0, 2.0]], dtype=np.float32)
+        check_grad("reduce_max", {"X": x}, {"dim": [1]}, ["Out"], ["X"])
+
+
+class TestManip:
+    def test_reshape(self):
+        x = _rand(2, 6)
+        check_output("reshape2", {"X": x}, {"shape": [3, 4]}, {"Out": x.reshape(3, 4)})
+
+    def test_reshape_zero_and_minus1(self):
+        x = _rand(2, 3, 4)
+        check_output(
+            "reshape2", {"X": x}, {"shape": [0, -1]}, {"Out": x.reshape(2, 12)}
+        )
+
+    def test_transpose(self):
+        x = _rand(2, 3, 4)
+        check_output(
+            "transpose2", {"X": x}, {"axis": [2, 0, 1]},
+            {"Out": x.transpose(2, 0, 1)},
+        )
+
+    def test_concat_grad(self):
+        a, b = _rand(2, 3), _rand(2, 5, seed=1)
+        check_grad("concat", {"X": [a, b]}, {"axis": 1}, ["Out"], ["X"])
+
+    def test_slice(self):
+        x = _rand(4, 5)
+        check_output(
+            "slice", {"Input": x},
+            {"axes": [0, 1], "starts": [1, 0], "ends": [3, 2]},
+            {"Out": x[1:3, 0:2]},
+        )
+
+    def test_stack(self):
+        a, b = _rand(2, 3), _rand(2, 3, seed=1)
+        from op_test import run_single_op
+
+        outs, _ = run_single_op("stack", {"X": [a, b]}, {"axis": 0}, ["Y"])
+        np.testing.assert_allclose(outs["Y"], np.stack([a, b]))
+
+
+class TestEmbedding:
+    def test_lookup(self):
+        w = _rand(10, 4)
+        ids = np.array([[1], [3], [7]], dtype=np.int64)
+        check_output(
+            "lookup_table", {"W": w, "Ids": ids}, {"padding_idx": -1},
+            {"Out": w[ids[:, 0]]},
+        )
+
+    def test_lookup_grad(self):
+        w = _rand(6, 3)
+        ids = np.array([[0], [2], [2]], dtype=np.int64)
+        from op_test import run_single_op
+
+        _, grads = run_single_op(
+            "lookup_table", {"W": w, "Ids": ids}, {"padding_idx": -1},
+            ["Out"], grad_of=[("W", 0)],
+        )
+        expected = np.zeros_like(w)
+        for i in ids[:, 0]:
+            expected[i] += 1.0
+        np.testing.assert_allclose(grads["w_0@GRAD"], expected)
+
+
+class TestDropout:
+    def test_dropout_test_mode(self):
+        x = _rand(4, 5)
+        check_output(
+            "dropout", {"X": x},
+            {"dropout_prob": 0.3, "is_test": True,
+             "dropout_implementation": "upscale_in_train"},
+            {"Out": x},
+        )
+
+    def test_dropout_train_mask_consistency(self):
+        from op_test import run_single_op
+
+        x = np.ones((100, 100), dtype=np.float32)
+        outs, grads = run_single_op(
+            "dropout", {"X": x},
+            {"dropout_prob": 0.5, "dropout_implementation": "upscale_in_train"},
+            ["Out", "Mask"], grad_of=[("X", 0)],
+        )
+        mask = outs["Mask"].astype(np.float32)
+        # forward uses the mask
+        np.testing.assert_allclose(outs["Out"], x * mask / 0.5, rtol=1e-5)
+        # grad reuses the SAME mask (custom grad op, not fresh rng)
+        np.testing.assert_allclose(grads["x_0@GRAD"], mask / 0.5, rtol=1e-5)
+        assert 0.3 < mask.mean() < 0.7
+
+
+class TestOptimizerOps:
+    def test_sgd(self):
+        from op_test import run_single_op
+
+        p, g = _rand(4), _rand(4, seed=1)
+        lr = np.array([0.1], dtype=np.float32)
+        outs, _ = run_single_op(
+            "sgd", {"Param": p, "Grad": g, "LearningRate": lr}, {}, ["ParamOut"]
+        )
+        np.testing.assert_allclose(outs["ParamOut"], p - 0.1 * g, rtol=1e-6)
+
+    def test_adam_step(self):
+        from op_test import run_single_op
+
+        p, g = _rand(4), _rand(4, seed=1)
+        lr = np.array([0.01], dtype=np.float32)
+        m1 = np.zeros(4, np.float32)
+        m2 = np.zeros(4, np.float32)
+        b1p = np.array([0.9], np.float32)
+        b2p = np.array([0.999], np.float32)
+        outs, _ = run_single_op(
+            "adam",
+            {"Param": p, "Grad": g, "LearningRate": lr, "Moment1": m1,
+             "Moment2": m2, "Beta1Pow": b1p, "Beta2Pow": b2p},
+            {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+            ["ParamOut", "Moment1Out", "Moment2Out"],
+        )
+        m1_ref = 0.1 * g
+        m2_ref = 0.001 * g * g
+        lr_t = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+        ref = p - lr_t * m1_ref / (np.sqrt(m2_ref) + 1e-8)
+        np.testing.assert_allclose(outs["ParamOut"], ref, rtol=1e-5, atol=1e-6)
+
+
+class TestConvTranspose:
+    def test_conv2d_transpose_output_shape_and_value(self):
+        import torch
+        import torch.nn.functional as F
+
+        x = _rand(2, 3, 4, 4)
+        w = _rand(3, 5, 3, 3, seed=1)  # IOHW: [Cin, Cout, kh, kw]
+        ref = F.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(w), stride=2, padding=1
+        ).numpy()
+        check_output(
+            "conv2d_transpose", {"Input": x, "Filter": w},
+            {"strides": [2, 2], "paddings": [1, 1]},
+            {"Output": ref}, rtol=1e-4, atol=1e-4,
+        )
+
+    def test_conv2d_transpose_grad(self):
+        x = _rand(1, 2, 3, 3)
+        w = _rand(2, 2, 2, 2, seed=1)
+        check_grad(
+            "conv2d_transpose", {"Input": x, "Filter": w},
+            {"strides": [1, 1], "paddings": [0, 0]},
+            ["Output"], ["Input", "Filter"], rtol=1e-2, atol=1e-3,
+        )
+
+
+class TestEmbeddingPadding:
+    def test_negative_padding_idx_resolved_by_layer(self):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import layers
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = layers.data("ids", shape=[1], dtype="int64")
+            emb = layers.embedding(ids, size=[10, 4], padding_idx=-1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out, = exe.run(
+            main, feed={"ids": np.array([[9], [1]], dtype=np.int64)},
+            fetch_list=[emb],
+        )
+        assert np.all(out[0] == 0.0)  # row 9 == vocab-1 is the padding row
+        assert np.any(out[1] != 0.0)
