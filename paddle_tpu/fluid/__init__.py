@@ -9,11 +9,14 @@ from . import (  # noqa: F401
     backward,
     clip,
     initializer,
+    io,
     layers,
     optimizer,
+    reader,
     regularizer,
     unique_name,
 )
+from .reader import DataLoader  # noqa: F401
 from .backward import append_backward, gradients  # noqa: F401
 from .core.place import (  # noqa: F401
     CPUPlace,

@@ -1,0 +1,223 @@
+"""Checkpointing & deployment export.
+
+Capability parity: reference `python/paddle/fluid/io.py` — save_vars:224,
+save_params, save_persistables:598, load_vars, load_persistables,
+save_inference_model:1093 (prunes to the feed/fetch subgraph + serialized
+program), load_inference_model:1303, unified save:1598/load:1662
+(.pdparams/.pdopt), load_program_state:1833 / set_program_state.
+
+TPU-first: values are host numpy arrays saved via npz (no save/load ops in
+the program — the executor scope is the source of truth); the serialized
+program is the JSON IR from framework.py.
+"""
+
+import os
+import pickle
+
+import numpy as np
+
+from . import framework
+from .core.scope import global_scope
+
+
+def _collect_vars(program, predicate):
+    return [v for v in program.list_vars() if predicate(v)]
+
+
+def _is_persistable(v):
+    return v.persistable and not v.is_data
+
+
+def _is_param(v):
+    return isinstance(v, framework.Parameter)
+
+
+def _save_var_dict(dirname, var_values, filename=None):
+    os.makedirs(dirname, exist_ok=True)
+    if filename:
+        np.savez(os.path.join(dirname, filename), **var_values)
+    else:
+        for name, val in var_values.items():
+            np.save(os.path.join(dirname, name.replace("/", "__slash__")), val)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = _collect_vars(program, predicate or _is_persistable)
+    scope = global_scope()
+    values = {}
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            raise RuntimeError("variable %s has no value in scope" % v.name)
+        values[v.name] = np.asarray(val)
+    _save_var_dict(dirname, values, filename)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=_is_param, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=_is_persistable,
+              filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = _collect_vars(program, predicate or _is_persistable)
+    scope = global_scope()
+    if filename:
+        data = np.load(os.path.join(dirname, filename), allow_pickle=False)
+        get = lambda name: data[name]
+    else:
+        def get(name):
+            path = os.path.join(dirname, name.replace("/", "__slash__") + ".npy")
+            return np.load(path)
+
+    import jax
+
+    for v in vars:
+        arr = get(v.name)
+        scope.set(v.name, jax.device_put(arr))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_param, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_persistable,
+              filename=filename)
+
+
+# ---------------------------------------------------------------------------
+# graph pruning for inference export
+# ---------------------------------------------------------------------------
+
+def _prune_program(program, feed_names, target_names):
+    """Keep only ops backward-reachable from targets, stopping at feeds
+    (cf. reference Program._prune_with_input used by save_inference_model)."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block
+    needed = set(target_names)
+    keep = []
+    for op in reversed(block.ops):
+        outs = op.all_output_names()
+        if any(n in needed for n in outs):
+            keep.append(op)
+            for n in op.all_input_names():
+                if n not in feed_names:
+                    needed.add(n)
+    keep.reverse()
+    block.ops = keep
+    # drop vars not referenced anymore (keep feeds + referenced)
+    referenced = set(feed_names) | set(target_names)
+    for op in keep:
+        referenced.update(op.all_input_names())
+        referenced.update(op.all_output_names())
+    block.vars = {k: v for k, v in block.vars.items() if k in referenced}
+    for name in feed_names:
+        if name in block.vars:
+            block.vars[name].is_data = True
+    pruned._bump()
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None):
+    """cf. reference io.py:1093 — prune to the inference subgraph, serialize
+    the program + parameters."""
+    program = main_program or framework.default_main_program()
+    target_names = [
+        t.name if isinstance(t, framework.Variable) else t for t in target_vars
+    ]
+    pruned = _prune_program(program, list(feeded_var_names), target_names)
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or "__model__.json")
+    with open(model_path, "w") as f:
+        f.write(pruned.to_json())
+    meta = {"feed_names": list(feeded_var_names), "fetch_names": target_names}
+    with open(os.path.join(dirname, "__meta__.pkl"), "wb") as f:
+        pickle.dump(meta, f)
+    save_vars(
+        executor, dirname, pruned,
+        predicate=lambda v: v.persistable and not v.is_data,
+        filename=params_filename,
+    )
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """Returns [program, feed_names, fetch_vars] (reference signature)."""
+    model_path = os.path.join(dirname, model_filename or "__model__.json")
+    with open(model_path) as f:
+        program = framework.Program.from_json(f.read())
+    with open(os.path.join(dirname, "__meta__.pkl"), "rb") as f:
+        meta = pickle.load(f)
+    load_vars(
+        executor, dirname, program,
+        predicate=lambda v: v.persistable and not v.is_data,
+        filename=params_filename,
+    )
+    fetch_vars = [program.global_block.var(n) for n in meta["fetch_names"]]
+    return [program, meta["feed_names"], fetch_vars]
+
+
+# ---------------------------------------------------------------------------
+# unified save/load (.pdparams / .pdopt) — cf. reference io.py:1598
+# ---------------------------------------------------------------------------
+
+def save(program, model_path):
+    scope = global_scope()
+    params = {}
+    opt = {}
+    for v in program.list_vars():
+        if not v.persistable or v.is_data:
+            continue
+        val = scope.find_var(v.name)
+        if val is None:
+            continue
+        (params if _is_param(v) else opt)[v.name] = np.asarray(val)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(params, f)
+    with open(model_path + ".pdopt", "wb") as f:
+        pickle.dump(opt, f)
+    with open(model_path + ".pdmodel", "w") as f:
+        f.write(program.to_json())
+
+
+def load(program, model_path, executor=None):
+    state = load_program_state(model_path)
+    set_program_state(program, state)
+
+
+def load_program_state(model_path):
+    state = {}
+    for suffix in (".pdparams", ".pdopt"):
+        path = model_path + suffix
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                state.update(pickle.load(f))
+    return state
+
+
+def set_program_state(program, state_dict):
+    import jax
+
+    scope = global_scope()
+    missing = []
+    for v in program.list_vars():
+        if not v.persistable or v.is_data:
+            continue
+        if v.name in state_dict:
+            scope.set(v.name, jax.device_put(np.asarray(state_dict[v.name])))
+        else:
+            missing.append(v.name)
+    return missing

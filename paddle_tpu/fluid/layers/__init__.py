@@ -1,6 +1,16 @@
 """Layer (op wrapper) API — cf. reference python/paddle/fluid/layers/."""
 
-from . import loss, nn, ops, tensor  # noqa: F401
+from . import learning_rate_scheduler, loss, nn, ops, tensor  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    cosine_decay,
+    exponential_decay,
+    inverse_time_decay,
+    linear_lr_warmup,
+    natural_exp_decay,
+    noam_decay,
+    piecewise_decay,
+    polynomial_decay,
+)
 from .loss import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403

@@ -1,0 +1,224 @@
+"""Input pipelines: DataLoader, Dataset, BatchSampler, reader decorators.
+
+Capability parity: reference `python/paddle/fluid/reader.py` (DataLoader:101,
+from_generator:361 double-buffered feed), `python/paddle/fluid/dataloader/`
+(Dataset, BatchSampler, worker prefetch) and `python/paddle/reader/decorator.py`
+(batch/shuffle/buffered composition).
+
+TPU-first: the C++ BufferedReader/LoDTensorBlockingQueue
+(`operators/reader/buffered_reader.cc`) becomes a host-side background-thread
+prefetcher whose slots are `jax.device_put`-ahead batches — the XLA dispatch
+queue overlaps H2D copies with compute, so one thread + a small queue gives
+the same double-buffering.
+"""
+
+import itertools
+import queue
+import threading
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# reader decorators (cf. paddle.batch / paddle.reader.shuffle)
+# ---------------------------------------------------------------------------
+
+def batch(reader, batch_size, drop_last=False):
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def shuffle(reader, buf_size, seed=None):
+    rs = np.random.RandomState(seed)
+
+    def shuffled():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                rs.shuffle(buf)
+                yield from buf
+                buf = []
+        rs.shuffle(buf)
+        yield from buf
+
+    return shuffled
+
+
+def cache(reader):
+    items = []
+
+    def cached():
+        if not items:
+            for it in reader():
+                items.append(it)
+                yield it
+        else:
+            yield from items
+
+    return cached
+
+
+def firstn(reader, n):
+    def limited():
+        yield from itertools.islice(reader(), n)
+
+    return limited
+
+
+# ---------------------------------------------------------------------------
+# Dataset / BatchSampler (cf. python/paddle/fluid/dataloader/)
+# ---------------------------------------------------------------------------
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class TensorDataset(Dataset):
+    def __init__(self, *arrays):
+        self.arrays = [np.asarray(a) for a in arrays]
+        assert all(len(a) == len(self.arrays[0]) for a in self.arrays)
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self.arrays)
+
+    def __len__(self):
+        return len(self.arrays[0])
+
+
+class BatchSampler:
+    def __init__(self, dataset=None, shuffle=False, batch_size=1, drop_last=False,
+                 seed=None):
+        self.n = len(dataset)
+        self.shuffle = shuffle
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self._rs = np.random.RandomState(seed)
+
+    def __iter__(self):
+        idx = np.arange(self.n)
+        if self.shuffle:
+            self._rs.shuffle(idx)
+        for i in range(0, self.n, self.batch_size):
+            b = idx[i : i + self.batch_size]
+            if len(b) < self.batch_size and self.drop_last:
+                return
+            yield list(b)
+
+    def __len__(self):
+        if self.drop_last:
+            return self.n // self.batch_size
+        return (self.n + self.batch_size - 1) // self.batch_size
+
+
+def default_collate(items):
+    """list of tuples -> tuple of stacked arrays."""
+    transposed = list(zip(*items))
+    return tuple(np.stack([np.asarray(x) for x in col]) for col in transposed)
+
+
+class DataLoader:
+    """Iterable over batches with background-thread prefetch.
+
+    Two construction modes, mirroring the reference:
+      * DataLoader(dataset, batch_size=..., shuffle=...) — map-style dataset.
+      * DataLoader.from_generator(capacity=..., feed_list=...) then
+        .set_sample_list_generator / .set_batch_generator — generator-fed.
+    """
+
+    def __init__(self, dataset=None, feed_list=None, batch_size=1, shuffle=False,
+                 drop_last=False, collate_fn=None, num_workers=0, capacity=4,
+                 batch_sampler=None, return_list=True):
+        self.dataset = dataset
+        self.feed_list = feed_list
+        self.capacity = max(2, capacity)
+        self.collate_fn = collate_fn or default_collate
+        self._gen = None
+        if dataset is not None:
+            self.batch_sampler = batch_sampler or BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+            )
+
+    # -- generator-fed mode (cf. reader.py:361) -----------------------------
+    @staticmethod
+    def from_generator(feed_list=None, capacity=4, use_double_buffer=True,
+                       iterable=True, return_list=False):
+        return DataLoader(feed_list=feed_list, capacity=capacity)
+
+    def set_sample_generator(self, generator, batch_size, drop_last=True, places=None):
+        from .reader import batch as _batch  # self-module import for clarity
+
+        self._gen = lambda: (
+            self.collate_fn(samples)
+            for samples in _batch(generator, batch_size, drop_last)()
+        )
+        return self
+
+    def set_sample_list_generator(self, generator, places=None):
+        self._gen = lambda: (self.collate_fn(samples) for samples in generator())
+        return self
+
+    def set_batch_generator(self, generator, places=None):
+        self._gen = generator
+        return self
+
+    # -- iteration with prefetch -------------------------------------------
+    def _batches(self):
+        if self._gen is not None:
+            yield from self._gen()
+            return
+        for indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        q = queue.Queue(maxsize=self.capacity)
+        sentinel = object()
+        err = []
+
+        def worker():
+            try:
+                for b in self._batches():
+                    q.put(b)
+            except BaseException as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                if err:
+                    raise err[0]
+                return
+            if self.feed_list is not None:
+                yield {
+                    v.name if hasattr(v, "name") else v: arr
+                    for v, arr in zip(self.feed_list, item)
+                }
+            else:
+                yield item
+
+    def __len__(self):
+        if self._gen is not None:
+            raise TypeError("generator-fed DataLoader has no length")
+        return len(self.batch_sampler)

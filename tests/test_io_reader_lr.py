@@ -1,0 +1,148 @@
+"""save/load, inference export, DataLoader, LR schedules (cf. reference
+test_io_save_load*, test_dataloader*, test_learning_rate_scheduler)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import io, layers
+from paddle_tpu.fluid.layers import learning_rate_scheduler as lrs
+from paddle_tpu.fluid.optimizer import AdamOptimizer, SGDOptimizer
+from paddle_tpu.fluid.reader import BatchSampler, DataLoader, TensorDataset, batch, shuffle
+
+
+def _small_model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4])
+        y = layers.data("y", shape=[1], dtype="int64")
+        logits = layers.fc(x, 3)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    return main, startup, x, y, logits, loss
+
+
+def test_save_load_roundtrip(tmp_path):
+    main, startup, *_ = _small_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    path = str(tmp_path / "model")
+    io.save(main, path)
+    w = main.all_parameters()[0]
+    orig = np.asarray(fluid.global_scope().find_var(w.name)).copy()
+    fluid.global_scope().set(w.name, np.zeros_like(orig))
+    io.load(main, path)
+    np.testing.assert_allclose(
+        np.asarray(fluid.global_scope().find_var(w.name)), orig
+    )
+
+
+def test_save_persistables_includes_optimizer_state(tmp_path):
+    main, startup, x, y, logits, loss = _small_model()
+    with fluid.program_guard(main, startup):
+        AdamOptimizer(1e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed={"x": np.ones((2, 4), np.float32),
+                        "y": np.zeros((2, 1), np.int64)}, fetch_list=[loss])
+    d = str(tmp_path / "persist")
+    io.save_persistables(exe, d, main)
+    import os
+
+    files = os.listdir(d)
+    assert any("moment1" in f for f in files), files  # adam state saved
+
+
+def test_inference_export_prunes_and_runs(tmp_path):
+    main, startup, x, y, logits, loss = _small_model()
+    with fluid.program_guard(main, startup):
+        SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    d = str(tmp_path / "infer")
+    io.save_inference_model(d, ["x"], [logits], exe, main)
+    prog, feeds, fetches = io.load_inference_model(d, exe)
+    types = [op.type for op in prog.global_block.ops]
+    assert "sgd" not in types and "vjp_grad" not in types
+    assert "softmax_with_cross_entropy" not in types  # pruned past target
+    (out,) = exe.run(prog, feed={"x": np.ones((5, 4), np.float32)},
+                     fetch_list=fetches)
+    assert out.shape == (5, 3)
+
+
+def test_dataloader_map_style():
+    ds = TensorDataset(np.arange(20, dtype=np.float32).reshape(10, 2),
+                       np.arange(10, dtype=np.int64))
+    loader = DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 2)
+    np.testing.assert_array_equal(batches[0][1], [0, 1, 2, 3])
+
+
+def test_dataloader_generator_mode():
+    def gen():
+        for i in range(7):
+            yield [np.full((2,), i, np.float32), np.array([i], np.int64)]
+
+    loader = DataLoader.from_generator(capacity=2)
+    loader.set_sample_list_generator(lambda: (list(g) for g in _chunks(gen(), 2)))
+    got = list(loader)
+    assert len(got) == 4
+
+
+def _chunks(it, n):
+    buf = []
+    for x in it:
+        buf.append(x)
+        if len(buf) == n:
+            yield buf
+            buf = []
+    if buf:
+        yield buf
+
+
+def test_reader_decorators():
+    r = batch(lambda: iter(range(10)), 3)
+    out = list(r())
+    assert out[0] == [0, 1, 2] and len(out) == 4
+    s = shuffle(lambda: iter(range(10)), 5, seed=0)
+    assert sorted(list(s())) == list(range(10))
+
+
+def test_noam_decay_warmup_then_decay():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2])
+        out = layers.fc(x, 1)
+        loss = layers.mean(out)
+        lr = lrs.noam_decay(d_model=64, warmup_steps=5, learning_rate=1.0)
+        SGDOptimizer(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    seen = []
+    for _ in range(12):
+        _, lrv = exe.run(
+            main,
+            feed={"x": np.ones((2, 2), np.float32)},
+            fetch_list=[loss, lr],
+        )
+        seen.append(float(lrv[0]))
+    assert seen[0] < seen[2] < seen[4]  # warming up
+    assert seen[11] < seen[4]  # decaying after warmup_steps
+
+
+def test_piecewise_decay():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2])
+        loss = layers.mean(layers.fc(x, 1))
+        lr = lrs.piecewise_decay([3, 6], [0.1, 0.01, 0.001])
+        SGDOptimizer(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    seen = []
+    for _ in range(8):
+        (lrv,) = exe.run(main, feed={"x": np.ones((1, 2), np.float32)},
+                         fetch_list=[lr])
+        seen.append(round(float(lrv[0]), 6))
+    # counter starts at 1 after first increment
+    assert seen[0] == 0.1 and seen[3] == 0.01 and seen[7] == 0.001, seen
