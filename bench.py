@@ -1,0 +1,94 @@
+"""Benchmark: flagship BERT-base pretraining step, tokens/sec/chip.
+
+North star (BASELINE.md): ERNIE/BERT-base pretrain tokens/sec/chip at
+>=35% MFU.  The reference publishes no in-repo numbers (BASELINE.json
+"published": {}), so vs_baseline reports measured-MFU / 0.35 — the ratio to
+the target; 1.0 means the 35% MFU goal is met.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+
+    from paddle_tpu import distributed as dist
+    from paddle_tpu import models
+    from paddle_tpu.fluid import dygraph
+    from paddle_tpu.fluid.optimizer import AdamWOptimizer
+
+    if on_tpu:
+        cfg = models.BertConfig(  # BERT-base
+            vocab_size=30528,  # pad to multiple of 64 for lane alignment
+            hidden_size=768, num_hidden_layers=12, num_attention_heads=12,
+            intermediate_size=3072, max_position_embeddings=512,
+            hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+        )
+        B, S, iters = 8, 512, 20
+    else:  # CPU smoke path so the bench never hangs off-TPU
+        cfg = models.BertConfig.tiny()
+        B, S, iters = 4, 32, 3
+
+    with dygraph.guard():
+        model = models.BertForPretraining(cfg)
+        opt = AdamWOptimizer(learning_rate=1e-4, weight_decay=0.01)
+        mesh = dist.auto_mesh(1)
+
+        def loss_fn(m, batch):
+            logits, nsp_logits = m(
+                batch["input_ids"], batch["token_type_ids"],
+                batch["position_ids"],
+            )
+            return m.loss(
+                logits, nsp_logits, batch["mlm_labels"],
+                batch["mlm_weights"], batch["nsp_labels"],
+            )
+
+        step = dist.ShardedTrainStep(model, opt, loss_fn, mesh, zero_stage=0)
+        state = step.init()
+        n_params = sum(int(np.prod(v.shape)) for v in state["params"].values())
+
+        rng = np.random.RandomState(0)
+        batch = {
+            "input_ids": rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32),
+            "token_type_ids": np.zeros((B, S), np.int32),
+            "position_ids": np.tile(np.arange(S, dtype=np.int32), (B, 1)),
+            "mlm_labels": rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32),
+            "mlm_weights": (rng.rand(B, S) < 0.15).astype(np.float32),
+            "nsp_labels": rng.randint(0, 2, (B, 1)).astype(np.int32),
+        }
+
+        # warmup (compile)
+        for _ in range(2):
+            state, loss = step(state, batch)
+        loss.block_until_ready()
+
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, loss = step(state, batch)
+        loss.block_until_ready()
+        dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * iters / dt
+    # MFU: ~6 flops per param per token (fwd+bwd), v5e peak 197 TFLOP/s bf16
+    flops_per_tok = 6.0 * n_params
+    peak = 197e12 if on_tpu else 1e12
+    mfu = tokens_per_sec * flops_per_tok / peak
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.35, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

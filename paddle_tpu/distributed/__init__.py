@@ -1,0 +1,42 @@
+"""Distributed runtime: mesh + GSPMD shardings + collective surface + launch.
+
+Capability parity (SURVEY.md §2.3, §5): the reference's four transports
+(NCCL collective ops `operators/collective/`, gRPC/bRPC parameter server
+`operators/distributed/`, gloo CPU collectives, MPI rendezvous) collapse
+into XLA collectives over ICI/DCN under a `jax.sharding.Mesh`.  What this
+package provides instead of a transport layer:
+
+  * topology.py — Mesh construction/axis management (dp/tp/pp/sp/ep), env
+    contract (`PADDLE_TRAINER_ID`-style), `init_parallel_env`
+    (≈ `jax.distributed.initialize` + NCCLCommContext bootstrap parity).
+  * collective.py — `all_reduce/all_gather/reduce_scatter/broadcast/
+    send_recv(ppermute)/barrier` mirroring `c_allreduce_sum`/`c_broadcast`/
+    `c_allgather`/`c_reducescatter` semantics (collective/c_*.cc), usable
+    eagerly (dygraph DataParallel) and under jit/shard_map.
+  * sharding.py — sharding-annotation API: shard params/activations along
+    named axes; ZeRO-style sharded optimizer state (subsumes the reference
+    parameter server capability, SURVEY §2.3).
+  * train_step.py — builds ONE jitted SPMD training step from a dygraph
+    Layer: dp/tp/sp sharded forward+backward+update with XLA-inserted
+    collectives (replaces ParallelExecutor + transpilers).
+  * launch.py — `python -m paddle_tpu.distributed.launch` process-per-host
+    launcher with the reference env contract (launch.py:193).
+"""
+
+from . import collective  # noqa: F401
+from .collective import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    barrier,
+    broadcast,
+    reduce_scatter,
+    send_recv,
+)
+from .parallel import ParallelEnv, get_rank, get_world_size, init_parallel_env  # noqa: F401
+from .topology import (  # noqa: F401
+    DeviceMesh,
+    auto_mesh,
+    get_mesh,
+    mesh_guard,
+)
+from .train_step import ShardedTrainStep  # noqa: F401

@@ -1,0 +1,152 @@
+"""Collective op surface: functional API + registered program ops.
+
+Capability parity: reference `operators/collective/` (`c_allreduce_{sum,max,
+min,prod}`, `c_broadcast`, `c_allgather`, `c_reducescatter`,
+`c_sync_*_stream`, `c_comm_init*` — each pulling an NCCL comm by ring id
+from NCCLCommContext) and `transpiler/collective.py` which inserts them.
+
+TPU-first: a "ring" is a named mesh axis; the ops lower to XLA collectives
+(`psum`/`all_gather`/`psum_scatter`/`ppermute`) which GSPMD schedules onto
+ICI.  Stream-sync ops are identity: XLA owns scheduling.  The functional
+forms work inside `shard_map`/`pjit`; outside any mapped axis they
+degenerate to single-participant no-ops (world size 1), which is also the
+reference behavior with one trainer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..fluid.core.registry import register_op
+
+# ring id -> mesh axis name (cf. NCCLCommContext rings; fleet sets these)
+_RING_AXES: dict[int, str] = {0: "dp"}
+
+
+def set_ring_axis(ring_id, axis_name):
+    _RING_AXES[int(ring_id)] = axis_name
+
+
+def _axis_bound(axis_name):
+    """True when called inside shard_map/pmap tracing with this axis."""
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except (NameError, KeyError, ValueError):
+        return False
+
+
+def all_reduce(x, op="sum", axis="dp"):
+    """cf. c_allreduce_sum/max/min/prod (collective/c_allreduce_op.h)."""
+    if not _axis_bound(axis):
+        return x
+    if op == "sum":
+        return jax.lax.psum(x, axis)
+    if op == "mean":
+        return jax.lax.pmean(x, axis)
+    if op == "max":
+        return jax.lax.pmax(x, axis)
+    if op == "min":
+        return jax.lax.pmin(x, axis)
+    if op == "prod":
+        return jnp.exp(jax.lax.psum(jnp.log(x), axis))
+    raise ValueError("unknown reduce op %r" % op)
+
+
+def all_gather(x, axis="dp", tiled_axis=0):
+    """cf. c_allgather_op.cc: concatenate shards along tiled_axis."""
+    if not _axis_bound(axis):
+        return x
+    return jax.lax.all_gather(x, axis, axis=tiled_axis, tiled=True)
+
+
+def reduce_scatter(x, axis="dp", scatter_axis=0, op="sum"):
+    """cf. c_reducescatter_op.cc."""
+    if not _axis_bound(axis):
+        return x
+    assert op == "sum", "reference reduce-scatter is sum"
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def broadcast(x, root=0, axis="dp"):
+    """cf. c_broadcast_op.cc: all participants end with root's value."""
+    if not _axis_bound(axis):
+        return x
+    n = jax.lax.axis_size(axis)
+    # select root's shard on every participant: gather then index is the
+    # simple formulation; GSPMD lowers this to a broadcast-from-root
+    gathered = jax.lax.all_gather(x, axis)
+    return gathered[root]
+
+
+def send_recv(x, perm, axis="dp"):
+    """Point-to-point ring shift via collective_permute (cf. reference
+    send/recv distributed ops; on TPU p2p is `ppermute` over ICI).
+
+    perm: list of (source, dest) pairs.
+    """
+    if not _axis_bound(axis):
+        return x
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def barrier(axis="dp"):
+    """cf. GlooWrapper::Barrier / c_sync_comm_stream: under XLA, program
+    order is the barrier; provided for API parity."""
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Program-level collective ops (transpiler/fleet insert these into Programs;
+# the executor runs the block under shard_map over the active mesh)
+# ---------------------------------------------------------------------------
+
+
+def _ring_axis(attrs):
+    return _RING_AXES.get(int(attrs.get("ring_id", 0)), "dp")
+
+
+@register_op("c_allreduce_sum", inputs=["X"], outputs=["Out"], grad=None)
+def _c_allreduce_sum(ctx, ins, attrs):
+    return {"Out": [all_reduce(ins["X"][0], "sum", _ring_axis(attrs))]}
+
+
+@register_op("c_allreduce_max", inputs=["X"], outputs=["Out"], grad=None)
+def _c_allreduce_max(ctx, ins, attrs):
+    return {"Out": [all_reduce(ins["X"][0], "max", _ring_axis(attrs))]}
+
+
+@register_op("c_allreduce_min", inputs=["X"], outputs=["Out"], grad=None)
+def _c_allreduce_min(ctx, ins, attrs):
+    return {"Out": [all_reduce(ins["X"][0], "min", _ring_axis(attrs))]}
+
+
+@register_op("c_allreduce_prod", inputs=["X"], outputs=["Out"], grad=None)
+def _c_allreduce_prod(ctx, ins, attrs):
+    return {"Out": [all_reduce(ins["X"][0], "prod", _ring_axis(attrs))]}
+
+
+@register_op("c_broadcast", inputs=["X"], outputs=["Out"], grad=None)
+def _c_broadcast(ctx, ins, attrs):
+    return {"Out": [broadcast(ins["X"][0], attrs.get("root", 0), _ring_axis(attrs))]}
+
+
+@register_op("c_allgather", inputs=["X"], outputs=["Out"], grad=None)
+def _c_allgather(ctx, ins, attrs):
+    return {"Out": [all_gather(ins["X"][0], _ring_axis(attrs))]}
+
+
+@register_op("c_reducescatter", inputs=["X"], outputs=["Out"], grad=None)
+def _c_reducescatter(ctx, ins, attrs):
+    return {"Out": [reduce_scatter(ins["X"][0], _ring_axis(attrs))]}
+
+
+@register_op("c_sync_calc_stream", inputs=["X"], outputs=["Out"], grad=None)
+def _c_sync_calc(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}  # XLA owns scheduling; identity
+
+
+@register_op("c_sync_comm_stream", inputs=["X"], outputs=["Out"], grad=None)
+def _c_sync_comm(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}

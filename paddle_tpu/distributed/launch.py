@@ -1,0 +1,103 @@
+"""Multi-process launcher: `python -m paddle_tpu.distributed.launch train.py`.
+
+Capability parity: reference `python/paddle/distributed/launch.py`
+(`launch:193`, `get_cluster_from_args:142`) — spawns one worker process per
+device/host, exporting PADDLE_TRAINER_ID / PADDLE_CURRENT_ENDPOINT /
+PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS.
+
+TPU note: on TPU pods one process per HOST (not per chip) is the rule; each
+process drives all local chips via one jax runtime.  `--nproc_per_node`
+therefore defaults to 1, and the spawned script should call
+`distributed.init_parallel_env()` which maps the env contract onto
+`jax.distributed.initialize`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--cluster_node_ips", type=str, default="127.0.0.1")
+    p.add_argument("--node_ip", type=str, default="127.0.0.1")
+    p.add_argument("--started_port", type=int, default=6170)
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def get_cluster_endpoints(node_ips, started_port, nproc_per_node):
+    """cf. reference get_cluster_from_args:142."""
+    eps = []
+    for ip in node_ips:
+        for i in range(nproc_per_node):
+            eps.append("%s:%d" % (ip, started_port + i))
+    return eps
+
+
+def launch(args=None):
+    args = args or _parse_args()
+    node_ips = args.cluster_node_ips.split(",")
+    endpoints = get_cluster_endpoints(
+        node_ips, args.started_port, args.nproc_per_node
+    )
+    node_idx = node_ips.index(args.node_ip)
+    procs = []
+    log_files = []
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    for local_rank in range(args.nproc_per_node):
+        rank = node_idx * args.nproc_per_node + local_rank
+        env = dict(os.environ)
+        env.update(
+            {
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+                "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            }
+        )
+        cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
+        if args.log_dir:
+            f = open(os.path.join(args.log_dir, "workerlog.%d" % rank), "w")
+            log_files.append(f)
+            procs.append(subprocess.Popen(cmd, env=env, stdout=f, stderr=f))
+        else:
+            procs.append(subprocess.Popen(cmd, env=env))
+
+    try:
+        rc = 0
+        alive = True
+        while alive:
+            alive = False
+            for p in procs:
+                r = p.poll()
+                if r is None:
+                    alive = True
+                elif r != 0:  # fail fast, kill the gang (reference behavior)
+                    rc = r
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    alive = False
+                    break
+            time.sleep(0.5)
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+        return rc
+    finally:
+        for f in log_files:
+            f.close()
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

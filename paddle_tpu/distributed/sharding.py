@@ -1,0 +1,119 @@
+"""Sharding-annotation API: parameter partition rules + ZeRO state sharding.
+
+Capability parity: the reference has no tensor parallelism (SURVEY §2.3 —
+TP absent in the 2020 tree); its *capability* for scaling beyond one
+device's memory is the parameter server (`distribute_transpiler.py` slicing
+params into VarBlocks across pservers).  The TPU-native equivalent is GSPMD
+sharding: Megatron-style TP rules for transformer params + ZeRO dp-sharded
+optimizer state subsume PS-sharded storage with zero custom transport.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+
+class ShardingRule:
+    """Maps parameter names -> PartitionSpec via ordered regex rules."""
+
+    def __init__(self, rules=None, default=()):
+        # rules: [(regex, spec_tuple)]; spec entries are mesh axis names or None
+        self.rules = [(re.compile(p), tuple(s)) for p, s in (rules or [])]
+        self.default = tuple(default)
+
+    def spec_for(self, name, shape):
+        from jax.sharding import PartitionSpec
+
+        for pat, spec in self.rules:
+            if pat.search(name):
+                spec = _trim_spec(spec, shape)
+                return PartitionSpec(*spec)
+        return PartitionSpec(*_trim_spec(self.default, shape))
+
+    def shardings(self, params, mesh):
+        """{name: array} -> {name: NamedSharding} (divisibility-checked)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        out = {}
+        for name, arr in params.items():
+            spec = self.spec_for(name, arr.shape)
+            spec = _validate_spec(spec, arr.shape, mesh)
+            out[name] = NamedSharding(mesh.mesh, spec)
+        return out
+
+
+def _trim_spec(spec, shape):
+    return tuple(spec[: len(shape)]) if len(spec) > len(shape) else spec
+
+
+def _validate_spec(spec, shape, mesh):
+    """Drop axis annotations that don't divide the dim (falls back to
+    replicated on that dim) — mirrors GSPMD's requirement."""
+    from jax.sharding import PartitionSpec
+
+    fixed = []
+    for i, ax in enumerate(tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if ax is None:
+            fixed.append(None)
+            continue
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        total = int(np.prod([mesh.axis_size(a) for a in axes]))
+        if total <= 1 or shape[i] % total:
+            fixed.append(None)
+        else:
+            fixed.append(ax)
+    while fixed and fixed[-1] is None:
+        fixed.pop()
+    return PartitionSpec(*fixed)
+
+
+def megatron_rule():
+    """Standard transformer TP sharding (Megatron-LM pattern, cf. PAPERS.md):
+
+    - attention q/k/v proj + ffn up proj: column parallel (shard out dim on tp)
+    - attention out proj + ffn down proj: row parallel (shard in dim on tp)
+    - embeddings: shard vocab (dim 0) on tp
+    - biases of column-parallel layers: shard on tp; everything else replicated
+    """
+    return ShardingRule(
+        rules=[
+            (r"(q_proj|k_proj|v_proj|fc1|mlm_transform)\.weight", (None, "tp")),
+            (r"(q_proj|k_proj|v_proj|fc1)\.bias", ("tp",)),
+            (r"(out_proj|fc2)\.weight", ("tp", None)),
+            (r"(word|position|token_type|pos)\.weight", ("tp", None)),
+            (r"embedding", ("tp", None)),
+        ],
+        default=(),
+    )
+
+
+def replicated_rule():
+    return ShardingRule()
+
+
+def zero_shard_state(state_specs, params, mesh, zero_stage=1):
+    """ZeRO-1: shard optimizer moments along dp over the largest divisible
+    dim (subsumes the reference PS capability of distributing optimizer
+    state, cf. distribute_transpiler slice_variable VarBlocks).
+
+    state_specs: {param_name: {state_name: shape}} -> returns
+    {param_name: {state_name: NamedSharding}}.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    dp = mesh.axis_size("dp")
+    out = {}
+    for pname, states in state_specs.items():
+        out[pname] = {}
+        for sname, shape in states.items():
+            spec = ()
+            if zero_stage >= 1 and dp > 1 and len(shape) > 0:
+                # choose first dim divisible by dp
+                for i, s in enumerate(shape):
+                    if s % dp == 0 and s >= dp:
+                        spec = (None,) * i + ("dp",)
+                        break
+            out[pname][sname] = NamedSharding(mesh.mesh, PartitionSpec(*spec))
+    return out

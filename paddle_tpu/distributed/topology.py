@@ -1,0 +1,104 @@
+"""Device-mesh topology management.
+
+Capability parity: reference `platform/nccl_helper.h:76-91` (NCCLContextMap:
+device ring construction), `platform/collective_helper.h:50-76`
+(NCCLCommContext: communicators keyed by ring id), and the fleet topology
+fields (`distributed_strategy.proto:35-36` hierarchical allreduce).
+
+TPU-first: a communicator ring becomes a named mesh axis; "hierarchical
+allreduce" becomes axis ordering (outer axes ride DCN, inner axes ICI).
+Canonical axis names: dp (data), pp (pipeline stage), tp (tensor/model),
+sp (sequence/context), ep (expert).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+
+import numpy as np
+
+AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")  # outermost (DCN) -> innermost (ICI)
+
+
+class DeviceMesh:
+    """Thin wrapper over jax.sharding.Mesh with named parallelism axes.
+
+    tp should map to the innermost (fastest ICI) axis, dp to the outermost
+    (cf. scaling-book mesh recipe); `shape` is {axis_name: size}.
+    """
+
+    def __init__(self, shape: dict, devices=None):
+        import jax
+        from jax.sharding import Mesh
+
+        self.shape = {k: int(v) for k, v in shape.items() if int(v) > 1 or k == "dp"}
+        if not self.shape:
+            self.shape = {"dp": 1}
+        names = [a for a in AXIS_ORDER if a in self.shape]
+        extra = [a for a in self.shape if a not in AXIS_ORDER]
+        names += extra
+        sizes = [self.shape[a] for a in names]
+        n = int(np.prod(sizes))
+        devices = list(devices if devices is not None else jax.devices())
+        if n > len(devices):
+            raise ValueError(
+                "mesh %s needs %d devices, have %d" % (self.shape, n, len(devices))
+            )
+        dev_array = np.array(devices[:n]).reshape(sizes)
+        self.axis_names = tuple(names)
+        self.mesh = Mesh(dev_array, self.axis_names)
+
+    @property
+    def size(self):
+        return int(np.prod([self.shape[a] for a in self.axis_names]))
+
+    def axis_size(self, name):
+        return self.shape.get(name, 1)
+
+    def has_axis(self, name):
+        return name in self.axis_names
+
+    def __enter__(self):
+        self.mesh.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self.mesh.__exit__(*exc)
+
+    def __repr__(self):
+        return "DeviceMesh(%s)" % (self.shape,)
+
+
+def auto_mesh(n_devices=None, tp=1, pp=1, sp=1, ep=1, devices=None):
+    """Factor available devices into dp x pp x ep x sp x tp (dp inferred)."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devices)
+    denom = tp * pp * sp * ep
+    if n % denom:
+        raise ValueError("%d devices not divisible by tp*pp*sp*ep=%d" % (n, denom))
+    return DeviceMesh(
+        {"dp": n // denom, "pp": pp, "ep": ep, "sp": sp, "tp": tp},
+        devices=devices[:n],
+    )
+
+
+_current_mesh: DeviceMesh | None = None
+
+
+def get_mesh() -> DeviceMesh | None:
+    return _current_mesh
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh: DeviceMesh):
+    global _current_mesh
+    old = _current_mesh
+    _current_mesh = mesh
+    try:
+        with mesh.mesh:
+            yield mesh
+    finally:
+        _current_mesh = old

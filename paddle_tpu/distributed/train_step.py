@@ -1,0 +1,308 @@
+"""ShardedTrainStep: ONE jitted SPMD program = forward + backward + update.
+
+Capability parity: this replaces the reference's entire multi-device
+execution stack — ParallelExecutor SSA graphs (`parallel_executor.cc:443`,
+`details/all_reduce_op_handle.cc`), the collective transpiler
+(`transpiler/collective.py:178` inserting c_allreduce_sum per grad) and the
+parameter-server topology (`distribute_transpiler.py:545`).  Under GSPMD
+there is no graph rewriting: batch is sharded on `dp`, params on `tp` (and
+optionally `sp` for sequence), optimizer state ZeRO-sharded on `dp`; XLA
+inserts the all-reduces/all-gathers the reference spelled as c_* ops.
+
+The model is any dygraph Layer; its forward traces through the tape (pure
+JAX), grads come from `jax.grad` over the functional application, and the
+update math reuses the registered optimizer-op lowerings — so the numerics
+are byte-identical to the single-device fluid path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fluid import framework
+from ..fluid.core.registry import LowerContext, get_op_def
+from .sharding import ShardingRule, megatron_rule, replicated_rule, zero_shard_state
+from .topology import DeviceMesh
+
+# optimizer-op adapter table: op_type -> (state slots, per-state init)
+_STATE_SLOTS = {
+    "sgd": [],
+    "momentum": [("Velocity", "zeros_like")],
+    "adam": [
+        ("Moment1", "zeros_like"),
+        ("Moment2", "zeros_like"),
+        ("Beta1Pow", "beta1"),
+        ("Beta2Pow", "beta2"),
+    ],
+}
+_STATE_SLOTS["adamw"] = _STATE_SLOTS["adam"]
+_STATE_SLOTS["lamb"] = _STATE_SLOTS["adam"]
+_OUT_SLOT = {
+    "Velocity": "VelocityOut",
+    "Moment1": "Moment1Out",
+    "Moment2": "Moment2Out",
+    "Beta1Pow": "Beta1PowOut",
+    "Beta2Pow": "Beta2PowOut",
+}
+
+
+class FunctionalOptimizer:
+    """Pure-pytree adapter over a fluid Optimizer's update op."""
+
+    def __init__(self, fluid_opt):
+        from ..fluid import optimizer as opt_mod
+
+        self._opt = fluid_opt
+        self.attrs = {}
+        if isinstance(fluid_opt, opt_mod.SGDOptimizer):
+            self.op_type = "sgd"
+        elif isinstance(fluid_opt, opt_mod.LambOptimizer):
+            self.op_type = "lamb"
+            self.attrs = {
+                "beta1": fluid_opt._beta1, "beta2": fluid_opt._beta2,
+                "epsilon": fluid_opt._epsilon,
+                "weight_decay": fluid_opt._weight_decay,
+            }
+        elif isinstance(fluid_opt, opt_mod.AdamWOptimizer):
+            self.op_type = "adamw"
+            self.attrs = {
+                "beta1": fluid_opt._beta1, "beta2": fluid_opt._beta2,
+                "epsilon": fluid_opt._epsilon, "coeff": fluid_opt._coeff,
+            }
+        elif isinstance(fluid_opt, opt_mod.AdamOptimizer):
+            self.op_type = "adam"
+            self.attrs = {
+                "beta1": fluid_opt._beta1, "beta2": fluid_opt._beta2,
+                "epsilon": fluid_opt._epsilon,
+            }
+        elif isinstance(fluid_opt, opt_mod.MomentumOptimizer):
+            self.op_type = "momentum"
+            self.attrs = {
+                "mu": fluid_opt._momentum,
+                "use_nesterov": fluid_opt._use_nesterov,
+            }
+        else:
+            raise NotImplementedError(
+                "FunctionalOptimizer: no adapter for %s (add a state-slot "
+                "table entry)" % type(fluid_opt).__name__
+            )
+        self._opdef = get_op_def(self.op_type)
+
+    @property
+    def learning_rate(self):
+        lr = self._opt._learning_rate
+        return float(lr) if not callable(lr) else lr
+
+    def state_shapes(self, params):
+        out = {}
+        for name, p in params.items():
+            out[name] = {}
+            for slot, _init in _STATE_SLOTS[self.op_type]:
+                shape = (1,) if slot.endswith("Pow") else tuple(p.shape)
+                out[name][slot] = shape
+        return out
+
+    def init_state(self, params):
+        state = {}
+        for name, p in params.items():
+            st = {}
+            for slot, init in _STATE_SLOTS[self.op_type]:
+                if init == "zeros_like":
+                    st[slot] = jnp.zeros(p.shape, jnp.float32)
+                elif init == "beta1":
+                    st[slot] = jnp.full((1,), self.attrs.get("beta1", 0.9), jnp.float32)
+                elif init == "beta2":
+                    st[slot] = jnp.full((1,), self.attrs.get("beta2", 0.999), jnp.float32)
+            state[name] = st
+        return state
+
+    def apply(self, params, grads, state, lr):
+        """(params, grads, state, scalar lr) -> (new_params, new_state)."""
+        ctx = LowerContext(base_key=None, is_test=False)
+        new_params, new_state = {}, {}
+        for name, p in params.items():
+            g = grads[name]
+            ins = {
+                "Param": [p],
+                "Grad": [g],
+                "LearningRate": [jnp.asarray(lr, jnp.float32)],
+            }
+            for slot, _ in _STATE_SLOTS[self.op_type]:
+                ins[slot] = [state[name][slot]]
+            outs = self._opdef.lower(ctx, ins, self.attrs)
+            new_params[name] = outs["ParamOut"][0]
+            new_state[name] = {
+                slot: outs[_OUT_SLOT[slot]][0]
+                for slot, _ in _STATE_SLOTS[self.op_type]
+            }
+        return new_params, new_state
+
+
+class ShardedTrainStep:
+    """Compile a dygraph Layer + fluid optimizer into one SPMD step.
+
+    loss_fn(model, batch_dict) -> scalar loss VarBase, written in normal
+    dygraph style.  batch_specs: {key: PartitionSpec-like tuple}; defaults
+    shard dim 0 on dp (and dim 1 on sp when the mesh has sp > 1).
+
+    Usage::
+
+        mesh = auto_mesh(tp=2)
+        step = ShardedTrainStep(model, opt, loss_fn, mesh)
+        state = step.init()              # shard + place params/opt state
+        state, loss = step(state, batch) # one fused XLA program
+    """
+
+    def __init__(self, model, optimizer, loss_fn, mesh: DeviceMesh,
+                 param_rule: ShardingRule = None, batch_specs=None,
+                 zero_stage=1, donate=True, remat=False):
+        if mesh.axis_size("pp") > 1:
+            raise NotImplementedError(
+                "pipeline stages use parallel.PipelineOptimizer (gpipe scan)"
+            )
+        self.model = model
+        self.fopt = FunctionalOptimizer(optimizer)
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.param_rule = param_rule or (
+            megatron_rule() if mesh.axis_size("tp") > 1 else replicated_rule()
+        )
+        self.batch_specs = batch_specs or {}
+        self.zero_stage = zero_stage
+        self.remat = remat
+        self._step_fn = None
+        self._shardings = None
+
+    # -- state ----------------------------------------------------------
+    def init(self):
+        """Extract + shard params and optimizer state across the mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        params = {k: v.data for k, v in self.model.state_dict().items()}
+        p_sh = self.param_rule.shardings(params, self.mesh)
+        params = {
+            k: jax.device_put(v, p_sh[k]) for k, v in params.items()
+        }
+        state = self.fopt.init_state(params)
+        s_sh = zero_shard_state(
+            self.fopt.state_shapes(params), params, self.mesh, self.zero_stage
+        )
+        state = {
+            k: {s: jax.device_put(v, s_sh[k][s]) for s, v in st.items()}
+            for k, st in state.items()
+        }
+        step_no = jax.device_put(
+            jnp.zeros((), jnp.int32),
+            NamedSharding(self.mesh.mesh, PartitionSpec()),
+        )
+        self._shardings = {
+            "params": p_sh,
+            "opt": s_sh,
+            "step": NamedSharding(self.mesh.mesh, PartitionSpec()),
+        }
+        return {"params": params, "opt": state, "step": step_no}
+
+    def _batch_sharding(self, batch):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        out = {}
+        for k, v in batch.items():
+            if k in self.batch_specs:
+                spec = PartitionSpec(*self.batch_specs[k])
+            else:
+                spec = [None] * np.ndim(v)
+                if np.ndim(v) >= 1 and v.shape[0] % max(1, self.mesh.axis_size("dp")) == 0:
+                    spec[0] = "dp"
+                if (
+                    np.ndim(v) >= 2
+                    and self.mesh.axis_size("sp") > 1
+                    and v.shape[1] % self.mesh.axis_size("sp") == 0
+                ):
+                    spec[1] = "sp"
+                spec = PartitionSpec(*spec)
+            out[k] = NamedSharding(self.mesh.mesh, spec)
+        return out
+
+    # -- the traced step -------------------------------------------------
+    def _build(self, batch):
+        from ..fluid.dygraph.tracer import Tracer
+        from ..fluid.dygraph.varbase import VarBase
+
+        model, loss_fn, fopt = self.model, self.loss_fn, self.fopt
+        lr = self.fopt.learning_rate
+
+        def loss_of(params, batch, key):
+            old = framework._dygraph_tracer
+            tracer = Tracer()
+            tracer._base_key = key
+            framework._dygraph_tracer = tracer
+            try:
+                sd = model.state_dict()
+                for vb in sd.values():
+                    tracer.register_var(vb)
+                saved = {}
+                for name, arr in params.items():
+                    var = sd[name]
+                    saved[name] = var.data
+                    var.data = arr
+                try:
+                    wrapped = {
+                        k: VarBase(v, stop_gradient=True) for k, v in batch.items()
+                    }
+                    loss = loss_fn(model, wrapped)
+                finally:
+                    for name, arr in saved.items():
+                        sd[name].data = arr
+                return loss.data if isinstance(loss, VarBase) else loss
+            finally:
+                framework._dygraph_tracer = old
+
+        if self.remat:
+            loss_of = jax.checkpoint(loss_of, static_argnums=())
+
+        def step(train_state, batch):
+            params = train_state["params"]
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(0), train_state["step"]
+            )
+            lr_t = lr(train_state["step"]) if callable(lr) else lr
+            loss, grads = jax.value_and_grad(loss_of)(params, batch, key)
+            new_params, new_opt = fopt.apply(
+                params, grads, train_state["opt"], lr_t
+            )
+            return (
+                {
+                    "params": new_params,
+                    "opt": new_opt,
+                    "step": train_state["step"] + 1,
+                },
+                loss,
+            )
+
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        state_sh = {
+            "params": self._shardings["params"],
+            "opt": self._shardings["opt"],
+            "step": self._shardings["step"],
+        }
+        batch_sh = self._batch_sharding(batch)
+        loss_sh = NamedSharding(self.mesh.mesh, PartitionSpec())
+        return jax.jit(
+            step,
+            in_shardings=(state_sh, batch_sh),
+            out_shardings=(state_sh, loss_sh),
+            donate_argnums=(0,),
+        )
+
+    def __call__(self, train_state, batch):
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        if self._step_fn is None:
+            if self._shardings is None:
+                raise RuntimeError("call init() before the first step")
+            self._step_fn = self._build(batch)
+        batch_sh = self._batch_sharding(batch)
+        batch = {k: jax.device_put(v, batch_sh[k]) for k, v in batch.items()}
+        return self._step_fn(train_state, batch)

@@ -1,0 +1,29 @@
+"""fleet: the unified distributed-training façade.
+
+Capability parity: reference `python/paddle/fluid/incubate/fleet/`
+(`base/fleet_base.py:34` Fleet singleton, `collective/__init__.py`
+Collective/CollectiveOptimizer) and the v2 scaffolding `python/paddle/fleet/`
+(`base/distributed_strategy.py` backed by `distributed_strategy.proto:25-74`).
+
+Usage (reference-compatible)::
+
+    import paddle_tpu.fleet as fleet
+    fleet.init(is_collective=True)
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    opt = fleet.distributed_optimizer(optimizer, strategy)
+    opt.minimize(loss)          # static: rewrites program w/ c_allreduce
+"""
+
+from .base import (  # noqa: F401
+    DistributedOptimizer,
+    Fleet,
+    distributed_optimizer,
+    fleet,
+    init,
+    is_first_worker,
+    worker_index,
+    worker_num,
+)
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase, UserDefinedRoleMaker  # noqa: F401

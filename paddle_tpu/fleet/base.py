@@ -1,0 +1,190 @@
+"""Fleet singleton + DistributedOptimizer.
+
+Capability parity: reference `incubate/fleet/base/fleet_base.py` (`Fleet:34`
+— init(role_maker), is_worker, worker_index, save_persistables;
+`DistributedOptimizer:252`) and `incubate/fleet/collective/__init__.py`
+(`CollectiveOptimizer:384` — wraps the collective transpiler;
+checkpointing `save_check_point:236` lives in fleet/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..fluid import framework
+from .distributed_strategy import DistributedStrategy
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker: RoleMakerBase | None = None
+        self._strategy: DistributedStrategy | None = None
+        self._is_initialized = False
+
+    # -- lifecycle (cf. fleet_base.py Fleet.init) ------------------------
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        if role_maker is None:
+            role_maker = PaddleCloudRoleMaker(is_collective=is_collective)
+        role_maker.generate_role()
+        self._role_maker = role_maker
+        self._strategy = strategy or DistributedStrategy()
+        self._is_initialized = True
+        # multi-host: join the XLA runtime now (≈ NCCL comm init)
+        from ..distributed.parallel import init_parallel_env
+
+        if self.worker_num() > 1 and os.getenv("PADDLE_TRAINER_ENDPOINTS"):
+            init_parallel_env()
+        return self
+
+    def _ensure(self):
+        if not self._is_initialized:
+            raise RuntimeError("call fleet.init(...) first")
+
+    # -- identity --------------------------------------------------------
+    def is_worker(self):
+        self._ensure()
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        self._ensure()
+        return self._role_maker.is_server()
+
+    def is_first_worker(self):
+        self._ensure()
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        self._ensure()
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        self._ensure()
+        return self._role_maker.worker_num()
+
+    def worker_endpoints(self):
+        self._ensure()
+        return self._role_maker.get_trainer_endpoints()
+
+    # reference no-ops kept for script parity
+    def init_worker(self):
+        pass
+
+    def stop_worker(self):
+        pass
+
+    def barrier_worker(self):
+        # program order + jax.distributed is the barrier; parity no-op
+        pass
+
+    # -- persistence (cf. fleet save_persistables) -----------------------
+    def save_persistables(self, executor, dirname, main_program=None):
+        from ..fluid import io
+
+        if self.is_first_worker():
+            io.save_persistables(executor, dirname, main_program)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None):
+        from ..fluid import io
+
+        if self.is_first_worker():
+            io.save_inference_model(
+                dirname, feeded_var_names, target_vars, executor,
+                main_program=main_program,
+            )
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._ensure()
+        return DistributedOptimizer(
+            optimizer, strategy or self._strategy, fleet_=self
+        )
+
+
+class DistributedOptimizer:
+    """cf. CollectiveOptimizer (collective/__init__.py:384): minimize =
+    inner minimize + collective transpile; strategy toggles compose
+    program-rewrite wrappers (amp, recompute, gradient merge)."""
+
+    def __init__(self, optimizer, strategy=None, fleet_=None):
+        self._inner = optimizer
+        self._strategy = strategy or DistributedStrategy()
+        self._fleet = fleet_ or fleet
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        opt = self._inner
+        s = self._strategy
+        from ..fluid.contrib.mixed_precision import decorate as amp_decorate
+        from ..fluid.optimizer import GradientMergeOptimizer, RecomputeOptimizer
+
+        if s.recompute:
+            opt = RecomputeOptimizer(opt)
+            opt._set_checkpoints(s.recompute_configs.checkpoints)
+        if s.amp:
+            opt = amp_decorate(
+                opt,
+                init_loss_scaling=s.amp_configs.init_loss_scaling,
+                use_dynamic_loss_scaling=s.amp_configs.use_dynamic_loss_scaling,
+            )
+        if s.gradient_merge:
+            opt = GradientMergeOptimizer(
+                opt, k_steps=s.gradient_merge_configs.k_steps,
+                avg=s.gradient_merge_configs.avg,
+            )
+        result = opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        if framework.in_dygraph_mode():
+            return result
+        # static mode: rewrite grads -> c_allreduce (GradAllReduce parity)
+        n = self._fleet.worker_num() if self._fleet._is_initialized else 1
+        if s.localsgd:
+            from ..fluid.transpiler.collective import LocalSGD
+
+            t = LocalSGD(k_steps=s.localsgd_configs.k_steps)
+            t.transpile(
+                startup_program or framework.default_startup_program(),
+                framework.default_main_program(),
+                rank=self._fleet.worker_index(),
+                endpoints=["x"] * max(n, 1),
+            )
+            self.localsgd_avg_program = t.avg_program
+        elif n > 1:
+            from ..fluid.transpiler.collective import GradAllReduce
+
+            t = GradAllReduce()
+            t.transpile(
+                startup_program or framework.default_startup_program(),
+                framework.default_main_program(),
+                rank=self._fleet.worker_index(),
+                endpoints=["x"] * n,
+            )
+        return result
+
+
+fleet = Fleet()
+
+
+# module-level conveniences matching `paddle.fleet` usage
+def init(role_maker=None, is_collective=True, strategy=None):
+    return fleet.init(role_maker, is_collective, strategy)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def worker_index():
+    return fleet.worker_index()
+
+
+def worker_num():
+    return fleet.worker_num()
+
+
+def is_first_worker():
+    return fleet.is_first_worker()

@@ -1,0 +1,88 @@
+"""Role makers: who am I in the cluster.
+
+Capability parity: reference `incubate/fleet/base/role_maker.py`
+(`PaddleCloudRoleMaker:477` env-driven, `UserDefinedRoleMaker:988`,
+`GeneralRoleMaker:578` gloo-rendezvous).  The TPU build has no parameter
+servers, so every process is a WORKER; rendezvous is jax.distributed
+(topology.py), so role makers only answer identity questions.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._generated = False
+
+    def generate_role(self):
+        self._generated = True
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_num(self):
+        return 1
+
+    def worker_index(self):
+        return 0
+
+    def server_num(self):
+        return 0
+
+    def get_trainer_endpoints(self):
+        return []
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-contract role maker (cf. role_maker.py:477): reads
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS."""
+
+    def __init__(self, is_collective=True):
+        super().__init__()
+        self._is_collective = is_collective
+
+    def worker_num(self):
+        return int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+
+    def worker_index(self):
+        return int(os.getenv("PADDLE_TRAINER_ID", "0"))
+
+    def get_trainer_endpoints(self):
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """cf. role_maker.py:988."""
+
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._worker_num = worker_num
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def worker_num(self):
+        return self._worker_num
+
+    def worker_index(self):
+        return self._current_id

@@ -40,6 +40,7 @@ from .framework import (  # noqa: F401
     program_guard,
 )
 from .layer_helper import ParamAttr  # noqa: F401
+from . import dygraph  # noqa: F401  (after core symbols: dygraph imports them)
 
 
 def data(name, shape, dtype="float32", lod_level=0):

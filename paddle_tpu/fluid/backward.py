@@ -91,6 +91,48 @@ def _vjp_grad(ctx, ins, attrs):
 
 
 # ---------------------------------------------------------------------------
+# Recompute segments (activation checkpointing)
+# ---------------------------------------------------------------------------
+# Capability parity: reference `backward.py:629`
+# `_append_backward_ops_with_checkpoints_` re-emits forward ops between user
+# checkpoints before their grads.  TPU-first: a segment becomes ONE composite
+# op whose lowering runs the segment under `jax.checkpoint`; the generic VJP
+# then differentiates the segment as a unit, so XLA stores only segment
+# boundaries and rematerializes the interior in the backward pass (the
+# reference's re-emission + our CSE-proofing in one primitive).
+
+
+@register_op("recompute_segment", inputs=["X"], outputs=["Out"], grad="auto",
+             needs_rng=True)
+def _recompute_segment(ctx, ins, attrs):
+    from .core.block_eval import run_ops
+
+    seg_ops = attrs["ops"]  # serialized op dicts (framework.Operator.to_dict)
+    in_names = attrs["in_names"]
+    out_names = attrs["out_names"]
+    needs_rng = any(get_op_def(od["type"]).needs_rng for od in seg_ops)
+    # RNG key must be IDENTICAL between the primal lowering and the VJP
+    # re-lowering (the grad path resets its sub-context counter), so derive
+    # it from the program base key + a per-segment static seed — NOT from
+    # ctx.rng(), whose counter differs between the two traversals.
+    key = None
+    if needs_rng:
+        key = jax.random.fold_in(
+            ctx._base_key, 0x5E6 ^ int(attrs.get("segment_seed", 0))
+        )
+    is_test = ctx.is_test
+
+    def seg(key, xs):
+        env = dict(zip(in_names, xs))
+        sub = LowerContext(base_key=key, is_test=is_test)
+        run_ops(seg_ops, env, sub)
+        return [env[n] for n in out_names]
+
+    seg = jax.checkpoint(seg)
+    return {"Out": seg(key, list(ins["X"]))}
+
+
+# ---------------------------------------------------------------------------
 # Custom grad makers (ops whose grads can't come from plain VJP)
 # ---------------------------------------------------------------------------
 

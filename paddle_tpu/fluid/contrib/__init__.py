@@ -1,0 +1,6 @@
+"""contrib: mixed precision (AMP), slim/quant stubs.
+
+Capability parity: reference `python/paddle/fluid/contrib/`.
+"""
+
+from . import mixed_precision  # noqa: F401

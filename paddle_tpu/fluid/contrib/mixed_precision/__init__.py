@@ -1,0 +1,16 @@
+"""Automatic mixed precision (static graph).
+
+Capability parity: reference `contrib/mixed_precision/` — `decorate:218`,
+`OptimizerWithMixedPrecision` (decorator.py), `rewrite_program`
+(fp16_utils.py:190) black/white-list cast insertion, dynamic loss scaling
+(`update_loss_scaling` fp16_utils.py:333).
+
+TPU-first: the low-precision dtype defaults to bfloat16 — same exponent
+range as fp32, so loss scaling is mathematically unnecessary; the dynamic
+loss-scaling machinery is still implemented (reference parity + fp16
+support) but `decorate(..., use_bf16=True)` disables it by default.
+"""
+
+from .decorator import OptimizerWithMixedPrecision, decorate  # noqa: F401
+from .fp16_lists import AutoMixedPrecisionLists  # noqa: F401
+from .fp16_utils import cast_model_to_bf16, rewrite_program  # noqa: F401

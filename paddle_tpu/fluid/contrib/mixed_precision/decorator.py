@@ -1,0 +1,173 @@
+"""OptimizerWithMixedPrecision: AMP as an optimizer wrapper.
+
+Capability parity: reference `contrib/mixed_precision/decorator.py` —
+`decorate:218` and `OptimizerWithMixedPrecision:27` (scale loss, backward,
+check-finite + unscale, dynamic loss-scale update, apply).
+"""
+
+from __future__ import annotations
+
+from ... import framework, unique_name
+from ...framework import Operator, default_startup_program
+from .fp16_lists import AutoMixedPrecisionLists
+from .fp16_utils import rewrite_program
+
+
+class OptimizerWithMixedPrecision:
+    def __init__(self, optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+                 use_dynamic_loss_scaling=True, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.5,
+                 dest_dtype="bfloat16"):
+        self._inner = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._dest_dtype = dest_dtype
+        # bf16 covers the fp32 exponent range: loss scaling off by default
+        self._use_scaling = use_dynamic_loss_scaling and dest_dtype == "float16"
+        self._init_loss_scaling = init_loss_scaling
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._loss_scaling = None
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def _make_state_var(self, block, sblock, name, value, dtype="float32"):
+        v = block.create_var(name=name, shape=(1,), dtype=dtype,
+                             persistable=True, stop_gradient=True)
+        sblock.create_var(name=name, shape=(1,), dtype=dtype,
+                          persistable=True, stop_gradient=True)
+        sblock.append_op(
+            "fill_constant", outputs={"Out": [name]},
+            attrs={"shape": [1], "value": float(value), "dtype": dtype},
+            infer=False,
+        )
+        return v
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        """Rewrite + (scaled) backward.  Split from minimize so outer
+        wrappers (gradient merge, recompute) compose (reference
+        OptimizerWithMixedPrecision.backward)."""
+        main = framework.default_main_program()
+        block = main.global_block
+        sblock = (startup_program or default_startup_program()).global_block
+
+        # 1. cast insertion on the forward program (fp16_utils.py:190)
+        rewrite_program(main, self._amp_lists, self._dest_dtype)
+
+        if not self._use_scaling:
+            return self._inner.backward(
+                loss, startup_program, parameter_list, no_grad_set
+            )
+
+        # 2. scale the loss (decorator.py backward)
+        ls_name = unique_name.generate("loss_scaling")
+        self._loss_scaling = self._make_state_var(
+            block, sblock, ls_name, self._init_loss_scaling
+        )
+        good = self._make_state_var(
+            block, sblock, unique_name.generate("good_steps"), 0, "int32"
+        )
+        bad = self._make_state_var(
+            block, sblock, unique_name.generate("bad_steps"), 0, "int32"
+        )
+        scaled_name = unique_name.generate(loss.name + ".scaled")
+        block.append_op(
+            "elementwise_mul",
+            inputs={"X": [loss.name], "Y": [ls_name]},
+            outputs={"Out": [scaled_name]},
+            attrs={"axis": -1},
+        )  # infer=True: the scaled loss picks up the broadcast (1,) shape
+        scaled_loss = block.var(scaled_name)
+
+        params_grads = self._inner.backward(
+            scaled_loss, startup_program, parameter_list, no_grad_set
+        )
+
+        # 3. unscale grads + detect overflow (check_finite_and_unscale op)
+        found_name = unique_name.generate("found_inf")
+        block.create_var(name=found_name, shape=(1,), dtype="bool",
+                         stop_gradient=True)
+        g_names = [g.name for _, g in params_grads]
+        block.append_op(
+            "check_finite_and_unscale",
+            inputs={"X": g_names, "Scale": [ls_name]},
+            outputs={"Out": g_names, "FoundInfinite": [found_name]},
+            attrs={"op_role": "backward"},
+            infer=False,
+        )
+
+        # 4. zero grads on overflow so the update is a no-op in expectation
+        # (reference skips the whole update via control flow; select-to-zero
+        # is the XLA-friendly equivalent — moments still decay, documented)
+        for _, g in params_grads:
+            zname = unique_name.generate(g.name + ".zeros")
+            block.create_var(name=zname, shape=g.shape, dtype=g.dtype,
+                             stop_gradient=True)
+            block.append_op(
+                "fill_zeros_like", inputs={"X": [g.name]},
+                outputs={"Out": [zname]}, attrs={"op_role": "backward"},
+                infer=False,
+            )
+            block.append_op(
+                "where",
+                inputs={"Condition": [found_name], "X": [zname], "Y": [g.name]},
+                outputs={"Out": [g.name]},
+                attrs={"op_role": "backward"},
+                infer=False,
+            )
+        self._scaling_state = (ls_name, found_name, good.name, bad.name)
+        return params_grads
+
+    def apply_gradients(self, params_grads):
+        if self._use_scaling and getattr(self, "_scaling_state", None):
+            ls_name, found_name, good_name, bad_name = self._scaling_state
+            block = framework.default_main_program().global_block
+            # dynamic loss-scale update (update_loss_scaling op)
+            block.append_op(
+                "update_loss_scaling",
+                inputs={
+                    "LossScaling": [ls_name], "FoundInfinite": [found_name],
+                    "InGoodSteps": [good_name], "InBadSteps": [bad_name],
+                },
+                outputs={
+                    "LossScalingOut": [ls_name], "OutGoodSteps": [good_name],
+                    "OutBadSteps": [bad_name],
+                },
+                attrs={
+                    "incr_every_n_steps": self._incr_every_n_steps,
+                    "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+                    "incr_ratio": self._incr_ratio,
+                    "decr_ratio": self._decr_ratio,
+                    "op_role": "optimize",
+                },
+                infer=False,
+            )
+        return self._inner.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        self.apply_gradients(params_grads)
+        return [], params_grads
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.5, use_dynamic_loss_scaling=True,
+             dest_dtype="bfloat16"):
+    """cf. reference decorate:218."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists=amp_lists, init_loss_scaling=init_loss_scaling,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling,
+        incr_every_n_steps=incr_every_n_steps,
+        decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+        incr_ratio=incr_ratio, decr_ratio=decr_ratio, dest_dtype=dest_dtype,
+    )

@@ -1,0 +1,57 @@
+"""Black/white op lists for AMP cast insertion.
+
+Capability parity: reference `contrib/mixed_precision/fp16_lists.py` —
+white = compute-bound ops that are safe & fast in low precision (MXU ops),
+black = numerically sensitive ops kept in fp32, gray = follow inputs.
+"""
+
+from __future__ import annotations
+
+# MXU-bound ops: always cast to low precision
+white_list = {
+    "matmul",
+    "mul",
+    "bmm",
+    "conv2d",
+    "depthwise_conv2d",
+    "conv2d_transpose",
+    "flash_attention",
+}
+
+# numerically sensitive: force fp32
+black_list = {
+    "softmax_with_cross_entropy",
+    "cross_entropy",
+    "softmax",
+    "log_softmax",
+    "layer_norm",
+    "batch_norm",
+    "group_norm",
+    "mean",
+    "sum",
+    "reduce_mean",
+    "reduce_sum",
+    "squared_l2_norm",
+    "exp",
+    "log",
+    "sigmoid_cross_entropy_with_logits",
+    "update_loss_scaling",
+    "check_finite_and_unscale",
+}
+
+# everything else is gray: runs in whatever precision its inputs arrive in
+
+
+class AutoMixedPrecisionLists:
+    """cf. reference AutoMixedPrecisionLists(custom_white_list,
+    custom_black_list)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(white_list)
+        self.black_list = set(black_list)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+            self.black_list -= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+            self.white_list -= set(custom_black_list)

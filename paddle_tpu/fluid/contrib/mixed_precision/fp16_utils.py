@@ -1,0 +1,156 @@
+"""Program rewriting for AMP: cast insertion + loss-scaling ops.
+
+Capability parity: reference `contrib/mixed_precision/fp16_utils.py` —
+`rewrite_program:190` walks ops inserting casts by black/white list;
+`update_loss_scaling:333` dynamic loss-scale adjustment.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import framework
+from ...core import dtypes as dtypes_mod
+from ...core.registry import register_op
+from ...framework import Operator
+
+
+_FLOATS = {"float32", "float64"}
+
+
+def _cast_name(name, dtype):
+    return "%s.cast_%s" % (name, dtype)
+
+
+def rewrite_program(main_program, amp_lists, dest_dtype="bfloat16"):
+    """Insert casts so white-list ops compute in `dest_dtype` and
+    black-list ops in fp32 (cf. fp16_utils.py:190).  Parameters stay fp32
+    (master weights); their low-precision copies are per-use casts that XLA
+    fuses into the consumer (free on TPU)."""
+    block = main_program.global_block
+    var_dtype = {}  # name -> current dtype str (tracks rewrites)
+
+    def dtype_of(name):
+        if name in var_dtype:
+            return var_dtype[name]
+        v = block._find_var_recursive(name)
+        return v.dtype if v is not None else "float32"
+
+    new_ops = []
+    for op in block.ops:
+        if op.type in amp_lists.white_list:
+            target = dest_dtype
+        elif op.type in amp_lists.black_list:
+            target = "float32"
+        else:
+            target = None  # gray: leave inputs alone
+        if target is not None:
+            for slot, names in op.inputs.items():
+                cast_names = []
+                for name in names:
+                    cur = dtype_of(name)
+                    if cur in _FLOATS or cur == "bfloat16" or cur == "float16":
+                        if cur != target:
+                            cname = _cast_name(name, target)
+                            if not block.has_var(cname):
+                                src = block._find_var_recursive(name)
+                                block.create_var(
+                                    name=cname,
+                                    shape=src.shape if src is not None else None,
+                                    dtype=target,
+                                    stop_gradient=(
+                                        src.stop_gradient if src is not None else False
+                                    ),
+                                )
+                            new_ops.append(Operator(
+                                block, "cast",
+                                inputs={"X": [name]}, outputs={"Out": [cname]},
+                                attrs={
+                                    "in_dtype": cur, "out_dtype": target,
+                                    "op_role": op.attrs.get("op_role", "forward"),
+                                },
+                            ))
+                            name = cname
+                    cast_names.append(name)
+                op.inputs[slot] = cast_names
+            # outputs of white ops become low precision
+            if target != "float32":
+                for names in op.outputs.values():
+                    for name in names:
+                        var_dtype[name] = target
+                        v = block._find_var_recursive(name)
+                        if v is not None and not v.persistable:
+                            v.dtype = target
+        else:
+            # gray op: outputs inherit the (possibly rewritten) input dtype
+            in_dts = {dtype_of(n) for n in op.all_input_names()}
+            if dest_dtype in in_dts and "float32" not in in_dts:
+                for names in op.outputs.values():
+                    for name in names:
+                        var_dtype[name] = dest_dtype
+        new_ops.append(op)
+    block.ops[:] = new_ops
+    main_program._bump()
+
+
+def cast_model_to_bf16(main_program, amp_lists=None):
+    """Pure-bf16 convenience (reference cast_model_to_fp16 analogue)."""
+    from .fp16_lists import AutoMixedPrecisionLists
+
+    rewrite_program(main_program, amp_lists or AutoMixedPrecisionLists(),
+                    dest_dtype="bfloat16")
+
+
+# ---------------------------------------------------------------------------
+# Loss scaling ops (cf. check_finite_and_unscale_op.cc, update_loss_scaling_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op(
+    "check_finite_and_unscale",
+    inputs=["X", "Scale"],
+    outputs=["Out", "FoundInfinite"],
+    grad=None,
+)
+def _check_finite_and_unscale(ctx, ins, attrs):
+    scale = ins["Scale"][0]
+    outs = []
+    found = jnp.zeros((), jnp.bool_)
+    for g in ins["X"]:
+        gs = g.astype(jnp.float32) / scale
+        found = found | ~jnp.all(jnp.isfinite(gs))
+        outs.append(gs)
+    return {"Out": outs, "FoundInfinite": [found.reshape(1)]}
+
+
+@register_op(
+    "update_loss_scaling",
+    inputs=["LossScaling", "FoundInfinite", "InGoodSteps", "InBadSteps"],
+    outputs=["LossScalingOut", "OutGoodSteps", "OutBadSteps"],
+    grad=None,
+)
+def _update_loss_scaling(ctx, ins, attrs):
+    """cf. update_loss_scaling_op.cc: grow scale after N clean steps, shrink
+    on overflow."""
+    ls = ins["LossScaling"][0]
+    found = ins["FoundInfinite"][0].reshape(())
+    good = ins["InGoodSteps"][0]
+    bad = ins["InBadSteps"][0]
+    incr_every = attrs.get("incr_every_n_steps", 1000)
+    decr_every = attrs.get("decr_every_n_nan_or_inf", 2)
+    incr_ratio = attrs.get("incr_ratio", 2.0)
+    decr_ratio = attrs.get("decr_ratio", 0.5)
+
+    good_new = jnp.where(found, jnp.zeros_like(good), good + 1)
+    bad_new = jnp.where(found, bad + 1, jnp.zeros_like(bad))
+    grow = good_new >= incr_every
+    shrink = bad_new >= decr_every
+    ls_new = jnp.where(grow, ls * incr_ratio, ls)
+    ls_new = jnp.where(shrink, jnp.maximum(ls * decr_ratio, 1.0), ls_new)
+    good_new = jnp.where(grow, jnp.zeros_like(good_new), good_new)
+    bad_new = jnp.where(shrink, jnp.zeros_like(bad_new), bad_new)
+    return {
+        "LossScalingOut": [ls_new],
+        "OutGoodSteps": [good_new],
+        "OutBadSteps": [bad_new],
+    }

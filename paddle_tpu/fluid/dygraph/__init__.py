@@ -1,0 +1,31 @@
+"""Imperative (dygraph) mode.
+
+Capability parity: reference `python/paddle/fluid/dygraph/` — eager
+execution with taped autograd (imperative/tracer.cc, basic_engine.cc),
+Layer/nn/containers, to_variable/guard/no_grad, save/load_dygraph.
+"""
+
+from . import base, container, layers, nn  # noqa: F401
+from .base import (  # noqa: F401
+    disable_dygraph,
+    enable_dygraph,
+    enabled,
+    guard,
+    no_grad,
+    to_variable,
+)
+from .checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from .container import LayerList, ParameterList, Sequential  # noqa: F401
+from .layers import Layer  # noqa: F401
+from .nn import (  # noqa: F401
+    BatchNorm,
+    Conv2D,
+    Dropout,
+    Embedding,
+    GroupNorm,
+    LayerNorm,
+    Linear,
+    Pool2D,
+)
+from .tracer import Tracer  # noqa: F401
+from .varbase import ParamBase, VarBase  # noqa: F401

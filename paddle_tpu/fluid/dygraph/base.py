@@ -1,0 +1,76 @@
+"""Dygraph mode switches: guard, to_variable, no_grad, enable/disable.
+
+Capability parity: reference `python/paddle/fluid/dygraph/base.py`
+(`guard`, `to_variable`, `no_grad`, `enabled`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+
+from .. import framework
+from .tracer import Tracer
+from .varbase import VarBase
+
+
+def enabled():
+    return framework.in_dygraph_mode()
+
+
+def enable_dygraph(place=None):
+    if framework._dygraph_tracer is None:
+        framework._dygraph_tracer = Tracer()
+
+
+def disable_dygraph():
+    framework._dygraph_tracer = None
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """cf. fluid.dygraph.guard — activates eager mode within the block."""
+    old = framework._dygraph_tracer
+    framework._dygraph_tracer = Tracer()
+    try:
+        yield
+    finally:
+        framework._dygraph_tracer = old
+
+
+def to_variable(value, name=None, zero_copy=None, stop_gradient=True):
+    """numpy/jax array -> eager VarBase (cf. reference base.py to_variable)."""
+    if isinstance(value, VarBase):
+        return value
+    if isinstance(value, framework.Variable):
+        raise TypeError("to_variable expects an array, got a static Variable")
+    return VarBase(np.asarray(value) if not hasattr(value, "dtype") else value,
+                   name=name, stop_gradient=stop_gradient)
+
+
+class no_grad:
+    """Context-manager AND decorator disabling tape recording
+    (cf. reference dygraph.base.no_grad)."""
+
+    def __enter__(self):
+        tracer = framework._dygraph_tracer
+        self._old = tracer._has_grad if tracer is not None else None
+        if tracer is not None:
+            tracer._has_grad = False
+        return self
+
+    def __exit__(self, *exc):
+        tracer = framework._dygraph_tracer
+        if tracer is not None and self._old is not None:
+            tracer._has_grad = self._old
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with no_grad():
+                return fn(*a, **kw)
+
+        return wrapper

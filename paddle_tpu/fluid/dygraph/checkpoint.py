@@ -1,0 +1,58 @@
+"""save_dygraph / load_dygraph: eager state-dict checkpointing.
+
+Capability parity: reference `python/paddle/fluid/dygraph/checkpoint.py`
+(save_dygraph -> .pdparams / .pdopt npz-style files, load_dygraph returns
+(param_dict, opt_dict)).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .varbase import VarBase
+
+
+def _to_numpy_dict(state_dict):
+    out = {}
+    for k, v in state_dict.items():
+        out[k] = np.asarray(v.data) if isinstance(v, VarBase) else np.asarray(v)
+    return out
+
+
+def save_dygraph(state_dict, model_path):
+    """cf. reference save_dygraph: writes <path>.pdparams (or .pdopt when the
+    dict looks like optimizer state)."""
+    base = str(model_path)
+    if base.endswith(".pdparams") or base.endswith(".pdopt"):
+        base = base.rsplit(".", 1)[0]
+    is_opt = any(not isinstance(v, VarBase) and not hasattr(v, "shape")
+                 for v in state_dict.values())
+    suffix = ".pdopt" if is_opt else ".pdparams"
+    d = os.path.dirname(base)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = {}
+    for k, v in state_dict.items():
+        payload[k] = np.asarray(v.data) if isinstance(v, VarBase) else v
+    with open(base + suffix, "wb") as f:
+        pickle.dump(payload, f, protocol=2)
+
+
+def load_dygraph(model_path):
+    """cf. reference load_dygraph -> (param_dict, opt_dict)."""
+    base = str(model_path)
+    if base.endswith(".pdparams") or base.endswith(".pdopt"):
+        base = base.rsplit(".", 1)[0]
+    params, opt = None, None
+    if os.path.exists(base + ".pdparams"):
+        with open(base + ".pdparams", "rb") as f:
+            params = pickle.load(f)
+    if os.path.exists(base + ".pdopt"):
+        with open(base + ".pdopt", "rb") as f:
+            opt = pickle.load(f)
+    if params is None and opt is None:
+        raise ValueError("no checkpoint found at '%s(.pdparams|.pdopt)'" % base)
+    return params, opt

@@ -1,0 +1,255 @@
+"""Layer: the dygraph module base class.
+
+Capability parity: reference `python/paddle/fluid/dygraph/layers.py:60`
+(Layer: lazy parameter dict, sublayer tree, hooks, state_dict,
+train/eval, `__call__:583`).
+
+Works in BOTH modes (the 2.0 design): parameters are eager ParamBase in
+dygraph mode and static Parameters otherwise, created through LayerHelper;
+forward() composes fluid.layers functions which dispatch per mode.  A
+dygraph Layer's forward is jax-traceable, so `jax.jit(layer)` and
+`functional_call` (params-as-pytree application, used by the distributed
+train-step builder) both work.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..layer_helper import LayerHelper, ParamAttr
+from .varbase import ParamBase, VarBase
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._full_name = name_scope or type(self).__name__.lower()
+        self._dtype = dtype
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self.training = True
+
+    # -- mode ------------------------------------------------------------
+    def train(self):
+        self.training = True
+        tracer = framework._dygraph_tracer
+        if tracer is not None:
+            tracer.train_mode = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        tracer = framework._dygraph_tracer
+        if tracer is not None:
+            tracer.train_mode = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # -- parameter creation ---------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ):
+        helper = LayerHelper(self._full_name)
+        return helper.create_parameter(
+            attr,
+            list(shape),
+            dtype=dtype or self._dtype,
+            is_bias=is_bias,
+            default_initializer=default_initializer,
+        )
+
+    def register_buffer(self, name, value, persistable=True):
+        if not isinstance(value, VarBase) and value is not None:
+            value = VarBase(value, stop_gradient=True, persistable=persistable)
+        self._buffers[name] = value
+        return value
+
+    # -- tree ------------------------------------------------------------
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def add_parameter(self, name, parameter):
+        self._parameters[str(name)] = parameter
+        return parameter
+
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        if isinstance(value, (ParamBase, framework.Parameter)):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            layers[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for d in ("_parameters", "_sub_layers", "_buffers"):
+            table = self.__dict__.get(d)
+            if table is not None and name in table:
+                return table[name]
+        raise AttributeError(
+            "'%s' object has no attribute '%s'" % (type(self).__name__, name)
+        )
+
+    def __delattr__(self, name):
+        for d in ("_parameters", "_sub_layers", "_buffers"):
+            table = self.__dict__.get(d)
+            if table is not None and name in table:
+                del table[name]
+                return
+        object.__delattr__(self, name)
+
+    def children(self):
+        yield from self._sub_layers.values()
+
+    def named_children(self):
+        yield from self._sub_layers.items()
+
+    def sublayers(self, include_self=False):
+        out = [self] if include_self else []
+        for l in self._sub_layers.values():
+            out.extend(l.sublayers(include_self=True))
+        return out
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers)]
+
+    def named_parameters(self, include_sublayers=True, prefix=""):
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else prefix + "." + name), p
+        if include_sublayers:
+            for lname, l in self._sub_layers.items():
+                sub_prefix = prefix + "." + lname if prefix else lname
+                for n, p in l.named_parameters(True, sub_prefix):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def named_buffers(self, prefix=""):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (prefix + "." + name if prefix else name), b
+        for lname, l in self._sub_layers.items():
+            sub_prefix = prefix + "." + lname if prefix else lname
+            yield from l.named_buffers(sub_prefix)
+
+    # -- hooks -----------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks, len(self._forward_pre_hooks))
+        self._forward_pre_hooks[handle.idx] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks, len(self._forward_post_hooks))
+        self._forward_post_hooks[handle.idx] = hook
+        return handle
+
+    # -- run -------------------------------------------------------------
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    # -- state dict ------------------------------------------------------
+    def state_dict(self, include_sublayers=True):
+        d = collections.OrderedDict()
+        for name, p in self.named_parameters(include_sublayers):
+            d[name] = p
+        for name, b in self.named_buffers():
+            d[name] = b
+        return d
+
+    def set_state_dict(self, state_dict, include_sublayers=True):
+        own = self.state_dict(include_sublayers)
+        missing = [k for k in own if k not in state_dict]
+        for name, var in own.items():
+            if name not in state_dict:
+                continue
+            value = state_dict[name]
+            arr = value.data if isinstance(value, VarBase) else np.asarray(value)
+            if tuple(arr.shape) != tuple(var.shape):
+                raise ValueError(
+                    "shape mismatch for '%s': checkpoint %s vs layer %s"
+                    % (name, tuple(arr.shape), tuple(var.shape))
+                )
+            if isinstance(var, VarBase):
+                var.data = jnp.asarray(arr, dtype=var.data.dtype)
+            else:  # static-mode Parameter: write into the scope
+                from ..core.scope import global_scope
+
+                global_scope().set(var.name, jnp.asarray(arr))
+        return missing
+
+    # reference aliases
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            if isinstance(p, VarBase):
+                p.clear_gradient()
+
+    # -- functional application (TPU-native extension) -------------------
+    def functional_call(self, params, *args, **kwargs):
+        """Run forward with parameter arrays taken from ``params``
+        ({name: array}, as produced by ``{k: v.data for k, v in
+        layer.state_dict().items()}``).  Pure w.r.t. the layer's own state,
+        so it is safe to `jax.jit` / `jax.grad` over: used by the
+        distributed train-step builder (parallel/ package)."""
+        sd = self.state_dict()
+        saved = {}
+        try:
+            for name, arr in params.items():
+                var = sd.get(name)
+                if var is None:
+                    raise KeyError("unknown parameter '%s'" % name)
+                saved[name] = var.data
+                var.data = arr
+            return self(*args, **kwargs)
+        finally:
+            for name, arr in saved.items():
+                sd[name].data = arr
+
+
+class _HookHandle:
+    def __init__(self, table, idx):
+        self._table = table
+        self.idx = idx
+
+    def remove(self):
+        self._table.pop(self.idx, None)

@@ -1,0 +1,346 @@
+"""Parameter-holding layer classes (dygraph *and* static capable).
+
+Capability parity: reference `python/paddle/fluid/dygraph/nn.py` (Conv2D,
+Linear, Pool2D, BatchNorm, Embedding, LayerNorm, Dropout, GroupNorm, PRelu,
+Conv2DTranspose...).  Parameters are created once in ``__init__``; forward
+composes the shared op layer (`layers/common.py`) which dispatches eagerly
+in dygraph mode and appends program ops in static mode — so the same model
+class serves both the imperative milestone (ResNet-50 dygraph) and the
+static flagship path.
+"""
+
+from __future__ import annotations
+
+from .. import framework
+from ..initializer import ConstantInitializer
+from ..layer_helper import ParamAttr
+from ..layers.common import append_simple_op
+from .layers import Layer
+
+
+def _pair(v):
+    return [v, v] if isinstance(v, int) else list(v)
+
+
+class Linear(Layer):
+    """cf. reference dygraph/nn.py Linear."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._act = act
+        self.weight = self.create_parameter(
+            [input_dim, output_dim], attr=param_attr, dtype=dtype
+        )
+        self.bias = (
+            None
+            if bias_attr is False
+            else self.create_parameter(
+                [output_dim], attr=bias_attr, dtype=dtype, is_bias=True
+            )
+        )
+
+    def forward(self, input):
+        out = append_simple_op(
+            "mul",
+            {"X": input, "Y": self.weight},
+            {"x_num_col_dims": len(input.shape) - 1, "y_num_col_dims": 1},
+        )
+        if self.bias is not None:
+            out = append_simple_op(
+                "elementwise_add",
+                {"X": out, "Y": self.bias},
+                {"axis": len(input.shape) - 1},
+            )
+        if self._act:
+            out = append_simple_op(self._act, {"X": out}, {})
+        return out
+
+
+class Conv2D(Layer):
+    """cf. reference dygraph/nn.py Conv2D."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._act = act
+        self._stride = _pair(stride)
+        self._padding = _pair(padding)
+        self._dilation = _pair(dilation)
+        self._groups = groups or 1
+        fs = _pair(filter_size)
+        import math
+
+        from ..initializer import NormalInitializer
+
+        fan_in = (num_channels // self._groups) * fs[0] * fs[1]
+        std = math.sqrt(2.0 / fan_in)
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // self._groups] + fs,
+            attr=param_attr,
+            dtype=dtype,
+            default_initializer=NormalInitializer(0.0, std),
+        )
+        self.bias = (
+            None
+            if bias_attr is False
+            else self.create_parameter(
+                [num_filters], attr=bias_attr, dtype=dtype, is_bias=True
+            )
+        )
+
+    def forward(self, input):
+        out = append_simple_op(
+            "conv2d",
+            {"Input": input, "Filter": self.weight},
+            {
+                "strides": self._stride,
+                "paddings": self._padding,
+                "dilations": self._dilation,
+                "groups": self._groups,
+            },
+            out_slots=("Output",),
+        )
+        if self.bias is not None:
+            out = append_simple_op(
+                "elementwise_add", {"X": out, "Y": self.bias}, {"axis": 1}
+            )
+        if self._act:
+            out = append_simple_op(self._act, {"X": out}, {})
+        return out
+
+
+class Pool2D(Layer):
+    """cf. reference dygraph/nn.py Pool2D."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True):
+        super().__init__()
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": _pair(pool_size),
+            "strides": _pair(pool_stride),
+            "paddings": _pair(pool_padding),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        }
+
+    def forward(self, input):
+        return append_simple_op("pool2d", {"X": input}, dict(self._attrs))
+
+
+class BatchNorm(Layer):
+    """cf. reference dygraph/nn.py BatchNorm; running stats are buffers
+    updated in place by the op's stateful outputs."""
+
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", use_global_stats=False,
+                 trainable_statistics=False):
+        super().__init__(dtype=dtype)
+        self._act = act
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_layout = data_layout
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            [num_channels], attr=param_attr, dtype=dtype,
+            default_initializer=ConstantInitializer(1.0),
+        )
+        self.bias = self.create_parameter(
+            [num_channels], attr=bias_attr, dtype=dtype, is_bias=True
+        )
+        self._mean = self.create_parameter(
+            [num_channels], attr=ParamAttr(trainable=False), dtype=dtype,
+            default_initializer=ConstantInitializer(0.0),
+        )
+        self._variance = self.create_parameter(
+            [num_channels], attr=ParamAttr(trainable=False), dtype=dtype,
+            default_initializer=ConstantInitializer(1.0),
+        )
+
+    def forward(self, input):
+        from ..layers import nn as static_nn
+
+        is_test = (not self.training) or self._use_global_stats
+        if framework.in_dygraph_mode():
+            tracer = framework._dygraph_tracer
+            outs = tracer.eager_run(
+                "batch_norm",
+                {
+                    "X": [input],
+                    "Scale": [self.weight],
+                    "Bias": [self.bias],
+                    "Mean": [self._mean],
+                    "Variance": [self._variance],
+                },
+                {
+                    "momentum": self._momentum,
+                    "epsilon": self._epsilon,
+                    "is_test": is_test,
+                    "data_layout": self._data_layout,
+                },
+            )
+            # write back running stats (MeanOut aliases Mean in reference)
+            self._mean.data = outs["MeanOut"][0].data
+            self._variance.data = outs["VarianceOut"][0].data
+            out = outs["Y"][0]
+        else:
+            out, *_ = append_simple_op(
+                "batch_norm",
+                {
+                    "X": input,
+                    "Scale": self.weight,
+                    "Bias": self.bias,
+                    "Mean": self._mean,
+                    "Variance": self._variance,
+                },
+                {
+                    "momentum": self._momentum,
+                    "epsilon": self._epsilon,
+                    "is_test": is_test,
+                    "data_layout": self._data_layout,
+                },
+                out_slots=("Y", "SavedMean", "SavedVariance"),
+                n_outs=None,
+            )
+            # alias the running-stat outputs onto the persistable params
+            self.block_alias_running_stats()
+        if self._act:
+            out = append_simple_op(self._act, {"X": out}, {})
+        return out
+
+    def block_alias_running_stats(self):
+        """In static mode the op just appended has fresh MeanOut/VarianceOut
+        temp names; rewrite them to alias the persistable stats so the
+        executor writes running statistics back to the scope."""
+        block = framework.default_main_program().current_block()
+        op = block.ops[-1]
+        if op.type == "batch_norm":
+            op.outputs["MeanOut"] = [self._mean.name]
+            op.outputs["VarianceOut"] = [self._variance.name]
+
+
+class Embedding(Layer):
+    """cf. reference dygraph/nn.py Embedding (lookup_table)."""
+
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._size = list(size)
+        if padding_idx is None:
+            self._padding_idx = -1
+        elif padding_idx < 0:
+            self._padding_idx = int(size[0]) + padding_idx
+        else:
+            self._padding_idx = padding_idx
+        self.weight = self.create_parameter(self._size, attr=param_attr, dtype=dtype)
+
+    def forward(self, input):
+        return append_simple_op(
+            "lookup_table",
+            {"W": self.weight, "Ids": input},
+            {"padding_idx": self._padding_idx},
+            dtype=self._dtype,
+        )
+
+
+class LayerNorm(Layer):
+    """cf. reference dygraph/nn.py LayerNorm."""
+
+    def __init__(self, normalized_shape, scale=True, shift=True, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self._act = act
+        n = 1
+        for s in self._normalized_shape:
+            n *= int(s)
+        self.weight = (
+            self.create_parameter(
+                [n], attr=param_attr, dtype=dtype,
+                default_initializer=ConstantInitializer(1.0),
+            )
+            if scale
+            else None
+        )
+        self.bias = (
+            self.create_parameter([n], attr=bias_attr, dtype=dtype, is_bias=True)
+            if shift
+            else None
+        )
+
+    def forward(self, input):
+        bna = len(input.shape) - len(self._normalized_shape)
+        ins = {"X": input}
+        if self.weight is not None:
+            ins["Scale"] = self.weight
+        if self.bias is not None:
+            ins["Bias"] = self.bias
+        out, _, _ = append_simple_op(
+            "layer_norm",
+            ins,
+            {"begin_norm_axis": bna, "epsilon": self._epsilon},
+            out_slots=("Y", "Mean", "Variance"),
+        )
+        if self._act:
+            out = append_simple_op(self._act, {"X": out}, {})
+        return out
+
+
+class Dropout(Layer):
+    """cf. reference dygraph/nn.py Dropout."""
+
+    def __init__(self, p=0.5, dropout_implementation="downgrade_in_infer",
+                 is_test=False):
+        super().__init__()
+        self._p = p
+        self._impl = dropout_implementation
+
+    def forward(self, input):
+        out, _ = append_simple_op(
+            "dropout",
+            {"X": input},
+            {
+                "dropout_prob": self._p,
+                "is_test": not self.training,
+                "dropout_implementation": self._impl,
+            },
+            out_slots=("Out", "Mask"),
+        )
+        return out
+
+
+class GroupNorm(Layer):
+    """cf. reference dygraph/nn.py GroupNorm."""
+
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._groups = groups
+        self._epsilon = epsilon
+        self._act = act
+        self.weight = self.create_parameter(
+            [channels], attr=param_attr, dtype=dtype,
+            default_initializer=ConstantInitializer(1.0),
+        )
+        self.bias = self.create_parameter(
+            [channels], attr=bias_attr, dtype=dtype, is_bias=True
+        )
+
+    def forward(self, input):
+        out, _, _ = append_simple_op(
+            "group_norm",
+            {"X": input, "Scale": self.weight, "Bias": self.bias},
+            {"groups": self._groups, "epsilon": self._epsilon},
+            out_slots=("Y", "Mean", "Variance"),
+        )
+        if self._act:
+            out = append_simple_op(self._act, {"X": out}, {})
+        return out

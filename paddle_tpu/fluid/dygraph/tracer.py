@@ -1,0 +1,262 @@
+"""Eager op execution with taped reverse-mode autograd.
+
+Capability parity: reference `paddle/fluid/imperative/tracer.cc:45`
+(Tracer::TraceOp creates + runs an op immediately, then CreateGradOpNode
+tapes it) and `imperative/basic_engine.cc:159` (reverse sweep with dependency
+counting and gradient accumulation, `gradient_accumulator.cc`).
+
+TPU-first redesign: there is no separate grad-op registry.  Every registered
+op lowering is a pure JAX function, so the tape stores (opdef, inputs, attrs,
+rng key) and the backward sweep calls `jax.vjp` on the forward lowering
+itself.  RNG ops (dropout...) replay the exact key used in forward, so the
+recomputed mask is identical — no Mask plumbing needed.  Because lowerings
+are jax-traceable, a dygraph forward also traces cleanly under `jax.jit`
+(the tape then records tracers, which is fine: it is trace-time only).
+"""
+
+from __future__ import annotations
+
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes as dtypes_mod
+from ..core.registry import LowerContext, get_op_def
+
+
+def _is_float(arr):
+    return jnp.issubdtype(arr.dtype, jnp.floating)
+
+
+class _TapeEntry:
+    __slots__ = ("opdef", "attrs", "ins", "outs", "base_key", "is_test")
+
+    def __init__(self, opdef, attrs, ins, outs, base_key, is_test):
+        self.opdef = opdef
+        self.attrs = attrs
+        self.ins = ins  # {slot: [VarBase]}
+        self.outs = outs  # {slot: [VarBase]}
+        self.base_key = base_key
+        self.is_test = is_test
+
+
+class Tracer:
+    """cf. reference imperative::Tracer + the Python tracer wrapper
+    (`python/paddle/fluid/dygraph/tracer.py`)."""
+
+    def __init__(self, seed=0):
+        self._vars = weakref.WeakValueDictionary()  # name -> VarBase
+        self._tape: list[_TapeEntry] = []
+        self._has_grad = True
+        self.train_mode = True
+        self._base_key = jax.random.PRNGKey(seed)
+        self._op_count = 0
+
+    # -- var table (lets static-graph layer code run eagerly by name) -------
+    def register_var(self, vb):
+        self._vars[vb.name] = vb
+
+    def lookup(self, name):
+        return self._vars.get(name)
+
+    # ------------------------------------------------------------------
+    def eager_run(self, op_type, ins, attrs, out_slots=None):
+        """Run one op immediately on VarBases/arrays.
+
+        ins: {slot: [VarBase | array-like]}.  Returns {slot: [VarBase]}.
+        """
+        from .varbase import VarBase
+
+        opdef = get_op_def(op_type)
+        attrs = dict(attrs or {})
+        in_vbs = {}
+        arrs = {}
+        for slot, vs in ins.items():
+            vbs = []
+            vals = []
+            for v in vs:
+                if not isinstance(v, VarBase):
+                    v = VarBase(jnp.asarray(v), stop_gradient=True)
+                vbs.append(v)
+                vals.append(v.data)
+            in_vbs[slot] = vbs
+            arrs[slot] = vals
+
+        self._op_count += 1
+        op_key = jax.random.fold_in(self._base_key, self._op_count)
+        ctx = LowerContext(base_key=op_key, is_test=not self.train_mode)
+        outs = opdef.lower(ctx, arrs, attrs)
+
+        slots = out_slots or [s for s in opdef.output_slots if s in outs]
+        if not slots:
+            slots = list(outs)
+        out_vbs = {}
+        for slot in slots:
+            out_vbs[slot] = [VarBase(v, stop_gradient=True) for v in outs[slot]]
+
+        # -- tape ----------------------------------------------------------
+        record = (
+            self._has_grad
+            and opdef.grad_maker is not None
+            and any(
+                not vb.stop_gradient and _is_float(vb.data)
+                for slot, vbs in in_vbs.items()
+                if slot not in opdef.no_grad_slots
+                for vb in vbs
+            )
+        )
+        if record:
+            for slot, vbs in out_vbs.items():
+                if slot in opdef.stateful_out_slots:
+                    continue
+                for vb in vbs:
+                    if _is_float(vb.data):
+                        vb.stop_gradient = False
+                        vb._produced = True
+            self._tape.append(
+                _TapeEntry(opdef, attrs, in_vbs, out_vbs, op_key, not self.train_mode)
+            )
+        return out_vbs
+
+    # ------------------------------------------------------------------
+    def trace_op(self, op_type, inputs, outputs, attrs):
+        """Name-keyed entry point used by LayerHelper in dygraph mode.
+
+        inputs/outputs: {slot: [var_name]} — names resolve through the var
+        table, so the static-graph layer functions work unchanged in eager
+        mode (cf. reference where one layer API serves both modes).
+        """
+        from .varbase import VarBase
+
+        ins = {}
+        for slot, names in (inputs or {}).items():
+            vbs = []
+            for n in names:
+                vb = self.lookup(n)
+                if vb is None:
+                    raise RuntimeError(
+                        "dygraph: input var '%s' of op '%s' not found in "
+                        "tracer table" % (n, op_type)
+                    )
+                vbs.append(vb)
+            ins[slot] = vbs
+
+        out_names = {slot: list(ns) for slot, ns in (outputs or {}).items()}
+        # honor explicit stop_gradient=True placeholders (e.g. masks)
+        out_vbs = self.eager_run(op_type, ins, attrs, out_slots=list(out_names))
+        results = {}
+        for slot, names in out_names.items():
+            res = []
+            for name, src in zip(names, out_vbs[slot]):
+                dst = self.lookup(name)
+                if dst is None:
+                    src.name = name
+                    self.register_var(src)
+                    dst = src
+                else:
+                    dst.data = src.data
+                    if not src.stop_gradient:
+                        dst.stop_gradient = False
+                        dst._produced = True
+                        # re-point the tape at the caller's placeholder
+                        if self._tape and self._tape[-1].outs.get(slot):
+                            outs = self._tape[-1].outs[slot]
+                            for i, o in enumerate(outs):
+                                if o is src:
+                                    outs[i] = dst
+                    elif not dst.persistable:
+                        # in-place state writes (optimizer ParamOut, running
+                        # stats) must NOT flip a parameter to stop_gradient
+                        dst.stop_gradient = True
+                res.append(dst)
+            results[slot] = res
+        return results
+
+    # -- backward ------------------------------------------------------
+    def backward(self, root, retain_graph=False):
+        """Reverse sweep (cf. BasicEngine::Execute basic_engine.cc:159)."""
+        grads = {}  # id(VarBase) -> cotangent array
+        alive = {}  # id -> VarBase (keep alive during sweep)
+        grads[id(root)] = jnp.ones_like(root.data)
+        alive[id(root)] = root
+
+        for entry in reversed(self._tape):
+            opdef, attrs = entry.opdef, entry.attrs
+            # cotangents for this op's differentiable outputs
+            diff_outs = []
+            for slot, vbs in entry.outs.items():
+                if slot in opdef.stateful_out_slots:
+                    continue
+                for vb in vbs:
+                    if _is_float(vb.data):
+                        diff_outs.append(vb)
+            if not any(id(vb) in grads for vb in diff_outs):
+                continue
+
+            diff_index = []  # (slot, i)
+            primals = []
+            for slot, vbs in entry.ins.items():
+                if slot in opdef.no_grad_slots:
+                    continue
+                for i, vb in enumerate(vbs):
+                    if not vb.stop_gradient and _is_float(vb.data):
+                        diff_index.append((slot, i))
+                        primals.append(vb.data)
+            if not primals:
+                continue
+
+            in_arrs = {s: [vb.data for vb in vbs] for s, vbs in entry.ins.items()}
+            out_struct = [
+                (slot, len(vbs))
+                for slot, vbs in entry.outs.items()
+                if slot not in opdef.stateful_out_slots
+            ]
+
+            def fwd(*dvals):
+                rebuilt = {s: list(vs) for s, vs in in_arrs.items()}
+                for (slot, i), v in zip(diff_index, dvals):
+                    rebuilt[slot][i] = v
+                ctx = LowerContext(base_key=entry.base_key, is_test=entry.is_test)
+                outs = opdef.lower(ctx, rebuilt, attrs)
+                flat = []
+                for slot, n in out_struct:
+                    for v in outs[slot][:n]:
+                        if jnp.issubdtype(v.dtype, jnp.floating):
+                            flat.append(v)
+                return tuple(flat)
+
+            _, vjp_fn = jax.vjp(fwd, *primals)
+            cots = []
+            for vb in diff_outs:
+                g = grads.get(id(vb))
+                cots.append(g if g is not None else jnp.zeros_like(vb.data))
+            in_grads = vjp_fn(tuple(cots))
+
+            for (slot, i), g in zip(diff_index, in_grads):
+                vb = entry.ins[slot][i]
+                prev = grads.get(id(vb))
+                grads[id(vb)] = g if prev is None else prev + g
+                alive[id(vb)] = vb
+
+            # free output cotangents (no longer needed once consumed)
+            for vb in diff_outs:
+                grads.pop(id(vb), None)
+                alive.pop(id(vb), None)
+
+        # materialize leaf gradients (params & requires-grad inputs),
+        # accumulating across backward calls (reference semantics)
+        for vid, g in grads.items():
+            vb = alive.get(vid)
+            if vb is None:
+                continue
+            if not getattr(vb, "_produced", False):
+                vb._grad = g if vb._grad is None else vb._grad + g
+
+        if not retain_graph:
+            self._tape.clear()
+
+
+def _np(value):
+    return np.asarray(value)

@@ -1,0 +1,179 @@
+"""VarBase / ParamBase: eager tensors backed by jax.Array.
+
+Capability parity: reference `paddle/fluid/imperative/layer.h:56` (VarBase =
+tensor + grad var + stop_gradient) and the Python-side patch methods
+(`dygraph/varbase_patch_methods.py` — backward:127, gradient, numpy).
+
+Subclasses :class:`framework.Variable` so every static-graph layer function
+(isinstance checks, `.name/.dtype/.shape` access, operator sugar) accepts
+eager tensors unchanged.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework, unique_name
+from ..core import dtypes as dtypes_mod
+
+
+class _EagerBlockShim:
+    """Duck-typed Block for program-rewrite utilities (clip, regularizer)
+    that reach vars through ``grad.block`` — resolves names via the active
+    tracer so those utilities run eagerly unchanged."""
+
+    def create_var(self, name=None, shape=None, dtype="float32",
+                   stop_gradient=True, **kw):
+        return VarBase(None, name=name, stop_gradient=stop_gradient)
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None, infer=False):
+        return framework._dygraph_tracer.trace_op(type, inputs, outputs, attrs)
+
+    def var(self, name):
+        vb = framework._dygraph_tracer.lookup(name)
+        if vb is None:
+            raise KeyError("eager var '%s' not found" % name)
+        return vb
+
+    def has_var(self, name):
+        return framework._dygraph_tracer.lookup(name) is not None
+
+
+_eager_block_shim = _EagerBlockShim()
+
+
+class VarBase(framework.Variable):
+    def __init__(self, data, name=None, stop_gradient=True, persistable=False):
+        # NOTE: deliberately does NOT call Variable.__init__ — an eager tensor
+        # belongs to no Block; shape/dtype derive from the live array.
+        self.name = name or unique_name.generate("eager_tmp")
+        self.data = None if data is None else jnp.asarray(data)
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.is_data = False
+        self._grad = None
+        self._produced = False  # True once an op on the tape wrote this var
+        tracer = framework._dygraph_tracer
+        if tracer is not None:
+            tracer.register_var(self)
+
+    @property
+    def block(self):
+        return _eager_block_shim if framework._dygraph_tracer is not None else None
+
+    @block.setter
+    def block(self, _):
+        pass
+
+    # -- array-facing ----------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(int(s) for s in self.data.shape) if self.data is not None else None
+
+    @shape.setter
+    def shape(self, _):
+        pass  # shape always derives from data
+
+    @property
+    def dtype(self):
+        return dtypes_mod.to_str(self.data.dtype) if self.data is not None else "float32"
+
+    @dtype.setter
+    def dtype(self, _):
+        pass
+
+    def numpy(self):
+        return np.asarray(self.data)
+
+    def item(self):
+        return self.numpy().item()
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __len__(self):
+        return int(self.data.shape[0])
+
+    def __getitem__(self, idx):
+        out = VarBase(self.data[idx], stop_gradient=True)
+        # slicing is differentiable; route through the tape when needed
+        tracer = framework._dygraph_tracer
+        if (
+            tracer is not None
+            and not self.stop_gradient
+            and jnp.issubdtype(self.data.dtype, jnp.floating)
+        ):
+            from ..core.registry import has_op
+
+            # fall back to a tape-recorded gather via the slice op family is
+            # overkill here; record a closure-style entry instead
+            return _tape_getitem(tracer, self, idx)
+        return out
+
+    # -- autograd --------------------------------------------------------
+    def backward(self, retain_graph=False):
+        tracer = framework._dygraph_tracer
+        if tracer is None:
+            raise RuntimeError("backward() requires dygraph mode (fluid.dygraph.guard)")
+        tracer.backward(self, retain_graph=retain_graph)
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def detach(self):
+        return VarBase(self.data, stop_gradient=True)
+
+    def astype(self, dtype):
+        out = VarBase(self.data.astype(dtypes_mod.to_jnp(dtype)))
+        out.stop_gradient = self.stop_gradient
+        return out
+
+    def __repr__(self):
+        return "VarBase(name=%s, shape=%s, dtype=%s, stop_gradient=%s)\n%s" % (
+            self.name,
+            self.shape,
+            self.dtype,
+            self.stop_gradient,
+            self.data,
+        )
+
+
+def _tape_getitem(tracer, vb, idx):
+    """Record x[idx] on the tape as a one-off op via jax.vjp in backward."""
+    from ..core.registry import LowerContext, OpDef
+
+    def lower(ctx, ins, attrs):
+        return {"Out": [ins["X"][0][idx]]}
+
+    opdef = OpDef("__getitem__", lower, ["X"], ["Out"])
+    out_data = vb.data[idx]
+    out = VarBase(out_data, stop_gradient=False)
+    out._produced = True
+    from .tracer import _TapeEntry
+
+    tracer._tape.append(
+        _TapeEntry(opdef, {}, {"X": [vb]}, {"Out": [out]}, None, True)
+    )
+    return out
+
+
+class ParamBase(VarBase):
+    """Eager trainable parameter (cf. reference ParamBase / dygraph Parameter)."""
+
+    def __init__(self, data, name=None, trainable=True, **kw):
+        self.trainable = trainable
+        self.optimize_attr = kw.pop("optimize_attr", {"learning_rate": 1.0})
+        self.regularizer = kw.pop("regularizer", None)
+        self.need_clip = kw.pop("need_clip", True)
+        self.is_distributed = kw.pop("is_distributed", False)
+        super().__init__(
+            data, name=name, stop_gradient=not trainable, persistable=True
+        )

@@ -58,6 +58,33 @@ class _LoweredBlock:
                     )
             produced.update(op.all_output_names())
 
+        # fetches must be materialized by the block (clear diagnostic when a
+        # var was folded into a recompute_segment interior or never produced)
+        produced_all = set(feed_names) | set(state_in)
+        for op in ops:
+            produced_all.update(op.all_output_names())
+        for name in fetch_names:
+            if name not in produced_all:
+                inside_seg = any(
+                    op.type == "recompute_segment"
+                    and any(
+                        name in od["outputs"].get(slot, [])
+                        for od in op.attrs.get("ops", [])
+                        for slot in od["outputs"]
+                    )
+                    for op in ops
+                )
+                if inside_seg:
+                    raise RuntimeError(
+                        "fetch var '%s' lives inside a recompute segment; "
+                        "its value is rematerialized (not stored). Add it to "
+                        "the RecomputeOptimizer checkpoints to fetch it."
+                        % name
+                    )
+                raise RuntimeError(
+                    "fetch var '%s' is not produced by this program" % name
+                )
+
         # persistable outputs -> write back to scope after the step
         state_out = []
         for op in ops:
@@ -76,21 +103,13 @@ class _LoweredBlock:
         is_test = program._is_test
 
         def run_block(feed_vals, donate_state, ro_state, rng_key):
+            from .core.block_eval import run_ops
+
             env = dict(feed_vals)
             env.update(donate_state)
             env.update(ro_state)
             ctx = LowerContext(base_key=rng_key, is_test=is_test)
-            for op in ops:
-                opdef = get_op_def(op.type)
-                ins = {
-                    slot: [env[n] for n in names]
-                    for slot, names in op.inputs.items()
-                }
-                outs = opdef.lower(ctx, ins, op.attrs)
-                for slot, names in op.outputs.items():
-                    vals = outs[slot]
-                    for name, val in zip(names, vals):
-                        env[name] = val
+            run_ops(ops, env, ctx)
             fetches = [env[n] for n in self.fetch_names]
             new_state = {n: env[n] for n in self.state_out}
             return fetches, new_state

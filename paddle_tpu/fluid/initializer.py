@@ -169,6 +169,36 @@ Xavier = XavierInitializer
 MSRA = MSRAInitializer
 
 
+def eager_init(init, shape, dtype="float32"):
+    """Evaluate any Initializer immediately -> jax array (dygraph mode).
+
+    Reuses the initializer's own startup-op emission on a scratch block and
+    runs those ops' lowerings eagerly, so custom initializers work in both
+    modes without a second code path (cf. reference dygraph param init which
+    runs the init op through the tracer)."""
+    import jax
+
+    from .core.block_eval import run_ops
+    from .core.registry import LowerContext
+
+    prog = framework.Program()
+    blk = prog.global_block
+    var = blk.create_var(
+        name="__init_out__", shape=list(shape), dtype=dtype,
+        persistable=True, stop_gradient=True,
+    )
+    init(var, blk)
+    tracer = framework._dygraph_tracer
+    if tracer is not None:
+        tracer._op_count += 1
+        key = jax.random.fold_in(tracer._base_key, tracer._op_count)
+    else:
+        key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+    ctx = LowerContext(base_key=key, is_test=True)
+    env = run_ops(blk.ops, {}, ctx)
+    return env["__init_out__"]
+
+
 def _global_weight_initializer():
     return XavierInitializer()
 

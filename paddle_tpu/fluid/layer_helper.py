@@ -74,6 +74,18 @@ class LayerHelper:
                 else initializer._global_weight_initializer()
             )
         name = attr.name or unique_name.generate(self.layer_type + ".w")
+        if framework.in_dygraph_mode():
+            from .dygraph.varbase import ParamBase
+
+            data = initializer.eager_init(init, shape, dtype)
+            return ParamBase(
+                data,
+                name=name,
+                trainable=attr.trainable,
+                optimize_attr={"learning_rate": attr.learning_rate},
+                regularizer=attr.regularizer,
+                need_clip=attr.need_clip,
+            )
         startup_block = self.startup_program.global_block
         main_block = self.main_program.global_block
         # startup side: param var + its init op
@@ -99,6 +111,14 @@ class LayerHelper:
         )
 
     def create_variable_for_type_inference(self, dtype="float32", stop_gradient=False):
+        if framework.in_dygraph_mode():
+            from .dygraph.varbase import VarBase
+
+            return VarBase(
+                None,
+                name=unique_name.generate(self.layer_type + ".tmp"),
+                stop_gradient=stop_gradient,
+            )
         return self.main_program.current_block().create_var(
             name=unique_name.generate(self.layer_type + ".tmp"),
             dtype=dtype,
@@ -106,6 +126,8 @@ class LayerHelper:
         )
 
     def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        if framework.in_dygraph_mode():
+            return framework._dygraph_tracer.trace_op(type, inputs, outputs, attrs)
         return self.main_program.current_block().append_op(
             type, inputs=inputs, outputs=outputs, attrs=attrs
         )
@@ -115,6 +137,8 @@ class LayerHelper:
             return out
         res = self.create_variable_for_type_inference(out.dtype)
         self.append_op(act, inputs={"X": [out.name]}, outputs={"Out": [res.name]})
+        if framework.in_dygraph_mode():
+            return res
         return self.main_program.current_block().var(res.name)
 
     def append_bias_op(self, out, bias, axis=1):
@@ -127,4 +151,6 @@ class LayerHelper:
             outputs={"Out": [res.name]},
             attrs={"axis": axis},
         )
+        if framework.in_dygraph_mode():
+            return res
         return self.main_program.current_block().var(res.name)

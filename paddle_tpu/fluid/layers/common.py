@@ -47,9 +47,11 @@ def append_simple_op(op_type, inputs, attrs=None, out_slots=("Out",), dtype=None
         outputs={slot: [v.name for v in vs] for slot, vs in out_vars.items()},
         attrs=attrs or {},
     )
-    # re-fetch (inference updated shapes)
     results = []
     for slot in out_slots:
-        vs = [block.var(v.name) for v in out_vars[slot]]
+        if framework.in_dygraph_mode():
+            vs = out_vars[slot]  # trace_op filled the placeholders in place
+        else:
+            vs = [block.var(v.name) for v in out_vars[slot]]  # shapes inferred
         results.append(vs if len(vs) > 1 else vs[0])
     return results[0] if len(results) == 1 else tuple(results)

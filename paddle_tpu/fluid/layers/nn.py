@@ -286,7 +286,9 @@ def batch_norm(
             "data_layout": data_layout,
         },
     )
-    return helper.append_activation(block.var(y.name), act)
+    if not framework.in_dygraph_mode():
+        y = block.var(y.name)  # shape inferred during append
+    return helper.append_activation(y, act)
 
 
 def layer_norm(
@@ -389,4 +391,25 @@ def l2_normalize(x, axis=-1, epsilon=1e-12):
 
 
 def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None, act=None):
-    raise NotImplementedError("group_norm arrives with the vision model family")
+    """cf. reference nn.py group_norm (group_norm_op.cc)."""
+    from ..initializer import ConstantInitializer
+
+    helper = LayerHelper("group_norm")
+    channels = int(input.shape[1])
+    inputs = {"X": input}
+    if param_attr is not False:
+        inputs["Scale"] = helper.create_parameter(
+            param_attr, [channels], dtype="float32",
+            default_initializer=ConstantInitializer(1.0),
+        )
+    if bias_attr is not False:
+        inputs["Bias"] = helper.create_parameter(
+            bias_attr, [channels], dtype="float32", is_bias=True
+        )
+    out, _, _ = append_simple_op(
+        "group_norm",
+        inputs,
+        {"groups": groups, "epsilon": epsilon},
+        out_slots=("Y", "Mean", "Variance"),
+    )
+    return helper.append_activation(out, act)

@@ -252,6 +252,66 @@ def _dropout_grad(ctx, ins, attrs):
 
 
 @register_op(
+    "flash_attention",
+    inputs=["Q", "K", "V", "Bias"],
+    outputs=["Out"],
+)
+def _flash_attention(ctx, ins, attrs):
+    """Fused scaled-dot-product attention.
+
+    Capability parity: reference fused attention
+    (`operators/fused/multihead_matmul_op.cu`,
+    `ir/multihead_matmul_fuse_pass.cc`) — there it is a graph-fusion pass +
+    hand CUDA; here it is a single op whose TPU lowering is a pallas
+    flash-attention kernel (ops/pallas/attention.py) and whose oracle path
+    is the naive jnp composition XLA fuses on CPU.
+
+    Q/K/V: [batch, heads, seq, head_dim]; optional Bias broadcastable to
+    [batch, heads, q_seq, k_seq] (additive, pre-softmax).  attrs: scale
+    (default 1/sqrt(head_dim)), causal.
+    """
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    scale = attrs.get("scale") or float(q.shape[-1]) ** -0.5
+    causal = attrs.get("causal", False)
+
+    from ...ops.attention import scaled_dot_product_attention
+
+    out = scaled_dot_product_attention(q, k, v, bias=bias, scale=scale,
+                                       causal=causal)
+    return {"Out": [out]}
+
+
+@register_op(
+    "group_norm",
+    inputs=["X", "Scale", "Bias"],
+    outputs=["Y", "Mean", "Variance"],
+)
+def _group_norm(ctx, ins, attrs):
+    """cf. group_norm_op.cc: normalize per (N, group) over grouped channels
+    and spatial dims; NCHW layout."""
+    x = ins["X"][0]
+    g = attrs["groups"]
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xf = x.astype(jnp.float32).reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xf.ndim))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = ((xf - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(bshape).astype(jnp.float32)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(bshape).astype(jnp.float32)
+    return {
+        "Y": [y.astype(x.dtype)],
+        "Mean": [mean.reshape(n, g)],
+        "Variance": [var.reshape(n, g)],
+    }
+
+
+@register_op(
     "lookup_table",
     inputs=["W", "Ids"],
     outputs=["Out"],

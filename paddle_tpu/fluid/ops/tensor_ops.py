@@ -346,4 +346,6 @@ def _cumsum(ctx, ins, attrs):
 
 @register_op("increment", inputs=["X"], outputs=["Out"], grad=None)
 def _increment(ctx, ins, attrs):
-    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}
+    x = ins["X"][0]
+    # preserve x's dtype: int counters must not be promoted to float
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), x.dtype)]}

@@ -15,9 +15,24 @@ from __future__ import annotations
 
 from . import framework, unique_name
 from .backward import append_backward
-from .framework import Variable, default_startup_program
+from .framework import Operator, Variable, default_startup_program
 from .initializer import ConstantInitializer
 from .layer_helper import LayerHelper
+
+
+class _EagerBlock:
+    """Block shim: lets _append_optimize_op emit update ops through the
+    dygraph tracer (name-resolved via the tracer var table) instead of a
+    program block."""
+
+    def __init__(self):
+        self.ops = []
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None, infer=False):
+        framework._dygraph_tracer.trace_op(type, inputs, outputs, attrs)
+        op = framework.Operator(self, type, inputs, outputs, attrs)
+        self.ops.append(op)
+        return op
 
 
 class Optimizer:
@@ -36,6 +51,16 @@ class Optimizer:
             return self._lr_var
         if isinstance(self._learning_rate, Variable):
             self._lr_var = self._learning_rate
+            return self._lr_var
+        if framework.in_dygraph_mode():
+            from .dygraph.varbase import VarBase
+
+            self._lr_var = VarBase(
+                [float(self._learning_rate)],
+                name=unique_name.generate("learning_rate"),
+                stop_gradient=True,
+                persistable=True,
+            )
             return self._lr_var
         block = framework.default_main_program().global_block
         name = unique_name.generate("learning_rate")
@@ -56,16 +81,50 @@ class Optimizer:
         return self._lr_var
 
     def current_step_lr(self):
+        lr = self._global_learning_rate()
+        from .dygraph.varbase import VarBase
+
+        if isinstance(lr, VarBase):
+            return float(lr.numpy().reshape(-1)[0])
         from .core.scope import global_scope
 
-        v = global_scope().find_var(self._global_learning_rate().name)
+        v = global_scope().find_var(lr.name)
         return float(v[0]) if v is not None else float(self._learning_rate)
+
+    def set_lr(self, value):
+        """cf. reference optimizer set_lr (dygraph) / scope write (static)."""
+        import jax.numpy as jnp
+
+        lr = self._global_learning_rate()
+        from .dygraph.varbase import VarBase
+
+        if isinstance(lr, VarBase):
+            lr.data = jnp.asarray([float(value)], dtype=lr.data.dtype)
+        else:
+            from .core.scope import global_scope
+
+            global_scope().set(lr.name, jnp.asarray([float(value)], jnp.float32))
 
     # -- accumulators -------------------------------------------------------
     def _add_accumulator(self, name, param, fill_value=0.0, shape=None, dtype="float32"):
         if name in self._accumulators and param.name in self._accumulators[name]:
             return self._accumulators[name][param.name]
         shape = list(shape if shape is not None else param.shape)
+        if framework.in_dygraph_mode():
+            import jax.numpy as jnp
+
+            from .core import dtypes as dtypes_mod
+            from .dygraph.varbase import VarBase
+
+            v = VarBase(
+                jnp.full(tuple(shape), float(fill_value),
+                         dtype=dtypes_mod.to_jnp(dtype)),
+                name=unique_name.generate(param.name + "_" + name),
+                stop_gradient=True,
+                persistable=True,
+            )
+            self._accumulators.setdefault(name, {})[param.name] = v
+            return v
         var_name = unique_name.generate(param.name + "_" + name)
         mb = framework.default_main_program().global_block
         v = mb.create_var(
@@ -102,7 +161,10 @@ class Optimizer:
         return append_backward(loss, parameter_list, no_grad_set)
 
     def apply_gradients(self, params_grads):
-        block = framework.default_main_program().global_block
+        if framework.in_dygraph_mode():
+            block = _EagerBlock()
+        else:
+            block = framework.default_main_program().global_block
         first_op_idx = len(block.ops)
         # reference order (optimizer.py apply_gradients): clip the raw
         # gradients FIRST, then append weight-decay regularization unclipped
@@ -124,9 +186,31 @@ class Optimizer:
         return self.apply_gradients(params_grads)
 
     def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        if framework.in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list)
         params_grads = self.backward(
             loss, startup_program, parameter_list, no_grad_set
         )
+        self.apply_gradients(params_grads)
+        return [], params_grads
+
+    def _dygraph_minimize(self, loss, parameter_list):
+        """Eager update path (cf. reference dygraph minimize): the user has
+        called loss.backward(); apply the SAME optimizer ops eagerly through
+        the tracer — updates land in-place on the ParamBase arrays."""
+        if parameter_list is None:
+            raise ValueError(
+                "dygraph minimize() requires parameter_list "
+                "(cf. reference optimizer parameter_list requirement)"
+            )
+        from .dygraph.varbase import VarBase
+
+        params_grads = []
+        for p in parameter_list:
+            if getattr(p, "_grad", None) is None or not getattr(p, "trainable", True):
+                continue
+            g = VarBase(p._grad, name=p.name + "@GRAD", stop_gradient=True)
+            params_grads.append((p, g))
         self.apply_gradients(params_grads)
         return [], params_grads
 
@@ -440,6 +524,276 @@ class DpsgdOptimizer(Optimizer):
             block, "dpsgd", p, g, {}, {},
             {"clip": self._clip, "batch_size": self._batch_size, "sigma": self._sigma},
         )
+
+
+class RecomputeOptimizer(Optimizer):
+    """Activation checkpointing (cf. reference optimizer.py
+    RecomputeOptimizer:4483 + backward.py:629).
+
+    `_set_checkpoints([vars])` marks segment boundaries; before backward the
+    forward ops between consecutive checkpoints are folded into
+    `recompute_segment` composite ops (backward.py) that lower under
+    `jax.checkpoint`, so the backward pass rematerializes segment interiors
+    instead of storing them — the XLA-native form of the reference's
+    forward-op re-emission.
+    """
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        self._checkpoints = None
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = [
+            c.name if isinstance(c, Variable) else str(c) for c in (checkpoints or [])
+        ]
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if self._checkpoints:
+            self._fold_segments(loss)
+        return self._inner.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        self._inner.apply_gradients(params_grads)
+        return [], params_grads
+
+    def _fold_segments(self, loss):
+        from .core.registry import get_op_def
+
+        block = loss.block
+        ops = block.ops
+        producer = {}
+        for i, op in enumerate(ops):
+            for n in op.all_output_names():
+                producer[n] = i
+        bounds = sorted(
+            {producer[c] for c in self._checkpoints if c in producer}
+        )
+        if not bounds:
+            return
+        # segments: (start, end] between consecutive checkpoint producers;
+        # the first begins at op 0, ops after the last checkpoint stay as-is
+        segments = []
+        prev = -1
+        for b in bounds:
+            if b - prev > 1:  # fold only multi-op spans
+                segments.append((prev + 1, b))
+            prev = b
+        if not segments:
+            return
+
+        # var usage after each position (to compute segment boundary outputs)
+        new_ops = []
+        cursor = 0
+        for start, end in segments:
+            new_ops.extend(ops[cursor:start])
+            seg_ops = ops[start:end + 1]
+            seg_op_dicts = [o.to_dict() for o in seg_ops]
+            produced = set()
+            in_names = []
+            for o in seg_ops:
+                for n in o.all_input_names():
+                    if n not in produced and n not in in_names:
+                        in_names.append(n)
+                produced.update(o.all_output_names())
+            used_later = set()
+            for o in ops[end + 1:]:
+                used_later.update(o.all_input_names())
+            out_names = []
+            for o in seg_ops:
+                for n in o.all_output_names():
+                    v = block._find_var_recursive(n)
+                    if n in used_later or (v is not None and v.persistable):
+                        if n not in out_names:
+                            out_names.append(n)
+            new_ops.append(Operator(
+                block, "recompute_segment",
+                inputs={"X": in_names},
+                outputs={"Out": out_names},
+                attrs={
+                    "ops": seg_op_dicts,
+                    "in_names": in_names,
+                    "out_names": out_names,
+                    # static per-segment RNG seed: forward and VJP re-lowering
+                    # derive the same key from it (see backward.py)
+                    "segment_seed": len(segments) * 1000 + start,
+                    "op_role": "forward",
+                },
+            ))
+            cursor = end + 1
+        new_ops.extend(ops[cursor:])
+        block.ops[:] = new_ops
+        block.program._bump()
+
+
+class GradientMergeOptimizer(Optimizer):
+    """k-step gradient accumulation (cf. `gradient_merge` strategy,
+    distributed_strategy.proto:37-38; reference implements it with
+    conditional blocks).
+
+    XLA-friendly rewrite: grads accumulate into persistable buffers every
+    step; the update ops run unconditionally but their state writes are
+    select-masked (`where(cond, new, old)`) so parameters/moments only
+    change every k-th step — branchless, fully fusable control flow.
+    """
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self._inner = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._inner.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        self.apply_gradients(params_grads, startup_program)
+        return [], params_grads
+
+    def _state_var(self, name, shape, dtype, value, startup_program):
+        mb = framework.default_main_program().global_block
+        v = mb.create_var(name=name, shape=shape, dtype=dtype,
+                          persistable=True, stop_gradient=True)
+        sb = (startup_program or default_startup_program()).global_block
+        sb.create_var(name=name, shape=shape, dtype=dtype, persistable=True,
+                      stop_gradient=True)
+        sb.append_op(
+            "fill_constant", outputs={"Out": [name]},
+            attrs={"shape": list(shape), "value": float(value), "dtype": dtype},
+            infer=False,
+        )
+        return v
+
+    def apply_gradients(self, params_grads, startup_program=None):
+        block = framework.default_main_program().global_block
+        k = self.k_steps
+        # int32 counter: a float32 counter saturates at 2^24 steps and would
+        # silently freeze updates on long runs
+        step = self._state_var(
+            unique_name.generate("grad_merge_step"), (1,), "int32", 0,
+            startup_program,
+        )
+        block.append_op(
+            "increment", inputs={"X": [step.name]}, outputs={"Out": [step.name]},
+            attrs={"step": 1, "op_role": "optimize"}, infer=False,
+        )
+        kmod = unique_name.generate("grad_merge_mod")
+        block.create_var(name=kmod, shape=(1,), dtype="int32", stop_gradient=True)
+        kconst = unique_name.generate("grad_merge_k")
+        block.create_var(name=kconst, shape=(1,), dtype="int32", stop_gradient=True)
+        block.append_op(
+            "fill_constant", outputs={"Out": [kconst]},
+            attrs={"shape": [1], "value": k, "dtype": "int32",
+                   "op_role": "optimize"},
+            infer=False,
+        )
+        block.append_op(
+            "elementwise_mod", inputs={"X": [step.name], "Y": [kconst]},
+            outputs={"Out": [kmod]}, attrs={"op_role": "optimize"}, infer=False,
+        )
+        zero = unique_name.generate("grad_merge_zero")
+        block.create_var(name=zero, shape=(1,), dtype="int32", stop_gradient=True)
+        block.append_op(
+            "fill_constant", outputs={"Out": [zero]},
+            attrs={"shape": [1], "value": 0, "dtype": "int32",
+                   "op_role": "optimize"},
+            infer=False,
+        )
+        cond = unique_name.generate("grad_merge_cond")
+        block.create_var(name=cond, shape=(1,), dtype="bool", stop_gradient=True)
+        block.append_op(
+            "equal", inputs={"X": [kmod], "Y": [zero]}, outputs={"Out": [cond]},
+            attrs={"op_role": "optimize"}, infer=False,
+        )
+
+        # accumulate grads; feed the inner optimizer the averaged accumulator
+        merged = []
+        accs = []
+        for p, g in params_grads:
+            acc = self._state_var(
+                unique_name.generate(p.name + "_grad_merge"), list(g.shape),
+                g.dtype, 0.0, startup_program,
+            )
+            block.append_op(
+                "sum", inputs={"X": [acc.name, g.name]},
+                outputs={"Out": [acc.name]}, attrs={"op_role": "optimize"},
+                infer=False,
+            )
+            eff = unique_name.generate(g.name + "_merged")
+            block.create_var(name=eff, shape=g.shape, dtype=g.dtype,
+                             stop_gradient=True)
+            block.append_op(
+                "scale", inputs={"X": [acc.name]}, outputs={"Out": [eff]},
+                attrs={"scale": 1.0 / k if self.avg else 1.0,
+                       "op_role": "optimize"},
+                infer=False,
+            )
+            merged.append((p, block.var(eff)))
+            accs.append(acc)
+
+        first = len(block.ops)
+        self._inner.apply_gradients(merged)
+
+        # select-mask every persistable-state write in the update section
+        appended = block.ops[first:]
+        rebuilt = block.ops[:first]
+        for op in appended:
+            redirects = []  # (slot, idx, orig, tmp)
+            for slot, names in op.outputs.items():
+                for i, n in enumerate(names):
+                    v = block._find_var_recursive(n)
+                    if v is not None and v.persistable:
+                        tmp = unique_name.generate(n + "_gm_new")
+                        block.create_var(name=tmp, shape=v.shape, dtype=v.dtype,
+                                         stop_gradient=True)
+                        names[i] = tmp
+                        redirects.append((slot, i, n, tmp))
+            rebuilt.append(op)
+            for _slot, _i, orig, tmp in redirects:
+                rebuilt.append(Operator(
+                    block, "where",
+                    inputs={"Condition": [cond], "X": [tmp], "Y": [orig]},
+                    outputs={"Out": [orig]},
+                    attrs={"op_role": "optimize"},
+                ))
+        block.ops[:] = rebuilt
+
+        # reset accumulators after an applied step
+        for acc in accs:
+            zname = unique_name.generate(acc.name + "_zeros")
+            block.create_var(name=zname, shape=acc.shape, dtype=acc.dtype,
+                             stop_gradient=True)
+            block.append_op(
+                "fill_zeros_like", inputs={"X": [acc.name]},
+                outputs={"Out": [zname]}, attrs={"op_role": "optimize"},
+                infer=False,
+            )
+            block.append_op(
+                "where",
+                inputs={"Condition": [cond], "X": [zname], "Y": [acc.name]},
+                outputs={"Out": [acc.name]},
+                attrs={"op_role": "optimize"},
+                infer=False,
+            )
+        framework.default_main_program()._bump()
 
 
 # reference-style lowercase aliases (cf. optimizer.py bottom: SGD = SGDOptimizer)

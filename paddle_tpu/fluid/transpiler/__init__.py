@@ -1,0 +1,9 @@
+"""Program transpilers (distributed program rewriting).
+
+Capability parity: reference `python/paddle/fluid/transpiler/` —
+collective.py (NCCL DP rewrite), distribute_transpiler.py (PS topology,
+subsumed by GSPMD sharding — see distributed/sharding.py), and the
+deprecated memory_optimization_transpiler (subsumed by XLA).
+"""
+
+from .collective import Collective, GradAllReduce, LocalSGD  # noqa: F401

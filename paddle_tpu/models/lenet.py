@@ -1,0 +1,27 @@
+"""LeNet-5 for MNIST — the PR1 reference config.
+
+Capability parity: reference `python/paddle/fluid/tests/book/
+test_recognize_digits.py` (conv_pool x2 + fc softmax head).
+"""
+
+from ..fluid import dygraph
+
+
+class LeNet5(dygraph.Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.conv1 = dygraph.Conv2D(1, 20, 5, act="relu")
+        self.pool1 = dygraph.Pool2D(2, "max", 2)
+        self.conv2 = dygraph.Conv2D(20, 50, 5, act="relu")
+        self.pool2 = dygraph.Pool2D(2, "max", 2)
+        self.fc = dygraph.Linear(50 * 4 * 4, 500, act="relu")
+        self.out = dygraph.Linear(500, num_classes)
+
+    def forward(self, x):
+        from ..fluid import layers
+
+        h = self.pool1(self.conv1(x))
+        h = self.pool2(self.conv2(h))
+        h = layers.reshape(h, [-1, 50 * 4 * 4])
+        h = self.fc(h)
+        return self.out(h)

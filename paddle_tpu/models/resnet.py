@@ -1,0 +1,124 @@
+"""ResNet family — the dygraph ImageNet milestone.
+
+Capability parity: reference book test `tests/book/test_image_classification.py`
+and the dygraph ResNet unit test (`tests/unittests/test_imperative_resnet.py`,
+which pins the reference layer recipe: conv7x7/2 + maxpool, 4 bottleneck
+stages, global pool, fc).
+
+TPU notes: NCHW layout matches the op library; XLA handles layout assignment
+for the MXU.  BatchNorm running stats live as layer buffers updated by the
+op's stateful outputs in both modes.
+"""
+
+from ..fluid import dygraph, layers
+
+
+class ConvBNLayer(dygraph.Layer):
+    def __init__(self, in_ch, out_ch, filter_size, stride=1, groups=1, act=None):
+        super().__init__()
+        self._conv = dygraph.Conv2D(
+            in_ch, out_ch, filter_size, stride=stride,
+            padding=(filter_size - 1) // 2, groups=groups, bias_attr=False,
+        )
+        self._bn = dygraph.BatchNorm(out_ch, act=act)
+
+    def forward(self, x):
+        return self._bn(self._conv(x))
+
+
+class BottleneckBlock(dygraph.Layer):
+    expansion = 4
+
+    def __init__(self, in_ch, ch, stride=1, shortcut=True):
+        super().__init__()
+        self.conv0 = ConvBNLayer(in_ch, ch, 1, act="relu")
+        self.conv1 = ConvBNLayer(ch, ch, 3, stride=stride, act="relu")
+        self.conv2 = ConvBNLayer(ch, ch * 4, 1)
+        if not shortcut:
+            self.short = ConvBNLayer(in_ch, ch * 4, 1, stride=stride)
+        self._shortcut = shortcut
+
+    def forward(self, x):
+        y = self.conv2(self.conv1(self.conv0(x)))
+        short = x if self._shortcut else self.short(x)
+        return layers.relu(short + y)
+
+
+class BasicBlock(dygraph.Layer):
+    expansion = 1
+
+    def __init__(self, in_ch, ch, stride=1, shortcut=True):
+        super().__init__()
+        self.conv0 = ConvBNLayer(in_ch, ch, 3, stride=stride, act="relu")
+        self.conv1 = ConvBNLayer(ch, ch, 3)
+        if not shortcut:
+            self.short = ConvBNLayer(in_ch, ch, 1, stride=stride)
+        self._shortcut = shortcut
+
+    def forward(self, x):
+        y = self.conv1(self.conv0(x))
+        short = x if self._shortcut else self.short(x)
+        return layers.relu(short + y)
+
+
+_DEPTH_CFG = {
+    18: (BasicBlock, [2, 2, 2, 2]),
+    34: (BasicBlock, [3, 4, 6, 3]),
+    50: (BottleneckBlock, [3, 4, 6, 3]),
+    101: (BottleneckBlock, [3, 4, 23, 3]),
+    152: (BottleneckBlock, [3, 8, 36, 3]),
+}
+
+
+class ResNet(dygraph.Layer):
+    def __init__(self, depth=50, num_classes=1000, in_channels=3):
+        super().__init__()
+        block, counts = _DEPTH_CFG[depth]
+        self.stem = ConvBNLayer(in_channels, 64, 7, stride=2, act="relu")
+        self.pool = dygraph.Pool2D(3, "max", 2, pool_padding=1)
+        self.blocks = dygraph.LayerList()
+        in_ch = 64
+        chs = [64, 128, 256, 512]
+        for stage, n in enumerate(counts):
+            for i in range(n):
+                stride = 2 if i == 0 and stage > 0 else 1
+                shortcut = in_ch == chs[stage] * block.expansion and stride == 1
+                self.blocks.append(
+                    block(in_ch, chs[stage], stride=stride, shortcut=shortcut)
+                )
+                in_ch = chs[stage] * block.expansion
+        self.out_dim = in_ch
+        import math
+
+        from ..fluid.initializer import UniformInitializer
+        from ..fluid.layer_helper import ParamAttr
+
+        stdv = 1.0 / math.sqrt(in_ch)
+        self.fc = dygraph.Linear(
+            in_ch, num_classes,
+            param_attr=ParamAttr(initializer=UniformInitializer(-stdv, stdv)),
+        )
+
+    def forward(self, x):
+        h = self.pool(self.stem(x))
+        for blk in self.blocks:
+            h = blk(h)
+        h = layers.adaptive_pool2d(h, 1, pool_type="avg")
+        h = layers.reshape(h, [-1, self.out_dim])
+        return self.fc(h)
+
+
+def resnet18(**kw):
+    return ResNet(18, **kw)
+
+
+def resnet34(**kw):
+    return ResNet(34, **kw)
+
+
+def resnet50(**kw):
+    return ResNet(50, **kw)
+
+
+def resnet101(**kw):
+    return ResNet(101, **kw)

@@ -1,0 +1,10 @@
+"""TPU kernel library: pallas implementations of the hot fused ops with
+jnp oracle fallbacks (CPU/testing).
+
+Capability parity: reference `paddle/fluid/operators/fused/` (hand CUDA
+fused kernels) and `ir/fusion_group` (NVRTC runtime codegen) — on TPU the
+compiler does most fusion, so only genuinely memory-bound patterns
+(attention over long sequences) get hand kernels.
+"""
+
+from .attention import scaled_dot_product_attention  # noqa: F401

@@ -1,0 +1,52 @@
+"""Scaled-dot-product attention: pallas flash kernel on TPU, jnp oracle
+elsewhere.
+
+The naive composition materializes the [B, H, S, S] score matrix in HBM —
+fine for short S, quadratic HBM traffic for long S.  The pallas kernel
+(flash attention, cf. PAPERS.md) streams K/V blocks through VMEM with an
+online softmax so HBM traffic stays linear in S.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _naive_attention(q, k, v, bias, scale, causal):
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        qs, ks = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((qs, ks), jnp.bool_), k=ks - qs)
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+def _use_pallas(q, k, bias):
+    if jax.default_backend() != "tpu":
+        return False
+    # pallas kernel wants MXU/VPU-aligned tiles; the in-kernel bias path
+    # only handles row-broadcast (padding-mask) biases
+    sq, dim = q.shape[-2], q.shape[-1]
+    sk = k.shape[-2]
+    if bias is not None and bias.shape[-2] != 1:
+        return False
+    return (
+        sq % 128 == 0 and sk % 128 == 0 and dim % 128 == 0 and sq >= 256
+    )
+
+
+def scaled_dot_product_attention(q, k, v, bias=None, scale=None, causal=False):
+    """q/k/v: [batch, heads, seq, head_dim]."""
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    if _use_pallas(q, k, bias):
+        from .pallas.attention import flash_attention
+
+        return flash_attention(q, k, v, bias=bias, scale=scale, causal=causal)
+    return _naive_attention(q, k, v, bias, scale, causal)

@@ -1,0 +1,6 @@
+"""Hand-written pallas TPU kernels for memory-bound hot paths.
+
+Capability parity: reference `operators/fused/` CUDA kernels +
+`ir/fusion_group` NVRTC codegen — here only where XLA fusion genuinely
+can't help (online-softmax attention streaming K/V through VMEM).
+"""

@@ -1,0 +1,393 @@
+"""Flash attention: online-softmax pallas kernels with a custom VJP.
+
+Forward streams K/V blocks through VMEM with running (m, l, acc) statistics
+so the [S, S] score matrix never touches HBM — HBM traffic is linear in S
+instead of quadratic (the reason the naive composition stalls on long
+sequences; cf. PAPERS.md flash-attention).  Backward recomputes P blockwise
+from (Q, K) and accumulates dQ / dK / dV in two kernels (row-parallel and
+column-parallel respectively), the standard flash backward.
+
+Layout: [BH, S, D] (batch*heads flattened).  Causal masking and a
+broadcastable additive bias of shape [BH, 1, Sk] (padding masks) are
+supported in-kernel; richer biases fall back to the naive path in
+ops/attention.py.
+
+Set `interpret=True` (or run on CPU — auto-detected) to run the same
+kernels through the pallas interpreter for testing.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _block_sizes(sq, sk):
+    bq = 256 if sq % 256 == 0 else 128
+    bk = 256 if sk % 256 == 0 else 128
+    return min(bq, sq), min(bk, sk)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref, acc_ref,
+                *, scale, causal, bq, bk, nk):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    i = pl.program_id(1)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0, :].astype(jnp.float32)[None, :]
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]  # [bq]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])  # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)  # [bq]
+        l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
+        acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    if causal:  # skip blocks entirely above the diagonal
+        pl.when((j * bk) <= (i * bq + bq - 1))(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, :, :] = (acc_ref[...] / safe_l[:, None]).astype(o_ref.dtype)
+
+
+def _fwd(q, k, v, bias, scale, causal, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _block_sizes(sq, sk)
+    nq, nk = sq // bq, sk // bk
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, j)))
+        args.append(bias)
+
+    kernel = functools.partial(
+        _fwd_kernel if bias is not None else _fwd_kernel_nobias,
+        scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),  # running row max
+            pltpu.VMEM((bq, 128), jnp.float32),  # running row sum
+            pltpu.VMEM((bq, d), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(*args)
+    return out
+
+
+def _fwd_kernel_nobias(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, **kw):
+    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, m_ref, l_ref, acc_ref, **kw)
+
+
+# ---------------------------------------------------------------------------
+# backward: dq (row-parallel) and dk/dv (column-parallel)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
+                   dq_ref, acc_ref, *, scale, causal, bq, bk, nk):
+    j = pl.program_id(2)
+    i = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]  # [bq] logsumexp rows
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0, :].astype(jnp.float32)[None, :]
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        delta = jnp.sum(do * o, axis=1)  # [bq]
+        ds = p * (dp - delta[:, None]) * scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when((j * bk) <= (i * bq + bq - 1))(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, :, :] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dq_kernel_nobias(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                          dq_ref, acc_ref, **kw):
+    _bwd_dq_kernel(q_ref, k_ref, v_ref, None, o_ref, do_ref, lse_ref,
+                   dq_ref, acc_ref, **kw)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk,
+                    nq):
+    i = pl.program_id(2)  # q block index (inner loop)
+    j = pl.program_id(1)  # k block index
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0, :].astype(jnp.float32)[None, :]
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        delta = jnp.sum(do * o, axis=1)
+        ds = p * (dp - delta[:, None]) * scale  # [bq, bk]
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, d]
+
+    if causal:
+        pl.when((j * bk) <= (i * bq + bq - 1))(_compute)
+    else:
+        _compute()
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0, :, :] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, :, :] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_dkv_kernel_nobias(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc, **kw):
+    _bwd_dkv_kernel(q_ref, k_ref, v_ref, None, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, **kw)
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper
+# ---------------------------------------------------------------------------
+
+
+def _lse(q, k, bias, scale, causal):
+    """Row log-sum-exp, recomputed cheaply for the backward kernels
+    (one [S,S]-free pass would need the fwd kernel to emit it; recomputing
+    via XLA keeps the fwd kernel single-output and is still O(S) memory
+    per row block under XLA fusion)."""
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    return jax.scipy.special.logsumexp(s, axis=-1)  # [bh, sq]
+
+
+def flash_attention(q, k, v, bias=None, scale=None, causal=False,
+                    interpret=None):
+    """q/k/v: [B, H, S, D].  bias: None or broadcastable [B, 1/H, 1, Sk]."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    bf = None
+    if bias is not None:
+        bf = jnp.broadcast_to(bias, (b, h, 1, sk)).reshape(b * h, 1, sk)
+
+    out = _flash_core(qf, kf, vf, bf, scale, causal, interpret)
+    return out.reshape(b, h, sq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_core(q, k, v, bias, scale, causal, interpret):
+    return _fwd(q, k, v, bias, scale, causal, interpret)
+
+
+def _flash_core_fwd(q, k, v, bias, scale, causal, interpret):
+    out = _fwd(q, k, v, bias, scale, causal, interpret)
+    return out, (q, k, v, bias, out)
+
+
+def _flash_core_bwd(scale, causal, interpret, res, g):
+    q, k, v, bias, out = res
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq, bk = _block_sizes(sq, sk)
+    nq, nk = sq // bq, sk // bk
+    lse = _lse(q, k, bias, scale, causal)  # [bh, sq]
+    lse2d = jnp.broadcast_to(lse[:, :, None], (bh, sq, 128))
+
+    common_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # q
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # k
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # v
+    ]
+    bias_spec = [pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, j))]
+    tail_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # o
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # do
+        pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),  # lse rows
+    ]
+    args = [q, k, v] + ([bias] if bias is not None else []) + [out, g, lse2d]
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel if bias is not None else _bwd_dq_kernel_nobias,
+            scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+        ),
+        grid=(bh, nq, nk),
+        in_specs=common_specs + (bias_spec if bias is not None else []) + tail_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+
+    # column-parallel pass: lse/o/do blocks follow the INNER grid dim (i)
+    kv_tail_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # o
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # do
+        pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),  # lse
+    ]
+    kv_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # q
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # k
+        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # v
+    ]
+    kv_bias_spec = [pl.BlockSpec((1, 1, bk), lambda b, j, i: (b, 0, j))]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel if bias is not None else _bwd_dkv_kernel_nobias,
+            scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
+        ),
+        grid=(bh, nk, nq),
+        in_specs=kv_specs + (kv_bias_spec if bias is not None else []) + kv_tail_specs,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+
+    dbias = None
+    if bias is not None:
+        # d bias = sum over rows of dS; cheap to get via XLA from recompute
+        s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale + bias.astype(jnp.float32)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, :, None])
+        dp = jnp.einsum("bqd,bkd->bqk", g.astype(jnp.float32),
+                        v.astype(jnp.float32))
+        delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=2)
+        ds = p * (dp - delta[:, :, None])
+        dbias = jnp.sum(ds, axis=1, keepdims=True).astype(bias.dtype)
+
+    return dq, dk, dv, dbias
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)

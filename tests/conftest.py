@@ -13,8 +13,19 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 
+import jax
+
+# The env var alone is not enough when a site hook pre-selects a platform
+# (e.g. JAX_PLATFORMS=axon for the real-TPU tunnel) — force it via config
+# before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
+
+# CPU is the numerics oracle (reference pattern: CPU kernels are golden);
+# default matmul precision emulates TPU bf16 passes, so force full f32.
+jax.config.update("jax_default_matmul_precision", "highest")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,207 @@
+"""AMP (bf16/fp16 + loss scaling), recompute segments, gradient merge.
+
+Mirrors reference tests test_mixed_precision.py / test_recompute.py /
+test_gradient_merge patterns: program-structure assertions + loss-parity
+with the unwrapped optimizer.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.contrib.mixed_precision import decorate
+from paddle_tpu.fluid.optimizer import (
+    GradientMergeOptimizer,
+    RecomputeOptimizer,
+    SGDOptimizer,
+)
+
+
+def _build_mlp(seed=0):
+    np.random.seed(seed)
+    x = fluid.data("x", [8, 4], "float32")
+    y = fluid.data("y", [8, 1], "float32")
+    h = layers.fc(x, 16, act="relu")
+    h2 = layers.fc(h, 16, act="relu")
+    pred = layers.fc(h2, 1)
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    return x, y, h, h2, loss
+
+
+def _feed(seed=1):
+    rng = np.random.RandomState(seed)
+    return {
+        "x": rng.randn(8, 4).astype(np.float32),
+        "y": rng.randn(8, 1).astype(np.float32),
+    }
+
+
+def test_amp_bf16_inserts_casts_and_trains():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        *_, loss = _build_mlp()
+        opt = decorate(SGDOptimizer(0.01), dest_dtype="bfloat16")
+        opt.minimize(loss, startup)
+    types = [op.type for op in prog.global_block.ops]
+    assert "cast" in types, "AMP must insert casts"
+    # white-listed mul ops now consume bf16-cast inputs
+    mul_ops = [op for op in prog.global_block.ops if op.type == "mul"]
+    assert any(
+        any(".cast_bfloat16" in n for n in op.all_input_names())
+        for op in mul_ops
+    )
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run_startup(startup)
+        feed = _feed(1)
+        losses = [
+            float(exe.run(prog, feed=feed, fetch_list=[loss])[0])
+            for _ in range(6)
+        ]
+    assert losses[-1] < losses[0]
+
+
+def test_amp_fp16_dynamic_loss_scaling_program():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        *_, loss = _build_mlp()
+        opt = decorate(
+            SGDOptimizer(0.01), dest_dtype="float16", init_loss_scaling=8.0
+        )
+        opt.minimize(loss, startup)
+    types = [op.type for op in prog.global_block.ops]
+    assert "check_finite_and_unscale" in types
+    assert "update_loss_scaling" in types
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run_startup(startup)
+        feed = _feed(0)
+        l0 = float(exe.run(prog, feed=feed, fetch_list=[loss])[0])
+        l5 = l0
+        for _ in range(5):
+            l5 = float(exe.run(prog, feed=feed, fetch_list=[loss])[0])
+        # training proceeds under scaling
+        assert np.isfinite(l5)
+        from paddle_tpu.fluid.core.scope import global_scope
+
+        ls = float(np.asarray(global_scope().find_var(opt.get_loss_scaling().name))[0])
+        assert ls == 8.0  # no overflow on this toy problem
+
+
+def test_recompute_segments_fold_and_match_baseline():
+    # baseline
+    prog_a = fluid.Program()
+    startup_a = fluid.Program()
+    with fluid.program_guard(prog_a, startup_a):
+        fluid.framework.reset_default_programs  # no-op, clarity
+        import paddle_tpu.fluid.unique_name as un
+
+        with un.guard():
+            *_, loss_a = _build_mlp()
+            SGDOptimizer(0.05).minimize(loss_a, startup_a)
+
+    prog_b = fluid.Program()
+    startup_b = fluid.Program()
+    with fluid.program_guard(prog_b, startup_b):
+        import paddle_tpu.fluid.unique_name as un
+
+        with un.guard():
+            x, y, h, h2, loss_b = _build_mlp()
+            opt = RecomputeOptimizer(SGDOptimizer(0.05))
+            opt._set_checkpoints([h, h2])
+            opt.minimize(loss_b, startup_b)
+    types = [op.type for op in prog_b.global_block.ops]
+    assert "recompute_segment" in types
+
+    feeds = [_feed(i) for i in range(4)]
+    exe_a = fluid.Executor()  # fresh executors: identical PRNG streams
+    with fluid.scope_guard(fluid.Scope()):
+        exe_a.run_startup(startup_a)
+        la = [float(exe_a.run(prog_a, feed=f, fetch_list=[loss_a])[0]) for f in feeds]
+    exe_b = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe_b.run_startup(startup_b)
+        lb = [float(exe_b.run(prog_b, feed=f, fetch_list=[loss_b])[0]) for f in feeds]
+    np.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
+
+
+def test_recompute_dropout_replays_same_mask():
+    """Regression: the VJP re-lowering of a recompute segment must use the
+    SAME dropout mask as the forward pass.  With w=1 and
+    loss = sum(dropout(x) * w): sum(dw) == loss iff masks agree."""
+    from paddle_tpu.fluid.initializer import ConstantInitializer
+    from paddle_tpu.fluid.layer_helper import ParamAttr
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data("x", [64], "float32")
+        w_list = layers.fc(
+            layers.reshape(x, [1, 64]), 64, bias_attr=False,
+            param_attr=ParamAttr(initializer=ConstantInitializer(0.0)),
+        )  # dummy route to make a trainable param; we use our own below
+        h = layers.dropout(x, 0.5, dropout_implementation="upscale_in_train")
+        helper_block = prog.global_block
+        w = helper_block.create_parameter("w_direct", [64], "float32")
+        sb = startup.global_block
+        sb.create_parameter("w_direct", [64], "float32")
+        sb.append_op(
+            "fill_constant", outputs={"Out": ["w_direct"]},
+            attrs={"shape": [64], "value": 1.0, "dtype": "float32"},
+            infer=False,
+        )
+        prod = h * w
+        loss = layers.reduce_sum(prod) + layers.reduce_sum(w_list) * 0.0
+        opt = RecomputeOptimizer(SGDOptimizer(0.0))
+        opt._set_checkpoints([prod])
+        opt.minimize(loss, startup)
+    types = [op.type for op in prog.global_block.ops]
+    assert "recompute_segment" in types
+
+    exe = fluid.Executor()
+    rng = np.random.RandomState(7)
+    feed = {"x": rng.randn(64).astype(np.float32)}
+    from paddle_tpu.fluid.core import scope as scope_mod
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run_startup(startup)
+        lval, gw = exe.run(
+            prog, feed=feed, fetch_list=[loss, "w_direct@GRAD"]
+        )
+    np.testing.assert_allclose(float(np.sum(gw)), float(lval), rtol=1e-5)
+
+
+def test_gradient_merge_updates_every_k_steps():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data("x", [4, 3], "float32")
+        y = fluid.data("y", [4, 1], "float32")
+        pred = layers.fc(x, 1, bias_attr=False)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        opt = GradientMergeOptimizer(SGDOptimizer(0.1), k_steps=2, avg=True)
+        opt.minimize(loss, startup)
+        w_name = prog.global_block.all_parameters()[0].name
+
+    exe = fluid.Executor()
+    rng = np.random.RandomState(3)
+    feed = {
+        "x": rng.randn(4, 3).astype(np.float32),
+        "y": rng.randn(4, 1).astype(np.float32),
+    }
+    from paddle_tpu.fluid.core.scope import global_scope
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run_startup(startup)
+        from paddle_tpu.fluid.core import scope as scope_mod
+
+        w0 = np.asarray(scope_mod.global_scope().find_var(w_name)).copy()
+        exe.run(prog, feed=feed, fetch_list=[loss])
+        w1 = np.asarray(scope_mod.global_scope().find_var(w_name)).copy()
+        exe.run(prog, feed=feed, fetch_list=[loss])
+        w2 = np.asarray(scope_mod.global_scope().find_var(w_name)).copy()
+    # step 1: accumulate only -> no param change; step 2: apply
+    np.testing.assert_allclose(w0, w1, atol=1e-7)
+    assert np.abs(w2 - w1).max() > 1e-6
