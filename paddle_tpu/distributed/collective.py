@@ -50,7 +50,9 @@ def all_reduce(x, op="sum", axis="dp"):
     if op == "min":
         return jax.lax.pmin(x, axis)
     if op == "prod":
-        return jnp.exp(jax.lax.psum(jnp.log(x), axis))
+        # NOT exp(psum(log)): that NaNs on negative elements.  Gather the
+        # participants and reduce locally (prod is rare; clarity wins).
+        return jnp.prod(jax.lax.all_gather(x, axis), axis=0)
     raise ValueError("unknown reduce op %r" % op)
 
 

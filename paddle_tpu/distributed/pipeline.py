@@ -1,0 +1,118 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the `pp` mesh axis.
+
+Capability parity: reference `PipelineOptimizer` (`optimizer.py:3632` splits
+the program by device_guard into per-device sections) + `PipelineTrainer` /
+`SectionWorker` (`trainer.h:127`, `section_worker.cc:142` — microbatch loop
+over sections connected by scope queues, one thread per section).
+
+TPU-first redesign: sections become one SPMD program.  Each `pp` shard
+holds ONE stage's parameters; a `lax.scan` over schedule ticks runs every
+stage in lockstep while `ppermute` hands activations to the next stage
+over ICI.  Because `ppermute` is differentiable (its transpose is the
+reverse permutation), `jax.grad` through the scan yields the reverse
+pipeline schedule automatically — no hand-written backward scheduler,
+no scope queues, no thread pinning.
+
+The schedule is GPipe: T = n_micro + n_stages - 1 ticks, bubble fraction
+(n_stages-1)/T; pick n_micro >= 4*n_stages to amortize.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def gpipe(stage_fn, n_stages, n_micro, axis_name="pp"):
+    """Build a pipelined apply: (stacked_params_local, xs) -> ys.
+
+    stage_fn(params, x) -> y: one stage's compute; all stages share this
+    structure (the homogeneous-blocks middle of a transformer).  Call the
+    result inside shard_map where `axis_name` is a manual axis and the
+    params' leading (stage) dim is sharded on it:
+
+        xs: [n_micro, mb, ...] microbatched inputs (used by stage 0)
+        returns ys: [n_micro, mb, ...] final-stage outputs (valid on every
+        shard — they ride one extra ppermute hop from the last stage back
+        to stage 0 and are then broadcast via psum-style selection).
+    """
+
+    def pipelined(params_local, xs):
+        # drop the sharded stage dim: each shard holds exactly one stage
+        params_local = jax.tree.map(lambda a: a[0], params_local)
+        s = jax.lax.axis_index(axis_name)
+        n_ticks = n_micro + n_stages - 1
+        mb_shape = xs.shape[1:]
+
+        fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+        ring_back = [(n_stages - 1, 0)]
+
+        def tick(carry, t):
+            recv, outs = carry
+            # stage 0 ingests microbatch t (zeros on idle ticks)
+            mb_idx = jnp.clip(t, 0, n_micro - 1)
+            x0 = jnp.where(t < n_micro, xs[mb_idx],
+                           jnp.zeros(mb_shape, xs.dtype))
+            inp = jnp.where(s == 0, x0, recv)
+            out = stage_fn(params_local, inp)
+            # pass activations to the next stage...
+            recv_next = jax.lax.ppermute(out, axis_name, fwd_perm)
+            # ...and ship the last stage's finished microbatch to stage 0's
+            # output buffer (valid when t >= n_stages-1)
+            done = jax.lax.ppermute(out, axis_name, ring_back)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            outs = jax.lax.cond(
+                t >= n_stages - 1,
+                lambda o: o.at[out_idx].set(done),
+                lambda o: o,
+                outs,
+            )
+            return (recv_next, outs), None
+
+        outs0 = jnp.zeros((n_micro,) + mb_shape, xs.dtype)
+        outs0 = jax.lax.pcast(outs0, axis_name, to="varying")
+        recv0 = jax.lax.pcast(
+            jnp.zeros(mb_shape, xs.dtype), axis_name, to="varying"
+        )
+        (_, outs), _ = jax.lax.scan(
+            tick, (recv0, outs0), jnp.arange(n_ticks)
+        )
+        # outs landed on stage 0; make them stage-invariant for downstream
+        # replicated compute (head/loss): rotate-select via psum over a
+        # one-hot so every shard ends with stage 0's buffer
+        sel = (s == 0).astype(outs.dtype)
+        outs = jax.lax.psum(outs * sel, axis_name)
+        return outs
+
+    return pipelined
+
+
+class PipelineOptimizer:
+    """Static-graph API parity (cf. reference optimizer.py:3632).
+
+    The reference splits by device_guard annotations and runs section
+    threads; under XLA a single-host "pipeline" with no pp mesh axis
+    degenerates to microbatch accumulation — which is exactly
+    GradientMergeOptimizer.  For real stage parallelism use
+    distributed.pipeline.gpipe inside a ShardedTrainStep-style jit (mesh
+    pp axis), which subsumes SectionWorker entirely.
+    """
+
+    def __init__(self, optimizer, num_microbatches=1):
+        from ..fluid.optimizer import GradientMergeOptimizer
+
+        self._inner = GradientMergeOptimizer(
+            optimizer, k_steps=num_microbatches, avg=True
+        )
+        self._num_microbatches = num_microbatches
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._inner.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )

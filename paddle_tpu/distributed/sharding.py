@@ -82,6 +82,12 @@ def megatron_rule():
             (r"(q_proj|k_proj|v_proj|fc1|mlm_transform)\.weight", (None, "tp")),
             (r"(q_proj|k_proj|v_proj|fc1)\.bias", ("tp",)),
             (r"(out_proj|fc2)\.weight", ("tp", None)),
+            # MoE experts shard on ep (gate replicated); w1 column-parallel
+            # on tp (shard d_hidden), w2 row-parallel (contract d_hidden
+            # locally, one psum — mirrors the fc1/fc2 pattern above)
+            (r"(^|\.)w1$", ("ep", None, "tp")),
+            (r"(^|\.)w2$", ("ep", "tp", None)),
+            (r"(^|\.)(b1|b2)$", ("ep",)),
             (r"(word|position|token_type|pos)\.weight", ("tp", None)),
             (r"embedding", ("tp", None)),
         ],

@@ -166,8 +166,9 @@ class ShardedTrainStep:
         self.fopt = FunctionalOptimizer(optimizer)
         self.loss_fn = loss_fn
         self.mesh = mesh
+        needs_rules = mesh.axis_size("tp") > 1 or mesh.axis_size("ep") > 1
         self.param_rule = param_rule or (
-            megatron_rule() if mesh.axis_size("tp") > 1 else replicated_rule()
+            megatron_rule() if needs_rules else replicated_rule()
         )
         self.batch_specs = batch_specs or {}
         self.zero_stage = zero_stage

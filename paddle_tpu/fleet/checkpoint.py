@@ -1,0 +1,135 @@
+"""Fleet checkpoint-restart: numbered checkpoints + TrainStatus + cleanup.
+
+Capability parity: reference `incubate/fleet/collective/__init__.py` —
+`save_check_point:236` (checkpoint_N dirs with TrainStatus epoch metadata),
+`load_check_point:287`, `clean_redundant_check_points:206`, `TrainStatus:49`.
+
+Sharded arrays (ShardedTrainStep state across a mesh) are saved via orbax
+(each host writes its shards — the TPU equivalent of the reference's
+pserver-side sliced save, io.py:446).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import re
+import shutil
+
+import numpy as np
+
+
+class TrainStatus:
+    """cf. reference TrainStatus:49 — epoch bookkeeping carried in the
+    checkpoint."""
+
+    def __init__(self, epoch_no=-1):
+        self._epoch_no = epoch_no
+
+    def next(self):
+        return self._epoch_no + 1
+
+    def __eq__(self, other):
+        return isinstance(other, TrainStatus) and self._epoch_no == other._epoch_no
+
+    def __ne__(self, other):
+        return not self == other
+
+
+_CKPT_RE = re.compile(r"^checkpoint_(\d+)$")
+
+
+def _checkpoint_numbers(root):
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        m = _CKPT_RE.match(name)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def get_last_checkpoint_no(root):
+    """cf. reference _get_last_checkpoint_no."""
+    nums = _checkpoint_numbers(root)
+    return nums[-1] if nums else -1
+
+
+def clean_redundant_check_points(root, reserved_num=1):
+    """cf. reference clean_redundant_check_points:206."""
+    nums = _checkpoint_numbers(root)
+    for n in nums[:-reserved_num] if reserved_num > 0 else nums:
+        shutil.rmtree(os.path.join(root, "checkpoint_%d" % n))
+
+
+def save_check_point(executor, path, train_status, main_program=None,
+                     local_cache_path=None, remain_all_checkpoint=True):
+    """Static-graph checkpoint (cf. save_check_point:236): persistables +
+    TrainStatus into path/checkpoint_N."""
+    from ..fluid import framework, io
+
+    n = get_last_checkpoint_no(path) + 1
+    ckpt = os.path.join(path, "checkpoint_%d" % n)
+    os.makedirs(ckpt, exist_ok=True)
+    io.save_persistables(executor, ckpt,
+                         main_program or framework.default_main_program())
+    with open(os.path.join(ckpt, "train_status"), "w") as f:
+        json.dump({"epoch_no": train_status._epoch_no}, f)
+    if not remain_all_checkpoint:
+        clean_redundant_check_points(path)
+    return n
+
+
+def load_check_point(executor, path, main_program=None, trainer_id=None):
+    """cf. load_check_point:287 — returns TrainStatus (or None if no
+    checkpoint exists)."""
+    from ..fluid import framework, io
+
+    n = get_last_checkpoint_no(path)
+    if n < 0:
+        return None
+    ckpt = os.path.join(path, "checkpoint_%d" % n)
+    io.load_persistables(executor, ckpt,
+                         main_program or framework.default_main_program())
+    with open(os.path.join(ckpt, "train_status")) as f:
+        meta = json.load(f)
+    return TrainStatus(meta["epoch_no"])
+
+
+# ---------------------------------------------------------------------------
+# Sharded (mesh) checkpoints for ShardedTrainStep state
+# ---------------------------------------------------------------------------
+
+
+def save_sharded(state, path, step_meta=None):
+    """Save a pytree of (possibly mesh-sharded) jax arrays with orbax.
+
+    Multi-host: every process must call this; orbax coordinates shard
+    writes (TPU analogue of the reference's distributed persistable save).
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, state, force=True)
+    if step_meta is not None:
+        with open(os.path.join(path, "train_status.json"), "w") as f:
+            json.dump(step_meta, f)
+
+
+def load_sharded(path, template=None):
+    """Restore a pytree saved by save_sharded; `template` (matching pytree
+    of arrays/shardings) restores with the original shardings."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(path, item=template)
+    meta = None
+    meta_path = os.path.join(path, "train_status.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    return restored, meta

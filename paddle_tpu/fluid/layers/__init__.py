@@ -1,6 +1,7 @@
 """Layer (op wrapper) API — cf. reference python/paddle/fluid/layers/."""
 
-from . import learning_rate_scheduler, loss, nn, ops, tensor  # noqa: F401
+from . import control_flow, learning_rate_scheduler, loss, nn, ops, tensor  # noqa: F401
+from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
 from .learning_rate_scheduler import (  # noqa: F401
     cosine_decay,
     exponential_decay,

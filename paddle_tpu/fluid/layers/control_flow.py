@@ -1,0 +1,276 @@
+"""Control-flow layers: cond / case / switch_case / while_loop.
+
+Capability parity: reference `operators/controlflow/` (`conditional_block_op
+.cc`, `while_op.cc` — each runs a sub-block through a nested executor) and
+`python/paddle/fluid/layers/control_flow.py` (`cond`, `case`,
+`switch_case`, `while_loop`, `While`).
+
+TPU-first redesign: a sub-block is captured by TRACING the branch/body
+builder against the enclosing program (nested Block for IR parity), then
+serialized into the op's attrs; the lowering rebuilds it as a pure function
+and hands it to `lax.cond` / `lax.while_loop`, so control flow compiles
+into the SAME XLA program instead of bouncing through a nested interpreter.
+XLA requires both branches (and every loop iteration) to produce identical
+shapes/dtypes — checked at build time with clear errors.
+
+LoDTensorArray-based dynamic loops (`array_write`/`array_read`) are
+deliberately not carried over: their dynamic shapes cannot compile; use
+`while_loop` with fixed-shape carried state or `lax.scan`-style batching
+(see sequence packing utilities).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import framework, unique_name
+from ..core import dtypes as dtypes_mod
+from ..core.block_eval import run_ops
+from ..core.registry import LowerContext, register_op
+from ..framework import Variable
+
+
+def _trace_subblock(fn, args):
+    """Run a python builder against a child Block; returns (ops, outputs).
+
+    args are Variables handed to fn; every op fn creates lands in the child
+    block, and reads of enclosing-block vars become external captures.
+    """
+    program = framework.default_main_program()
+    parent_idx = program.current_block_idx
+    block = program._create_block()
+    try:
+        outs = fn(*args) if args else fn()
+    finally:
+        program._rollback()
+    assert program.current_block_idx == parent_idx
+    if outs is None:
+        outs = []
+    if isinstance(outs, Variable):
+        outs = [outs]
+    outs = list(outs)
+    return block, outs
+
+
+def _captures(block, arg_names):
+    """External vars a sub-block reads (defined outside it)."""
+    produced = set(arg_names)
+    caps = []
+    for op in block.ops:
+        for n in op.all_input_names():
+            if n not in produced and n not in caps:
+                caps.append(n)
+        produced.update(op.all_output_names())
+    return caps
+
+
+@register_op("cond", inputs=["Cond", "Captures"], outputs=["Out"], grad="auto")
+def _cond_op(ctx, ins, attrs):
+    pred = ins["Cond"][0]
+    caps = ins["Captures"]
+    cap_names = attrs["cap_names"]
+    is_test = ctx.is_test
+    base_key = ctx._base_key
+
+    def make_branch(ops_key, out_key):
+        branch_ops = attrs[ops_key]
+        out_names = attrs[out_key]
+
+        def branch(cap_vals):
+            env = dict(zip(cap_names, cap_vals))
+            sub = LowerContext(base_key=base_key, is_test=is_test)
+            run_ops(branch_ops, env, sub)
+            return [env[n] for n in out_names]
+
+        return branch
+
+    out = jax.lax.cond(
+        jnp.reshape(pred, ()).astype(jnp.bool_),
+        make_branch("true_ops", "true_outs"),
+        make_branch("false_ops", "false_outs"),
+        list(caps),
+    )
+    return {"Out": out}
+
+
+@register_op(
+    "while_loop_op", inputs=["Init", "Captures"], outputs=["Out"], grad=None
+)
+def _while_loop_op(ctx, ins, attrs):
+    """Reverse-mode AD through lax.while_loop is undefined (unbounded trip
+    count); like the reference while_op, training through a while requires
+    a bounded formulation — use lax.scan via static unrolling or fori."""
+    init = list(ins["Init"])
+    caps = list(ins["Captures"])
+    cap_names = attrs["cap_names"]
+    var_names = attrs["var_names"]
+    is_test = ctx.is_test
+    base_key = ctx._base_key
+
+    def run_sub(ops_key, out_key, loop_vals):
+        env = dict(zip(cap_names, caps))
+        env.update(zip(var_names, loop_vals))
+        sub = LowerContext(base_key=base_key, is_test=is_test)
+        run_ops(attrs[ops_key], env, sub)
+        return [env[n] for n in attrs[out_key]]
+
+    def cond_f(loop_vals):
+        out = run_sub("cond_ops", "cond_outs", loop_vals)
+        return jnp.reshape(out[0], ()).astype(jnp.bool_)
+
+    def body_f(loop_vals):
+        return run_sub("body_ops", "body_outs", loop_vals)
+
+    final = jax.lax.while_loop(cond_f, body_f, init)
+    return {"Out": list(final)}
+
+
+def _seal_subblock_ops(block):
+    return [op.to_dict() for op in block.ops]
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """cf. reference layers.cond (conditional_block_op): both branches run
+    in the same XLA program under lax.cond."""
+    if framework.in_dygraph_mode():
+        if bool(pred.numpy()):
+            return true_fn() if true_fn else None
+        return false_fn() if false_fn else None
+
+    t_block, t_outs = _trace_subblock(true_fn, ())
+    f_block, f_outs = _trace_subblock(false_fn, ())
+    if len(t_outs) != len(f_outs):
+        raise ValueError(
+            "cond: true_fn returned %d outputs, false_fn %d — branches must "
+            "match (XLA requires identical output structure)"
+            % (len(t_outs), len(f_outs))
+        )
+    for tv, fv in zip(t_outs, f_outs):
+        if tv.shape != fv.shape or tv.dtype != fv.dtype:
+            raise ValueError(
+                "cond: branch output mismatch %s%s vs %s%s"
+                % (tv.shape, tv.dtype, fv.shape, fv.dtype)
+            )
+
+    caps = sorted(
+        set(_captures(t_block, [])) | set(_captures(f_block, []))
+    )
+    block = framework.default_main_program().current_block()
+    outs = []
+    for tv in t_outs:
+        out = block.create_var(
+            name=unique_name.generate("cond_out"), shape=tv.shape,
+            dtype=tv.dtype,
+        )
+        outs.append(out)
+    block.append_op(
+        "cond",
+        inputs={"Cond": [pred.name], "Captures": caps},
+        outputs={"Out": [o.name for o in outs]},
+        attrs={
+            "true_ops": _seal_subblock_ops(t_block),
+            "false_ops": _seal_subblock_ops(f_block),
+            "true_outs": [v.name for v in t_outs],
+            "false_outs": [v.name for v in f_outs],
+            "cap_names": caps,
+            "sub_block_true": t_block.idx,
+            "sub_block_false": f_block.idx,
+        },
+        infer=False,
+    )
+    return outs[0] if len(outs) == 1 else outs
+
+
+def case(pred_fn_pairs, default=None):
+    """cf. reference layers.case: first true predicate wins."""
+    if not pred_fn_pairs:
+        raise ValueError("case: need at least one (pred, fn) pair")
+    (pred, fn), rest = pred_fn_pairs[0], pred_fn_pairs[1:]
+    if rest:
+        return cond(pred, fn, lambda: case(rest, default))
+    if default is None:
+        raise ValueError("case: final default fn required")
+    return cond(pred, fn, default)
+
+
+def switch_case(branch_index, branch_fns, default=None):
+    """cf. reference layers.switch_case."""
+    from .tensor import fill_constant
+
+    pairs = []
+    for idx, fn in (branch_fns.items() if isinstance(branch_fns, dict)
+                    else enumerate(branch_fns)):
+        c = fill_constant([1], "int64", int(idx))
+        from .tensor import equal
+
+        pairs.append((equal(branch_index, c), fn))
+    return case(pairs, default or pairs[-1][1])
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """cf. reference layers.while_loop (while_op.cc).  loop_vars: list of
+    Variables; body must return same-shaped vars."""
+    if framework.in_dygraph_mode():
+        vals = list(loop_vars)
+        while bool(cond_fn(*vals).numpy()):
+            out = body_fn(*vals)
+            vals = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vals
+
+    loop_vars = list(loop_vars)
+    var_names = []
+    block = framework.default_main_program().current_block()
+    # loop vars enter the sub-blocks under stable alias names
+    alias_vars = []
+    for v in loop_vars:
+        alias = block.create_var(
+            name=unique_name.generate(v.name + "@LOOP"), shape=v.shape,
+            dtype=v.dtype,
+        )
+        alias_vars.append(alias)
+        var_names.append(alias.name)
+
+    c_block, c_outs = _trace_subblock(cond_fn, alias_vars)
+    if len(c_outs) != 1:
+        raise ValueError("while_loop: cond_fn must return one boolean var")
+    b_block, b_outs = _trace_subblock(body_fn, alias_vars)
+    if len(b_outs) != len(loop_vars):
+        raise ValueError(
+            "while_loop: body returned %d vars, expected %d"
+            % (len(b_outs), len(loop_vars))
+        )
+    for bv, lv in zip(b_outs, loop_vars):
+        if bv.shape != lv.shape or bv.dtype != lv.dtype:
+            raise ValueError(
+                "while_loop: body output %s%s must match loop var %s%s"
+                % (bv.shape, bv.dtype, lv.shape, lv.dtype)
+            )
+
+    caps = sorted(
+        (set(_captures(c_block, var_names)) | set(_captures(b_block, var_names)))
+        - set(var_names)
+    )
+    outs = []
+    for v in loop_vars:
+        out = block.create_var(
+            name=unique_name.generate("while_out"), shape=v.shape, dtype=v.dtype
+        )
+        outs.append(out)
+    block.append_op(
+        "while_loop_op",
+        inputs={"Init": [v.name for v in loop_vars], "Captures": caps},
+        outputs={"Out": [o.name for o in outs]},
+        attrs={
+            "cond_ops": _seal_subblock_ops(c_block),
+            "body_ops": _seal_subblock_ops(b_block),
+            "cond_outs": [c_outs[0].name],
+            "body_outs": [v.name for v in b_outs],
+            "var_names": var_names,
+            "cap_names": caps,
+            "sub_block_cond": c_block.idx,
+            "sub_block_body": b_block.idx,
+        },
+        infer=False,
+    )
+    return outs

@@ -283,6 +283,64 @@ def _flash_attention(ctx, ins, attrs):
 
 
 @register_op(
+    "switch_moe",
+    inputs=["X", "GateW", "W1", "B1", "W2", "B2"],
+    outputs=["Out", "AuxLoss"],
+)
+def _switch_moe(ctx, ins, attrs):
+    """Switch-style top-1 mixture-of-experts FFN (expert parallelism).
+
+    Capability parity: the reference has no MoE (SURVEY §2.3 — EP absent);
+    this is a new TPU-native capability.  Einsum dispatch/combine with a
+    capacity limit (GShard pattern) keeps everything dense and MXU-shaped;
+    the expert dim of W1/W2 shards on the `ep` mesh axis under GSPMD, which
+    inserts the all-to-alls the dispatch implies.
+
+    X: [tokens, d]; GateW: [d, E]; W1: [E, d, h]; B1: [E, h];
+    W2: [E, h, d]; B2: [E, d].  attrs: capacity_factor (default 1.25).
+    AuxLoss: load-balancing loss (mean over experts of fraction*prob * E).
+    """
+    x = ins["X"][0]
+    gw = ins["GateW"][0]
+    w1, b1 = ins["W1"][0], ins["B1"][0]
+    w2, b2 = ins["W2"][0], ins["B2"][0]
+    t, d = x.shape
+    e = gw.shape[1]
+    cap = int(attrs.get("capacity_factor", 1.25) * t / e + 1)
+
+    xf = x.astype(jnp.float32)
+    logits = xf @ gw.astype(jnp.float32)  # [t, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [t]
+    gate = jnp.max(probs, axis=-1)  # [t]
+
+    # position of each token within its expert's capacity buffer
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.int32)  # [t, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # [t, E], -1 elsewhere
+    pos_in_exp = jnp.sum(pos * onehot, axis=-1)  # [t]
+    keep = pos_in_exp < cap
+
+    # dispatch tensor [t, E, cap]
+    disp = (
+        jax.nn.one_hot(expert, e, dtype=jnp.float32)[:, :, None]
+        * jax.nn.one_hot(jnp.where(keep, pos_in_exp, cap), cap + 1,
+                         dtype=jnp.float32)[:, None, :cap]
+    )
+    xin = jnp.einsum("tec,td->ecd", disp, xf)  # [E, cap, d]
+    h = jnp.einsum("ecd,edh->ech", xin, w1.astype(jnp.float32))
+    h = jax.nn.gelu(h + b1.astype(jnp.float32)[:, None, :])
+    y = jnp.einsum("ech,ehd->ecd", h, w2.astype(jnp.float32))
+    y = y + b2.astype(jnp.float32)[:, None, :]
+    out = jnp.einsum("tec,ecd->td", disp, y) * gate[:, None]
+
+    # Switch load-balancing aux loss
+    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)  # [E]
+    prob_mean = jnp.mean(probs, axis=0)  # [E]
+    aux = jnp.sum(frac * prob_mean) * e
+    return {"Out": [out.astype(x.dtype)], "AuxLoss": [aux]}
+
+
+@register_op(
     "group_norm",
     inputs=["X", "Scale", "Bias"],
     outputs=["Y", "Mean", "Variance"],

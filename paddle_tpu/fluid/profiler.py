@@ -1,0 +1,89 @@
+"""Profiler: trace collection + chrome-trace export.
+
+Capability parity: reference `python/paddle/fluid/profiler.py` (`profiler`
+contextmanager, start_profiler/stop_profiler, reset_profiler) over the C++
+RecordEvent/CUPTI DeviceTracer machinery (`platform/profiler.h:39-213`,
+`tools/timeline.py` chrome-trace export).
+
+TPU-first: jax.profiler captures host AND device (TPU) activity into a
+TensorBoard/Perfetto trace — the XLA-era equivalent of RecordEvent + CUPTI
+correlation.  `RecordEvent`/`record_event` map to TraceAnnotation so user
+code can mark regions exactly like the reference API.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+
+_state = {"dir": None, "active": False}
+
+
+def start_profiler(state="All", tracer_option="Default", log_dir=None):
+    """cf. reference start_profiler (state/tracer_option accepted for API
+    parity; XLA traces always include host+device)."""
+    import jax
+
+    if _state["active"]:
+        return
+    _state["dir"] = log_dir or tempfile.mkdtemp(prefix="paddle_tpu_prof_")
+    jax.profiler.start_trace(_state["dir"])
+    _state["active"] = True
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    """cf. reference stop_profiler: ends the trace; the trace directory
+    path is recorded at `profile_path` (chrome://tracing-compatible
+    .trace.json.gz files live under it, cf. tools/timeline.py output)."""
+    import jax
+
+    if not _state["active"]:
+        return
+    jax.profiler.stop_trace()
+    _state["active"] = False
+    try:
+        with open(profile_path, "w") as f:
+            f.write(_state["dir"] or "")
+    except OSError:
+        pass
+    return _state["dir"]
+
+
+def reset_profiler():
+    """cf. reference reset_profiler (traces are per-session under XLA)."""
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option="Default", log_dir=None):
+    """cf. reference fluid.profiler.profiler contextmanager."""
+    start_profiler(state, tracer_option, log_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+class RecordEvent:
+    """Region annotation visible in the trace (cf. platform/profiler.h:126
+    RecordEvent RAII; dygraph/profiler record_event)."""
+
+    def __init__(self, name):
+        import jax
+
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._ann.__exit__(*exc)
+
+
+record_event = RecordEvent
+
+
+def cuda_profiler(*a, **kw):
+    raise RuntimeError("cuda_profiler is CUDA-only; use fluid.profiler.profiler")

@@ -1,0 +1,8 @@
+"""High-level API: Keras-like Model trainer.
+
+Capability parity: reference `python/paddle/incubate/hapi/` — `model.py`
+(Model.fit/evaluate/predict with static+dygraph adapters), `callbacks.py`.
+"""
+
+from .callbacks import Callback, ModelCheckpoint, ProgBarLogger  # noqa: F401
+from .model import Model  # noqa: F401

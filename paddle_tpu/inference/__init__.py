@@ -1,0 +1,22 @@
+"""Inference deployment: config + predictor + portable export.
+
+Capability parity: reference `paddle/fluid/inference/` —
+`AnalysisConfig`/`AnalysisPredictor` (`api/analysis_predictor.cc`: load
+__model__ + params, run analysis fusion passes, NaiveExecutor per request
+with zero-copy tensors) and `create_paddle_predictor`.
+
+TPU-first: the "analysis passes" (fc/conv-bn fusion, TRT subgraph capture)
+ARE XLA — loading compiles the pruned program once into a single
+executable; per-request runs are cached-executable calls with device-
+resident weights (NaiveExecutor's no-scope-churn property).  Portable
+serialization uses jax.export (StableHLO) for serving stacks that load
+models without Python (`export_stablehlo`/`load_stablehlo`).
+"""
+
+from .predictor import (  # noqa: F401
+    AnalysisConfig,
+    Predictor,
+    create_predictor,
+    export_stablehlo,
+    load_stablehlo,
+)

@@ -1,0 +1,48 @@
+"""Mixture-of-experts layers (expert parallelism over the `ep` mesh axis).
+
+New TPU-native capability (the reference has no MoE; SURVEY §2.3 lists EP
+as absent).  MoEFFN replaces a transformer FFN; under ShardedTrainStep the
+expert dim of its weights shards on `ep` (see distributed/sharding.py
+moe rules) and GSPMD emits the dispatch all-to-alls over ICI.
+"""
+
+from __future__ import annotations
+
+from ..fluid import dygraph, layers
+from ..fluid.layers.common import append_simple_op
+
+
+class MoEFFN(dygraph.Layer):
+    """Switch-style top-1 routed FFN."""
+
+    def __init__(self, d_model, d_hidden, num_experts, capacity_factor=1.25,
+                 param_attr=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.gate = self.create_parameter([d_model, num_experts], attr=param_attr)
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden],
+                                        attr=param_attr)
+        self.b1 = self.create_parameter([num_experts, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model],
+                                        attr=param_attr)
+        self.b2 = self.create_parameter([num_experts, d_model], is_bias=True)
+        self.aux_loss = None  # set on every forward
+
+    def forward(self, x):
+        """x: [..., d_model]; flattens leading dims to tokens."""
+        shape = list(x.shape)
+        d = int(shape[-1])
+        flat = layers.reshape(x, [-1, d])
+        out, aux = append_simple_op(
+            "switch_moe",
+            {
+                "X": flat, "GateW": self.gate,
+                "W1": self.w1, "B1": self.b1,
+                "W2": self.w2, "B2": self.b2,
+            },
+            {"capacity_factor": self.capacity_factor},
+            out_slots=("Out", "AuxLoss"),
+        )
+        self.aux_loss = aux
+        return layers.reshape(out, shape[:-1] + [d])

@@ -1,0 +1,72 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Capability parity: the reference's C++ Dataset/DataFeed out-of-core input
+engine (`framework/data_set.cc`, `data_feed.cc`).  Built on demand with the
+system g++ into a cached shared library (no pybind11 in this image; the
+C ABI + ctypes is the binding layer).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "_native.so")
+_SRC = os.path.join(_HERE, "dataset.cpp")
+
+_lib = None
+_build_error = None
+
+
+def _build():
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, "-o", _SO,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def get_lib():
+    """Load (building if needed) the native library; raises RuntimeError
+    with the compiler output if the toolchain is unavailable."""
+    global _lib, _build_error
+    if _lib is not None:
+        return _lib
+    if _build_error is not None:
+        raise RuntimeError("native build failed earlier: %s" % _build_error)
+    try:
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            _build()
+        lib = ctypes.CDLL(_SO)
+    except (subprocess.CalledProcessError, OSError) as e:
+        _build_error = getattr(e, "stderr", b"") or str(e)
+        raise RuntimeError("could not build native dataset engine: %s"
+                           % _build_error)
+    lib.ds_create.restype = ctypes.c_void_p
+    lib.ds_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_int,
+    ]
+    lib.ds_destroy.argtypes = [ctypes.c_void_p]
+    lib.ds_load_into_memory.argtypes = [ctypes.c_void_p]
+    lib.ds_memory_data_size.restype = ctypes.c_int64
+    lib.ds_memory_data_size.argtypes = [ctypes.c_void_p]
+    lib.ds_error_line_count.restype = ctypes.c_int64
+    lib.ds_error_line_count.argtypes = [ctypes.c_void_p]
+    lib.ds_local_shuffle.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.ds_release_memory.argtypes = [ctypes.c_void_p]
+    lib.ds_reset_cursor.argtypes = [ctypes.c_void_p]
+    lib.ds_next_batch_sizes.restype = ctypes.c_int
+    lib.ds_next_batch_sizes.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.ds_fill_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+    ]
+    _lib = lib
+    return _lib

@@ -1,0 +1,199 @@
+// Out-of-core dataset engine: file-sharded multi-threaded parsing into an
+// in-memory sample store with shuffle and ragged (LoD-style) batching.
+//
+// Capability parity: reference C++ Dataset/DataFeed
+// (`framework/data_set.h:43,157` DatasetImpl::LoadIntoMemory/LocalShuffle,
+// `framework/data_feed.h:108,291` InMemoryDataFeed / MultiSlotDataFeed
+// text-slot format, channels in `framework/channel.h`).
+//
+// Text format (MultiSlot, cf. data_feed.cc MultiSlotDataFeed::ParseOneInstance):
+//   one sample per line; for each declared slot in order:
+//     "<count> v1 v2 ... vcount"
+//   float slots parse as float32, int slots as int64.
+//
+// Exposed as a C API consumed via ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <random>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Sample {
+  // per slot: values (union-typed by slot schema) + count
+  std::vector<std::vector<float>> fvals;
+  std::vector<std::vector<int64_t>> ivals;
+};
+
+struct Dataset {
+  std::vector<std::string> files;
+  std::vector<int> slot_is_float;  // schema: 1 = float slot, 0 = int64
+  int nthreads = 1;
+  std::vector<Sample> samples;
+  std::mutex mu;
+  std::atomic<int64_t> error_lines{0};
+  size_t cursor = 0;
+};
+
+bool parse_line(const std::string& line, const std::vector<int>& schema,
+                Sample* out) {
+  std::istringstream is(line);
+  out->fvals.assign(schema.size(), {});
+  out->ivals.assign(schema.size(), {});
+  for (size_t s = 0; s < schema.size(); ++s) {
+    long long cnt;
+    if (!(is >> cnt) || cnt < 0) return false;
+    if (schema[s]) {
+      auto& v = out->fvals[s];
+      v.resize(cnt);
+      for (long long i = 0; i < cnt; ++i)
+        if (!(is >> v[i])) return false;
+    } else {
+      auto& v = out->ivals[s];
+      v.resize(cnt);
+      for (long long i = 0; i < cnt; ++i)
+        if (!(is >> v[i])) return false;
+    }
+  }
+  return true;
+}
+
+void load_worker(Dataset* ds, size_t begin, size_t step) {
+  std::vector<Sample> local;
+  for (size_t fi = begin; fi < ds->files.size(); fi += step) {
+    std::ifstream in(ds->files[fi]);
+    if (!in.is_open()) {
+      ds->error_lines.fetch_add(1);
+      continue;
+    }
+    std::string line;
+    while (std::getline(in, line)) {
+      if (line.empty()) continue;
+      Sample s;
+      if (parse_line(line, ds->slot_is_float, &s)) {
+        local.emplace_back(std::move(s));
+      } else {
+        ds->error_lines.fetch_add(1);
+      }
+    }
+  }
+  std::lock_guard<std::mutex> g(ds->mu);
+  for (auto& s : local) ds->samples.emplace_back(std::move(s));
+}
+
+}  // namespace
+
+extern "C" {
+
+// schema: array of slot type flags (1 float / 0 int64)
+void* ds_create(const char** files, int nfiles, const int* schema, int nslots,
+                int nthreads) {
+  auto* ds = new Dataset();
+  for (int i = 0; i < nfiles; ++i) ds->files.emplace_back(files[i]);
+  ds->slot_is_float.assign(schema, schema + nslots);
+  ds->nthreads = nthreads > 0 ? nthreads : 1;
+  return ds;
+}
+
+void ds_destroy(void* h) { delete static_cast<Dataset*>(h); }
+
+// cf. DatasetImpl::LoadIntoMemory: one worker per file shard.
+void ds_load_into_memory(void* h) {
+  auto* ds = static_cast<Dataset*>(h);
+  int n = std::min<int>(ds->nthreads, std::max<size_t>(ds->files.size(), 1));
+  std::vector<std::thread> ts;
+  for (int t = 0; t < n; ++t) ts.emplace_back(load_worker, ds, t, n);
+  for (auto& t : ts) t.join();
+  ds->cursor = 0;
+}
+
+int64_t ds_memory_data_size(void* h) {
+  return static_cast<Dataset*>(h)->samples.size();
+}
+
+int64_t ds_error_line_count(void* h) {
+  return static_cast<Dataset*>(h)->error_lines.load();
+}
+
+// cf. DatasetImpl::LocalShuffle.
+void ds_local_shuffle(void* h, uint64_t seed) {
+  auto* ds = static_cast<Dataset*>(h);
+  std::mt19937_64 rng(seed);
+  std::shuffle(ds->samples.begin(), ds->samples.end(), rng);
+  ds->cursor = 0;
+}
+
+void ds_release_memory(void* h) {
+  auto* ds = static_cast<Dataset*>(h);
+  ds->samples.clear();
+  ds->samples.shrink_to_fit();
+  ds->cursor = 0;
+}
+
+void ds_reset_cursor(void* h) { static_cast<Dataset*>(h)->cursor = 0; }
+
+// Batch extraction with LoD-style ragged offsets.
+// For slot s the caller receives:
+//   values buffer (float32 or int64), length = lod[batch] (total values)
+//   lod offsets buffer of size batch+1 (prefix counts, cf. LoD level)
+// Two-phase: ds_next_batch_sizes fills per-slot total counts so the caller
+// can allocate, then ds_fill_batch copies and advances the cursor.
+int ds_next_batch_sizes(void* h, int batch_size, int64_t* out_counts) {
+  auto* ds = static_cast<Dataset*>(h);
+  size_t n = ds->samples.size();
+  if (ds->cursor >= n) return 0;
+  int actual = static_cast<int>(
+      std::min<size_t>(batch_size, n - ds->cursor));
+  size_t nslots = ds->slot_is_float.size();
+  for (size_t s = 0; s < nslots; ++s) {
+    int64_t total = 0;
+    for (int b = 0; b < actual; ++b) {
+      const Sample& smp = ds->samples[ds->cursor + b];
+      total += ds->slot_is_float[s] ? smp.fvals[s].size()
+                                    : smp.ivals[s].size();
+    }
+    out_counts[s] = total;
+  }
+  return actual;
+}
+
+// bufs[s]: caller-allocated value buffer; lods[s]: int64 buffer [actual+1]
+void ds_fill_batch(void* h, int batch_size, void** bufs, int64_t** lods) {
+  auto* ds = static_cast<Dataset*>(h);
+  size_t n = ds->samples.size();
+  int actual = static_cast<int>(
+      std::min<size_t>(batch_size, n - ds->cursor));
+  size_t nslots = ds->slot_is_float.size();
+  for (size_t s = 0; s < nslots; ++s) {
+    int64_t off = 0;
+    lods[s][0] = 0;
+    for (int b = 0; b < actual; ++b) {
+      const Sample& smp = ds->samples[ds->cursor + b];
+      if (ds->slot_is_float[s]) {
+        const auto& v = smp.fvals[s];
+        std::memcpy(static_cast<float*>(bufs[s]) + off, v.data(),
+                    v.size() * sizeof(float));
+        off += v.size();
+      } else {
+        const auto& v = smp.ivals[s];
+        std::memcpy(static_cast<int64_t*>(bufs[s]) + off, v.data(),
+                    v.size() * sizeof(int64_t));
+        off += v.size();
+      }
+      lods[s][b + 1] = off;
+    }
+  }
+  ds->cursor += actual;
+}
+
+}  // extern "C"

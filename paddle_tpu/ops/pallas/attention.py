@@ -3,14 +3,17 @@
 Forward streams K/V blocks through VMEM with running (m, l, acc) statistics
 so the [S, S] score matrix never touches HBM — HBM traffic is linear in S
 instead of quadratic (the reason the naive composition stalls on long
-sequences; cf. PAPERS.md flash-attention).  Backward recomputes P blockwise
-from (Q, K) and accumulates dQ / dK / dV in two kernels (row-parallel and
-column-parallel respectively), the standard flash backward.
+sequences; cf. PAPERS.md flash-attention).  The forward also emits the row
+log-sum-exp, so the backward never rebuilds full scores: dQ accumulates in
+a row-parallel kernel, dK/dV (and the padding-bias gradient) in a
+column-parallel kernel, each recomputing P blockwise from (Q, K, LSE) —
+the standard flash backward, O(S) memory end to end.
 
 Layout: [BH, S, D] (batch*heads flattened).  Causal masking and a
 broadcastable additive bias of shape [BH, 1, Sk] (padding masks) are
 supported in-kernel; richer biases fall back to the naive path in
-ops/attention.py.
+ops/attention.py.  Sequences that no supported block size divides also
+fall back (never silently truncate).
 
 Set `interpret=True` (or run on CPU — auto-detected) to run the same
 kernels through the pallas interpreter for testing.
@@ -28,10 +31,15 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _pick_block(s):
+    for b in (512, 256, 128):
+        if s % b == 0:
+            return b
+    return None
+
+
 def _block_sizes(sq, sk):
-    bq = 256 if sq % 256 == 0 else 128
-    bk = 256 if sk % 256 == 0 else 128
-    return min(bq, sq), min(bk, sk)
+    return _pick_block(sq), _pick_block(sk)
 
 
 # ---------------------------------------------------------------------------
@@ -39,8 +47,8 @@ def _block_sizes(sq, sk):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref, acc_ref,
-                *, scale, causal, bq, bk, nk):
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                m_ref, l_ref, acc_ref, *, scale, causal, bq, bk, nk):
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -88,9 +96,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref, acc_ref,
         l = l_ref[:, 0]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, :, :] = (acc_ref[...] / safe_l[:, None]).astype(o_ref.dtype)
+        lse = m_ref[:, 0] + jnp.log(safe_l)
+        lse_ref[0, :, :] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
+
+
+def _fwd_kernel_nobias(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       m_ref, l_ref, acc_ref, **kw):
+    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                m_ref, l_ref, acc_ref, **kw)
 
 
 def _fwd(q, k, v, bias, scale, causal, interpret):
+    """Returns (out [bh,sq,d], lse [bh,sq,128] row-broadcast)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _block_sizes(sq, sk)
@@ -110,12 +127,18 @@ def _fwd(q, k, v, bias, scale, causal, interpret):
         _fwd_kernel if bias is not None else _fwd_kernel_nobias,
         scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),  # running row max
             pltpu.VMEM((bq, 128), jnp.float32),  # running row sum
@@ -123,15 +146,11 @@ def _fwd(q, k, v, bias, scale, causal, interpret):
         ],
         interpret=interpret,
     )(*args)
-    return out
-
-
-def _fwd_kernel_nobias(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, **kw):
-    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, m_ref, l_ref, acc_ref, **kw)
+    return out, lse
 
 
 # ---------------------------------------------------------------------------
-# backward: dq (row-parallel) and dk/dv (column-parallel)
+# backward: dq (row-parallel) and dk/dv/dbias (column-parallel)
 # ---------------------------------------------------------------------------
 
 
@@ -190,8 +209,8 @@ def _bwd_dq_kernel_nobias(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk,
-                    nq):
+                    dk_ref, dv_ref, db_ref, dk_acc, dv_acc, db_acc,
+                    *, scale, causal, bq, bk, nq):
     i = pl.program_id(2)  # q block index (inner loop)
     j = pl.program_id(1)  # k block index
 
@@ -199,6 +218,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
+        if db_acc is not None:
+            db_acc[...] = jnp.zeros_like(db_acc)
 
     def _compute():
         q = q_ref[0].astype(jnp.float32)
@@ -227,11 +248,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32,
         )
         delta = jnp.sum(do * o, axis=1)
-        ds = p * (dp - delta[:, None]) * scale  # [bq, bk]
+        ds_raw = p * (dp - delta[:, None])  # d bias (unscaled) [bq, bk]
+        ds = ds_raw * scale
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [bk, d]
+        if db_acc is not None:
+            db_acc[0:1, :] = db_acc[0:1, :] + jnp.sum(ds_raw, axis=0)[None, :]
 
     if causal:
         pl.when((j * bk) <= (i * bq + bq - 1))(_compute)
@@ -242,12 +266,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
     def _finalize():
         dk_ref[0, :, :] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0, :, :] = dv_acc[...].astype(dv_ref.dtype)
+        if db_ref is not None:
+            db_ref[0, 0, :] = db_acc[0, :].astype(db_ref.dtype)
 
 
 def _bwd_dkv_kernel_nobias(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                            dk_ref, dv_ref, dk_acc, dv_acc, **kw):
     _bwd_dkv_kernel(q_ref, k_ref, v_ref, None, o_ref, do_ref, lse_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, **kw)
+                    dk_ref, dv_ref, None, dk_acc, dv_acc, None, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -255,32 +281,24 @@ def _bwd_dkv_kernel_nobias(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 # ---------------------------------------------------------------------------
 
 
-def _lse(q, k, bias, scale, causal):
-    """Row log-sum-exp, recomputed cheaply for the backward kernels
-    (one [S,S]-free pass would need the fwd kernel to emit it; recomputing
-    via XLA keeps the fwd kernel single-output and is still O(S) memory
-    per row block under XLA fusion)."""
-    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    if bias is not None:
-        s = s + bias.astype(jnp.float32)
-    if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where(rows >= cols, s, NEG_INF)
-    return jax.scipy.special.logsumexp(s, axis=-1)  # [bh, sq]
-
-
 def flash_attention(q, k, v, bias=None, scale=None, causal=False,
                     interpret=None):
-    """q/k/v: [B, H, S, D].  bias: None or broadcastable [B, 1/H, 1, Sk]."""
+    """q/k/v: [B, H, S, D].  bias: None or broadcastable [B, 1/H, 1, Sk].
+
+    Falls back to the naive composition when no supported block size
+    divides the sequence lengths (never silently truncates)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if scale is None:
         scale = d ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+
+    bq, bk = _block_sizes(sq, sk)
+    if bq is None or bk is None:
+        from ..attention import _naive_attention
+
+        return _naive_attention(q, k, v, bias, scale, causal)
 
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, sk, d)
@@ -295,22 +313,21 @@ def flash_attention(q, k, v, bias=None, scale=None, causal=False,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def _flash_core(q, k, v, bias, scale, causal, interpret):
-    return _fwd(q, k, v, bias, scale, causal, interpret)
+    out, _ = _fwd(q, k, v, bias, scale, causal, interpret)
+    return out
 
 
 def _flash_core_fwd(q, k, v, bias, scale, causal, interpret):
-    out = _fwd(q, k, v, bias, scale, causal, interpret)
-    return out, (q, k, v, bias, out)
+    out, lse = _fwd(q, k, v, bias, scale, causal, interpret)
+    return out, (q, k, v, bias, out, lse)
 
 
 def _flash_core_bwd(scale, causal, interpret, res, g):
-    q, k, v, bias, out = res
+    q, k, v, bias, out, lse2d = res
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _block_sizes(sq, sk)
     nq, nk = sq // bq, sk // bk
-    lse = _lse(q, k, bias, scale, causal)  # [bh, sq]
-    lse2d = jnp.broadcast_to(lse[:, :, None], (bh, sq, 128))
 
     common_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # q
@@ -338,54 +355,62 @@ def _flash_core_bwd(scale, causal, interpret, res, g):
     )(*args)
 
     # column-parallel pass: lse/o/do blocks follow the INNER grid dim (i)
-    kv_tail_specs = [
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # o
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # do
-        pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),  # lse
-    ]
     kv_specs = [
         pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # q
         pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # k
         pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # v
     ]
     kv_bias_spec = [pl.BlockSpec((1, 1, bk), lambda b, j, i: (b, 0, j))]
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel if bias is not None else _bwd_dkv_kernel_nobias,
-            scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
-        ),
-        grid=(bh, nk, nq),
-        in_specs=kv_specs + (kv_bias_spec if bias is not None else []) + kv_tail_specs,
-        out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bk, d), jnp.float32),
-            pltpu.VMEM((bk, d), jnp.float32),
-        ],
-        interpret=interpret,
-    )(*args)
-
-    dbias = None
+    kv_tail_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # o
+        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # do
+        pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),  # lse
+    ]
+    dk_spec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
+    dv_spec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
     if bias is not None:
-        # d bias = sum over rows of dS; cheap to get via XLA from recompute
-        s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
-                       k.astype(jnp.float32)) * scale + bias.astype(jnp.float32)
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse[:, :, None])
-        dp = jnp.einsum("bqd,bkd->bqk", g.astype(jnp.float32),
-                        v.astype(jnp.float32))
-        delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=2)
-        ds = p * (dp - delta[:, :, None])
-        dbias = jnp.sum(ds, axis=1, keepdims=True).astype(bias.dtype)
+        db_spec = pl.BlockSpec((1, 1, bk), lambda b, j, i: (b, 0, j))
+        dk, dv, db = pl.pallas_call(
+            functools.partial(
+                _bwd_dkv_kernel,
+                scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
+            ),
+            grid=(bh, nk, nq),
+            in_specs=kv_specs + kv_bias_spec + kv_tail_specs,
+            out_specs=[dk_spec, dv_spec, db_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+                jax.ShapeDtypeStruct((bh, 1, sk), bias.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((8, bk), jnp.float32),
+            ],
+            interpret=interpret,
+        )(*args)
+        dbias = db
+    else:
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_dkv_kernel_nobias,
+                scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
+            ),
+            grid=(bh, nk, nq),
+            in_specs=kv_specs + kv_tail_specs,
+            out_specs=[dk_spec, dv_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(*args)
+        dbias = None
 
     return dq, dk, dv, dbias
 

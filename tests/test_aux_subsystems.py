@@ -1,0 +1,145 @@
+"""Aux subsystems: fleet checkpoints, flags, metrics, profiler, hapi Model.
+
+Mirrors reference tests: test_fleet_checkpoint.py (numbered checkpoint
+round-trip + TrainStatus), test_metrics.py, test_profiler.py smoke,
+hapi test_model.py fit-loop.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph, layers
+from paddle_tpu.fluid.optimizer import SGDOptimizer
+
+
+def _small_program():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data("x", [4, 3], "float32")
+        y = fluid.data("y", [4, 1], "float32")
+        loss = layers.reduce_mean(layers.square_error_cost(layers.fc(x, 1), y))
+        SGDOptimizer(0.1).minimize(loss, startup)
+    return prog, startup, loss
+
+
+def test_fleet_checkpoint_roundtrip(tmp_path):
+    from paddle_tpu.fleet import checkpoint as ckpt
+
+    prog, startup, loss = _small_program()
+    exe = fluid.Executor()
+    root = str(tmp_path / "ckpts")
+    from paddle_tpu.fluid.core import scope as scope_mod
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run_startup(startup)
+        w_name = prog.global_block.all_parameters()[0].name
+        w0 = np.asarray(scope_mod.global_scope().find_var(w_name)).copy()
+        n = ckpt.save_check_point(exe, root, ckpt.TrainStatus(2), prog)
+        assert n == 0
+        n = ckpt.save_check_point(exe, root, ckpt.TrainStatus(3), prog)
+        assert n == 1
+        assert ckpt.get_last_checkpoint_no(root) == 1
+        # clobber the param, restore, compare
+        scope_mod.global_scope().set(w_name, np.zeros_like(w0))
+        ts = ckpt.load_check_point(exe, root, prog)
+        assert ts.next() == 4
+        w1 = np.asarray(scope_mod.global_scope().find_var(w_name))
+        np.testing.assert_allclose(w0, w1, atol=1e-7)
+        ckpt.clean_redundant_check_points(root, reserved_num=1)
+        assert ckpt.get_last_checkpoint_no(root) == 1
+        assert not os.path.isdir(os.path.join(root, "checkpoint_0"))
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from paddle_tpu.fleet import checkpoint as ckpt
+
+    state = {"params": {"w": jnp.arange(6.0).reshape(2, 3)},
+             "step": jnp.asarray(7)}
+    path = str(tmp_path / "sharded")
+    ckpt.save_sharded(state, path, step_meta={"epoch": 3})
+    restored, meta = ckpt.load_sharded(path)
+    np.testing.assert_allclose(np.asarray(restored["params"]["w"]),
+                               np.arange(6.0).reshape(2, 3))
+    assert meta["epoch"] == 3
+
+
+def test_flags_set_get_and_nan_debug():
+    import jax
+
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    assert jax.config.jax_debug_nans
+    assert fluid.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+    fluid.set_flags({"FLAGS_check_nan_inf": False})
+    assert not jax.config.jax_debug_nans
+    with pytest.raises(ValueError):
+        fluid.set_flags({"FLAGS_nonexistent": 1})
+
+
+def test_metrics_accuracy_precision_recall_auc():
+    from paddle_tpu.fluid.metrics import Accuracy, Auc, Precision, Recall
+
+    acc = Accuracy()
+    acc.update(0.8, 10)
+    acc.update(0.6, 10)
+    assert abs(acc.eval() - 0.7) < 1e-9
+
+    p = Precision()
+    p.update([1, 1, 0, 1], [1, 0, 0, 1])
+    assert abs(p.eval() - 2 / 3) < 1e-9
+
+    r = Recall()
+    r.update([1, 0, 0, 1], [1, 1, 0, 1])
+    assert abs(r.eval() - 2 / 3) < 1e-9
+
+    auc = Auc()
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 2, 1000)
+    # informative scores -> auc well above 0.5
+    scores = np.clip(labels * 0.5 + rng.rand(1000) * 0.5, 0, 1)
+    auc.update(scores, labels)
+    assert auc.eval() > 0.8
+
+
+def test_profiler_smoke(tmp_path):
+    from paddle_tpu.fluid import profiler as prof
+
+    with dygraph.guard():
+        with prof.profiler(log_dir=str(tmp_path / "trace")):
+            with prof.RecordEvent("toy_region"):
+                x = dygraph.to_variable(np.ones((4, 4), np.float32))
+                (x * 2.0).numpy()
+    assert os.path.isdir(str(tmp_path / "trace"))
+
+
+def test_hapi_model_fit_evaluate_predict(tmp_path):
+    from paddle_tpu import hapi
+    from paddle_tpu.fluid.metrics import Accuracy
+    from paddle_tpu.fluid.optimizer import AdamOptimizer
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int64).reshape(-1, 1)
+
+    with dygraph.guard():
+        net = dygraph.Linear(8, 2)
+        model = hapi.Model(net)
+
+        def loss_fn(pred, label):
+            return layers.reduce_mean(
+                layers.softmax_with_cross_entropy(pred, label)
+            )
+
+        model.prepare(AdamOptimizer(1e-2), loss_fn, metrics=[Accuracy()])
+        hist = model.fit((x, y), batch_size=16, epochs=3, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+        ev = model.evaluate((x, y), batch_size=16)
+        assert ev["Accuracy"] > 0.6
+        pred = model.predict(x, batch_size=16)
+        assert pred.shape == (64, 2)
+        model.save(str(tmp_path / "m"))
+        model.load(str(tmp_path / "m"))

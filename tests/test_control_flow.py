@@ -1,0 +1,112 @@
+"""Control flow: cond / case / switch_case / while_loop lower to lax
+control flow inside ONE compiled program.
+
+Mirrors reference tests test_cond.py / test_while_loop.py (value parity
+with python control flow, gradients through cond).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph, layers
+from paddle_tpu.fluid.optimizer import SGDOptimizer
+
+
+def test_cond_value_and_both_branches():
+    for flag, expected in [(1.0, 10.0), (-1.0, 20.0)]:
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.data("x", [1], "float32")
+            pred = layers.greater_than(x, layers.zeros([1]))
+
+            out = layers.cond(
+                pred,
+                lambda: x * 10.0,
+                lambda: x * (-20.0),
+            )
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            r, = exe.run(prog, feed={"x": np.array([flag], np.float32)},
+                         fetch_list=[out])
+        assert float(r[0]) == expected
+
+
+def test_cond_gradient_flows_through_taken_branch():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data("x", [1], "float32")
+        w = prog.global_block.create_parameter("w_cf", [1], "float32")
+        sb = startup.global_block
+        sb.create_parameter("w_cf", [1], "float32")
+        sb.append_op("fill_constant", outputs={"Out": ["w_cf"]},
+                     attrs={"shape": [1], "value": 3.0, "dtype": "float32"},
+                     infer=False)
+        pred = layers.greater_than(x, layers.zeros([1]))
+        out = layers.cond(pred, lambda: w * x * 2.0, lambda: w * x * 5.0)
+        loss = layers.reduce_sum(out)
+        SGDOptimizer(0.0).minimize(loss, startup)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run_startup(startup)
+        _, g = exe.run(prog, feed={"x": np.array([4.0], np.float32)},
+                       fetch_list=[loss, "w_cf@GRAD"])
+        assert float(g[0]) == 8.0  # taken branch: d(w*x*2)/dw = 2x
+        _, g = exe.run(prog, feed={"x": np.array([-4.0], np.float32)},
+                       fetch_list=[loss, "w_cf@GRAD"])
+        assert float(g[0]) == -20.0  # other branch: 5x
+
+
+def test_while_loop_accumulates():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        i = layers.fill_constant([1], "int64", 0)
+        acc = layers.fill_constant([1], "float32", 0.0)
+        ten = layers.fill_constant([1], "int64", 10)
+
+        def cond_fn(i, acc):
+            return layers.less_than(i, ten)
+
+        def body_fn(i, acc):
+            return [i + 1, acc + 2.5]
+
+        i_out, acc_out = layers.while_loop(cond_fn, body_fn, [i, acc])
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        iv, av = exe.run(prog, feed={}, fetch_list=[i_out, acc_out])
+    assert int(iv[0]) == 10
+    assert abs(float(av[0]) - 25.0) < 1e-6
+
+
+def test_case_and_switch_case():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        idx = fluid.data("idx", [1], "int64")
+        out = layers.switch_case(
+            idx,
+            {0: lambda: layers.fill_constant([1], "float32", 100.0),
+             1: lambda: layers.fill_constant([1], "float32", 200.0)},
+            default=lambda: layers.fill_constant([1], "float32", -1.0),
+        )
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        for i, want in [(0, 100.0), (1, 200.0), (7, -1.0)]:
+            r, = exe.run(prog, feed={"idx": np.array([i], np.int64)},
+                         fetch_list=[out])
+            assert float(r[0]) == want
+
+
+def test_dygraph_cond_and_while():
+    with dygraph.guard():
+        x = dygraph.to_variable(np.array([2.0], np.float32))
+        out = layers.cond(
+            layers.greater_than(x, layers.zeros([1])),
+            lambda: x * 3.0, lambda: x,
+        )
+        assert float(out.numpy()[0]) == 6.0
+        i = dygraph.to_variable(np.array([0], np.int64))
+        n = dygraph.to_variable(np.array([5], np.int64))
+        vals = layers.while_loop(
+            lambda i: layers.less_than(i, n), lambda i: i + 1, [i]
+        )
+        assert int(vals[0].numpy()[0]) == 5

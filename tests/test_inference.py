@@ -1,0 +1,70 @@
+"""Inference: save_inference_model -> Predictor serving + StableHLO export.
+
+Mirrors reference inference tests (analyzer_*_tester pattern: saved model
+round-trip, output parity with the training-time network).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.optimizer import SGDOptimizer
+from paddle_tpu.inference import (
+    AnalysisConfig,
+    create_predictor,
+    export_stablehlo,
+    load_stablehlo,
+)
+
+
+@pytest.fixture
+def saved_model(tmp_path):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data("x", [-1, 4], "float32")
+        y = fluid.data("y", [-1, 1], "float32")
+        h = layers.fc(x, 8, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        SGDOptimizer(0.1).minimize(loss, startup)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 4).astype(np.float32),
+            "y": rng.randn(8, 1).astype(np.float32)}
+    model_dir = str(tmp_path / "model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run_startup(startup)
+        exe.run(prog, feed=feed, fetch_list=[loss])
+        # training-time prediction for parity checking
+        test_prog = prog.clone(for_test=True)
+        x_new = rng.randn(5, 4).astype(np.float32)
+        expected, = exe.run(
+            test_prog,
+            feed={"x": x_new, "y": np.zeros((5, 1), np.float32)},
+            fetch_list=[pred],
+        )
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe, test_prog)
+    return model_dir, x_new, expected
+
+
+def test_predictor_matches_training_network(saved_model):
+    model_dir, x_new, expected = saved_model
+    config = AnalysisConfig(model_dir)
+    predictor = create_predictor(config)
+    assert predictor.get_input_names() == ["x"]
+    out, = predictor.run([x_new])
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+    # second request reuses the compiled executable (NaiveExecutor property)
+    out2, = predictor.run({"x": x_new})
+    np.testing.assert_allclose(out2, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_stablehlo_export_roundtrip(saved_model, tmp_path):
+    model_dir, x_new, expected = saved_model
+    predictor = create_predictor(AnalysisConfig(model_dir))
+    export_dir = str(tmp_path / "shlo")
+    export_stablehlo(export_dir, predictor, [x_new])
+    served = load_stablehlo(export_dir)
+    out, = served({"x": x_new})
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5, atol=1e-6)

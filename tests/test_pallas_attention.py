@@ -66,6 +66,17 @@ def test_flash_backward_matches_naive(causal):
         )
 
 
+def test_flash_non_divisible_seq_falls_back():
+    """S=192 divides no supported block: must fall back to naive, never
+    silently truncate."""
+    B, H, S, D = 1, 1, 192, 128
+    q, k, v = _rand((B, H, S, D), 20), _rand((B, H, S, D), 21), _rand((B, H, S, D), 22)
+    out = flash_attention(q, k, v, interpret=True)
+    ref = _naive_attention(q, k, v, None, D ** -0.5, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_flash_backward_with_bias_grad():
     B, H, S, D = 1, 2, 256, 128
     q, k, v = _rand((B, H, S, D), 9), _rand((B, H, S, D), 10), _rand((B, H, S, D), 11)
