@@ -27,5 +27,19 @@ from .nn import (  # noqa: F401
     Linear,
     Pool2D,
 )
+from . import learning_rate_scheduler  # noqa: F401
+from .jit import TracedLayer, declarative, to_static  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    CosineDecay,
+    ExponentialDecay,
+    InverseTimeDecay,
+    LinearLrWarmup,
+    NaturalExpDecay,
+    NoamDecay,
+    PiecewiseDecay,
+    PolynomialDecay,
+    ReduceLROnPlateau,
+)
+from .parallel import DataParallel, ParallelEnv, prepare_context  # noqa: F401
 from .tracer import Tracer  # noqa: F401
 from .varbase import ParamBase, VarBase  # noqa: F401

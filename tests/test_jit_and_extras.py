@@ -1,0 +1,152 @@
+"""Dygraph-to-static (TracedLayer/@declarative), dygraph LR schedulers,
+DataParallel API, EMA / ModelAverage / Lookahead.
+
+Mirrors reference tests: test_traced_layer.py, test_imperative_decorator,
+test_learning_rate_scheduler.py, test_ema.py, test_lookahead.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph, layers
+from paddle_tpu.fluid.dygraph import to_variable
+from paddle_tpu.fluid.optimizer import (
+    ExponentialMovingAverage,
+    LookaheadOptimizer,
+    SGDOptimizer,
+)
+
+
+def test_traced_layer_matches_dygraph_and_serves(tmp_path):
+    with dygraph.guard():
+        net = dygraph.Linear(4, 3, act="relu")
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        eager_out = net(to_variable(x)).numpy()
+        outs, traced = dygraph.TracedLayer.trace(net, [to_variable(x)])
+        static_out, = traced([x])
+        np.testing.assert_allclose(static_out, eager_out, rtol=1e-5)
+        # save as inference model and serve through the Predictor
+        model_dir = str(tmp_path / "traced")
+        traced.save_inference_model(model_dir)
+    from paddle_tpu.inference import AnalysisConfig, create_predictor
+
+    p = create_predictor(AnalysisConfig(model_dir))
+    out, = p.run([x])
+    np.testing.assert_allclose(out, eager_out, rtol=1e-5)
+
+
+def test_declarative_function_caches_and_matches():
+    with dygraph.guard():
+        net = dygraph.Linear(3, 2)
+
+        @dygraph.declarative
+        def infer(x):
+            return net(x)
+
+        x = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+        eager = net(to_variable(x)).numpy()
+        static = infer(to_variable(x))
+        np.testing.assert_allclose(static.numpy(), eager, rtol=1e-5)
+        infer(to_variable(x))
+        assert len(infer.program_cache) == 1  # same signature: cached
+        x2 = np.random.RandomState(2).randn(7, 3).astype(np.float32)
+        infer(to_variable(x2))
+        assert len(infer.program_cache) == 2  # new batch size: new program
+
+
+def test_dygraph_lr_schedulers_drive_optimizer():
+    from paddle_tpu.fluid.dygraph import NoamDecay, PiecewiseDecay
+
+    sched = PiecewiseDecay([2, 4], [0.1, 0.01, 0.001], begin=0)
+    with dygraph.guard():
+        model = dygraph.Linear(2, 1)
+        opt = SGDOptimizer(learning_rate=sched)
+        lrs = []
+        for _ in range(5):
+            loss = layers.reduce_mean(model(to_variable(
+                np.ones((2, 2), np.float32))))
+            loss.backward()
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+            lrs.append(opt.current_step_lr())
+    assert lrs[0] == pytest.approx(0.1)
+    assert lrs[2] == pytest.approx(0.01)
+    assert lrs[4] == pytest.approx(0.001)
+
+    noam = NoamDecay(d_model=512, warmup_steps=4000)
+    vals = [noam() for _ in range(10)]
+    assert all(b > a for a, b in zip(vals, vals[1:]))  # warming up
+
+
+def test_data_parallel_api_single_process():
+    with dygraph.guard():
+        net = dygraph.DataParallel(dygraph.Linear(3, 2))
+        x = to_variable(np.ones((2, 3), np.float32))
+        out = net(x)
+        assert out.shape == (2, 2)
+        loss = layers.reduce_mean(out)
+        loss = net.scale_loss(loss)  # world=1: passthrough
+        loss.backward()
+        net.apply_collective_grads()  # world=1: no-op
+        assert len(net.parameters()) == 2
+        net.clear_gradients()
+
+
+def test_ema_shadow_tracks_and_applies():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data("x", [4, 3], "float32")
+        y = fluid.data("y", [4, 1], "float32")
+        loss = layers.reduce_mean(layers.square_error_cost(layers.fc(x, 1), y))
+        SGDOptimizer(0.5).minimize(loss, startup)
+        ema = ExponentialMovingAverage(0.5)
+        ema.update()
+        w_name = prog.global_block.all_parameters()[0].name
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(4, 3).astype(np.float32),
+            "y": rng.randn(4, 1).astype(np.float32)}
+    from paddle_tpu.fluid.core import scope as scope_mod
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run_startup(startup)
+        for _ in range(3):
+            exe.run(prog, feed=feed, fetch_list=[loss])
+        raw = np.asarray(scope_mod.global_scope().find_var(w_name)).copy()
+        with ema.apply(exe):
+            shadow = np.asarray(scope_mod.global_scope().find_var(w_name)).copy()
+        restored = np.asarray(scope_mod.global_scope().find_var(w_name))
+        assert not np.allclose(raw, shadow)  # EMA lags the raw weights
+        np.testing.assert_allclose(raw, restored)  # restore() worked
+
+
+def test_lookahead_slow_weights_update_every_k():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data("x", [4, 3], "float32")
+        y = fluid.data("y", [4, 1], "float32")
+        loss = layers.reduce_mean(layers.square_error_cost(
+            layers.fc(x, 1, bias_attr=False), y))
+        opt = LookaheadOptimizer(SGDOptimizer(0.2), alpha=0.5, k=2)
+        opt.minimize(loss, startup)
+        w_name = prog.global_block.all_parameters()[0].name
+        slow_name = [v.name for v in prog.global_block.vars.values()
+                     if "@SLOW" in v.name][0]
+    exe = fluid.Executor()
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.randn(4, 3).astype(np.float32),
+            "y": rng.randn(4, 1).astype(np.float32)}
+    from paddle_tpu.fluid.core import scope as scope_mod
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run_startup(startup)
+        slow0 = np.asarray(scope_mod.global_scope().find_var(slow_name)).copy()
+        exe.run(prog, feed=feed, fetch_list=[loss])
+        slow1 = np.asarray(scope_mod.global_scope().find_var(slow_name)).copy()
+        np.testing.assert_allclose(slow0, slow1)  # step 1: slow unchanged
+        exe.run(prog, feed=feed, fetch_list=[loss])
+        slow2 = np.asarray(scope_mod.global_scope().find_var(slow_name)).copy()
+        w2 = np.asarray(scope_mod.global_scope().find_var(w_name))
+        assert np.abs(slow2 - slow1).max() > 1e-7  # step 2: interpolated
+        np.testing.assert_allclose(w2, slow2)  # fast reset to slow
