@@ -5,6 +5,16 @@ North star (BASELINE.md): ERNIE/BERT-base pretrain tokens/sec/chip at
 "published": {}), so vs_baseline reports measured-MFU / 0.35 — the ratio to
 the target; 1.0 means the 35% MFU goal is met.
 
+Self-validation (round-2, after VERDICT r1 flagged an impossible 179% MFU):
+- timing fetches the loss *value* to host every step, so the wall clock can
+  never be shorter than true device compute (defeats any async-dispatch or
+  remote-platform distortion in ``block_until_ready``);
+- the FLOP model counts only matmul params (embedding gather tables
+  excluded; the word-embedding table counts once because it is tied to the
+  MLM decoder matmul) plus the attention term 12*L*S*h per token;
+- asserts implied MFU <= 100% before printing; per-step latency and the
+  full accounting go to stderr.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
@@ -13,6 +23,30 @@ import sys
 import time
 
 import numpy as np
+
+
+def _flops_per_token(cfg, params):
+    """Training FLOPs/token: 6 per matmul-param + exact attention term.
+
+    Matmul params = everything except embedding gather tables
+    (position/token-type) and the word embedding, which IS counted because
+    BertForPretraining ties it to the MLM output projection (one matmul
+    use).  LayerNorm scales/biases are counted too — they are a <0.1%
+    overstatement, dwarfed by what padding/masking understates.
+    Attention scores+context: 2*S*h MACs per token per layer forward
+    (S*h for QK^T + S*h for AV) = 4*S*h FLOPs, 3x for fwd+bwd
+    = 12*L*S*h per token (S = sequence length).
+    """
+    gather_only = 0
+    matmul = 0
+    for name, v in params.items():
+        n = int(np.prod(v.shape))
+        if "position" in name or "token_type" in name:
+            gather_only += n
+        else:
+            matmul += n
+    attn = 12.0 * cfg.num_hidden_layers * 1.0 * cfg.hidden_size
+    return lambda seq_len: 6.0 * matmul + attn * seq_len, matmul, gather_only
 
 
 def main():
@@ -32,10 +66,15 @@ def main():
             intermediate_size=3072, max_position_embeddings=512,
             hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
         )
-        B, S, iters = 8, 512, 20
+        B, S = 8, 512
+        k_short, k_long, reps = 10, 30, 2
+        # bf16 peak TFLOP/s for one v5e chip (public spec: 197 bf16)
+        peak = 197e12
     else:  # CPU smoke path so the bench never hangs off-TPU
         cfg = models.BertConfig.tiny()
-        B, S, iters = 4, 32, 3
+        B, S = 4, 32
+        k_short, k_long, reps = 1, 3, 1
+        peak = 1e12
 
     with dygraph.guard():
         model = models.BertForPretraining(cfg)
@@ -52,36 +91,94 @@ def main():
                 batch["mlm_weights"], batch["nsp_labels"],
             )
 
-        step = dist.ShardedTrainStep(model, opt, loss_fn, mesh, zero_stage=0)
+        step = dist.ShardedTrainStep(
+            model, opt, loss_fn, mesh, zero_stage=0,
+            amp="bf16" if on_tpu else None,
+        )
         state = step.init()
         n_params = sum(int(np.prod(v.shape)) for v in state["params"].values())
+        per_tok, matmul_params, gather_params = _flops_per_token(
+            cfg, state["params"]
+        )
 
         rng = np.random.RandomState(0)
-        batch = {
-            "input_ids": rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32),
-            "token_type_ids": np.zeros((B, S), np.int32),
-            "position_ids": np.tile(np.arange(S, dtype=np.int32), (B, 1)),
-            "mlm_labels": rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32),
-            "mlm_weights": (rng.rand(B, S) < 0.15).astype(np.float32),
-            "nsp_labels": rng.randint(0, 2, (B, 1)).astype(np.int32),
-        }
 
-        # warmup (compile)
-        for _ in range(2):
-            state, loss = step(state, batch)
-        loss.block_until_ready()
+        def make_batch():
+            return {
+                "input_ids": rng.randint(
+                    0, cfg.vocab_size, (B, S)).astype(np.int32),
+                "token_type_ids": np.zeros((B, S), np.int32),
+                "position_ids": np.tile(
+                    np.arange(S, dtype=np.int32), (B, 1)),
+                "mlm_labels": rng.randint(
+                    0, cfg.vocab_size, (B, S)).astype(np.int32),
+                "mlm_weights": (rng.rand(B, S) < 0.15).astype(np.float32),
+                "nsp_labels": rng.randint(0, 2, (B, 1)).astype(np.int32),
+            }
 
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            state, loss = step(state, batch)
-        loss.block_until_ready()
-        dt = time.perf_counter() - t0
+        batches = [make_batch() for _ in range(4)]
 
-    tokens_per_sec = B * S * iters / dt
-    # MFU: ~6 flops per param per token (fwd+bwd), v5e peak 197 TFLOP/s bf16
-    flops_per_tok = 6.0 * n_params
-    peak = 197e12 if on_tpu else 1e12
+        # warmup (compile + two real executes, value-fetched)
+        for i in range(2):
+            state, loss = step(state, batches[i % 4])
+        float(loss)
+
+        # Timing: segments of K chained steps, each ending with a host
+        # fetch of the loss *value*.  The final loss depends on the whole
+        # donated-state chain, so a segment cannot finish before the device
+        # executed every step in it — each segment time is an honest lower
+        # bound regardless of how the platform implements
+        # block_until_ready (the axon remote tunnel's did not wait in
+        # round 1, implying 179% MFU).  Steady-state step time is the
+        # marginal cost between a long and a short segment, which cancels
+        # the fixed per-segment dispatch/fetch RTT (~150 ms over the
+        # tunnel) that a production input pipeline would overlap.
+        def timed_segment(k, i0):
+            t0 = time.perf_counter()
+            nonlocal state
+            loss = None
+            for i in range(i0, i0 + k):
+                state, loss = step(state, batches[i % 4])
+            lv = float(loss)
+            if not np.isfinite(lv):
+                raise RuntimeError("bench loss went non-finite")
+            return time.perf_counter() - t0
+
+        shorts, longs = [], []
+        i0 = 0
+        for _ in range(reps):
+            shorts.append(timed_segment(k_short, i0))
+            i0 += k_short
+            longs.append(timed_segment(k_long, i0))
+            i0 += k_long
+        dt = (min(longs) - min(shorts)) / (k_long - k_short)
+        dt_worst = max(longs) / k_long  # includes all fixed overhead
+        # plain raise, not assert: the guards must survive python -O
+        if dt <= 0:
+            raise RuntimeError(
+                "non-positive marginal step time (%.1f ms): RTT noise "
+                "swamped the measurement; segment times shorts=%s longs=%s"
+                % (dt * 1e3, shorts, longs)
+            )
+
+    tokens_per_sec = B * S / dt
+    flops_per_tok = per_tok(S)
     mfu = tokens_per_sec * flops_per_tok / peak
+    print(
+        "bench: marginal step %.2f ms over %dx(%d,%d)-step segments "
+        "(conservative incl. dispatch RTT: %.2f ms), %.0f tokens/s, "
+        "params=%.1fM (matmul %.1fM, gather-only %.1fM), "
+        "%.0f MFLOP/token, implied MFU %.1f%%"
+        % (dt * 1e3, reps, k_short, k_long, dt_worst * 1e3,
+           tokens_per_sec, n_params / 1e6, matmul_params / 1e6,
+           gather_params / 1e6, flops_per_tok / 1e6, mfu * 100),
+        file=sys.stderr,
+    )
+    if mfu > 1.0:
+        raise RuntimeError(
+            "implied MFU %.1f%% exceeds physical peak — measurement or FLOP "
+            "accounting is wrong; refusing to report" % (mfu * 100)
+        )
     print(json.dumps({
         "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),

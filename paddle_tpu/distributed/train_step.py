@@ -157,7 +157,8 @@ class ShardedTrainStep:
 
     def __init__(self, model, optimizer, loss_fn, mesh: DeviceMesh,
                  param_rule: ShardingRule = None, batch_specs=None,
-                 zero_stage=1, donate=True, remat=False):
+                 zero_stage=1, donate=True, remat=False, amp=None,
+                 prng_impl="rbg"):
         if mesh.axis_size("pp") > 1:
             raise NotImplementedError(
                 "pipeline stages use parallel.PipelineOptimizer (gpipe scan)"
@@ -173,6 +174,13 @@ class ShardedTrainStep:
         self.batch_specs = batch_specs or {}
         self.zero_stage = zero_stage
         self.remat = remat
+        if amp not in (None, "bf16"):
+            raise ValueError("amp must be None or 'bf16' (TPU needs no fp16 "
+                             "loss scaling; cf. mixed_precision/decorator.py)")
+        self.amp = amp
+        # rbg = TPU hardware random-bit generator; threefry dropout masks
+        # cost ~13 ms/step (28%) on BERT-base B=8,S=512 on one v5e chip.
+        self.prng_impl = prng_impl
         self._step_fn = None
         self._shardings = None
 
@@ -263,13 +271,30 @@ class ShardedTrainStep:
         if self.remat:
             loss_of = jax.checkpoint(loss_of, static_argnums=())
 
+        amp = self.amp
+
+        prng_impl = self.prng_impl
+
         def step(train_state, batch):
             params = train_state["params"]
             key = jax.random.fold_in(
-                jax.random.PRNGKey(0), train_state["step"]
+                jax.random.key(0, impl=prng_impl), train_state["step"]
             )
             lr_t = lr(train_state["step"]) if callable(lr) else lr
-            loss, grads = jax.value_and_grad(loss_of)(params, batch, key)
+            if amp == "bf16":
+                # bf16 compute / fp32 master params (SURVEY §2.3 AMP row:
+                # the TPU equivalent of decorator.py:218 needs no loss
+                # scaling).  AD transposes the param cast, so grads arrive
+                # already fp32 for the update ops.
+                def amp_loss(p32, batch, key):
+                    p16 = jax.tree.map(
+                        lambda x: x.astype(jnp.bfloat16)
+                        if x.dtype == jnp.float32 else x, p32)
+                    return loss_of(p16, batch, key).astype(jnp.float32)
+
+                loss, grads = jax.value_and_grad(amp_loss)(params, batch, key)
+            else:
+                loss, grads = jax.value_and_grad(loss_of)(params, batch, key)
             new_params, new_opt = fopt.apply(
                 params, grads, train_state["opt"], lr_t
             )
