@@ -1,7 +1,24 @@
 """Layer (op wrapper) API — cf. reference python/paddle/fluid/layers/."""
 
-from . import control_flow, learning_rate_scheduler, loss, nn, ops, tensor  # noqa: F401
-from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
+from . import (  # noqa: F401
+    control_flow,
+    learning_rate_scheduler,
+    loss,
+    nn,
+    ops,
+    rnn,
+    sequence,
+    tensor,
+)
+from .control_flow import (  # noqa: F401
+    StaticRNN,
+    case,
+    cond,
+    switch_case,
+    while_loop,
+)
+from .rnn import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 from .learning_rate_scheduler import (  # noqa: F401
     cosine_decay,
     exponential_decay,

@@ -130,6 +130,189 @@ def _seal_subblock_ops(block):
     return [op.to_dict() for op in block.ops]
 
 
+@register_op(
+    "static_rnn",
+    inputs=["SeqIn", "MemInit", "Captures"],
+    outputs=["Out", "MemFinal"],
+    grad="auto",
+)
+def _static_rnn_op(ctx, ins, attrs):
+    """cf. operators/controlflow/recurrent_op.cc (StaticRNN): the step
+    sub-block runs once per time step with memories carried between steps.
+    TPU-first: ONE `lax.scan` over the time-major axis — the reference
+    re-runs a nested executor per step and stitches grads through
+    recurrent_grad_op; here scan's native VJP handles the recurrence.
+    """
+    seq = list(ins["SeqIn"])
+    mems = list(ins["MemInit"])
+    caps = list(ins["Captures"])
+    cap_names = attrs["cap_names"]
+    seq_names = attrs["seq_in_names"]
+    mem_names = attrs["mem_names"]
+    upd_names = attrs["mem_update_names"]
+    out_names = attrs["step_out_names"]
+    step_ops = attrs["step_ops"]
+    is_test = ctx.is_test
+    base_key = ctx._base_key
+
+    def body(carry, xs):
+        step_no, mem_vals = carry
+        env = dict(zip(cap_names, caps))
+        env.update(zip(mem_names, mem_vals))
+        env.update(zip(seq_names, xs))
+        key = (jax.random.fold_in(base_key, step_no)
+               if base_key is not None else None)
+        sub = LowerContext(base_key=key, is_test=is_test)
+        run_ops(step_ops, env, sub)
+        new_mems = [env[n] for n in upd_names]
+        outs = [env[n] for n in out_names]
+        return (step_no + 1, new_mems), outs
+
+    (_, final_mems), outs = jax.lax.scan(
+        body, (jnp.zeros((), jnp.int32), mems), tuple(seq))
+    return {"Out": list(outs), "MemFinal": list(final_mems)}
+
+
+class StaticRNN:
+    """Static RNN over a time-major sequence (cf. reference
+    `layers/control_flow.py` StaticRNN + recurrent_op.cc).
+
+    Usage (reference API)::
+
+        rnn = StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)          # x: [T, B, D] time-major
+            h_prev = rnn.memory(init=h0)     # h0: [B, D]
+            h = layers.fc([x_t, h_prev], size=D, act="tanh")
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()                          # [T, B, D]
+    """
+
+    def __init__(self, name=None):
+        self._block = None
+        self._seq_inputs = []   # (outer Variable, alias Variable)
+        self._memories = []     # (init Variable, alias Variable)
+        self._updates = {}      # alias name -> updated Variable
+        self._outputs = []
+        self._sealed = False
+        self._result = None
+
+    def step(self):
+        rnn = self
+
+        class _Guard:
+            def __enter__(self):
+                program = framework.default_main_program()
+                rnn._block = program._create_block()
+                return rnn
+
+            def __exit__(self, exc_type, exc, tb):
+                framework.default_main_program()._rollback()
+                if exc_type is None:
+                    rnn._seal()
+                return False
+
+        return _Guard()
+
+    def _alias(self, shape, dtype, tag):
+        return self._block.create_var(
+            name=unique_name.generate("static_rnn_%s" % tag),
+            shape=shape, dtype=dtype)
+
+    def step_input(self, x):
+        """Register a [T, ...] sequence; returns the per-step slice var."""
+        alias = self._alias(tuple(x.shape[1:]), x.dtype, "in")
+        self._seq_inputs.append((x, alias))
+        return alias
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               dtype="float32"):
+        """A carried state: init Variable, or zeros like (batch_ref, shape)."""
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError(
+                    "StaticRNN.memory needs init= or (shape=, batch_ref=)")
+            from .tensor import fill_constant_batch_size_like
+
+            program = framework.default_main_program()
+            # build the init in the PARENT block
+            program._rollback()
+            try:
+                init = fill_constant_batch_size_like(
+                    batch_ref, [-1] + list(shape), dtype, init_value)
+            finally:
+                program.current_block_idx = self._block.idx
+        alias = self._alias(tuple(init.shape), init.dtype, "mem")
+        self._memories.append((init, alias))
+        return alias
+
+    def update_memory(self, mem, var):
+        self._updates[mem.name] = var
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _seal(self):
+        if not self._seq_inputs:
+            raise ValueError("StaticRNN needs at least one step_input")
+        if not self._outputs:
+            raise ValueError("StaticRNN needs at least one step_output")
+        for _init, alias in self._memories:
+            if alias.name not in self._updates:
+                raise ValueError(
+                    "StaticRNN memory %s never update_memory'd" % alias.name)
+        block = framework.default_main_program().current_block()
+        T = int(self._seq_inputs[0][0].shape[0])
+        seq_names = [a.name for _x, a in self._seq_inputs]
+        mem_names = [a.name for _i, a in self._memories]
+        caps = sorted(
+            set(_captures(self._block, seq_names + mem_names))
+            - set(seq_names) - set(mem_names))
+        outs = []
+        for o in self._outputs:
+            outs.append(block.create_var(
+                name=unique_name.generate("static_rnn_out"),
+                shape=(T,) + tuple(o.shape), dtype=o.dtype))
+        mem_finals = [
+            block.create_var(
+                name=unique_name.generate("static_rnn_memfinal"),
+                shape=tuple(a.shape), dtype=a.dtype)
+            for _i, a in self._memories]
+        block.append_op(
+            "static_rnn",
+            inputs={
+                "SeqIn": [x.name for x, _a in self._seq_inputs],
+                "MemInit": [i.name for i, _a in self._memories],
+                "Captures": caps,
+            },
+            outputs={"Out": [o.name for o in outs],
+                     "MemFinal": [m.name for m in mem_finals]},
+            attrs={
+                "step_ops": _seal_subblock_ops(self._block),
+                "cap_names": caps,
+                "seq_in_names": seq_names,
+                "mem_names": mem_names,
+                "mem_update_names": [
+                    self._updates[a.name].name for _i, a in self._memories],
+                "step_out_names": [o.name for o in self._outputs],
+                "sub_block": self._block.idx,
+            },
+            infer=False,
+        )
+        self._sealed = True
+        self._result = outs[0] if len(outs) == 1 else outs
+
+    def __call__(self):
+        if not self._sealed:
+            raise RuntimeError("StaticRNN used before its step block closed")
+        return self._result
+
+
 def cond(pred, true_fn=None, false_fn=None, name=None):
     """cf. reference layers.cond (conditional_block_op): both branches run
     in the same XLA program under lax.cond."""

@@ -1,0 +1,291 @@
+"""RNN layer API: dynamic_lstm/gru, cells, rnn(), beam search.
+
+Capability parity: reference `python/paddle/fluid/layers/rnn.py` —
+RNNCell:58, GRUCell:224, LSTMCell:322, rnn():432, dynamic_lstm:1987,
+dynamic_gru:2561, gru_unit:2724, beam_search:2880, beam_search_decode:3040,
+lstm_unit:3120.  TPU-first: the full-sequence ops lower to one `lax.scan`
+(ops/rnn_ops.py); sequences are padded dense + explicit ``seq_lens``.
+Gate orders follow the reference kernels: LSTM {candidate, input, forget,
+output} (`math/detail/lstm_kernel.h`), GRU {update, reset, candidate}
+(`math/gru_compute.cc`).
+"""
+
+from ..layer_helper import LayerHelper
+from . import tensor
+from .common import append_simple_op
+
+__all__ = [
+    "dynamic_lstm", "dynamic_gru", "lstm_unit", "gru_unit", "rnn",
+    "RNNCell", "LSTMCell", "GRUCell", "beam_search", "beam_search_decode",
+]
+
+
+def dynamic_lstm(input, size, seq_lens=None, h_0=None, c_0=None,
+                 param_attr=None, bias_attr=None, use_peepholes=False,
+                 is_reverse=False, gate_activation="sigmoid",
+                 cell_activation="tanh", candidate_activation="tanh",
+                 dtype="float32", name=None):
+    """cf. rnn.py:1987.  input: [B, T, 4*D] pre-projected (x@Wx+b done by
+    an fc, as in the reference); size = 4*D.  Returns (hidden, cell),
+    each [B, T, D]."""
+    helper = LayerHelper("dynamic_lstm", name=name)
+    D = size // 4
+    w = helper.create_parameter(param_attr, [D, 4 * D], dtype=dtype)
+    b = helper.create_parameter(
+        bias_attr, [1, 7 * D if use_peepholes else 4 * D], dtype=dtype,
+        is_bias=True)
+    ins = {"Input": input, "Weight": w, "Bias": b}
+    if h_0 is not None:
+        ins["H0"] = h_0
+    if c_0 is not None:
+        ins["C0"] = c_0
+    if seq_lens is not None:
+        ins["SeqLens"] = seq_lens
+    hidden, cell, _, _ = append_simple_op(
+        "lstm", ins,
+        {"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+         "gate_activation": gate_activation,
+         "cell_activation": cell_activation,
+         "candidate_activation": candidate_activation},
+        out_slots=("Hidden", "Cell", "LastH", "LastC"))
+    return hidden, cell
+
+
+def dynamic_gru(input, size, seq_lens=None, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False,
+                dtype="float32", name=None):
+    """cf. rnn.py:2561.  input: [B, T, 3*D] pre-projected; size = D.
+    Returns hidden [B, T, D]."""
+    helper = LayerHelper("dynamic_gru", name=name)
+    D = size
+    w = helper.create_parameter(param_attr, [D, 3 * D], dtype=dtype)
+    b = helper.create_parameter(bias_attr, [1, 3 * D], dtype=dtype,
+                                is_bias=True)
+    ins = {"Input": input, "Weight": w, "Bias": b}
+    if h_0 is not None:
+        ins["H0"] = h_0
+    if seq_lens is not None:
+        ins["SeqLens"] = seq_lens
+    hidden, _ = append_simple_op(
+        "gru", ins,
+        {"is_reverse": is_reverse, "origin_mode": origin_mode,
+         "gate_activation": gate_activation,
+         "activation": candidate_activation},
+        out_slots=("Hidden", "LastH"))
+    return hidden
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """cf. rnn.py:3120: one step.  x_t [B, Din]; projects x and runs the
+    cell; returns (hidden, cell)."""
+    from .nn import fc
+
+    helper = LayerHelper("lstm_unit", name=name)
+    D = int(hidden_t_prev.shape[-1])
+    x4 = fc(x_t, 4 * D, param_attr=param_attr, bias_attr=bias_attr)
+    w = helper.create_parameter(None, [D, 4 * D], dtype=x_t.dtype)
+    h, c = append_simple_op(
+        "lstm_unit",
+        {"X": x4, "HPrev": hidden_t_prev, "CPrev": cell_t_prev, "Weight": w},
+        {"forget_bias": float(forget_bias)}, out_slots=("H", "C"))
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False, name=None):
+    """cf. rnn.py:2724: one step.  input [B, 3*D] pre-projected; size=3*D
+    (reference convention).  Returns the new hidden [B, D]."""
+    helper = LayerHelper("gru_unit", name=name)
+    D = size // 3
+    w = helper.create_parameter(param_attr, [D, 3 * D], dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, [1, 3 * D], dtype=input.dtype,
+                                is_bias=True)
+    h = append_simple_op(
+        "gru_unit", {"X": input, "HPrev": hidden, "Weight": w, "Bias": b},
+        {"activation": activation, "gate_activation": gate_activation,
+         "origin_mode": origin_mode}, out_slots=("H",))
+    return h
+
+
+def _sub_attr(attr, suffix):
+    """Derive a ParamAttr for a sub-weight: a fixed user name gets the
+    suffix so a cell's input and hidden weights never collide."""
+    from ..layer_helper import ParamAttr
+
+    a = ParamAttr._to_attr(attr)
+    if a is False or a is None or a.name is None:
+        return attr
+    import copy
+
+    a = copy.copy(a)
+    a.name = a.name + suffix
+    return a
+
+
+class RNNCell(object):
+    """cf. rnn.py:58 — single-step recurrence with learnable weights."""
+
+    def call(self, inputs, states):
+        raise NotImplementedError()
+
+    def get_initial_states(self, batch_ref, dtype="float32"):
+        """Zero states shaped from a [B, ...] reference Variable (batch
+        dim may be the dynamic sentinel in static graph)."""
+        return [tensor.fill_constant_batch_size_like(
+                    batch_ref, [-1, s], dtype, 0.0)
+                for s in self.state_size]
+
+    def __call__(self, inputs, states):
+        return self.call(inputs, states)
+
+
+class LSTMCell(RNNCell):
+    """cf. rnn.py:322.  States: [hidden, cell].  Gate order {c~, i, f, o}
+    (ops/rnn_ops.py); forget_bias added to the f gate pre-activation."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 forget_bias=1.0, dtype="float32", name="LSTMCell"):
+        self.hidden_size = hidden_size
+        self.param_attr = param_attr
+        self.bias_attr = bias_attr
+        self.forget_bias = forget_bias
+        self.dtype = dtype
+        self._helper = LayerHelper(name)
+        self._wx = None
+        self._wh = None
+
+    @property
+    def state_size(self):
+        return [self.hidden_size, self.hidden_size]
+
+    def call(self, inputs, states):
+        from .ops import matmul
+
+        h, c = states
+        D = self.hidden_size
+        if self._wh is None:  # weights shared across every step
+            self._wh = self._helper.create_parameter(
+                _sub_attr(self.param_attr, "_h"), [D, 4 * D],
+                dtype=self.dtype)
+            self._wx = self._helper.create_parameter(
+                _sub_attr(self.param_attr, "_x"),
+                [int(inputs.shape[-1]), 4 * D], dtype=self.dtype)
+            self._bias = self._helper.create_parameter(
+                self.bias_attr, [1, 4 * D], dtype=self.dtype, is_bias=True)
+        x4 = matmul(inputs, self._wx)
+        h_new, c_new = append_simple_op(
+            "lstm_unit",
+            {"X": x4, "HPrev": h, "CPrev": c, "Weight": self._wh,
+             "Bias": self._bias},
+            {"forget_bias": float(self.forget_bias)}, out_slots=("H", "C"))
+        return h_new, [h_new, c_new]
+
+
+class GRUCell(RNNCell):
+    """cf. rnn.py:224.  State: [hidden].  h = u*h_prev + (1-u)*c~ (the
+    reference GRUCell form, origin_mode=True)."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 dtype="float32", name="GRUCell"):
+        self.hidden_size = hidden_size
+        self.param_attr = param_attr
+        self.bias_attr = bias_attr
+        self.dtype = dtype
+        self._helper = LayerHelper(name)
+        self._wh = None
+
+    @property
+    def state_size(self):
+        return [self.hidden_size]
+
+    def call(self, inputs, states):
+        from .ops import matmul
+
+        (h,) = states if isinstance(states, (list, tuple)) else (states,)
+        D = self.hidden_size
+        if self._wh is None:  # weights shared across every step
+            self._wh = self._helper.create_parameter(
+                _sub_attr(self.param_attr, "_h"), [D, 3 * D],
+                dtype=self.dtype)
+            self._wx = self._helper.create_parameter(
+                _sub_attr(self.param_attr, "_x"),
+                [int(inputs.shape[-1]), 3 * D], dtype=self.dtype)
+            self._bias = self._helper.create_parameter(
+                self.bias_attr, [1, 3 * D], dtype=self.dtype, is_bias=True)
+        x3 = matmul(inputs, self._wx)
+        h_new = append_simple_op(
+            "gru_unit",
+            {"X": x3, "HPrev": h, "Weight": self._wh, "Bias": self._bias},
+            {"origin_mode": True}, out_slots=("H",))
+        return h_new, [h_new]
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """cf. rnn.py:432: run a cell over the time axis (unrolled at build
+    time — T is static under XLA; the fused scan path is dynamic_lstm/gru).
+
+    inputs: [B, T, ...] (or [T, B, ...] when time_major).  Returns
+    (outputs [B, T, D], final_states).
+    """
+    x = inputs
+    if time_major:
+        x = tensor.transpose(x, [1, 0] + list(range(2, len(x.shape))))
+    T = int(x.shape[1])
+    states = (initial_states if initial_states is not None
+              else cell.get_initial_states(x))
+    mask = None
+    if sequence_length is not None:
+        mask = append_simple_op(
+            "sequence_mask", {"X": sequence_length},
+            {"maxlen": T, "out_dtype": "float32"}, out_slots=("Y",),
+            dtype="float32", stop_gradient=True)
+    outs = []
+    steps = list(range(T - 1, -1, -1) if is_reverse else range(T))
+    for t in steps:
+        xt = tensor.reshape(
+            tensor.slice(x, axes=[1], starts=[t], ends=[t + 1]),
+            [0] + [int(s) for s in x.shape[2:]])
+        out_t, new_states = cell(xt, states)
+        if mask is not None:
+            mt = tensor.reshape(
+                tensor.slice(mask, axes=[1], starts=[t], ends=[t + 1]),
+                [0, 1])
+            new_states = [s_new * mt + s_old * (1.0 - mt)
+                          for s_new, s_old in zip(new_states, states)]
+            out_t = out_t * mt
+        states = new_states
+        outs.append(out_t)
+    if is_reverse:
+        outs = outs[::-1]
+    outs = [tensor.unsqueeze(o, [1]) for o in outs]
+    out = tensor.concat(outs, axis=1)
+    if time_major:
+        out = tensor.transpose(out, [1, 0] + list(range(2, len(out.shape))))
+    return out, states
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id,
+                is_accumulated=True, name=None):
+    """cf. rnn.py:2880 / beam_search_op.cc — one step over dense [B, beam]
+    tensors; returns (selected_ids, selected_scores, parent_idx)."""
+    return append_simple_op(
+        "beam_search",
+        {"PreIds": pre_ids, "PreScores": pre_scores, "Scores": scores},
+        {"beam_size": int(beam_size), "end_id": int(end_id),
+         "is_accumulated": bool(is_accumulated)},
+        out_slots=("SelectedIds", "SelectedScores", "ParentIdx"),
+        stop_gradient=True)
+
+
+def beam_search_decode(ids, parents, final_scores, beam_size=None,
+                       end_id=None, name=None):
+    """cf. rnn.py:3040 — backtrack per-step (ids, parents) [T, B, beam]
+    into (sentence_ids [B, beam, T], sentence_scores [B, beam])."""
+    return append_simple_op(
+        "beam_search_decode",
+        {"Ids": ids, "Parents": parents, "FinalScores": final_scores}, {},
+        out_slots=("SentenceIds", "SentenceScores"), stop_gradient=True)

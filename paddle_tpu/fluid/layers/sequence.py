@@ -1,0 +1,154 @@
+"""Sequence layer API over padded-dense batches + explicit lengths.
+
+Capability parity: reference `python/paddle/fluid/layers/sequence_lod.py`
+(~16 public sequence_* symbols over LoDTensor).  TPU-first: every function
+takes the sequence lengths as an explicit Variable (``seq_lens``) instead
+of reading LoD metadata off the tensor; see ops/sequence_ops.py for the
+padded-layout semantics.
+"""
+
+from .common import append_simple_op, to_var_list
+
+__all__ = [
+    "sequence_mask", "sequence_pool", "sequence_softmax", "sequence_reverse",
+    "sequence_expand", "sequence_expand_as", "sequence_concat",
+    "sequence_pad", "sequence_unpad", "sequence_slice", "sequence_erase",
+    "sequence_enumerate", "sequence_reshape", "sequence_scatter",
+    "sequence_conv", "sequence_first_step", "sequence_last_step",
+]
+
+
+def sequence_mask(x, maxlen, dtype="int64", name=None):
+    """cf. sequence_lod.py:1302 — lengths -> [B, maxlen] 0/1 mask.  maxlen
+    must be a static int (XLA static shapes)."""
+    return append_simple_op(
+        "sequence_mask", {"X": x},
+        {"maxlen": int(maxlen), "out_dtype": dtype}, out_slots=("Y",),
+        dtype=dtype, stop_gradient=True)
+
+
+def sequence_pool(input, pool_type, seq_lens, is_test=False, pad_value=0.0):
+    """cf. sequence_lod.py:261."""
+    return append_simple_op(
+        "sequence_pool", {"X": input, "SeqLens": seq_lens},
+        {"pooltype": pool_type.upper(), "pad_value": pad_value})
+
+
+def sequence_first_step(input, seq_lens):
+    """cf. sequence_lod.py:436."""
+    return sequence_pool(input, "FIRST", seq_lens)
+
+
+def sequence_last_step(input, seq_lens):
+    """cf. sequence_lod.py:492."""
+    return sequence_pool(input, "LAST", seq_lens)
+
+
+def sequence_softmax(input, seq_lens, use_cudnn=False, name=None):
+    """cf. sequence_lod.py:177."""
+    return append_simple_op(
+        "sequence_softmax", {"X": input, "SeqLens": seq_lens}, {})
+
+
+def sequence_reverse(x, seq_lens, name=None):
+    """cf. sequence_lod.py:1376."""
+    return append_simple_op(
+        "sequence_reverse", {"X": x, "SeqLens": seq_lens}, {},
+        out_slots=("Y",))
+
+
+def sequence_expand(x, ref_lens, max_ref_len, name=None):
+    """cf. sequence_lod.py:637 — repeat row b ref_lens[b] times into a
+    padded repeat axis of static size max_ref_len."""
+    return append_simple_op(
+        "sequence_expand", {"X": x, "RefLens": ref_lens},
+        {"max_ref_len": int(max_ref_len)})
+
+
+def sequence_expand_as(x, y, seq_lens, name=None):
+    """cf. sequence_lod.py:773."""
+    return append_simple_op(
+        "sequence_expand_as", {"X": x, "Y": y, "SeqLens": seq_lens}, {})
+
+
+def sequence_concat(inputs, seq_lens, name=None):
+    """cf. sequence_lod.py:375 — returns (out, out_lens)."""
+    return append_simple_op(
+        "sequence_concat",
+        {"X": to_var_list(inputs), "SeqLens": to_var_list(seq_lens)}, {},
+        out_slots=("Out", "OutLens"))
+
+
+def sequence_pad(x, pad_value, seq_lens, maxlen=None, name=None):
+    """cf. sequence_lod.py:893 — returns (out, length)."""
+    return append_simple_op(
+        "sequence_pad", {"X": x, "SeqLens": seq_lens},
+        {"padded_length": int(maxlen) if maxlen else -1,
+         "pad_value": float(pad_value)},
+        out_slots=("Out", "Length"))
+
+
+def sequence_unpad(x, length, name=None):
+    """cf. sequence_lod.py:1007."""
+    return append_simple_op("sequence_unpad", {"X": x, "Length": length}, {})
+
+
+def sequence_slice(input, offset, length, name=None):
+    """cf. sequence_lod.py:549."""
+    return append_simple_op(
+        "sequence_slice", {"X": input, "Offset": offset, "Length": length},
+        {})
+
+
+def sequence_erase(input, seq_lens, tokens, name=None):
+    """cf. sequence_ops/sequence_erase_op.cc — returns (out, out_lens)."""
+    return append_simple_op(
+        "sequence_erase", {"X": input, "SeqLens": seq_lens},
+        {"tokens": [int(t) for t in tokens]},
+        out_slots=("Out", "OutLens"), stop_gradient=True)
+
+
+def sequence_enumerate(input, seq_lens, win_size, pad_value=0, name=None):
+    """cf. sequence_lod.py:1234."""
+    return append_simple_op(
+        "sequence_enumerate", {"X": input, "SeqLens": seq_lens},
+        {"win_size": int(win_size), "pad_value": int(pad_value)},
+        stop_gradient=True)
+
+
+def sequence_reshape(input, seq_lens, new_dim):
+    """cf. sequence_lod.py:1082 — returns (out, out_lens)."""
+    return append_simple_op(
+        "sequence_reshape", {"X": input, "SeqLens": seq_lens},
+        {"new_dim": int(new_dim)}, out_slots=("Out", "OutLens"))
+
+
+def sequence_scatter(input, ids, updates, upd_lens, name=None):
+    """cf. sequence_lod.py:1144."""
+    return append_simple_op(
+        "sequence_scatter",
+        {"X": input, "Ids": ids, "Updates": updates, "UpdLens": upd_lens},
+        {})
+
+
+def sequence_conv(input, seq_lens, num_filters, filter_size=3,
+                  filter_stride=1, padding=True, padding_start=None,
+                  bias_attr=None, param_attr=None, act=None, name=None):
+    """cf. sequence_lod.py:44 — context-window projection over time."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("sequence_conv", name=name)
+    D = int(input.shape[-1])
+    filt = helper.create_parameter(
+        param_attr, [filter_size * D, num_filters], dtype=input.dtype)
+    start = (padding_start if padding_start is not None
+             else -(filter_size - 1) // 2)
+    out = append_simple_op(
+        "sequence_conv",
+        {"X": input, "SeqLens": seq_lens, "Filter": filt},
+        {"context_length": int(filter_size), "context_start": int(start)})
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            bias_attr, [num_filters], dtype=out.dtype, is_bias=True)
+        out = helper.append_bias_op(out, b, axis=2)
+    return helper.append_activation(out, act)

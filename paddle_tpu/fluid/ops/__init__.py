@@ -13,6 +13,8 @@ from . import (  # noqa: F401
     optimizer_ops,
     random_ops,
     reduce_ops,
+    rnn_ops,
+    sequence_ops,
     tensor_ops,
 )
 from .optimizer_ops import OPTIMIZER_OP_TYPES  # noqa: F401

@@ -1,0 +1,248 @@
+"""Recurrent ops (LSTM/GRU) and beam search, lowered onto lax.scan.
+
+Capability parity: reference `operators/lstm_op.cc` + `math/detail/
+lstm_kernel.h` (gate order: candidate, input, forget, output),
+`operators/gru_op.cc` + `math/gru_compute.cc`, `operators/lstm_unit_op.cc`,
+`operators/gru_unit_op.cc`, `operators/beam_search_op.cc` +
+`math/beam_search.cc`.  TPU-first redesign: the recurrence is ONE
+`lax.scan` over the time axis inside the jitted program (the reference
+walks LoD-batched rows on CPU / cuDNN); variable lengths are handled by
+freezing the carried state at padded steps, so LastH/LastC equal the state
+at each row's true last step.  Beam search is dense [B, beam] tensors with
+`lax.top_k` over beam*vocab — no LoD offset juggling.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _act(name):
+    try:
+        return _ACTS[name]
+    except KeyError:
+        raise ValueError("unsupported rnn activation %r (have %s)"
+                         % (name, sorted(_ACTS))) from None
+
+
+def _lstm_cell(x4, h, c, W, bias, peep, acts):
+    """One LSTM step.  x4: [B, 4D] pre-projected input; gate columns in
+    reference order {candidate, input, forget, output}."""
+    act_gate, act_cell, act_cand = acts
+    D = h.shape[-1]
+    g = x4 + h @ W
+    if bias is not None:
+        g = g + bias[..., :4 * D]
+    gc, gi, gf, go = (g[..., :D], g[..., D:2 * D],
+                      g[..., 2 * D:3 * D], g[..., 3 * D:])
+    if peep is not None:
+        w_ic, w_fc, w_oc = peep
+        gi = gi + c * w_ic
+        gf = gf + c * w_fc
+    c_new = act_cand(gc) * act_gate(gi) + c * act_gate(gf)
+    if peep is not None:
+        go = go + c_new * w_oc
+    h_new = act_gate(go) * act_cell(c_new)
+    return h_new, c_new
+
+
+def _gru_cell(x3, h, W, bias, origin_mode, acts):
+    """One GRU step.  x3: [B, 3D] pre-projected; W: [D, 3D] with columns
+    {update, reset, candidate} (reference gru_compute layout)."""
+    act_gate, act_cand = acts
+    D = h.shape[-1]
+    if bias is not None:
+        x3 = x3 + bias
+    xu, xr, xc = x3[..., :D], x3[..., D:2 * D], x3[..., 2 * D:]
+    u = act_gate(xu + h @ W[:, :D])
+    r = act_gate(xr + h @ W[:, D:2 * D])
+    c = act_cand(xc + (r * h) @ W[:, 2 * D:])
+    if origin_mode:  # h = u*h_prev + (1-u)*c  (GRUCell / origin paper form)
+        return u * h + (1.0 - u) * c
+    return (1.0 - u) * h + u * c  # dynamic_gru default form
+
+
+def _scan_rnn(step_fn, x, lens, init_carry, is_reverse):
+    """Run step_fn over time with length masking.
+
+    step_fn(carry, xt) -> (new_carry, out_t); carries are masked so padded
+    steps leave state unchanged and emit zeros.  With is_reverse the scan
+    visits t = T-1..0: padded steps come first and keep the initial state,
+    so the recurrence runs over the valid prefix in reverse order while
+    outputs stay at their original positions.
+    """
+    B, T = x.shape[0], x.shape[1]
+    xs = jnp.moveaxis(x, 1, 0)  # [T, B, ...]
+    if lens is None:
+        mask = jnp.ones((T, B, 1), x.dtype)
+    else:
+        mask = (jnp.arange(T)[:, None] < lens[None, :]).astype(x.dtype)
+        mask = mask[..., None]
+
+    def body(carry, tm):
+        xt, m = tm
+        new_carry, out = step_fn(carry, xt)
+        new_carry = jax.tree.map(
+            lambda n, o: m * n + (1.0 - m) * o, new_carry, carry)
+        out = jax.tree.map(lambda o: m * o, out)
+        return new_carry, out
+
+    carry, outs = jax.lax.scan(
+        body, init_carry, (xs, mask), reverse=bool(is_reverse))
+    return carry, jax.tree.map(lambda o: jnp.moveaxis(o, 0, 1), outs)
+
+
+@register_op("lstm",
+             inputs=["Input", "Weight", "Bias", "H0", "C0", "SeqLens"],
+             outputs=["Hidden", "Cell", "LastH", "LastC"],
+             no_grad_slots=("SeqLens",))
+def _lstm(ctx, ins, attrs):
+    """cf. lstm_op.cc: Input [B,T,4D] = x@Wx+b already projected; Weight
+    [D,4D] hidden-to-hidden; Bias [1,4D] or [1,7D] with peepholes
+    ({b, W_ic, W_fc, W_oc}, cf. lstm_op.cc peephole layout)."""
+    x = ins["Input"][0]
+    W = ins["Weight"][0]
+    D = W.shape[0]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    use_peep = bool(attrs.get("use_peepholes", False))
+    peep = None
+    if use_peep:
+        if bias is None or bias.shape[-1] != 7 * D:
+            raise ValueError("use_peepholes needs Bias of width 7*D")
+        b = bias.reshape(-1)
+        peep = (b[4 * D:5 * D], b[5 * D:6 * D], b[6 * D:])
+    acts = (_act(attrs.get("gate_activation", "sigmoid")),
+            _act(attrs.get("cell_activation", "tanh")),
+            _act(attrs.get("candidate_activation", "tanh")))
+    B = x.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, D), x.dtype)
+    lens = ins["SeqLens"][0] if ins.get("SeqLens") else None
+
+    def step(carry, xt):
+        h, c = carry
+        h_new, c_new = _lstm_cell(xt, h, c, W, bias, peep, acts)
+        return (h_new, c_new), (h_new, c_new)
+
+    (last_h, last_c), (hs, cs) = _scan_rnn(
+        step, x, lens, (h0, c0), attrs.get("is_reverse", False))
+    return {"Hidden": [hs], "Cell": [cs],
+            "LastH": [last_h], "LastC": [last_c]}
+
+
+@register_op("gru", inputs=["Input", "Weight", "Bias", "H0", "SeqLens"],
+             outputs=["Hidden", "LastH"], no_grad_slots=("SeqLens",))
+def _gru(ctx, ins, attrs):
+    """cf. gru_op.cc: Input [B,T,3D] pre-projected; Weight [D,3D] columns
+    {update, reset, candidate}."""
+    x = ins["Input"][0]
+    W = ins["Weight"][0]
+    D = W.shape[0]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    acts = (_act(attrs.get("gate_activation", "sigmoid")),
+            _act(attrs.get("activation", "tanh")))
+    origin = bool(attrs.get("origin_mode", False))
+    B = x.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, D), x.dtype)
+    lens = ins["SeqLens"][0] if ins.get("SeqLens") else None
+
+    def step(h, xt):
+        h_new = _gru_cell(xt, h, W, bias, origin, acts)
+        return h_new, h_new
+
+    last_h, hs = _scan_rnn(step, x, lens, h0, attrs.get("is_reverse", False))
+    return {"Hidden": [hs], "LastH": [last_h]}
+
+
+@register_op("lstm_unit", inputs=["X", "HPrev", "CPrev", "Weight", "Bias"],
+             outputs=["H", "C"])
+def _lstm_unit(ctx, ins, attrs):
+    """cf. lstm_unit_op.cc: one step; X [B,4D] pre-projected input part."""
+    acts = (_act(attrs.get("gate_activation", "sigmoid")),
+            _act(attrs.get("cell_activation", "tanh")),
+            _act(attrs.get("candidate_activation", "tanh")))
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    x = ins["X"][0]
+    fb = float(attrs.get("forget_bias", 0.0))
+    if fb:
+        D = ins["Weight"][0].shape[0]
+        x = x.at[..., 2 * D:3 * D].add(fb)  # forget-gate column block
+    h, c = _lstm_cell(x, ins["HPrev"][0], ins["CPrev"][0],
+                      ins["Weight"][0], bias, None, acts)
+    return {"H": [h], "C": [c]}
+
+
+@register_op("gru_unit", inputs=["X", "HPrev", "Weight", "Bias"],
+             outputs=["H"])
+def _gru_unit(ctx, ins, attrs):
+    """cf. gru_unit_op.cc: one step; X [B,3D] pre-projected input part."""
+    acts = (_act(attrs.get("gate_activation", "sigmoid")),
+            _act(attrs.get("activation", "tanh")))
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    h = _gru_cell(ins["X"][0], ins["HPrev"][0], ins["Weight"][0], bias,
+                  bool(attrs.get("origin_mode", False)), acts)
+    return {"H": [h]}
+
+
+_NEG = -1e9
+
+
+@register_op("beam_search", inputs=["PreIds", "PreScores", "Scores"],
+             outputs=["SelectedIds", "SelectedScores", "ParentIdx"],
+             grad=None)
+def _beam_search(ctx, ins, attrs):
+    """One beam-search step (cf. beam_search_op.cc / math/beam_search.cc).
+
+    Dense layout: PreIds/PreScores [B, beam]; Scores [B, beam, V] = log
+    probs of the next token per live beam (already accumulated when
+    attrs['is_accumulated'], reference default).  Finished beams (pre id
+    == end_id) contribute a single end_id candidate carrying their score,
+    so they survive top-k unchanged.  Initialize PreScores as
+    [0, -1e9, ...] per batch row so step 0 doesn't pick beam duplicates.
+    Returns [B, beam] ids/scores and the parent beam of each selection.
+    """
+    pre_ids, pre_scores, scores = (
+        ins["PreIds"][0], ins["PreScores"][0], ins["Scores"][0])
+    beam_size = int(attrs.get("beam_size", pre_ids.shape[1]))
+    end_id = int(attrs.get("end_id", 0))
+    V = scores.shape[-1]
+    total = scores if attrs.get("is_accumulated", True) else (
+        pre_scores[..., None] + scores)
+    finished = (pre_ids == end_id)[..., None]
+    keep_end = jax.nn.one_hot(end_id, V, dtype=jnp.bool_)
+    fin_scores = jnp.where(keep_end, pre_scores[..., None], _NEG)
+    total = jnp.where(finished, fin_scores, total)
+    flat = total.reshape(total.shape[0], -1)
+    top_scores, top_idx = jax.lax.top_k(flat, beam_size)
+    parent = (top_idx // V).astype(jnp.int64)
+    token = (top_idx % V).astype(jnp.int64)
+    return {"SelectedIds": [token], "SelectedScores": [top_scores],
+            "ParentIdx": [parent]}
+
+
+@register_op("beam_search_decode", inputs=["Ids", "Parents", "FinalScores"],
+             outputs=["SentenceIds", "SentenceScores"], grad=None)
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrack stored (ids, parents) into full hypotheses (cf.
+    beam_search_decode_op.cc).  Ids/Parents [T, B, beam] from the step op;
+    output SentenceIds [B, beam, T] in generation order."""
+    ids, parents = ins["Ids"][0], ins["Parents"][0]
+    B, beam = ids.shape[1], ids.shape[2]
+    k0 = jnp.broadcast_to(jnp.arange(beam, dtype=parents.dtype), (B, beam))
+
+    def back(k, t_slice):
+        ids_t, par_t = t_slice
+        tok = jnp.take_along_axis(ids_t, k, axis=1)
+        return jnp.take_along_axis(par_t, k, axis=1), tok
+
+    _, toks = jax.lax.scan(back, k0, (ids, parents), reverse=True)
+    return {"SentenceIds": [jnp.moveaxis(toks, 0, 1).transpose(0, 2, 1)],
+            "SentenceScores": [ins["FinalScores"][0]]}

@@ -1,0 +1,329 @@
+"""Sequence ops over padded-dense batches (the LoD capability, TPU-first).
+
+Capability parity: reference `paddle/fluid/operators/sequence_ops/` (48
+files operating on LoDTensor offset tables, cf. `framework/lod_tensor.h:52`).
+TPU-first redesign: variable-length batches are a padded dense tensor
+``[B, T, ...]`` plus an explicit ``SeqLens [B]`` int array — XLA needs
+static shapes, and masks/gathers over a padded layout vectorize onto the
+VPU where the reference walks per-sequence offset tables on CPU.  Every op
+takes the lengths as a real input slot so the mask math stays inside the
+jitted program.
+
+Conventions:
+- positions >= SeqLens[b] are padding; ops write zeros (or the declared
+  pad value) there so downstream matmuls stay clean.
+- ops that change lengths return the new lengths as an output slot
+  (``OutLens``) instead of mutating LoD metadata.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _pos(T):
+    return jnp.arange(T)
+
+
+def _valid_mask(lens, T):
+    """[B, T] bool, True where position < length."""
+    return _pos(T)[None, :] < lens[:, None]
+
+
+def _bcast(mask, x):
+    """Broadcast [B, T] mask to x's rank ([B, T, ...])."""
+    return mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+
+
+@register_op("sequence_mask", inputs=["X"], outputs=["Y"], grad=None)
+def _sequence_mask(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_mask_op.cc: lengths -> 0/1 mask."""
+    lens = ins["X"][0]
+    maxlen = int(attrs.get("maxlen", -1))
+    if maxlen < 0:
+        raise ValueError(
+            "sequence_mask needs a static maxlen attr on TPU (dynamic "
+            "max(lengths) would be a dynamic shape)")
+    out = (_pos(maxlen)[None, :] < lens[..., None])
+    return {"Y": [out.astype(attrs.get("out_dtype", "int64"))]}
+
+
+@register_op("sequence_pool", inputs=["X", "SeqLens"], outputs=["Out"],
+             no_grad_slots=("SeqLens",))
+def _sequence_pool(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_pool_op.cc: per-sequence reduce over time.
+
+    pooltype: AVERAGE | SUM | SQRT | MAX | LAST | FIRST.  Empty sequences
+    produce pad_value (reference behavior).
+    """
+    x, lens = ins["X"][0], ins["SeqLens"][0]
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    pad_value = attrs.get("pad_value", 0.0)
+    T = x.shape[1]
+    mask = _bcast(_valid_mask(lens, T), x)
+    n = jnp.maximum(lens, 1).reshape((-1,) + (1,) * (x.ndim - 2))
+    if ptype == "SUM":
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1) / n
+    elif ptype == "SQRT":
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1) / jnp.sqrt(
+            n.astype(x.dtype))
+    elif ptype == "MAX":
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(
+            x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jnp.max(jnp.where(mask, x, neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lens - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError("unknown pooltype %r" % ptype)
+    empty = (lens == 0).reshape((-1,) + (1,) * (out.ndim - 1))
+    out = jnp.where(empty, jnp.asarray(pad_value, out.dtype), out)
+    return {"Out": [out]}
+
+
+@register_op("sequence_softmax", inputs=["X", "SeqLens"], outputs=["Out"],
+             no_grad_slots=("SeqLens",))
+def _sequence_softmax(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_softmax_op.cc: softmax over the valid
+    prefix of axis 1; padding positions get 0."""
+    x, lens = ins["X"][0], ins["SeqLens"][0]
+    T = x.shape[1]
+    mask = _valid_mask(lens, T)
+    if x.ndim > 2:
+        mask = _bcast(mask, x)
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, x.dtype)
+    z = jnp.where(mask, x, neg)
+    out = jax.nn.softmax(z.astype(jnp.float32), axis=1).astype(x.dtype)
+    return {"Out": [jnp.where(mask, out, 0)]}
+
+
+@register_op("sequence_reverse", inputs=["X", "SeqLens"], outputs=["Y"],
+             no_grad_slots=("SeqLens",))
+def _sequence_reverse(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_reverse_op.h: reverse each valid prefix,
+    padding stays in place."""
+    x, lens = ins["X"][0], ins["SeqLens"][0]
+    T = x.shape[1]
+    pos = _pos(T)[None, :]
+    idx = jnp.where(pos < lens[:, None], lens[:, None] - 1 - pos, pos)
+    return {"Y": [jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)]}
+
+
+@register_op("sequence_expand_as", inputs=["X", "Y", "SeqLens"],
+             outputs=["Out"], no_grad_slots=("Y", "SeqLens"))
+def _sequence_expand_as(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_expand_as_op.cc: broadcast each row of X
+    over the valid time steps of reference Y; padding is zero."""
+    x, y, lens = ins["X"][0], ins["Y"][0], ins["SeqLens"][0]
+    T = y.shape[1]
+    out = jnp.broadcast_to(
+        x[:, None], (x.shape[0], T) + x.shape[1:]).astype(x.dtype)
+    return {"Out": [jnp.where(_bcast(_valid_mask(lens, T), out), out, 0)]}
+
+
+@register_op("sequence_expand", inputs=["X", "RefLens"], outputs=["Out"],
+             no_grad_slots=("RefLens",))
+def _sequence_expand(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_expand_op.cc: repeat row b RefLens[b]
+    times.  Dense layout: Out[b, r] = X[b] for r < RefLens[b], else 0,
+    with static bound attrs['max_ref_len'] (the reference's ragged output
+    rows become a padded repeat axis)."""
+    x, ref = ins["X"][0], ins["RefLens"][0]
+    R = int(attrs.get("max_ref_len", -1))
+    if R < 0:
+        raise ValueError("sequence_expand needs static max_ref_len attr")
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], R) + x.shape[1:])
+    mask = _pos(R)[None, :] < ref[:, None]
+    return {"Out": [jnp.where(
+        mask.reshape(mask.shape + (1,) * (x.ndim - 1)), out, 0)]}
+
+
+def _compact(x, keep):
+    """Stable-compact valid positions of axis 1 to the front.
+
+    keep: [B, T] bool.  Returns (compacted x, new lens).  Uses a stable
+    argsort on the inverted mask — a vectorizable TPU idiom for the
+    reference's per-sequence memmove loops.
+    """
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    out = jnp.take_along_axis(
+        x, order.reshape(order.shape + (1,) * (x.ndim - 2)), axis=1)
+    newlens = jnp.sum(keep, axis=1).astype(jnp.int32)
+    newmask = _valid_mask(newlens, x.shape[1])
+    out = jnp.where(_bcast(newmask, out), out, 0)
+    return out, newlens
+
+
+@register_op("sequence_concat", inputs=["X", "SeqLens"],
+             outputs=["Out", "OutLens"],
+             no_grad_slots=("SeqLens",), stateful_out_slots=("OutLens",))
+def _sequence_concat(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_concat_op.cc: concat the valid prefixes
+    of N padded inputs along time, then re-pad."""
+    xs, lens = ins["X"], ins["SeqLens"]
+    cat = jnp.concatenate(xs, axis=1)
+    offs = []
+    for x, l in zip(xs, lens):
+        offs.append(_valid_mask(l, x.shape[1]))
+    keep = jnp.concatenate(offs, axis=1)
+    out, outlens = _compact(cat, keep)
+    return {"Out": [out], "OutLens": [outlens]}
+
+
+@register_op("sequence_pad", inputs=["X", "SeqLens"],
+             outputs=["Out", "Length"],
+             no_grad_slots=("SeqLens",), stateful_out_slots=("Length",))
+def _sequence_pad(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_pad_op.cc: normalize to padded_length,
+    filling padding with pad_value."""
+    x, lens = ins["X"][0], ins["SeqLens"][0]
+    P = int(attrs.get("padded_length", -1))
+    if P < 0:
+        P = x.shape[1]
+    pad_value = attrs.get("pad_value", 0.0)
+    if P > x.shape[1]:
+        pad = [(0, 0)] * x.ndim
+        pad[1] = (0, P - x.shape[1])
+        x = jnp.pad(x, pad)
+    elif P < x.shape[1]:
+        x = x[:, :P]
+    lens = jnp.minimum(lens, P)
+    mask = _bcast(_valid_mask(lens, P), x)
+    return {"Out": [jnp.where(mask, x, jnp.asarray(pad_value, x.dtype))],
+            "Length": [lens.astype(jnp.int64)]}
+
+
+@register_op("sequence_unpad", inputs=["X", "Length"], outputs=["Out"],
+             no_grad_slots=("Length",))
+def _sequence_unpad(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_unpad_op.cc.  The reference flattens to a
+    ragged LoD tensor; the dense equivalent zeroes padding and keeps the
+    (x, lens) pair as the sequence representation."""
+    x, lens = ins["X"][0], ins["Length"][0]
+    mask = _bcast(_valid_mask(lens, x.shape[1]), x)
+    return {"Out": [jnp.where(mask, x, 0)]}
+
+
+@register_op("sequence_slice", inputs=["X", "Offset", "Length"],
+             outputs=["Out"], no_grad_slots=("Offset", "Length"))
+def _sequence_slice(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_slice_op.h: per-row slice
+    [offset_b, offset_b + length_b) of the time axis, left-aligned."""
+    x = ins["X"][0]
+    off = ins["Offset"][0].reshape(-1)
+    ln = ins["Length"][0].reshape(-1)
+    T = x.shape[1]
+    pos = _pos(T)[None, :]
+    src = jnp.clip(pos + off[:, None], 0, T - 1)
+    out = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    mask = pos < ln[:, None]
+    return {"Out": [jnp.where(_bcast(mask, out), out, 0)]}
+
+
+@register_op("sequence_erase", inputs=["X", "SeqLens"],
+             outputs=["Out", "OutLens"], grad=None)
+def _sequence_erase(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_erase_op.cc: drop listed token ids and
+    compact each sequence."""
+    x, lens = ins["X"][0], ins["SeqLens"][0]
+    tokens = attrs.get("tokens", [])
+    keep = _valid_mask(lens, x.shape[1])
+    for t in tokens:
+        keep = keep & (x != t)
+    out, outlens = _compact(x, keep)
+    return {"Out": [out], "OutLens": [outlens]}
+
+
+@register_op("sequence_enumerate", inputs=["X", "SeqLens"], outputs=["Out"],
+             grad=None)
+def _sequence_enumerate(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_enumerate_op.cc: sliding windows of ids;
+    positions past the end filled with pad_value."""
+    x, lens = ins["X"][0], ins["SeqLens"][0]
+    win = int(attrs["win_size"])
+    pad = attrs.get("pad_value", 0)
+    T = x.shape[1]
+    cols = []
+    for w in range(win):
+        shifted = jnp.concatenate(
+            [x[:, w:], jnp.full((x.shape[0], w), pad, x.dtype)], axis=1)
+        inside = (_pos(T)[None, :] + w) < lens[:, None]
+        cols.append(jnp.where(inside, shifted, pad))
+    out = jnp.stack(cols, axis=-1)
+    valid = _valid_mask(lens, T)
+    return {"Out": [jnp.where(valid[..., None], out, pad)]}
+
+
+@register_op("sequence_reshape", inputs=["X", "SeqLens"],
+             outputs=["Out", "OutLens"], no_grad_slots=("SeqLens",),
+             stateful_out_slots=("OutLens",))
+def _sequence_reshape(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_reshape_op.cc: re-chunk each row's valid
+    region (len*D elements, contiguous in the padded row-major layout)
+    into new_dim-wide steps."""
+    x, lens = ins["X"][0], ins["SeqLens"][0]
+    new_dim = int(attrs["new_dim"])
+    B, T, D = x.shape[0], x.shape[1], x.shape[-1]
+    total = T * D
+    if total % new_dim:
+        raise ValueError("T*D=%d not divisible by new_dim=%d" % (total, new_dim))
+    out = x.reshape(B, total // new_dim, new_dim)
+    # per-row ceil: a row whose len*D is not divisible by new_dim keeps a
+    # zero-padded final step instead of silently dropping valid elements
+    # (the reference op raises on non-divisible rows; raising on traced
+    # lengths is impossible under jit)
+    newlens = -((lens * D) // -new_dim)
+    mask = _bcast(_valid_mask(newlens, out.shape[1]), out)
+    return {"Out": [jnp.where(mask, out, 0)],
+            "OutLens": [newlens.astype(jnp.int32)]}
+
+
+@register_op("sequence_scatter", inputs=["X", "Ids", "Updates", "UpdLens"],
+             outputs=["Out"], no_grad_slots=("Ids", "UpdLens"))
+def _sequence_scatter(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_scatter_op.cc: per-row scatter-add of
+    updates into the time axis at the given indices."""
+    x, ids, upd, ulens = (ins["X"][0], ins["Ids"][0], ins["Updates"][0],
+                          ins["UpdLens"][0])
+    U = ids.shape[1]
+    mask = _pos(U)[None, :] < ulens[:, None]
+    upd = jnp.where(_bcast(mask, upd), upd, 0)
+    ids = jnp.where(mask, ids, 0)  # masked updates are zero, index 0 is safe
+    one_hot = jax.nn.one_hot(ids, x.shape[1], dtype=x.dtype)  # [B, U, T]
+    add = jnp.einsum("but,bu...->bt...", one_hot, upd)
+    return {"Out": [x + add]}
+
+
+@register_op("sequence_conv", inputs=["X", "SeqLens", "Filter"],
+             outputs=["Out"], no_grad_slots=("SeqLens",))
+def _sequence_conv(ctx, ins, attrs):
+    """cf. sequence_ops/sequence_conv_op.cc + math/context_project.h: stack
+    a context window around each step (zero beyond the valid region) and
+    project.  Filter: [context_length * D, M]."""
+    x, lens, filt = ins["X"][0], ins["SeqLens"][0], ins["Filter"][0]
+    ctx_len = int(attrs.get("context_length", 3))
+    ctx_start = int(attrs.get("context_start", -(ctx_len - 1) // 2))
+    B, T, D = x.shape
+    valid = _valid_mask(lens, T)
+    xz = jnp.where(valid[..., None], x, 0)
+    cols = []
+    for w in range(ctx_len):
+        shift = ctx_start + w
+        rolled = jnp.roll(xz, -shift, axis=1)
+        pos = _pos(T)[None, :] + shift
+        inside = (pos >= 0) & (pos < lens[:, None])
+        cols.append(jnp.where(inside[..., None], rolled, 0))
+    stacked = jnp.concatenate(cols, axis=-1)  # [B, T, ctx_len*D]
+    out = jnp.einsum("btc,cm->btm", stacked, filt)
+    return {"Out": [jnp.where(valid[..., None], out, 0)]}

@@ -1,0 +1,449 @@
+"""Sequence/RNN/beam-search op tests: numpy oracles + finite-diff grads.
+
+Mirrors reference tests: tests/unittests/sequence/test_sequence_*.py,
+test_lstm_op.py, test_gru_op.py, test_beam_search_op.py (OpTest pattern:
+outputs vs numpy, analytic vs numeric grads).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+from op_test import check_grad, check_output, run_single_op
+
+
+def _lens_mask(lens, T):
+    return np.arange(T)[None, :] < np.asarray(lens)[:, None]
+
+
+class TestSequenceOps:
+    def test_sequence_mask(self):
+        lens = np.array([2, 0, 4], np.int32)
+        exp = _lens_mask(lens, 5).astype(np.int64)
+        check_output("sequence_mask", {"X": lens},
+                     {"maxlen": 5, "out_dtype": "int64"}, {"Y": exp})
+
+    @pytest.mark.parametrize("ptype", ["SUM", "AVERAGE", "SQRT", "MAX",
+                                       "LAST", "FIRST"])
+    def test_sequence_pool(self, rng, ptype):
+        x = rng.randn(3, 5, 4).astype(np.float32)
+        lens = np.array([2, 5, 1], np.int32)
+        rows = []
+        for b in range(3):
+            v = x[b, :lens[b]]
+            if ptype == "SUM":
+                rows.append(v.sum(0))
+            elif ptype == "AVERAGE":
+                rows.append(v.mean(0))
+            elif ptype == "SQRT":
+                rows.append(v.sum(0) / np.sqrt(lens[b]))
+            elif ptype == "MAX":
+                rows.append(v.max(0))
+            elif ptype == "LAST":
+                rows.append(v[-1])
+            else:
+                rows.append(v[0])
+        check_output("sequence_pool", {"X": x, "SeqLens": lens},
+                     {"pooltype": ptype}, {"Out": np.stack(rows)},
+                     rtol=1e-5, atol=1e-5)
+
+    def test_sequence_pool_grad(self, rng):
+        x = rng.randn(2, 4, 3).astype(np.float64)
+        lens = np.array([3, 2], np.int32)
+        check_grad("sequence_pool", {"X": x, "SeqLens": lens},
+                   {"pooltype": "AVERAGE"}, ["Out"], ["X"])
+
+    def test_sequence_softmax(self, rng):
+        x = rng.randn(2, 6).astype(np.float32)
+        lens = np.array([4, 6], np.int32)
+        exp = np.zeros_like(x)
+        for b in range(2):
+            v = x[b, :lens[b]]
+            e = np.exp(v - v.max())
+            exp[b, :lens[b]] = e / e.sum()
+        check_output("sequence_softmax", {"X": x, "SeqLens": lens}, {},
+                     {"Out": exp}, rtol=1e-5, atol=1e-6)
+
+    def test_sequence_reverse(self, rng):
+        x = rng.randn(2, 5, 3).astype(np.float32)
+        lens = np.array([3, 5], np.int32)
+        exp = x.copy()
+        for b in range(2):
+            exp[b, :lens[b]] = x[b, :lens[b]][::-1]
+        check_output("sequence_reverse", {"X": x, "SeqLens": lens}, {},
+                     {"Y": exp})
+
+    def test_sequence_expand_as(self, rng):
+        x = rng.randn(3, 4).astype(np.float32)
+        y = np.zeros((3, 5, 1), np.float32)
+        lens = np.array([2, 0, 5], np.int32)
+        exp = np.zeros((3, 5, 4), np.float32)
+        for b in range(3):
+            exp[b, :lens[b]] = x[b]
+        check_output("sequence_expand_as",
+                     {"X": x, "Y": y, "SeqLens": lens}, {}, {"Out": exp})
+
+    def test_sequence_expand(self, rng):
+        x = rng.randn(2, 3).astype(np.float32)
+        ref = np.array([2, 1], np.int32)
+        exp = np.zeros((2, 4, 3), np.float32)
+        exp[0, :2] = x[0]
+        exp[1, :1] = x[1]
+        check_output("sequence_expand", {"X": x, "RefLens": ref},
+                     {"max_ref_len": 4}, {"Out": exp})
+
+    def test_sequence_concat(self, rng):
+        a = rng.randn(2, 3, 2).astype(np.float32)
+        b = rng.randn(2, 2, 2).astype(np.float32)
+        la = np.array([1, 3], np.int32)
+        lb = np.array([2, 1], np.int32)
+        exp = np.zeros((2, 5, 2), np.float32)
+        explens = la + lb
+        for i in range(2):
+            cat = np.concatenate([a[i, :la[i]], b[i, :lb[i]]])
+            exp[i, :len(cat)] = cat
+        outs, _ = run_single_op("sequence_concat",
+                                {"X": [a, b], "SeqLens": [la, lb]}, {},
+                                ["Out", "OutLens"])
+        np.testing.assert_allclose(outs["Out"], exp, rtol=1e-6)
+        np.testing.assert_array_equal(outs["OutLens"], explens)
+
+    def test_sequence_pad_unpad(self, rng):
+        x = rng.randn(2, 3, 2).astype(np.float32)
+        lens = np.array([2, 3], np.int32)
+        outs, _ = run_single_op(
+            "sequence_pad", {"X": x, "SeqLens": lens},
+            {"padded_length": 5, "pad_value": -1.0}, ["Out", "Length"])
+        assert outs["Out"].shape == (2, 5, 2)
+        np.testing.assert_allclose(outs["Out"][0, :2], x[0, :2])
+        assert (outs["Out"][0, 2:] == -1.0).all()
+        np.testing.assert_array_equal(outs["Length"], lens)
+        up, _ = run_single_op(
+            "sequence_unpad",
+            {"X": outs["Out"], "Length": lens.astype(np.int64)}, {}, ["Out"])
+        assert (up["Out"][0, 2:] == 0).all()
+        np.testing.assert_allclose(up["Out"][1, :3], x[1, :3])
+
+    def test_sequence_slice(self, rng):
+        x = rng.randn(2, 6, 2).astype(np.float32)
+        off = np.array([1, 3], np.int32)
+        ln = np.array([2, 3], np.int32)
+        exp = np.zeros((2, 6, 2), np.float32)
+        exp[0, :2] = x[0, 1:3]
+        exp[1, :3] = x[1, 3:6]
+        check_output("sequence_slice",
+                     {"X": x, "Offset": off, "Length": ln}, {}, {"Out": exp})
+
+    def test_sequence_erase(self):
+        x = np.array([[1, 2, 3, 2, 5], [2, 2, 2, 7, 0]], np.int64)
+        lens = np.array([5, 4], np.int32)
+        outs, _ = run_single_op(
+            "sequence_erase", {"X": x, "SeqLens": lens}, {"tokens": [2]},
+            ["Out", "OutLens"])
+        np.testing.assert_array_equal(outs["Out"][0, :3], [1, 3, 5])
+        np.testing.assert_array_equal(outs["Out"][1, :1], [7])
+        np.testing.assert_array_equal(outs["OutLens"], [3, 1])
+
+    def test_sequence_enumerate(self):
+        x = np.array([[1, 2, 3, 4, 0]], np.int64)
+        lens = np.array([4], np.int32)
+        outs, _ = run_single_op(
+            "sequence_enumerate", {"X": x, "SeqLens": lens},
+            {"win_size": 2, "pad_value": 0}, ["Out"])
+        np.testing.assert_array_equal(
+            outs["Out"][0, :4], [[1, 2], [2, 3], [3, 4], [4, 0]])
+
+    def test_sequence_reshape(self, rng):
+        x = rng.randn(2, 4, 6).astype(np.float32)
+        lens = np.array([2, 4], np.int32)
+        outs, _ = run_single_op(
+            "sequence_reshape", {"X": x, "SeqLens": lens}, {"new_dim": 3},
+            ["Out", "OutLens"])
+        np.testing.assert_array_equal(outs["OutLens"], [4, 8])
+        np.testing.assert_allclose(
+            outs["Out"][0, :4].reshape(-1), x[0, :2].reshape(-1), rtol=1e-6)
+
+    def test_sequence_scatter(self, rng):
+        x = rng.randn(2, 5, 3).astype(np.float32)
+        ids = np.array([[1, 3], [0, 0]], np.int64)
+        upd = rng.randn(2, 2, 3).astype(np.float32)
+        ulens = np.array([2, 1], np.int32)
+        exp = x.copy()
+        exp[0, 1] += upd[0, 0]
+        exp[0, 3] += upd[0, 1]
+        exp[1, 0] += upd[1, 0]
+        check_output("sequence_scatter",
+                     {"X": x, "Ids": ids, "Updates": upd, "UpdLens": ulens},
+                     {}, {"Out": exp}, rtol=1e-5, atol=1e-5)
+
+    def test_sequence_conv(self, rng):
+        x = rng.randn(1, 4, 2).astype(np.float32)
+        lens = np.array([3], np.int32)
+        filt = rng.randn(6, 3).astype(np.float32)  # ctx=3, D=2 -> [6, M=3]
+        # oracle: context window [-1, 0, 1], zeros outside valid region
+        xz = x.copy()
+        xz[0, 3:] = 0
+        exp = np.zeros((1, 4, 3), np.float32)
+        for t in range(3):
+            win = []
+            for s in (-1, 0, 1):
+                p = t + s
+                win.append(xz[0, p] if 0 <= p < 3 else np.zeros(2, np.float32))
+            exp[0, t] = np.concatenate(win) @ filt
+        check_output("sequence_conv",
+                     {"X": x, "SeqLens": lens, "Filter": filt},
+                     {"context_length": 3, "context_start": -1},
+                     {"Out": exp}, rtol=1e-5, atol=1e-5)
+
+
+def _np_lstm(x4, W, b, lens, peep=None):
+    """Oracle LSTM, gate order {c~, i, f, o}."""
+    B, T, D4 = x4.shape
+    D = D4 // 4
+    sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+    h = np.zeros((B, D)); c = np.zeros((B, D))
+    hs = np.zeros((B, T, D)); cs = np.zeros((B, T, D))
+    for t in range(T):
+        g = x4[:, t] + h @ W + b[..., :4 * D]
+        gc, gi, gf, go = np.split(g, 4, axis=-1)
+        c_new = np.tanh(gc) * sig(gi) + c * sig(gf)
+        h_new = sig(go) * np.tanh(c_new)
+        m = (t < lens)[:, None]
+        h = np.where(m, h_new, h); c = np.where(m, c_new, c)
+        hs[:, t] = np.where(m, h_new, 0); cs[:, t] = np.where(m, c_new, 0)
+    return hs, cs, h, c
+
+
+class TestRNNOps:
+    def test_lstm_matches_numpy(self, rng):
+        B, T, D = 2, 5, 3
+        x4 = rng.randn(B, T, 4 * D).astype(np.float32) * 0.5
+        W = rng.randn(D, 4 * D).astype(np.float32) * 0.3
+        b = rng.randn(1, 4 * D).astype(np.float32) * 0.1
+        lens = np.array([3, 5], np.int32)
+        hs, cs, lh, lc = _np_lstm(x4, W, b, lens)
+        outs, _ = run_single_op(
+            "lstm", {"Input": x4, "Weight": W, "Bias": b, "SeqLens": lens},
+            {}, ["Hidden", "Cell", "LastH", "LastC"])
+        np.testing.assert_allclose(outs["Hidden"], hs, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(outs["Cell"], cs, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(outs["LastH"], lh, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(outs["LastC"], lc, rtol=1e-5, atol=1e-5)
+
+    def test_lstm_grad(self, rng):
+        B, T, D = 2, 3, 2
+        x4 = rng.randn(B, T, 4 * D).astype(np.float64) * 0.5
+        W = rng.randn(D, 4 * D).astype(np.float64) * 0.3
+        b = np.zeros((1, 4 * D))
+        lens = np.array([2, 3], np.int32)
+        check_grad("lstm",
+                   {"Input": x4, "Weight": W, "Bias": b, "SeqLens": lens},
+                   {}, ["Hidden"], ["Input", "Weight"], rtol=1e-2, atol=1e-3)
+
+    def test_lstm_reverse_runs(self, rng):
+        x4 = rng.randn(2, 4, 8).astype(np.float32)
+        W = rng.randn(2, 8).astype(np.float32) * 0.3
+        lens = np.array([2, 4], np.int32)
+        outs, _ = run_single_op(
+            "lstm", {"Input": x4, "Weight": W, "SeqLens": lens},
+            {"is_reverse": True}, ["Hidden", "Cell", "LastH", "LastC"])
+        assert (outs["Hidden"][0, 2:] == 0).all()  # padding stays zero
+        assert np.isfinite(outs["LastH"]).all()
+
+    def test_gru_matches_numpy(self, rng):
+        B, T, D = 2, 4, 3
+        x3 = rng.randn(B, T, 3 * D).astype(np.float32) * 0.5
+        W = rng.randn(D, 3 * D).astype(np.float32) * 0.3
+        lens = np.array([4, 2], np.int32)
+        sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+        h = np.zeros((B, D)); hs = np.zeros((B, T, D))
+        for t in range(T):
+            xu, xr, xc = np.split(x3[:, t], 3, axis=-1)
+            u = sig(xu + h @ W[:, :D])
+            r = sig(xr + h @ W[:, D:2 * D])
+            c = np.tanh(xc + (r * h) @ W[:, 2 * D:])
+            h_new = (1 - u) * h + u * c
+            m = (t < lens)[:, None]
+            h = np.where(m, h_new, h)
+            hs[:, t] = np.where(m, h_new, 0)
+        outs, _ = run_single_op(
+            "gru", {"Input": x3, "Weight": W, "SeqLens": lens}, {},
+            ["Hidden", "LastH"])
+        np.testing.assert_allclose(outs["Hidden"], hs, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(outs["LastH"], h, rtol=1e-5, atol=1e-5)
+
+    def test_gru_grad(self, rng):
+        x3 = rng.randn(2, 3, 6).astype(np.float64) * 0.5
+        W = rng.randn(2, 6).astype(np.float64) * 0.3
+        lens = np.array([3, 2], np.int32)
+        check_grad("gru", {"Input": x3, "Weight": W, "SeqLens": lens}, {},
+                   ["Hidden"], ["Input", "Weight"], rtol=1e-2, atol=1e-3)
+
+    def test_lstm_unit_forget_bias(self, rng):
+        B, D = 2, 3
+        x = rng.randn(B, 4 * D).astype(np.float32) * 0.5
+        W = rng.randn(D, 4 * D).astype(np.float32) * 0.3
+        h0 = rng.randn(B, D).astype(np.float32) * 0.5
+        c0 = rng.randn(B, D).astype(np.float32) * 0.5
+        sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+        g = x + h0 @ W
+        gc, gi, gf, go = np.split(g, 4, axis=-1)
+        c_exp = np.tanh(gc) * sig(gi) + c0 * sig(gf + 1.0)
+        h_exp = sig(go) * np.tanh(c_exp)
+        outs, _ = run_single_op(
+            "lstm_unit", {"X": x, "HPrev": h0, "CPrev": c0, "Weight": W},
+            {"forget_bias": 1.0}, ["H", "C"])
+        np.testing.assert_allclose(outs["H"], h_exp, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(outs["C"], c_exp, rtol=1e-5, atol=1e-5)
+
+
+class TestBeamSearch:
+    def test_one_step(self):
+        # B=1, beam=2, V=4; beam 1 finished (id==end_id==0)
+        pre_ids = np.array([[3, 0]], np.int64)
+        pre_scores = np.array([[-1.0, -0.5]], np.float32)
+        scores = np.log(np.array([[[0.1, 0.4, 0.3, 0.2],
+                                   [0.25, 0.25, 0.25, 0.25]]], np.float32))
+        scores = pre_scores[..., None] + scores  # accumulated
+        outs, _ = run_single_op(
+            "beam_search",
+            {"PreIds": pre_ids, "PreScores": pre_scores, "Scores": scores},
+            {"beam_size": 2, "end_id": 0, "is_accumulated": True},
+            ["SelectedIds", "SelectedScores", "ParentIdx"])
+        # finished beam keeps (end_id, -0.5); live beam's best is id 1
+        assert outs["SelectedScores"][0, 0] == pytest.approx(-0.5)
+        assert outs["SelectedIds"][0, 0] == 0
+        assert outs["ParentIdx"][0, 0] == 1
+        assert outs["SelectedIds"][0, 1] == 1
+        assert outs["ParentIdx"][0, 1] == 0
+        assert outs["SelectedScores"][0, 1] == pytest.approx(
+            -1.0 + np.log(0.4), rel=1e-5)
+
+    def test_decode_backtrack(self):
+        # T=3, B=1, beam=2: trace parents backwards
+        ids = np.array([[[5, 6]], [[7, 8]], [[9, 10]]], np.int64)
+        parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int64)
+        scores = np.array([[-1.0, -2.0]], np.float32)
+        outs, _ = run_single_op(
+            "beam_search_decode",
+            {"Ids": ids, "Parents": parents, "FinalScores": scores}, {},
+            ["SentenceIds", "SentenceScores"])
+        # beam 0 at t=2: token 9, parent 0 -> t=1 token 7, parent 1 ->
+        # t=0 token 6
+        np.testing.assert_array_equal(outs["SentenceIds"][0, 0], [6, 7, 9])
+        # beam 1 at t=2: token 10, parent 1 -> t=1 token 8, parent 0 ->
+        # t=0 token 5
+        np.testing.assert_array_equal(outs["SentenceIds"][0, 1], [5, 8, 10])
+
+
+class TestStaticRNN:
+    def test_tanh_rnn_matches_numpy_and_trains(self, rng):
+        """StaticRNN h_t = tanh(x_t W + h_{t-1} U): forward oracle + grads
+        flow (cf. reference test_recurrent_op.py)."""
+        T, B, D = 4, 2, 3
+        xv = rng.randn(T, B, D).astype(np.float32) * 0.5
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[T, B, D], append_batch_size=False)
+            x.stop_gradient = False
+            h0 = layers.fill_constant([B, D], "float32", 0.0)
+            srnn = layers.StaticRNN()
+            with srnn.step():
+                xt = srnn.step_input(x)
+                hp = srnn.memory(init=h0)
+                h = layers.tanh(
+                    layers.elementwise_add(
+                        layers.fc(xt, D, bias_attr=False,
+                                  param_attr=fluid.ParamAttr(name="W")),
+                        layers.fc(hp, D, bias_attr=False,
+                                  param_attr=fluid.ParamAttr(name="U"))))
+                srnn.update_memory(hp, h)
+                srnn.step_output(h)
+            out = srnn()
+            loss = layers.reduce_sum(out)
+            fluid.append_backward(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        o, W, U, dx = exe.run(
+            main, feed={"x": xv},
+            fetch_list=[out, "W", "U", "x@GRAD"])
+        # numpy oracle
+        h = np.zeros((B, D), np.float32)
+        exp = []
+        for t in range(T):
+            h = np.tanh(xv[t] @ W + h @ U)
+            exp.append(h)
+        np.testing.assert_allclose(o, np.stack(exp), rtol=1e-4, atol=1e-5)
+        # finite-difference grad spot check on one element
+        eps = 1e-3
+        def loss_at(xp):
+            h = np.zeros((B, D), np.float32); s = 0.0
+            for t in range(T):
+                h = np.tanh(xp[t] @ W + h @ U)
+                s += h.sum()
+            return s
+        xp = xv.copy(); xp[1, 0, 1] += eps
+        xm = xv.copy(); xm[1, 0, 1] -= eps
+        num = (loss_at(xp) - loss_at(xm)) / (2 * eps)
+        assert dx[1, 0, 1] == pytest.approx(num, rel=2e-2, abs=1e-3)
+
+
+class TestRNNLayers:
+    def test_dynamic_lstm_layer(self, rng):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[4, 12], append_batch_size=True)
+            lens = layers.data("lens", shape=[], dtype="int32",
+                               append_batch_size=True)
+            h, c = layers.dynamic_lstm(x, size=12, seq_lens=lens)
+            out = layers.reduce_mean(h)
+        exe = fluid.Executor()
+        exe.run(startup)
+        r, = exe.run(main, feed={
+            "x": rng.randn(2, 4, 12).astype(np.float32),
+            "lens": np.array([2, 4], np.int32)}, fetch_list=[out])
+        assert np.isfinite(r).all()
+
+    def test_rnn_runner_with_cell(self, rng):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[3, 4], append_batch_size=True)
+            lens = layers.data("lens", shape=[], dtype="int32",
+                               append_batch_size=True)
+            cell = layers.GRUCell(hidden_size=5)
+            out, states = layers.rnn(cell, x, sequence_length=lens)
+            m = layers.reduce_mean(out)
+        exe = fluid.Executor()
+        exe.run(startup)
+        o, s = exe.run(main, feed={
+            "x": rng.randn(2, 3, 4).astype(np.float32),
+            "lens": np.array([1, 3], np.int32)}, fetch_list=[out, m])
+        assert o.shape == (2, 3, 5)
+        # masked: row 0 steps 1,2 are zero
+        assert (np.abs(o[0, 1:]) == 0).all()
+        # the cell's weights are shared across time: exactly one input
+        # projection + one hidden weight + one bias parameter
+        from paddle_tpu.fluid.framework import Parameter
+        params = [v for v in main.global_block.vars.values()
+                  if isinstance(v, Parameter)]
+        assert len(params) == 3, [p.name for p in params]
+
+    def test_cell_named_param_attr_no_collision(self, rng):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[3, 4], append_batch_size=True)
+            cell = layers.LSTMCell(
+                hidden_size=5, param_attr=fluid.ParamAttr(name="cellw"))
+            out, _ = layers.rnn(cell, x)
+            m = layers.reduce_mean(out)
+        exe = fluid.Executor()
+        exe.run(startup)
+        r, = exe.run(main, feed={"x": rng.randn(2, 3, 4).astype(np.float32)},
+                     fetch_list=[m])
+        assert np.isfinite(r).all()
+        from paddle_tpu.fluid.framework import Parameter
+        names = {v.name for v in main.global_block.vars.values()
+                 if isinstance(v, Parameter)}
+        assert "cellw_x" in names and "cellw_h" in names
