@@ -40,6 +40,11 @@ from .framework import (  # noqa: F401
     program_guard,
 )
 from .layer_helper import ParamAttr  # noqa: F401
+from .compiler import (  # noqa: F401
+    BuildStrategy,
+    CompiledProgram,
+    ExecutionStrategy,
+)
 from . import dygraph  # noqa: F401  (after core symbols: dygraph imports them)
 from . import contrib, metrics, profiler  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401

@@ -33,7 +33,10 @@ from .core.registry import LowerContext, get_op_def, register_op
 #        non-stateful), grad_in_slots (subset receiving gradients)
 
 
-@register_op("vjp_grad", inputs=[], outputs=[], grad=None)
+# grad="auto": differentiating a vjp_grad op (VJP of a VJP, both pure JAX)
+# is how double-grad works — cf. reference double_grad makers
+# (`imperative/partial_grad_engine.cc`, per-op *GradGrad ops).
+@register_op("vjp_grad", inputs=[], outputs=[], grad="auto")
 def _vjp_grad(ctx, ins, attrs):
     fwd_def = get_op_def(attrs["fwd_type"])
     fwd_attrs = attrs["fwd_attrs"]
@@ -168,13 +171,26 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
     Matches reference `backward.py:1193` semantics: honors stop_gradient and
     no_grad_set, sums multi-consumer gradients, names grads `<var>@GRAD`.
     """
+    return _append_backward_for_targets(
+        [loss], [None], parameter_list=parameter_list, no_grad_set=no_grad_set
+    )
+
+
+def _append_backward_for_targets(
+    targets, target_gradients, parameter_list=None, no_grad_set=None,
+    return_map=False,
+):
+    """Shared engine behind append_backward / gradients (reference
+    `backward.py:1601` calc_gradient): seeds each target with the provided
+    output gradient (or ones), then runs one reverse sweep."""
+    loss = targets[0]
     block = loss.block
     program = block.program
     no_grad = set(no_grad_set or ())
     first_backward_op_idx = len(block.ops)
 
-    # 1. ops relevant to the loss (backward data-flow reachability)
-    needed = {loss.name}
+    # 1. ops relevant to the targets (backward data-flow reachability)
+    needed = {t.name for t in targets}
     relevant = []
     for op in reversed(block.ops):
         if any(n in needed for n in op.all_output_names()):
@@ -184,17 +200,22 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
 
     # 2. partial-grad bookkeeping
     partials: dict[str, list[str]] = {}
-    uniq = [0]
 
     def new_grad_name(var_name):
         lst = partials.setdefault(var_name, [])
         base = framework.grad_var_name(var_name)
-        name = base if not lst else base + "@RENAME@" + str(uniq[0])
-        uniq[0] += 1
+        # a fresh name when the canonical one is taken (second sweep for
+        # double-grad, or multiple partials) — SSA, never redefine a var
+        if not lst and not block.has_var(base):
+            name = base
+        else:
+            name = framework.unique_name.generate(base + "@RENAME")
         lst.append(name)
         v = block._find_var_recursive(var_name)
+        # stop_gradient=False: grad vars stay differentiable so a second
+        # reverse sweep (double-grad) can chain through them.
         block.create_var(
-            name=name, shape=v.shape, dtype=v.dtype, stop_gradient=True
+            name=name, shape=v.shape, dtype=v.dtype, stop_gradient=False
         )
         return name
 
@@ -205,31 +226,43 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
         if len(lst) == 1:
             return lst[0]
         total = framework.grad_var_name(var_name) + "@SUM"
+        if block.has_var(total):  # a previous sweep already used this name
+            total = framework.unique_name.generate(total)
         v = block._find_var_recursive(var_name)
-        block.create_var(name=total, shape=v.shape, dtype=v.dtype, stop_gradient=True)
+        block.create_var(name=total, shape=v.shape, dtype=v.dtype, stop_gradient=False)
         block.append_op(
             "sum", inputs={"X": list(lst)}, outputs={"Out": [total]}, infer=False
         )
         partials[var_name] = [total]
         return total
 
-    # 3. seed: d loss / d loss = 1
-    loss_grad = framework.grad_var_name(loss.name)
-    block.create_var(
-        name=loss_grad, shape=loss.shape, dtype=loss.dtype, stop_gradient=True
-    )
-    block.append_op(
-        "fill_constant",
-        inputs={},
-        outputs={"Out": [loss_grad]},
-        attrs={
-            "shape": list(loss.shape),
-            "value": 1.0,
-            "dtype": loss.dtype,
-        },
-        infer=False,
-    )
-    partials[loss.name] = [loss_grad]
+    # 3. seed each target: provided output grad, else d target/d target = 1
+    for t, tg in zip(targets, target_gradients):
+        if tg is not None:
+            if tuple(tg.shape) != tuple(t.shape):
+                raise ValueError(
+                    "target_gradient %s shape %s does not match target %s "
+                    "shape %s" % (tg.name, tg.shape, t.name, t.shape)
+                )
+            partials.setdefault(t.name, []).append(tg.name)
+            continue
+        t_grad = framework.grad_var_name(t.name)
+        if not block.has_var(t_grad):
+            block.create_var(
+                name=t_grad, shape=t.shape, dtype=t.dtype, stop_gradient=True
+            )
+        block.append_op(
+            "fill_constant",
+            inputs={},
+            outputs={"Out": [t_grad]},
+            attrs={
+                "shape": list(t.shape),
+                "value": 1.0,
+                "dtype": t.dtype,
+            },
+            infer=False,
+        )
+        partials.setdefault(t.name, []).append(t_grad)
 
     def wants_grad(var_name, slot, opdef):
         if slot in opdef.no_grad_slots or var_name in no_grad:
@@ -315,8 +348,10 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
         )
 
     # 5. sum any remaining multi-partial leaf grads so `<var>@GRAD` is total
-    #    (cf. reference _addup_repetitive_outputs_)
-    for var_name in list(partials):
+    #    (cf. reference _addup_repetitive_outputs_).  Skipped for the
+    #    calc_gradient path (return_map): redefining the canonical name would
+    #    clobber an earlier sweep's grads under double-grad.
+    for var_name in [] if return_map else list(partials):
         if len(partials[var_name]) > 1:
             total = get_total_grad(var_name)
             # expose under the canonical @GRAD name
@@ -339,6 +374,13 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
     for op in block.ops[first_backward_op_idx:]:
         op.attrs.setdefault("op_role", "backward")
 
+    if return_map:
+        gmap = {name: get_total_grad(name) for name in list(partials)}
+        # tag the sum ops get_total_grad just emitted, too
+        for op in block.ops[first_backward_op_idx:]:
+            op.attrs.setdefault("op_role", "backward")
+        return gmap
+
     # 6. collect (param, grad) pairs
     if parameter_list is not None:
         params = [
@@ -357,19 +399,32 @@ def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None)
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    """cf. reference backward.py:1727 — grads of targets w.r.t. inputs."""
+    """cf. reference backward.py:1727 / calc_gradient:1601 — grads of
+    (possibly several) targets w.r.t. inputs, with optional provided output
+    gradients.  Calling it on the result of a previous call yields
+    double-grad (the emitted vjp_grad ops are themselves differentiable).
+    """
     if not isinstance(targets, (list, tuple)):
         targets = [targets]
     if not isinstance(inputs, (list, tuple)):
         inputs = [inputs]
-    assert len(targets) == 1, "gradients(): single target supported"
-    loss = targets[0]
-    pairs = append_backward(
-        loss, parameter_list=None, no_grad_set=no_grad_set
+    if target_gradients is None:
+        target_gradients = [None] * len(targets)
+    if not isinstance(target_gradients, (list, tuple)):
+        target_gradients = [target_gradients]
+    if len(target_gradients) != len(targets):
+        raise ValueError(
+            "gradients(): %d targets but %d target_gradients"
+            % (len(targets), len(target_gradients))
+        )
+    block = targets[0].block
+    grad_map = _append_backward_for_targets(
+        list(targets), list(target_gradients),
+        parameter_list=[], no_grad_set=no_grad_set,
+        return_map=True,
     )
-    block = loss.block
     out = []
     for iv in inputs:
-        gname = framework.grad_var_name(iv.name)
-        out.append(block.var(gname) if block.has_var(gname) else None)
+        gname = grad_map.get(iv.name)
+        out.append(block.var(gname) if gname is not None else None)
     return out

@@ -29,11 +29,20 @@ from .core.scope import Scope, global_scope
 class _LoweredBlock:
     """A compiled (feed, state, key) -> (fetch, new_state) executable."""
 
-    def __init__(self, program, block, feed_names, fetch_names, scope):
+    def __init__(self, program, block, feed_names, fetch_names, scope,
+                 dp_devices=None):
         import jax
 
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
+        # single-process data parallel (CompiledProgram.with_data_parallel):
+        # a 1-axis GSPMD mesh; feeds shard on dim 0, state replicates
+        self.dp_mesh = None
+        if dp_devices:
+            import numpy as _np
+            from jax.sharding import Mesh
+
+            self.dp_mesh = Mesh(_np.array(dp_devices), ("dp",))
         ops = block.ops
 
         produced = set()
@@ -146,7 +155,10 @@ class Executor:
 
         program = program or framework.default_main_program()
         # CompiledProgram facade (compiler.py) unwraps to its program + config
+        dp_devices = None
         if hasattr(program, "_unwrap_for_executor"):
+            if hasattr(program, "_dp_devices"):
+                dp_devices = program._dp_devices()
             program = program._unwrap_for_executor()
         feed = dict(feed or {})
         scope = scope or global_scope()
@@ -174,17 +186,41 @@ class Executor:
             feed_sig,
             tuple(fetch_names),
             id(scope),
+            tuple(id(d) for d in dp_devices) if dp_devices else None,
         )
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
-            entry = _LoweredBlock(program, block, list(feed_vals), fetch_names, scope)
+            entry = _LoweredBlock(
+                program, block, list(feed_vals), fetch_names, scope,
+                dp_devices=dp_devices,
+            )
             if use_program_cache:
                 self._cache[key] = entry
 
         donate_state = {n: scope.find_var(n) for n in entry.state_donate}
         ro_state = {n: scope.find_var(n) for n in entry.state_ro}
-        device = self.place.get_device()
-        feed_dev = {n: jax.device_put(a, device) for n, a in feed_vals.items()}
+        if entry.dp_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = entry.dp_mesh
+            ndev = mesh.devices.size
+            repl = NamedSharding(mesh, P())
+
+            def _put_feed(a):
+                if a.ndim >= 1 and a.shape[0] > 0 and a.shape[0] % ndev == 0:
+                    return jax.device_put(a, NamedSharding(mesh, P("dp")))
+                return jax.device_put(a, repl)
+
+            feed_dev = {n: _put_feed(a) for n, a in feed_vals.items()}
+            donate_state = {
+                n: jax.device_put(v, repl) for n, v in donate_state.items()
+            }
+            ro_state = {n: jax.device_put(v, repl) for n, v in ro_state.items()}
+        else:
+            device = self.place.get_device()
+            feed_dev = {
+                n: jax.device_put(a, device) for n, a in feed_vals.items()
+            }
 
         seed = program.random_seed
         if seed is None:

@@ -381,14 +381,18 @@ def switch_case(branch_index, branch_fns, default=None):
     """cf. reference layers.switch_case."""
     from .tensor import fill_constant
 
+    items = (branch_fns.items() if isinstance(branch_fns, dict)
+             else enumerate(branch_fns))
+    # reference semantics: with no default, the branch with the LARGEST
+    # index is the fallback (not the last-listed one)
+    items = sorted(items, key=lambda kv: int(kv[0]))
     pairs = []
-    for idx, fn in (branch_fns.items() if isinstance(branch_fns, dict)
-                    else enumerate(branch_fns)):
+    for idx, fn in items:
         c = fill_constant([1], "int64", int(idx))
         from .tensor import equal
 
         pairs.append((equal(branch_index, c), fn))
-    return case(pairs, default or pairs[-1][1])
+    return case(pairs, default or items[-1][1])
 
 
 def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
