@@ -15,6 +15,7 @@ __all__ = [
     "sequence_pad", "sequence_unpad", "sequence_slice", "sequence_erase",
     "sequence_enumerate", "sequence_reshape", "sequence_scatter",
     "sequence_conv", "sequence_first_step", "sequence_last_step",
+    "segment_pool",
 ]
 
 
@@ -152,3 +153,11 @@ def sequence_conv(input, seq_lens, num_filters, filter_size=3,
             bias_attr, [num_filters], dtype=out.dtype, is_bias=True)
         out = helper.append_bias_op(out, b, axis=2)
     return helper.append_activation(out, act)
+
+
+def segment_pool(input, segment_ids, num_segments, pool_type="sum"):
+    """Pool per packed segment: [B, T, D] + [B, T] ids -> [B, N, D]
+    (in-graph LoD pooling; see ops/sequence_ops.py segment_pool)."""
+    return append_simple_op(
+        "segment_pool", {"X": input, "SegIds": segment_ids},
+        {"num_segments": int(num_segments), "pooltype": pool_type.upper()})

@@ -253,8 +253,9 @@ def _dropout_grad(ctx, ins, attrs):
 
 @register_op(
     "flash_attention",
-    inputs=["Q", "K", "V", "Bias"],
+    inputs=["Q", "K", "V", "Bias", "QSeg", "KSeg"],
     outputs=["Out"],
+    no_grad_slots=("QSeg", "KSeg"),
 )
 def _flash_attention(ctx, ins, attrs):
     """Fused scaled-dot-product attention.
@@ -267,18 +268,31 @@ def _flash_attention(ctx, ins, attrs):
     is the naive jnp composition XLA fuses on CPU.
 
     Q/K/V: [batch, heads, seq, head_dim]; optional Bias broadcastable to
-    [batch, heads, q_seq, k_seq] (additive, pre-softmax).  attrs: scale
+    [batch, heads, q_seq, k_seq] (additive, pre-softmax).  Optional
+    QSeg/KSeg: [batch, seq] int segment ids for packed batches (in-graph
+    LoD parity) — attention is confined to equal ids.  attrs: scale
     (default 1/sqrt(head_dim)), causal.
     """
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     bias = ins["Bias"][0] if ins.get("Bias") else None
+    qseg = ins["QSeg"][0] if ins.get("QSeg") else None
+    kseg = ins["KSeg"][0] if ins.get("KSeg") else None
+    if kseg is not None and qseg is None:
+        raise ValueError(
+            "flash_attention: KSeg without QSeg is meaningless (equality "
+            "masking needs both sides); feed QSeg too"
+        )
+    segment_ids = None
+    if qseg is not None:
+        segment_ids = (qseg, kseg if kseg is not None else qseg)
     scale = attrs.get("scale") or float(q.shape[-1]) ** -0.5
     causal = attrs.get("causal", False)
 
     from ...ops.attention import scaled_dot_product_attention
 
-    out = scaled_dot_product_attention(q, k, v, bias=bias, scale=scale,
-                                       causal=causal)
+    out = scaled_dot_product_attention(q, k, v, bias=bias,
+                                       segment_ids=segment_ids,
+                                       scale=scale, causal=causal)
     return {"Out": [out]}
 
 

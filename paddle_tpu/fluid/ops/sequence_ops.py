@@ -327,3 +327,43 @@ def _sequence_conv(ctx, ins, attrs):
     stacked = jnp.concatenate(cols, axis=-1)  # [B, T, ctx_len*D]
     out = jnp.einsum("btc,cm->btm", stacked, filt)
     return {"Out": [jnp.where(valid[..., None], out, 0)]}
+
+
+@register_op("segment_pool", inputs=["X", "SegIds"], outputs=["Out"],
+             no_grad_slots=("SegIds",))
+def _segment_pool(ctx, ins, attrs):
+    """Pool features per packed segment (in-graph LoD parity for pooling,
+    cf. reference sequence_pool over LoDTensor offsets,
+    `operators/sequence_ops/sequence_pool_op.cc`).
+
+    X: [B, T, D]; SegIds: [B, T] int, id s in [0, num_segments) selects a
+    segment, anything outside (e.g. padding marked -1 or >= N) is dropped.
+    attrs: num_segments (static), pooltype in SUM/AVERAGE/MAX/SQRT.
+    Out: [B, num_segments, D].
+
+    SUM/AVERAGE/SQRT lower to a one-hot matmul so the reduction runs on
+    the MXU; MAX uses a masked segment reduction.
+    """
+    x, seg = ins["X"][0], ins["SegIds"][0]
+    n = int(attrs["num_segments"])
+    pooltype = attrs.get("pooltype", "SUM").upper()
+    seg = seg.astype(jnp.int32)
+    valid = (seg >= 0) & (seg < n)
+    safe = jnp.where(valid, seg, 0)
+    one_hot = jax.nn.one_hot(safe, n, dtype=x.dtype) * valid[..., None]
+    if pooltype == "MAX":
+        big = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+        # [B, T, n, 1] mask against [B, T, 1, D] -> segment max over T
+        m = (one_hot > 0)[..., None]
+        vals = jnp.where(m, x[:, :, None, :], big)
+        out = jnp.max(vals, axis=1)
+        counts = jnp.einsum("btn->bn", one_hot)
+        return {"Out": [jnp.where(counts[..., None] > 0, out, 0)]}
+    out = jnp.einsum("btn,btd->bnd", one_hot, x)
+    if pooltype in ("AVERAGE", "MEAN", "SQRT"):
+        counts = jnp.einsum("btn->bn", one_hot)
+        denom = jnp.maximum(counts, 1.0)
+        if pooltype == "SQRT":
+            denom = jnp.sqrt(denom)
+        out = out / denom[..., None]
+    return {"Out": [out]}

@@ -1,0 +1,93 @@
+"""Sequence packing: LoD batches -> fixed-shape packed rows + segment ids.
+
+Capability parity: the reference carries variable-length batches as
+LoDTensor offset tables end to end (`framework/lod_tensor.h:52,104`).
+TPU-first redesign: XLA wants static shapes, so variable-length data is
+*packed* — several sequences concatenated into one fixed-length row — and
+the in-graph ops consume O(S) segment-id vectors instead of offset tables:
+`flash_attention` (QSeg/KSeg) confines attention to a segment,
+`segment_pool` pools per segment, positions restart per segment.  Packing
+wastes far less compute than padding when lengths vary (the padding is only
+the tail of each row, not per-sequence).
+
+Host-side (numpy) — runs in the reader/data pipeline, not in-graph.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["pack_sequences", "PackedBatch"]
+
+
+class PackedBatch:
+    """data [B, S, ...], segment_ids [B, S] (1-based, 0 = padding),
+    positions [B, S] (restart at 0 per segment), index: list per row of
+    (sequence_index, start, length)."""
+
+    def __init__(self, data, segment_ids, positions, index):
+        self.data = data
+        self.segment_ids = segment_ids
+        self.positions = positions
+        self.index = index
+
+    def __repr__(self):
+        return "PackedBatch(data=%s, rows=%d)" % (
+            self.data.shape, len(self.index)
+        )
+
+
+def pack_sequences(sequences, seq_len, pad_value=0, max_rows=None):
+    """Greedy first-fit-decreasing packing of variable-length sequences
+    into rows of length ``seq_len``.
+
+    sequences: list of 1-D (token ids) or 2-D ([T, D] features) arrays,
+    each with len <= seq_len (longer raises — never silently truncate).
+    Returns a :class:`PackedBatch`; segment ids are 1-based per row with 0
+    marking the padded tail, so they can feed `flash_attention`'s
+    QSeg/KSeg directly (padding attends only padding) and `segment_pool`
+    after subtracting 1.
+    """
+    seqs = [np.asarray(s) for s in sequences]
+    for i, s in enumerate(seqs):
+        if s.shape[0] > seq_len:
+            raise ValueError(
+                "sequence %d has length %d > seq_len %d (packing never "
+                "truncates; split or raise seq_len)" % (i, s.shape[0], seq_len)
+            )
+    order = sorted(range(len(seqs)), key=lambda i: -seqs[i].shape[0])
+    rows = []  # each: [used, [(orig_idx, seq), ...]]
+    for i in order:
+        s = seqs[i]
+        placed = False
+        for row in rows:
+            if row[0] + s.shape[0] <= seq_len:
+                row[1].append((i, s))
+                row[0] += s.shape[0]
+                placed = True
+                break
+        if not placed:
+            if max_rows is not None and len(rows) >= max_rows:
+                raise ValueError(
+                    "pack_sequences: need more than max_rows=%d rows"
+                    % max_rows
+                )
+            rows.append([s.shape[0], [(i, s)]])
+
+    feat_shape = seqs[0].shape[1:] if seqs and seqs[0].ndim > 1 else ()
+    B = len(rows) if max_rows is None else max_rows
+    data = np.full((B, seq_len) + feat_shape, pad_value,
+                   dtype=seqs[0].dtype if seqs else np.int64)
+    seg = np.zeros((B, seq_len), np.int32)
+    pos = np.zeros((B, seq_len), np.int32)
+    index = [[] for _ in range(B)]
+    for r, (_, items) in enumerate(rows):
+        cursor = 0
+        for s_rank, (orig_idx, s) in enumerate(items, start=1):
+            L = s.shape[0]
+            data[r, cursor:cursor + L] = s
+            seg[r, cursor:cursor + L] = s_rank
+            pos[r, cursor:cursor + L] = np.arange(L)
+            index[r].append((orig_idx, cursor, L))
+            cursor += L
+    return PackedBatch(data, seg, pos, index)

@@ -85,7 +85,8 @@ class MultiHeadAttention(dygraph.Layer):
         x = layers.reshape(x, [0, seq_len, self.n_head, self.d_head])
         return layers.transpose(x, [0, 2, 1, 3])
 
-    def forward(self, query, key=None, value=None, attn_bias=None, causal=False):
+    def forward(self, query, key=None, value=None, attn_bias=None,
+                causal=False, segment_ids=None):
         key = key if key is not None else query
         value = value if value is not None else key
         q_len = int(query.shape[1])
@@ -96,6 +97,21 @@ class MultiHeadAttention(dygraph.Layer):
         ins = {"Q": q, "K": k, "V": v}
         if attn_bias is not None:
             ins["Bias"] = attn_bias
+        if segment_ids is not None:
+            # packed batch: a [B, S] id array (self-attention) or a
+            # (q_seg, kv_seg) pair for cross-attention over packed memory;
+            # attention is confined to equal ids
+            if isinstance(segment_ids, (tuple, list)):
+                qseg, kseg = segment_ids
+            else:
+                if key is not query:
+                    raise ValueError(
+                        "cross-attention with packed segments needs a "
+                        "(q_seg, kv_seg) pair, got a single id array"
+                    )
+                qseg = kseg = segment_ids
+            ins["QSeg"] = qseg
+            ins["KSeg"] = kseg
         ctxv = append_simple_op(
             "flash_attention",
             ins,
@@ -121,8 +137,10 @@ class TransformerEncoderLayer(dygraph.Layer):
             cfg.hidden_dropout_prob, dropout_implementation="upscale_in_train"
         )
 
-    def forward(self, x, attn_bias=None):
-        h = self.ln1(x + self.attn(x, attn_bias=attn_bias))
+    def forward(self, x, attn_bias=None, segment_ids=None):
+        h = self.ln1(
+            x + self.attn(x, attn_bias=attn_bias, segment_ids=segment_ids)
+        )
         f = self.fc2(layers.gelu(self.fc1(h)))
         return self.ln2(h + self.dropout(f))
 
@@ -165,9 +183,13 @@ class BertModel(dygraph.Layer):
             cfg.hidden_size, cfg.hidden_size, act="tanh", param_attr=_winit(cfg)
         )
 
-    def forward(self, input_ids, token_type_ids, position_ids, attention_mask=None):
+    def forward(self, input_ids, token_type_ids, position_ids,
+                attention_mask=None, segment_ids=None):
         """attention_mask: [B, S] with 1 = attend, 0 = pad (reference input
-        convention); converted to an additive bias for the fused op."""
+        convention); converted to an additive bias for the fused op.
+        segment_ids: [B, S] int ids for packed batches (several sequences
+        per row, in-graph LoD parity) — attention stays within a segment;
+        feed per-segment restarting position_ids alongside."""
         attn_bias = None
         if attention_mask is not None:
             m = layers.cast(attention_mask, "float32")
@@ -175,7 +197,7 @@ class BertModel(dygraph.Layer):
             attn_bias = (m + (-1.0)) * 10000.0  # 0 -> -1e4, 1 -> 0
         h = self.embeddings(input_ids, token_type_ids, position_ids)
         for layer in self.encoder:
-            h = layer(h, attn_bias=attn_bias)
+            h = layer(h, attn_bias=attn_bias, segment_ids=segment_ids)
         pooled = self.pooler(h[:, 0] if _eager() else _first_token(h))
         return h, pooled
 
@@ -208,9 +230,11 @@ class BertForPretraining(dygraph.Layer):
         )
         self.nsp = dygraph.Linear(d, 2, param_attr=_winit(cfg))
 
-    def forward(self, input_ids, token_type_ids, position_ids, attention_mask=None):
+    def forward(self, input_ids, token_type_ids, position_ids,
+                attention_mask=None, segment_ids=None):
         seq, pooled = self.bert(
-            input_ids, token_type_ids, position_ids, attention_mask
+            input_ids, token_type_ids, position_ids, attention_mask,
+            segment_ids=segment_ids,
         )
         h = self.mlm_ln(self.mlm_transform(seq))
         logits = layers.matmul(

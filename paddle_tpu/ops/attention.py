@@ -5,14 +5,29 @@ The naive composition materializes the [B, H, S, S] score matrix in HBM —
 fine for short S, quadratic HBM traffic for long S.  The pallas kernel
 (flash attention, cf. PAPERS.md) streams K/V blocks through VMEM with an
 online softmax so HBM traffic stays linear in S.
+
+Packed batches (in-graph LoD parity, reference `framework/lod_tensor.h:52`):
+`segment_ids` confines attention to tokens with equal ids — the pallas path
+rebuilds the mask blockwise from O(S) id vectors; the naive path expands it
+to an additive bias.
 """
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _segment_bias(segment_ids):
+    """[B,1,Sq,Sk] additive bias from segment ids (0 allowed, -inf blocked)."""
+    qseg, kseg = (
+        segment_ids if isinstance(segment_ids, (tuple, list))
+        else (segment_ids, segment_ids)
+    )
+    same = qseg[:, None, :, None] == kseg[:, None, None, :]
+    return jnp.where(same, 0.0, NEG_INF).astype(jnp.float32)
 
 
 def _naive_attention(q, k, v, bias, scale, causal):
@@ -24,6 +39,9 @@ def _naive_attention(q, k, v, bias, scale, causal):
         mask = jnp.tril(jnp.ones((qs, ks), jnp.bool_), k=ks - qs)
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1)
+    # hard-zero heavily masked entries: a fully-masked row would otherwise
+    # softmax to uniform and emit mean(V); now it emits zeros
+    probs = jnp.where(logits <= NEG_INF / 2, 0.0, probs)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
 
@@ -41,12 +59,18 @@ def _use_pallas(q, k, bias):
     )
 
 
-def scaled_dot_product_attention(q, k, v, bias=None, scale=None, causal=False):
-    """q/k/v: [batch, heads, seq, head_dim]."""
+def scaled_dot_product_attention(q, k, v, bias=None, segment_ids=None,
+                                 scale=None, causal=False):
+    """q/k/v: [batch, heads, seq, head_dim].  segment_ids: None, [B, S], or
+    (q_seg, kv_seg) — attention stays within equal segment ids (packing)."""
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
     if _use_pallas(q, k, bias):
         from .pallas.attention import flash_attention
 
-        return flash_attention(q, k, v, bias=bias, scale=scale, causal=causal)
+        return flash_attention(q, k, v, bias=bias, segment_ids=segment_ids,
+                               scale=scale, causal=causal)
+    if segment_ids is not None:
+        sb = _segment_bias(segment_ids)
+        bias = sb if bias is None else bias + sb
     return _naive_attention(q, k, v, bias, scale, causal)

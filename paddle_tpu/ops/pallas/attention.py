@@ -9,11 +9,17 @@ a row-parallel kernel, dK/dV (and the padding-bias gradient) in a
 column-parallel kernel, each recomputing P blockwise from (Q, K, LSE) —
 the standard flash backward, O(S) memory end to end.
 
-Layout: [BH, S, D] (batch*heads flattened).  Causal masking and a
-broadcastable additive bias of shape [BH, 1, Sk] (padding masks) are
-supported in-kernel; richer biases fall back to the naive path in
-ops/attention.py.  Sequences that no supported block size divides also
-fall back (never silently truncate).
+Layout: [BH, S, D] (batch*heads flattened).  Supported in-kernel:
+  - causal masking,
+  - a broadcastable additive bias of shape [BH, 1, Sk] (padding masks),
+  - packed-batch segment ids ([BH, Sq], [BH, Sk]): token i attends token j
+    only when their segment ids are equal.  This is the in-graph LoD story
+    (reference `framework/lod_tensor.h:52,104`): several variable-length
+    sequences packed into one row stay isolated without an O(S^2) mask in
+    HBM — the mask is rebuilt blockwise from two O(S) id vectors.
+Richer biases fall back to the naive path in ops/attention.py.  Sequences
+that no supported block size divides also fall back (never silently
+truncate).
 
 Set `interpret=True` (or run on CPU — auto-detected) to run the same
 kernels through the pallas interpreter for testing.
@@ -25,6 +31,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -42,13 +49,51 @@ def _block_sizes(sq, sk):
     return _pick_block(sq), _pick_block(sk)
 
 
+def _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j, bq, bk):
+    """Common pre-softmax masking: additive bias, segment ids, causal.
+
+    Segment-id tiles use the TPU-friendly layouts: q ids lane-broadcast
+    [bq, 128], kv ids sublane-broadcast [8, bk] (blocks must tile by
+    (8, 128) on TPU; an O(S) id vector alone cannot)."""
+    if bias_ref is not None:
+        s = s + bias_ref[0, 0, :].astype(jnp.float32)[None, :]
+    if qseg_ref is not None:
+        qs = jnp.tile(qseg_ref[0], (1, bk // 128))  # [bq, bk]
+        ks = kseg_ref[0, 0:1, :]  # [1, bk]
+        s = jnp.where(qs == ks, s, NEG_INF)
+    if causal:
+        rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    return s
+
+
+def _split_refs(refs, has_bias, has_seg):
+    """Unpack a kernel's positional refs: q, k, v, [bias], [qseg, kseg],
+    then the remaining out/scratch refs as `tail`."""
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    idx = 3
+    bias_ref = qseg_ref = kseg_ref = None
+    if has_bias:
+        bias_ref = refs[idx]
+        idx += 1
+    if has_seg:
+        qseg_ref, kseg_ref = refs[idx], refs[idx + 1]
+        idx += 2
+    return q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, refs[idx:]
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                m_ref, l_ref, acc_ref, *, scale, causal, bq, bk, nk):
+def _fwd_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg):
+    (q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, tail) = _split_refs(
+        refs, has_bias, has_seg
+    )
+    o_ref, lse_ref, m_ref, l_ref, acc_ref = tail
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -67,12 +112,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk]
-        if bias_ref is not None:
-            s = s + bias_ref[0, 0, :].astype(jnp.float32)[None, :]
-        if causal:
-            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        s = _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j, bq, bk)
 
         m_prev = m_ref[:, 0]  # [bq]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -95,23 +135,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
     def _finalize():
         l = l_ref[:, 0]
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, :, :] = (acc_ref[...] / safe_l[:, None]).astype(o_ref.dtype)
-        lse = m_ref[:, 0] + jnp.log(safe_l)
+        o = acc_ref[...] / safe_l[:, None]
+        # a row whose every score was masked (m stuck at NEG_INF) has been
+        # accumulating p = exp(0) = 1 garbage; emit zeros, keep lse at
+        # NEG_INF so the backward zeroes it too
+        dead = m_ref[:, 0] <= NEG_INF / 2
+        o_ref[0, :, :] = jnp.where(dead[:, None], 0.0, o).astype(o_ref.dtype)
+        lse = jnp.where(dead, NEG_INF, m_ref[:, 0] + jnp.log(safe_l))
         lse_ref[0, :, :] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
-def _fwd_kernel_nobias(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                       m_ref, l_ref, acc_ref, **kw):
-    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
-                m_ref, l_ref, acc_ref, **kw)
+def _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret):
+    """Returns (out [bh,sq,d], lse [bh,sq,128] row-broadcast).
 
-
-def _fwd(q, k, v, bias, scale, causal, interpret):
-    """Returns (out [bh,sq,d], lse [bh,sq,128] row-broadcast)."""
+    qseg: [B, sq, 128] lane-broadcast ids; kseg: [B, 8, sk] sublane-
+    broadcast (B = bh // n_head; the index map divides by n_head so the
+    ids are not replicated per head in HBM)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _block_sizes(sq, sk)
     nq, nk = sq // bq, sk // bk
+    has_bias, has_seg = bias is not None, qseg is not None
+    h = n_head
 
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -119,13 +164,21 @@ def _fwd(q, k, v, bias, scale, causal, interpret):
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
     ]
     args = [q, k, v]
-    if bias is not None:
+    if has_bias:
         in_specs.append(pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, j)))
         args.append(bias)
+    if has_seg:
+        in_specs.append(
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b // h, i, 0))
+        )
+        in_specs.append(
+            pl.BlockSpec((1, 8, bk), lambda b, i, j: (b // h, 0, j))
+        )
+        args.extend([qseg, kseg])
 
     kernel = functools.partial(
-        _fwd_kernel if bias is not None else _fwd_kernel_nobias,
-        scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+        has_bias=has_bias, has_seg=has_seg,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -154,8 +207,11 @@ def _fwd(q, k, v, bias, scale, causal, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
-                   dq_ref, acc_ref, *, scale, causal, bq, bk, nk):
+def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg):
+    (q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, tail) = _split_refs(
+        refs, has_bias, has_seg
+    )
+    o_ref, do_ref, lse_ref, dq_ref, acc_ref = tail
     j = pl.program_id(2)
     i = pl.program_id(1)
 
@@ -174,13 +230,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        if bias_ref is not None:
-            s = s + bias_ref[0, 0, :].astype(jnp.float32)[None, :]
-        if causal:
-            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        s = _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j, bq, bk)
+        # explicit zero where masked: with a fully-masked row lse is
+        # NEG_INF and exp(s - lse) would resurrect p = 1
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse[:, None]))
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -202,15 +255,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
         dq_ref[0, :, :] = acc_ref[...].astype(dq_ref.dtype)
 
 
-def _bwd_dq_kernel_nobias(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                          dq_ref, acc_ref, **kw):
-    _bwd_dq_kernel(q_ref, k_ref, v_ref, None, o_ref, do_ref, lse_ref,
-                   dq_ref, acc_ref, **kw)
-
-
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
-                    dk_ref, dv_ref, db_ref, dk_acc, dv_acc, db_acc,
-                    *, scale, causal, bq, bk, nq):
+def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg):
+    (q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, tail) = _split_refs(
+        refs, has_bias, has_seg
+    )
+    if has_bias:
+        o_ref, do_ref, lse_ref, dk_ref, dv_ref, db_ref = tail[:6]
+        dk_acc, dv_acc, db_acc = tail[6:]
+    else:
+        o_ref, do_ref, lse_ref, dk_ref, dv_ref = tail[:5]
+        dk_acc, dv_acc = tail[5:]
+        db_ref = db_acc = None
     i = pl.program_id(2)  # q block index (inner loop)
     j = pl.program_id(1)  # k block index
 
@@ -232,13 +287,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        if bias_ref is not None:
-            s = s + bias_ref[0, 0, :].astype(jnp.float32)[None, :]
-        if causal:
-            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        s = _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j, bq, bk)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse[:, None]))
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -270,20 +320,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, do_ref, lse_ref,
             db_ref[0, 0, :] = db_acc[0, :].astype(db_ref.dtype)
 
 
-def _bwd_dkv_kernel_nobias(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                           dk_ref, dv_ref, dk_acc, dv_acc, **kw):
-    _bwd_dkv_kernel(q_ref, k_ref, v_ref, None, o_ref, do_ref, lse_ref,
-                    dk_ref, dv_ref, None, dk_acc, dv_acc, None, **kw)
-
-
 # ---------------------------------------------------------------------------
 # custom-vjp wrapper
 # ---------------------------------------------------------------------------
 
 
-def flash_attention(q, k, v, bias=None, scale=None, causal=False,
-                    interpret=None):
+def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
+                    causal=False, interpret=None):
     """q/k/v: [B, H, S, D].  bias: None or broadcastable [B, 1/H, 1, Sk].
+    segment_ids: None, a [B, S] int array (self-attention packing), or a
+    (q_seg [B, Sq], kv_seg [B, Sk]) pair — attention is confined to equal
+    segment ids.
 
     Falls back to the naive composition when no supported block size
     divides the sequence lengths (never silently truncates)."""
@@ -296,8 +343,11 @@ def flash_attention(q, k, v, bias=None, scale=None, causal=False,
 
     bq, bk = _block_sizes(sq, sk)
     if bq is None or bk is None:
-        from ..attention import _naive_attention
+        from ..attention import _naive_attention, _segment_bias
 
+        if segment_ids is not None:
+            sb = _segment_bias(segment_ids)
+            bias = sb if bias is None else bias + sb
         return _naive_attention(q, k, v, bias, scale, causal)
 
     qf = q.reshape(b * h, sq, d)
@@ -306,48 +356,77 @@ def flash_attention(q, k, v, bias=None, scale=None, causal=False,
     bf = None
     if bias is not None:
         bf = jnp.broadcast_to(bias, (b, h, 1, sk)).reshape(b * h, 1, sk)
+    qsegf = ksegf = None
+    if segment_ids is not None:
+        qseg, kseg = (
+            segment_ids if isinstance(segment_ids, (tuple, list))
+            else (segment_ids, segment_ids)
+        )
+        # TPU-tileable broadcast layouts (see _apply_masks)
+        qsegf = jnp.broadcast_to(
+            qseg.astype(jnp.int32)[:, :, None], (b, sq, 128)
+        )
+        ksegf = jnp.broadcast_to(
+            kseg.astype(jnp.int32)[:, None, :], (b, 8, sk)
+        )
 
-    out = _flash_core(qf, kf, vf, bf, scale, causal, interpret)
+    out = _flash_core(qf, kf, vf, bf, qsegf, ksegf, h, scale, causal,
+                      interpret)
     return out.reshape(b, h, sq, d)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_core(q, k, v, bias, scale, causal, interpret):
-    out, _ = _fwd(q, k, v, bias, scale, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
+def _flash_core(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret):
+    out, _ = _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret)
     return out
 
 
-def _flash_core_fwd(q, k, v, bias, scale, causal, interpret):
-    out, lse = _fwd(q, k, v, bias, scale, causal, interpret)
-    return out, (q, k, v, bias, out, lse)
+def _flash_core_fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal,
+                    interpret):
+    out, lse = _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal,
+                    interpret)
+    return out, (q, k, v, bias, qseg, kseg, out, lse)
 
 
-def _flash_core_bwd(scale, causal, interpret, res, g):
-    q, k, v, bias, out, lse2d = res
+def _flash_core_bwd(n_head, scale, causal, interpret, res, g):
+    q, k, v, bias, qseg, kseg, out, lse2d = res
+    h = n_head
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _block_sizes(sq, sk)
     nq, nk = sq // bq, sk // bk
+    has_bias, has_seg = bias is not None, qseg is not None
 
-    common_specs = [
+    dq_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # q
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # k
         pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # v
     ]
-    bias_spec = [pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, j))]
-    tail_specs = [
+    args = [q, k, v]
+    if has_bias:
+        dq_specs.append(pl.BlockSpec((1, 1, bk), lambda b, i, j: (b, 0, j)))
+        args.append(bias)
+    if has_seg:
+        dq_specs.append(
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b // h, i, 0))
+        )
+        dq_specs.append(
+            pl.BlockSpec((1, 8, bk), lambda b, i, j: (b // h, 0, j))
+        )
+        args.extend([qseg, kseg])
+    dq_specs += [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # o
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # do
         pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),  # lse rows
     ]
-    args = [q, k, v] + ([bias] if bias is not None else []) + [out, g, lse2d]
+    args += [out, g, lse2d]
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel if bias is not None else _bwd_dq_kernel_nobias,
-            scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+            _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+            has_bias=has_bias, has_seg=has_seg,
         ),
         grid=(bh, nq, nk),
-        in_specs=common_specs + (bias_spec if bias is not None else []) + tail_specs,
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
@@ -360,59 +439,60 @@ def _flash_core_bwd(scale, causal, interpret, res, g):
         pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # k
         pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # v
     ]
-    kv_bias_spec = [pl.BlockSpec((1, 1, bk), lambda b, j, i: (b, 0, j))]
-    kv_tail_specs = [
+    if has_bias:
+        kv_specs.append(pl.BlockSpec((1, 1, bk), lambda b, j, i: (b, 0, j)))
+    if has_seg:
+        kv_specs.append(
+            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b // h, i, 0))
+        )
+        kv_specs.append(
+            pl.BlockSpec((1, 8, bk), lambda b, j, i: (b // h, 0, j))
+        )
+    kv_specs += [
         pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # o
         pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # do
         pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),  # lse
     ]
     dk_spec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
     dv_spec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
-    if bias is not None:
-        db_spec = pl.BlockSpec((1, 1, bk), lambda b, j, i: (b, 0, j))
-        dk, dv, db = pl.pallas_call(
-            functools.partial(
-                _bwd_dkv_kernel,
-                scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
-            ),
-            grid=(bh, nk, nq),
-            in_specs=kv_specs + kv_bias_spec + kv_tail_specs,
-            out_specs=[dk_spec, dv_spec, db_spec],
-            out_shape=[
-                jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-                jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
-                jax.ShapeDtypeStruct((bh, 1, sk), bias.dtype),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((bk, d), jnp.float32),
-                pltpu.VMEM((bk, d), jnp.float32),
-                pltpu.VMEM((8, bk), jnp.float32),
-            ],
-            interpret=interpret,
-        )(*args)
-        dbias = db
+    out_specs = [dk_spec, dv_spec]
+    out_shape = [
+        jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+        jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+    ]
+    scratch = [
+        pltpu.VMEM((bk, d), jnp.float32),
+        pltpu.VMEM((bk, d), jnp.float32),
+    ]
+    if has_bias:
+        out_specs.append(pl.BlockSpec((1, 1, bk), lambda b, j, i: (b, 0, j)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, 1, sk), bias.dtype))
+        scratch.append(pltpu.VMEM((8, bk), jnp.float32))
+    res = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
+            has_bias=has_bias, has_seg=has_seg,
+        ),
+        grid=(bh, nk, nq),
+        in_specs=kv_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*args)
+    if has_bias:
+        dk, dv, dbias = res
     else:
-        dk, dv = pl.pallas_call(
-            functools.partial(
-                _bwd_dkv_kernel_nobias,
-                scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
-            ),
-            grid=(bh, nk, nq),
-            in_specs=kv_specs + kv_tail_specs,
-            out_specs=[dk_spec, dv_spec],
-            out_shape=[
-                jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-                jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((bk, d), jnp.float32),
-                pltpu.VMEM((bk, d), jnp.float32),
-            ],
-            interpret=interpret,
-        )(*args)
-        dbias = None
+        (dk, dv), dbias = res, None
 
-    return dq, dk, dv, dbias
+    # integer segment-id inputs take float0 cotangents
+    dqseg = (
+        np.zeros(qseg.shape, jax.dtypes.float0) if qseg is not None else None
+    )
+    dkseg = (
+        np.zeros(kseg.shape, jax.dtypes.float0) if kseg is not None else None
+    )
+    return dq, dk, dv, dbias, dqseg, dkseg
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)

@@ -1,0 +1,239 @@
+"""Packed-batch (in-graph LoD) capability: segment-id flash attention,
+segment pooling, and the pack_sequences utility (reference
+`framework/lod_tensor.h:52,104` — capability cover, TPU-first packing)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph, layers
+from paddle_tpu.fluid.dygraph.varbase import VarBase
+from paddle_tpu.fluid.packing import pack_sequences
+from paddle_tpu.ops.attention import _naive_attention, _segment_bias
+from paddle_tpu.ops.pallas.attention import flash_attention
+
+
+def _rand(shape, seed):
+    return jnp.asarray(
+        np.random.RandomState(seed).randn(*shape).astype(np.float32)
+    )
+
+
+# ---------------------------------------------------------------------------
+# pallas kernel segment-id path (interpret mode on CPU)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segment_forward_matches_naive(causal):
+    B, H, S, D = 2, 2, 256, 128
+    q, k, v = _rand((B, H, S, D), 0), _rand((B, H, S, D), 1), _rand((B, H, S, D), 2)
+    rng = np.random.RandomState(3)
+    # contiguous segments per row, like a packed batch
+    seg = np.zeros((B, S), np.int32)
+    for b in range(B):
+        cuts = np.sort(rng.choice(np.arange(32, S - 32), 3, replace=False))
+        sid, prev = 1, 0
+        for c in list(cuts) + [S]:
+            seg[b, prev:c] = sid
+            sid += 1
+            prev = c
+    seg = jnp.asarray(seg)
+    scale = D ** -0.5
+    out = flash_attention(q, k, v, segment_ids=seg, scale=scale,
+                          causal=causal, interpret=True)
+    ref = _naive_attention(q, k, v, _segment_bias(seg), scale, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_segment_backward_matches_naive():
+    import jax
+
+    B, H, S, D = 1, 1, 256, 128
+    q, k, v = _rand((B, H, S, D), 6), _rand((B, H, S, D), 7), _rand((B, H, S, D), 8)
+    seg = jnp.asarray(
+        np.repeat(np.arange(1, 5), S // 4)[None, :].astype(np.int32)
+    )
+    scale = D ** -0.5
+
+    def f_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, segment_ids=seg, scale=scale,
+                            interpret=True) ** 2
+        )
+
+    def f_naive(q, k, v):
+        return jnp.sum(
+            _naive_attention(q, k, v, _segment_bias(seg), scale, False)
+            ** 2
+        )
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# pack_sequences utility
+# ---------------------------------------------------------------------------
+
+
+def test_pack_sequences_roundtrip():
+    rng = np.random.RandomState(0)
+    seqs = [rng.randint(1, 100, (L,)).astype(np.int64)
+            for L in (7, 3, 9, 2, 5, 6)]
+    pb = pack_sequences(seqs, seq_len=16)
+    assert pb.data.shape[1] == 16
+    # every sequence is recoverable via the index
+    seen = set()
+    for r, row in enumerate(pb.index):
+        for orig_idx, start, length in row:
+            np.testing.assert_array_equal(
+                pb.data[r, start:start + length], seqs[orig_idx]
+            )
+            # segment ids constant inside, positions restart
+            sid = pb.segment_ids[r, start]
+            assert sid >= 1
+            assert (pb.segment_ids[r, start:start + length] == sid).all()
+            np.testing.assert_array_equal(
+                pb.positions[r, start:start + length], np.arange(length)
+            )
+            seen.add(orig_idx)
+    assert seen == set(range(len(seqs)))
+    # padding tail is segment 0
+    assert (pb.segment_ids[pb.data == 0] == 0).all()
+
+
+def test_pack_sequences_never_truncates():
+    with pytest.raises(ValueError):
+        pack_sequences([np.arange(20)], seq_len=16)
+
+
+# ---------------------------------------------------------------------------
+# segment_pool op
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("pool_type", ["sum", "average", "max", "sqrt"])
+def test_segment_pool(pool_type):
+    rng = np.random.RandomState(1)
+    B, T, D, N = 2, 10, 4, 3
+    x = rng.randn(B, T, D).astype(np.float32)
+    seg = rng.randint(-1, N, (B, T)).astype(np.int32)  # -1 = dropped
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", shape=[B, T, D], append_batch_size=False)
+        sv = layers.data("s", shape=[B, T], dtype="int32",
+                         append_batch_size=False)
+        out = layers.segment_pool(xv, sv, N, pool_type)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (got,) = exe.run(main, feed={"x": x, "s": seg}, fetch_list=[out])
+
+    want = np.zeros((B, N, D), np.float32)
+    for b in range(B):
+        for n in range(N):
+            rows = x[b][seg[b] == n]
+            if len(rows) == 0:
+                continue
+            if pool_type == "sum":
+                want[b, n] = rows.sum(0)
+            elif pool_type == "average":
+                want[b, n] = rows.mean(0)
+            elif pool_type == "max":
+                want[b, n] = rows.max(0)
+            else:
+                want[b, n] = rows.sum(0) / np.sqrt(len(rows))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# packed-batch BERT == padded-batch BERT (the LoD parity milestone)
+# ---------------------------------------------------------------------------
+
+
+def test_packed_bert_matches_padded():
+    from paddle_tpu import models
+
+    cfg = models.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    rng = np.random.RandomState(0)
+    L1, L2, S = 24, 40, 64
+    ids1 = rng.randint(1, cfg.vocab_size, (L1,)).astype(np.int32)
+    ids2 = rng.randint(1, cfg.vocab_size, (L2,)).astype(np.int32)
+
+    with dygraph.guard():
+        model = models.BertModel(cfg)
+        model.eval()
+
+        # padded: batch of 2 rows with attention_mask
+        pad_ids = np.zeros((2, S), np.int32)
+        pad_ids[0, :L1], pad_ids[1, :L2] = ids1, ids2
+        mask = np.zeros((2, S), np.int32)
+        mask[0, :L1], mask[1, :L2] = 1, 1
+        pos = np.tile(np.arange(S, dtype=np.int32), (2, 1))
+        tok = np.zeros((2, S), np.int32)
+        h_pad, _ = model(
+            VarBase(pad_ids, stop_gradient=True),
+            VarBase(tok, stop_gradient=True),
+            VarBase(pos, stop_gradient=True),
+            VarBase(mask, stop_gradient=True),
+        )
+        h_pad = np.asarray(h_pad.data)
+
+        # packed: both sequences in ONE row with segment ids + restart pos
+        pb = pack_sequences([ids1, ids2], seq_len=S)
+        assert pb.data.shape[0] == 1  # both fit one row
+        h_pack, _ = model(
+            VarBase(pb.data.astype(np.int32), stop_gradient=True),
+            VarBase(np.zeros((1, S), np.int32), stop_gradient=True),
+            VarBase(pb.positions, stop_gradient=True),
+            None,
+            segment_ids=VarBase(pb.segment_ids, stop_gradient=True),
+        )
+        h_pack = np.asarray(h_pack.data)
+
+    # compare per original sequence
+    for orig_idx, start, length in pb.index[0]:
+        ref = h_pad[orig_idx, :length]
+        got = h_pack[0, start:start + length]
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_orphan_segment_rows_emit_zeros():
+    # a query whose segment id appears nowhere in kv must output ZEROS
+    # (not mean(V)) and leak no gradient — both kernel and naive paths
+    import jax
+
+    B, H, S, D = 1, 1, 256, 128
+    q, k, v = _rand((B, H, S, D), 10), _rand((B, H, S, D), 11), _rand((B, H, S, D), 12)
+    qseg = np.ones((B, S), np.int32)
+    qseg[:, :128] = 99  # first q block's segment absent from kv
+    kseg = np.ones((B, S), np.int32)
+    seg = (jnp.asarray(qseg), jnp.asarray(kseg))
+    scale = D ** -0.5
+
+    out = flash_attention(q, k, v, segment_ids=seg, scale=scale,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out[:, :, :128]), 0.0, atol=1e-6)
+    ref = _naive_attention(q, k, v, _segment_bias(seg), scale, False)
+    np.testing.assert_allclose(np.asarray(ref[:, :, :128]), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    # gradients: nothing may flow into k/v from the orphan rows
+    gk = jax.grad(
+        lambda k_: jnp.sum(
+            flash_attention(q, k_, v, segment_ids=seg, scale=scale,
+                            interpret=True)[:, :, :128] ** 2
+        )
+    )(k)
+    np.testing.assert_allclose(np.asarray(gk), 0.0, atol=1e-6)
