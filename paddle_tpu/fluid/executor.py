@@ -30,8 +30,10 @@ class _LoweredBlock:
     """A compiled (feed, state, key) -> (fetch, new_state) executable."""
 
     def __init__(self, program, block, feed_names, fetch_names, scope,
-                 dp_devices=None):
+                 dp_devices=None, mesh=None, feed_shapes=None):
         import jax
+
+        feed_shapes = feed_shapes or {}
 
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
@@ -43,6 +45,13 @@ class _LoweredBlock:
             from jax.sharding import Mesh
 
             self.dp_mesh = Mesh(_np.array(dp_devices), ("dp",))
+        # mesh mode (SPMD over a DeviceMesh, possibly multi-process): the
+        # whole block runs under shard_map on the "dp" axis so transpiled
+        # c_allreduce_* ops bind the axis and lower to real psum — the
+        # execution story behind transpiler/collective.py (reference
+        # ParallelExecutor multi-trainer semantics: each rank feeds its
+        # LOCAL batch, gradients all-reduce across ranks).
+        self.mesh = mesh
         ops = block.ops
 
         produced = set()
@@ -111,20 +120,82 @@ class _LoweredBlock:
 
         is_test = program._is_test
 
-        def run_block(feed_vals, donate_state, ro_state, rng_key):
-            from .core.block_eval import run_ops
+        if mesh is None:
+            def run_block(feed_vals, donate_state, ro_state, rng_key):
+                from .core.block_eval import run_ops
 
-            env = dict(feed_vals)
-            env.update(donate_state)
-            env.update(ro_state)
-            ctx = LowerContext(base_key=rng_key, is_test=is_test)
-            run_ops(ops, env, ctx)
-            fetches = [env[n] for n in self.fetch_names]
-            new_state = {n: env[n] for n in self.state_out}
-            return fetches, new_state
+                env = dict(feed_vals)
+                env.update(donate_state)
+                env.update(ro_state)
+                ctx = LowerContext(base_key=rng_key, is_test=is_test)
+                run_ops(ops, env, ctx)
+                fetches = [env[n] for n in self.fetch_names]
+                new_state = {n: env[n] for n in self.state_out}
+                return fetches, new_state
 
-        # donate_state (arg 1) is donated: optimizer updates reuse param buffers.
-        self._jitted = jax.jit(run_block, donate_argnums=(1,))
+            # donate_state (arg 1): optimizer updates reuse param buffers.
+            self._jitted = jax.jit(run_block, donate_argnums=(1,))
+        else:
+            import jax.numpy as jnp
+            from jax.sharding import PartitionSpec as P
+
+            jmesh = mesh.mesh
+            ndev = jmesh.devices.size
+            nproc = jax.process_count()
+            local_dev = max(1, ndev // nproc)
+            # per-feed spec: shard dim 0 over dp when this process's LOCAL
+            # feed divides over its addressable devices; otherwise
+            # replicate (same fallback as the dp_devices path)
+            self.feed_specs = {}
+            for n in feed_names:
+                shp = feed_shapes.get(n, ())
+                if len(shp) >= 1 and shp[0] > 0 and shp[0] % local_dev == 0:
+                    self.feed_specs[n] = P("dp")
+                else:
+                    self.feed_specs[n] = P()
+            # Per-rank RNG: a startup program (no feeds, no backward/
+            # optimize ops) must init identically on every rank — the XLA
+            # analogue of the reference's param broadcast
+            # (parallel_executor.cc:740 BCastParamsToDevices).  Training/
+            # eval programs fold the rank in so dropout masks decorrelate
+            # across ranks (reference: per-device CUDA RNG states).
+            fold_rank = bool(feed_names) or any(
+                op.attrs.get("op_role") in ("backward", "optimize")
+                for op in ops
+            )
+
+            def run_block_sharded(feed_vals, donate_state, ro_state, rng_key):
+                from .core.block_eval import run_ops
+
+                if fold_rank:
+                    rng_key = jax.random.fold_in(
+                        rng_key, jax.lax.axis_index("dp")
+                    )
+                env = dict(feed_vals)
+                env.update(donate_state)
+                env.update(ro_state)
+                ctx = LowerContext(base_key=rng_key, is_test=is_test)
+                run_ops(ops, env, ctx)
+                # fetches gain a leading per-rank dim (shard_map needs a
+                # mapped output dim; per-rank values like the local loss
+                # genuinely differ across ranks)
+                fetches = [jnp.expand_dims(env[n], 0) for n in self.fetch_names]
+                new_state = {n: env[n] for n in self.state_out}
+                return fetches, new_state
+
+            sharded = jax.shard_map(
+                run_block_sharded,
+                mesh=jmesh,
+                in_specs=(
+                    dict(self.feed_specs),
+                    P(),  # state replicated (identical after psum'd grads)
+                    P(),
+                    P(),
+                ),
+                out_specs=([P("dp")] * len(fetch_names), P()),
+                check_vma=False,
+            )
+            self._jitted = jax.jit(sharded, donate_argnums=(1,))
 
     def __call__(self, feed_vals, donate_state, ro_state, rng_key):
         return self._jitted(feed_vals, donate_state, ro_state, rng_key)
@@ -133,8 +204,16 @@ class _LoweredBlock:
 class Executor:
     """cf. reference fluid.Executor — run(program, feed, fetch_list)."""
 
-    def __init__(self, place: Place = None):
+    def __init__(self, place: Place = None, mesh=None):
+        """mesh: a distributed.DeviceMesh with a "dp" axis switches the
+        executor into SPMD mesh mode — every run executes the block under
+        shard_map over dp, feeds are PER-RANK local batches (stitched into
+        one global array across processes), and transpiled c_allreduce_*
+        ops perform real cross-rank reductions.  This is the execution
+        engine the collective transpiler targets (reference
+        ParallelExecutor / test_dist_base multi-trainer semantics)."""
         self.place = place if place is not None else default_place()
+        self.mesh = mesh
         self._cache = {}
         self._rng_counter = 0
 
@@ -187,19 +266,47 @@ class Executor:
             tuple(fetch_names),
             id(scope),
             tuple(id(d) for d in dp_devices) if dp_devices else None,
+            id(self.mesh) if self.mesh is not None else None,
         )
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             entry = _LoweredBlock(
                 program, block, list(feed_vals), fetch_names, scope,
-                dp_devices=dp_devices,
+                dp_devices=dp_devices, mesh=self.mesh,
+                feed_shapes={n: a.shape for n, a in feed_vals.items()},
             )
             if use_program_cache:
                 self._cache[key] = entry
 
         donate_state = {n: scope.find_var(n) for n in entry.state_donate}
         ro_state = {n: scope.find_var(n) for n in entry.state_ro}
-        if entry.dp_mesh is not None:
+        if entry.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            jmesh = entry.mesh.mesh
+            repl = NamedSharding(jmesh, P())
+
+            def _stitch(a, sharding):
+                # per-process local data -> one global array (works single-
+                # process too, where local IS global)
+                return jax.make_array_from_process_local_data(
+                    sharding, np.asarray(a)
+                )
+
+            def _ensure_repl(d):
+                return {
+                    n: v if getattr(v, "sharding", None) == repl
+                    else _stitch(v, repl)
+                    for n, v in d.items()
+                }
+
+            feed_dev = {
+                n: _stitch(a, NamedSharding(jmesh, entry.feed_specs[n]))
+                for n, a in feed_vals.items()
+            }
+            donate_state = _ensure_repl(donate_state)
+            ro_state = _ensure_repl(ro_state)
+        elif entry.dp_mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             mesh = entry.dp_mesh
@@ -235,6 +342,20 @@ class Executor:
 
         for n, val in new_state.items():
             scope.set(n, val)
+
+        if entry.mesh is not None:
+            # fetches carry a leading per-rank dim; a process can only read
+            # its addressable shards, so return the LOCAL ranks' values
+            # (shape [n_local_ranks, ...]) — reference multi-trainer
+            # semantics: each trainer sees its own fetch results.
+            out = []
+            for f in fetches:
+                shards = sorted(
+                    f.addressable_shards, key=lambda s: s.index[0].start or 0
+                )
+                loc = np.concatenate([np.asarray(s.data) for s in shards], 0)
+                out.append(loc if return_numpy else jax.numpy.asarray(loc))
+            return out
 
         if return_numpy:
             return [np.asarray(f) for f in fetches]

@@ -352,7 +352,12 @@ def _segment_pool(ctx, ins, attrs):
     safe = jnp.where(valid, seg, 0)
     one_hot = jax.nn.one_hot(safe, n, dtype=x.dtype) * valid[..., None]
     if pooltype == "MAX":
-        big = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+        big = jnp.asarray(
+            jnp.finfo(x.dtype).min
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else jnp.iinfo(x.dtype).min,
+            x.dtype,
+        )
         # [B, T, n, 1] mask against [B, T, 1, D] -> segment max over T
         m = (one_hot > 0)[..., None]
         vals = jnp.where(m, x[:, :, None, :], big)

@@ -20,12 +20,21 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def normalize_segment_ids(segment_ids):
+    """Accept a single [B, S] id array (self-attention) or a (q, kv) pair;
+    return the explicit (q_seg, kv_seg) pair.  The ONE place the two
+    accepted forms are interpreted — every consumer takes the pair."""
+    if segment_ids is None:
+        return None
+    if isinstance(segment_ids, (tuple, list)):
+        qseg, kseg = segment_ids
+        return qseg, kseg
+    return segment_ids, segment_ids
+
+
 def _segment_bias(segment_ids):
     """[B,1,Sq,Sk] additive bias from segment ids (0 allowed, -inf blocked)."""
-    qseg, kseg = (
-        segment_ids if isinstance(segment_ids, (tuple, list))
-        else (segment_ids, segment_ids)
-    )
+    qseg, kseg = normalize_segment_ids(segment_ids)
     same = qseg[:, None, :, None] == kseg[:, None, None, :]
     return jnp.where(same, 0.0, NEG_INF).astype(jnp.float32)
 

@@ -358,10 +358,9 @@ def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
         bf = jnp.broadcast_to(bias, (b, h, 1, sk)).reshape(b * h, 1, sk)
     qsegf = ksegf = None
     if segment_ids is not None:
-        qseg, kseg = (
-            segment_ids if isinstance(segment_ids, (tuple, list))
-            else (segment_ids, segment_ids)
-        )
+        from ..attention import normalize_segment_ids
+
+        qseg, kseg = normalize_segment_ids(segment_ids)
         # TPU-tileable broadcast layouts (see _apply_masks)
         qsegf = jnp.broadcast_to(
             qseg.astype(jnp.int32)[:, :, None], (b, sq, 128)

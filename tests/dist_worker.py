@@ -1,0 +1,98 @@
+"""Multi-process data-parallel worker (spawned by distributed/launch.py).
+
+Mirrors the reference `tests/unittests/test_dist_base.py` runtime half: each
+rank builds the SAME program (same seeds), transpiles it with GradAllReduce,
+and trains on its OWN local shard of a deterministic global dataset; the
+mesh-mode executor stitches local batches into one global array and the
+transpiled c_allreduce_sum ops psum the gradients, so every rank's params
+stay identical to a single-process run over the global batch.
+
+Writes {out_dir}/result_{rank}.json with per-step local losses + a param
+checksum for the parity assertion in test_multiprocess.py.
+"""
+
+import json
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# exactly ONE local device per process: the dp axis must span processes
+# (a leaked 8-device flag from the parent test env would put the whole
+# mesh inside process 0 and dodge the cross-process path entirely)
+_flags = os.environ.get("XLA_FLAGS", "")
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
+os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np
+
+
+def build_and_train(rank, nranks, out_dir, steps=6):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.transpiler.collective import GradAllReduce
+
+    if nranks > 1:
+        dist.init_parallel_env()  # jax.distributed over the env contract
+
+    # deterministic global data; each rank slices its shard
+    rng = np.random.RandomState(1234)
+    G = 16  # global batch
+    xs = rng.randn(steps, G, 8).astype(np.float32)
+    w_true = rng.randn(8, 1).astype(np.float32)
+    ys = xs @ w_true + 0.1 * rng.randn(steps, G, 1).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 8], append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+
+    if nranks > 1:
+        endpoints = os.getenv("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        GradAllReduce().transpile(
+            startup_program=startup, main_program=main,
+            rank=rank, endpoints=endpoints,
+            current_endpoint=os.getenv("PADDLE_CURRENT_ENDPOINT"),
+        )
+        mesh = dist.DeviceMesh({"dp": nranks}, devices=jax.devices())
+    else:
+        mesh = None
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace(), mesh=mesh)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        B = G // nranks
+        lo, hi = rank * B, (rank + 1) * B
+        for t in range(steps):
+            (lv,) = exe.run(
+                main,
+                feed={"x": xs[t, lo:hi], "y": ys[t, lo:hi]},
+                fetch_list=[loss],
+            )
+            # mesh mode returns [n_local_ranks, ...]; plain mode a scalar
+            losses.append(float(np.mean(lv)))
+        w = np.asarray(scope.find_var(main.all_parameters()[0].name))
+
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "result_%d.json" % rank), "w") as f:
+        json.dump({"losses": losses, "w_sum": float(np.abs(w).sum()),
+                   "w": w.reshape(-1).tolist()}, f)
+
+
+if __name__ == "__main__":
+    rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+    nranks = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    build_and_train(rank, nranks, sys.argv[1])
