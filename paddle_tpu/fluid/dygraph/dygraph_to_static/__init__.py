@@ -1,0 +1,11 @@
+"""Dygraph-to-static conversion (reference `dygraph_to_static/` package)."""
+
+from .ast_transformer import transform_function  # noqa: F401
+from .convert_operators import (  # noqa: F401
+    UNDEF,
+    convert_ifelse,
+    convert_logical_and,
+    convert_logical_not,
+    convert_logical_or,
+    convert_while_loop,
+)

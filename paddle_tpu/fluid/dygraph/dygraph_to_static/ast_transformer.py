@@ -1,0 +1,624 @@
+"""Dygraph-to-static AST rewriting.
+
+Capability parity: reference `dygraph_to_static/ast_transformer.py:1` +
+`program_translator.py:1` (20 files of transformers).  The same pipeline
+idea, TPU-sized: rewrite data-dependent Python control flow into calls on
+`_jst` (convert_operators), which pick `layers.cond`/`layers.while_loop`
+when the condition is a tensor — those lower to native XLA `lax.cond`/
+`lax.while_loop`, the compiler-friendly control flow the platform wants —
+and keep plain Python semantics otherwise.
+
+Passes, in order (each output is plain AST the next pass understands):
+  1. BreakContinueTransformer — break/continue become boolean flag vars;
+     statements downstream of a possible interrupt are guarded by `if`.
+  2. ForToWhileTransformer — `for i in range(...)` becomes a counter
+     `while` (other iterables stay Python: they unroll at trace time).
+  3. LoopTransformer — `while` becomes cond_fn/body_fn + convert_while_loop
+     over the loop-carried names.
+  4. IfElseTransformer — `if` becomes true_fn/false_fn + convert_ifelse
+     over the union of names either branch assigns.
+  (BoolOpTransformer runs inside passes 3/4 on test expressions only:
+  and/or/not there become convert_logical_* calls with lazy operands —
+  tensors have no Python truthiness, while pure-Python guards keep
+  short-circuit semantics.)
+
+`if`/`while` containing `return` keep Python semantics (a tensor condition
+then raises Variable.__bool__'s guidance error) — data-dependent early
+return has no XLA analogue; assign-then-return instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+
+_JST = "_jst"
+
+
+# ---------------------------------------------------------------------------
+# analysis helpers
+# ---------------------------------------------------------------------------
+
+
+def _target_names(target):
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for e in target.elts:
+            out.extend(_target_names(e))
+        return out
+    return []  # attribute/subscript stores mutate objects, not names
+
+
+def _assigned_names(stmts):
+    """Names bound by a statement list (incl. nested blocks)."""
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_Assign(self, node):
+            for t in node.targets:
+                names.extend(_target_names(t))
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            names.extend(_target_names(node.target))
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node):
+            names.extend(_target_names(node.target))
+            self.generic_visit(node)
+
+        def visit_For(self, node):
+            names.extend(_target_names(node.target))
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            # function objects cannot thread through cond/while outputs;
+            # a def stays local to its branch/body (do not descend either)
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    # stable order, unique
+    seen, out = set(), []
+    for n in names:
+        if n not in seen:
+            seen.add(n)
+            out.append(n)
+    return out
+
+
+def _walk_shallow(root):
+    """ast.walk that does NOT descend into nested function/lambda bodies
+    (their returns/breaks belong to them, not the enclosing block)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.append(child)
+
+
+def _contains(stmts, node_types, stop_at_loops=False):
+    for s in stmts:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue  # a nested def's returns/breaks are its own
+        for node in _walk_shallow(s):
+            if isinstance(node, node_types):
+                if stop_at_loops and _inside_nested_loop(s, node):
+                    continue
+                return True
+    return False
+
+
+def _inside_nested_loop(root, node):
+    """True if `node` sits inside a loop nested under `root` (that loop
+    owns the break/continue)."""
+    # walk with explicit parent tracking
+    stack = [(root, False)]
+    while stack:
+        cur, in_loop = stack.pop()
+        if cur is node:
+            return in_loop
+        for child in ast.iter_child_nodes(cur):
+            stack.append(
+                (child, in_loop or isinstance(cur, (ast.For, ast.While)))
+            )
+    return False
+
+
+def _has_return(stmts):
+    return _contains(stmts, (ast.Return,))
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_call(fn_name, args):
+    return ast.Call(
+        func=ast.Attribute(value=_name(_JST), attr=fn_name, ctx=ast.Load()),
+        args=args,
+        keywords=[],
+    )
+
+
+def _ensure_defined(names):
+    """`try: x\nexcept NameError: x = _jst.UNDEF` per name — makes branch
+    functions well-defined when a name is only assigned on one path."""
+    out = []
+    for n in names:
+        out.append(
+            ast.Try(
+                body=[ast.Expr(value=_name(n))],
+                handlers=[
+                    ast.ExceptHandler(
+                        type=_name("NameError"),
+                        name=None,
+                        body=[
+                            ast.Assign(
+                                targets=[_name(n, ast.Store())],
+                                value=ast.Attribute(
+                                    value=_name(_JST), attr="UNDEF",
+                                    ctx=ast.Load(),
+                                ),
+                            )
+                        ],
+                    ),
+                    ast.ExceptHandler(
+                        type=_name("UnboundLocalError"),
+                        name=None,
+                        body=[
+                            ast.Assign(
+                                targets=[_name(n, ast.Store())],
+                                value=ast.Attribute(
+                                    value=_name(_JST), attr="UNDEF",
+                                    ctx=ast.Load(),
+                                ),
+                            )
+                        ],
+                    ),
+                ],
+                orelse=[],
+                finalbody=[],
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pass 1: break/continue -> flags
+# ---------------------------------------------------------------------------
+
+
+class BreakContinueTransformer(ast.NodeTransformer):
+    """Rewrite break/continue into boolean flag assignments; guard the
+    statements that would have been skipped (reference
+    break_continue_transformer.py)."""
+
+    def __init__(self):
+        self._uid = 0
+
+    def _fresh(self, tag):
+        self._uid += 1
+        return "__dy2st_%s_%d" % (tag, self._uid)
+
+    def visit_While(self, node):
+        self.generic_visit(node)  # inner loops first
+        return self._rewrite_loop(node, is_for=False)
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        return self._rewrite_loop(node, is_for=True)
+
+    def _rewrite_loop(self, node, is_for):
+        has_brk = _contains(node.body, (ast.Break,), stop_at_loops=True)
+        has_cont = _contains(node.body, (ast.Continue,), stop_at_loops=True)
+        if not (has_brk or has_cont):
+            return node
+        brk = self._fresh("brk") if has_brk else None
+        cont = self._fresh("cont") if has_cont else None
+
+        new_body = []
+        if cont:
+            new_body.append(
+                ast.Assign(
+                    targets=[_name(cont, ast.Store())],
+                    value=ast.Constant(value=False),
+                )
+            )
+        new_body.extend(self._guard_block(node.body, brk, cont))
+        node.body = new_body
+
+        if brk:
+            # flag init before the loop + loop condition &= not brk
+            init = ast.Assign(
+                targets=[_name(brk, ast.Store())],
+                value=ast.Constant(value=False),
+            )
+            if isinstance(node, ast.While):
+                node.test = _jst_call(
+                    "convert_logical_and",
+                    [node.test, _jst_call("convert_logical_not", [_name(brk)])],
+                )
+            else:
+                # For: ForToWhile pass will fold the flag into its test
+                node._dy2st_break_flag = brk
+            return [init, node]
+        return node
+
+    def _guard_block(self, stmts, brk, cont):
+        """Replace break/continue with flag-sets; wrap statements after a
+        possible interrupt in `if not (brk or cont):`."""
+        out = []
+        pending_guard = None  # names of flags that may be set so far
+        for s in stmts:
+            if isinstance(s, ast.Break):
+                repl = ast.Assign(
+                    targets=[_name(brk, ast.Store())],
+                    value=ast.Constant(value=True),
+                )
+                out.append(self._wrap(repl, pending_guard))
+                pending_guard = self._merge(pending_guard, [brk])
+                continue
+            if isinstance(s, ast.Continue):
+                repl = ast.Assign(
+                    targets=[_name(cont, ast.Store())],
+                    value=ast.Constant(value=True),
+                )
+                out.append(self._wrap(repl, pending_guard))
+                pending_guard = self._merge(pending_guard, [cont])
+                continue
+            # recurse into if/with bodies (loops already handled themselves;
+            # try/finally falls back to plain tracing at compile time)
+            if isinstance(s, (ast.If, ast.With)) and (
+                _contains([s], (ast.Break, ast.Continue), stop_at_loops=True)
+            ):
+                s.body = self._guard_block(s.body, brk, cont)
+                if isinstance(s, ast.If):
+                    s.orelse = self._guard_block(s.orelse, brk, cont)
+                flags = [f for f in (brk, cont) if f is not None]
+                out.append(self._wrap(s, pending_guard))
+                pending_guard = self._merge(pending_guard, flags)
+                continue
+            out.append(self._wrap(s, pending_guard))
+        return out
+
+    def _merge(self, guard, flags):
+        cur = list(guard or [])
+        for f in flags:
+            if f and f not in cur:
+                cur.append(f)
+        return cur
+
+    def _wrap(self, stmt, guard):
+        if not guard:
+            return stmt
+        test = _name(guard[0])
+        for g in guard[1:]:
+            test = _jst_call("convert_logical_or", [test, _name(g)])
+        return ast.If(
+            test=_jst_call("convert_logical_not", [test]),
+            body=[stmt],
+            orelse=[],
+        )
+
+
+# ---------------------------------------------------------------------------
+# pass 2: for-range -> while
+# ---------------------------------------------------------------------------
+
+
+class ForToWhileTransformer(ast.NodeTransformer):
+    """`for i in range(...)` -> counter while (other iterables unroll at
+    trace time, which is the right call for Python lists under XLA)."""
+
+    def __init__(self):
+        self._uid = 0
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        it = node.iter
+        if not (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Name)
+            and it.func.id == "range"
+            and isinstance(node.target, ast.Name)
+            and not node.orelse
+        ):
+            return node
+        self._uid += 1
+        a = it.args
+        start = a[0] if len(a) >= 2 else ast.Constant(value=0)
+        stop = a[1] if len(a) >= 2 else a[0]
+        step = a[2] if len(a) >= 3 else ast.Constant(value=1)
+        i = node.target.id
+        # internal counter: the user's loop variable is assigned at the TOP
+        # of each iteration, so after the loop it holds the last iterated
+        # value (Python semantics), not `stop`
+        it_name = "__dy2st_it_%d" % self._uid
+
+        init = ast.Assign(targets=[_name(it_name, ast.Store())], value=start)
+        # step-sign-aware bound check (range(3,0,-1) iterates downward)
+        test = _jst_call(
+            "convert_range_cond", [_name(it_name), stop, step]
+        )
+        flag = getattr(node, "_dy2st_break_flag", None)
+        if flag:
+            test = _jst_call(
+                "convert_logical_and",
+                [test, _jst_call("convert_logical_not", [_name(flag)])],
+            )
+        set_i = ast.Assign(
+            targets=[_name(i, ast.Store())], value=_name(it_name)
+        )
+        incr = ast.AugAssign(
+            target=_name(it_name, ast.Store()), op=ast.Add(), value=step
+        )
+        w = ast.While(
+            test=test, body=[set_i] + list(node.body) + [incr], orelse=[]
+        )
+        return [init, w]
+
+
+# ---------------------------------------------------------------------------
+# pass 3: while -> convert_while_loop
+# ---------------------------------------------------------------------------
+
+
+class LoopTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._uid = 0
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_return(node.body) or node.orelse:
+            return node  # python semantics (tensor cond raises guidance)
+        node.test = _rewrite_test(node.test)
+        self._uid += 1
+        assigned = _assigned_names(node.body)
+        # loop-carried names: assigned in the body and visible outside
+        loop_names = assigned
+        if not loop_names:
+            return node
+        cond_name = "__dy2st_cond_%d" % self._uid
+        body_name = "__dy2st_body_%d" % self._uid
+
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in loop_names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[],
+        )
+        cond_fn = ast.FunctionDef(
+            name=cond_name, args=args,
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None,
+        )
+        body_fn = ast.FunctionDef(
+            name=body_name, args=args,
+            body=list(node.body)
+            + [
+                ast.Return(
+                    value=ast.Tuple(
+                        elts=[_name(n) for n in loop_names], ctx=ast.Load()
+                    )
+                )
+            ],
+            decorator_list=[], returns=None,
+        )
+        call = ast.Assign(
+            targets=[
+                ast.Tuple(
+                    elts=[_name(n, ast.Store()) for n in loop_names],
+                    ctx=ast.Store(),
+                )
+            ],
+            value=_jst_call(
+                "convert_while_loop",
+                [
+                    _name(cond_name),
+                    _name(body_name),
+                    ast.Tuple(
+                        elts=[_name(n) for n in loop_names], ctx=ast.Load()
+                    ),
+                    ast.Constant(value=tuple(loop_names)),
+                ],
+            ),
+        )
+        return _ensure_defined(loop_names) + [cond_fn, body_fn, call]
+
+
+# ---------------------------------------------------------------------------
+# pass 4: if -> convert_ifelse
+# ---------------------------------------------------------------------------
+
+
+class IfElseTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._uid = 0
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_return(node.body) or _has_return(node.orelse):
+            return node  # python semantics (tensor cond raises guidance)
+        node.test = _rewrite_test(node.test)
+        names = _assigned_names(node.body + node.orelse)
+        self._uid += 1
+        t_name = "__dy2st_true_%d" % self._uid
+        f_name = "__dy2st_false_%d" % self._uid
+        ret = ast.Return(
+            value=ast.Tuple(elts=[_name(n) for n in names], ctx=ast.Load())
+        )
+        # branch fns take the assigned names as PARAMETERS (a name both
+        # read and re-assigned in a branch would otherwise be an unbound
+        # local of the branch function)
+        fn_args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[],
+        )
+        true_fn = ast.FunctionDef(
+            name=t_name, args=fn_args,
+            body=list(node.body) + [ret],
+            decorator_list=[], returns=None,
+        )
+        false_fn = ast.FunctionDef(
+            name=f_name, args=fn_args,
+            body=(list(node.orelse) or [ast.Pass()]) + [ret],
+            decorator_list=[], returns=None,
+        )
+        orig_vals = ast.Tuple(
+            elts=[_name(n) for n in names], ctx=ast.Load()
+        )
+        call_args = [
+            node.test, _name(t_name), _name(f_name),
+            ast.Constant(value=tuple(names)), orig_vals,
+        ]
+        if names:
+            tgt = [
+                ast.Tuple(
+                    elts=[_name(n, ast.Store()) for n in names],
+                    ctx=ast.Store(),
+                )
+            ]
+            call_stmt = ast.Assign(
+                targets=tgt, value=_jst_call("convert_ifelse", call_args)
+            )
+        else:
+            call_stmt = ast.Expr(
+                value=_jst_call("convert_ifelse", call_args)
+            )
+        return _ensure_defined(names) + [true_fn, false_fn, call_stmt]
+
+
+# ---------------------------------------------------------------------------
+# pass 5: and/or/not -> convert_logical_*
+# ---------------------------------------------------------------------------
+
+
+class BoolOpTransformer(ast.NodeTransformer):
+    """Applied ONLY to `if`/`while` test expressions (tensors have no
+    Python truthiness there); `and`/`or` elsewhere keep native semantics.
+    Later operands are wrapped `_jst.lazy(lambda: ...)` so pure-Python
+    guards keep short-circuit behavior."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = (
+            "convert_logical_and"
+            if isinstance(node.op, ast.And)
+            else "convert_logical_or"
+        )
+        expr = node.values[0]
+        for v in node.values[1:]:
+            lazy_v = _jst_call(
+                "lazy",
+                [
+                    ast.Lambda(
+                        args=ast.arguments(
+                            posonlyargs=[], args=[], vararg=None,
+                            kwonlyargs=[], kw_defaults=[], kwarg=None,
+                            defaults=[],
+                        ),
+                        body=v,
+                    )
+                ],
+            )
+            expr = _jst_call(fn, [expr, lazy_v])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _jst_call("convert_logical_not", [node.operand])
+        return node
+
+    # do not descend into nested statements: tests only
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+
+def _rewrite_test(expr):
+    return BoolOpTransformer().visit(expr)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def transform_function(fn):
+    """Source-rewrite `fn` through the pass pipeline; returns the new
+    callable (or None when source is unavailable — builtins, lambdas from
+    exec, etc. — the caller then falls back to plain tracing)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+
+    def _is_declarative(dec):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = getattr(target, "attr", None) or getattr(target, "id", None)
+        return name in ("declarative", "to_static")
+
+    # strip ONLY @declarative/@to_static; other stacked decorators re-apply
+    # when the transformed source is exec'd
+    fdef.decorator_list = [
+        d for d in fdef.decorator_list if not _is_declarative(d)
+    ]
+
+    for pass_cls in (
+        BreakContinueTransformer,
+        ForToWhileTransformer,
+        LoopTransformer,
+        IfElseTransformer,
+        # BoolOp rewriting happens inside Loop/IfElse on test exprs only
+    ):
+        tree = pass_cls().visit(tree)
+    ast.fix_missing_locations(tree)
+
+    from . import convert_operators
+
+    glb = dict(getattr(fn, "__globals__", {}))
+    glb[_JST] = convert_operators
+    # closure cells become plain globals of the transformed function
+    # (values snapshot at transform time; cf. reference
+    # program_translator function wrapping)
+    freevars = getattr(fn.__code__, "co_freevars", ())
+    for name, cell in zip(freevars, fn.__closure__ or ()):
+        try:
+            glb[name] = cell.cell_contents
+        except ValueError:
+            pass
+    try:
+        code = compile(tree, filename="<dygraph_to_static %s>" % fn.__name__,
+                       mode="exec")
+    except SyntaxError:
+        # e.g. break under try/finally survived into a generated function —
+        # fall back to plain tracing (tensor conds then raise guidance)
+        return None
+    ns = {}
+    exec(code, glb, ns)
+    new_fn = ns[fdef.name]
+    new_fn.__dy2st_source__ = ast.unparse(tree)
+    return new_fn

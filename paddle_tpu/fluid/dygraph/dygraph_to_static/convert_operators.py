@@ -1,0 +1,269 @@
+"""Runtime dispatchers the AST transformer targets (`_jst.*` calls).
+
+Capability parity: reference
+`python/paddle/fluid/dygraph/dygraph_to_static/convert_operators.py`
+(convert_ifelse, convert_while_loop, convert_logical_*) — each decides at
+RUNTIME whether the rewritten construct sees a tensor (→ emit
+layers.cond / layers.while_loop into the program) or a plain Python value
+(→ keep native Python semantics), so one transformed source serves both.
+"""
+
+from __future__ import annotations
+
+from ... import framework
+from ...framework import Variable
+
+
+class _Undefined:
+    """Sentinel for names possibly unbound before a branch assigns them
+    (reference UndefinedVar, `dygraph_to_static/utils.py`)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def __bool__(self):
+        raise NameError(
+            "variable is undefined on this control-flow path (assigned in "
+            "only one branch of a converted if/loop)"
+        )
+
+
+UNDEF = _Undefined()
+
+
+def _is_tensor(x):
+    from ..varbase import VarBase
+
+    return isinstance(x, (Variable, VarBase))
+
+
+def _as_py_bool(x):
+    from ..varbase import VarBase
+
+    if isinstance(x, VarBase):
+        return bool(x.numpy())
+    return bool(x)
+
+
+def convert_ifelse(pred, true_fn, false_fn, names, orig_vals):
+    """`if` rewritten by IfElseTransformer.  true_fn/false_fn take the
+    current values of `names` (the union of names either branch assigns)
+    and return one value per name."""
+    if isinstance(pred, Variable) and not framework.in_dygraph_mode():
+        from ...layers import control_flow
+
+        holder = {}
+
+        def wrap(fn, tag, lift):
+            def inner():
+                vals = list(fn(*orig_vals))
+                if lift:
+                    vals = [
+                        _lift_scalar(v)
+                        if isinstance(v, (bool, int, float)) else v
+                        for v in vals
+                    ]
+                holder[tag] = vals
+                return [v for v in vals if isinstance(v, Variable)]
+
+            return inner
+
+        try:
+            outs = control_flow.cond(
+                pred, wrap(true_fn, "t", False), wrap(false_fn, "f", False)
+            )
+        except ValueError:
+            # a slot is a python scalar in one branch but a tensor in the
+            # other (e.g. an already-promoted break flag): lift scalars and
+            # trace again so both branches return matching structures
+            try:
+                outs = control_flow.cond(
+                    pred, wrap(true_fn, "t", True), wrap(false_fn, "f", True)
+                )
+            except ValueError as e:
+                raise TypeError(
+                    "@declarative: branches of a data-dependent `if` "
+                    "produce incompatible values for %s — a variable is "
+                    "likely undefined or non-scalar in exactly one branch"
+                    % (names,)
+                ) from e
+        if isinstance(outs, Variable):
+            outs = [outs]
+        outs = list(outs) if outs is not None else []
+        t_vals, f_vals = holder["t"], holder["f"]
+        # stitch: tensor slots take the cond output; python slots must agree
+        # between branches (they were computed at trace time, not runtime)
+        result, oi = [], 0
+        for i, name in enumerate(names):
+            tv, fv = t_vals[i], f_vals[i]
+            t_tensor, f_tensor = isinstance(tv, Variable), isinstance(fv, Variable)
+            if t_tensor != f_tensor:
+                raise TypeError(
+                    "@declarative: variable '%s' is a tensor in one branch "
+                    "of a data-dependent `if` but not the other — both "
+                    "branches must produce the same kind" % name
+                )
+            if t_tensor:
+                # cond emitted both branches; outputs align in true-branch
+                # tensor order, which equals false-branch order here
+                result.append(outs[oi])
+                oi += 1
+            else:
+                if tv is UNDEF and fv is UNDEF:
+                    result.append(UNDEF)
+                elif (
+                    isinstance(tv, (bool, int, float))
+                    and isinstance(fv, (bool, int, float))
+                    and tv != fv
+                ):
+                    # differing python scalars under a tensor pred (e.g. a
+                    # break flag): lift to a runtime select
+                    result.append(
+                        control_flow.cond(
+                            pred,
+                            lambda v=tv: _lift_scalar(v),
+                            lambda v=fv: _lift_scalar(v),
+                        )
+                    )
+                elif tv is UNDEF or fv is UNDEF or tv != fv:
+                    raise TypeError(
+                        "@declarative: non-tensor variable '%s' differs "
+                        "between branches of a data-dependent `if` (%r vs "
+                        "%r); make it a tensor or hoist it out" % (name, tv, fv)
+                    )
+                else:
+                    result.append(tv)
+        return tuple(result)
+    # python / eager path: real short-circuit semantics
+    return tuple(
+        true_fn(*orig_vals) if _as_py_bool(pred) else false_fn(*orig_vals)
+    )
+
+
+def convert_while_loop(cond_fn, body_fn, loop_vars, names):
+    """`while` rewritten by LoopTransformer.
+
+    A loop may PROMOTE mid-trace: iterations run in Python while the
+    condition stays a Python bool, and the moment it becomes a tensor
+    (e.g. a break flag set inside a data-dependent `if`) the remaining
+    iterations compile to one while_loop op from the current state."""
+    vals = list(loop_vars)
+    while True:
+        c = cond_fn(*vals)
+        if isinstance(c, Variable) and not framework.in_dygraph_mode():
+            from ...layers import control_flow
+
+            lifted = []
+            for name, v in zip(names, vals):
+                if isinstance(v, Variable):
+                    lifted.append(v)
+                elif isinstance(v, (bool, int, float)):
+                    lifted.append(_lift_scalar(v))
+                else:
+                    raise TypeError(
+                        "@declarative: loop variable '%s' of a "
+                        "data-dependent `while` must be a tensor or scalar "
+                        "(got %r)" % (name, type(v).__name__)
+                    )
+            outs = control_flow.while_loop(cond_fn, body_fn, lifted)
+            return tuple(outs)
+        if not _as_py_bool(c):
+            return tuple(vals)
+        out = body_fn(*vals)
+        vals = list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+class _Lazy:
+    """Deferred operand of a rewritten `and`/`or` (keeps Python
+    short-circuit semantics for non-tensor left operands)."""
+
+    __slots__ = ("fn",)
+
+    def __init__(self, fn):
+        self.fn = fn
+
+
+def lazy(fn):
+    return _Lazy(fn)
+
+
+def _force(v):
+    return v.fn() if isinstance(v, _Lazy) else v
+
+
+def convert_logical_and(x, y):
+    if not _is_tensor(x):
+        if not _as_py_bool(x):
+            return x  # short-circuit: y is never evaluated
+        y = _force(y)
+        if not _is_tensor(y):
+            return y  # python `and` returns the second operand
+    else:
+        y = _force(y)
+    from ...layers import tensor as t
+
+    return t.logical_and(_to_bool_tensor(x), _to_bool_tensor(y))
+
+
+def convert_logical_or(x, y):
+    if not _is_tensor(x):
+        if _as_py_bool(x):
+            return x  # short-circuit
+        y = _force(y)
+        if not _is_tensor(y):
+            return y
+    else:
+        y = _force(y)
+    from ...layers import tensor as t
+
+    return t.logical_or(_to_bool_tensor(x), _to_bool_tensor(y))
+
+
+def convert_logical_not(x):
+    if _is_tensor(x):
+        from ...layers import tensor as t
+
+        return t.logical_not(_to_bool_tensor(x))
+    return not x
+
+
+def convert_range_cond(i, stop, step):
+    """Bound test of a converted `for i in range(...)`: direction follows
+    the sign of step (range(3, 0, -1) iterates downward)."""
+    if not any(_is_tensor(v) for v in (i, stop, step)):
+        return i < stop if step > 0 else i > stop
+    if isinstance(step, (bool, int, float)):  # static step: pick statically
+        return i < stop if step > 0 else i > stop
+    # tensor step: (step > 0 and i < stop) or (step < 0 and i > stop)
+    from ...layers import tensor as t
+
+    up = t.logical_and(_to_bool_tensor(step > 0), _to_bool_tensor(i < stop))
+    dn = t.logical_and(_to_bool_tensor(step < 0), _to_bool_tensor(i > stop))
+    return t.logical_or(up, dn)
+
+
+def _lift_scalar(v):
+    """Python scalar -> [1] tensor: bool stays bool, numbers use float32
+    (int loop counters survive `scale`-op arithmetic without dtype drift)."""
+    from ...layers import tensor as t
+
+    if isinstance(v, bool):
+        return t.fill_constant([1], "bool", v)
+    return t.fill_constant([1], "float32", float(v))
+
+
+def _to_bool_tensor(x):
+    from ...layers import tensor as t
+
+    if not _is_tensor(x):
+        return t.fill_constant([1], "bool", bool(x))
+    if getattr(x, "dtype", "bool") != "bool":
+        return t.cast(x, "bool")
+    return x

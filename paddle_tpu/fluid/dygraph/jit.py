@@ -159,13 +159,33 @@ def _closure_layers(fn):
 
 
 class _DeclarativeFunction:
-    """cf. reference program_translator.StaticFunction: per-signature
-    program cache + executor dispatch."""
+    """cf. reference program_translator.StaticFunction: AST-transform the
+    source (dygraph_to_static/) so data-dependent Python control flow
+    becomes layers.cond / layers.while_loop in the captured program, with
+    a per-signature program cache + executor dispatch."""
 
     def __init__(self, fn):
         self._fn = fn
+        self._transformed = None
+        self._transform_tried = False
         self._cache = {}
         functools.update_wrapper(self, fn)
+
+    def _static_fn(self):
+        """The AST-rewritten function (falls back to the original when
+        source is unavailable — plain trace capture, control flow baked)."""
+        if not self._transform_tried:
+            self._transform_tried = True
+            from .dygraph_to_static import transform_function
+
+            self._transformed = transform_function(self._fn)
+        return self._transformed or self._fn
+
+    @property
+    def code(self):
+        """Rewritten source (reference StaticFunction.code) for debugging."""
+        fn = self._static_fn()
+        return getattr(fn, "__dy2st_source__", None)
 
     def __get__(self, obj, objtype=None):
         # decorating Layer.forward: bind like a method (per-instance cache
@@ -181,9 +201,11 @@ class _DeclarativeFunction:
         if args and isinstance(args[0], Layer):
             bound_self, args = args[0], args[1:]
 
+        static_fn = self._static_fn()
+
         def call_fn(*xs):
-            return self._fn(bound_self, *xs) if bound_self is not None \
-                else self._fn(*xs)
+            return static_fn(bound_self, *xs) if bound_self is not None \
+                else static_fn(*xs)
 
         if framework._dygraph_tracer is None:
             return call_fn(*args)  # already static: plain build

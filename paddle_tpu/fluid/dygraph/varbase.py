@@ -92,6 +92,9 @@ class VarBase(framework.Variable):
     def __float__(self):
         return float(self.numpy())
 
+    def __bool__(self):
+        return bool(self.numpy())  # eager: true data-dependent truthiness
+
     def __len__(self):
         return int(self.data.shape[0])
 

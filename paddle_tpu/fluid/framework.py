@@ -59,6 +59,16 @@ class Variable:
         self.is_data = is_data
 
     # -- helpers ------------------------------------------------------------
+    def __bool__(self):
+        # a static Variable has no value at build time; silently defaulting
+        # to True would bake one branch of `if tensor:` into the program
+        raise RuntimeError(
+            "Cannot use a static-graph Variable '%s' as a Python bool. "
+            "Use layers.cond / layers.while_loop, or decorate the function "
+            "with @declarative so data-dependent control flow converts "
+            "automatically." % self.name
+        )
+
     @property
     def ndim(self):
         return len(self.shape) if self.shape is not None else None
@@ -141,6 +151,40 @@ class Variable:
         from .layers import nn as _nn
 
         return _nn.matmul(self, o)
+
+    # comparisons build compare ops (needed by dygraph_to_static rewritten
+    # conditions; __eq__ deliberately stays identity so Variables keep
+    # working in sets/dicts — use layers.equal for elementwise equality)
+    def _compare(self, other, op_type):
+        from .layers import tensor as _t
+
+        if not isinstance(other, Variable):
+            # keep float operands exact even against int tensors (the
+            # compare lowering promotes dtypes like numpy)
+            if isinstance(other, float) and "int" in self.dtype:
+                dt = "float32"
+            elif isinstance(other, bool):
+                dt = "bool"
+            else:
+                dt = self.dtype
+            other = _t.fill_constant([1], dt, float(other))
+        from .layers.common import append_simple_op
+
+        return append_simple_op(
+            op_type, {"X": self, "Y": other}, dtype="bool", stop_gradient=True
+        )
+
+    def __lt__(self, o):
+        return self._compare(o, "less_than")
+
+    def __le__(self, o):
+        return self._compare(o, "less_equal")
+
+    def __gt__(self, o):
+        return self._compare(o, "greater_than")
+
+    def __ge__(self, o):
+        return self._compare(o, "greater_equal")
 
 
 class Parameter(Variable):

@@ -65,6 +65,15 @@ def _captures(block, arg_names):
     return caps
 
 
+def _passthrough(block, outs, arg_names=()):
+    """Sub-block outputs no op produces — outer vars returned untouched
+    (e.g. the unchanged side of a converted `if`); they must be captured."""
+    produced = set(arg_names)
+    for op in block.ops:
+        produced.update(op.all_output_names())
+    return [v.name for v in outs if v.name not in produced]
+
+
 @register_op("cond", inputs=["Cond", "Captures"], outputs=["Out"], grad="auto")
 def _cond_op(ctx, ins, attrs):
     pred = ins["Cond"][0]
@@ -337,7 +346,10 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
             )
 
     caps = sorted(
-        set(_captures(t_block, [])) | set(_captures(f_block, []))
+        set(_captures(t_block, []))
+        | set(_captures(f_block, []))
+        | set(_passthrough(t_block, t_outs))
+        | set(_passthrough(f_block, f_outs))
     )
     block = framework.default_main_program().current_block()
     outs = []
@@ -435,7 +447,12 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
             )
 
     caps = sorted(
-        (set(_captures(c_block, var_names)) | set(_captures(b_block, var_names)))
+        (
+            set(_captures(c_block, var_names))
+            | set(_captures(b_block, var_names))
+            | set(_passthrough(b_block, b_outs, var_names))
+            | set(_passthrough(c_block, c_outs, var_names))
+        )
         - set(var_names)
     )
     outs = []

@@ -291,5 +291,9 @@ def logical_and(x, y):
     return append_simple_op("logical_and", {"X": x, "Y": y}, dtype="bool", stop_gradient=True)
 
 
+def logical_or(x, y):
+    return append_simple_op("logical_or", {"X": x, "Y": y}, dtype="bool", stop_gradient=True)
+
+
 def logical_not(x):
     return append_simple_op("logical_not", {"X": x}, dtype="bool", stop_gradient=True)
