@@ -156,8 +156,50 @@ def _dropout_grad_maker(op, get_out_grad, new_grad_name, block):
     ]
 
 
+def _lookup_table_grad_maker(op, get_out_grad, new_grad_name, block):
+    """SelectedRows cover (reference `selected_rows.h:1`): with
+    is_sparse=True the table's grad becomes a (Rows, Values) pair plus a
+    marker grad Variable carrying `.selected_rows`; the optimizer emits a
+    sparse scatter update instead of a dense one.  Dense mode (the default)
+    returns None to fall through to the generic VJP path."""
+    if not op.attrs.get("is_sparse"):
+        return None
+    g = get_out_grad(op.output("Out")[0])
+    if g is None:
+        return []
+    w_name = op.input("W")[0]
+    ids_name = op.input("Ids")[0]
+    w = block._find_var_recursive(w_name)
+    ids = block._find_var_recursive(ids_name)
+    n = 1
+    for s in ids.shape:
+        if s == -1:
+            n = -1
+            break
+        n *= int(s)
+    gw = new_grad_name(w_name)
+    rows_name, vals_name = gw + "@ROWS", gw + "@VALUES"
+    block.create_var(name=rows_name, shape=(n,), dtype="int32",
+                     stop_gradient=True)
+    block.create_var(name=vals_name, shape=(n, int(w.shape[1])),
+                     dtype=w.dtype, stop_gradient=True)
+    # the grad var itself is a marker: no op produces it, the executor
+    # errors loudly if anything tries to read it as a dense array
+    block.var(gw).selected_rows = (rows_name, vals_name)
+    return [
+        (
+            "lookup_table_sparse_grad",
+            {"Ids": [ids_name], "OutGrad": [g]},
+            {"Rows": [rows_name], "Values": [vals_name]},
+            {"padding_idx": op.attrs.get("padding_idx", -1)},
+            {},
+        )
+    ]
+
+
 CUSTOM_GRAD_MAKERS = {
     "dropout_grad_maker": _dropout_grad_maker,
+    "lookup_table_grad_maker": _lookup_table_grad_maker,
 }
 
 
@@ -225,6 +267,15 @@ def _append_backward_for_targets(
             return None
         if len(lst) == 1:
             return lst[0]
+        for pname in lst:
+            pv = block._find_var_recursive(pname)
+            if pv is not None and getattr(pv, "selected_rows", None):
+                raise NotImplementedError(
+                    "parameter '%s' receives multiple gradients and at "
+                    "least one is sparse (SelectedRows) — a table used by "
+                    "an is_sparse=True embedding cannot be shared with "
+                    "other consumers; set is_sparse=False" % var_name
+                )
         total = framework.grad_var_name(var_name) + "@SUM"
         if block.has_var(total):  # a previous sweep already used this name
             total = framework.unique_name.generate(total)
@@ -278,13 +329,15 @@ def _append_backward_for_targets(
         if opdef.grad_maker is None:
             continue
 
-        # custom maker?
+        # custom maker?  (returning None falls through to the generic path)
         if isinstance(opdef.grad_maker, str) and opdef.grad_maker != "auto":
             maker = CUSTOM_GRAD_MAKERS[opdef.grad_maker]
             specs = maker(op, get_total_grad, new_grad_name, block)
-            for type_, ins_, outs_, attrs_, _gradmap in specs:
-                block.append_op(type_, inputs=ins_, outputs=outs_, attrs=attrs_, infer=False)
-            continue
+            if specs is not None:
+                for type_, ins_, outs_, attrs_, _gradmap in specs:
+                    block.append_op(type_, inputs=ins_, outputs=outs_,
+                                    attrs=attrs_, infer=False)
+                continue
 
         # generic vjp path
         grad_in_slots = []

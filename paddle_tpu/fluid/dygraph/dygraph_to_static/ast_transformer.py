@@ -226,6 +226,12 @@ class BreakContinueTransformer(ast.NodeTransformer):
         has_cont = _contains(node.body, (ast.Continue,), stop_at_loops=True)
         if not (has_brk or has_cont):
             return node
+        # flags are only honored by loops the later passes convert; a
+        # non-range for, or any loop with an else clause, keeps REAL
+        # break/continue (Python semantics; a tensor condition around them
+        # then raises the Variable.__bool__ guidance error)
+        if node.orelse or (is_for and not _is_convertible_for(node)):
+            return node
         brk = self._fresh("brk") if has_brk else None
         cont = self._fresh("cont") if has_cont else None
 
@@ -319,6 +325,19 @@ class BreakContinueTransformer(ast.NodeTransformer):
 # ---------------------------------------------------------------------------
 
 
+def _is_convertible_for(node):
+    """The for-loops ForToWhileTransformer rewrites: `for <name> in
+    range(...)` with no else clause."""
+    it = node.iter
+    return (
+        isinstance(it, ast.Call)
+        and isinstance(it.func, ast.Name)
+        and it.func.id == "range"
+        and isinstance(node.target, ast.Name)
+        and not node.orelse
+    )
+
+
 class ForToWhileTransformer(ast.NodeTransformer):
     """`for i in range(...)` -> counter while (other iterables unroll at
     trace time, which is the right call for Python lists under XLA)."""
@@ -328,15 +347,9 @@ class ForToWhileTransformer(ast.NodeTransformer):
 
     def visit_For(self, node):
         self.generic_visit(node)
-        it = node.iter
-        if not (
-            isinstance(it, ast.Call)
-            and isinstance(it.func, ast.Name)
-            and it.func.id == "range"
-            and isinstance(node.target, ast.Name)
-            and not node.orelse
-        ):
+        if not _is_convertible_for(node):
             return node
+        it = node.iter
         self._uid += 1
         a = it.args
         start = a[0] if len(a) >= 2 else ast.Constant(value=0)
@@ -451,6 +464,12 @@ class IfElseTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         if _has_return(node.body) or _has_return(node.orelse):
             return node  # python semantics (tensor cond raises guidance)
+        if _contains(node.body + node.orelse, (ast.Break, ast.Continue),
+                     stop_at_loops=True):
+            # a surviving real break/continue (unconvertible enclosing
+            # loop): converting this if would move it into a function and
+            # make it a SyntaxError — keep Python semantics
+            return node
         node.test = _rewrite_test(node.test)
         names = _assigned_names(node.body + node.orelse)
         self._uid += 1

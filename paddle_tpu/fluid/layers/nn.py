@@ -58,9 +58,10 @@ def embedding(
     param_attr=None,
     dtype="float32",
 ):
-    """cf. reference nn.py embedding / lookup_table op.  is_sparse is accepted
-    for API parity; on TPU the gather/scatter-add path is already sparse-safe
-    under XLA (SelectedRows capability subsumed)."""
+    """cf. reference nn.py embedding / lookup_table op.  With
+    is_sparse=True the gradient of W is SelectedRows-style — a
+    (Rows, Values) pair the optimizer applies as an O(N*D) scatter
+    (backward.py _lookup_table_grad_maker; cf. `selected_rows.h:1`)."""
     helper = LayerHelper("embedding")
     w = helper.create_parameter(param_attr, list(size), dtype=dtype)
     if padding_idx is None:
@@ -72,7 +73,7 @@ def embedding(
     return append_simple_op(
         "lookup_table",
         {"W": w, "Ids": input},
-        {"padding_idx": pad},
+        {"padding_idx": pad, "is_sparse": bool(is_sparse)},
         dtype=dtype,
     )
 

@@ -12,6 +12,10 @@ _UNARY = [
     "relu", "sigmoid", "tanh", "exp", "log", "sqrt", "rsqrt", "abs",
     "square", "reciprocal", "floor", "ceil", "round", "sin", "cos",
     "softplus", "softsign", "silu", "erf", "sign", "logsigmoid",
+    # round-2 breadth (extra_ops.py; cf. activation_op.cc full registry)
+    "sinh", "cosh", "tan", "asin", "acos", "atan", "asinh", "acosh",
+    "atanh", "expm1", "log1p", "log2", "log10", "lgamma", "digamma",
+    "erfinv", "erfc", "trunc", "frac", "tanh_shrink", "mish", "selu",
 ]
 
 _module = sys.modules[__name__]
@@ -209,3 +213,106 @@ def cumsum(x, axis=-1, exclusive=False, reverse=False):
     return append_simple_op(
         "cumsum", {"X": x}, {"axis": axis, "exclusive": exclusive, "reverse": reverse}
     )
+
+
+# -- round-2 breadth wrappers (linalg_ops.py / extra_ops.py) -----------------
+
+def kron(x, y, name=None):
+    return append_simple_op("kron", {"X": x, "Y": y})
+
+
+def einsum(equation, *operands):
+    return append_simple_op("einsum", {"Operands": list(operands)},
+                            {"equation": equation})
+
+
+def cholesky(x, upper=False, name=None):
+    return append_simple_op("cholesky", {"X": x}, {"upper": upper})
+
+
+def inverse(x, name=None):
+    return append_simple_op("inverse", {"Input": x}, out_slots=("Output",))
+
+
+def matrix_power(x, n, name=None):
+    return append_simple_op("matrix_power", {"X": x}, {"n": int(n)})
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return append_simple_op(
+        "triangular_solve", {"X": x, "Y": y},
+        {"upper": upper, "transpose": transpose,
+         "unitriangular": unitriangular})
+
+
+def cross(x, y, axis=None, name=None):
+    return append_simple_op("cross", {"X": x, "Y": y}, {"dim": axis})
+
+
+def multi_dot(xs, name=None):
+    return append_simple_op("multi_dot", {"X": list(xs)})
+
+
+def roll(x, shifts, axis=None, name=None):
+    shifts = shifts if isinstance(shifts, (list, tuple)) else [shifts]
+    axis = axis if axis is None or isinstance(axis, (list, tuple)) else [axis]
+    return append_simple_op("roll", {"X": x}, {"shifts": list(shifts),
+                                               "axis": axis})
+
+
+def flip(x, axis, name=None):
+    axis = axis if isinstance(axis, (list, tuple)) else [axis]
+    return append_simple_op("flip", {"X": x}, {"axis": list(axis)})
+
+
+def broadcast_to(x, shape, name=None):
+    return append_simple_op("broadcast_to", {"X": x}, {"shape": list(shape)})
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return append_simple_op("logsumexp", {"X": x},
+                            {"axis": axis, "keepdim": keepdim})
+
+
+def instance_norm(x, scale=None, bias=None, epsilon=1e-5, name=None):
+    ins = {"X": x}
+    if scale is not None:
+        ins["Scale"] = scale
+    if bias is not None:
+        ins["Bias"] = bias
+    return append_simple_op("instance_norm", ins, {"epsilon": epsilon},
+                            out_slots=("Y",))
+
+
+def grid_sampler(x, grid, align_corners=True, name=None):
+    return append_simple_op("grid_sampler", {"X": x, "Grid": grid},
+                            {"align_corners": align_corners},
+                            out_slots=("Output",))
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    return append_simple_op("affine_grid", {"Theta": theta},
+                            {"output_shape": list(out_shape),
+                             "align_corners": align_corners},
+                            out_slots=("Output",))
+
+
+def pixel_shuffle(x, upscale_factor, name=None):
+    return append_simple_op("pixel_shuffle", {"X": x},
+                            {"upscale_factor": int(upscale_factor)})
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    return append_simple_op("kldiv_loss", {"X": x, "Target": target},
+                            {"reduction": reduction}, out_slots=("Loss",))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    ins = {"X": label}
+    if prior_dist is not None:
+        ins["PriorDist"] = prior_dist
+    return append_simple_op("label_smooth", ins, {"epsilon": epsilon})
+
+
+def cos_sim(x, y, name=None):
+    return append_simple_op("cos_sim", {"X": x, "Y": y})

@@ -7,6 +7,8 @@ the kernel backend and fuser.
 """
 
 from . import (  # noqa: F401
+    extra_ops,
+    linalg_ops,
     math_ops,
     metric_ops,
     nn_ops,

@@ -1,0 +1,617 @@
+"""Breadth op families beyond the round-1 core.
+
+Capability parity by family (reference `paddle/fluid/operators/`):
+- activations: activation_op.cc (the full registry, not just the common 12)
+- manipulation: roll_op.cc, flip_op.cc, meshgrid_op.cc, expand_v2_op.cc,
+  repeat_interleave (newer tree), take/put_along_axis, scatter_nd_op.cc,
+  unfold_op.cc, argsort_op.cc (sort), searchsorted, kthvalue, shard_index_op.cc
+- losses: kldiv_loss_op.cc, log_loss_op.cc, label_smooth_op.cc,
+  margin_rank_loss_op.cc, hinge_loss_op.cc, cos_sim_op.cc, nll_loss_op.cc,
+  rank_loss_op.cc, bce_loss_op.cc, smooth_l1_loss_op.cc
+- norms: instance_norm_op.cc, sync_batch_norm_op.cu (psum of batch stats
+  over the data-parallel axis — here a mesh-axis pmean inside shard_map),
+  spectral_norm_op.cc, data_norm_op.cc
+- vision: grid_sampler_op.cc, affine_grid_op.cc, interpolate_op.cc
+  (bilinear/nearest), pixel_shuffle_op.cc, conv3d (conv_op.cc), pool3d
+  (pool_op.cc)
+
+Every lowering is pure jnp/lax; XLA fuses and tiles them (the reference
+hand-wrote one CUDA kernel per op per dtype).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+# ---------------------------------------------------------------------------
+# activation extras (cf. activation_op.cc full registry)
+# ---------------------------------------------------------------------------
+
+
+def _register_unary(name, fn):
+    @register_op(name, inputs=["X"], outputs=["Out"])
+    def _lower(ctx, ins, attrs, fn=fn):
+        return {"Out": [fn(ins["X"][0], attrs)]}
+
+
+_register_unary("sinh", lambda x, a: jnp.sinh(x))
+_register_unary("cosh", lambda x, a: jnp.cosh(x))
+_register_unary("tan", lambda x, a: jnp.tan(x))
+_register_unary("asin", lambda x, a: jnp.arcsin(x))
+_register_unary("acos", lambda x, a: jnp.arccos(x))
+_register_unary("atan", lambda x, a: jnp.arctan(x))
+_register_unary("asinh", lambda x, a: jnp.arcsinh(x))
+_register_unary("acosh", lambda x, a: jnp.arccosh(x))
+_register_unary("atanh", lambda x, a: jnp.arctanh(x))
+_register_unary("expm1", lambda x, a: jnp.expm1(x))
+_register_unary("log1p", lambda x, a: jnp.log1p(x))
+_register_unary("log2", lambda x, a: jnp.log2(x))
+_register_unary("log10", lambda x, a: jnp.log10(x))
+_register_unary("lgamma", lambda x, a: jax.lax.lgamma(x))
+_register_unary("digamma", lambda x, a: jax.lax.digamma(x))
+_register_unary("erfinv", lambda x, a: jax.lax.erf_inv(x))
+_register_unary("trunc", lambda x, a: jnp.trunc(x))
+_register_unary("frac", lambda x, a: x - jnp.trunc(x))
+_register_unary(
+    "hard_swish",
+    lambda x, a: x * jnp.clip(
+        x / a.get("scale", 6.0) + a.get("offset", 3.0) / a.get("scale", 6.0),
+        0.0, 1.0,
+    ),
+)
+_register_unary(
+    "hard_shrink",
+    lambda x, a: jnp.where(
+        jnp.abs(x) > a.get("threshold", 0.5), x, 0.0
+    ),
+)
+_register_unary(
+    "softshrink",
+    lambda x, a: jnp.sign(x) * jnp.maximum(
+        jnp.abs(x) - a.get("lambda", 0.5), 0.0
+    ),
+)
+_register_unary("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_register_unary(
+    "thresholded_relu",
+    lambda x, a: jnp.where(x > a.get("threshold", 1.0), x, 0.0),
+)
+_register_unary(
+    "stanh",
+    lambda x, a: a.get("scale_b", 1.7159)
+    * jnp.tanh(a.get("scale_a", 0.67) * x),
+)
+_register_unary("mish", lambda x, a: x * jnp.tanh(jax.nn.softplus(x)))
+_register_unary("celu", lambda x, a: jax.nn.celu(x, a.get("alpha", 1.0)))
+_register_unary("selu", lambda x, a: jax.nn.selu(x))
+_register_unary("erfc", lambda x, a: jax.lax.erfc(x))
+
+
+@register_op("atan2", inputs=["X1", "X2"], outputs=["Out"])
+def _atan2(ctx, ins, attrs):
+    return {"Out": [jnp.arctan2(ins["X1"][0], ins["X2"][0])]}
+
+
+@register_op("logsumexp", inputs=["X"], outputs=["Out"])
+def _logsumexp(ctx, ins, attrs):
+    axis = attrs.get("axis")
+    keepdim = attrs.get("keepdim", False)
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return {"Out": [jax.scipy.special.logsumexp(
+        ins["X"][0], axis=axis, keepdims=keepdim
+    )]}
+
+
+@register_op("cumprod", inputs=["X"], outputs=["Out"])
+def _cumprod(ctx, ins, attrs):
+    return {"Out": [jnp.cumprod(ins["X"][0], axis=int(attrs.get("dim", -1)))]}
+
+
+# ---------------------------------------------------------------------------
+# manipulation
+# ---------------------------------------------------------------------------
+
+
+@register_op("roll", inputs=["X"], outputs=["Out"])
+def _roll(ctx, ins, attrs):
+    shifts = attrs["shifts"]
+    axis = attrs.get("axis")
+    if axis is None or axis == []:
+        return {"Out": [jnp.roll(ins["X"][0].reshape(-1),
+                                 shifts[0]).reshape(ins["X"][0].shape)]}
+    return {"Out": [jnp.roll(ins["X"][0], tuple(shifts), tuple(axis))]}
+
+
+@register_op("flip", inputs=["X"], outputs=["Out"])
+def _flip(ctx, ins, attrs):
+    return {"Out": [jnp.flip(ins["X"][0], axis=tuple(attrs["axis"]))]}
+
+
+@register_op("meshgrid", inputs=["X"], outputs=["Out"])
+def _meshgrid(ctx, ins, attrs):
+    return {"Out": list(jnp.meshgrid(*ins["X"], indexing="ij"))}
+
+
+@register_op("broadcast_to", inputs=["X"], outputs=["Out"])
+def _broadcast_to(ctx, ins, attrs):
+    return {"Out": [jnp.broadcast_to(ins["X"][0], tuple(attrs["shape"]))]}
+
+
+@register_op("repeat_interleave", inputs=["X"], outputs=["Out"])
+def _repeat_interleave(ctx, ins, attrs):
+    return {"Out": [jnp.repeat(
+        ins["X"][0], int(attrs["repeats"]), axis=attrs.get("dim")
+    )]}
+
+
+@register_op("take_along_axis", inputs=["Input", "Index"], outputs=["Result"],
+             no_grad_slots=("Index",))
+def _take_along_axis(ctx, ins, attrs):
+    return {"Result": [jnp.take_along_axis(
+        ins["Input"][0], ins["Index"][0].astype(jnp.int32),
+        axis=int(attrs["Axis"]),
+    )]}
+
+
+@register_op("put_along_axis", inputs=["Input", "Index", "Value"],
+             outputs=["Result"], no_grad_slots=("Index",))
+def _put_along_axis(ctx, ins, attrs):
+    x, idx, v = ins["Input"][0], ins["Index"][0], ins["Value"][0]
+    axis = int(attrs["Axis"])
+    reduce = attrs.get("Reduce", "assign")
+    idx = idx.astype(jnp.int32)
+    dims = [jnp.arange(s) for s in idx.shape]
+    grids = jnp.meshgrid(*dims, indexing="ij")
+    grids[axis] = idx
+    v = jnp.broadcast_to(v, idx.shape)
+    if reduce == "add":
+        return {"Result": [x.at[tuple(grids)].add(v)]}
+    if reduce == "multiply" or reduce == "mul":
+        return {"Result": [x.at[tuple(grids)].multiply(v)]}
+    return {"Result": [x.at[tuple(grids)].set(v)]}
+
+
+@register_op("scatter_nd_add", inputs=["X", "Index", "Updates"],
+             outputs=["Out"], no_grad_slots=("Index",))
+def _scatter_nd_add(ctx, ins, attrs):
+    x, idx, upd = ins["X"][0], ins["Index"][0], ins["Updates"][0]
+    idx = idx.astype(jnp.int32)
+    return {"Out": [x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)]}
+
+
+@register_op("unfold", inputs=["X"], outputs=["Y"])
+def _unfold(ctx, ins, attrs):
+    """im2col (cf. unfold_op.cc / math/im2col.cc): [N,C,H,W] ->
+    [N, C*kh*kw, L] — the MXU-friendly patch extraction."""
+    x = ins["X"][0]
+    kh, kw = attrs["kernel_sizes"]
+    sh, sw = attrs.get("strides", [1, 1])
+    ph, pw = attrs.get("paddings", [0, 0])[:2]
+    dh, dw = attrs.get("dilations", [1, 1])
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = x[:, :, i * dh:i * dh + oh * sh:sh,
+                      j * dw:j * dw + ow * sw:sw]
+            cols.append(patch)
+    out = jnp.stack(cols, axis=2)  # [N, C, kh*kw, oh, ow]
+    return {"Y": [out.reshape(n, c * kh * kw, oh * ow)]}
+
+
+@register_op("sort", inputs=["X"], outputs=["Out", "Indices"],
+             stateful_out_slots=("Indices",))
+def _sort(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", -1))
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(x, axis=axis, descending=desc)
+    return {"Out": [jnp.take_along_axis(x, idx, axis=axis)],
+            "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("searchsorted", inputs=["SortedSequence", "Values"],
+             outputs=["Out"], grad=None)
+def _searchsorted(ctx, ins, attrs):
+    seq, vals = ins["SortedSequence"][0], ins["Values"][0]
+    side = "right" if attrs.get("right", False) else "left"
+    if seq.ndim == 1:
+        out = jnp.searchsorted(seq, vals, side=side)
+    else:
+        out = jax.vmap(
+            lambda s, v: jnp.searchsorted(s, v, side=side)
+        )(seq.reshape(-1, seq.shape[-1]), vals.reshape(-1, vals.shape[-1]))
+        out = out.reshape(vals.shape)
+    dt = jnp.int32 if attrs.get("out_int32", False) else jnp.int64
+    return {"Out": [out.astype(dt)]}
+
+
+@register_op("kthvalue", inputs=["X"], outputs=["Out", "Indices"],
+             stateful_out_slots=("Indices",))
+def _kthvalue(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = int(attrs["k"])
+    axis = int(attrs.get("axis", -1))
+    keepdim = attrs.get("keepdim", False)
+    idx = jnp.argsort(x, axis=axis)
+    kth_idx = jnp.take(idx, k - 1, axis=axis)
+    out = jnp.take_along_axis(
+        x, jnp.expand_dims(kth_idx, axis), axis=axis
+    )
+    if not keepdim:
+        out = jnp.squeeze(out, axis)
+    return {"Out": [out], "Indices": [kth_idx.astype(jnp.int64)]}
+
+
+@register_op("shard_index", inputs=["X"], outputs=["Out"], grad=None)
+def _shard_index(ctx, ins, attrs):
+    """cf. shard_index_op.cc: map global ids to shard-local ids."""
+    x = ins["X"][0]
+    index_num = int(attrs["index_num"])
+    nshards = int(attrs["nshards"])
+    shard_id = int(attrs["shard_id"])
+    ignore = int(attrs.get("ignore_value", -1))
+    per = (index_num + nshards - 1) // nshards
+    mine = (x // per) == shard_id
+    return {"Out": [jnp.where(mine, x % per, ignore)]}
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+@register_op("kldiv_loss", inputs=["X", "Target"], outputs=["Loss"])
+def _kldiv_loss(ctx, ins, attrs):
+    x, t = ins["X"][0], ins["Target"][0]  # x is log-prob (reference semantics)
+    loss = t * (jnp.log(jnp.maximum(t, 1e-10)) - x)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        return {"Loss": [jnp.mean(loss)]}
+    if red == "sum":
+        return {"Loss": [jnp.sum(loss)]}
+    if red == "batchmean":
+        return {"Loss": [jnp.sum(loss) / x.shape[0]]}
+    return {"Loss": [loss]}
+
+
+@register_op("log_loss", inputs=["Predicted", "Labels"], outputs=["Loss"])
+def _log_loss(ctx, ins, attrs):
+    p, l = ins["Predicted"][0], ins["Labels"][0]
+    e = float(attrs.get("epsilon", 1e-4))
+    return {"Loss": [-l * jnp.log(p + e) - (1 - l) * jnp.log(1 - p + e)]}
+
+
+@register_op("label_smooth", inputs=["X", "PriorDist"], outputs=["Out"])
+def _label_smooth(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = float(attrs.get("epsilon", 0.1))
+    if ins.get("PriorDist"):
+        prior = ins["PriorDist"][0]
+        return {"Out": [(1 - eps) * x + eps * prior]}
+    return {"Out": [(1 - eps) * x + eps / x.shape[-1]]}
+
+
+@register_op("margin_rank_loss", inputs=["X1", "X2", "Label"],
+             outputs=["Out"], no_grad_slots=("Label",))
+def _margin_rank_loss(ctx, ins, attrs):
+    m = float(attrs.get("margin", 0.0))
+    x1, x2, l = ins["X1"][0], ins["X2"][0], ins["Label"][0]
+    return {"Out": [jnp.maximum(0.0, -l * (x1 - x2) + m)]}
+
+
+@register_op("hinge_loss", inputs=["Logits", "Labels"], outputs=["Loss"],
+             no_grad_slots=("Labels",))
+def _hinge_loss(ctx, ins, attrs):
+    x, y = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2.0 * y - 1.0) * x)]}
+
+
+@register_op("cos_sim", inputs=["X", "Y"], outputs=["Out"])
+def _cos_sim(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, -1, keepdims=True))
+    return {"Out": [jnp.sum(x * y, -1, keepdims=True) / (xn * yn + 1e-12)]}
+
+
+@register_op("nll_loss", inputs=["X", "Label", "Weight"], outputs=["Out"],
+             no_grad_slots=("Label",))
+def _nll_loss(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]  # x: [N, C] log-probs
+    w = ins["Weight"][0] if ins.get("Weight") else jnp.ones(x.shape[1], x.dtype)
+    label = label.reshape(-1).astype(jnp.int32)
+    picked = -jnp.take_along_axis(x, label[:, None], axis=1)[:, 0]
+    wl = w[label]
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        return {"Out": [jnp.sum(picked * wl) / jnp.sum(wl)]}
+    if red == "sum":
+        return {"Out": [jnp.sum(picked * wl)]}
+    return {"Out": [picked * wl]}
+
+
+@register_op("rank_loss", inputs=["Label", "Left", "Right"], outputs=["Out"],
+             no_grad_slots=("Label",))
+def _rank_loss(ctx, ins, attrs):
+    l, x1, x2 = ins["Label"][0], ins["Left"][0], ins["Right"][0]
+    d = x1 - x2
+    return {"Out": [jax.nn.softplus(d) - l * d]}
+
+
+@register_op("bce_loss", inputs=["X", "Label"], outputs=["Out"])
+def _bce_loss(ctx, ins, attrs):
+    x, l = ins["X"][0], ins["Label"][0]
+    x = jnp.clip(x, 1e-12, 1.0 - 1e-7)
+    return {"Out": [-(l * jnp.log(x) + (1 - l) * jnp.log(1 - x))]}
+
+
+@register_op("smooth_l1_loss", inputs=["X", "Y"], outputs=["Out", "Diff"],
+             stateful_out_slots=("Diff",))
+def _smooth_l1_loss(ctx, ins, attrs):
+    d = ins["X"][0] - ins["Y"][0]
+    sigma = float(attrs.get("sigma", 1.0))
+    s2 = sigma * sigma
+    a = jnp.abs(d)
+    loss = jnp.where(a < 1.0 / s2, 0.5 * s2 * d * d, a - 0.5 / s2)
+    return {"Out": [loss], "Diff": [d]}
+
+
+# ---------------------------------------------------------------------------
+# norm variants
+# ---------------------------------------------------------------------------
+
+
+@register_op("instance_norm", inputs=["X", "Scale", "Bias"],
+             outputs=["Y", "SavedMean", "SavedVariance"],
+             stateful_out_slots=("SavedMean", "SavedVariance"))
+def _instance_norm(ctx, ins, attrs):
+    x = ins["X"][0]  # [N, C, ...]
+    eps = float(attrs.get("epsilon", 1e-5))
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(shape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(shape)
+    return {"Y": [y], "SavedMean": [jnp.squeeze(mean)],
+            "SavedVariance": [jnp.squeeze(var)]}
+
+
+@register_op(
+    "sync_batch_norm",
+    inputs=["X", "Scale", "Bias", "Mean", "Variance"],
+    outputs=["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+    stateful_out_slots=("MeanOut", "VarianceOut", "SavedMean",
+                        "SavedVariance"),
+)
+def _sync_batch_norm(ctx, ins, attrs):
+    """cf. sync_batch_norm_op.cu: batch statistics are averaged across the
+    data-parallel ranks (there: ncclAllReduce of sum/sum-of-squares; here:
+    lax.pmean over the `dp` mesh axis when the program runs inside
+    shard_map — outside any mapped axis it degenerates to plain BN,
+    matching one-rank reference behavior)."""
+    from ...distributed.collective import _axis_bound
+
+    x = ins["X"][0]
+    eps = float(attrs.get("epsilon", 1e-5))
+    mom = float(attrs.get("momentum", 0.9))
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    axes = (0,) + tuple(range(2, x.ndim))
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    r_mean, r_var = ins["Mean"][0], ins["Variance"][0]
+    if is_test:
+        mean, var = r_mean, r_var
+        new_mean, new_var = r_mean, r_var
+    else:
+        mean = jnp.mean(x, axis=axes)
+        sq = jnp.mean(x * x, axis=axes)
+        if _axis_bound("dp"):
+            mean = jax.lax.pmean(mean, "dp")
+            sq = jax.lax.pmean(sq, "dp")
+        var = sq - mean * mean
+        new_mean = mom * r_mean + (1 - mom) * mean
+        new_var = mom * r_var + (1 - mom) * var
+    y = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + eps)
+    y = y * ins["Scale"][0].reshape(shape) + ins["Bias"][0].reshape(shape)
+    return {
+        "Y": [y], "MeanOut": [new_mean], "VarianceOut": [new_var],
+        "SavedMean": [mean], "SavedVariance": [var],
+    }
+
+
+@register_op("spectral_norm", inputs=["Weight", "U", "V"], outputs=["Out"],
+             no_grad_slots=("U", "V"))
+def _spectral_norm(ctx, ins, attrs):
+    """cf. spectral_norm_op.cc: power-iteration estimate of sigma_max, then
+    W / sigma.  U/V are persistent estimate vectors (updated outside)."""
+    w = ins["Weight"][0]
+    u, v = ins["U"][0], ins["V"][0]
+    dim = int(attrs.get("dim", 0))
+    power_iters = int(attrs.get("power_iters", 1))
+    eps = float(attrs.get("eps", 1e-12))
+    w_mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    for _ in range(power_iters):
+        v = w_mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = w_mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ w_mat @ v
+    return {"Out": [w / sigma]}
+
+
+@register_op(
+    "data_norm", inputs=["X", "BatchSize", "BatchSum", "BatchSquareSum"],
+    outputs=["Y", "Means", "Scales"],
+    stateful_out_slots=("Means", "Scales"),
+)
+def _data_norm(ctx, ins, attrs):
+    """cf. data_norm_op.cc: normalization by accumulated batch statistics
+    (CTR models); the running counters update outside the op."""
+    x = ins["X"][0]
+    n = ins["BatchSize"][0]
+    s = ins["BatchSum"][0]
+    ss = ins["BatchSquareSum"][0]
+    means = s / n
+    scales = jnp.sqrt(n / ss)
+    return {"Y": [(x - means) * scales], "Means": [means],
+            "Scales": [scales]}
+
+
+# ---------------------------------------------------------------------------
+# vision
+# ---------------------------------------------------------------------------
+
+
+@register_op("affine_grid", inputs=["Theta"], outputs=["Output"])
+def _affine_grid(ctx, ins, attrs):
+    """cf. affine_grid_op.cc: [N,2,3] theta -> [N,H,W,2] sampling grid."""
+    theta = ins["Theta"][0]
+    n, h, w = theta.shape[0], attrs["output_shape"][2], attrs["output_shape"][3]
+    align = attrs.get("align_corners", True)
+    if align:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) + 0.5) * 2.0 / h - 1.0
+        xs = (jnp.arange(w) + 0.5) * 2.0 / w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H,W,3]
+    out = jnp.einsum("hwk,nck->nhwc", base, theta)  # [N,H,W,2]
+    return {"Output": [out]}
+
+
+@register_op("grid_sampler", inputs=["X", "Grid"], outputs=["Output"])
+def _grid_sampler(ctx, ins, attrs):
+    """cf. grid_sampler_op.cc: bilinear sample of [N,C,H,W] at [N,Ho,Wo,2]
+    normalized coordinates (zero padding outside)."""
+    x, grid = ins["X"][0], ins["Grid"][0]
+    n, c, h, w = x.shape
+    align = attrs.get("align_corners", True)
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align:
+        fx = (gx + 1.0) * (w - 1) / 2.0
+        fy = (gy + 1.0) * (h - 1) / 2.0
+    else:
+        fx = ((gx + 1.0) * w - 1.0) / 2.0
+        fy = ((gy + 1.0) * h - 1.0) / 2.0
+    x0 = jnp.floor(fx)
+    y0 = jnp.floor(fy)
+    wx = fx - x0
+    wy = fy - y0
+
+    def gather(yi, xi):
+        inside = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        # [N,Ho,Wo] index into [N,C,H,W] -> [N,C,Ho,Wo]
+        v = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, yc, xc)
+        return v * inside[:, None, :, :]
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wx = wx[:, None]
+    wy = wy[:, None]
+    out = (
+        v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+        + v10 * (1 - wx) * wy + v11 * wx * wy
+    )
+    return {"Output": [out]}
+
+
+def _interp(x, out_h, out_w, method, align_corners):
+    n, c, h, w = x.shape
+    if align_corners and method == "linear" and out_h > 1 and out_w > 1:
+        # jax.image.resize implements half-pixel centers; align_corners
+        # resamples on the corner-aligned lattice instead
+        ys = jnp.linspace(0, h - 1, out_h)
+        xs = jnp.linspace(0, w - 1, out_w)
+        y0 = jnp.floor(ys).astype(jnp.int32)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[None, None, :, None]
+        wx = (xs - x0)[None, None, None, :]
+        g = lambda yi, xi: x[:, :, yi][:, :, :, xi]
+        return (
+            g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x1) * (1 - wy) * wx
+            + g(y1, x0) * wy * (1 - wx) + g(y1, x1) * wy * wx
+        )
+    return jax.image.resize(x, (n, c, out_h, out_w), method=method)
+
+
+@register_op("bilinear_interp", inputs=["X"], outputs=["Out"])
+def _bilinear_interp(ctx, ins, attrs):
+    x = ins["X"][0]
+    oh = int(attrs.get("out_h", 0)) or int(x.shape[2] * attrs["scale"])
+    ow = int(attrs.get("out_w", 0)) or int(x.shape[3] * attrs["scale"])
+    return {"Out": [_interp(x, oh, ow, "linear",
+                            attrs.get("align_corners", True))]}
+
+
+@register_op("nearest_interp", inputs=["X"], outputs=["Out"])
+def _nearest_interp(ctx, ins, attrs):
+    x = ins["X"][0]
+    oh = int(attrs.get("out_h", 0)) or int(x.shape[2] * attrs["scale"])
+    ow = int(attrs.get("out_w", 0)) or int(x.shape[3] * attrs["scale"])
+    return {"Out": [_interp(x, oh, ow, "nearest", False)]}
+
+
+@register_op("pixel_shuffle", inputs=["X"], outputs=["Out"])
+def _pixel_shuffle(ctx, ins, attrs):
+    x = ins["X"][0]
+    r = int(attrs["upscale_factor"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return {"Out": [x.reshape(n, c // (r * r), h * r, w * r)]}
+
+
+@register_op("conv3d", inputs=["Input", "Filter"], outputs=["Output"])
+def _conv3d(ctx, ins, attrs):
+    x, f = ins["Input"][0], ins["Filter"][0]  # NCDHW, OI dhw
+    s = attrs.get("strides", [1, 1, 1])
+    p = attrs.get("paddings", [0, 0, 0])
+    d = attrs.get("dilations", [1, 1, 1])
+    g = int(attrs.get("groups", 1))
+    out = jax.lax.conv_general_dilated(
+        x, f, window_strides=tuple(s),
+        padding=[(p[0], p[0]), (p[1], p[1]), (p[2], p[2])],
+        rhs_dilation=tuple(d), feature_group_count=g,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+    )
+    return {"Output": [out]}
+
+
+@register_op("pool3d", inputs=["X"], outputs=["Out"])
+def _pool3d(ctx, ins, attrs):
+    x = ins["X"][0]
+    ksize = attrs["ksize"]
+    stride = attrs.get("strides", ksize)
+    pad = attrs.get("paddings", [0, 0, 0])
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        red = (2, 3, 4)
+        out = (jnp.max if ptype == "max" else jnp.mean)(x, axis=red,
+                                                        keepdims=True)
+        return {"Out": [out]}
+    dims = (1, 1) + tuple(ksize)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + tuple((pp, pp) for pp in pad)
+    if ptype == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, dims, strides, pads)
+    else:
+        out = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, dims, strides, pads
+        ) / float(ksize[0] * ksize[1] * ksize[2])
+    return {"Out": [out]}

@@ -1,0 +1,149 @@
+"""Linear-algebra ops.
+
+Capability parity: reference `paddle/fluid/operators/` kron_op.cc,
+cholesky_op.cc, matrix_power_op.cc, inverse_op.cc, triangular_solve (in
+newer tree), cross_op.cc, trace_op.cc, diag_op.cc/diag_embed_op.cc,
+dist_op.cc, histogram_op.cc, bincount_op.cc, index_sample_op.cc and the
+einsum/multi_dot python APIs.  One jnp/lax lowering per op — XLA supplies
+the factorization/solve kernels the reference hand-wrote against
+cuSOLVER/Eigen.
+"""
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("kron", inputs=["X", "Y"], outputs=["Out"])
+def _kron(ctx, ins, attrs):
+    return {"Out": [jnp.kron(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("einsum", inputs=["Operands"], outputs=["Out"])
+def _einsum(ctx, ins, attrs):
+    return {"Out": [jnp.einsum(attrs["equation"], *ins["Operands"])]}
+
+
+@register_op("cholesky", inputs=["X"], outputs=["Out"])
+def _cholesky(ctx, ins, attrs):
+    x = ins["X"][0]
+    u = bool(attrs.get("upper", False))
+    L = jnp.linalg.cholesky(x)
+    return {"Out": [jnp.swapaxes(L, -1, -2) if u else L]}
+
+
+@register_op("inverse", inputs=["Input"], outputs=["Output"])
+def _inverse(ctx, ins, attrs):
+    return {"Output": [jnp.linalg.inv(ins["Input"][0])]}
+
+
+@register_op("matrix_power", inputs=["X"], outputs=["Out"])
+def _matrix_power(ctx, ins, attrs):
+    return {"Out": [jnp.linalg.matrix_power(ins["X"][0], int(attrs["n"]))]}
+
+
+@register_op("triangular_solve", inputs=["X", "Y"], outputs=["Out"])
+def _triangular_solve(ctx, ins, attrs):
+    import jax
+
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jax.scipy.linalg.solve_triangular(
+        x, y,
+        lower=not attrs.get("upper", True),
+        trans=1 if attrs.get("transpose", False) else 0,
+        unit_diagonal=attrs.get("unitriangular", False),
+    )]}
+
+
+@register_op("cross", inputs=["X", "Y"], outputs=["Out"])
+def _cross(ctx, ins, attrs):
+    axis = attrs.get("dim")
+    if axis is None:  # first axis of size 3 (reference default)
+        x = ins["X"][0]
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return {"Out": [jnp.cross(ins["X"][0], ins["Y"][0], axis=int(axis))]}
+
+
+@register_op("trace", inputs=["Input"], outputs=["Out"])
+def _trace(ctx, ins, attrs):
+    return {"Out": [jnp.trace(
+        ins["Input"][0], offset=int(attrs.get("offset", 0)),
+        axis1=int(attrs.get("axis1", 0)), axis2=int(attrs.get("axis2", 1)),
+    )]}
+
+
+@register_op("diag_v2", inputs=["X"], outputs=["Out"])
+def _diag_v2(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = int(attrs.get("offset", 0))
+    if x.ndim == 1:
+        out = jnp.diag(x, k=k)
+        pad = attrs.get("padding_value", 0.0)
+        if pad:
+            mask = jnp.diag(jnp.ones_like(x), k=k)
+            out = out + (1 - mask) * pad
+        return {"Out": [out]}
+    return {"Out": [jnp.diagonal(x, offset=k)]}
+
+
+@register_op("diag_embed", inputs=["Input"], outputs=["Out"])
+def _diag_embed(ctx, ins, attrs):
+    x = ins["Input"][0]
+    k = int(attrs.get("offset", 0))
+    d1 = int(attrs.get("dim1", -2))
+    d2 = int(attrs.get("dim2", -1))
+    n = x.shape[-1] + abs(k)
+    idx = jnp.arange(x.shape[-1])
+    rows = idx + (abs(k) if k < 0 else 0)
+    cols = idx + (k if k > 0 else 0)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    out = out.at[..., rows, cols].set(x)
+    if (d1, d2) != (-2, -1):
+        out = jnp.moveaxis(out, (-2, -1), (d1, d2))
+    return {"Out": [out]}
+
+
+@register_op("multi_dot", inputs=["X"], outputs=["Out"])
+def _multi_dot(ctx, ins, attrs):
+    return {"Out": [jnp.linalg.multi_dot(list(ins["X"]))]}
+
+
+@register_op("dist", inputs=["X", "Y"], outputs=["Out"])
+def _dist(ctx, ins, attrs):
+    p = float(attrs.get("p", 2.0))
+    d = (ins["X"][0] - ins["Y"][0]).reshape(-1)
+    if p == float("inf"):
+        return {"Out": [jnp.max(jnp.abs(d))]}
+    if p == 0:
+        return {"Out": [jnp.sum((d != 0).astype(d.dtype))]}
+    return {"Out": [jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)]}
+
+
+@register_op("histogram", inputs=["X"], outputs=["Out"], grad=None)
+def _histogram(ctx, ins, attrs):
+    x = ins["X"][0].reshape(-1)
+    bins = int(attrs.get("bins", 100))
+    lo = float(attrs.get("min", 0.0))
+    hi = float(attrs.get("max", 0.0))
+    if lo == 0.0 and hi == 0.0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    h, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return {"Out": [h.astype(jnp.int64)]}
+
+
+@register_op("bincount", inputs=["X", "Weights"], outputs=["Out"],
+             grad=None)
+def _bincount(ctx, ins, attrs):
+    x = ins["X"][0].reshape(-1)
+    w = ins["Weights"][0].reshape(-1) if ins.get("Weights") else None
+    # static shapes: minlength must cover the value range (attr, like the
+    # reference's output resize after a device max-scan)
+    length = int(attrs["minlength"])
+    return {"Out": [jnp.bincount(x, weights=w, length=length)]}
+
+
+@register_op("index_sample", inputs=["X", "Index"], outputs=["Out"],
+             no_grad_slots=("Index",))
+def _index_sample(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [jnp.take_along_axis(x, idx.astype(jnp.int32), axis=1)]}

@@ -388,6 +388,7 @@ def _group_norm(ctx, ins, attrs):
     inputs=["W", "Ids"],
     outputs=["Out"],
     no_grad_slots=("Ids",),
+    grad="lookup_table_grad_maker",
 )
 def _lookup_table(ctx, ins, attrs):
     """Embedding gather (cf. lookup_table_op.cc).  padding_idx rows zeroed."""
@@ -403,9 +404,34 @@ def _lookup_table(ctx, ins, attrs):
     return {"Out": [out]}
 
 
-register_op("lookup_table_v2", inputs=["W", "Ids"], outputs=["Out"], no_grad_slots=("Ids",))(
+register_op("lookup_table_v2", inputs=["W", "Ids"], outputs=["Out"],
+            no_grad_slots=("Ids",), grad="lookup_table_grad_maker")(
     _lookup_table
 )
+
+
+@register_op(
+    "lookup_table_sparse_grad",
+    inputs=["Ids", "OutGrad"],
+    outputs=["Rows", "Values"],
+    grad=None,
+)
+def _lookup_table_sparse_grad(ctx, ins, attrs):
+    """SelectedRows-style embedding gradient (cf. `selected_rows.h:1`,
+    lookup_table_op.cc grad SelectedRows branch): the gradient of the big
+    table is (Rows, Values) — the looked-up ids and the per-id output
+    grads — NEVER a dense [V, D] scatter.  padding_idx rows contribute 0."""
+    ids = ins["Ids"][0]
+    g = ins["OutGrad"][0]
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    rows = ids.reshape(-1).astype(jnp.int32)
+    d = g.shape[-1]
+    vals = g.reshape(-1, d)
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        vals = jnp.where((rows == padding_idx)[:, None], 0.0, vals)
+    return {"Rows": [rows], "Values": [vals]}
 
 
 @register_op(

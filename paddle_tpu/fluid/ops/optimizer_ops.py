@@ -379,3 +379,83 @@ OPTIMIZER_OP_TYPES = frozenset(
         "dpsgd",
     }
 )
+
+
+# -- SelectedRows-style sparse updates ---------------------------------------
+# Capability parity: reference `framework/selected_rows.h:1` +
+# `operators/optimizers/sgd_op.cc` (SelectedRows branch) and `adam_op.cc`
+# lazy_mode.  TPU-first: the sparse gradient is an explicit (Rows [N],
+# Values [N, D]) pair with static N = number of looked-up ids; the update
+# is an XLA scatter touching O(N*D) elements of the donated parameter
+# buffer instead of an O(V*D) dense elementwise update.
+
+
+@register_op(
+    "sgd_sparse",
+    inputs=["Param", "Rows", "Values", "LearningRate"],
+    outputs=["ParamOut"],
+    grad=None,
+)
+def _sgd_sparse(ctx, ins, attrs):
+    p = ins["Param"][0]
+    rows = ins["Rows"][0].astype(jnp.int32)
+    vals = ins["Values"][0].astype(p.dtype)
+    lr = ins["LearningRate"][0]
+    # duplicate rows accumulate, matching SelectedRows MergeAdd + update
+    return {"ParamOut": [p.at[rows].add(-(lr * vals).astype(p.dtype))]}
+
+
+@register_op(
+    "adam_sparse",
+    inputs=[
+        "Param", "Rows", "Values", "LearningRate",
+        "Moment1", "Moment2", "Beta1Pow", "Beta2Pow",
+    ],
+    outputs=["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+             "Beta2PowOut"],
+    grad=None,
+)
+def _adam_sparse(ctx, ins, attrs):
+    """Lazy-mode sparse Adam (cf. adam_op.cc `lazy_mode`): rows absent from
+    the gradient keep their moments UNdecayed and their params untouched —
+    a semantic difference from dense adam, matching the reference."""
+    p = ins["Param"][0]
+    rows = ins["Rows"][0].astype(jnp.int32)
+    vals = ins["Values"][0].astype(jnp.float32)
+    lr = ins["LearningRate"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    # merge duplicate rows (SelectedRows MergeAdd) WITHOUT densifying:
+    # sort occurrences by row, per-group totals via boundary cumsum
+    # differences, broadcast the total back to every occurrence — then
+    # duplicate scatter writes below all carry identical values, so .set
+    # is deterministic.  Everything stays O(N*D + N log N).
+    order = jnp.argsort(rows)
+    r_s = jnp.take(rows, order)
+    v_s = jnp.take(vals, order, axis=0)
+    csum = jnp.cumsum(v_s, axis=0)
+    last = jnp.searchsorted(r_s, r_s, side="right") - 1
+    first = jnp.searchsorted(r_s, r_s, side="left")
+    total_s = jnp.take(csum, last, axis=0) - jnp.where(
+        (first > 0)[:, None], jnp.take(csum, jnp.maximum(first - 1, 0),
+                                       axis=0), 0.0
+    )
+    merged = jnp.zeros_like(vals).at[order].set(total_s)  # occurrence order
+
+    m1_r = jnp.take(m1, rows, axis=0)
+    m2_r = jnp.take(m2, rows, axis=0)
+    p_r = jnp.take(p, rows, axis=0).astype(jnp.float32)
+    m1_new = b1 * m1_r + (1 - b1) * merged
+    m2_new = b2 * m2_r + (1 - b2) * merged * merged
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_new = p_r - lr_t * m1_new / (jnp.sqrt(m2_new) + eps)
+    return {
+        "ParamOut": [p.at[rows].set(p_new.astype(p.dtype))],
+        "Moment1Out": [m1.at[rows].set(m1_new)],
+        "Moment2Out": [m2.at[rows].set(m2_new)],
+        "Beta1PowOut": [b1p * b1],
+        "Beta2PowOut": [b2p * b2],
+    }

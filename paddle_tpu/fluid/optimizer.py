@@ -169,6 +169,34 @@ class Optimizer:
         else:
             block = framework.default_main_program().global_block
         first_op_idx = len(block.ops)
+        # SelectedRows-style sparse grads (marker .selected_rows) have no
+        # clip/regularization lowering yet — refuse loudly rather than
+        # silently skipping them (which would under-clip everything else
+        # and drop the embedding's weight decay)
+        sparse = [
+            pg for pg in params_grads
+            if getattr(pg[1], "selected_rows", None)
+        ]
+        params_grads = [
+            pg for pg in params_grads
+            if not getattr(pg[1], "selected_rows", None)
+        ]
+        if sparse:
+            bad = [p.name for p, _ in sparse]
+            if self._grad_clip is not None:
+                raise NotImplementedError(
+                    "gradient clipping is not implemented for sparse "
+                    "(SelectedRows) gradients (%s); set is_sparse=False "
+                    "or drop grad_clip" % bad
+                )
+            if self.regularization is not None or any(
+                getattr(p, "regularizer", None) for p, _ in sparse
+            ):
+                raise NotImplementedError(
+                    "weight-decay regularization is not implemented for "
+                    "sparse (SelectedRows) gradients (%s); set "
+                    "is_sparse=False or drop the regularizer" % bad
+                )
         # reference order (optimizer.py apply_gradients): clip the raw
         # gradients FIRST, then append weight-decay regularization unclipped
         if self._grad_clip is not None:
@@ -176,6 +204,7 @@ class Optimizer:
         from .regularizer import append_regularization_ops
 
         params_grads = append_regularization_ops(params_grads, self.regularization)
+        params_grads = params_grads + sparse
         self._create_accumulators(block, [p for p, _ in params_grads])
         for pg in params_grads:
             self._append_optimize_op(block, pg)
@@ -229,6 +258,12 @@ class Optimizer:
 
     # helper for emitting update ops with the in-place convention
     def _emit(self, block, type, param, grad, extra_inputs, extra_outputs, attrs):
+        if getattr(grad, "selected_rows", None):
+            raise NotImplementedError(
+                "param '%s' has a sparse (SelectedRows) gradient but %s has "
+                "no sparse update op — use SGD or Adam, or set "
+                "is_sparse=False on the embedding" % (param.name, type)
+            )
         inputs = {
             "Param": [param.name],
             "Grad": [grad.name],
@@ -245,6 +280,20 @@ class Optimizer:
 class SGDOptimizer(Optimizer):
     def _append_optimize_op(self, block, pg):
         p, g = pg
+        sr = getattr(g, "selected_rows", None)
+        if sr is not None:
+            rows, vals = sr
+            block.append_op(
+                "sgd_sparse",
+                inputs={
+                    "Param": [p.name], "Rows": [rows], "Values": [vals],
+                    "LearningRate": [self._global_learning_rate().name],
+                },
+                outputs={"ParamOut": [p.name]},
+                attrs={},
+                infer=False,
+            )
+            return
         self._emit(block, "sgd", p, g, {}, {}, {})
 
 
@@ -338,6 +387,31 @@ class AdamOptimizer(Optimizer):
         b2p = self._get_accumulator("beta2_pow_acc", p)
         attrs = {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon}
         attrs.update(self._extra_attrs())
+        sr = getattr(g, "selected_rows", None)
+        if sr is not None:
+            if self._op_type != "adam":
+                raise NotImplementedError(
+                    "sparse (SelectedRows) gradients: only sgd/adam have "
+                    "sparse update ops; got %s" % self._op_type
+                )
+            rows, vals = sr
+            block.append_op(
+                "adam_sparse",
+                inputs={
+                    "Param": [p.name], "Rows": [rows], "Values": [vals],
+                    "LearningRate": [self._global_learning_rate().name],
+                    "Moment1": [m1.name], "Moment2": [m2.name],
+                    "Beta1Pow": [b1p.name], "Beta2Pow": [b2p.name],
+                },
+                outputs={
+                    "ParamOut": [p.name], "Moment1Out": [m1.name],
+                    "Moment2Out": [m2.name], "Beta1PowOut": [b1p.name],
+                    "Beta2PowOut": [b2p.name],
+                },
+                attrs=attrs,
+                infer=False,
+            )
+            return
         self._emit(
             block, self._op_type, p, g,
             {"Moment1": m1, "Moment2": m2, "Beta1Pow": b1p, "Beta2Pow": b2p},

@@ -238,3 +238,18 @@ def test_loop_var_value_after_loop():
     with dygraph.guard():
         out = f(to_variable(np.zeros((1,), np.float32)))
         assert float(np.asarray(out.data)[0]) == pytest.approx(5.0)  # 3 + 2
+
+
+def test_break_in_python_iterable_loop_keeps_python_semantics():
+    @declarative
+    def f(x):
+        total = x
+        for item in [1.0, 2.0, 3.0]:
+            total = total + item
+            if item >= 2.0:
+                break
+        return total
+
+    with dygraph.guard():
+        out = f(to_variable(np.zeros((1,), np.float32)))
+        assert float(np.asarray(out.data)[0]) == pytest.approx(3.0)  # 1+2

@@ -1,0 +1,165 @@
+"""SelectedRows-style sparse embedding gradients (reference
+`framework/selected_rows.h:1`, lookup_table_op.cc grad SelectedRows branch,
+adam_op.cc lazy_mode): is_sparse=True embeddings produce (Rows, Values)
+grads applied as O(N*D) scatters, never a dense [V, D] gradient."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _build(is_sparse, opt_factory, vocab=50, dim=8):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[6, 1], dtype="int64",
+                          append_batch_size=False)
+        y = layers.data("y", shape=[6, 1], append_batch_size=False)
+        emb = layers.embedding(ids, size=[vocab, dim], is_sparse=is_sparse)
+        pred = layers.fc(emb, size=1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        opt_factory().minimize(loss)
+    return main, startup, loss
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adam"])
+def test_sparse_matches_dense(opt_name):
+    def factory():
+        if opt_name == "sgd":
+            return fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+        return fluid.optimizer.AdamOptimizer(learning_rate=0.05)
+
+    rng = np.random.RandomState(0)
+    idv = rng.randint(0, 50, (4, 6, 1)).astype(np.int64)
+    yv = rng.randn(4, 6, 1).astype(np.float32)
+
+    weights = {}
+    for sparse in (False, True):
+        main, startup, loss = _build(sparse, factory)
+        types = [op.type for op in main.global_block.ops]
+        if sparse:
+            assert "lookup_table_sparse_grad" in types
+            assert ("sgd_sparse" in types) or ("adam_sparse" in types)
+            # the defining property: NO dense grad op ever touches the table
+            emb_name = main.all_parameters()[0].name
+            assert not any(
+                op.type == "vjp_grad"
+                and emb_name in op.all_input_names()
+                for op in main.global_block.ops
+            )
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for t in range(4):
+                exe.run(main, feed={"ids": idv[t], "y": yv[t]},
+                        fetch_list=[loss])
+            emb_name = [p.name for p in main.all_parameters()
+                        if "embedding" in p.name or p.shape == (50, 8)][0]
+            weights[sparse] = np.asarray(scope.find_var(emb_name))
+
+    if opt_name == "sgd":
+        # sparse SGD == dense SGD exactly (scatter-add of the same updates)
+        np.testing.assert_allclose(weights[True], weights[False],
+                                   rtol=1e-5, atol=1e-6)
+    else:
+        # lazy adam: touched rows match dense adam only in which rows moved
+        touched = np.unique(idv.reshape(-1))
+        untouched = np.setdiff1d(np.arange(50), touched)
+        # untouched rows must be EXACTLY initial (dense adam still applies
+        # zero-grad moment decay; lazy does not — reference lazy_mode)
+        main, startup, _ = _build(True, factory)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            emb_name = main.all_parameters()[0].name
+            w0 = np.asarray(scope.find_var(emb_name)).copy()
+        np.testing.assert_allclose(
+            weights[True][untouched], w0[untouched], rtol=1e-6
+        )
+        # and touched rows did move
+        assert np.abs(weights[True][touched] - w0[touched]).max() > 1e-4
+
+
+def test_sparse_with_unsupported_optimizer_raises():
+    with pytest.raises(NotImplementedError):
+        _build(True, lambda: fluid.optimizer.MomentumOptimizer(
+            learning_rate=0.1, momentum=0.9))
+
+
+def test_sparse_grad_marker_is_not_dense_readable():
+    main, startup, loss = _build(
+        True, lambda: fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+    )
+    emb = main.all_parameters()[0]
+    g = main.global_block.var(emb.name + "@GRAD")
+    assert g.selected_rows is not None
+    # fetching the marker as a dense array must fail loudly
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(RuntimeError):
+            exe.run(main,
+                    feed={"ids": np.zeros((6, 1), np.int64),
+                          "y": np.zeros((6, 1), np.float32)},
+                    fetch_list=[emb.name + "@GRAD"])
+
+
+def test_shared_sparse_table_raises_clearly():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids1 = layers.data("i1", shape=[4, 1], dtype="int64",
+                           append_batch_size=False)
+        ids2 = layers.data("i2", shape=[4, 1], dtype="int64",
+                           append_batch_size=False)
+        attr = fluid.ParamAttr(name="shared_w")
+        e1 = layers.embedding(ids1, size=[20, 4], is_sparse=True,
+                              param_attr=attr)
+        e2 = layers.embedding(ids2, size=[20, 4], is_sparse=True,
+                              param_attr=attr)
+        loss = layers.reduce_mean(e1 + e2)
+        with pytest.raises(NotImplementedError, match="SelectedRows"):
+            fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+
+def test_sparse_with_clip_raises_clearly():
+    from paddle_tpu.fluid.clip import GradientClipByGlobalNorm
+
+    with pytest.raises(NotImplementedError, match="clip"):
+        _build(True, lambda: fluid.optimizer.SGDOptimizer(
+            learning_rate=0.1, grad_clip=GradientClipByGlobalNorm(1.0)))
+
+
+def test_adam_sparse_merges_duplicate_rows():
+    # duplicate ids in one batch must behave like the merged (summed) grad
+    import jax.numpy as jnp
+    from paddle_tpu.fluid.core.registry import get_op_def, LowerContext
+
+    opdef = get_op_def("adam_sparse")
+    V, D = 6, 3
+    p = jnp.ones((V, D), jnp.float32)
+    m1 = jnp.zeros((V, D), jnp.float32)
+    m2 = jnp.zeros((V, D), jnp.float32)
+    rows = jnp.asarray(np.array([2, 2, 4], np.int32))
+    vals = jnp.asarray(np.array(
+        [[1, 1, 1], [2, 2, 2], [3, 3, 3]], np.float32))
+    out = opdef.lower(
+        LowerContext(),
+        {"Param": [p], "Rows": [rows], "Values": [vals],
+         "LearningRate": [jnp.asarray([0.1], jnp.float32)],
+         "Moment1": [m1], "Moment2": [m2],
+         "Beta1Pow": [jnp.asarray([0.9])], "Beta2Pow": [jnp.asarray([0.999])]},
+        {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+    )
+    m1o = np.asarray(out["Moment1Out"][0])
+    # row 2 got merged grad 3.0 per column; row 4 got 3.0; others untouched
+    np.testing.assert_allclose(m1o[2], 0.1 * 3.0, rtol=1e-5)
+    np.testing.assert_allclose(m1o[4], 0.1 * 3.0, rtol=1e-5)
+    np.testing.assert_allclose(m1o[0], 0.0)
+    po = np.asarray(out["ParamOut"][0])
+    assert (po[2] != 1.0).all() and (po[4] != 1.0).all()
+    np.testing.assert_allclose(po[0], 1.0)
