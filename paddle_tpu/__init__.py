@@ -15,6 +15,8 @@ Architecture (see SURVEY.md §7):
 __version__ = "0.1.0"
 
 from . import fluid  # noqa: F401
+from . import dataset, reader  # noqa: F401
+from .reader import batch  # noqa: F401  (paddle.batch parity)
 
 CPUPlace = fluid.CPUPlace
 TPUPlace = fluid.TPUPlace

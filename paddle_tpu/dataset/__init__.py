@@ -1,0 +1,13 @@
+"""Dataset readers with the reference `python/paddle/dataset/` API.
+
+Reference modules (`dataset/uci_housing.py:1`, `mnist.py:1`, `imdb.py:1`,
+`movielens.py:1`, `cifar.py:1`) download public corpora and yield
+reader-creator generators.  This environment has no network egress, so each
+module synthesizes a deterministic dataset with the SAME shapes, dtypes,
+vocabularies, and reader-creator protocol — `train()`/`test()` return
+zero-arg callables producing example generators, exactly what
+`paddle_tpu.batch(...)` and the book tests consume.  Swap in real data by
+pointing the loaders at downloaded files; the consuming code is unchanged.
+"""
+
+from . import cifar, imdb, mnist, movielens, uci_housing  # noqa: F401

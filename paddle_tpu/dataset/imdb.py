@@ -1,0 +1,45 @@
+"""IMDB sentiment reader (reference `python/paddle/dataset/imdb.py:1`):
+word-id sequences + 0/1 label, plus `word_dict()`.  Synthetic: two token
+distributions with sentiment-bearing marker tokens, deterministic."""
+
+import numpy as np
+
+_VOCAB = 2000
+
+
+def word_dict():
+    """id map with the reference's contract: str token -> int id."""
+    return {"w%d" % i: i for i in range(_VOCAB)}
+
+
+def _make(n, seed):
+    rs = np.random.RandomState(seed)
+    examples = []
+    for _ in range(n):
+        label = int(rs.randint(0, 2))
+        length = int(rs.randint(8, 40))
+        base = rs.randint(10, _VOCAB, size=(length,))
+        # sentiment markers: ids 0-4 positive, 5-9 negative
+        marker = rs.randint(0, 5, size=(max(2, length // 5),)) + (
+            0 if label == 1 else 5
+        )
+        seq = np.concatenate([base, marker])
+        rs.shuffle(seq)
+        examples.append((seq.astype(np.int64).tolist(), label))
+    return examples
+
+
+def train(n=256):
+    def reader():
+        for ex in _make(n, seed=21):
+            yield ex
+
+    return reader
+
+
+def test(n=64):
+    def reader():
+        for ex in _make(n, seed=22):
+            yield ex
+
+    return reader

@@ -55,10 +55,10 @@ _register_unary("trunc", lambda x, a: jnp.trunc(x))
 _register_unary("frac", lambda x, a: x - jnp.trunc(x))
 _register_unary(
     "hard_swish",
+    # reference: x * min(max(0, x + offset), threshold) / scale
     lambda x, a: x * jnp.clip(
-        x / a.get("scale", 6.0) + a.get("offset", 3.0) / a.get("scale", 6.0),
-        0.0, 1.0,
-    ),
+        x + a.get("offset", 3.0), 0.0, a.get("threshold", 6.0)
+    ) / a.get("scale", 6.0),
 )
 _register_unary(
     "hard_shrink",
@@ -118,6 +118,11 @@ def _roll(ctx, ins, attrs):
     shifts = attrs["shifts"]
     axis = attrs.get("axis")
     if axis is None or axis == []:
+        if len(shifts) != 1:
+            raise ValueError(
+                "roll: %d shifts but no axis — pass one axis per shift"
+                % len(shifts)
+            )
         return {"Out": [jnp.roll(ins["X"][0].reshape(-1),
                                  shifts[0]).reshape(ins["X"][0].shape)]}
     return {"Out": [jnp.roll(ins["X"][0], tuple(shifts), tuple(axis))]}
@@ -187,12 +192,15 @@ def _unfold(ctx, ins, attrs):
     x = ins["X"][0]
     kh, kw = attrs["kernel_sizes"]
     sh, sw = attrs.get("strides", [1, 1])
-    ph, pw = attrs.get("paddings", [0, 0])[:2]
+    pads = list(attrs.get("paddings", [0, 0]))
+    if len(pads) == 2:  # symmetric [ph, pw] -> [pt, pl, pb, pr]
+        pads = [pads[0], pads[1], pads[0], pads[1]]
+    pt, pl, pb, pr = pads
     dh, dw = attrs.get("dilations", [1, 1])
     n, c, h, w = x.shape
-    x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
-    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
-    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    x = jnp.pad(x, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+    oh = (h + pt + pb - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + pl + pr - dw * (kw - 1) - 1) // sw + 1
     cols = []
     for i in range(kh):
         for j in range(kw):
@@ -563,6 +571,11 @@ def _nearest_interp(ctx, ins, attrs):
     x = ins["X"][0]
     oh = int(attrs.get("out_h", 0)) or int(x.shape[2] * attrs["scale"])
     ow = int(attrs.get("out_w", 0)) or int(x.shape[3] * attrs["scale"])
+    if attrs.get("align_corners", True) and oh > 1 and ow > 1:
+        h, w = x.shape[2], x.shape[3]
+        yi = jnp.round(jnp.linspace(0, h - 1, oh)).astype(jnp.int32)
+        xi = jnp.round(jnp.linspace(0, w - 1, ow)).astype(jnp.int32)
+        return {"Out": [x[:, :, yi][:, :, :, xi]]}
     return {"Out": [_interp(x, oh, ow, "nearest", False)]}
 
 

@@ -394,10 +394,8 @@ def _lookup_table(ctx, ins, attrs):
     """Embedding gather (cf. lookup_table_op.cc).  padding_idx rows zeroed."""
     w, ids = ins["W"][0], ins["Ids"][0]
     padding_idx = attrs.get("padding_idx", -1)
-    squeeze = False
     if ids.ndim >= 2 and ids.shape[-1] == 1:
         ids = ids.squeeze(-1)
-        squeeze = True
     out = jnp.take(w, ids, axis=0)
     if padding_idx is not None and padding_idx >= 0:
         out = jnp.where((ids == padding_idx)[..., None], 0.0, out)

@@ -1,0 +1,77 @@
+"""Reader decorators (reference `python/paddle/reader/decorator.py`):
+compose example generators — shuffle, batch, map, chain — feeding the
+executor/DataLoader.  Pure-Python host-side plumbing; the device-feed path
+is fluid/reader.py's DataLoader."""
+
+from __future__ import annotations
+
+import random as _random
+
+import numpy as np
+
+
+def shuffle(reader, buf_size):
+    """cf. reference reader.shuffle: buffered shuffling of a reader."""
+
+    def _impl():
+        buf = []
+        for ex in reader():
+            buf.append(ex)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                while buf:
+                    yield buf.pop()
+        _random.shuffle(buf)
+        while buf:
+            yield buf.pop()
+
+    return _impl
+
+
+def batch(reader, batch_size, drop_last=False):
+    """cf. reference paddle.batch: group examples into lists of tuples."""
+
+    def _impl():
+        cur = []
+        for ex in reader():
+            cur.append(ex)
+            if len(cur) == batch_size:
+                yield cur
+                cur = []
+        if cur and not drop_last:
+            yield cur
+
+    return _impl
+
+
+def map_readers(func, *readers):
+    """cf. reference reader.map_readers."""
+
+    def _impl():
+        for exs in zip(*[r() for r in readers]):
+            yield func(*exs)
+
+    return _impl
+
+
+def chain(*readers):
+    """cf. reference reader.chain."""
+
+    def _impl():
+        for r in readers:
+            yield from r()
+
+    return _impl
+
+
+def to_feed(batch_examples, names):
+    """Stack a paddle.batch-style list of tuples into a feed dict of
+    numpy arrays keyed by `names` (scalars gain a trailing dim)."""
+    cols = list(zip(*batch_examples))
+    feed = {}
+    for name, col in zip(names, cols):
+        arr = np.asarray(col)
+        if arr.ndim == 1:
+            arr = arr.reshape(-1, 1)
+        feed[name] = arr
+    return feed

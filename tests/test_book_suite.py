@@ -1,0 +1,291 @@
+"""Book-test breadth: fit_a_line, word2vec, understand_sentiment (conv +
+stacked LSTM), recommender_system, image_classification — e2e static-graph
+training with loss decrease + save/load round trips, over the
+paddle.dataset-parity readers (reference `tests/book/*.py`)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _pad_ids(seqs, T, pad=0):
+    out = np.full((len(seqs), T), pad, np.int64)
+    lens = np.zeros((len(seqs),), np.int64)
+    for i, s in enumerate(seqs):
+        s = s[:T]
+        out[i, : len(s)] = s
+        lens[i] = len(s)
+    return out, lens
+
+
+# ---------------------------------------------------------------------------
+# fit_a_line (reference tests/book/test_fit_a_line.py)
+# ---------------------------------------------------------------------------
+
+
+def test_fit_a_line(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[13])
+        y = layers.data("y", shape=[1])
+        pred = layers.fc(x, size=1)
+        cost = layers.square_error_cost(pred, y)
+        avg = layers.mean(cost)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(avg)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    reader = paddle_tpu.batch(
+        paddle_tpu.reader.shuffle(
+            paddle_tpu.dataset.uci_housing.train(), buf_size=200),
+        batch_size=20, drop_last=True,
+    )
+    losses = []
+    for epoch in range(6):
+        for batch in reader():
+            feed = paddle_tpu.reader.to_feed(batch, ["x", "y"])
+            (lv,) = exe.run(main, feed=feed, fetch_list=[avg])
+            losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+    # save_inference_model round trip (reference train->infer flow)
+    path = str(tmp_path / "fit_a_line.model")
+    fluid.io.save_inference_model(path, ["x"], [pred], exe, main)
+    scope2 = fluid.Scope()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fluid.io.load_inference_model(path, exe2)
+        xv = np.random.RandomState(5).randn(4, 13).astype(np.float32)
+        (out2,) = exe2.run(prog, feed={feeds[0]: xv}, fetch_list=fetches)
+    (out1,) = exe.run(test_prog, feed={"x": xv, "y": np.zeros((4, 1), np.float32)},
+                      fetch_list=[pred])
+    np.testing.assert_allclose(out2, out1, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# word2vec (reference tests/book/test_word2vec.py: 4-gram, shared table)
+# ---------------------------------------------------------------------------
+
+
+def test_word2vec():
+    dict_size, EMB, HID, N = 150, 16, 64, 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = [
+            layers.data("w%d" % i, shape=[1], dtype="int64")
+            for i in range(N)
+        ]
+        embs = [
+            layers.embedding(
+                w, size=[dict_size, EMB],
+                param_attr=fluid.ParamAttr(name="shared_w"),
+            )
+            for w in words[:4]
+        ]
+        embs = [layers.reshape(e, [-1, EMB]) for e in embs]
+        concat = layers.concat(embs, axis=1)
+        hidden = layers.fc(concat, size=HID, act="sigmoid")
+        logits = layers.fc(hidden, size=dict_size)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, words[4])
+        )
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(loss)
+
+    # synthetic 5-grams with LEARNABLE structure: next word = f(context)
+    rs = np.random.RandomState(0)
+    data = rs.randint(0, dict_size, (2000, 5)).astype(np.int64)
+    data[:, 4] = (data[:, 0] + data[:, 3]) % dict_size
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    bs = 64
+    for epoch in range(8):
+        for i in range(0, len(data), bs):
+            b = data[i: i + bs]
+            feed = {"w%d" % j: b[:, j: j + 1] for j in range(5)}
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+    # the table really is shared: exactly ONE embedding parameter
+    emb_params = [p for p in main.all_parameters() if p.name == "shared_w"]
+    assert len(emb_params) == 1
+
+
+# ---------------------------------------------------------------------------
+# understand_sentiment (reference notest_understand_sentiment.py)
+# ---------------------------------------------------------------------------
+
+
+def _sentiment_data(T=48):
+    word_dict = paddle_tpu.dataset.imdb.word_dict()
+    train = list(paddle_tpu.dataset.imdb.train(192)())
+    ids, lens = _pad_ids([s for s, _ in train], T)
+    labels = np.array([l for _, l in train], np.int64).reshape(-1, 1)
+    return len(word_dict), ids, lens, labels
+
+
+def _run_sentiment(build_net):
+    dict_dim, ids, lens, labels = _sentiment_data()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = layers.data("words", shape=[48], dtype="int64")
+        seq_len = layers.data("lens", shape=[-1], dtype="int64",
+                              append_batch_size=False)
+        label = layers.data("label", shape=[1], dtype="int64")
+        probs, loss = build_net(data, seq_len, label, dict_dim)
+        acc = layers.accuracy(probs, label)
+        fluid.optimizer.AdamOptimizer(learning_rate=2e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    bs = 32
+    first = last = None
+    accs = []
+    for epoch in range(6):
+        for i in range(0, len(ids), bs):
+            feed = {
+                "words": ids[i: i + bs],
+                "lens": lens[i: i + bs].reshape(-1),
+                "label": labels[i: i + bs],
+            }
+            lv, av = exe.run(main, feed=feed, fetch_list=[loss, acc])
+            first = first if first is not None else float(lv)
+            last = float(lv)
+            accs.append(float(av))
+    assert last < first, (first, last)
+    assert np.mean(accs[-6:]) > 0.8, accs[-6:]
+
+
+def test_understand_sentiment_conv():
+    def conv_net(data, seq_len, label, dict_dim, emb_dim=24, hid_dim=24):
+        emb = layers.embedding(data, size=[dict_dim, emb_dim])
+        conv3 = layers.sequence_conv(
+            emb, seq_len, num_filters=hid_dim, filter_size=3, act="tanh")
+        conv4 = layers.sequence_conv(
+            emb, seq_len, num_filters=hid_dim, filter_size=4, act="tanh")
+        p3 = layers.sequence_pool(conv3, "max", seq_len)
+        p4 = layers.sequence_pool(conv4, "max", seq_len)
+        logits = layers.fc(layers.concat([p3, p4], axis=1), size=2)
+        probs = layers.softmax(logits)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        return probs, loss
+
+    _run_sentiment(conv_net)
+
+
+def test_understand_sentiment_stacked_lstm():
+    def lstm_net(data, seq_len, label, dict_dim, emb_dim=24, hid_dim=24,
+                 stacked_num=3):
+        emb = layers.embedding(data, size=[dict_dim, emb_dim])
+        fc1 = layers.fc(emb, size=hid_dim * 4, num_flatten_dims=2)
+        lstm1, _ = layers.dynamic_lstm(fc1, size=hid_dim * 4,
+                                       seq_lens=seq_len)
+        inputs = lstm1
+        for i in range(2, stacked_num + 1):
+            fc_i = layers.fc(inputs, size=hid_dim * 4, num_flatten_dims=2)
+            lstm_i, _ = layers.dynamic_lstm(
+                fc_i, size=hid_dim * 4, seq_lens=seq_len,
+                is_reverse=(i % 2) == 0)
+            inputs = lstm_i
+        pooled = layers.sequence_pool(inputs, "last", seq_len)
+        logits = layers.fc(pooled, size=2)
+        probs = layers.softmax(logits)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        return probs, loss
+
+    _run_sentiment(lstm_net)
+
+
+# ---------------------------------------------------------------------------
+# recommender_system (reference tests/book/test_recommender_system.py)
+# ---------------------------------------------------------------------------
+
+
+def test_recommender_system():
+    ml = paddle_tpu.dataset.movielens
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        uid = layers.data("user_id", shape=[1], dtype="int64")
+        gender = layers.data("gender", shape=[1], dtype="int64")
+        age = layers.data("age", shape=[1], dtype="int64")
+        job = layers.data("job", shape=[1], dtype="int64")
+        mid = layers.data("movie_id", shape=[1], dtype="int64")
+        cat = layers.data("category", shape=[1], dtype="int64")
+        rating = layers.data("score", shape=[1], dtype="float32")
+
+        def tower(parts, size=32):
+            feats = [layers.reshape(e, [-1, int(e.shape[-1])]) for e in parts]
+            return layers.fc(layers.concat(feats, axis=1), size=size,
+                             act="tanh")
+
+        usr = tower([
+            layers.embedding(uid, [ml.USER_COUNT, 16]),
+            layers.embedding(gender, [2, 8]),
+            layers.embedding(age, [ml.AGE_COUNT, 8]),
+            layers.embedding(job, [ml.JOB_COUNT, 8]),
+        ])
+        mov = tower([
+            layers.embedding(mid, [ml.MOVIE_COUNT, 16]),
+            layers.embedding(cat, [ml.CATEGORY_COUNT, 8]),
+        ])
+        sim = layers.ops.cos_sim(usr, mov)
+        pred = layers.scale(sim, scale=5.0)
+        loss = layers.mean(layers.square_error_cost(pred, rating))
+        fluid.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    names = ["user_id", "gender", "age", "job", "movie_id", "category",
+             "score"]
+    reader = paddle_tpu.batch(ml.train(512), batch_size=64, drop_last=True)
+    losses = []
+    for epoch in range(8):
+        for batch in reader():
+            feed = paddle_tpu.reader.to_feed(batch, names)
+            feed["score"] = feed["score"].astype(np.float32)
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------------
+# image_classification on CIFAR-shape data (reference
+# tests/book/test_image_classification.py — VGG-lite)
+# ---------------------------------------------------------------------------
+
+
+def test_image_classification_cifar():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[3, 32, 32])
+        label = layers.data("label", shape=[1], dtype="int64")
+        c1 = layers.conv2d(img, num_filters=16, filter_size=3, padding=1,
+                           act="relu")
+        p1 = layers.pool2d(c1, pool_size=2, pool_stride=2)
+        c2 = layers.conv2d(p1, num_filters=32, filter_size=3, padding=1,
+                           act="relu")
+        p2 = layers.pool2d(c2, pool_size=2, pool_stride=2)
+        bn = layers.batch_norm(layers.fc(p2, size=64), act="relu")
+        logits = layers.fc(layers.dropout(bn, 0.2), size=10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        fluid.optimizer.AdamOptimizer(learning_rate=2e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    reader = paddle_tpu.batch(paddle_tpu.dataset.cifar.train10(256),
+                              batch_size=32, drop_last=True)
+    accs, losses = [], []
+    for epoch in range(5):
+        for batch in reader():
+            feed = paddle_tpu.reader.to_feed(batch, ["img", "label"])
+            feed["img"] = feed["img"].reshape(-1, 3, 32, 32)
+            lv, av = exe.run(main, feed=feed, fetch_list=[loss, acc])
+            losses.append(float(lv))
+            accs.append(float(av))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    assert np.mean(accs[-4:]) > 0.5, accs[-4:]
