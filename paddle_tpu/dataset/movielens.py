@@ -1,6 +1,7 @@
 """MovieLens reader (reference `python/paddle/dataset/movielens.py:1`):
-(user_id, gender, age, job, movie_id, category, title, rating) tuples for
-the recommender-system book test.  Synthetic with the reference's field
+(user_id, gender, age, job, movie_id, category, rating) tuples for the
+recommender-system book test (the reference also carries a title token
+sequence; this synthetic variant drops it).  Synthetic with the reference's field
 layout; ratings follow a low-rank user x movie structure so the model has
 signal to fit."""
 

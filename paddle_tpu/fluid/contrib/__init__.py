@@ -1,6 +1,6 @@
-"""contrib: mixed precision (AMP), slim/quant stubs.
+"""contrib: mixed precision (AMP) + slim (quantization).
 
 Capability parity: reference `python/paddle/fluid/contrib/`.
 """
 
-from . import mixed_precision  # noqa: F401
+from . import mixed_precision, slim  # noqa: F401

@@ -1,0 +1,3 @@
+"""Model compression (reference `python/paddle/fluid/contrib/slim/`)."""
+
+from . import quantization  # noqa: F401

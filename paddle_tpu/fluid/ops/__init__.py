@@ -13,6 +13,7 @@ from . import (  # noqa: F401
     metric_ops,
     nn_ops,
     optimizer_ops,
+    quant_ops,
     random_ops,
     reduce_ops,
     rnn_ops,

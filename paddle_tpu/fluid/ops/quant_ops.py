@@ -1,0 +1,114 @@
+"""Quantization ops (QAT simulation + int8 storage).
+
+Capability parity: reference
+`python/paddle/fluid/contrib/slim/quantization/quantization_pass.py:1` and
+the C++ fake_quantize_op.cc / dequantize ops family:
+- fake_quantize_dequantize_abs_max: QAT simulation with a per-tensor
+  abs-max scale computed on the fly,
+- fake_channel_wise_quantize_dequantize_abs_max: per-output-channel weight
+  simulation,
+- fake_quantize_dequantize_moving_average_abs_max: activation simulation
+  with a running scale (persistable state),
+- quantize_linear / dequantize_linear: real int8 storage conversion used
+  by the freeze pass and post-training quantization.
+
+TPU-first: the straight-through estimator is not a hand-written grad
+kernel — the lowering is `x + stop_gradient(qdq(x) - x)`, so the generic
+VJP differentiates it as identity inside the clip range for free, and XLA
+folds the whole simulation into neighboring ops.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+QMAX = 127.0
+
+
+def _qdq(x, scale):
+    """quantize->dequantize to the int8 grid at `scale` (abs-max)."""
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * QMAX), -QMAX, QMAX)
+    return q * s / QMAX
+
+
+def _ste(x, scale):
+    # straight-through: forward = qdq(x), backward = identity
+    return x + jax.lax.stop_gradient(_qdq(x, scale) - x)
+
+
+@register_op("fake_quantize_dequantize_abs_max", inputs=["X"],
+             outputs=["Out", "OutScale"], stateful_out_slots=("OutScale",))
+def _fake_qdq_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": [_ste(x, scale)], "OutScale": [scale.reshape(1)]}
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max", inputs=["X"],
+             outputs=["Out", "OutScale"], stateful_out_slots=("OutScale",))
+def _fake_qdq_channel(ctx, ins, attrs):
+    """Per-output-channel weight simulation; quant_axis selects the channel
+    dim (0 for conv filters [O,I,H,W], 1 for fc weights [in, out])."""
+    x = ins["X"][0]
+    axis = int(attrs.get("quant_axis", 0))
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    return {
+        "Out": [_ste(x, scale)],
+        "OutScale": [scale.reshape(-1)],
+    }
+
+
+@register_op(
+    "fake_quantize_dequantize_moving_average_abs_max",
+    inputs=["X", "InScale"],
+    outputs=["Out", "OutScale"],
+    no_grad_slots=("InScale",),
+    stateful_out_slots=("OutScale",),
+)
+def _fake_qdq_moving_avg(ctx, ins, attrs):
+    """Activation simulation with EMA scale state (cf. fake_quantize_op.cc
+    moving_average_abs_max): scale' = rho*scale + (1-rho)*absmax(x)."""
+    x = ins["X"][0]
+    in_scale = ins["InScale"][0]
+    rho = float(attrs.get("moving_rate", 0.9))
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    if is_test:
+        scale = in_scale
+    else:
+        cur = jnp.max(jnp.abs(x)).reshape(1)
+        # first step: running scale still zero -> adopt the batch scale
+        scale = jnp.where(in_scale > 0, rho * in_scale + (1 - rho) * cur, cur)
+    return {"Out": [_ste(x, scale)], "OutScale": [scale]}
+
+
+@register_op("quantize_linear", inputs=["X", "Scale"], outputs=["Y"],
+             grad=None)
+def _quantize_linear(ctx, ins, attrs):
+    """float -> int8 at the given abs-max scale (freeze / PTQ storage)."""
+    x, scale = ins["X"][0], ins["Scale"][0]
+    axis = attrs.get("quant_axis", -1)
+    if axis >= 0 and scale.size > 1:
+        shape = [1] * x.ndim
+        shape[axis] = -1
+        scale = scale.reshape(shape)
+    s = jnp.maximum(scale, 1e-9)
+    return {"Y": [jnp.clip(jnp.round(x / s * QMAX), -QMAX, QMAX)
+                  .astype(jnp.int8)]}
+
+
+@register_op("dequantize_linear", inputs=["X", "Scale"], outputs=["Y"],
+             no_grad_slots=("Scale",))
+def _dequantize_linear(ctx, ins, attrs):
+    """int8 -> float: the only op a quantized program needs at run time;
+    XLA fuses the multiply into the consuming matmul/conv so the weight is
+    read from HBM as int8 (the bandwidth win)."""
+    x, scale = ins["X"][0], ins["Scale"][0]
+    axis = attrs.get("quant_axis", -1)
+    if axis >= 0 and scale.size > 1:
+        shape = [1] * x.ndim
+        shape[axis] = -1
+        scale = scale.reshape(shape)
+    return {"Y": [x.astype(jnp.float32) * scale / QMAX]}

@@ -1,0 +1,189 @@
+"""Quantization: fake-quant ops (STE), QAT transform/freeze, and
+post-training int8 (reference contrib/slim/quantization tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.contrib.slim.quantization import (
+    PostTrainingQuantization,
+    QuantizationFreezePass,
+    QuantizationTransformPass,
+)
+
+from op_test import check_output, run_single_op
+
+
+def _qdq_ref(x, scale=None, axis=None):
+    if scale is None:
+        scale = np.abs(x).max() if axis is None else np.abs(x).max(
+            axis=tuple(i for i in range(x.ndim) if i != axis), keepdims=True
+        )
+    s = np.maximum(scale, 1e-9)
+    return np.clip(np.round(x / s * 127.0), -127, 127) * s / 127.0
+
+
+def test_fake_qdq_abs_max_forward_and_ste_grad():
+    x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    outs, _ = run_single_op(
+        "fake_quantize_dequantize_abs_max", {"X": x}, {}, ["Out", "OutScale"]
+    )
+    np.testing.assert_allclose(outs["Out"], _qdq_ref(x), rtol=1e-5, atol=1e-6)
+    # STE: grad of sum(out) wrt x must be exactly ones
+    _, grads = run_single_op(
+        "fake_quantize_dequantize_abs_max", {"X": x}, {}, ["Out", "OutScale"],
+        grad_of=[("X", 0)],
+    )
+    np.testing.assert_allclose(grads["x_0@GRAD"], np.ones_like(x))
+
+
+def test_fake_channel_wise_qdq():
+    w = np.random.RandomState(1).randn(6, 8).astype(np.float32) * 3
+    outs, _ = run_single_op(
+        "fake_channel_wise_quantize_dequantize_abs_max", {"X": w},
+        {"quant_axis": 1}, ["Out", "OutScale"],
+    )
+    np.testing.assert_allclose(outs["Out"], _qdq_ref(w, axis=1),
+                               rtol=1e-5, atol=1e-6)
+    assert outs["OutScale"].shape == (8,)
+
+
+def test_quantize_dequantize_linear_roundtrip():
+    w = np.random.RandomState(2).randn(5, 4).astype(np.float32)
+    scale = np.abs(w).max(axis=0)
+    q, _ = run_single_op(
+        "quantize_linear", {"X": w, "Scale": scale}, {"quant_axis": 1}, ["Y"]
+    )
+    assert q["Y"].dtype == np.int8
+    dq, _ = run_single_op(
+        "dequantize_linear", {"X": q["Y"], "Scale": scale},
+        {"quant_axis": 1}, ["Y"],
+    )
+    np.testing.assert_allclose(dq["Y"], w, atol=np.abs(w).max() / 127.0)
+
+
+def _train_tiny(main, startup, loss, feeds, steps=40, seed=0):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(seed)
+    losses = []
+    for _ in range(steps):
+        x = rng.randn(16, 8).astype(np.float32)
+        y = (x[:, :1] * 2 - x[:, 1:2]).astype(np.float32)
+        (lv,) = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+        losses.append(float(lv))
+    return exe, losses
+
+
+def test_qat_transform_train_freeze():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[16, 8], append_batch_size=False)
+        y = layers.data("y", shape=[16, 1], append_batch_size=False)
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+    # QAT rewrite BEFORE optimizer insertion (reference flow)
+    QuantizationTransformPass().apply(main, startup)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+
+    types = [op.type for op in main.global_block.ops]
+    assert "fake_channel_wise_quantize_dequantize_abs_max" in types
+    assert "fake_quantize_dequantize_moving_average_abs_max" in types
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe, losses = _train_tiny(main, startup, loss, ["x", "y"])
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+        # inference clone + freeze to real int8 weights
+        infer = main.clone(for_test=True)
+        frozen = QuantizationFreezePass().apply(infer, scope)
+        ftypes = [op.type for op in frozen.global_block.ops]
+        assert "dequantize_linear" in ftypes
+        assert "fake_channel_wise_quantize_dequantize_abs_max" not in ftypes
+
+        xv = np.random.RandomState(9).randn(16, 8).astype(np.float32)
+        yv = np.zeros((16, 1), np.float32)
+        (qat_out,) = exe.run(
+            main.clone(for_test=True), feed={"x": xv, "y": yv},
+            fetch_list=[pred])
+        (frozen_out,) = exe.run(frozen, feed={"x": xv, "y": yv},
+                                fetch_list=[pred])
+        # frozen int8 weights reproduce the QAT simulation (same grid)
+        np.testing.assert_allclose(frozen_out, qat_out, rtol=1e-4, atol=1e-4)
+        # weights really are int8 in the scope
+        w_name = main.all_parameters()[0].name
+        assert np.asarray(scope.find_var(w_name + "@INT8")).dtype == np.int8
+
+
+def test_post_training_quantization():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[16, 8], append_batch_size=False)
+        y = layers.data("y", shape=[16, 1], append_batch_size=False)
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        infer = main.clone(for_test=True)
+        fluid.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe, _ = _train_tiny(main, startup, loss, ["x", "y"], steps=60)
+
+        rng = np.random.RandomState(7)
+        xv = rng.randn(16, 8).astype(np.float32)
+        yv = np.zeros((16, 1), np.float32)
+        (fp32_out,) = exe.run(infer, feed={"x": xv, "y": yv},
+                              fetch_list=[pred])
+
+        def calib():
+            r = np.random.RandomState(13)
+            for _ in range(4):
+                yield {"x": r.randn(16, 8).astype(np.float32), "y": yv}
+
+        ptq = PostTrainingQuantization(
+            executor=exe, program=infer, feed_names=["x", "y"], scope=scope,
+            batch_generator=calib,
+        )
+        qprog = ptq.quantize()
+        types = [op.type for op in qprog.global_block.ops]
+        assert "dequantize_linear" in types
+
+        (int8_out,) = exe.run(qprog, feed={"x": xv, "y": yv},
+                              fetch_list=[pred])
+    # int8 within a few percent of fp32 (reference PTQ acceptance)
+    np.testing.assert_allclose(int8_out, fp32_out, rtol=0.05, atol=0.02)
+
+
+def test_predictor_int8(tmp_path):
+    from paddle_tpu.inference.predictor import AnalysisConfig, create_predictor
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 8
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 8], append_batch_size=False)
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=3)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        path = str(tmp_path / "m")
+        fluid.io.save_inference_model(path, ["x"], [pred], exe, main)
+
+    xv = np.random.RandomState(3).randn(4, 8).astype(np.float32)
+    p32 = create_predictor(AnalysisConfig(path))
+    (o32,) = p32.run([xv])
+    cfg8 = AnalysisConfig(path)
+    cfg8.enable_int8()
+    p8 = create_predictor(cfg8)
+    (o8,) = p8.run([xv])
+    assert any(op.type == "dequantize_linear"
+               for op in p8._program.global_block.ops)
+    np.testing.assert_allclose(o8, o32, rtol=0.05, atol=0.02)
