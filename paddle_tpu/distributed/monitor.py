@@ -1,0 +1,148 @@
+"""Worker liveness + barrier diagnostics (failure detection).
+
+Capability parity: reference
+`operators/distributed/heart_beat_monitor.h:54` (HeartBeatMonitor with
+`LostWorkerMonitor` loop marking workers COMPLETED/LOST on ping timeout)
+and `barrier_monitor.{h,cc}` (barrier timeout diagnostics naming the
+absent trainers).
+
+TPU-first: there is no parameter server to host the monitor, so liveness
+is FILE-based over a shared directory (the same medium fleet checkpoints
+use — local FS or a mounted distributed FS): every rank touches
+`hb_<rank>` on a cadence; any rank (typically rank 0, or an external
+watchdog) scans mtimes and reports lost workers.  This detects hung or
+dead processes even when the XLA collective itself would just block —
+the watchdog can then trigger the fleet checkpoint-restart path
+(fleet/checkpoint.py), which is the reference's elastic story.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+UNINITED = "UNINITED"
+RUNNING = "RUNNING"
+COMPLETED = "COMPLETED"
+LOST = "LOST"
+
+
+class HeartBeatMonitor:
+    """File-based worker liveness (cf. `heart_beat_monitor.h:54`)."""
+
+    def __init__(self, workspace, worker_id, worker_num,
+                 interval_s=10.0, timeout_s=60.0):
+        self._dir = os.path.join(workspace, "heartbeats")
+        os.makedirs(self._dir, exist_ok=True)
+        self._id = int(worker_id)
+        self._num = int(worker_num)
+        self._interval = float(interval_s)
+        self._timeout = float(timeout_s)
+        self._thread = None
+        self._stop = threading.Event()
+
+    # -- worker side ----------------------------------------------------
+    def _path(self, rank, kind="hb"):
+        return os.path.join(self._dir, "%s_%d" % (kind, rank))
+
+    def update(self, rank=None):
+        """One ping (cf. HeartBeatMonitor::Update)."""
+        rank = self._id if rank is None else rank
+        with open(self._path(rank), "w") as f:
+            f.write(str(time.time()))
+
+    def complete(self, rank=None):
+        rank = self._id if rank is None else rank
+        with open(self._path(rank, "done"), "w") as f:
+            f.write(str(time.time()))
+
+    def start(self):
+        """Background ping loop (cf. LostWorkerMonitor thread)."""
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.is_set():
+                self.update()
+                self._stop.wait(self._interval)
+
+        self.update()
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- watchdog side --------------------------------------------------
+    def worker_status(self, now=None):
+        """{rank: UNINITED | RUNNING | COMPLETED | LOST}."""
+        now = time.time() if now is None else now
+        out = {}
+        for r in range(self._num):
+            if os.path.exists(self._path(r, "done")):
+                out[r] = COMPLETED
+                continue
+            p = self._path(r)
+            if not os.path.exists(p):
+                out[r] = UNINITED
+                continue
+            age = now - os.path.getmtime(p)
+            out[r] = LOST if age > self._timeout else RUNNING
+        return out
+
+    def lost_workers(self, now=None):
+        return [r for r, s in self.worker_status(now).items() if s == LOST]
+
+
+class BarrierMonitor:
+    """Barrier with timeout diagnostics naming absent ranks
+    (cf. `barrier_monitor.cc`).  File-based: each rank drops a marker for
+    the barrier id; everyone waits until all markers exist or times out
+    with the missing rank list in the error."""
+
+    def __init__(self, workspace, worker_id, worker_num, timeout_s=300.0):
+        self._dir = os.path.join(workspace, "barriers")
+        os.makedirs(self._dir, exist_ok=True)
+        self._id = int(worker_id)
+        self._num = int(worker_num)
+        self._timeout = float(timeout_s)
+        self._round = 0
+
+    def wait(self, barrier_id=None, poll_s=0.05):
+        """Barrier ids must be UNIQUE per synchronization point (markers
+        persist; a reused id would fall through instantly).  Omit the id
+        to use an auto-incrementing round counter — correct as long as
+        every rank calls wait() in the same order."""
+        if barrier_id is None:
+            self._round += 1
+            barrier_id = "auto%d" % self._round
+        me = os.path.join(self._dir, "b%s_r%d" % (barrier_id, self._id))
+        if os.path.exists(me):
+            raise ValueError(
+                "barrier id %r was already used by rank %d — ids must be "
+                "unique per synchronization point (or omit the id for the "
+                "auto counter)" % (barrier_id, self._id)
+            )
+        with open(me, "w") as f:
+            f.write(str(time.time()))
+        deadline = time.time() + self._timeout
+        while True:
+            missing = [
+                r for r in range(self._num)
+                if not os.path.exists(
+                    os.path.join(self._dir, "b%s_r%d" % (barrier_id, r))
+                )
+            ]
+            if not missing:
+                return
+            if time.time() > deadline:
+                raise TimeoutError(
+                    "barrier %r timed out after %.0fs; absent ranks: %s "
+                    "(cf. reference BarrierMonitor diagnostics)"
+                    % (barrier_id, self._timeout, missing)
+                )
+            time.sleep(poll_s)

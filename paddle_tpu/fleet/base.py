@@ -100,6 +100,26 @@ class Fleet:
             optimizer, strategy or self._strategy, fleet_=self
         )
 
+    # -- failure detection (strategy.elastic; cf. reference
+    #    heart_beat_monitor.h LostWorkerMonitor + elastic training) -------
+    def elastic_monitor(self, workspace):
+        """Heartbeat monitor for this worker over a shared `workspace`
+        (the checkpoint directory is the natural choice).  Call
+        `.start()` to ping in the background; rank 0 (or an external
+        watchdog) polls `.lost_workers()` and triggers the
+        checkpoint-restart path on loss."""
+        self._ensure()
+        from ..distributed.monitor import HeartBeatMonitor
+
+        cfg = getattr(self._strategy, "elastic_configs", None)
+        return HeartBeatMonitor(
+            workspace,
+            worker_id=self.worker_index(),
+            worker_num=self.worker_num(),
+            interval_s=getattr(cfg, "heartbeat_interval_s", 10.0),
+            timeout_s=getattr(cfg, "heartbeat_timeout_s", 60.0),
+        )
+
 
 class DistributedOptimizer:
     """cf. CollectiveOptimizer (collective/__init__.py:384): minimize =
@@ -140,6 +160,17 @@ class DistributedOptimizer:
         )
         if framework.in_dygraph_mode():
             return result
+        if s.sync_batch_norm:
+            # rewrite batch_norm -> sync_batch_norm EVERYWHERE it appears
+            # (same slots; the op pmean's batch stats over the dp mesh axis
+            # in mesh mode — cf. reference sync_batch_norm_op.cu): top-level
+            # ops, vjp_grad fwd_type (the backward re-lowers the forward, so
+            # grads must differentiate the pmean'd op too), and ops
+            # serialized into recompute_segment / control-flow attrs.
+            _rewrite_batch_norm_ops(
+                framework.default_main_program().global_block.ops
+            )
+            framework.default_main_program()._bump()
         # static mode: rewrite grads -> c_allreduce (GradAllReduce parity)
         n = self._fleet.worker_num() if self._fleet._is_initialized else 1
         if s.localsgd:
@@ -188,3 +219,24 @@ def worker_num():
 
 def is_first_worker():
     return fleet.is_first_worker()
+
+
+def _rewrite_batch_norm_ops(ops):
+    """Recursive batch_norm -> sync_batch_norm rewrite over Operator objects
+    AND serialized op dicts (recompute segments, cond/while sub-blocks)."""
+    _SUBOP_ATTRS = ("ops", "true_ops", "false_ops", "cond_ops", "body_ops")
+    for op in ops:
+        is_dict = isinstance(op, dict)
+        op_type = op["type"] if is_dict else op.type
+        attrs = op["attrs"] if is_dict else op.attrs
+        if op_type == "batch_norm":
+            if is_dict:
+                op["type"] = "sync_batch_norm"
+            else:
+                op.type = "sync_batch_norm"
+        elif op_type == "vjp_grad" and attrs.get("fwd_type") == "batch_norm":
+            attrs["fwd_type"] = "sync_batch_norm"
+        for key in _SUBOP_ATTRS:
+            sub = attrs.get(key)
+            if isinstance(sub, list):
+                _rewrite_batch_norm_ops(sub)

@@ -37,6 +37,12 @@ class LocalSGDConfigs:
         self.k_steps = 1
 
 
+class ElasticConfigs:
+    def __init__(self):
+        self.heartbeat_interval_s = 10.0
+        self.heartbeat_timeout_s = 60.0
+
+
 class AMPConfigs:
     def __init__(self):
         # on TPU bf16 needs no loss scaling; kept for parity with the
@@ -78,7 +84,9 @@ class DistributedStrategy:
         self.pipeline_configs = PipelineConfigs()
         self.sync = True  # PS modes are subsumed by sharding
         self.async_k_step = -1
-        self.elastic = False
+        self.sync_batch_norm = False  # rewrite batch_norm -> sync_batch_norm
+        self.elastic = False  # enable worker heartbeat monitoring
+        self.elastic_configs = ElasticConfigs()
         self.auto = False
         # TPU-native extension
         self.sharding = False

@@ -9,6 +9,67 @@ from __future__ import annotations
 
 from .registry import get_op_def
 
+_HOST_CB_SUPPORTED = None
+
+
+def host_callbacks_supported():
+    """Some PJRT plugins (e.g. the axon TPU tunnel) implement no host
+    send/recv, so jax.debug.callback fails at compile time.  Probe once;
+    debugging ops degrade gracefully (with a warning) when unsupported."""
+    global _HOST_CB_SUPPORTED
+    if _HOST_CB_SUPPORTED is None:
+        import jax
+
+        try:
+            def probe(x):
+                jax.debug.callback(lambda v: None, x)
+                return x
+
+            # the probe usually fires while TRACING a program (op lowering);
+            # escape to compile-time eval so it really compiles + runs now
+            with jax.ensure_compile_time_eval():
+                jax.jit(probe)(1.0).block_until_ready()
+            _HOST_CB_SUPPORTED = True
+        except Exception:
+            _HOST_CB_SUPPORTED = False
+    return _HOST_CB_SUPPORTED
+
+
+def _warn_no_callbacks(feature):
+    import warnings
+
+    warnings.warn(
+        "%s needs host callbacks, which this backend's PJRT plugin does "
+        "not support — it is a no-op here; debug on JAX_PLATFORMS=cpu"
+        % feature
+    )
+
+
+def _nan_guard(op_type, out_name, val):
+    """Per-op NaN/Inf localization (reference
+    `details/nan_inf_utils_detail.cc` via FLAGS_check_nan_inf): a host
+    callback raises naming the exact op + output var, from inside the
+    compiled program."""
+    import jax
+    import jax.numpy as jnp
+
+    if not hasattr(val, "dtype") or not jnp.issubdtype(val.dtype,
+                                                       jnp.floating):
+        return val
+    if not host_callbacks_supported():
+        _warn_no_callbacks("FLAGS_check_nan_inf per-op localization")
+        return val
+
+    def cb(bad):
+        if bool(bad):
+            raise FloatingPointError(
+                "NaN/Inf detected in output '%s' of op '%s' "
+                "(FLAGS_check_nan_inf)" % (out_name, op_type)
+            )
+
+    jax.debug.callback(cb, ~jnp.all(jnp.isfinite(val)))
+    return val
+
 
 def run_ops(ops, env, ctx):
     """Run a sequence of ops over a name->value env (mutated in place).
@@ -16,6 +77,11 @@ def run_ops(ops, env, ctx):
     ops: framework.Operator objects OR serialized dicts
     (framework.Operator.to_dict form: {"type", "inputs", "outputs", "attrs"}).
     """
+    from ..flags import get_flags
+
+    check_nan = bool(
+        get_flags(["FLAGS_check_nan_inf"]).get("FLAGS_check_nan_inf")
+    )
     for op in ops:
         if isinstance(op, dict):
             op_type, op_ins, op_outs, op_attrs = (
@@ -38,5 +104,7 @@ def run_ops(ops, env, ctx):
         outs = opdef.lower(ctx, ins, op_attrs)
         for slot, names in op_outs.items():
             for n, val in zip(names, outs[slot]):
+                if check_nan:
+                    val = _nan_guard(op_type, n, val)
                 env[n] = val
     return env

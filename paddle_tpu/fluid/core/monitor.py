@@ -1,0 +1,46 @@
+"""StatRegistry: named int64 counters for runtime observability.
+
+Capability parity: reference `platform/monitor.h:31-76` — `StatRegistry`
+singleton of `StatValue` counters with `STAT_ADD`/`STAT_RESET` macros
+(used there for GPU memory high-water marks).  Here the registry is a
+plain host-side dict the framework increments at interesting points
+(program compiles, executor runs, predictor requests); users read it via
+`fluid.core.monitor.stat_values()` or reset with `reset()`.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_stats: dict[str, int] = {}
+
+
+def stat_add(name: str, value: int = 1) -> None:
+    """cf. STAT_ADD(item, t) (`monitor.h:142`)."""
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + int(value)
+
+
+def stat_set(name: str, value: int) -> None:
+    with _lock:
+        _stats[name] = int(value)
+
+
+def stat_get(name: str) -> int:
+    with _lock:
+        return _stats.get(name, 0)
+
+
+def stat_values() -> dict[str, int]:
+    """Snapshot of all counters (cf. StatRegistry::publish)."""
+    with _lock:
+        return dict(_stats)
+
+
+def reset(name: str = None) -> None:
+    with _lock:
+        if name is None:
+            _stats.clear()
+        else:
+            _stats.pop(name, None)

@@ -1,0 +1,93 @@
+"""Program visualization + pretty printing.
+
+Capability parity: reference `python/paddle/fluid/debugger.py:1`
+(`draw_block_graphviz` — ops and vars as a dot graph;
+`pprint_program_codes` — C-like program listing) and
+`framework/ir/graph_viz_pass.cc` (the pass-pipeline dot dumper)."""
+
+from __future__ import annotations
+
+from . import framework
+
+
+def _esc(s):
+    return str(s).replace('"', r"\"")
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+    """Write `block` as a graphviz dot file: op nodes (boxes) wired to var
+    nodes (ellipses; parameters shaded).  Returns the path."""
+    highlights = set(highlights or ())
+    lines = [
+        "digraph G {",
+        "  rankdir=TB;",
+        '  node [fontsize=10, fontname="Helvetica"];',
+    ]
+    var_nodes = {}
+
+    def var_node(name):
+        if name not in var_nodes:
+            nid = "var_%d" % len(var_nodes)
+            var_nodes[name] = nid
+            v = block._find_var_recursive(name)
+            shape = ""
+            if v is not None and v.shape is not None:
+                shape = r"\n%s %s" % (v.dtype, list(v.shape))
+            style = 'style=filled, fillcolor="lightgrey", ' if (
+                v is not None and getattr(v, "persistable", False)
+            ) else ""
+            extra = 'color="red", penwidth=2, ' if name in highlights else ""
+            lines.append(
+                '  %s [%s%sshape=ellipse, label="%s%s"];'
+                % (nid, style, extra, _esc(name), shape)
+            )
+        return var_nodes[name]
+
+    for i, op in enumerate(block.ops):
+        op_id = "op_%d" % i
+        lines.append(
+            '  %s [shape=box, style=filled, fillcolor="lightblue", '
+            'label="%s"];' % (op_id, _esc(op.type))
+        )
+        for name in op.all_input_names():
+            lines.append("  %s -> %s;" % (var_node(name), op_id))
+        for name in op.all_output_names():
+            lines.append("  %s -> %s;" % (op_id, var_node(name)))
+    lines.append("}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def draw(program, path="./program.dot"):
+    """Convenience: dot-dump a Program's global block."""
+    if hasattr(program, "global_block"):
+        return draw_block_graphviz(program.global_block, path=path)
+    return draw_block_graphviz(program, path=path)
+
+
+def pprint_program_codes(program):
+    """C-like listing of every block (cf. reference pprint_program_codes);
+    returns the string (the reference prints)."""
+    out = []
+    for blk in getattr(program, "blocks", [program.global_block]):
+        out.append("block_%d {" % getattr(blk, "idx", 0))
+        for v in sorted(getattr(blk, "vars", {}).values(),
+                        key=lambda v: v.name):
+            out.append(
+                "  var %s : %s%s%s" % (
+                    v.name, v.dtype,
+                    list(v.shape) if v.shape is not None else "?",
+                    "  // param" if getattr(v, "persistable", False) else "",
+                )
+            )
+        for op in blk.ops:
+            ins = ", ".join(
+                "%s=%s" % (k, v) for k, v in sorted(op.inputs.items())
+            )
+            outs = ", ".join(
+                "%s=%s" % (k, v) for k, v in sorted(op.outputs.items())
+            )
+            out.append("  %s := %s(%s)" % (outs, op.type, ins))
+        out.append("}")
+    return "\n".join(out)

@@ -113,6 +113,9 @@ class _LoweredBlock:
                         state_out.append(name)
         self.state_in = state_in
         self.state_out = state_out
+        # print ops emit host callbacks; the executor must flush them so
+        # output appears before run() returns
+        self.has_print_effects = any(op.type == "print" for op in ops)
         # Only state that is rewritten may be donated; read-only persistables
         # (e.g. params during eval) must keep their buffers alive in the scope.
         self.state_donate = [n for n in state_in if n in set(state_out)]
@@ -259,6 +262,8 @@ class Executor:
         feed_sig = tuple(
             (n, feed_vals[n].shape, str(feed_vals[n].dtype)) for n in sorted(feed_vals)
         )
+        from .flags import get_flags
+
         key = (
             id(program),
             program._version,
@@ -267,7 +272,12 @@ class Executor:
             id(scope),
             tuple(id(d) for d in dp_devices) if dp_devices else None,
             id(self.mesh) if self.mesh is not None else None,
+            # the NaN guard is baked into the traced program, so the flag
+            # must participate in the cache key
+            bool(get_flags(["FLAGS_check_nan_inf"])["FLAGS_check_nan_inf"]),
         )
+        from .core import monitor
+
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             entry = _LoweredBlock(
@@ -275,8 +285,11 @@ class Executor:
                 dp_devices=dp_devices, mesh=self.mesh,
                 feed_shapes={n: a.shape for n, a in feed_vals.items()},
             )
+            monitor.stat_add("STAT_executor_programs_compiled")
             if use_program_cache:
                 self._cache[key] = entry
+            self._maybe_warn_unused_vars(block, fetch_names)
+        monitor.stat_add("STAT_executor_runs")
 
         donate_state = {n: scope.find_var(n) for n in entry.state_donate}
         ro_state = {n: scope.find_var(n) for n in entry.state_ro}
@@ -339,6 +352,8 @@ class Executor:
         rng_key = jax.random.PRNGKey(seed_val)
 
         fetches, new_state = entry(feed_dev, donate_state, ro_state, rng_key)
+        if entry.has_print_effects:
+            jax.effects_barrier()
 
         for n, val in new_state.items():
             scope.set(n, val)
@@ -360,6 +375,37 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
+
+    @staticmethod
+    def _maybe_warn_unused_vars(block, fetch_names):
+        """FLAGS_enable_unused_var_check (reference
+        `framework/unused_var_check.cc`): warn about op outputs nothing
+        consumes — usually a sign of a mis-built program."""
+        from .flags import get_flags
+
+        if not get_flags(["FLAGS_enable_unused_var_check"]).get(
+            "FLAGS_enable_unused_var_check"
+        ):
+            return
+        consumed = set(fetch_names)
+        for op in block.ops:
+            consumed.update(op.all_input_names())
+        unused = []
+        for op in block.ops:
+            for n in op.all_output_names():
+                v = block._find_var_recursive(n)
+                persistable = v is not None and getattr(
+                    v, "persistable", False
+                )
+                if n not in consumed and not persistable:
+                    unused.append("%s (from %s)" % (n, op.type))
+        if unused:
+            import warnings
+
+            warnings.warn(
+                "unused op outputs (FLAGS_enable_unused_var_check): %s"
+                % ", ".join(unused[:20])
+            )
 
     # convenience used by tests/io
     def run_startup(self, startup_program=None, scope=None):

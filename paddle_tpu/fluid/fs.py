@@ -1,0 +1,161 @@
+"""File-system shell abstraction for fleet checkpoints & datasets.
+
+Capability parity: reference `framework/io/fs.{h,cc}` + `shell.{h,cc}`
+(popen-based local/HDFS ops behind one interface) and the Python fleet
+side `incubate/fleet/utils/fs.py` (LocalFS / BDFS clients with
+ls_dir/is_dir/upload/download/mkdirs/delete).
+
+LocalFS is complete; HDFSClient shells out to the `hadoop fs` CLI when
+one is configured (the reference does exactly this through shell.cc) and
+raises with guidance otherwise — checkpoint code written against the
+interface ports unchanged between backends."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+
+class FS:
+    """Interface (cf. reference fs.h function table)."""
+
+    def ls_dir(self, path):
+        raise NotImplementedError
+
+    def is_dir(self, path):
+        raise NotImplementedError
+
+    def is_file(self, path):
+        raise NotImplementedError
+
+    def is_exist(self, path):
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mv(self, src, dst):
+        raise NotImplementedError
+
+    def touch(self, path):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """cf. reference LocalFS (fs.cc localfs_* functions)."""
+
+    def ls_dir(self, path):
+        if not os.path.exists(path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name)) else files
+             ).append(name)
+        return dirs, files
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def upload(self, local_path, fs_path):
+        self.mkdirs(os.path.dirname(fs_path) or ".")
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def mv(self, src, dst):
+        shutil.move(src, dst)
+
+    def touch(self, path):
+        self.mkdirs(os.path.dirname(path) or ".")
+        open(path, "a").close()
+
+
+class HDFSClient(FS):
+    """cf. reference HDFSClient (fs.cc hdfs_* via popen `hadoop fs`)."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        self._hadoop = (
+            os.path.join(hadoop_home, "bin", "hadoop")
+            if hadoop_home else shutil.which("hadoop")
+        )
+        self._configs = configs or {}
+
+    def _cmd(self, *args):
+        if self._hadoop is None or not os.path.exists(self._hadoop):
+            raise RuntimeError(
+                "HDFSClient needs a hadoop CLI (hadoop_home=...) — "
+                "none found; use LocalFS or mount the DFS locally"
+            )
+        pre = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            pre += ["-D%s=%s" % (k, v)]
+        return subprocess.run(
+            pre + list(args), capture_output=True, text=True, timeout=300
+        )
+
+    def ls_dir(self, path):
+        r = self._cmd("-ls", path)
+        dirs, files = [], []
+        for line in r.stdout.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path):
+        return self._cmd("-test", "-e", path).returncode == 0
+
+    def is_dir(self, path):
+        return self._cmd("-test", "-d", path).returncode == 0
+
+    def is_file(self, path):
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def mkdirs(self, path):
+        self._cmd("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._cmd("-rm", "-r", "-f", path)
+
+    def upload(self, local_path, fs_path):
+        self._cmd("-put", "-f", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._cmd("-get", fs_path, local_path)
+
+    def mv(self, src, dst):
+        self._cmd("-mv", src, dst)
+
+    def touch(self, path):
+        self._cmd("-touchz", path)

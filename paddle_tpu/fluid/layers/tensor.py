@@ -297,3 +297,20 @@ def logical_or(x, y):
 
 def logical_not(x):
     return append_simple_op("logical_not", {"X": x}, dtype="bool", stop_gradient=True)
+
+
+def Print(input, first_n=-1, message="", summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """cf. reference layers.Print (print_op.cc): identity op that prints
+    the tensor from inside the compiled program.  first_n/phase knobs are
+    accepted for parity (XLA prints on every execution)."""
+    msg = message or ""
+    if print_tensor_name:
+        msg = ("%s %s" % (msg, input.name)).strip()
+    return append_simple_op(
+        "print", {"In": input},
+        {"message": msg, "summarize": summarize,
+         "print_tensor_shape": print_tensor_shape},
+    )

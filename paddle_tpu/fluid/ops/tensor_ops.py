@@ -349,3 +349,40 @@ def _increment(ctx, ins, attrs):
     x = ins["X"][0]
     # preserve x's dtype: int counters must not be promoted to float
     return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), x.dtype)]}
+
+
+@register_op("print", inputs=["In"], outputs=["Out"])
+def _print(ctx, ins, attrs):
+    """Periodic fetch printer (cf. reference operators/print_op.cc /
+    layers.Print): passes X through and prints message + summarized values
+    from inside the compiled program via jax.debug.print (the TPU-safe
+    analogue of the reference's host-side tensor printer)."""
+    import jax
+
+    x = ins["In"][0]
+    message = str(attrs.get("message", ""))
+    summarize = int(attrs.get("summarize", 20))
+    show_shape = bool(attrs.get("print_tensor_shape", True))
+    shape = tuple(x.shape)
+    flat = x.reshape(-1)
+    head = flat[: summarize if summarize > 0 else flat.shape[0]]
+
+    from ..core.block_eval import _warn_no_callbacks, host_callbacks_supported
+
+    if not host_callbacks_supported():
+        _warn_no_callbacks("layers.Print")
+        return {"Out": [x]}
+
+    # host callback, NOT jax.debug.print: the user message is arbitrary
+    # text (its braces must not reach a format-string parser)
+    def _emit(v):
+        import numpy as _np
+
+        if show_shape:
+            print("%s shape=%s values=%s" % (message, shape, _np.asarray(v)),
+                  flush=True)
+        else:
+            print("%s %s" % (message, _np.asarray(v)), flush=True)
+
+    jax.debug.callback(_emit, head)
+    return {"Out": [x]}

@@ -1,0 +1,248 @@
+"""Observability floor: StatRegistry counters, Print op, graphviz dump,
+per-op NaN localization, unused-var check (reference `platform/monitor.h`,
+`operators/print_op.cc`, `python/paddle/fluid/debugger.py:1`,
+`details/nan_inf_utils_detail.cc`, `framework/unused_var_check.cc`)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.core import monitor
+
+
+def _simple_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 3], append_batch_size=False)
+        h = layers.fc(x, size=5, act="relu")
+        out = layers.reduce_sum(h)
+    return main, startup, out
+
+
+def test_stat_registry_counts_runs():
+    monitor.reset()
+    main, startup, out = _simple_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed={"x": np.ones((4, 3), np.float32)},
+                    fetch_list=[out])
+    stats = monitor.stat_values()
+    assert stats["STAT_executor_runs"] >= 4  # startup + 3 main runs
+    assert stats["STAT_executor_programs_compiled"] >= 2
+    monitor.stat_add("custom_counter", 5)
+    assert monitor.stat_get("custom_counter") == 5
+    monitor.reset("custom_counter")
+    assert monitor.stat_get("custom_counter") == 0
+
+
+def test_print_op_passthrough(capfd):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], append_batch_size=False)
+        y = layers.Print(x, message="DBGVAL", summarize=3)
+        z = layers.scale(y, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[z])
+    np.testing.assert_allclose(out, xv * 2)  # identity pass-through
+    captured = capfd.readouterr()
+    assert "DBGVAL" in captured.out or "DBGVAL" in captured.err
+
+
+def test_graphviz_dump(tmp_path):
+    main, _, _ = _simple_program()
+    path = str(tmp_path / "prog.dot")
+    fluid.debugger.draw(main, path=path)
+    dot = open(path).read()
+    assert dot.startswith("digraph G {")
+    assert "matmul" in dot or "mul" in dot  # the fc's compute op
+    assert "shape=box" in dot and "shape=ellipse" in dot
+    # parameters shaded
+    assert "lightgrey" in dot
+
+
+def test_pprint_program_codes():
+    main, _, _ = _simple_program()
+    listing = fluid.debugger.pprint_program_codes(main)
+    assert "block_0 {" in listing
+    assert "reduce_sum" in listing
+
+
+def test_nan_localization_names_the_op():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], append_batch_size=False)
+        lg = layers.log(x)  # NaN for negative inputs
+        out = layers.reduce_sum(lg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(Exception) as ei:
+            exe.run(main, feed={"x": np.array([-1.0, 1.0, 2.0], np.float32)},
+                    fetch_list=[out])
+        assert "log" in str(ei.value)  # the guard names the culprit op
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+    # and clean inputs still work with the flag off
+    (ov,) = exe.run(main, feed={"x": np.array([1.0, 1.0, 2.0], np.float32)},
+                    fetch_list=[out])
+    assert np.isfinite(ov).all()
+
+
+def test_unused_var_check_warns():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], append_batch_size=False)
+        _dead = layers.scale(x, scale=3.0)  # produced, never consumed
+        out = layers.reduce_sum(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flags({"FLAGS_enable_unused_var_check": True})
+    try:
+        with pytest.warns(UserWarning, match="unused op outputs"):
+            exe.run(main, feed={"x": np.ones((3,), np.float32)},
+                    fetch_list=[out])
+    finally:
+        fluid.set_flags({"FLAGS_enable_unused_var_check": False})
+
+
+# ---------------------------------------------------------------------------
+# failure detection (reference heart_beat_monitor.h:54, barrier_monitor.cc)
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_monitor_detects_lost_worker(tmp_path):
+    import time
+
+    from paddle_tpu.distributed.monitor import (
+        COMPLETED, LOST, RUNNING, UNINITED, HeartBeatMonitor,
+    )
+
+    ws = str(tmp_path)
+    m0 = HeartBeatMonitor(ws, worker_id=0, worker_num=3, timeout_s=0.2)
+    m1 = HeartBeatMonitor(ws, worker_id=1, worker_num=3, timeout_s=0.2)
+    m0.update()
+    m1.update()
+    st = m0.worker_status()
+    assert st[0] == RUNNING and st[1] == RUNNING and st[2] == UNINITED
+    assert m0.lost_workers() == []
+    # worker 1 stops pinging -> LOST after timeout; worker 0 keeps pinging
+    time.sleep(0.3)
+    m0.update()
+    st = m0.worker_status()
+    assert st[0] == RUNNING and st[1] == LOST
+    assert m0.lost_workers() == [1]
+    m1.complete()
+    assert m0.worker_status()[1] == COMPLETED
+
+
+def test_barrier_monitor_names_absent_ranks(tmp_path):
+    from paddle_tpu.distributed.monitor import BarrierMonitor
+
+    import threading
+
+    b0 = BarrierMonitor(str(tmp_path), 0, 2, timeout_s=0.3)
+    with pytest.raises(TimeoutError, match=r"absent ranks: \[1\]"):
+        b0.wait("step1")
+    # both present -> passes (second party joins from a thread)
+    b0._timeout = 5.0
+    b1 = BarrierMonitor(str(tmp_path), 1, 2, timeout_s=5.0)
+    t = threading.Thread(target=lambda: b1.wait("step2"))
+    t.start()
+    b0.wait("step2")
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
+def test_fleet_sync_batch_norm_rewrite():
+    import paddle_tpu.fleet as fleet_mod
+    from paddle_tpu.fleet import DistributedStrategy
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8, 4], append_batch_size=False)
+        h = layers.batch_norm(layers.fc(x, size=4))
+        loss = layers.reduce_mean(h)
+        fleet = fleet_mod.fleet
+        fleet.init(is_collective=True)
+        s = DistributedStrategy()
+        s.sync_batch_norm = True
+        opt = fleet.distributed_optimizer(
+            fluid.optimizer.SGDOptimizer(learning_rate=0.1), strategy=s)
+        opt.minimize(loss)
+    types = [op.type for op in main.global_block.ops]
+    assert "sync_batch_norm" in types and "batch_norm" not in types
+    # single-rank it still executes correctly
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (lv,) = exe.run(main, feed={"x": np.ones((8, 4), np.float32)},
+                        fetch_list=[loss])
+    assert np.isfinite(lv)
+
+
+def test_local_fs_roundtrip(tmp_path):
+    from paddle_tpu.fluid.fs import LocalFS
+
+    fs = LocalFS()
+    d = str(tmp_path / "a" / "b")
+    fs.mkdirs(d)
+    assert fs.is_dir(d)
+    f = str(tmp_path / "a" / "b" / "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f) and fs.is_exist(f)
+    dirs, files = fs.ls_dir(str(tmp_path / "a" / "b"))
+    assert files == ["x.txt"]
+    fs.upload(f, str(tmp_path / "copy.txt"))
+    assert fs.is_file(str(tmp_path / "copy.txt"))
+    fs.mv(str(tmp_path / "copy.txt"), str(tmp_path / "moved.txt"))
+    assert fs.is_file(str(tmp_path / "moved.txt"))
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_nan_flag_toggle_after_first_run_takes_effect():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], append_batch_size=False)
+        out = layers.reduce_sum(layers.log(x))
+    exe = fluid.Executor(fluid.CPUPlace())
+    bad = np.array([-1.0, 1.0, 2.0], np.float32)
+    # first run WITHOUT the flag: NaN passes through silently
+    (v,) = exe.run(main, feed={"x": bad}, fetch_list=[out])
+    assert np.isnan(v)
+    # toggling the flag must invalidate the cached trace
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(Exception, match="log"):
+            exe.run(main, feed={"x": bad}, fetch_list=[out])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_print_message_with_braces_is_safe(capfd):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2], append_batch_size=False)
+        y = layers.Print(x, message="loss at {step}")
+        z = layers.scale(y, scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out,) = exe.run(main, feed={"x": np.ones((2,), np.float32)},
+                     fetch_list=[z])
+    np.testing.assert_allclose(out, [1.0, 1.0])
+    assert "loss at {step}" in capfd.readouterr().out
+
+
+def test_barrier_id_reuse_raises(tmp_path):
+    from paddle_tpu.distributed.monitor import BarrierMonitor
+
+    b = BarrierMonitor(str(tmp_path), 0, 1, timeout_s=1.0)
+    b.wait("once")
+    with pytest.raises(ValueError, match="already used"):
+        b.wait("once")
+    b.wait()  # auto ids never collide
+    b.wait()
