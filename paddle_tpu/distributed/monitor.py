@@ -58,9 +58,11 @@ class HeartBeatMonitor:
             f.write(str(time.time()))
 
     def start(self):
-        """Background ping loop (cf. LostWorkerMonitor thread)."""
+        """Background ping loop (cf. LostWorkerMonitor thread); safe to
+        call again after stop()."""
         if self._thread is not None:
             return
+        self._stop.clear()
 
         def loop():
             while not self._stop.is_set():

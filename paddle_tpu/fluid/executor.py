@@ -114,8 +114,22 @@ class _LoweredBlock:
         self.state_in = state_in
         self.state_out = state_out
         # print ops emit host callbacks; the executor must flush them so
-        # output appears before run() returns
-        self.has_print_effects = any(op.type == "print" for op in ops)
+        # output appears before run() returns (including prints serialized
+        # into cond/while/recompute sub-op attrs)
+        def _has_print(op_seq):
+            for o in op_seq:
+                o_type = o["type"] if isinstance(o, dict) else o.type
+                o_attrs = o["attrs"] if isinstance(o, dict) else o.attrs
+                if o_type == "print":
+                    return True
+                for key in ("ops", "true_ops", "false_ops", "cond_ops",
+                            "body_ops"):
+                    sub = o_attrs.get(key)
+                    if isinstance(sub, list) and _has_print(sub):
+                        return True
+            return False
+
+        self.has_print_effects = _has_print(ops)
         # Only state that is rewritten may be donated; read-only persistables
         # (e.g. params during eval) must keep their buffers alive in the scope.
         self.state_donate = [n for n in state_in if n in set(state_out)]
@@ -397,7 +411,9 @@ class Executor:
                 persistable = v is not None and getattr(
                     v, "persistable", False
                 )
-                if n not in consumed and not persistable:
+                if (n not in consumed and not persistable
+                        and "@GRAD@JUNK" not in n):
+                    # @GRAD@JUNK: deliberate cotangent sinks (backward.py)
                     unused.append("%s (from %s)" % (n, op.type))
         if unused:
             import warnings

@@ -122,6 +122,17 @@ class HDFSClient(FS):
             pre + list(args), capture_output=True, text=True, timeout=300
         )
 
+    def _cmd_checked(self, *args):
+        """Mutating ops must FAIL LOUDLY (reference raises ExecuteError on
+        nonzero exit) — a silently lost checkpoint is data loss."""
+        r = self._cmd(*args)
+        if r.returncode != 0:
+            raise RuntimeError(
+                "hadoop fs %s failed (rc=%d): %s"
+                % (" ".join(args), r.returncode, r.stderr.strip()[:500])
+            )
+        return r
+
     def ls_dir(self, path):
         r = self._cmd("-ls", path)
         dirs, files = [], []
@@ -143,19 +154,19 @@ class HDFSClient(FS):
         return self.is_exist(path) and not self.is_dir(path)
 
     def mkdirs(self, path):
-        self._cmd("-mkdir", "-p", path)
+        self._cmd_checked("-mkdir", "-p", path)
 
     def delete(self, path):
-        self._cmd("-rm", "-r", "-f", path)
+        self._cmd_checked("-rm", "-r", "-f", path)
 
     def upload(self, local_path, fs_path):
-        self._cmd("-put", "-f", local_path, fs_path)
+        self._cmd_checked("-put", "-f", local_path, fs_path)
 
     def download(self, fs_path, local_path):
-        self._cmd("-get", fs_path, local_path)
+        self._cmd_checked("-get", fs_path, local_path)
 
     def mv(self, src, dst):
-        self._cmd("-mv", src, dst)
+        self._cmd_checked("-mv", src, dst)
 
     def touch(self, path):
-        self._cmd("-touchz", path)
+        self._cmd_checked("-touchz", path)

@@ -1,0 +1,62 @@
+"""paddle.metric 2.0-style namespace (reference `python/paddle/metric/
+metrics.py`): Accuracy takes (pred, label) batches directly; the v1 fluid
+classes (scalar-accumulating) remain available under their names."""
+
+import numpy as np
+
+from ..fluid.metrics import (  # noqa: F401
+    Auc,
+    CompositeMetric,
+    MetricBase,
+    Precision,
+    Recall,
+)
+
+Metric = MetricBase  # 2.0 alias
+
+
+class Accuracy:
+    """cf. paddle.metric.Accuracy (2.0): top-k accuracy over (pred, label)
+    batches; update() accepts either raw (pred, label) arrays or the
+    precomputed correctness matrix from compute()."""
+
+    def __init__(self, topk=(1,), name=None):
+        self.topk = topk if isinstance(topk, (tuple, list)) else (topk,)
+        self.name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label):
+        pred = np.asarray(
+            pred.numpy() if hasattr(pred, "numpy") else pred
+        )
+        label = np.asarray(
+            label.numpy() if hasattr(label, "numpy") else label
+        ).reshape(-1)
+        maxk = max(self.topk)
+        topk_idx = np.argsort(-pred, axis=-1)[:, :maxk]
+        return (topk_idx == label[:, None]).astype(np.float32)
+
+    def update(self, correct, label=None):
+        if label is not None:  # raw (pred, label) convenience
+            correct = self.compute(correct, label)
+        correct = np.asarray(
+            correct.numpy() if hasattr(correct, "numpy") else correct
+        )
+        for i, k in enumerate(self.topk):
+            self.total[i] += correct[:, :k].max(axis=1).sum()
+            self.count[i] += correct.shape[0]
+        return self.accumulate()
+
+    def accumulate(self):
+        out = [
+            float(t / c) if c else 0.0 for t, c in zip(self.total, self.count)
+        ]
+        return out[0] if len(out) == 1 else out
+
+    # fluid-style alias
+    def eval(self):
+        return self.accumulate()

@@ -1,0 +1,90 @@
+"""paddle.nn 2.0-style namespace (reference `python/paddle/nn/__init__.py`).
+
+Layer classes and `nn.functional` over the same dual-mode machinery as
+fluid — 2.0 names, identical lowering.  The reference's 2.0 preview
+re-exports fluid internals the same way (`python/paddle/nn/layer/*.py`
+wraps `fluid.dygraph.nn`)."""
+
+from ..fluid.dygraph import (  # noqa: F401
+    BatchNorm,
+    Conv2D,
+    Dropout,
+    Embedding,
+    GroupNorm,
+    LayerList,
+    LayerNorm,
+    Linear,
+    ParameterList,
+    Pool2D,
+    Sequential,
+)
+from ..fluid.dygraph.layers import Layer  # noqa: F401
+from . import functional  # noqa: F401
+from ..fluid.layer_helper import ParamAttr  # noqa: F401
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class GELU(Layer):
+    def __init__(self, approximate=False):
+        super().__init__()
+        self._approximate = approximate
+
+    def forward(self, x):
+        return functional.gelu(x, self._approximate)
+
+
+class Sigmoid(Layer):
+    def forward(self, x):
+        return functional.sigmoid(x)
+
+
+class Tanh(Layer):
+    def forward(self, x):
+        return functional.tanh(x)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self._axis)
+
+
+class CrossEntropyLoss(Layer):
+    """cf. paddle.nn.CrossEntropyLoss: softmax + CE over int labels."""
+
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        from ..fluid import layers
+
+        loss = layers.softmax_with_cross_entropy(input, label)
+        if self._reduction == "mean":
+            return layers.reduce_mean(loss)
+        if self._reduction == "sum":
+            return layers.reduce_sum(loss)
+        return loss
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        from ..fluid import layers
+
+        loss = layers.square(input - label)
+        if self._reduction == "mean":
+            return layers.reduce_mean(loss)
+        if self._reduction == "sum":
+            return layers.reduce_sum(loss)
+        return loss

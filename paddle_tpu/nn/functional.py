@@ -1,0 +1,75 @@
+"""paddle.nn.functional (reference `python/paddle/nn/functional/`):
+functional forms with 2.0 names, delegating to the dual-mode layer API
+(works eagerly under dygraph and as program building in static mode)."""
+
+from ..fluid import layers as _L
+
+relu = _L.relu
+sigmoid = _L.sigmoid
+tanh = _L.tanh
+log_softmax = _L.log_softmax
+dropout = _L.dropout
+elu = _L.elu
+selu = _L.selu
+leaky_relu = _L.leaky_relu
+mish = _L.mish
+silu = _L.silu
+softplus = _L.softplus
+softsign = _L.softsign
+
+
+def hardswish(x):
+    from ..fluid.layers.common import append_simple_op
+
+    return append_simple_op("hard_swish", {"X": x})
+
+
+def gelu(x, approximate=False):
+    return _L.gelu(x, approximate)
+
+
+def softmax(x, axis=-1):
+    return _L.softmax(x, axis=axis)
+
+
+def cross_entropy(input, label, reduction="mean", soft_label=False):
+    loss = _L.softmax_with_cross_entropy(input, label,
+                                         soft_label=soft_label)
+    if reduction == "mean":
+        return _L.reduce_mean(loss)
+    if reduction == "sum":
+        return _L.reduce_sum(loss)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean"):
+    loss = _L.square(input - label)
+    if reduction == "mean":
+        return _L.reduce_mean(loss)
+    if reduction == "sum":
+        return _L.reduce_sum(loss)
+    return loss
+
+
+def linear(x, weight, bias=None):
+    out = _L.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def embedding(x, weight, padding_idx=None):
+    from ..fluid.layers.common import append_simple_op
+
+    pad = -1 if padding_idx is None else int(padding_idx)
+    return append_simple_op(
+        "lookup_table", {"W": weight, "Ids": x}, {"padding_idx": pad}
+    )
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    return _L.l2_normalize(x, axis=axis, epsilon=epsilon)
+
+
+def pad(x, paddings, value=0.0):
+    return _L.pad(x, paddings, pad_value=value)

@@ -57,15 +57,15 @@ def _naive_attention(q, k, v, bias, scale, causal):
 def _use_pallas(q, k, bias):
     if jax.default_backend() != "tpu":
         return False
-    # pallas kernel wants MXU/VPU-aligned tiles; the in-kernel bias path
-    # only handles row-broadcast (padding-mask) biases
+    # pallas kernel wants MXU-aligned head dim; the in-kernel bias path
+    # only handles row-broadcast (padding-mask) biases.  Non-128-divisible
+    # sequence lengths are fine — the kernel pads to the block and slices
+    # (flash_attention pad path); below ~192 the naive composition wins.
     sq, dim = q.shape[-2], q.shape[-1]
     sk = k.shape[-2]
     if bias is not None and bias.shape[-2] != 1:
         return False
-    return (
-        sq % 128 == 0 and sk % 128 == 0 and dim % 128 == 0 and sq >= 256
-    )
+    return dim % 128 == 0 and sq >= 192 and sk >= 192
 
 
 def scaled_dot_product_attention(q, k, v, bias=None, segment_ids=None,

@@ -49,7 +49,8 @@ def _block_sizes(sq, sk):
     return _pick_block(sq), _pick_block(sk)
 
 
-def _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j, bq, bk):
+def _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j, bq, bk,
+                 coff=0):
     """Common pre-softmax masking: additive bias, segment ids, causal.
 
     Segment-id tiles use the TPU-friendly layouts: q ids lane-broadcast
@@ -62,9 +63,11 @@ def _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j, bq, bk):
         ks = kseg_ref[0, 0:1, :]  # [1, bk]
         s = jnp.where(qs == ks, s, NEG_INF)
     if causal:
+        # bottom-right aligned (reference tril(k=Sk-Sq) semantics): row i
+        # attends cols <= i + (Sk - Sq); coff = Sk - Sq (original lengths)
         rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        s = jnp.where(rows >= cols, s, NEG_INF)
+        s = jnp.where(rows + coff >= cols, s, NEG_INF)
     return s
 
 
@@ -89,7 +92,8 @@ def _split_refs(refs, has_bias, has_seg):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg):
+def _fwd_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
+                coff=0):
     (q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, tail) = _split_refs(
         refs, has_bias, has_seg
     )
@@ -112,7 +116,8 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg):
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk]
-        s = _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j, bq, bk)
+        s = _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j,
+                         bq, bk, coff)
 
         m_prev = m_ref[:, 0]  # [bq]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -126,8 +131,8 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg):
         m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
-    if causal:  # skip blocks entirely above the diagonal
-        pl.when((j * bk) <= (i * bq + bq - 1))(_compute)
+    if causal:  # skip blocks entirely above the (offset) diagonal
+        pl.when((j * bk) <= (i * bq + bq - 1 + coff))(_compute)
     else:
         _compute()
 
@@ -145,7 +150,8 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg):
         lse_ref[0, :, :] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
-def _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret):
+def _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret,
+         coff=0):
     """Returns (out [bh,sq,d], lse [bh,sq,128] row-broadcast).
 
     qseg: [B, sq, 128] lane-broadcast ids; kseg: [B, 8, sk] sublane-
@@ -178,7 +184,7 @@ def _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-        has_bias=has_bias, has_seg=has_seg,
+        has_bias=has_bias, has_seg=has_seg, coff=coff,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -207,7 +213,8 @@ def _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg):
+def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
+                   coff=0):
     (q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, tail) = _split_refs(
         refs, has_bias, has_seg
     )
@@ -246,7 +253,7 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg):
         )
 
     if causal:
-        pl.when((j * bk) <= (i * bq + bq - 1))(_compute)
+        pl.when((j * bk) <= (i * bq + bq - 1 + coff))(_compute)
     else:
         _compute()
 
@@ -255,7 +262,8 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg):
         dq_ref[0, :, :] = acc_ref[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg):
+def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg,
+                    coff=0):
     (q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, tail) = _split_refs(
         refs, has_bias, has_seg
     )
@@ -287,7 +295,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg):
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        s = _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j, bq, bk)
+        s = _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j,
+                         bq, bk, coff)
         p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse[:, None]))
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -308,7 +317,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg):
             db_acc[0:1, :] = db_acc[0:1, :] + jnp.sum(ds_raw, axis=0)[None, :]
 
     if causal:
-        pl.when((j * bk) <= (i * bq + bq - 1))(_compute)
+        pl.when((j * bk) <= (i * bq + bq - 1 + coff))(_compute)
     else:
         _compute()
 
@@ -332,14 +341,52 @@ def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
     (q_seg [B, Sq], kv_seg [B, Sk]) pair — attention is confined to equal
     segment ids.
 
-    Falls back to the naive composition when no supported block size
-    divides the sequence lengths (never silently truncates)."""
+    Sequences not divisible by the 128-lane block are PADDED up to it
+    (padded keys masked by bias / a sentinel segment id, padded query
+    rows sliced off) so the kernel fast path is kept; the head dim must
+    still be 128-aligned, otherwise the naive composition runs (never
+    silently truncates either way)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if scale is None:
         scale = d ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+
+    # pad seq lengths up to the 128 block so _pick_block always succeeds
+    sq_orig, sk_orig = sq, sk
+    pq, pk = (-sq) % 128, (-sk) % 128
+    if (pq or pk) and d % 128 == 0:
+        from ..attention import NEG_INF as _NI
+        from ..attention import normalize_segment_ids as _norm
+
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pk), (0, 0)))
+        if pk:
+            # mask padded keys for every query (additive bias row)
+            key_pad = jnp.concatenate(
+                [jnp.zeros((1, 1, 1, sk), jnp.float32),
+                 jnp.full((1, 1, 1, pk), _NI, jnp.float32)], axis=-1
+            )
+            if bias is None:
+                bias = key_pad
+            else:
+                bias = jnp.pad(
+                    jnp.broadcast_to(bias, (b, bias.shape[1], 1, sk)),
+                    ((0, 0), (0, 0), (0, 0), (0, pk)),
+                ) + key_pad
+        if segment_ids is not None:
+            qseg0, kseg0 = _norm(segment_ids)
+            # sentinels differ so padded q rows match nothing (they emit
+            # zeros and are sliced off below)
+            segment_ids = (
+                jnp.pad(qseg0.astype(jnp.int32), ((0, 0), (0, pq)),
+                        constant_values=-2),
+                jnp.pad(kseg0.astype(jnp.int32), ((0, 0), (0, pk)),
+                        constant_values=-1),
+            )
+        sq, sk = sq + pq, sk + pk
 
     bq, bk = _block_sizes(sq, sk)
     if bq is None or bk is None:
@@ -369,25 +416,29 @@ def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
             kseg.astype(jnp.int32)[:, None, :], (b, 8, sk)
         )
 
+    coff = sk_orig - sq_orig  # bottom-right causal alignment (original S)
     out = _flash_core(qf, kf, vf, bf, qsegf, ksegf, h, scale, causal,
-                      interpret)
-    return out.reshape(b, h, sq, d)
+                      interpret, coff)
+    out = out.reshape(b, h, sq, d)
+    return out[:, :, :sq_orig] if sq != sq_orig else out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
-def _flash_core(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret):
-    out, _ = _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash_core(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret,
+                coff):
+    out, _ = _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal,
+                  interpret, coff)
     return out
 
 
 def _flash_core_fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal,
-                    interpret):
+                    interpret, coff):
     out, lse = _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal,
-                    interpret)
+                    interpret, coff)
     return out, (q, k, v, bias, qseg, kseg, out, lse)
 
 
-def _flash_core_bwd(n_head, scale, causal, interpret, res, g):
+def _flash_core_bwd(n_head, scale, causal, interpret, coff, res, g):
     q, k, v, bias, qseg, kseg, out, lse2d = res
     h = n_head
     bh, sq, d = q.shape
@@ -422,7 +473,7 @@ def _flash_core_bwd(n_head, scale, causal, interpret, res, g):
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-            has_bias=has_bias, has_seg=has_seg,
+            has_bias=has_bias, has_seg=has_seg, coff=coff,
         ),
         grid=(bh, nq, nk),
         in_specs=dq_specs,
@@ -470,7 +521,7 @@ def _flash_core_bwd(n_head, scale, causal, interpret, res, g):
     res = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
-            has_bias=has_bias, has_seg=has_seg,
+            has_bias=has_bias, has_seg=has_seg, coff=coff,
         ),
         grid=(bh, nk, nq),
         in_specs=kv_specs,

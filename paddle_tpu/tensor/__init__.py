@@ -1,0 +1,147 @@
+"""paddle.tensor 2.0-style namespace (reference `python/paddle/tensor/`):
+creation / manipulation / math / linalg functions with 2.0 names over the
+dual-mode layer API."""
+
+import numpy as _np
+
+from ..fluid import layers as _L
+
+# creation ------------------------------------------------------------------
+zeros = _L.zeros
+ones = _L.ones
+full_like = _L.full_like
+zeros_like = _L.zeros_like
+ones_like = _L.ones_like
+arange = _L.arange
+linspace = _L.linspace
+
+
+def to_tensor(data, dtype=None, stop_gradient=True):
+    """cf. paddle.to_tensor (dygraph)."""
+    from ..fluid.dygraph import to_variable
+
+    arr = _np.asarray(data)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    v = to_variable(arr)
+    v.stop_gradient = stop_gradient
+    return v
+
+
+def full(shape, fill_value, dtype="float32"):
+    return _L.fill_constant(shape, dtype, fill_value)
+
+
+# manipulation --------------------------------------------------------------
+concat = _L.concat
+reshape = _L.reshape
+transpose = _L.transpose
+squeeze = _L.squeeze
+unsqueeze = _L.unsqueeze
+split = _L.split
+stack = _L.stack
+unstack = _L.unstack
+gather = _L.gather
+gather_nd = _L.gather_nd
+scatter = _L.scatter
+tile = _L.tile
+expand = _L.expand
+flip = _L.ops.flip
+roll = _L.ops.roll
+broadcast_to = _L.ops.broadcast_to
+flatten = _L.flatten
+cast = _L.cast
+
+# math ----------------------------------------------------------------------
+add = _L.elementwise_add
+subtract = _L.elementwise_sub
+multiply = _L.elementwise_mul
+divide = _L.elementwise_div
+pow = _L.elementwise_pow
+maximum = _L.elementwise_max
+minimum = _L.elementwise_min
+mod = _L.elementwise_mod
+
+
+def floor_divide(x, y):
+    from ..fluid.layers.common import append_simple_op
+
+    return append_simple_op("elementwise_floordiv", {"X": x, "Y": y},
+                            {"axis": -1})
+
+
+abs = _L.abs
+exp = _L.exp
+log = _L.log
+sqrt = _L.sqrt
+rsqrt = _L.rsqrt
+square = _L.square
+sin = _L.sin
+cos = _L.cos
+tanh = _L.tanh
+floor = _L.floor
+ceil = _L.ceil
+round = _L.round
+sign = _L.sign
+clip = _L.clip
+cumsum = _L.cumsum
+logsumexp = _L.ops.logsumexp
+erf = _L.erf
+lgamma = _L.lgamma
+digamma = _L.digamma
+log1p = _L.log1p
+log2 = _L.log2
+log10 = _L.log10
+expm1 = _L.expm1
+trunc = _L.trunc
+asin = _L.asin
+acos = _L.acos
+atan = _L.atan
+sinh = _L.sinh
+cosh = _L.cosh
+
+
+def sum(x, axis=None, keepdim=False):
+    return _L.reduce_sum(x, dim=axis, keep_dim=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return _L.reduce_mean(x, dim=axis, keep_dim=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return _L.reduce_max(x, dim=axis, keep_dim=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return _L.reduce_min(x, dim=axis, keep_dim=keepdim)
+
+
+def prod(x, axis=None, keepdim=False):
+    return _L.reduce_prod(x, dim=axis, keep_dim=keepdim)
+
+
+argmax = _L.arg_max if hasattr(_L, "arg_max") else None
+argsort = _L.argsort if hasattr(_L, "argsort") else None
+
+# linalg --------------------------------------------------------------------
+matmul = _L.matmul
+dot = _L.dot
+bmm = _L.bmm if hasattr(_L, "bmm") else None
+kron = _L.ops.kron
+cross = _L.ops.cross
+cholesky = _L.ops.cholesky
+inverse = _L.ops.inverse
+matrix_power = _L.ops.matrix_power
+multi_dot = _L.ops.multi_dot
+einsum = _L.ops.einsum
+
+# comparison ----------------------------------------------------------------
+equal = _L.equal
+not_equal = _L.not_equal
+less_than = _L.less_than
+greater_than = _L.greater_than
+logical_and = _L.logical_and
+logical_or = _L.logical_or
+logical_not = _L.logical_not
+where = _L.where

@@ -1,0 +1,84 @@
+"""2.0-style namespaces (reference layer 10: python/paddle/nn, tensor,
+metric): dygraph training with paddle.nn layers + paddle.tensor math, and
+static-graph use of the same functions."""
+
+import numpy as np
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph
+
+
+def test_tensor_namespace_eager_math():
+    with dygraph.guard():
+        a = paddle_tpu.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]],
+                                          np.float32))
+        b = paddle_tpu.to_tensor(np.ones((2, 2), np.float32))
+        c = paddle_tpu.tensor.add(a, b)
+        d = paddle_tpu.tensor.matmul(c, a)
+        s = paddle_tpu.tensor.sum(d)
+        np.testing.assert_allclose(
+            np.asarray(d.data),
+            (np.array([[2, 3], [4, 5]], np.float32)
+             @ np.array([[1, 2], [3, 4]], np.float32)),
+        )
+        assert float(np.asarray(s.data)) == np.sum(np.asarray(d.data))
+        k = paddle_tpu.tensor.kron(a, b)
+        assert k.shape == (4, 4)
+
+
+def test_nn_layers_train_eager():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    with dygraph.guard():
+        model = nn.Sequential(
+            nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4)
+        )
+        loss_fn = nn.CrossEntropyLoss()
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=5e-3)
+        rng = np.random.RandomState(0)
+        losses = []
+        for _ in range(20):
+            x = paddle_tpu.to_tensor(rng.randn(16, 8).astype(np.float32))
+            y = paddle_tpu.to_tensor(
+                rng.randint(0, 4, (16, 1)).astype(np.int64))
+            logits = model(x)
+            loss = loss_fn(logits, y)
+            loss.backward()
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+            losses.append(float(np.asarray(loss.data)))
+        assert losses[-1] < losses[0]
+        probs = F.softmax(logits)
+        assert np.allclose(np.asarray(probs.data).sum(-1), 1.0, atol=1e-5)
+
+
+def test_nn_functional_static():
+    import paddle_tpu.nn.functional as F
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4, 8], append_batch_size=False)
+        y = fluid.layers.data("y", shape=[4, 1], dtype="int64",
+                              append_batch_size=False)
+        h = F.relu(fluid.layers.fc(x, size=16))
+        logits = fluid.layers.fc(h, size=3)
+        loss = F.cross_entropy(logits, y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (lv,) = exe.run(
+        main,
+        feed={"x": np.ones((4, 8), np.float32),
+              "y": np.zeros((4, 1), np.int64)},
+        fetch_list=[loss],
+    )
+    assert np.isfinite(lv)
+
+
+def test_metric_namespace():
+    m = paddle_tpu.metric.Accuracy()
+    preds = np.array([[0.9, 0.1], [0.2, 0.8]], np.float32)
+    labels = np.array([[0], [1]], np.int64)
+    m.update(preds, labels)  # raw (pred, label) form
+    assert m.eval() == 1.0

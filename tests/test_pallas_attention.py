@@ -96,3 +96,66 @@ def test_flash_backward_with_bias_grad():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=5e-4, atol=5e-4,
                                    err_msg="d%s mismatch" % name)
+
+
+@pytest.mark.parametrize("sq,sk", [(200, 200), (96, 96), (300, 260)])
+def test_flash_pad_to_block_matches_naive(sq, sk):
+    """Non-128-divisible seqs keep the kernel path via pad+slice."""
+    B, H, D = 1, 2, 128
+    q, k, v = _rand((B, H, sq, D), 20), _rand((B, H, sk, D), 21), _rand((B, H, sk, D), 22)
+    scale = D ** -0.5
+    out = flash_attention(q, k, v, scale=scale, interpret=True)
+    ref = _naive_attention(q, k, v, None, scale, False)
+    assert out.shape == (B, H, sq, D)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_pad_causal_and_grads():
+    import jax
+
+    B, H, S, D = 1, 1, 200, 128
+    q, k, v = _rand((B, H, S, D), 23), _rand((B, H, S, D), 24), _rand((B, H, S, D), 25)
+    scale = D ** -0.5
+    out = flash_attention(q, k, v, scale=scale, causal=True, interpret=True)
+    ref = _naive_attention(q, k, v, None, scale, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    g1 = jax.grad(lambda q_: (flash_attention(q_, k, v, scale=scale,
+                                              interpret=True) ** 2).sum())(q)
+    g2 = jax.grad(lambda q_: (_naive_attention(q_, k, v, None, scale,
+                                               False) ** 2).sum())(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_pad_with_segments_and_bias():
+    B, H, S, D = 1, 1, 200, 128
+    q, k, v = _rand((B, H, S, D), 26), _rand((B, H, S, D), 27), _rand((B, H, S, D), 28)
+    seg = jnp.asarray(
+        np.repeat([1, 2], [80, 120])[None, :].astype(np.int32))
+    scale = D ** -0.5
+    from paddle_tpu.ops.attention import _segment_bias
+
+    out = flash_attention(q, k, v, segment_ids=seg, scale=scale,
+                          interpret=True)
+    ref = _naive_attention(q, k, v, _segment_bias(seg), scale, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_causal_cross_attention_bottom_right_aligned():
+    """sq != sk causal must match the naive tril(k=Sk-Sq) alignment."""
+    B, H, D = 1, 1, 128
+    for sq, sk in [(128, 256), (200, 260), (256, 128)]:
+        q = _rand((B, H, sq, D), 30)
+        k = _rand((B, H, sk, D), 31)
+        v = _rand((B, H, sk, D), 32)
+        scale = D ** -0.5
+        out = flash_attention(q, k, v, scale=scale, causal=True,
+                              interpret=True)
+        ref = _naive_attention(q, k, v, None, scale, True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4,
+            err_msg="sq=%d sk=%d" % (sq, sk),
+        )
