@@ -123,7 +123,7 @@ class _LoweredBlock:
                 if o_type == "print":
                     return True
                 for key in ("ops", "true_ops", "false_ops", "cond_ops",
-                            "body_ops"):
+                            "body_ops", "step_ops"):
                     sub = o_attrs.get(key)
                     if isinstance(sub, list) and _has_print(sub):
                         return True

@@ -64,14 +64,7 @@ class CrossEntropyLoss(Layer):
         self._reduction = reduction
 
     def forward(self, input, label):
-        from ..fluid import layers
-
-        loss = layers.softmax_with_cross_entropy(input, label)
-        if self._reduction == "mean":
-            return layers.reduce_mean(loss)
-        if self._reduction == "sum":
-            return layers.reduce_sum(loss)
-        return loss
+        return functional.cross_entropy(input, label, self._reduction)
 
 
 class MSELoss(Layer):
@@ -80,11 +73,4 @@ class MSELoss(Layer):
         self._reduction = reduction
 
     def forward(self, input, label):
-        from ..fluid import layers
-
-        loss = layers.square(input - label)
-        if self._reduction == "mean":
-            return layers.reduce_mean(loss)
-        if self._reduction == "sum":
-            return layers.reduce_sum(loss)
-        return loss
+        return functional.mse_loss(input, label, self._reduction)

@@ -57,15 +57,17 @@ def _naive_attention(q, k, v, bias, scale, causal):
 def _use_pallas(q, k, bias):
     if jax.default_backend() != "tpu":
         return False
-    # pallas kernel wants MXU-aligned head dim; the in-kernel bias path
-    # only handles row-broadcast (padding-mask) biases.  Non-128-divisible
-    # sequence lengths are fine — the kernel pads to the block and slices
-    # (flash_attention pad path); below ~192 the naive composition wins.
+    # the head dim is never split (its block equals the full dim), so any
+    # 64-multiple works — 64 is BERT/GPT's head size and is MXU-packable;
+    # the in-kernel bias path only handles row-broadcast (padding-mask)
+    # biases.  Non-128-divisible sequence lengths are fine — the kernel
+    # pads to the block and slices (flash_attention pad path); below ~192
+    # the naive composition wins.
     sq, dim = q.shape[-2], q.shape[-1]
     sk = k.shape[-2]
     if bias is not None and bias.shape[-2] != 1:
         return False
-    return dim % 128 == 0 and sq >= 192 and sk >= 192
+    return dim % 64 == 0 and sq >= 192 and sk >= 192
 
 
 def scaled_dot_product_attention(q, k, v, bias=None, segment_ids=None,

@@ -237,7 +237,8 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        s = _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j, bq, bk)
+        s = _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j,
+                         bq, bk, coff)
         # explicit zero where masked: with a fully-masked row lse is
         # NEG_INF and exp(s - lse) would resurrect p = 1
         p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse[:, None]))
@@ -343,9 +344,10 @@ def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
 
     Sequences not divisible by the 128-lane block are PADDED up to it
     (padded keys masked by bias / a sentinel segment id, padded query
-    rows sliced off) so the kernel fast path is kept; the head dim must
-    still be 128-aligned, otherwise the naive composition runs (never
-    silently truncates either way)."""
+    rows sliced off) so the kernel fast path is kept; the head dim is
+    never split (its block always equals the full dim) so any 64-multiple
+    works — non-64-multiples run the naive composition (never silently
+    truncates either way)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if scale is None:
@@ -356,7 +358,7 @@ def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
     # pad seq lengths up to the 128 block so _pick_block always succeeds
     sq_orig, sk_orig = sq, sk
     pq, pk = (-sq) % 128, (-sk) % 128
-    if (pq or pk) and d % 128 == 0:
+    if (pq or pk) and d % 64 == 0:
         from ..attention import NEG_INF as _NI
         from ..attention import normalize_segment_ids as _norm
 

@@ -121,13 +121,28 @@ def prod(x, axis=None, keepdim=False):
     return _L.reduce_prod(x, dim=axis, keep_dim=keepdim)
 
 
-argmax = _L.arg_max if hasattr(_L, "arg_max") else None
-argsort = _L.argsort if hasattr(_L, "argsort") else None
+def argmax(x, axis=-1, keepdim=False):
+    from ..fluid.layers.common import append_simple_op
+
+    return append_simple_op("arg_max", {"X": x},
+                            {"axis": axis, "keepdims": keepdim},
+                            dtype="int64", stop_gradient=True)
+
+
+def argsort(x, axis=-1, descending=False):
+    from ..fluid.layers.common import append_simple_op
+
+    return append_simple_op("argsort", {"X": x},
+                            {"axis": axis, "descending": descending},
+                            dtype="int64", stop_gradient=True)
 
 # linalg --------------------------------------------------------------------
 matmul = _L.matmul
 dot = _L.dot
-bmm = _L.bmm if hasattr(_L, "bmm") else None
+def bmm(x, y):
+    from ..fluid.layers.common import append_simple_op
+
+    return append_simple_op("bmm", {"X": x, "Y": y})
 kron = _L.ops.kron
 cross = _L.ops.cross
 cholesky = _L.ops.cholesky

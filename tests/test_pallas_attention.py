@@ -122,9 +122,10 @@ def test_flash_pad_causal_and_grads():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
     g1 = jax.grad(lambda q_: (flash_attention(q_, k, v, scale=scale,
+                                              causal=True,
                                               interpret=True) ** 2).sum())(q)
     g2 = jax.grad(lambda q_: (_naive_attention(q_, k, v, None, scale,
-                                               False) ** 2).sum())(q)
+                                               True) ** 2).sum())(q)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                rtol=2e-3, atol=2e-3)
 
@@ -159,3 +160,39 @@ def test_causal_cross_attention_bottom_right_aligned():
             np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4,
             err_msg="sq=%d sk=%d" % (sq, sk),
         )
+        # causal CROSS-attention gradients (all three operands)
+        import jax as _jax
+
+        g1 = _jax.grad(
+            lambda q_, k_, v_: (flash_attention(
+                q_, k_, v_, scale=scale, causal=True, interpret=True,
+            ) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+        g2 = _jax.grad(
+            lambda q_, k_, v_: (_naive_attention(
+                q_, k_, v_, None, scale, True) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for a_, b_ in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a_), np.asarray(b_), rtol=2e-3, atol=2e-3,
+                err_msg="grad sq=%d sk=%d" % (sq, sk),
+            )
+
+
+def test_flash_head_dim_64():
+    """BERT-shaped heads (d=64) must take the kernel path (the head dim is
+    never split; its block equals the full dim)."""
+    import jax
+
+    B, H, S, D = 1, 2, 256, 64
+    q, k, v = _rand((B, H, S, D), 40), _rand((B, H, S, D), 41), _rand((B, H, S, D), 42)
+    scale = D ** -0.5
+    out = flash_attention(q, k, v, scale=scale, interpret=True)
+    ref = _naive_attention(q, k, v, None, scale, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    g1 = jax.grad(lambda q_: (flash_attention(q_, k, v, scale=scale,
+                                              interpret=True) ** 2).sum())(q)
+    g2 = jax.grad(lambda q_: (_naive_attention(q_, k, v, None, scale,
+                                               False) ** 2).sum())(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=2e-3, atol=2e-3)
