@@ -46,7 +46,7 @@ from .compiler import (  # noqa: F401
     ExecutionStrategy,
 )
 from . import dygraph  # noqa: F401  (after core symbols: dygraph imports them)
-from . import contrib, debugger, metrics, packing, profiler  # noqa: F401
+from . import contrib, debugger, gradient_checker, metrics, packing, profiler  # noqa: F401
 from .core import monitor  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
 

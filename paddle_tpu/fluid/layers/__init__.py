@@ -12,8 +12,12 @@ from . import (  # noqa: F401
 )
 from .control_flow import (  # noqa: F401
     StaticRNN,
+    array_length,
+    array_read,
+    array_write,
     case,
     cond,
+    create_array,
     switch_case,
     while_loop,
 )

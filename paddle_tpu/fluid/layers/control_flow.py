@@ -13,10 +13,11 @@ into the SAME XLA program instead of bouncing through a nested interpreter.
 XLA requires both branches (and every loop iteration) to produce identical
 shapes/dtypes — checked at build time with clear errors.
 
-LoDTensorArray-based dynamic loops (`array_write`/`array_read`) are
-deliberately not carried over: their dynamic shapes cannot compile; use
-`while_loop` with fixed-shape carried state or `lax.scan`-style batching
-(see sequence packing utilities).
+LoDTensorArray becomes a FIXED-CAPACITY array (`create_array(dtype,
+capacity, element_shape)` + `array_write`/`array_read` as
+dynamic_update_slice/dynamic_slice): XLA has no growable storage, so the
+static capacity bound replaces the reference's grow-on-write semantics —
+usable as while_loop carried state with a runtime index.
 """
 
 from __future__ import annotations
@@ -478,3 +479,53 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
         infer=False,
     )
     return outs
+
+
+# -- tensor array API (LoDTensorArray cover; see ops/tensor_ops.py) ----------
+
+
+def create_array(dtype, capacity=None, element_shape=None, initialized=None):
+    """cf. reference layers.create_array + LoDTensorArray.  TPU-first:
+    XLA has no growable storage, so the array is a preallocated
+    [capacity, *element_shape] tensor — pass BOTH (the reference grows on
+    write; here capacity is the static bound, like DynamicRNN max_len)."""
+    from .tensor import fill_constant
+
+    if initialized is not None:
+        return initialized
+    if capacity is None or element_shape is None:
+        raise ValueError(
+            "create_array on TPU needs capacity= and element_shape= "
+            "(static shapes; cf. LoDTensorArray growable semantics)"
+        )
+    arr = fill_constant([int(capacity)] + list(element_shape), dtype, 0.0)
+    arr.stop_gradient = False
+    return arr
+
+
+def array_write(x, i, array):
+    """cf. reference layers.array_write (write_to_array op).
+
+    CAVEAT: the TPU array is fixed-capacity; an index past capacity-1 is
+    CLAMPED to the last slot (dynamic_update_slice semantics) where the
+    reference would grow the array — size capacity for the worst case."""
+    from .common import append_simple_op
+
+    return append_simple_op(
+        "tensor_array_write", {"Array": array, "I": i, "X": x}
+    )
+
+
+def array_read(array, i):
+    """cf. reference layers.array_read (read_from_array op)."""
+    from .common import append_simple_op
+
+    return append_simple_op("tensor_array_read", {"Array": array, "I": i})
+
+
+def array_length(array):
+    """cf. reference layers.array_length.  The TPU array is fixed-capacity,
+    so length == capacity (track a separate counter for partial fills)."""
+    from .tensor import fill_constant
+
+    return fill_constant([1], "int64", int(array.shape[0]))

@@ -386,3 +386,39 @@ def _print(ctx, ins, attrs):
 
     jax.debug.callback(_emit, head)
     return {"Out": [x]}
+
+
+# -- tensor array (LoDTensorArray capability, static-shape redesign) ---------
+# Capability parity: reference LoDTensorArray + controlflow
+# `write_to_array`/`read_from_array` ops (`operators/controlflow/
+# lod_array_ops` family, `lod_tensor_array.h`).  TPU-first: the array is a
+# PREALLOCATED [capacity, ...] dense tensor (XLA has no growable storage);
+# writes are dynamic_update_slice, reads dynamic_slice — both work with a
+# runtime index inside while_loop bodies.
+
+
+@register_op("tensor_array_write", inputs=["Array", "I", "X"],
+             outputs=["Out"], no_grad_slots=("I",))
+def _tensor_array_write(ctx, ins, attrs):
+    arr, i, x = ins["Array"][0], ins["I"][0], ins["X"][0]
+    import jax
+
+    idx = i.reshape(()).astype(jnp.int32)
+    return {"Out": [jax.lax.dynamic_update_slice(
+        arr, x[None].astype(arr.dtype),
+        (idx,) + (jnp.int32(0),) * (arr.ndim - 1),
+    )]}
+
+
+@register_op("tensor_array_read", inputs=["Array", "I"], outputs=["Out"],
+             no_grad_slots=("I",))
+def _tensor_array_read(ctx, ins, attrs):
+    arr, i = ins["Array"][0], ins["I"][0]
+    import jax
+
+    idx = i.reshape(()).astype(jnp.int32)
+    out = jax.lax.dynamic_slice(
+        arr, (idx,) + (jnp.int32(0),) * (arr.ndim - 1),
+        (1,) + arr.shape[1:],
+    )
+    return {"Out": [out[0]]}

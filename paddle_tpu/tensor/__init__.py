@@ -130,11 +130,14 @@ def argmax(x, axis=-1, keepdim=False):
 
 
 def argsort(x, axis=-1, descending=False):
+    """Returns the sort INDICES (paddle.argsort contract)."""
     from ..fluid.layers.common import append_simple_op
 
-    return append_simple_op("argsort", {"X": x},
+    outs = append_simple_op("argsort", {"X": x},
                             {"axis": axis, "descending": descending},
-                            dtype="int64", stop_gradient=True)
+                            out_slots=("Out", "Indices"),
+                            stop_gradient=True)
+    return outs[1]
 
 # linalg --------------------------------------------------------------------
 matmul = _L.matmul

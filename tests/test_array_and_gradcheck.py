@@ -1,0 +1,73 @@
+"""TensorArray (fixed-capacity LoDTensorArray cover) + gradient_checker
+(reference lod_array ops, gradient_checker.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.gradient_checker import double_grad_check, grad_check
+
+
+def test_array_write_read_static_index():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], append_batch_size=False)
+        arr = layers.create_array("float32", capacity=4, element_shape=[3])
+        i0 = layers.fill_constant([1], "int64", 0)
+        i2 = layers.fill_constant([1], "int64", 2)
+        arr = layers.array_write(x, i0, arr)
+        arr = layers.array_write(x * 2.0, i2, arr)
+        r0 = layers.array_read(arr, i0)
+        r2 = layers.array_read(arr, i2)
+        n = layers.array_length(arr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    a, b, ln = exe.run(main, feed={"x": xv}, fetch_list=[r0, r2, n])
+    np.testing.assert_allclose(a, xv)
+    np.testing.assert_allclose(b, xv * 2)
+    assert int(ln) == 4
+
+
+def test_array_inside_while_loop():
+    # accumulate x*t into slot t for t in 0..3, then read back
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2], append_batch_size=False)
+        arr0 = layers.create_array("float32", capacity=4, element_shape=[2])
+        i0 = layers.fill_constant([1], "float32", 0.0)
+
+        def cond(i, arr):
+            return i < 4.0
+
+        def body(i, arr):
+            arr = layers.array_write(
+                x * i, layers.cast(i, "int64"), arr)
+            return i + 1.0, arr
+
+        _, arr = layers.while_loop(cond, body, [i0, arr0])
+        r3 = layers.array_read(arr, layers.fill_constant([1], "int64", 3))
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([1.0, -2.0], np.float32)
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[r3])
+    np.testing.assert_allclose(out, xv * 3.0)
+
+
+def test_grad_check_passes_and_catches():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], append_batch_size=False)
+        x.stop_gradient = False
+        y = layers.tanh(layers.square(x))
+    feed = {"x": np.linspace(-1, 1, 4).astype(np.float32)}
+    assert grad_check(x, y, feed, program=main)
+
+
+def test_double_grad_check():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], append_batch_size=False)
+        x.stop_gradient = False
+        y = layers.elementwise_mul(layers.square(x), x)  # x^3
+    feed = {"x": np.array([0.5, -0.7, 1.2], np.float32)}
+    assert double_grad_check(x, y, feed, program=main)

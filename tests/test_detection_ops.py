@@ -1,0 +1,165 @@
+"""Detection op family oracles (reference tests/unittests/
+test_iou_similarity_op.py, test_box_coder_op.py, test_prior_box_op.py,
+test_yolo_box_op.py, test_multiclass_nms_op.py, test_roi_align_op.py,
+test_bipartite_match_op.py patterns)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_output, run_single_op
+
+rng = np.random.RandomState(3)
+
+
+def _boxes(n):
+    xy = rng.rand(n, 2) * 50
+    wh = rng.rand(n, 2) * 30 + 2
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+def _iou(a, b):
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area = lambda x: (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    return inter / (area(a)[:, None] + area(b)[None, :] - inter + 1e-10)
+
+
+def test_iou_similarity():
+    a, b = _boxes(5), _boxes(7)
+    check_output("iou_similarity", {"X": a, "Y": b}, {},
+                 {"Out": _iou(a, b)}, rtol=1e-5)
+
+
+def test_box_clip():
+    boxes = (_boxes(6) - 10)[None]  # [1, 6, 4], some negative coords
+    im_info = np.array([[40.0, 60.0, 1.0]], np.float32)
+    outs, _ = run_single_op(
+        "box_clip", {"Input": boxes, "ImInfo": im_info}, {}, ["Output"]
+    )
+    o = outs["Output"]
+    assert (o[..., 0] >= 0).all() and (o[..., 2] <= 59.0).all()
+    assert (o[..., 1] >= 0).all() and (o[..., 3] <= 39.0).all()
+
+
+def test_prior_box_shapes_and_bounds():
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 64, 64), np.float32)
+    outs, _ = run_single_op(
+        "prior_box", {"Input": feat, "Image": img},
+        {"min_sizes": [16.0], "max_sizes": [32.0],
+         "aspect_ratios": [2.0], "flip": True, "clip": True},
+        ["Boxes", "Variances"],
+    )
+    boxes = outs["Boxes"]  # [4, 4, P, 4]; P = 1 + 2 + 1 = 4
+    assert boxes.shape == (4, 4, 4, 4)
+    assert (boxes >= 0).all() and (boxes <= 1).all()
+    # center of cell (0,0) prior 0: ~ (8/64, 8/64)
+    cx = (boxes[0, 0, 0, 0] + boxes[0, 0, 0, 2]) / 2
+    assert abs(cx - 8.0 / 64) < 1e-5
+    assert outs["Variances"].shape == boxes.shape
+
+
+def test_box_coder_encode_decode_roundtrip():
+    prior = _boxes(6)
+    pvar = np.full((6, 4), 0.1, np.float32)
+    target = _boxes(3)
+    enc, _ = run_single_op(
+        "box_coder", {"PriorBox": prior, "PriorBoxVar": pvar,
+                      "TargetBox": target},
+        {"code_type": "encode_center_size"}, ["OutputBox"],
+    )
+    assert enc["OutputBox"].shape == (3, 6, 4)
+    dec, _ = run_single_op(
+        "box_coder", {"PriorBox": prior, "PriorBoxVar": pvar,
+                      "TargetBox": enc["OutputBox"]},
+        {"code_type": "decode_center_size"}, ["OutputBox"],
+    )
+    # decode(encode(t)) reproduces the target for every prior column
+    for j in range(6):
+        np.testing.assert_allclose(dec["OutputBox"][:, j], target,
+                                   rtol=1e-4, atol=1e-3)
+
+
+def test_anchor_generator():
+    feat = np.zeros((1, 8, 2, 3), np.float32)
+    outs, _ = run_single_op(
+        "anchor_generator", {"Input": feat},
+        {"anchor_sizes": [32.0, 64.0], "aspect_ratios": [1.0],
+         "stride": [16.0, 16.0]},
+        ["Anchors", "Variances"],
+    )
+    a = outs["Anchors"]
+    assert a.shape == (2, 3, 2, 4)
+    np.testing.assert_allclose(a[0, 0, 0], [8 - 16, 8 - 16, 8 + 16, 8 + 16])
+
+
+def test_yolo_box_shapes():
+    N, A, C, H, W = 1, 2, 3, 4, 4
+    x = rng.randn(N, A * (5 + C), H, W).astype(np.float32)
+    img = np.array([[128, 128]], np.int32)
+    outs, _ = run_single_op(
+        "yolo_box", {"X": x, "ImgSize": img},
+        {"anchors": [10, 13, 16, 30], "class_num": C,
+         "conf_thresh": 0.0, "downsample_ratio": 32},
+        ["Boxes", "Scores"],
+    )
+    assert outs["Boxes"].shape == (N, A * H * W, 4)
+    assert outs["Scores"].shape == (N, A * H * W, C)
+    assert (outs["Scores"] >= 0).all() and (outs["Scores"] <= 1).all()
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    # two heavily overlapping boxes + one separate; the lower-scoring
+    # overlap must be suppressed
+    boxes = np.array([[
+        [0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60],
+    ]], np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)  # [N=1, C=1, M=3]
+    outs, _ = run_single_op(
+        "multiclass_nms", {"BBoxes": boxes, "Scores": scores},
+        {"score_threshold": 0.01, "nms_threshold": 0.5, "nms_top_k": 3,
+         "keep_top_k": 5},
+        ["Out"],
+    )
+    out = outs["Out"][0]  # [5, 6]
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) == 2  # overlap suppressed
+    np.testing.assert_allclose(sorted(kept[:, 1]), [0.7, 0.9], rtol=1e-5)
+
+
+def test_roi_align_constant_region():
+    x = np.zeros((1, 2, 8, 8), np.float32)
+    x[0, 0, 2:6, 2:6] = 3.0  # constant over pixel coords [2, 5]
+    # roi stays inside [2, 5] so every bilinear sample reads the constant
+    rois = np.array([[0, 2.0, 2.0, 5.0, 5.0]], np.float32)
+    outs, _ = run_single_op(
+        "roi_align", {"X": x, "ROIs": rois},
+        {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0,
+         "sampling_ratio": 2},
+        ["Out"],
+    )
+    o = outs["Out"]
+    assert o.shape == (1, 2, 2, 2)
+    # interior of a constant region averages to the constant
+    np.testing.assert_allclose(o[0, 0], 3.0, rtol=1e-4)
+    np.testing.assert_allclose(o[0, 1], 0.0, atol=1e-6)
+
+
+def test_bipartite_match_greedy():
+    # dist[gt, prior]
+    dist = np.array([
+        [0.9, 0.1, 0.3],
+        [0.8, 0.7, 0.2],
+    ], np.float32)
+    outs, _ = run_single_op(
+        "bipartite_match", {"DistMat": dist}, {},
+        ["ColToRowMatchIndices", "ColToRowMatchDist"],
+    )
+    cols = outs["ColToRowMatchIndices"][0]
+    # greedy: (0,0)=0.9 first, then row1 takes col1 (0.7)
+    assert cols[0] == 0 and cols[1] == 1 and cols[2] == -1
+    np.testing.assert_allclose(
+        outs["ColToRowMatchDist"][0], [0.9, 0.7, 0.0], rtol=1e-5
+    )
