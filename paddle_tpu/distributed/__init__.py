@@ -33,6 +33,11 @@ from .collective import (  # noqa: F401
     send_recv,
 )
 from .parallel import ParallelEnv, get_rank, get_world_size, init_parallel_env  # noqa: F401
+from .static_sharding import (  # noqa: F401
+    apply_dist_strategy,
+    shard_optimizer_state,
+    shard_parameters,
+)
 from .ring_attention import ring_attention, ring_attention_sharded  # noqa: F401
 from .topology import (  # noqa: F401
     DeviceMesh,

@@ -69,6 +69,16 @@ def _validate_spec(spec, shape, mesh):
     return PartitionSpec(*fixed)
 
 
+def _first_dp_divisible_dim(shape, dp):
+    """Index of the first dim shardable over dp, or None (ZeRO placement)."""
+    if dp <= 1:
+        return None
+    for i, s in enumerate(shape):
+        if s and s % dp == 0 and s >= dp:
+            return i
+    return None
+
+
 def megatron_rule():
     """Standard transformer TP sharding (Megatron-LM pattern, cf. PAPERS.md):
 
@@ -115,11 +125,9 @@ def zero_shard_state(state_specs, params, mesh, zero_stage=1):
         out[pname] = {}
         for sname, shape in states.items():
             spec = ()
-            if zero_stage >= 1 and dp > 1 and len(shape) > 0:
-                # choose first dim divisible by dp
-                for i, s in enumerate(shape):
-                    if s % dp == 0 and s >= dp:
-                        spec = (None,) * i + ("dp",)
-                        break
+            if zero_stage >= 1:
+                i = _first_dp_divisible_dim(shape, dp)
+                if i is not None:
+                    spec = (None,) * i + ("dp",)
             out[pname][sname] = NamedSharding(mesh.mesh, PartitionSpec(*spec))
     return out

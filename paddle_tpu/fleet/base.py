@@ -171,7 +171,42 @@ class DistributedOptimizer:
                 framework.default_main_program().global_block.ops
             )
             framework.default_main_program()._bump()
-        # static mode: rewrite grads -> c_allreduce (GradAllReduce parity)
+        # static mode distribution.  Preferred path: GSPMD sharding — when
+        # the strategy asks for sharded state (ZeRO) or tensor parallelism
+        # and a DeviceMesh is active, annotate vars with dist_attr and flag
+        # the programs; the mesh-mode Executor then runs ONE partitioned
+        # XLA program (grad allreduce, TP collectives, and ZeRO placement
+        # all compiler-inserted).  Covers reference ParallelExecutor +
+        # distribute_transpiler sharded-state capabilities without program
+        # rewrite.
+        from ..distributed.topology import get_mesh
+
+        sc = s.sharding_configs
+        mesh = get_mesh()
+        if (s.sharding or sc.tensor_parallel_degree > 1) and mesh is not None:
+            if s.localsgd:
+                raise ValueError(
+                    "strategy.localsgd cannot be combined with "
+                    "strategy.sharding / tensor parallelism: LocalSGD "
+                    "periodically averages whole params, which conflicts "
+                    "with GSPMD-sharded state. Disable one of them."
+                )
+            from ..distributed import static_sharding
+            from ..distributed.sharding import megatron_rule
+
+            rule = (megatron_rule()
+                    if sc.tensor_parallel_degree > 1 or mesh.axis_size("tp") > 1
+                    else None)
+            self.dist_param_specs = static_sharding.apply_dist_strategy(
+                framework.default_main_program(),
+                startup_program or framework.default_startup_program(),
+                mesh,
+                optimizer=self._inner,
+                rule=rule,
+                zero_stage=sc.zero_stage if s.sharding else 0,
+            )
+            return result
+        # fallback: rewrite grads -> c_allreduce (GradAllReduce parity)
         n = self._fleet.worker_num() if self._fleet._is_initialized else 1
         if s.localsgd:
             from ..fluid.transpiler.collective import LocalSGD

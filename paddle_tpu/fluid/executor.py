@@ -136,20 +136,69 @@ class _LoweredBlock:
         self.state_ro = [n for n in state_in if n not in set(state_out)]
 
         is_test = program._is_test
+        # GSPMD mode (program flagged by distributed.static_sharding):
+        # ONE logical program jitted with per-var in/out shardings taken
+        # from Variable.dist_attr — XLA partitions the computation and
+        # inserts the collectives (grad psum for dp, row-parallel psum for
+        # tp, ZeRO gather/scatter).  This is the static-graph answer to
+        # ParallelExecutor + distribute_transpiler state sharding under one
+        # roof: no program rewrite, no explicit c_* ops.
+        self.gspmd = bool(getattr(program, "_gspmd", False)) and mesh is not None
 
-        if mesh is None:
-            def run_block(feed_vals, donate_state, ro_state, rng_key):
-                from .core.block_eval import run_ops
+        def run_block(feed_vals, donate_state, ro_state, rng_key):
+            from .core.block_eval import run_ops
 
-                env = dict(feed_vals)
-                env.update(donate_state)
-                env.update(ro_state)
-                ctx = LowerContext(base_key=rng_key, is_test=is_test)
-                run_ops(ops, env, ctx)
-                fetches = [env[n] for n in self.fetch_names]
-                new_state = {n: env[n] for n in self.state_out}
-                return fetches, new_state
+            env = dict(feed_vals)
+            env.update(donate_state)
+            env.update(ro_state)
+            ctx = LowerContext(base_key=rng_key, is_test=is_test)
+            run_ops(ops, env, ctx)
+            fetches = [env[n] for n in self.fetch_names]
+            new_state = {n: env[n] for n in self.state_out}
+            return fetches, new_state
 
+        if self.gspmd:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            jmesh = mesh.mesh
+            repl = NamedSharding(jmesh, P())
+            nproc = jax.process_count()
+
+            def _sharding_for(name):
+                v = block._find_var_recursive(name)
+                spec = getattr(v, "dist_attr", None) if v is not None else None
+                return NamedSharding(jmesh, P(*spec)) if spec else repl
+
+            dp_total = mesh.axis_size("dp")
+            self.feed_shardings = {}
+            for n in feed_names:
+                shp = feed_shapes.get(n, ())
+                global0 = shp[0] * nproc if len(shp) >= 1 else 0
+                if (mesh.has_axis("dp") and global0 > 0
+                        and global0 % dp_total == 0):
+                    self.feed_shardings[n] = NamedSharding(jmesh, P("dp"))
+                else:
+                    self.feed_shardings[n] = repl
+            self.state_shardings = {
+                n: _sharding_for(n)
+                for n in set(state_in) | set(state_out)
+            }
+
+            self._jitted = jax.jit(
+                run_block,
+                in_shardings=(
+                    dict(self.feed_shardings),
+                    {n: self.state_shardings[n] for n in self.state_donate},
+                    {n: self.state_shardings[n] for n in self.state_ro},
+                    repl,
+                ),
+                out_shardings=(
+                    [repl] * len(self.fetch_names),
+                    {n: self.state_shardings[n] for n in self.state_out},
+                ),
+                donate_argnums=(1,),
+            )
+        elif mesh is None:
             # donate_state (arg 1): optimizer updates reuse param buffers.
             self._jitted = jax.jit(run_block, donate_argnums=(1,))
         else:
@@ -289,6 +338,7 @@ class Executor:
             # the NaN guard is baked into the traced program, so the flag
             # must participate in the cache key
             bool(get_flags(["FLAGS_check_nan_inf"])["FLAGS_check_nan_inf"]),
+            bool(getattr(program, "_gspmd", False)),
         )
         from .core import monitor
 
@@ -307,7 +357,25 @@ class Executor:
 
         donate_state = {n: scope.find_var(n) for n in entry.state_donate}
         ro_state = {n: scope.find_var(n) for n in entry.state_ro}
-        if entry.mesh is not None:
+        if entry.mesh is not None and entry.gspmd:
+            # GSPMD: feeds are per-process LOCAL batches stitched into one
+            # global batch-sharded array; state is placed per its dist_attr
+            # sharding (a resharding device_put is a no-op when the scope
+            # value already lands right, e.g. coming out of the last step)
+            def _place(n, v):
+                tgt = entry.state_shardings[n]
+                return v if getattr(v, "sharding", None) == tgt \
+                    else jax.device_put(v, tgt)
+
+            feed_dev = {
+                n: jax.make_array_from_process_local_data(
+                    entry.feed_shardings[n], np.asarray(a)
+                )
+                for n, a in feed_vals.items()
+            }
+            donate_state = {n: _place(n, v) for n, v in donate_state.items()}
+            ro_state = {n: _place(n, v) for n, v in ro_state.items()}
+        elif entry.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             jmesh = entry.mesh.mesh
@@ -372,7 +440,7 @@ class Executor:
         for n, val in new_state.items():
             scope.set(n, val)
 
-        if entry.mesh is not None:
+        if entry.mesh is not None and not entry.gspmd:
             # fetches carry a leading per-rank dim; a process can only read
             # its addressable shards, so return the LOCAL ranks' values
             # (shape [n_local_ranks, ...]) — reference multi-trainer

@@ -57,6 +57,22 @@ class Variable:
         self.persistable = persistable
         self.stop_gradient = stop_gradient
         self.is_data = is_data
+        # GSPMD sharding annotation: a tuple of mesh axis names / None per
+        # dim (PartitionSpec entries), or None = replicated.  The TPU-native
+        # dist_attr: where the reference slices persistable vars into
+        # VarBlocks across pservers (distribute_transpiler.py:80
+        # slice_variable), this framework annotates the var and lets GSPMD
+        # place the shards (honored by the mesh-mode Executor).
+        self.dist_attr = None
+
+    def set_dist_attr(self, *spec):
+        """Annotate this var with a PartitionSpec-style sharding, e.g.
+        `w.set_dist_attr(None, "tp")` = shard dim 1 over the tp mesh axis."""
+        self.dist_attr = tuple(spec) if spec else None
+        # annotations participate in compilation: invalidate cached
+        # executables built from the old shardings
+        self.block.program._bump()
+        return self
 
     # -- helpers ------------------------------------------------------------
     def __bool__(self):

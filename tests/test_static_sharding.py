@@ -1,0 +1,225 @@
+"""Sharded static-graph execution (GSPMD path) on the 8-device virtual mesh.
+
+Reference capability being matched (SURVEY §2.3): ParallelExecutor
+data-parallel training (`parallel_executor.cc:443`) + the PS transpiler's
+sharded optimizer state (`distribute_transpiler.py:545`) — here as ONE
+statically-built Program whose vars carry dist_attr PartitionSpecs, run by
+the mesh-mode Executor as a single GSPMD-partitioned XLA program.
+
+Correctness oracle = reference test pattern (`test_dist_base.py`): loss
+parity against the plain single-device run of the same program, plus
+verification that state is ACTUALLY sharded on device.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fleet as fleet_mod
+from paddle_tpu import distributed as dist
+from paddle_tpu.fluid import layers
+import paddle_tpu.fluid as fluid
+
+
+def _build_mlp(seed=11):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 16], append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        h = layers.fc(x, size=32, act="relu",
+                      param_attr="mlp_fc1.weight", bias_attr="mlp_fc1.bias")
+        pred = layers.fc(h, size=1,
+                         param_attr="mlp_fc2.weight", bias_attr="mlp_fc2.bias")
+        loss = layers.reduce_mean(layers.square(pred - y))
+    return main, startup, loss
+
+
+def _build_bert_mini(seed=23):
+    """Tiny transformer-flavored classifier with megatron-matching names."""
+    V, D, H, C = 64, 32, 64, 4
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[-1, 8], dtype="int64",
+                          append_batch_size=False)
+        label = layers.data("label", shape=[-1, 1], dtype="int64",
+                            append_batch_size=False)
+        emb = layers.embedding(ids, size=[V, D], param_attr="word.weight")
+        h = layers.reduce_mean(emb, dim=1)  # [B, D]
+        ff = layers.fc(h, size=H, act="relu",
+                       param_attr="enc0_fc1.weight", bias_attr="enc0_fc1.bias")
+        h2 = layers.fc(ff, size=D,
+                       param_attr="enc0_fc2.weight", bias_attr="enc0_fc2.bias")
+        logits = layers.fc(h + h2, size=C,
+                           param_attr="cls.weight", bias_attr="cls.bias")
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(logits, label)
+        )
+    return main, startup, loss
+
+
+def _data_mlp(steps=8, B=16, seed=3):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(steps, B, 16).astype(np.float32)
+    w = rng.randn(16, 1).astype(np.float32)
+    ys = xs @ w + 0.05 * rng.randn(steps, B, 1).astype(np.float32)
+    return xs, ys
+
+
+def _data_bert(steps=8, B=16, seed=5):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, 64, size=(steps, B, 8)).astype(np.int64)
+    labels = rng.randint(0, 4, size=(steps, B, 1)).astype(np.int64)
+    return ids, labels
+
+
+def _train(main, startup, loss, feeds_per_step, opt_factory, mesh=None,
+           strategy=None, steps=8):
+    with fluid.program_guard(main, startup):
+        opt = opt_factory()
+        if strategy is not None:
+            fleet_mod.fleet._is_initialized = True
+            from paddle_tpu.fleet.role_maker import UserDefinedRoleMaker
+
+            rm = UserDefinedRoleMaker(current_id=0, worker_num=1)
+            rm.generate_role()
+            fleet_mod.fleet._role_maker = rm
+            fleet_mod.fleet._strategy = strategy
+            dopt = fleet_mod.distributed_optimizer(opt, strategy)
+            dopt.minimize(loss, startup_program=startup)
+        else:
+            opt.minimize(loss, startup_program=startup)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace(), mesh=mesh)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for feed in feeds_per_step[:steps]:
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.mean(lv)))
+    return losses, scope, opt
+
+
+def _spec_names(arr):
+    """mesh axis names used in this array's sharding spec (flattened)."""
+    spec = arr.sharding.spec
+    names = set()
+    for e in spec:
+        if e is None:
+            continue
+        for a in (e if isinstance(e, tuple) else (e,)):
+            names.add(a)
+    return names
+
+
+def test_gspmd_dp_parity_and_zero_sharded_state():
+    """DP over 8 devices under GSPMD: loss trajectory matches single-device
+    bit-for-bit-ish; Momentum velocity accumulators are ZeRO-sharded."""
+    xs, ys = _data_mlp()
+    feeds = [{"x": xs[t], "y": ys[t]} for t in range(len(xs))]
+
+    def make_opt():
+        return fluid.optimizer.MomentumOptimizer(learning_rate=0.01,
+                                                 momentum=0.9)
+
+    # baseline: plain single-device
+    main0, startup0, loss0 = _build_mlp()
+    base, _, _ = _train(main0, startup0, loss0, feeds, make_opt)
+
+    # GSPMD: dp=8, sharding (ZeRO-1) strategy
+    import paddle_tpu.fluid.framework as fw
+
+    fw.reset_default_programs()
+    mesh = dist.auto_mesh(8)
+    strategy = fleet_mod.DistributedStrategy()
+    strategy.sharding = True
+    main1, startup1, loss1 = _build_mlp()
+    with dist.mesh_guard(mesh):
+        got, scope, opt = _train(main1, startup1, loss1, feeds, make_opt,
+                                 mesh=mesh, strategy=strategy)
+
+    assert main1._gspmd and startup1._gspmd
+    np.testing.assert_allclose(got, base, rtol=2e-4, atol=1e-5)
+    assert got[-1] < got[0]
+
+    # velocity accumulators must be dp-sharded on device; fc1.weight
+    # velocity is [16, 32] -> dim0 sharded over dp=8
+    vel = opt._accumulators["velocity"]
+    wname = "mlp_fc1.weight"
+    vvar = vel[wname]
+    varr = scope.find_var(vvar.name)
+    assert "dp" in _spec_names(varr), (
+        "velocity not ZeRO-sharded: %s" % (varr.sharding,))
+    # params stay replicated under pure dp
+    warr = scope.find_var(wname)
+    assert _spec_names(warr) == set()
+
+
+def test_gspmd_dp_tp_bert_parity_and_tp_sharded_params():
+    """dp=4 x tp=2: megatron rules shard the ffn + embedding params on tp;
+    loss trajectory still matches the single-device run."""
+    ids, labels = _data_bert()
+    feeds = [{"ids": ids[t], "label": labels[t]} for t in range(len(ids))]
+
+    def make_opt():
+        return fluid.optimizer.AdamOptimizer(learning_rate=1e-2)
+
+    main0, startup0, loss0 = _build_bert_mini()
+    base, _, _ = _train(main0, startup0, loss0, feeds, make_opt)
+
+    import paddle_tpu.fluid.framework as fw
+
+    fw.reset_default_programs()
+    mesh = dist.auto_mesh(8, tp=2)
+    strategy = fleet_mod.DistributedStrategy()
+    strategy.sharding = True
+    strategy.sharding_configs.tensor_parallel_degree = 2
+    main1, startup1, loss1 = _build_bert_mini()
+    with dist.mesh_guard(mesh):
+        got, scope, opt = _train(main1, startup1, loss1, feeds, make_opt,
+                                 mesh=mesh, strategy=strategy)
+
+    np.testing.assert_allclose(got, base, rtol=5e-4, atol=5e-5)
+    assert got[-1] < got[0]
+
+    # TP shardings actually applied on device
+    w_fc1 = scope.find_var("enc0_fc1.weight")      # column parallel
+    assert "tp" in _spec_names(w_fc1)
+    w_fc2 = scope.find_var("enc0_fc2.weight")      # row parallel
+    assert "tp" in _spec_names(w_fc2)
+    w_emb = scope.find_var("word.weight")          # vocab sharded
+    assert "tp" in _spec_names(w_emb)
+    # adam moments of a TP-sharded param keep the tp axis
+    m1 = opt._accumulators["moment1"]["enc0_fc1.weight"]
+    assert "tp" in _spec_names(scope.find_var(m1.name))
+    # and the classifier head (unmatched by rules) stays replicated
+    assert _spec_names(scope.find_var("cls.weight")) == set()
+
+
+def test_gspmd_save_load_round_trip(tmp_path):
+    """Sharded state saves (gathered) and reloads into a fresh scope."""
+    xs, ys = _data_mlp()
+    feeds = [{"x": xs[t], "y": ys[t]} for t in range(len(xs))]
+
+    def make_opt():
+        return fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+
+    import paddle_tpu.fluid.framework as fw
+
+    mesh = dist.auto_mesh(8)
+    strategy = fleet_mod.DistributedStrategy()
+    strategy.sharding = True
+    main, startup, loss = _build_mlp()
+    with dist.mesh_guard(mesh):
+        _, scope, _ = _train(main, startup, loss, feeds, make_opt,
+                             mesh=mesh, strategy=strategy)
+    exe = fluid.Executor(fluid.CPUPlace(), mesh=mesh)
+    with fluid.scope_guard(scope):
+        fluid.io.save_persistables(exe, str(tmp_path / "ckpt"), main)
+    w_before = np.asarray(scope.find_var("mlp_fc1.weight"))
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        fluid.io.load_persistables(exe, str(tmp_path / "ckpt"), main)
+        w_after = np.asarray(scope2.find_var("mlp_fc1.weight"))
+    np.testing.assert_allclose(w_after, w_before, rtol=1e-6, atol=0)
