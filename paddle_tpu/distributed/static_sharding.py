@@ -21,8 +21,6 @@ ride ICI, scheduled by XLA.
 
 from __future__ import annotations
 
-import numpy as np
-
 from .sharding import ShardingRule, megatron_rule, replicated_rule  # noqa: F401
 from .topology import get_mesh
 

@@ -426,6 +426,10 @@ class Program:
         p._version = 0
         p.random_seed = self.random_seed
         p._is_test = for_test or self._is_test
+        # eval clones must keep the GSPMD execution mode (dist_attr carries
+        # over via copy.copy below; the flag must follow it)
+        if getattr(self, "_gspmd", False):
+            p._gspmd = True
         from .ops import OPTIMIZER_OP_TYPES
 
         for b in self.blocks:

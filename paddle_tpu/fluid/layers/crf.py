@@ -1,0 +1,106 @@
+"""Sequence-labeling layers: CRF, chunk_eval, edit_distance, warpctc.
+
+Capability parity: reference `python/paddle/fluid/layers/nn.py`
+linear_chain_crf / crf_decoding / chunk_eval / edit_distance and
+`layers/loss.py` warpctc.  The reference's LoD inputs become padded-dense
+``[B, T, ...]`` plus an explicit ``length`` Variable (this framework's
+packing convention, SURVEY §5 long-context note).
+"""
+
+from ..layer_helper import LayerHelper, ParamAttr
+from .common import append_simple_op
+
+
+def _transition_param(helper, param_attr, n_tags, dtype):
+    """Fetch-or-create the [N+2, N] transition param.  A named param that
+    already exists is REUSED (reference nn.py crf_decoding
+    helper.get_parameter) so decode shares the trained transition and the
+    startup program initializes it exactly once."""
+    attr = ParamAttr._to_attr(param_attr)
+    if attr and attr.name:
+        existing = helper.main_program.global_block._find_var_recursive(
+            attr.name)
+        if existing is not None:
+            return existing
+    return helper.create_parameter(
+        param_attr, [n_tags + 2, n_tags], dtype=dtype)
+
+
+def linear_chain_crf(input, label, length, param_attr=None):
+    """CRF negative log-likelihood cost [B, 1].
+
+    input: emissions [B, T, N]; label: [B, T] int64; length: [B] int64.
+    Creates the [N+2, N] transition parameter (row 0 start, row 1 end,
+    rows 2.. pairwise) under ``param_attr`` — same layout as the reference
+    `linear_chain_crf_op.cc`.
+    """
+    helper = LayerHelper("linear_chain_crf")
+    n_tags = int(input.shape[-1])
+    transition = _transition_param(helper, param_attr, n_tags, input.dtype)
+    nll, _alpha = append_simple_op(
+        "linear_chain_crf",
+        {"Emission": input, "Transition": transition,
+         "Label": label, "Length": length},
+        out_slots=("LogLikelihood", "Alpha"),
+    )
+    return nll
+
+
+def crf_decoding(input, length, param_attr=None, label=None):
+    """Viterbi decode [B, T] int64 (or 0/1 correctness marks when `label`
+    is given, reference semantics).  ``param_attr`` must name the SAME
+    transition parameter trained by linear_chain_crf."""
+    helper = LayerHelper("crf_decoding")
+    n_tags = int(input.shape[-1])
+    transition = _transition_param(helper, param_attr, n_tags, input.dtype)
+    ins = {"Emission": input, "Transition": transition, "Length": length}
+    if label is not None:
+        ins["Label"] = label
+    return append_simple_op(
+        "crf_decoding", ins, out_slots=("ViterbiPath",),
+        dtype="int64", stop_gradient=True,
+    )
+
+
+def chunk_eval(input, label, length, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    """Chunk-level precision/recall/F1 (cf. reference layers/nn.py
+    chunk_eval).  Returns the reference's 6-tuple:
+    (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks)."""
+    return append_simple_op(
+        "chunk_eval",
+        {"Inference": input, "Label": label, "Length": length},
+        {"chunk_scheme": chunk_scheme,
+         "num_chunk_types": int(num_chunk_types),
+         "excluded_chunk_types": list(excluded_chunk_types or [])},
+        out_slots=("Precision", "Recall", "F1-Score", "NumInferChunks",
+                   "NumLabelChunks", "NumCorrectChunks"),
+        dtype="float32", stop_gradient=True,
+    )
+
+
+def edit_distance(input, label, input_length, label_length, normalized=True):
+    """Batched Levenshtein distance [B, 1] + sequence count [1]
+    (cf. reference layers/nn.py edit_distance / edit_distance_op.cc)."""
+    return append_simple_op(
+        "edit_distance",
+        {"Hyps": input, "HypsLength": input_length,
+         "Refs": label, "RefsLength": label_length},
+        {"normalized": bool(normalized)},
+        out_slots=("Out", "SequenceNum"),
+        dtype="float32", stop_gradient=True,
+    )
+
+
+def warpctc(input, label, input_length, label_length, blank=0,
+            norm_by_times=False):
+    """CTC loss [B, 1] on raw logits [B, T, C] (cf. reference
+    layers/loss.py warpctc / warpctc_op.cc)."""
+    return append_simple_op(
+        "warpctc",
+        {"Logits": input, "LogitsLength": input_length,
+         "Label": label, "LabelLength": label_length},
+        {"blank": int(blank), "norm_by_times": bool(norm_by_times)},
+        out_slots=("Loss",),
+    )

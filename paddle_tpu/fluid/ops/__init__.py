@@ -7,6 +7,7 @@ the kernel backend and fuser.
 """
 
 from . import (  # noqa: F401
+    crf_ops,
     detection_ops,
     extra_ops,
     linalg_ops,

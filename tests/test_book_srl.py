@@ -1,0 +1,128 @@
+"""Book model 8/8: label_semantic_roles (reference
+`tests/book/test_label_semantic_roles.py:1` — CoNLL05 SRL: 8 feature
+embeddings, stacked bidirectional LSTM, CRF cost, Viterbi decode +
+chunk_eval).  Padded-dense TPU layout: every feature is [B, T] int64 with
+an explicit length array instead of LoD."""
+
+import numpy as np
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.layer_helper import ParamAttr
+
+T_MAX = 18
+FEATS = ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2", "pred",
+         "mark"]
+
+
+def _pad_batch(batch):
+    """9-slot conll05 examples -> dict of [B, T] arrays + length."""
+    B = len(batch)
+    arrs = {f: np.zeros((B, T_MAX), np.int64) for f in FEATS}
+    label = np.zeros((B, T_MAX), np.int64)
+    lens = np.zeros((B,), np.int64)
+    for i, ex in enumerate(batch):
+        L = min(len(ex[0]), T_MAX)
+        lens[i] = L
+        for j, f in enumerate(FEATS):
+            arrs[f][i, :L] = ex[j][:L]
+        label[i, :L] = ex[8][:L]
+    feed = {f: arrs[f] for f in FEATS}
+    feed["target"] = label
+    feed["length"] = lens
+    return feed
+
+
+def _db_lstm(emb_dim=16, hidden=32, depth=2):
+    """Scaled-down reference db_lstm: sum of feature embeddings -> stacked
+    alternating-direction LSTMs -> per-position tag emissions."""
+    from paddle_tpu.dataset import conll05
+
+    word_n = conll05.WORD_VOCAB
+    pred_n = conll05.PRED_VOCAB
+    n_labels = len(conll05.label_dict())
+
+    feats = {
+        f: layers.data(f, shape=[-1, T_MAX], dtype="int64",
+                       append_batch_size=False)
+        for f in FEATS
+    }
+    length = layers.data("length", shape=[-1], dtype="int64",
+                         append_batch_size=False)
+    target = layers.data("target", shape=[-1, T_MAX], dtype="int64",
+                         append_batch_size=False)
+
+    embs = []
+    for f in FEATS:
+        vocab = {"pred": pred_n, "mark": 2}.get(f, word_n)
+        embs.append(layers.embedding(feats[f], size=[vocab, emb_dim],
+                                     param_attr="emb_%s" % f))
+    hidden0 = layers.fc(layers.sums(embs), size=hidden * 4,
+                        num_flatten_dims=2)
+    inp = hidden0
+    lstm, _ = layers.dynamic_lstm(inp, size=hidden * 4, seq_lens=length)
+    for i in range(1, depth):
+        mix = layers.fc(lstm, size=hidden * 4, num_flatten_dims=2)
+        lstm, _ = layers.dynamic_lstm(
+            mix, size=hidden * 4, seq_lens=length, is_reverse=(i % 2) == 1)
+    emission = layers.fc(lstm, size=n_labels, num_flatten_dims=2)
+    return emission, target, length
+
+
+def test_label_semantic_roles(tmp_path):
+    from paddle_tpu.dataset import conll05
+
+    n_labels = len(conll05.label_dict())
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 17
+    with fluid.program_guard(main, startup):
+        emission, target, length = _db_lstm()
+        crf_cost = layers.linear_chain_crf(
+            emission, target, length,
+            param_attr=ParamAttr(name="crfw"))
+        avg_cost = layers.mean(crf_cost)
+        # decode + chunk metrics on the SAME transition param (reference
+        # crf_decoding(param_attr='crfw') + chunk_eval flow)
+        decode = layers.crf_decoding(emission, length,
+                                     param_attr=ParamAttr(name="crfw"))
+        (prec, rec, f1, n_infer, n_label, n_correct) = layers.chunk_eval(
+            decode, target, length, chunk_scheme="IOB",
+            num_chunk_types=conll05.CHUNK_TYPES)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    reader = paddle_tpu.batch(conll05.train(n=128), batch_size=16,
+                              drop_last=True)
+    losses = []
+    for epoch in range(8):
+        for batch in reader():
+            (lv,) = exe.run(main, feed=_pad_batch(batch),
+                            fetch_list=[avg_cost])
+            losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    # chunk F1 on held-out data should beat chance after training
+    test_batch = list(conll05.test(n=32)())
+    f1v, pv, rv = exe.run(
+        test_prog, feed=_pad_batch(test_batch),
+        fetch_list=[f1, prec, rec])[0:3]
+    assert float(f1v[0]) > 0.3, (f1v, pv, rv)
+
+    # save/load_inference_model round trip on the decode path
+    path = str(tmp_path / "srl.model")
+    feed_names = FEATS + ["length"]
+    fluid.io.save_inference_model(path, feed_names, [decode], exe, main)
+    scope2 = fluid.Scope()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    feed = _pad_batch(test_batch[:4])
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = fluid.io.load_inference_model(path, exe2)
+        (dec2,) = exe2.run(
+            prog, feed={n: feed[n] for n in feed_names},
+            fetch_list=fetches)
+    (dec1,) = exe.run(test_prog, feed=feed, fetch_list=[decode])
+    np.testing.assert_array_equal(dec2, dec1)

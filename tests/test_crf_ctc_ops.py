@@ -1,0 +1,363 @@
+"""CRF / CTC / edit-distance / chunk-eval op tests: brute-force numpy
+oracles + finite-difference gradients (reference OpTest pattern,
+`tests/unittests/test_linear_chain_crf_op.py`, `test_crf_decoding_op.py`,
+`test_chunk_eval_op.py`, `test_edit_distance_op.py`, `test_warpctc_op.py`)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_single_op
+
+
+# ---------------------------------------------------------------------------
+# brute-force oracles
+# ---------------------------------------------------------------------------
+
+def _crf_enumerate(emission, transition, lens):
+    """logZ and best path by enumerating ALL tag sequences (tiny N, T)."""
+    B, T, N = emission.shape
+    start, end, trans = transition[0], transition[1], transition[2:]
+    logZ = np.zeros(B)
+    best_paths = np.zeros((B, T), np.int64)
+    for b in range(B):
+        L = int(lens[b])
+        scores = []
+        paths = list(itertools.product(range(N), repeat=L))
+        for path in paths:
+            s = start[path[0]] + end[path[L - 1]]
+            for t in range(L):
+                s += emission[b, t, path[t]]
+            for t in range(1, L):
+                s += trans[path[t - 1], path[t]]
+            scores.append(s)
+        scores = np.array(scores)
+        logZ[b] = np.log(np.sum(np.exp(scores - scores.max()))) + scores.max()
+        best = paths[int(np.argmax(scores))]
+        best_paths[b, :L] = best
+    return logZ, best_paths
+
+
+def _crf_gold_score(emission, transition, label, lens):
+    B, T, N = emission.shape
+    start, end, trans = transition[0], transition[1], transition[2:]
+    out = np.zeros(B)
+    for b in range(B):
+        L = int(lens[b])
+        s = start[label[b, 0]] + end[label[b, L - 1]]
+        for t in range(L):
+            s += emission[b, t, label[b, t]]
+        for t in range(1, L):
+            s += trans[label[b, t - 1], label[b, t]]
+        out[b] = s
+    return out
+
+
+def _levenshtein(a, b):
+    d = np.zeros((len(a) + 1, len(b) + 1))
+    d[:, 0] = np.arange(len(a) + 1)
+    d[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                          d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return d[len(a), len(b)]
+
+
+def _ctc_enumerate(logits, llen, label, label_len, blank=0):
+    """-log P(label) by enumerating every frame path (tiny T, C)."""
+    B, T, C = logits.shape
+    out = np.zeros(B)
+    for b in range(B):
+        L = int(llen[b])
+        lab = tuple(label[b, : int(label_len[b])])
+        p = np.exp(logits[b, :L] - logits[b, :L].max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        total = 0.0
+        for path in itertools.product(range(C), repeat=L):
+            # collapse: remove repeats then blanks
+            col = []
+            prev = None
+            for s in path:
+                if s != prev:
+                    col.append(s)
+                prev = s
+            col = tuple(s for s in col if s != blank)
+            if col == lab:
+                pr = 1.0
+                for t, s in enumerate(path):
+                    pr *= p[t, s]
+                total += pr
+        out[b] = -np.log(total)
+    return out
+
+
+def _chunks_of(tags, scheme, num_types):
+    """Independent per-sequence chunk extractor (sequential python loop)."""
+    n_tag = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    other = num_types * n_tag
+    chunks = []
+    start = cur_type = None
+
+    def close(end_t):
+        nonlocal start, cur_type
+        if start is not None:
+            chunks.append((start, end_t, cur_type))
+        start, cur_type = None, None
+
+    for t, tag in enumerate(tags):
+        inside = tag < other
+        if not inside:
+            close(t - 1)
+            continue
+        ty, tt = tag // n_tag, tag % n_tag
+        if scheme == "plain":
+            close(t - 1)
+            chunks.append((t, t, ty))
+        elif scheme == "IOB":  # B=0, I=1
+            if tt == 0 or start is None or cur_type != ty:
+                close(t - 1)
+                start, cur_type = t, ty
+        elif scheme == "IOE":  # I=0, E=1
+            if start is None or cur_type != ty:
+                close(t - 1)
+                start, cur_type = t, ty
+            if tt == 1:
+                close(t)
+        else:  # IOBES: B=0, I=1, E=2, S=3
+            if tt in (0, 3) or start is None or cur_type != ty:
+                close(t - 1)
+                start, cur_type = t, ty
+            if tt in (2, 3):
+                close(t)
+    close(len(tags) - 1)
+    return set(chunks)
+
+
+# ---------------------------------------------------------------------------
+# linear_chain_crf
+# ---------------------------------------------------------------------------
+
+def test_linear_chain_crf_vs_enumeration(rng):
+    B, T, N = 3, 4, 3
+    emission = rng.randn(B, T, N).astype(np.float32)
+    transition = (0.3 * rng.randn(N + 2, N)).astype(np.float32)
+    lens = np.array([4, 2, 3], np.int64)
+    label = rng.randint(0, N, (B, T)).astype(np.int64)
+
+    logZ, _ = _crf_enumerate(emission, transition, lens)
+    gold = _crf_gold_score(emission, transition, label, lens)
+    expect = (logZ - gold)[:, None]
+
+    outs, _ = run_single_op(
+        "linear_chain_crf",
+        {"Emission": emission, "Transition": transition,
+         "Label": label, "Length": lens},
+        {}, ["LogLikelihood", "Alpha"],
+    )
+    np.testing.assert_allclose(outs["LogLikelihood"], expect,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_linear_chain_crf_grad(rng):
+    B, T, N = 2, 3, 3
+    inputs = {
+        "Emission": rng.randn(B, T, N).astype(np.float64),
+        "Transition": (0.3 * rng.randn(N + 2, N)).astype(np.float64),
+        "Label": rng.randint(0, N, (B, T)).astype(np.int64),
+        "Length": np.array([3, 2], np.int64),
+    }
+    check_grad("linear_chain_crf", inputs, {},
+               ["LogLikelihood", "Alpha"], ["Emission", "Transition"],
+               rtol=1e-2, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# crf_decoding
+# ---------------------------------------------------------------------------
+
+def test_crf_decoding_vs_enumeration(rng):
+    B, T, N = 4, 4, 3
+    emission = rng.randn(B, T, N).astype(np.float32)
+    transition = (0.5 * rng.randn(N + 2, N)).astype(np.float32)
+    lens = np.array([4, 3, 2, 1], np.int64)
+    _, best = _crf_enumerate(emission, transition, lens)
+
+    outs, _ = run_single_op(
+        "crf_decoding",
+        {"Emission": emission, "Transition": transition, "Length": lens},
+        {}, ["ViterbiPath"],
+    )
+    np.testing.assert_array_equal(outs["ViterbiPath"], best)
+
+
+def test_crf_decoding_with_label_marks(rng):
+    B, T, N = 2, 3, 3
+    emission = rng.randn(B, T, N).astype(np.float32)
+    transition = (0.5 * rng.randn(N + 2, N)).astype(np.float32)
+    lens = np.array([3, 2], np.int64)
+    _, best = _crf_enumerate(emission, transition, lens)
+    label = best.copy()
+    label[0, 0] = (label[0, 0] + 1) % N  # one wrong position
+
+    outs, _ = run_single_op(
+        "crf_decoding",
+        {"Emission": emission, "Transition": transition,
+         "Label": label, "Length": lens},
+        {}, ["ViterbiPath"],
+    )
+    marks = outs["ViterbiPath"]
+    assert marks[0, 0] == 0
+    assert marks[0, 1] == 1 and marks[0, 2] == 1
+    assert marks[1, 0] == 1 and marks[1, 1] == 1
+    assert marks[1, 2] == 0  # padding
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval
+# ---------------------------------------------------------------------------
+
+def _chunk_oracle(inf, lab, lens, scheme, num_types):
+    n_inf = n_lab = n_corr = 0
+    for b in range(inf.shape[0]):
+        L = int(lens[b])
+        ci = _chunks_of(inf[b, :L], scheme, num_types)
+        cl = _chunks_of(lab[b, :L], scheme, num_types)
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_corr += len(ci & cl)
+    return n_inf, n_lab, n_corr
+
+
+@pytest.mark.parametrize("scheme", ["IOB", "IOBES", "plain"])
+def test_chunk_eval_vs_oracle(rng, scheme):
+    num_types = 3
+    n_tag = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    B, T = 4, 10
+    hi = num_types * n_tag + 1  # include the "other" tag
+    inf = rng.randint(0, hi, (B, T)).astype(np.int64)
+    lab = rng.randint(0, hi, (B, T)).astype(np.int64)
+    lens = rng.randint(1, T + 1, (B,)).astype(np.int64)
+
+    n_inf, n_lab, n_corr = _chunk_oracle(inf, lab, lens, scheme, num_types)
+    outs, _ = run_single_op(
+        "chunk_eval",
+        {"Inference": inf, "Label": lab, "Length": lens},
+        {"chunk_scheme": scheme, "num_chunk_types": num_types},
+        ["Precision", "Recall", "F1-Score", "NumInferChunks",
+         "NumLabelChunks", "NumCorrectChunks"],
+    )
+    assert int(outs["NumInferChunks"][0]) == n_inf
+    assert int(outs["NumLabelChunks"][0]) == n_lab
+    assert int(outs["NumCorrectChunks"][0]) == n_corr
+    if n_inf and n_lab:
+        p = n_corr / n_inf
+        r = n_corr / n_lab
+        np.testing.assert_allclose(outs["Precision"][0], p, rtol=1e-5)
+        np.testing.assert_allclose(outs["Recall"][0], r, rtol=1e-5)
+        if p + r:
+            np.testing.assert_allclose(
+                outs["F1-Score"][0], 2 * p * r / (p + r), rtol=1e-5)
+
+
+def test_chunk_eval_identical_sequences(rng):
+    """inference == label => precision = recall = f1 = 1."""
+    B, T, num_types = 3, 8, 2
+    lab = rng.randint(0, num_types * 2 + 1, (B, T)).astype(np.int64)
+    lens = np.array([8, 5, 6], np.int64)
+    outs, _ = run_single_op(
+        "chunk_eval",
+        {"Inference": lab, "Label": lab, "Length": lens},
+        {"chunk_scheme": "IOB", "num_chunk_types": num_types},
+        ["Precision", "Recall", "F1-Score", "NumInferChunks",
+         "NumLabelChunks", "NumCorrectChunks"],
+    )
+    if int(outs["NumLabelChunks"][0]):
+        assert float(outs["Precision"][0]) == 1.0
+        assert float(outs["Recall"][0]) == 1.0
+        assert float(outs["F1-Score"][0]) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# edit_distance
+# ---------------------------------------------------------------------------
+
+def test_edit_distance_vs_oracle(rng):
+    B, T1, T2 = 5, 6, 7
+    hyps = rng.randint(1, 5, (B, T1)).astype(np.int64)
+    refs = rng.randint(1, 5, (B, T2)).astype(np.int64)
+    hlen = rng.randint(1, T1 + 1, (B,)).astype(np.int64)
+    rlen = rng.randint(1, T2 + 1, (B,)).astype(np.int64)
+    expect = np.array([
+        _levenshtein(hyps[b, : hlen[b]], refs[b, : rlen[b]])
+        for b in range(B)
+    ])[:, None]
+
+    outs, _ = run_single_op(
+        "edit_distance",
+        {"Hyps": hyps, "HypsLength": hlen, "Refs": refs, "RefsLength": rlen},
+        {"normalized": False}, ["Out", "SequenceNum"],
+    )
+    np.testing.assert_allclose(outs["Out"], expect, rtol=1e-6)
+    assert int(outs["SequenceNum"][0]) == B
+
+    outs_n, _ = run_single_op(
+        "edit_distance",
+        {"Hyps": hyps, "HypsLength": hlen, "Refs": refs, "RefsLength": rlen},
+        {"normalized": True}, ["Out", "SequenceNum"],
+    )
+    np.testing.assert_allclose(
+        outs_n["Out"], expect / rlen[:, None], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# warpctc (CTC loss)
+# ---------------------------------------------------------------------------
+
+def test_warpctc_vs_enumeration(rng):
+    B, T, C, Lmax = 3, 4, 3, 2
+    logits = rng.randn(B, T, C).astype(np.float64)
+    llen = np.array([4, 3, 4], np.int64)
+    label = rng.randint(1, C, (B, Lmax)).astype(np.int64)
+    label_len = np.array([2, 1, 2], np.int64)
+
+    expect = _ctc_enumerate(logits, llen, label, label_len)[:, None]
+    outs, _ = run_single_op(
+        "warpctc",
+        {"Logits": logits, "LogitsLength": llen,
+         "Label": label, "LabelLength": label_len},
+        {"blank": 0}, ["Loss"],
+    )
+    np.testing.assert_allclose(outs["Loss"], expect, rtol=1e-5, atol=1e-6)
+
+
+def test_warpctc_empty_label(rng):
+    """label_len == 0: loss = -log P(all-blank path), counted once."""
+    B, T, C = 1, 3, 3
+    logits = rng.randn(B, T, C).astype(np.float64)
+    llen = np.array([3], np.int64)
+    label = np.zeros((B, 2), np.int64)
+    label_len = np.array([0], np.int64)
+    p = np.exp(logits[0] - logits[0].max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    expect = -np.log(p[0, 0] * p[1, 0] * p[2, 0])
+    outs, _ = run_single_op(
+        "warpctc",
+        {"Logits": logits, "LogitsLength": llen,
+         "Label": label, "LabelLength": label_len},
+        {"blank": 0}, ["Loss"],
+    )
+    np.testing.assert_allclose(outs["Loss"][0, 0], expect, rtol=1e-6)
+
+
+def test_warpctc_grad(rng):
+    B, T, C, Lmax = 2, 3, 3, 2
+    inputs = {
+        "Logits": rng.randn(B, T, C).astype(np.float64),
+        "LogitsLength": np.array([3, 2], np.int64),
+        "Label": rng.randint(1, C, (B, Lmax)).astype(np.int64),
+        "LabelLength": np.array([2, 1], np.int64),
+    }
+    check_grad("warpctc", inputs, {"blank": 0}, ["Loss"], ["Logits"],
+               rtol=1e-2, atol=1e-3)
