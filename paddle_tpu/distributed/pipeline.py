@@ -25,66 +25,86 @@ import jax
 import jax.numpy as jnp
 
 
-def gpipe(stage_fn, n_stages, n_micro, axis_name="pp"):
-    """Build a pipelined apply: (stacked_params_local, xs) -> ys.
+def gpipe(stage_fn, n_stages, n_micro, axis_name="pp",
+          first_fn=None, last_fn=None):
+    """Build a pipelined apply: (stacked_params_local, xs[, first_params,
+    last_params]) -> ys.
 
-    stage_fn(params, x) -> y: one stage's compute; all stages share this
-    structure (the homogeneous-blocks middle of a transformer).  Call the
-    result inside shard_map where `axis_name` is a manual axis and the
-    params' leading (stage) dim is sharded on it:
+    stage_fn(params, x) -> y: one stage's compute; the homogeneous middle
+    (same activation shape in and out).  Heterogeneous ends (reference
+    SectionWorker runs arbitrary per-stage programs, section_worker.cc:142):
 
-        xs: [n_micro, mb, ...] microbatched inputs (used by stage 0)
-        returns ys: [n_micro, mb, ...] final-stage outputs (valid on every
-        shard — they ride one extra ppermute hop from the last stage back
-        to stage 0 and are then broadcast via psum-style selection).
+      * first_fn(first_params, raw_mb) -> activation — the embedding-style
+        entry applied to each raw microbatch before stage 0 (raw shape may
+        differ from the inter-stage activation shape);
+      * last_fn(last_params, activation) -> output — the head applied after
+        the final stage (output shape may differ again).
+
+    Call the result inside shard_map where `axis_name` is a manual axis and
+    the stacked params' leading (stage) dim is sharded on it; first/last
+    params ride in replicated.
+
+        xs: [n_micro, mb, ...] raw microbatched inputs (used by stage 0)
+        returns ys: [n_micro, mb, ...] head outputs, identical on every
+        shard (accumulated on the last stage, ONE psum broadcast at the
+        end — no per-tick ring traffic).
     """
 
-    def pipelined(params_local, xs):
+    def pipelined(params_local, xs, first_params=None, last_params=None):
         # drop the sharded stage dim: each shard holds exactly one stage
         params_local = jax.tree.map(lambda a: a[0], params_local)
         s = jax.lax.axis_index(axis_name)
         n_ticks = n_micro + n_stages - 1
-        mb_shape = xs.shape[1:]
+        raw_shape = xs.shape[1:]
+
+        def entry(x):
+            return first_fn(first_params, x) if first_fn is not None else x
+
+        def head(a):
+            return last_fn(last_params, a) if last_fn is not None else a
+
+        # entry applied ONCE to all microbatches up front (GPipe stores
+        # stage-0 inputs anyway); head applied ONCE after the scan — neither
+        # runs inside the tick loop, so the embedding gather / vocab matmul
+        # cost is per-microbatch, not per-tick-per-shard
+        xs_act = jax.vmap(entry)(xs)
+        act_shape = xs_act.shape[1:]
+        out_s = jax.eval_shape(
+            lambda p, x: stage_fn(p, x), params_local,
+            jax.ShapeDtypeStruct(act_shape, xs_act.dtype))
 
         fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
-        ring_back = [(n_stages - 1, 0)]
 
         def tick(carry, t):
             recv, outs = carry
             # stage 0 ingests microbatch t (zeros on idle ticks)
             mb_idx = jnp.clip(t, 0, n_micro - 1)
-            x0 = jnp.where(t < n_micro, xs[mb_idx],
-                           jnp.zeros(mb_shape, xs.dtype))
+            x0 = jnp.where(t < n_micro, xs_act[mb_idx],
+                           jnp.zeros(act_shape, xs_act.dtype))
             inp = jnp.where(s == 0, x0, recv)
             out = stage_fn(params_local, inp)
-            # pass activations to the next stage...
+            # hand activations to the next stage over ICI
             recv_next = jax.lax.ppermute(out, axis_name, fwd_perm)
-            # ...and ship the last stage's finished microbatch to stage 0's
-            # output buffer (valid when t >= n_stages-1)
-            done = jax.lax.ppermute(out, axis_name, ring_back)
+            # last stage accumulates its finished microbatch locally
             out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
-            outs = jax.lax.cond(
-                t >= n_stages - 1,
-                lambda o: o.at[out_idx].set(done),
-                lambda o: o,
-                outs,
-            )
+            take = (t >= n_stages - 1) & (s == n_stages - 1)
+            outs = jnp.where(take, outs.at[out_idx].set(out), outs)
             return (recv_next, outs), None
 
-        outs0 = jnp.zeros((n_micro,) + mb_shape, xs.dtype)
+        outs0 = jnp.zeros((n_micro,) + out_s.shape, out_s.dtype)
         outs0 = jax.lax.pcast(outs0, axis_name, to="varying")
         recv0 = jax.lax.pcast(
-            jnp.zeros(mb_shape, xs.dtype), axis_name, to="varying"
+            jnp.zeros(out_s.shape, out_s.dtype), axis_name, to="varying"
         )
         (_, outs), _ = jax.lax.scan(
             tick, (recv0, outs0), jnp.arange(n_ticks)
         )
-        # outs landed on stage 0; make them stage-invariant for downstream
-        # replicated compute (head/loss): rotate-select via psum over a
-        # one-hot so every shard ends with stage 0's buffer
-        sel = (s == 0).astype(outs.dtype)
+        # one collective: broadcast the last stage's activation buffer to
+        # every shard, then apply the head replicated (broadcasting hidden
+        # states is cheaper than broadcasting vocab-sized logits)
+        sel = (s == n_stages - 1).astype(outs.dtype)
         outs = jax.lax.psum(outs * sel, axis_name)
-        return outs
+        return jax.vmap(head)(outs)
 
     return pipelined
 
@@ -101,8 +121,20 @@ class PipelineOptimizer:
     """
 
     def __init__(self, optimizer, num_microbatches=1):
+        import warnings
+
         from ..fluid.optimizer import GradientMergeOptimizer
 
+        warnings.warn(
+            "PipelineOptimizer on the static-graph path runs MICROBATCH "
+            "ACCUMULATION (GradientMerge), not stage parallelism: the "
+            "program executes whole on each device and device_guard "
+            "annotations are ignored. For real pipeline parallelism use "
+            "distributed.pipeline.gpipe (optionally with first_fn/last_fn "
+            "heterogeneous stages) under a mesh with a 'pp' axis, e.g. via "
+            "ShardedTrainStep.",
+            stacklevel=2,
+        )
         self._inner = GradientMergeOptimizer(
             optimizer, k_steps=num_microbatches, avg=True
         )

@@ -17,6 +17,10 @@ def _transition_param(helper, param_attr, n_tags, dtype):
     helper.get_parameter) so decode shares the trained transition and the
     startup program initializes it exactly once."""
     attr = ParamAttr._to_attr(param_attr)
+    if attr is False:
+        raise ValueError(
+            "the CRF transition parameter cannot be disabled "
+            "(param_attr=False); pass a name/ParamAttr or None")
     if attr and attr.name:
         existing = helper.main_program.global_block._find_var_recursive(
             attr.name)

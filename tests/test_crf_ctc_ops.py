@@ -230,7 +230,7 @@ def _chunk_oracle(inf, lab, lens, scheme, num_types):
     return n_inf, n_lab, n_corr
 
 
-@pytest.mark.parametrize("scheme", ["IOB", "IOBES", "plain"])
+@pytest.mark.parametrize("scheme", ["IOB", "IOE", "IOBES", "plain"])
 def test_chunk_eval_vs_oracle(rng, scheme):
     num_types = 3
     n_tag = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
@@ -259,6 +259,41 @@ def test_chunk_eval_vs_oracle(rng, scheme):
         if p + r:
             np.testing.assert_allclose(
                 outs["F1-Score"][0], 2 * p * r / (p + r), rtol=1e-5)
+
+
+def test_chunk_eval_excluded_types(rng):
+    """excluded_chunk_types drops those chunks from all three counts."""
+    num_types = 3
+    B, T = 4, 10
+    hi = num_types * 2 + 1
+    inf = rng.randint(0, hi, (B, T)).astype(np.int64)
+    lab = rng.randint(0, hi, (B, T)).astype(np.int64)
+    lens = rng.randint(1, T + 1, (B,)).astype(np.int64)
+    excl = [1]
+
+    def drop(chunks):
+        return {c for c in chunks if c[2] not in excl}
+
+    n_inf = n_lab = n_corr = 0
+    for b in range(B):
+        L = int(lens[b])
+        ci = drop(_chunks_of(inf[b, :L], "IOB", num_types))
+        cl = drop(_chunks_of(lab[b, :L], "IOB", num_types))
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_corr += len(ci & cl)
+
+    outs, _ = run_single_op(
+        "chunk_eval",
+        {"Inference": inf, "Label": lab, "Length": lens},
+        {"chunk_scheme": "IOB", "num_chunk_types": num_types,
+         "excluded_chunk_types": excl},
+        ["Precision", "Recall", "F1-Score", "NumInferChunks",
+         "NumLabelChunks", "NumCorrectChunks"],
+    )
+    assert int(outs["NumInferChunks"][0]) == n_inf
+    assert int(outs["NumLabelChunks"][0]) == n_lab
+    assert int(outs["NumCorrectChunks"][0]) == n_corr
 
 
 def test_chunk_eval_identical_sequences(rng):
