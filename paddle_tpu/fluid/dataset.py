@@ -40,7 +40,30 @@ class DatasetBase:
 
     # -- reference setters ----------------------------------------------
     def set_filelist(self, filelist):
+        """The FULL file list (reference contract); each trainer loads its
+        own shard — see set_trainer_info / global_shuffle."""
         self._filelist = list(filelist)
+        if self._handle is not None:
+            # filelist changed: rebuild the engine on next use
+            self._lib.ds_destroy(self._handle)
+            self._handle = None
+
+    def set_trainer_info(self, trainer_id, trainer_num):
+        """Shard the filelist across trainers (reference DatasetImpl
+        SetTrainerNum / file dispatch in data_set.cc): trainer i loads
+        files [i::trainer_num] of the (possibly shuffled) global list."""
+        self._trainer_id = int(trainer_id)
+        self._trainer_num = max(int(trainer_num), 1)
+
+    def _my_files(self):
+        tid = getattr(self, "_trainer_id", 0)
+        tnum = getattr(self, "_trainer_num", 1)
+        files = list(self._filelist)
+        seed = getattr(self, "_file_perm_seed", None)
+        if seed is not None:
+            rs = np.random.RandomState(seed)
+            rs.shuffle(files)
+        return files[tid::tnum] if tnum > 1 else files
 
     def set_batch_size(self, batch_size):
         self._batch_size = int(batch_size)
@@ -71,15 +94,16 @@ class DatasetBase:
         if not self._slots:
             raise RuntimeError("call set_use_var(...) to declare slots first")
         lib = get_lib()
-        files = (ctypes.c_char_p * len(self._filelist))(
-            *[f.encode() for f in self._filelist]
+        my_files = self._my_files()
+        files = (ctypes.c_char_p * len(my_files))(
+            *[f.encode() for f in my_files]
         )
         schema = (ctypes.c_int * len(self._slots))(
             *[1 if f else 0 for _, f in self._slots]
         )
         self._lib = lib
         self._handle = lib.ds_create(
-            files, len(self._filelist), schema, len(self._slots),
+            files, len(my_files), schema, len(self._slots),
             self._thread_num,
         )
 
@@ -131,15 +155,51 @@ class InMemoryDataset(DatasetBase):
     def load_into_memory(self):
         self._ensure_handle()
         self._lib.ds_load_into_memory(self._handle)
+        self._was_loaded = True
+
+    def __iter__(self):
+        # a set_filelist after load_into_memory rebuilds the engine; honor
+        # the earlier load by reloading the new shard instead of silently
+        # yielding zero batches
+        self._ensure_handle()
+        if (getattr(self, "_was_loaded", False)
+                and self._lib.ds_memory_data_size(self._handle) == 0):
+            self._lib.ds_load_into_memory(self._handle)
+        yield from super().__iter__()
 
     def local_shuffle(self, seed=0):
         self._ensure_handle()
         self._lib.ds_local_shuffle(self._handle, seed)
 
     def global_shuffle(self, fleet=None, seed=0):
-        """Reference global shuffle redistributes samples across trainers
-        via gloo; under jax each host reads its own file shard (set_filelist
-        per rank) so a local shuffle completes the same contract."""
+        """Cross-trainer sample redistribution (reference data_set.cc
+        GlobalShuffle via gloo).  TPU-native: every trainer applies the
+        SAME seeded permutation to the global filelist and reloads its new
+        shard — samples move between trainers at file granularity with no
+        transport layer — then local-shuffles within the shard.  With one
+        trainer this degenerates to a local shuffle (reference behavior)."""
+        tnum = getattr(self, "_trainer_num", 1)
+        if fleet is not None and tnum == 1:
+            try:
+                self.set_trainer_info(fleet.worker_index(),
+                                      fleet.worker_num())
+                tnum = self._trainer_num
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    "global_shuffle could not read trainer identity from "
+                    "fleet (%s); falling back to a LOCAL shuffle — no "
+                    "cross-trainer redistribution will happen" % (e,),
+                    stacklevel=2,
+                )
+        if tnum > 1:
+            self._file_perm_seed = int(seed) + 1
+            if self._handle is not None:
+                self._lib.ds_destroy(self._handle)
+                self._handle = None
+            self._ensure_handle()
+            self._lib.ds_load_into_memory(self._handle)
         self.local_shuffle(seed)
 
     def release_memory(self):

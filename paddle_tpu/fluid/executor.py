@@ -491,6 +491,107 @@ class Executor:
                 % ", ".join(unused[:20])
             )
 
+    # ------------------------------------------------------------------
+    # Dataset trainer path (cf. reference Executor.train_from_dataset
+    # executor.py:1448 -> _run_from_dataset:1323 -> TrainerDesc +
+    # MultiTrainer/HogwildWorker threads, trainer.h:38).  TPU-first
+    # redesign: the per-thread interpreter workers collapse into the one
+    # jitted block — the native C++ engine parses/shuffles in its own
+    # threads while XLA executes the previous batch, and ragged slots are
+    # padded to the program's declared static shapes (bucketed otherwise)
+    # so recompiles stay bounded.
+    # ------------------------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """One full pass over `dataset` driving `program` batch-by-batch
+        with no Python reader.  fetch_list vars are printed every
+        `print_period` batches when `debug` (reference PrintFetchVars
+        semantics, device_worker.h)."""
+        return self._run_from_dataset(
+            program, dataset, scope, fetch_list, fetch_info,
+            print_period, debug,
+        )
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Same loop as train_from_dataset but gradient/optimizer ops DO
+        NOT run (reference contract, executor.py:1519): the program is
+        pruned via clone(for_test=True), cached per program version."""
+        program = program or framework.default_main_program()
+        key = (id(program), program._version)
+        cache = getattr(self, "_infer_clone_cache", None)
+        if cache is None:
+            cache = self._infer_clone_cache = {}
+        clone = cache.get(key)
+        if clone is None:
+            if len(cache) > 8:
+                cache.clear()
+            clone = cache[key] = program.clone(for_test=True)
+        return self._run_from_dataset(
+            clone, dataset, scope, fetch_list, fetch_info,
+            print_period, debug,
+        )
+
+    def _run_from_dataset(self, program, dataset, scope, fetch_list,
+                          fetch_info, print_period, debug):
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        from .dataset import pad_batch
+
+        program = program or framework.default_main_program()
+        block = program.global_block
+        fetch_names = [
+            f.name if isinstance(f, framework.Variable) else str(f)
+            for f in (fetch_list or [])
+        ]
+        labels = list(fetch_info or fetch_names)
+        last_fetch = None
+        for step, batch in enumerate(dataset):
+            feed = {}
+            for name, _is_float in dataset._slots:
+                vals, lod = batch[name]
+                lod = np.asarray(lod)
+                lens = lod[1:] - lod[:-1]
+                v = block._find_var_recursive(name)
+                vshape = v.shape if v is not None and v.shape else None
+                if (np.all(lens == 1) and vshape is not None
+                        and len(vshape) >= 2 and vshape[-1] == 1):
+                    # one value per sample: dense column (CTR labels)
+                    feed[name] = vals.reshape(-1, 1)
+                    continue
+                # ragged slot -> padded dense [B, T]; T from the program's
+                # declared dim, else bucketed to the next power of two so
+                # the executor cache sees few distinct shapes
+                T = None
+                if vshape is not None and len(vshape) >= 2 and vshape[1] > 0:
+                    T = int(vshape[1])
+                dense, _mask = pad_batch(vals, lod, max_len=T)
+                if T is None and dense.shape[1] > 0:
+                    L = 1
+                    while L < dense.shape[1]:
+                        L *= 2
+                    if L != dense.shape[1]:
+                        pad = np.zeros(
+                            (dense.shape[0], L - dense.shape[1]),
+                            dense.dtype)
+                        dense = np.concatenate([dense, pad], axis=1)
+                feed[name] = dense
+                lname = name + "_length"
+                if block._find_var_recursive(lname) is not None:
+                    feed[lname] = lens.astype(np.int64)
+            out = self.run(program, feed=feed, fetch_list=fetch_names,
+                           scope=scope)
+            last_fetch = out
+            if debug and fetch_names and step % max(print_period, 1) == 0:
+                msg = ", ".join(
+                    "%s=%s" % (lbl, np.asarray(val).reshape(-1)[:4])
+                    for lbl, val in zip(labels, out)
+                )
+                print("[train_from_dataset] step %d: %s" % (step, msg))
+        return last_fetch
+
     # convenience used by tests/io
     def run_startup(self, startup_program=None, scope=None):
         startup_program = startup_program or framework.default_startup_program()
