@@ -32,6 +32,7 @@ from .collective import (  # noqa: F401
     reduce_scatter,
     send_recv,
 )
+from .geo import GeoSGDCommunicator  # noqa: F401
 from .parallel import ParallelEnv, get_rank, get_world_size, init_parallel_env  # noqa: F401
 from .static_sharding import (  # noqa: F401
     apply_dist_strategy,

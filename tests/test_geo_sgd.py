@@ -1,0 +1,141 @@
+"""GEO-SGD semantics (reference geo_sgd_transpiler.py + GeoCommunicator):
+k-step local updates, delta fold across workers, loss parity within delta
+vs fully-synchronous training."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed.geo import GeoSGDCommunicator
+from paddle_tpu.fluid import layers
+
+
+def _build(seed=13):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 8], append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        h = layers.fc(x, size=16, act="relu", param_attr="g_fc1.w",
+                      bias_attr="g_fc1.b")
+        pred = layers.fc(h, size=1, param_attr="g_fc2.w", bias_attr="g_fc2.b")
+        loss = layers.reduce_mean(layers.square(pred - y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _data(steps=12, G=16, seed=3):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(steps, G, 8).astype(np.float32)
+    w = rng.randn(8, 1).astype(np.float32)
+    ys = xs @ w + 0.05 * rng.randn(steps, G, 1).astype(np.float32)
+    return xs, ys
+
+
+def test_geo_two_workers_track_sync_baseline():
+    """2 GEO workers (k=3) end within delta of the fully-synchronous
+    2-worker run and both converge; workers agree after each sync."""
+    xs, ys = _data()
+    steps, G = xs.shape[0], xs.shape[1]
+    W = 2
+    B = G // W
+
+    # --- fully synchronous baseline: train on the global batch ---------
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    sync_losses = []
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for t in range(steps):
+            (lv,) = exe.run(main, feed={"x": xs[t], "y": ys[t]},
+                            fetch_list=[loss])
+            sync_losses.append(float(lv))
+        w_sync = np.asarray(scope.find_var("g_fc1.w"))
+
+    # --- GEO: 2 workers, local SGD, delta fold every k=3 ----------------
+    import paddle_tpu.fluid.framework as fw
+
+    k = 3
+    workers = []
+    for wid in range(W):
+        fw.reset_default_programs()
+        main_w, startup_w, loss_w = _build()   # same seeds => same init
+        scope_w = fluid.Scope()
+        exe_w = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope_w):
+            exe_w.run(startup_w)
+        workers.append(dict(main=main_w, loss=loss_w, scope=scope_w,
+                            exe=exe_w))
+
+    # lockstep delta fold: deposit every worker's delta first, then each
+    # worker's sync applies the full sum (emulating the cross-process
+    # all-reduce in-process)
+    comms = []
+    deltas = [dict() for _ in range(W)]
+    for wid, w in enumerate(workers):
+        with fluid.scope_guard(w["scope"]):
+            comms.append(GeoSGDCommunicator(
+                w["main"], scope=w["scope"], k_steps=k,
+                reduce_fn=lambda name, d: d))  # replaced before each sync
+
+    geo_losses = []
+    for t in range(steps):
+        locs = []
+        for wid, w in enumerate(workers):
+            lo, hi = wid * B, (wid + 1) * B
+            with fluid.scope_guard(w["scope"]):
+                (lv,) = w["exe"].run(
+                    w["main"], feed={"x": xs[t, lo:hi], "y": ys[t, lo:hi]},
+                    fetch_list=[w["loss"]])
+            locs.append(float(lv))
+        geo_losses.append(float(np.mean(locs)))
+        if (t + 1) % k == 0:
+            # lockstep fold: deposit all deltas first (worker order), then
+            # each worker applies the full sum
+            for wid in range(W):
+                deltas[wid].clear()
+            for wid, w in enumerate(workers):
+                for n in comms[wid]._params:
+                    deltas[wid][n] = (
+                        np.asarray(w["scope"].find_var(n))
+                        - comms[wid]._snapshot[n])
+            for wid, w in enumerate(workers):
+                comms[wid]._reduce = lambda name, d, _w=wid: sum(
+                    deltas[i][name] for i in range(W))
+                comms[wid].sync()
+
+    # workers hold identical params after the last sync
+    w0 = np.asarray(workers[0]["scope"].find_var("g_fc1.w"))
+    w1 = np.asarray(workers[1]["scope"].find_var("g_fc1.w"))
+    np.testing.assert_allclose(w0, w1, rtol=1e-6, atol=1e-7)
+
+    # GEO converges and lands near the synchronous solution
+    assert geo_losses[-1] < geo_losses[0] * 0.5
+    assert abs(geo_losses[-1] - sync_losses[-1]) < 0.5 * max(
+        sync_losses[0], 1.0)
+    np.testing.assert_allclose(w0, w_sync, atol=0.5)
+
+
+def test_geo_single_worker_is_local_training():
+    """World size 1: GEO sync is the identity fold — training proceeds
+    exactly like plain local SGD (reference one-trainer behavior)."""
+    xs, ys = _data(steps=6)
+    main, startup, loss = _build(seed=29)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        comm = GeoSGDCommunicator(main, scope=scope, k_steps=2)
+        losses = []
+        n_syncs = 0
+        for t in range(6):
+            (lv,) = exe.run(main, feed={"x": xs[t], "y": ys[t]},
+                            fetch_list=[loss])
+            losses.append(float(lv))
+            n_syncs += int(comm.step())
+        assert n_syncs == 3
+        assert losses[-1] < losses[0]
+        # snapshot tracks the params after each sync
+        np.testing.assert_allclose(
+            comm._snapshot["g_fc1.w"],
+            np.asarray(scope.find_var("g_fc1.w")), rtol=1e-6)
