@@ -55,7 +55,6 @@ def gpipe(stage_fn, n_stages, n_micro, axis_name="pp",
         params_local = jax.tree.map(lambda a: a[0], params_local)
         s = jax.lax.axis_index(axis_name)
         n_ticks = n_micro + n_stages - 1
-        raw_shape = xs.shape[1:]
 
         def entry(x):
             return first_fn(first_params, x) if first_fn is not None else x

@@ -228,6 +228,18 @@ def _append_backward_for_targets(
     loss = targets[0]
     block = loss.block
     program = block.program
+    # all targets (and provided output gradients) must live in ONE block;
+    # mixed-block inputs would silently build a wrong graph
+    for t in targets[1:]:
+        if t.block is not block:
+            raise ValueError(
+                "backward targets span different blocks: %r vs %r — "
+                "compute gradients per block" % (loss.name, t.name))
+    for tg in (target_gradients or []):
+        if tg is not None and tg.block is not block:
+            raise ValueError(
+                "target_gradient %r lives in a different block than the "
+                "targets" % tg.name)
     no_grad = set(no_grad_set or ())
     first_backward_op_idx = len(block.ops)
 

@@ -187,9 +187,16 @@ class PostTrainingQuantization:
 
     def __init__(self, executor, program, feed_names, scope=None,
                  batch_generator=None, algo="abs_max",
-                 quantize_activations=True, quantizable_op_type=None):
-        if algo != "abs_max":
-            raise NotImplementedError("algo=abs_max only")
+                 quantize_activations=True, quantizable_op_type=None,
+                 percentile=99.99):
+        # abs_max: scale = max |activation| over calibration (reference
+        # default; one outlier fixes the scale).  percentile: scale = the
+        # given percentile of |activation| — robust to outliers (reference
+        # hist/KL capability, simplified).
+        if algo not in ("abs_max", "percentile"):
+            raise NotImplementedError("algo must be abs_max or percentile")
+        self._algo = algo
+        self._percentile = float(percentile)
         self._exe = executor
         self._program = program
         self._feed_names = list(feed_names)
@@ -205,6 +212,12 @@ class PostTrainingQuantization:
         scales = {n: 0.0 for n in act_names}
         if not act_names or self._batches is None:
             return scales
+        use_pct = self._algo == "percentile"
+        # percentile mode: O(bins) memory via a growable histogram per
+        # tensor (range doubles and bins pair-merge when a batch exceeds
+        # it) — the reference's hist calibration, not a full sample dump
+        NBINS = 2048
+        hists = {n: [np.zeros(NBINS, np.int64), 1e-8] for n in act_names}
         scope = self._scope or global_scope()
         with scope_guard(scope):
             for feed in self._batches():
@@ -212,7 +225,28 @@ class PostTrainingQuantization:
                     self._program, feed=feed, fetch_list=list(act_names)
                 )
                 for n, v in zip(act_names, outs):
-                    scales[n] = max(scales[n], float(np.max(np.abs(v))))
+                    a = np.abs(np.asarray(v)).reshape(-1)
+                    if not use_pct:
+                        scales[n] = max(scales[n], float(a.max(initial=0.0)))
+                        continue
+                    counts, rmax = hists[n]
+                    bmax = float(a.max(initial=0.0))
+                    while bmax > rmax:
+                        merged = counts[0::2] + counts[1::2]
+                        counts = np.concatenate(
+                            [merged, np.zeros(NBINS // 2, np.int64)])
+                        rmax *= 2.0
+                    counts += np.histogram(a, bins=NBINS,
+                                           range=(0.0, rmax))[0]
+                    hists[n] = [counts, rmax]
+        if use_pct:
+            for n, (counts, rmax) in hists.items():
+                total = counts.sum()
+                if total:
+                    cum = np.cumsum(counts)
+                    idx = int(np.searchsorted(
+                        cum, total * self._percentile / 100.0))
+                    scales[n] = (min(idx + 1, NBINS)) * rmax / NBINS
         return scales
 
     def quantize(self):

@@ -11,6 +11,7 @@ All update math runs in the accumulator dtype (fp32 master weights for AMP
 come from the amp layer keeping Param fp32).
 """
 
+import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_op
@@ -436,14 +437,19 @@ def _adam_sparse(ctx, ins, attrs):
     order = jnp.argsort(rows)
     r_s = jnp.take(rows, order)
     v_s = jnp.take(vals, order, axis=0)
-    csum = jnp.cumsum(v_s, axis=0)
-    last = jnp.searchsorted(r_s, r_s, side="right") - 1
-    first = jnp.searchsorted(r_s, r_s, side="left")
-    total_s = jnp.take(csum, last, axis=0) - jnp.where(
-        (first > 0)[:, None], jnp.take(csum, jnp.maximum(first - 1, 0),
-                                       axis=0), 0.0
-    )
-    merged = jnp.zeros_like(vals).at[order].set(total_s)  # occurrence order
+    if r_s.shape[0] == 0:
+        merged = vals  # empty sparse grad: nothing to merge
+    else:
+        # compact group index per occurrence (0,0,1,2,2,...), then exact
+        # per-group totals via segment_sum — no global running sum, so no
+        # cancellation for long Rows vectors
+        boundary = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             (r_s[1:] != r_s[:-1]).astype(jnp.int32)])
+        gid = jnp.cumsum(boundary)
+        totals = jax.ops.segment_sum(v_s, gid, num_segments=r_s.shape[0])
+        total_s = jnp.take(totals, gid, axis=0)
+        merged = jnp.zeros_like(vals).at[order].set(total_s)  # occ. order
 
     m1_r = jnp.take(m1, rows, axis=0)
     m2_r = jnp.take(m2, rows, axis=0)

@@ -404,10 +404,21 @@ def _tensor_array_write(ctx, ins, attrs):
     import jax
 
     idx = i.reshape(()).astype(jnp.int32)
-    return {"Out": [jax.lax.dynamic_update_slice(
+    out = jax.lax.dynamic_update_slice(
         arr, x[None].astype(arr.dtype),
         (idx,) + (jnp.int32(0),) * (arr.ndim - 1),
-    )]}
+    )
+    # a write past capacity-1 is CLAMPED (dynamic_update_slice semantics)
+    # where the reference grows the array; under FLAGS_check_nan_inf
+    # poison the overflowing write so the divergence is detectable instead
+    # of silently corrupting the last slot
+    from ..flags import get_flags
+
+    if (get_flags(["FLAGS_check_nan_inf"])["FLAGS_check_nan_inf"]
+            and jnp.issubdtype(out.dtype, jnp.floating)):
+        out = jnp.where(idx < arr.shape[0], out,
+                        jnp.full_like(out, jnp.nan))
+    return {"Out": [out]}
 
 
 @register_op("tensor_array_read", inputs=["Array", "I"], outputs=["Out"],

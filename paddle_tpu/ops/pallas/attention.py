@@ -37,6 +37,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# shapes already warned about falling back to the naive composition
+_FALLBACK_WARNED: set = set()
+
 
 def _pick_block(s):
     for b in (512, 256, 128):
@@ -392,8 +395,21 @@ def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
 
     bq, bk = _block_sizes(sq, sk)
     if bq is None or bk is None:
+        import warnings
+
         from ..attention import _naive_attention, _segment_bias
 
+        key = ("naive-fallback", sq, sk, d)
+        if key not in _FALLBACK_WARNED:
+            _FALLBACK_WARNED.add(key)
+            warnings.warn(
+                "flash_attention falling back to the O(S^2) naive path for "
+                "shape (Sq=%d, Sk=%d, D=%d): head dim must be a multiple "
+                "of 64 for the pallas kernel. This is a PERFORMANCE "
+                "fallback, not an error — pad the head dim to fix it."
+                % (sq_orig, sk_orig, d),
+                stacklevel=2,
+            )
         if segment_ids is not None:
             sb = _segment_bias(segment_ids)
             bias = sb if bias is None else bias + sb

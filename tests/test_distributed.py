@@ -176,3 +176,24 @@ def test_parallel_env_contract(monkeypatch):
     assert env.rank == 3
     assert env.world_size == 8
     assert len(env.trainer_endpoints) == 8
+
+
+def test_sharded_train_step_handles_changed_batch_shape():
+    """A batch with a different shape (e.g. the last partial batch) gets
+    its own compiled step with correct shardings instead of a stale
+    retrace against the first batch's in_shardings."""
+    cfg = models.BertConfig.tiny()
+    with dygraph.guard():
+        from paddle_tpu.fluid import framework as _fw
+
+        _fw._dygraph_tracer._base_key = jax.random.PRNGKey(7)
+        model = models.BertForPretraining(cfg)
+        opt = AdamOptimizer(learning_rate=1e-3)
+        mesh = dist.auto_mesh(8)
+        step = dist.ShardedTrainStep(model, opt, _bert_loss_fn, mesh)
+        state = step.init()
+        state, l1 = step(state, _bert_batch(cfg, 8, 16, seed=1))
+        state, l2 = step(state, _bert_batch(cfg, 4, 16, seed=2))  # smaller B
+        state, l3 = step(state, _bert_batch(cfg, 8, 16, seed=3))  # back
+        assert len(step._step_fns) == 2
+        assert all(np.isfinite(x) for x in (float(l1), float(l2), float(l3)))

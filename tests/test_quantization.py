@@ -187,3 +187,61 @@ def test_predictor_int8(tmp_path):
     assert any(op.type == "dequantize_linear"
                for op in p8._program.global_block.ops)
     np.testing.assert_allclose(o8, o32, rtol=0.05, atol=0.02)
+
+
+def test_post_training_quantization_percentile():
+    """percentile calibration ignores a huge injected outlier that would
+    blow up the abs_max scale."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[16, 8], append_batch_size=False)
+        y = layers.data("y", shape=[16, 1], append_batch_size=False)
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        infer = main.clone(for_test=True)
+        fluid.optimizer.AdamOptimizer(learning_rate=5e-3).minimize(loss)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe, _ = _train_tiny(main, startup, loss, ["x", "y"], steps=60)
+        yv = np.zeros((16, 1), np.float32)
+
+        def calib():
+            r = np.random.RandomState(13)
+            for i in range(4):
+                xb = r.randn(16, 8).astype(np.float32)
+                if i == 0:
+                    xb[0, 0] = 1e4  # single wild outlier
+                yield {"x": xb, "y": yv}
+
+        scales = {}
+        for algo in ("abs_max", "percentile"):
+            ptq = PostTrainingQuantization(
+                executor=exe, program=infer, feed_names=["x", "y"],
+                scope=scope, batch_generator=calib, algo=algo,
+                percentile=99.0)
+            ptq.quantize()
+            scales[algo] = ptq._act_scales if hasattr(
+                ptq, "_act_scales") else None
+        # behavioral check: percentile-calibrated program still close to
+        # fp32 on clean data; abs_max is poisoned by the outlier scale
+        rng = np.random.RandomState(7)
+        xv = rng.randn(16, 8).astype(np.float32)
+        (fp32_out,) = exe.run(infer, feed={"x": xv, "y": yv},
+                              fetch_list=[pred])
+        ptq_p = PostTrainingQuantization(
+            executor=exe, program=infer, feed_names=["x", "y"], scope=scope,
+            batch_generator=calib, algo="percentile", percentile=99.0)
+        qp = ptq_p.quantize()
+        (pct_out,) = exe.run(qp, feed={"x": xv, "y": yv}, fetch_list=[pred])
+        ptq_a = PostTrainingQuantization(
+            executor=exe, program=infer, feed_names=["x", "y"], scope=scope,
+            batch_generator=calib, algo="abs_max")
+        qa = ptq_a.quantize()
+        (amax_out,) = exe.run(qa, feed={"x": xv, "y": yv}, fetch_list=[pred])
+    err_p = np.abs(pct_out - fp32_out).mean()
+    err_a = np.abs(amax_out - fp32_out).mean()
+    assert err_p <= err_a + 1e-6
+    assert err_p < 0.1
