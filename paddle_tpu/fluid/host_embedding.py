@@ -1,0 +1,224 @@
+"""Host-offloaded sharded embedding tables (massive-sparse capability).
+
+Capability parity: reference `framework/fleet/fleet_wrapper.h:59-137`
+(PullSparseVarsSync / PushSparseVarsWithLabelAsync against the external
+pslib parameter server) driven by `framework/downpour_worker.cc` — tables
+larger than device memory live outside the accelerator; each step pulls
+only the touched rows and pushes their gradients.
+
+TPU-first redesign: the table lives in HOST RAM as a numpy array, row-
+sharded across processes (row r belongs to process r % nproc — the DCN
+shard layout).  Per step:
+
+  1. pull  — np.unique over the batch's ids, gather those rows from the
+             host shards, pad to a power-of-two bucket (bounded recompiles),
+             feed as a small dense `W@PULLED` [P, D] device array;
+  2. compute — the graph's lookup_table gathers from the PULLED table with
+             batch-local remapped ids; the backward produces a dense
+             [P, D] gradient (P is tiny vs the table);
+  3. push  — the host applies the optimizer update (sgd / adagrad, state
+             also host-resident) to exactly the touched rows.
+
+The device never sees more than the touched rows — the table can exceed
+HBM by orders of magnitude.  `layers.embedding(..., is_distributed=True)`
+builds this path automatically; drive steps through
+:class:`HostEmbeddingSession`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _bucket(n):
+    """Next power of two >= n (>=8): bounds the distinct PULLED shapes."""
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
+class HostEmbedding:
+    """One host-resident row-sharded table + its optimizer state."""
+
+    def __init__(self, name, num_rows, dim, dtype="float32",
+                 optimizer="adagrad", lr=0.05, init_scale=0.01, seed=0,
+                 epsilon=1e-6, padding_idx=None):
+        import jax
+
+        self.name = name
+        self.num_rows = int(num_rows)
+        self.dim = int(dim)
+        self.dtype = np.dtype(dtype)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.epsilon = float(epsilon)
+        self.nproc = jax.process_count()
+        self.rank = jax.process_index()
+        # padding row: always reads zeros, never updates (reference
+        # lookup_table padding_idx semantics carried into the host table)
+        self.padding_idx = (None if padding_idx is None
+                            else int(padding_idx) % self.num_rows)
+        # owned rows: r with r % nproc == rank, stored compactly at r//nproc
+        n_owned = (self.num_rows - self.rank + self.nproc - 1) // self.nproc
+        rs = np.random.RandomState(seed + self.rank)
+        self._rows = (init_scale * rs.randn(n_owned, self.dim)).astype(
+            self.dtype)
+        if optimizer == "adagrad":
+            self._accum = np.zeros((n_owned, self.dim), np.float32)
+        elif optimizer != "sgd":
+            raise ValueError("host optimizer must be sgd or adagrad")
+
+    # -- sharded row access ---------------------------------------------
+    def _gather_rows(self, uniq):
+        """uniq (sorted unique global row ids) -> [len(uniq), D].
+
+        Multi-process: every process owns rows r % nproc == rank; the
+        exchange all-gathers each rank's request and each rank's owned
+        responses (traffic = total pulled rows — the pslib pull RPC
+        without a transport layer)."""
+        if self.nproc == 1:
+            return self._rows[uniq]
+        from jax.experimental import multihost_utils
+
+        # 1 round: gather every rank's (padded) request list
+        P = _bucket(len(uniq))
+        req = np.full((P,), -1, np.int64)
+        req[: len(uniq)] = uniq
+        all_req = np.asarray(multihost_utils.process_allgather(req))
+        # answer what we own, for all requests
+        flat = all_req.reshape(-1)
+        mine = (flat >= 0) & (flat % self.nproc == self.rank)
+        ans = np.zeros((flat.shape[0], self.dim), self.dtype)
+        ans[mine] = self._rows[flat[mine] // self.nproc]
+        all_ans = np.asarray(multihost_utils.process_allgather(ans))
+        # rows for MY request: sum over the responder axis (only the owner
+        # wrote non-zero), slice my block
+        summed = all_ans.sum(axis=0).reshape(all_req.shape + (self.dim,))
+        return summed[self.rank][: len(uniq)]
+
+    # -- step API --------------------------------------------------------
+    def pull(self, ids):
+        """ids: int array [...] -> (pulled [P, D], local_ids like ids,
+        uniq).  local_ids index into pulled."""
+        ids = np.asarray(ids)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        if uniq.size and (uniq[0] < 0 or uniq[-1] >= self.num_rows):
+            raise IndexError(
+                "embedding id out of range [0, %d) in %s"
+                % (self.num_rows, self.name))
+        P = _bucket(max(len(uniq), 1))
+        pulled = np.zeros((P, self.dim), self.dtype)
+        if uniq.size:
+            pulled[: len(uniq)] = self._gather_rows(uniq)
+            if self.padding_idx is not None:
+                pulled[: len(uniq)][uniq == self.padding_idx] = 0
+        return pulled, inv.reshape(ids.shape).astype(np.int64), uniq
+
+    def push(self, uniq, grad_rows, lr=None):
+        """Apply the host-side optimizer to the touched rows.  grad_rows:
+        [len(uniq), D] dense gradient for the pulled rows."""
+        lr = self.lr if lr is None else float(lr)
+        uniq = np.asarray(uniq)
+        g = np.asarray(grad_rows, np.float32)[: len(uniq)]
+        own = uniq % self.nproc == self.rank
+        if self.nproc > 1:
+            # every rank computed the same grads for its batch only; sum
+            # contributions across ranks for shared rows
+            from jax.experimental import multihost_utils
+
+            # exchange (uniq, grad) pairs via the same gather trick
+            P = _bucket(len(uniq))
+            req = np.full((P,), -1, np.int64)
+            req[: len(uniq)] = uniq
+            gpad = np.zeros((P, self.dim), np.float32)
+            gpad[: len(uniq)] = g
+            all_req = np.asarray(multihost_utils.process_allgather(req))
+            all_g = np.asarray(multihost_utils.process_allgather(gpad))
+            flat = all_req.reshape(-1)
+            flatg = all_g.reshape(-1, self.dim)
+            mine = (flat >= 0) & (flat % self.nproc == self.rank)
+            uniq, g = flat[mine], flatg[mine]
+            # merge duplicate global rows
+            uniq, inv = np.unique(uniq, return_inverse=True)
+            merged = np.zeros((len(uniq), self.dim), np.float32)
+            np.add.at(merged, inv, g)
+            g = merged
+            own = np.ones(len(uniq), bool)
+        if self.padding_idx is not None:
+            own = own & (uniq != self.padding_idx)
+        local = uniq[own] // self.nproc
+        gl = g[own]
+        if self.optimizer == "adagrad":
+            self._accum[local] += gl * gl
+            self._rows[local] -= (
+                lr * gl / (np.sqrt(self._accum[local]) + self.epsilon)
+            ).astype(self.dtype)
+        else:  # sgd
+            self._rows[local] -= (lr * gl).astype(self.dtype)
+
+    # -- persistence (fleet SaveModel capability) ------------------------
+    def save(self, path):
+        np.savez(path, rows=self._rows,
+                 accum=getattr(self, "_accum", np.zeros(0)),
+                 meta=np.asarray([self.num_rows, self.dim, self.rank,
+                                  self.nproc]))
+
+    def load(self, path):
+        d = np.load(path if str(path).endswith(".npz") else str(path) + ".npz")
+        self._rows = d["rows"]
+        if self.optimizer == "adagrad" and d["accum"].size:
+            self._accum = d["accum"]
+
+
+class HostEmbeddingSession:
+    """Wraps Executor.run with the pull/compute/push cycle for every
+    HostEmbedding registered on the program (DownpourWorker parity:
+    `downpour_worker.cc` FillSparseValue -> train -> push_sparse)."""
+
+    def __init__(self, exe, program, loss=None):
+        self._exe = exe
+        self._program = program
+        self._tables = getattr(program, "_host_embeddings", {})
+        if not self._tables:
+            raise ValueError(
+                "program has no host embeddings; build one with "
+                "layers.embedding(..., is_distributed=True)")
+        # materialize grads of the pulled tables once (the param backward
+        # sweep does not necessarily produce them: PULLED is a data var)
+        self._grad_names = []
+        if loss is not None:
+            from . import framework
+            from .backward import gradients
+
+            block = program.global_block
+            pulled_vars = [
+                block.var(w + "@PULLED") for w in self._tables
+            ]
+            need = [
+                v for v in pulled_vars
+                if not block.has_var(v.name + framework.GRAD_SUFFIX)
+            ]
+            if need:
+                with framework.program_guard(program):
+                    gradients(loss, need)
+            self._grad_names = [
+                w + "@PULLED" + framework.GRAD_SUFFIX for w in self._tables
+            ]
+
+    def run(self, feed, fetch_list=None, lr=None, **kw):
+        fetch_list = list(fetch_list or [])
+        extra = {}
+        recs = []
+        for wname, (table, ids_slot) in self._tables.items():
+            pulled, local, uniq = table.pull(np.asarray(feed[ids_slot]))
+            extra[wname + "@PULLED"] = pulled
+            extra[ids_slot + "@LOCAL"] = local
+            recs.append((table, uniq))
+        outs = self._exe.run(
+            self._program, feed={**feed, **extra},
+            fetch_list=fetch_list + self._grad_names, **kw)
+        n = len(fetch_list)
+        for (table, uniq), g in zip(recs, outs[n:]):
+            table.push(uniq, g, lr=lr)
+        return outs[:n]

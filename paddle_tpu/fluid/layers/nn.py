@@ -63,6 +63,38 @@ def embedding(
     (Rows, Values) pair the optimizer applies as an O(N*D) scatter
     (backward.py _lookup_table_grad_maker; cf. `selected_rows.h:1`)."""
     helper = LayerHelper("embedding")
+    if is_distributed:
+        # massive-sparse capability (fleet_wrapper.h:86 PullSparseVarsSync):
+        # the table lives in host RAM; the graph sees only the per-batch
+        # pulled rows.  Requires driving steps via HostEmbeddingSession.
+        from ..host_embedding import HostEmbedding
+        from ..layer_helper import ParamAttr
+
+        attr = ParamAttr._to_attr(param_attr)
+        from .. import unique_name
+
+        w_name = (attr.name if attr and attr.name
+                  else unique_name.generate("host_embedding.w"))
+        main = helper.main_program
+        table = HostEmbedding(w_name, size[0], size[1], dtype=dtype,
+                              padding_idx=padding_idx)
+        block = main.global_block
+        pulled = block.create_var(
+            name=w_name + "@PULLED", shape=(-1, int(size[1])), dtype=dtype,
+            is_data=True, stop_gradient=False)
+        local = block.create_var(
+            name=input.name + "@LOCAL",
+            shape=tuple(input.shape) if input.shape else None,
+            dtype="int64", is_data=True, stop_gradient=True)
+        if not hasattr(main, "_host_embeddings"):
+            main._host_embeddings = {}
+        main._host_embeddings[w_name] = (table, input.name)
+        return append_simple_op(
+            "lookup_table",
+            {"W": pulled, "Ids": local},
+            {"padding_idx": -1, "is_sparse": False},
+            dtype=dtype,
+        )
     w = helper.create_parameter(param_attr, list(size), dtype=dtype)
     if padding_idx is None:
         pad = -1  # op-level sentinel: no padding row
@@ -414,3 +446,136 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None, act
         out_slots=("Y", "Mean", "Variance"),
     )
     return helper.append_activation(out, act)
+
+
+# ---------------------------------------------------------------------------
+# image / misc layer tail (reference layers/nn.py resize_*, pad2d, lrn,
+# maxout, row_conv, temporal_shift, shuffle_channel; metric_op.py auc)
+# ---------------------------------------------------------------------------
+
+
+def resize_bilinear(input, out_shape=None, scale=None, align_corners=True):
+    attrs = {"align_corners": align_corners}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    else:
+        attrs["scale"] = float(scale)
+    return append_simple_op("bilinear_interp", {"X": input}, attrs)
+
+
+def resize_nearest(input, out_shape=None, scale=None, align_corners=True):
+    attrs = {"align_corners": align_corners}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    else:
+        attrs["scale"] = float(scale)
+    return append_simple_op("nearest_interp", {"X": input}, attrs)
+
+
+def resize_linear(input, out_shape=None, scale=None, align_corners=True):
+    attrs = {"align_corners": align_corners}
+    if out_shape is not None:
+        attrs["out_w"] = int(out_shape[0])
+    else:
+        attrs["scale"] = float(scale)
+    return append_simple_op("linear_interp", {"X": input}, attrs)
+
+
+def resize_trilinear(input, out_shape=None, scale=None,
+                     align_corners=True):
+    attrs = {"align_corners": align_corners}
+    if out_shape is not None:
+        attrs["out_d"], attrs["out_h"], attrs["out_w"] = [
+            int(s) for s in out_shape]
+    else:
+        attrs["scale"] = float(scale)
+    return append_simple_op("trilinear_interp", {"X": input}, attrs)
+
+
+def resize_bicubic(input, out_shape=None, scale=None):
+    attrs = {}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    else:
+        attrs["scale"] = float(scale)
+    return append_simple_op("bicubic_interp", {"X": input}, attrs)
+
+
+def pad2d(input, paddings, mode="constant", pad_value=0.0):
+    return append_simple_op(
+        "pad2d", {"X": input},
+        {"paddings": list(paddings), "mode": mode, "pad_value": pad_value})
+
+
+def pad3d(input, paddings, mode="constant", value=0.0):
+    return append_simple_op(
+        "pad3d", {"X": input},
+        {"paddings": list(paddings), "mode": mode, "value": value})
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75):
+    return append_simple_op(
+        "lrn", {"X": input}, {"n": n, "k": k, "alpha": alpha, "beta": beta})
+
+
+def maxout(x, groups):
+    return append_simple_op("maxout", {"X": x}, {"groups": groups})
+
+
+def row_conv(input, future_context_size, seq_lens, param_attr=None):
+    helper = LayerHelper("row_conv")
+    f = helper.create_parameter(
+        param_attr, [future_context_size, int(input.shape[-1])],
+        dtype=input.dtype)
+    return append_simple_op(
+        "row_conv", {"X": input, "Filter": f, "SeqLens": seq_lens})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25):
+    return append_simple_op(
+        "temporal_shift", {"X": x},
+        {"seg_num": seg_num, "shift_ratio": shift_ratio})
+
+
+def shuffle_channel(x, group):
+    return append_simple_op("shuffle_channel", {"X": x}, {"group": group})
+
+
+def pixel_unshuffle(x, downscale_factor):
+    return append_simple_op("pixel_unshuffle", {"X": x},
+                            {"downscale_factor": downscale_factor})
+
+
+def auc(input, label, num_thresholds=4095, topk=1, slide_steps=1):
+    """cf. reference layers/metric_op.py auc: streaming AUC with
+    persistable histogram state."""
+    helper = LayerHelper("auc")
+    main = helper.main_program.global_block
+    startup = helper.startup_program.global_block
+    shape = [num_thresholds + 1]
+    names = []
+    for nm in ("auc_stat_pos", "auc_stat_neg"):
+        from .. import unique_name
+
+        vname = unique_name.generate(nm)
+        main.create_var(name=vname, shape=shape, dtype="float32",
+                        persistable=True, stop_gradient=True)
+        startup.create_var(name=vname, shape=shape, dtype="float32",
+                           persistable=True, stop_gradient=True)
+        startup.append_op(
+            "fill_constant", outputs={"Out": [vname]},
+            attrs={"shape": shape, "value": 0.0, "dtype": "float32"},
+            infer=False)
+        names.append(vname)
+    pos, neg = main.var(names[0]), main.var(names[1])
+    auc_out, pos_out, neg_out = append_simple_op(
+        "auc",
+        {"Predict": input, "Label": label, "StatPos": pos, "StatNeg": neg},
+        {}, out_slots=("AUC", "StatPosOut", "StatNegOut"),
+        dtype="float32", stop_gradient=True)
+    # thread accumulated state back into the persistable vars
+    helper.main_program.current_block().append_op(
+        "assign", inputs={"X": [pos_out.name]}, outputs={"Out": [names[0]]})
+    helper.main_program.current_block().append_op(
+        "assign", inputs={"X": [neg_out.name]}, outputs={"Out": [names[1]]})
+    return auc_out, [pos_out, neg_out]
