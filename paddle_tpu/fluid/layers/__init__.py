@@ -33,6 +33,7 @@ from .learning_rate_scheduler import (  # noqa: F401
     piecewise_decay,
     polynomial_decay,
 )
+from . import detection  # noqa: F401
 from .crf import (  # noqa: F401
     chunk_eval,
     crf_decoding,

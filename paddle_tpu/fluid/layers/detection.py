@@ -1,0 +1,171 @@
+"""Detection layer API (cf. reference python/paddle/fluid/layers/
+detection.py): thin wrappers over the registered detection ops."""
+
+from .common import append_simple_op
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=None, flip=False, clip=False, steps=None,
+              offset=0.5):
+    return append_simple_op(
+        "prior_box", {"Input": input, "Image": image},
+        {"min_sizes": list(min_sizes), "max_sizes": list(max_sizes or []),
+         "aspect_ratios": list(aspect_ratios or [1.0]),
+         "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+         "flip": flip, "clip": clip,
+         "step_w": (steps or [0, 0])[0], "step_h": (steps or [0, 0])[1],
+         "offset": offset},
+        out_slots=("Boxes", "Variances"), stop_gradient=True)
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=None, clip=False, steps=None, offset=0.5):
+    return append_simple_op(
+        "density_prior_box", {"Input": input, "Image": image},
+        {"densities": list(densities), "fixed_sizes": list(fixed_sizes),
+         "fixed_ratios": list(fixed_ratios),
+         "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+         "clip": clip, "step_w": (steps or [0, 0])[0],
+         "step_h": (steps or [0, 0])[1], "offset": offset},
+        out_slots=("Boxes", "Variances"), stop_gradient=True)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0):
+    return append_simple_op(
+        "box_coder",
+        {"PriorBox": prior_box, "PriorBoxVar": prior_box_var,
+         "TargetBox": target_box},
+        {"code_type": code_type, "box_normalized": box_normalized,
+         "axis": axis},
+        out_slots=("OutputBox",))
+
+
+def iou_similarity(x, y, box_normalized=True):
+    return append_simple_op("iou_similarity", {"X": x, "Y": y},
+                            {"box_normalized": box_normalized})
+
+
+def box_clip(input, im_info):
+    return append_simple_op("box_clip",
+                            {"Input": input, "ImInfo": im_info},
+                            out_slots=("Output",))
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variance=None,
+                     stride=None, offset=0.5):
+    return append_simple_op(
+        "anchor_generator", {"Input": input},
+        {"anchor_sizes": list(anchor_sizes),
+         "aspect_ratios": list(aspect_ratios),
+         "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+         "stride": list(stride or [16.0, 16.0]), "offset": offset},
+        out_slots=("Anchors", "Variances"), stop_gradient=True)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio):
+    return append_simple_op(
+        "yolo_box", {"X": x, "ImgSize": img_size},
+        {"anchors": list(anchors), "class_num": class_num,
+         "conf_thresh": conf_thresh,
+         "downsample_ratio": downsample_ratio},
+        out_slots=("Boxes", "Scores"))
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
+                   keep_top_k, nms_threshold=0.3, background_label=0):
+    return append_simple_op(
+        "multiclass_nms", {"BBoxes": bboxes, "Scores": scores},
+        {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+         "background_label": background_label},
+        stop_gradient=True)
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1):
+    return append_simple_op(
+        "roi_align", {"X": input, "ROIs": rois},
+        {"pooled_height": pooled_height, "pooled_width": pooled_width,
+         "spatial_scale": spatial_scale,
+         "sampling_ratio": sampling_ratio})
+
+
+def bipartite_match(dist_matrix):
+    return append_simple_op(
+        "bipartite_match", {"DistMat": dist_matrix},
+        out_slots=("ColToRowMatchIndices", "ColToRowMatchDist"),
+        dtype="int64", stop_gradient=True)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1):
+    return append_simple_op(
+        "generate_proposals",
+        {"Scores": scores, "BboxDeltas": bbox_deltas, "ImInfo": im_info,
+         "Anchors": anchors, "Variances": variances},
+        {"pre_nms_topN": pre_nms_top_n, "post_nms_topN": post_nms_top_n,
+         "nms_thresh": nms_thresh, "min_size": min_size},
+        out_slots=("RpnRois", "RpnRoiProbs"), stop_gradient=True)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale):
+    return append_simple_op(
+        "distribute_fpn_proposals", {"FpnRois": fpn_rois},
+        {"min_level": min_level, "max_level": max_level,
+         "refer_level": refer_level, "refer_scale": refer_scale},
+        out_slots=("MultiFpnRois", "RestoreIndex", "LevelIds"),
+        stop_gradient=True)
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n):
+    return append_simple_op(
+        "collect_fpn_proposals",
+        {"MultiLevelRois": multi_rois, "MultiLevelScores": multi_scores},
+        {"post_nms_topN": post_nms_top_n},
+        out_slots=("FpnRois",), stop_gradient=True)
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    return append_simple_op(
+        "sigmoid_focal_loss", {"X": x, "Label": label, "FgNum": fg_num},
+        {"gamma": gamma, "alpha": alpha})
+
+
+def polygon_box_transform(input):
+    return append_simple_op("polygon_box_transform", {"Input": input},
+                            out_slots=("Output",))
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip_value=4.135):
+    return append_simple_op(
+        "box_decoder_and_assign",
+        {"PriorBox": prior_box, "PriorBoxVar": prior_box_var,
+         "TargetBox": target_box, "BoxScore": box_score},
+        {"box_clip": box_clip_value},
+        out_slots=("DecodeBox", "OutputAssignBox"))
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0.0):
+    ins = {"X": input, "MatchIndices": matched_indices}
+    if negative_indices is not None:
+        ins["NegIndices"] = negative_indices
+    return append_simple_op(
+        "target_assign", ins, {"mismatch_value": mismatch_value},
+        out_slots=("Out", "OutWeight"))
+
+
+def detection_map(detect_res, label, class_num, overlap_threshold=0.5,
+                  ap_version="integral"):
+    return append_simple_op(
+        "detection_map", {"DetectRes": detect_res, "Label": label},
+        {"class_num": class_num, "overlap_threshold": overlap_threshold,
+         "ap_type": ap_version},
+        out_slots=("MAP",), dtype="float32", stop_gradient=True)

@@ -57,3 +57,47 @@ def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, normalize=Fal
 
         out = out / reduce_sum(out)
     return out
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    return append_simple_op("dice_loss", {"X": input, "Label": label},
+                            {"epsilon": epsilon})
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    return append_simple_op(
+        "npair_loss",
+        {"Anchor": anchor, "Positive": positive, "Labels": labels},
+        {"l2_reg": l2_reg})
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    from ..layer_helper import LayerHelper
+    from .tensor import fill_constant
+
+    helper = LayerHelper("center_loss")
+    centers = helper.create_parameter(
+        param_attr, [num_classes, int(input.shape[-1])], dtype=input.dtype)
+    centers.stop_gradient = True
+    rate = fill_constant([1], "float32", float(alpha))
+    loss, _diff, centers_out = append_simple_op(
+        "center_loss",
+        {"X": input, "Label": label, "Centers": centers,
+         "CenterUpdateRate": rate},
+        {"need_update": bool(update_center)},
+        out_slots=("Loss", "SampleCenterDiff", "CentersOut"))
+    if update_center:
+        helper.main_program.current_block().append_op(
+            "assign", inputs={"X": [centers_out.name]},
+            outputs={"Out": [centers.name]})
+    return loss
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    return append_simple_op(
+        "teacher_student_sigmoid_loss", {"X": input, "Label": label},
+        {"soft_max_up_bound": soft_max_up_bound,
+         "soft_max_lower_bound": soft_max_lower_bound},
+        out_slots=("Y",))

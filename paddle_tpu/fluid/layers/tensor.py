@@ -314,3 +314,43 @@ def Print(input, first_n=-1, message="", summarize=20,
         {"message": msg, "summarize": summarize,
          "print_tensor_shape": print_tensor_shape},
     )
+
+
+def crop_tensor(x, shape, offsets=None):
+    return append_simple_op(
+        "crop_tensor", {"X": x},
+        {"shape": list(shape), "offsets": list(offsets or [])})
+
+
+def unbind(input, axis=0):
+    n = int(input.shape[axis])
+    return append_simple_op("unbind", {"X": input}, {"axis": axis},
+                            n_outs={"Out": n})
+
+
+def size(input):
+    return append_simple_op("size", {"Input": input}, dtype="int64",
+                            stop_gradient=True)
+
+
+def gather_tree(ids, parents):
+    return append_simple_op("gather_tree",
+                            {"Ids": ids, "Parents": parents},
+                            dtype="int64", stop_gradient=True)
+
+
+def masked_fill(x, mask, value):
+    return append_simple_op("masked_fill", {"X": x, "Mask": mask},
+                            {"value": float(value)})
+
+
+def partial_sum(input, start_index=0, length=-1):
+    return append_simple_op(
+        "partial_sum", {"X": input},
+        {"start_index": start_index, "length": length})
+
+
+def partial_concat(input, start_index=0, length=-1):
+    return append_simple_op(
+        "partial_concat", {"X": input},
+        {"start_index": start_index, "length": length})

@@ -152,3 +152,87 @@ class CompositeMetric(MetricBase):
 
     def eval(self):
         return [m.eval() for m in self._metrics]
+
+
+class ChunkEvaluator(MetricBase):
+    """cf. reference metrics.py ChunkEvaluator: accumulates the chunk_eval
+    op's (num_infer, num_label, num_correct) counts across batches and
+    reports (precision, recall, f1)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
+        self.num_correct_chunks += int(
+            np.asarray(num_correct_chunks).sum())
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    """cf. reference metrics.py EditDistance: mean edit distance over all
+    evaluated sequences + ratio of exactly-matched instances."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        d = np.asarray(distances, np.float64).reshape(-1)
+        self.total_distance += float(d.sum())
+        self.seq_num += int(np.asarray(seq_num).sum())
+        self.instance_error += int((d > 0).sum())
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError(
+                "There is no data in EditDistance Metric. Please check "
+                "layers.edit_distance output has been added to "
+                "EditDistance.")
+        avg_distance = self.total_distance / self.seq_num
+        avg_instance_error = self.instance_error / float(self.seq_num)
+        return avg_distance, avg_instance_error
+
+
+class DetectionMAP(MetricBase):
+    """cf. reference metrics.py DetectionMAP: accumulates the
+    detection_map op's per-batch mAP (host-side average — the op computes
+    a full matching per batch on device)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self._total = 0.0
+        self._count = 0
+
+    def update(self, value, weight=1):
+        self._total += float(np.asarray(value).sum()) * weight
+        self._count += weight
+
+    def eval(self):
+        if self._count == 0:
+            raise ValueError("DetectionMAP has no accumulated batches")
+        return self._total / self._count

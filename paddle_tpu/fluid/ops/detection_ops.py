@@ -19,6 +19,7 @@ TPU-first notes:
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.registry import register_op
 
@@ -381,3 +382,255 @@ def _bipartite_match(ctx, ins, attrs):
         "ColToRowMatchIndices": [cols[None, :]],
         "ColToRowMatchDist": [col_dist[None, :]],
     }
+
+
+# ---------------------------------------------------------------------------
+# RPN / FPN tail (reference generate_proposals_op.cc,
+# distribute_fpn_proposals_op.cc, collect_fpn_proposals_op.cc,
+# density_prior_box_op.cc, sigmoid_focal_loss_op.cc,
+# polygon_box_transform_op.cc, box_decoder_and_assign_op.cc,
+# target_assign_op.cc).  Static-shape conventions as above: fixed top-N
+# buffers with score/validity sentinels instead of LoD-compacted outputs.
+# ---------------------------------------------------------------------------
+
+
+def _decode_bbox(anchors, deltas, variances=None):
+    """anchor-relative (dx,dy,dw,dh) -> corner boxes."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + aw * 0.5
+    ay = anchors[:, 1] + ah * 0.5
+    v = variances if variances is not None else jnp.ones_like(deltas)
+    dx, dy, dw, dh = (deltas * v).T
+    cx = dx * aw + ax
+    cy = dy * ah + ay
+    w = jnp.exp(jnp.minimum(dw, 10.0)) * aw
+    h = jnp.exp(jnp.minimum(dh, 10.0)) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2,
+                      cx + w / 2 - 1, cy + h / 2 - 1], axis=1)
+
+
+@register_op("generate_proposals",
+             inputs=["Scores", "BboxDeltas", "ImInfo", "Anchors",
+                     "Variances"],
+             outputs=["RpnRois", "RpnRoiProbs"], grad=None)
+def _generate_proposals(ctx, ins, attrs):
+    """cf. generate_proposals_op.cc: top-pre_nms scores -> decode -> clip
+    -> filter small -> NMS -> top post_nms.  Output is a FIXED
+    [N, post_nms_topN, 4] roi buffer + [N, post_nms_topN] scores (zero
+    score marks an empty slot)."""
+    scores = ins["Scores"][0]       # [N, A, H, W]
+    deltas = ins["BboxDeltas"][0]   # [N, A*4, H, W]
+    im_info = ins["ImInfo"][0]      # [N, 3] (h, w, scale)
+    anchors = ins["Anchors"][0].reshape(-1, 4)
+    variances = ins["Variances"][0].reshape(-1, 4) \
+        if ins.get("Variances") else None
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thr = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.0))
+    N, A, H, W = scores.shape
+    K = A * H * W
+    pre_n = min(pre_n, K)
+    post_n = min(post_n, pre_n)
+
+    sc = scores.reshape(N, K)
+    dl = deltas.reshape(N, A, 4, H, W).transpose(0, 1, 3, 4, 2).reshape(
+        N, K, 4)
+
+    def per_image(s, d, info):
+        boxes = _decode_bbox(anchors, d, variances)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1)], axis=1)
+        ws = boxes[:, 2] - boxes[:, 0] + 1
+        hs = boxes[:, 3] - boxes[:, 1] + 1
+        keep = (ws >= min_size * info[2]) & (hs >= min_size * info[2])
+        s = jnp.where(keep, s, -jnp.inf)
+        top_s, top_i = jax.lax.top_k(s, pre_n)
+        top_b = boxes[top_i]
+        # O(K^2) mask NMS over the pre_n candidates (score-descending)
+        iou = _pairwise_iou(top_b, top_b)
+        supp = jnp.zeros(pre_n, bool)
+
+        def body(i, supp):
+            kill = (iou[i] > nms_thr) & (jnp.arange(pre_n) > i) & ~supp[i]
+            return supp | kill
+
+        supp = jax.lax.fori_loop(0, pre_n, body, supp)
+        final_s = jnp.where(supp | (top_s == -jnp.inf), -jnp.inf, top_s)
+        out_s, oi = jax.lax.top_k(final_s, post_n)
+        out_b = top_b[oi]
+        valid = out_s > -jnp.inf
+        return (jnp.where(valid[:, None], out_b, 0),
+                jnp.where(valid, out_s, 0))
+
+    rois, probs = jax.vmap(per_image)(sc, dl, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs]}
+
+
+@register_op("distribute_fpn_proposals",
+             inputs=["FpnRois", "RoisNum"],
+             outputs=["MultiFpnRois", "RestoreIndex", "LevelIds"],
+             grad=None)
+def _distribute_fpn_proposals(ctx, ins, attrs):
+    """cf. distribute_fpn_proposals_op.cc.  Static redesign: instead of L
+    ragged per-level outputs, emit [R] level ids + the level-sorted roi
+    buffer [R, 4] + RestoreIndex mapping sorted order back to input order
+    (the consumer slices per level with the ids)."""
+    rois = ins["FpnRois"][0]        # [R, 4]
+    min_l = int(attrs["min_level"])
+    max_l = int(attrs["max_level"])
+    s0 = float(attrs.get("refer_scale", 224))
+    l0 = int(attrs.get("refer_level", 4))
+    w = jnp.clip(rois[:, 2] - rois[:, 0], 0)
+    h = jnp.clip(rois[:, 3] - rois[:, 1], 0)
+    scale = jnp.sqrt(w * h)
+    lvl = jnp.floor(l0 + jnp.log2(scale / s0 + 1e-8)).astype(jnp.int32)
+    lvl = jnp.clip(lvl, min_l, max_l)
+    order = jnp.argsort(lvl, stable=True)
+    restore = jnp.argsort(order, stable=True)
+    return {"MultiFpnRois": [rois[order]],
+            "RestoreIndex": [restore.astype(jnp.int64)[:, None]],
+            "LevelIds": [lvl[order].astype(jnp.int64)]}
+
+
+@register_op("collect_fpn_proposals",
+             inputs=["MultiLevelRois", "MultiLevelScores"],
+             outputs=["FpnRois"], grad=None)
+def _collect_fpn_proposals(ctx, ins, attrs):
+    """cf. collect_fpn_proposals_op.cc: concat per-level rois, keep the
+    post_nms_topN best by score (fixed-size output)."""
+    rois = jnp.concatenate(ins["MultiLevelRois"], axis=0)
+    scores = jnp.concatenate(
+        [s.reshape(-1) for s in ins["MultiLevelScores"]], axis=0)
+    n = min(int(attrs.get("post_nms_topN", 1000)), scores.shape[0])
+    top_s, idx = jax.lax.top_k(scores, n)
+    return {"FpnRois": [rois[idx]]}
+
+
+@register_op("density_prior_box", inputs=["Input", "Image"],
+             outputs=["Boxes", "Variances"], grad=None)
+def _density_prior_box(ctx, ins, attrs):
+    """cf. density_prior_box_op.cc (SSD-style dense anchor lattice)."""
+    feat = ins["Input"][0]
+    img = ins["Image"][0]
+    H, W = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    fixed_sizes = attrs["fixed_sizes"]
+    fixed_ratios = attrs["fixed_ratios"]
+    densities = attrs["densities"]
+    step_w = float(attrs.get("step_w", 0.0)) or img_w / W
+    step_h = float(attrs.get("step_h", 0.0)) or img_h / H
+    offset = float(attrs.get("offset", 0.5))
+    var = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+
+    boxes = []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            step = size / density
+            for di in range(density):
+                for dj in range(density):
+                    cx_off = (dj + 0.5) * step - size / 2
+                    cy_off = (di + 0.5) * step - size / 2
+                    boxes.append((cx_off, cy_off, bw, bh))
+    xs = (jnp.arange(W) + offset) * step_w
+    ys = (jnp.arange(H) + offset) * step_h
+    cx, cy = jnp.meshgrid(xs, ys)          # [H, W]
+    out = []
+    for cx_off, cy_off, bw, bh in boxes:
+        bx = cx + cx_off
+        by = cy + cy_off
+        out.append(jnp.stack([
+            (bx - bw / 2) / img_w, (by - bh / 2) / img_h,
+            (bx + bw / 2) / img_w, (by + bh / 2) / img_h], axis=-1))
+    prior = jnp.stack(out, axis=2)          # [H, W, P, 4]
+    prior = jnp.clip(prior, 0.0, 1.0)
+    variances = jnp.broadcast_to(jnp.asarray(var, jnp.float32),
+                                 prior.shape)
+    return {"Boxes": [prior], "Variances": [variances]}
+
+
+@register_op("sigmoid_focal_loss", inputs=["X", "Label", "FgNum"],
+             outputs=["Out"], no_grad_slots=("Label", "FgNum"))
+def _sigmoid_focal_loss(ctx, ins, attrs):
+    """cf. sigmoid_focal_loss_op.cc (RetinaNet): FL = -alpha_t (1-p_t)^g
+    log(p_t) per (sample, class), labels 1..C (0 = background)."""
+    x = ins["X"][0]                 # [N, C]
+    label = ins["Label"][0].reshape(-1)
+    fg = ins["FgNum"][0].reshape(-1)[0].astype(jnp.float32)
+    gamma = float(attrs.get("gamma", 2.0))
+    alpha = float(attrs.get("alpha", 0.25))
+    C = x.shape[1]
+    # target[n, c] = 1 iff label[n] == c+1
+    t = (label[:, None] == (jnp.arange(C) + 1)[None, :]).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    ce = -(t * jax.nn.log_sigmoid(x) + (1 - t) * jax.nn.log_sigmoid(-x))
+    p_t = t * p + (1 - t) * (1 - p)
+    a_t = t * alpha + (1 - t) * (1 - alpha)
+    loss = a_t * (1 - p_t) ** gamma * ce / jnp.maximum(fg, 1.0)
+    return {"Out": [loss]}
+
+
+@register_op("polygon_box_transform", inputs=["Input"], outputs=["Output"],
+             grad=None)
+def _polygon_box_transform(ctx, ins, attrs):
+    """cf. polygon_box_transform_op.cc (EAST text detection): offset
+    channels -> absolute vertex coordinates at 4x resolution."""
+    x = ins["Input"][0]             # [N, 2K, H, W]
+    n, c, h, w = x.shape
+    xs = jnp.arange(w, dtype=x.dtype) * 4.0
+    ys = jnp.arange(h, dtype=x.dtype) * 4.0
+    grid_x = jnp.broadcast_to(xs[None, :], (h, w))
+    grid_y = jnp.broadcast_to(ys[:, None], (h, w))
+    base = jnp.stack([grid_x, grid_y], axis=0)      # [2, H, W]
+    base = jnp.tile(base, (c // 2, 1, 1))           # [2K, H, W]
+    return {"Output": [base[None] - x]}
+
+
+@register_op("box_decoder_and_assign",
+             inputs=["PriorBox", "PriorBoxVar", "TargetBox", "BoxScore"],
+             outputs=["DecodeBox", "OutputAssignBox"], grad=None)
+def _box_decoder_and_assign(ctx, ins, attrs):
+    """cf. box_decoder_and_assign_op.cc: decode per-class deltas, assign
+    each roi its argmax-class box."""
+    prior = ins["PriorBox"][0]      # [R, 4]
+    pvar = ins["PriorBoxVar"][0]    # [R, 4]
+    target = ins["TargetBox"][0]    # [R, C*4]
+    score = ins["BoxScore"][0]      # [R, C]
+    R, C4 = target.shape
+    C = C4 // 4
+    per_class = target.reshape(R, C, 4)
+    decoded = jax.vmap(
+        lambda t: _decode_bbox(prior, t, pvar),
+        in_axes=1, out_axes=1)(per_class)       # [R, C, 4]
+    best = jnp.argmax(score, axis=1)
+    assign = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, 2), axis=1)[:, 0]
+    return {"DecodeBox": [decoded.reshape(R, C4)],
+            "OutputAssignBox": [assign]}
+
+
+@register_op("target_assign",
+             inputs=["X", "MatchIndices", "NegIndices"],
+             outputs=["Out", "OutWeight"], grad=None)
+def _target_assign(ctx, ins, attrs):
+    """cf. target_assign_op.cc: scatter per-gt rows onto matched priors;
+    unmatched rows get `mismatch_value` with weight 0 (negatives weight
+    1 via NegIndices mask)."""
+    x = ins["X"][0]                 # [N, G, K]
+    match = ins["MatchIndices"][0]  # [N, P] (-1 = unmatched)
+    mismatch = float(attrs.get("mismatch_value", 0.0))
+    safe = jnp.maximum(match, 0)
+    out = jax.vmap(lambda xb, mb: xb[mb])(x, safe)      # [N, P, K]
+    matched = (match >= 0)[..., None]
+    out = jnp.where(matched, out, mismatch)
+    weight = matched.astype(jnp.float32)
+    if ins.get("NegIndices"):
+        neg = ins["NegIndices"][0]  # [N, P] 0/1 mask of negatives
+        weight = jnp.maximum(weight, neg[..., None].astype(jnp.float32))
+    return {"Out": [out], "OutWeight": [weight]}

@@ -628,3 +628,181 @@ def _pool3d(ctx, ins, attrs):
             x, 0.0, jax.lax.add, dims, strides, pads
         ) / float(ksize[0] * ksize[1] * ksize[2])
     return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# interpolate tail (reference interpolate_op.cc trilinear/bicubic/linear
+# modes), pad2d/pad3d, channel utilities
+# ---------------------------------------------------------------------------
+
+
+@register_op("linear_interp", inputs=["X"], outputs=["Out"])
+def _linear_interp(ctx, ins, attrs):
+    x = ins["X"][0]  # NCW
+    ow = int(attrs.get("out_w", 0)) or int(x.shape[2] * attrs["scale"])
+    n, c, w = x.shape
+    if attrs.get("align_corners", True) and ow > 1:
+        xs = jnp.linspace(0, w - 1, ow)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wx = (xs - x0)[None, None, :]
+        return {"Out": [x[:, :, x0] * (1 - wx) + x[:, :, x1] * wx]}
+    return {"Out": [jax.image.resize(x, (n, c, ow), method="linear")]}
+
+
+@register_op("trilinear_interp", inputs=["X"], outputs=["Out"])
+def _trilinear_interp(ctx, ins, attrs):
+    x = ins["X"][0]  # NCDHW
+    n, c, d, h, w = x.shape
+    od = int(attrs.get("out_d", 0)) or int(d * attrs["scale"])
+    oh = int(attrs.get("out_h", 0)) or int(h * attrs["scale"])
+    ow = int(attrs.get("out_w", 0)) or int(w * attrs["scale"])
+    if attrs.get("align_corners", True) and min(od, oh, ow) > 1:
+        # corner-aligned separable linear resample per axis
+        def axis_ids(sz, out):
+            s = jnp.linspace(0, sz - 1, out)
+            i0 = jnp.floor(s).astype(jnp.int32)
+            return i0, jnp.minimum(i0 + 1, sz - 1), s - i0
+
+        d0, d1, wd = axis_ids(d, od)
+        h0, h1, wh = axis_ids(h, oh)
+        w0, w1, ww = axis_ids(w, ow)
+        wd = wd[:, None, None]
+        wh = wh[None, :, None]
+        ww = ww[None, None, :]
+
+        def g(di, hi, wi):
+            return x[:, :, di][:, :, :, hi][:, :, :, :, wi]
+
+        out = (
+            g(d0, h0, w0) * (1 - wd) * (1 - wh) * (1 - ww)
+            + g(d0, h0, w1) * (1 - wd) * (1 - wh) * ww
+            + g(d0, h1, w0) * (1 - wd) * wh * (1 - ww)
+            + g(d0, h1, w1) * (1 - wd) * wh * ww
+            + g(d1, h0, w0) * wd * (1 - wh) * (1 - ww)
+            + g(d1, h0, w1) * wd * (1 - wh) * ww
+            + g(d1, h1, w0) * wd * wh * (1 - ww)
+            + g(d1, h1, w1) * wd * wh * ww
+        )
+        return {"Out": [out]}
+    return {"Out": [jax.image.resize(
+        x, (n, c, od, oh, ow), method="trilinear")]}
+
+
+@register_op("bicubic_interp", inputs=["X"], outputs=["Out"])
+def _bicubic_interp(ctx, ins, attrs):
+    x = ins["X"][0]
+    n, c, h, w = x.shape
+    oh = int(attrs.get("out_h", 0)) or int(h * attrs["scale"])
+    ow = int(attrs.get("out_w", 0)) or int(w * attrs["scale"])
+    # half-pixel bicubic (jax.image cubic = Keys kernel, the reference's
+    # align_corners=False default path)
+    return {"Out": [jax.image.resize(x, (n, c, oh, ow), method="cubic")]}
+
+
+@register_op("pad2d", inputs=["X"], outputs=["Out"])
+def _pad2d(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    cfg = ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3]))
+    if mode == "constant":
+        return {"Out": [jnp.pad(x, cfg, constant_values=float(
+            attrs.get("pad_value", 0.0)))]}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": [jnp.pad(x, cfg, mode=jmode)]}
+
+
+@register_op("pad3d", inputs=["X"], outputs=["Out"])
+def _pad3d(ctx, ins, attrs):
+    x = ins["X"][0]  # NCDHW
+    p = attrs["paddings"]  # [front, back, top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    cfg = ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3]), (p[4], p[5]))
+    if mode == "constant":
+        return {"Out": [jnp.pad(x, cfg, constant_values=float(
+            attrs.get("value", 0.0)))]}
+    jmode = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return {"Out": [jnp.pad(x, cfg, mode=jmode)]}
+
+
+@register_op("pixel_unshuffle", inputs=["X"], outputs=["Out"])
+def _pixel_unshuffle(ctx, ins, attrs):
+    x = ins["X"][0]
+    r = int(attrs["downscale_factor"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return {"Out": [x.reshape(n, c * r * r, h // r, w // r)]}
+
+
+@register_op("shuffle_channel", inputs=["X"], outputs=["Out"])
+def _shuffle_channel(ctx, ins, attrs):
+    x = ins["X"][0]
+    g = int(attrs["group"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, g, c // g, h, w)
+    return {"Out": [jnp.transpose(x, (0, 2, 1, 3, 4)).reshape(n, c, h, w)]}
+
+
+@register_op("temporal_shift", inputs=["X"], outputs=["Out"])
+def _temporal_shift(ctx, ins, attrs):
+    """cf. temporal_shift_op.cc: shift 1/fold of channels one step back,
+    1/fold one step forward along the segment (time) dim."""
+    x = ins["X"][0]  # [N*T, C, H, W]
+    t = int(attrs["seg_num"])
+    frac = float(attrs.get("shift_ratio", 0.25))
+    nt, c, h, w = x.shape
+    n = nt // t
+    x = x.reshape(n, t, c, h, w)
+    c1 = int(c * frac)
+    c2 = int(c * 2 * frac)
+    back = jnp.concatenate(
+        [x[:, 1:, :c1], jnp.zeros((n, 1, c1, h, w), x.dtype)], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros((n, 1, c2 - c1, h, w), x.dtype), x[:, :-1, c1:c2]],
+        axis=1)
+    out = jnp.concatenate([back, fwd, x[:, :, c2:]], axis=2)
+    return {"Out": [out.reshape(nt, c, h, w)]}
+
+
+@register_op("lrn", inputs=["X"], outputs=["Out"])
+def _lrn(ctx, ins, attrs):
+    """cf. lrn_op.cc: local response normalization across channels."""
+    x = ins["X"][0]
+    n_size = int(attrs.get("n", 5))
+    k = float(attrs.get("k", 2.0))
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    sq = x * x
+    half = n_size // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    den = sum(
+        pad[:, i:i + x.shape[1]] for i in range(n_size)
+    )
+    return {"Out": [x / (k + alpha * den) ** beta]}
+
+
+@register_op("maxout", inputs=["X"], outputs=["Out"])
+def _maxout(ctx, ins, attrs):
+    x = ins["X"][0]
+    g = int(attrs["groups"])
+    n, c, h, w = x.shape
+    return {"Out": [x.reshape(n, c // g, g, h, w).max(axis=2)]}
+
+
+@register_op("row_conv", inputs=["X", "Filter", "SeqLens"],
+             outputs=["Out"], no_grad_slots=("SeqLens",))
+def _row_conv(ctx, ins, attrs):
+    """cf. row_conv_op.cc (lookahead conv for deep speech): out[t] =
+    sum_{i<future} x[t+i] * filter[i], masked past each sequence end."""
+    x, f = ins["X"][0], ins["Filter"][0]  # [B, T, D], [K, D]
+    lens = ins["SeqLens"][0]
+    K = f.shape[0]
+    B, T, D = x.shape
+    mask = (jnp.arange(T)[None, :] < lens[:, None])[..., None]
+    xm = jnp.where(mask, x, 0)
+    pad = jnp.pad(xm, ((0, 0), (0, K - 1), (0, 0)))
+    out = sum(pad[:, i:i + T] * f[i][None, None, :] for i in range(K))
+    return {"Out": [jnp.where(mask, out, 0)]}

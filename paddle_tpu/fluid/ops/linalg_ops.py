@@ -147,3 +147,100 @@ def _bincount(ctx, ins, attrs):
 def _index_sample(ctx, ins, attrs):
     x, idx = ins["X"][0], ins["Index"][0]
     return {"Out": [jnp.take_along_axis(x, idx.astype(jnp.int32), axis=1)]}
+
+
+# ---------------------------------------------------------------------------
+# decompositions / solvers (reference qr_op.cc, svd_op.cc, eigh_op.cc,
+# determinant_op.cc, solve/lstsq in the 2.x tree — XLA supplies the
+# factorization kernels the reference bound to cuSOLVER)
+# ---------------------------------------------------------------------------
+
+
+@register_op("qr", inputs=["X"], outputs=["Q", "R"], grad=None)
+def _qr(ctx, ins, attrs):
+    mode = attrs.get("mode", "reduced")
+    out = jnp.linalg.qr(ins["X"][0], mode=mode)
+    if mode == "r":  # single-array return; Q slot gets an empty sentinel
+        return {"Q": [jnp.zeros((0, 0), out.dtype)], "R": [out]}
+    return {"Q": [out[0]], "R": [out[1]]}
+
+
+@register_op("svd", inputs=["X"], outputs=["U", "S", "VH"], grad=None)
+def _svd(ctx, ins, attrs):
+    u, s, vh = jnp.linalg.svd(
+        ins["X"][0], full_matrices=bool(attrs.get("full_matrices", False)))
+    return {"U": [u], "S": [s], "VH": [vh]}
+
+
+@register_op("eigh", inputs=["X"], outputs=["Eigenvalues", "Eigenvectors"],
+             grad=None)
+def _eigh(ctx, ins, attrs):
+    uplo = attrs.get("UPLO", "L")
+    w, v = jnp.linalg.eigh(ins["X"][0], symmetrize_input=True,
+                           UPLO=uplo)
+    return {"Eigenvalues": [w], "Eigenvectors": [v]}
+
+
+@register_op("eigvalsh", inputs=["X"], outputs=["Eigenvalues"], grad=None)
+def _eigvalsh(ctx, ins, attrs):
+    return {"Eigenvalues": [jnp.linalg.eigvalsh(ins["X"][0])]}
+
+
+@register_op("determinant", inputs=["Input"], outputs=["Out"])
+def _determinant(ctx, ins, attrs):
+    return {"Out": [jnp.linalg.det(ins["Input"][0])]}
+
+
+@register_op("slogdeterminant", inputs=["Input"], outputs=["Sign", "Out"],
+             grad=None)
+def _slogdet(ctx, ins, attrs):
+    sign, logdet = jnp.linalg.slogdet(ins["Input"][0])
+    return {"Sign": [sign], "Out": [logdet]}
+
+
+@register_op("pinv", inputs=["X"], outputs=["Out"], grad=None)
+def _pinv(ctx, ins, attrs):
+    return {"Out": [jnp.linalg.pinv(
+        ins["X"][0], rtol=float(attrs.get("rcond", 1e-15)))]}
+
+
+@register_op("solve", inputs=["X", "Y"], outputs=["Out"])
+def _solve(ctx, ins, attrs):
+    return {"Out": [jnp.linalg.solve(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("lstsq", inputs=["X", "Y"], outputs=["Solution", "Residuals"],
+             grad=None)
+def _lstsq(ctx, ins, attrs):
+    sol, res, _rank, _sv = jnp.linalg.lstsq(ins["X"][0], ins["Y"][0])
+    return {"Solution": [sol], "Residuals": [res]}
+
+
+@register_op("lu", inputs=["X"], outputs=["Out", "Pivots"], grad=None)
+def _lu(ctx, ins, attrs):
+    import jax.scipy.linalg as jsl
+
+    lu, piv = jsl.lu_factor(ins["X"][0])
+    return {"Out": [lu], "Pivots": [piv.astype(jnp.int32)]}
+
+
+@register_op("matrix_rank", inputs=["X"], outputs=["Out"], grad=None)
+def _matrix_rank(ctx, ins, attrs):
+    # reference semantics: 'tol' is an ABSOLUTE singular-value threshold
+    tol = attrs.get("tol", None)
+    return {"Out": [jnp.linalg.matrix_rank(
+        ins["X"][0], tol=tol).astype(jnp.int64)]}
+
+
+@register_op("cholesky_solve", inputs=["X", "Y"], outputs=["Out"])
+def _cholesky_solve(ctx, ins, attrs):
+    import jax.scipy.linalg as jsl
+
+    upper = bool(attrs.get("upper", False))
+    # solve A x = b given the cholesky factor of A
+    return {"Out": [jsl.cho_solve((ins["Y"][0], not upper), ins["X"][0])]}
+
+
+@register_op("mv", inputs=["X", "Vec"], outputs=["Out"])
+def _mv(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] @ ins["Vec"][0]]}

@@ -224,3 +224,136 @@ def _sum(ctx, ins, attrs):
     for x in xs[1:]:
         out = out + x
     return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# 2.x math tail (reference elementwise_fmax/fmin, remainder, heaviside,
+# logit, nansum/nanmean, amax/amin, median/quantile, std/var ops)
+# ---------------------------------------------------------------------------
+
+
+@register_op("elementwise_fmax", inputs=["X", "Y"], outputs=["Out"])
+def _fmax(ctx, ins, attrs):
+    return {"Out": [jnp.fmax(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("elementwise_fmin", inputs=["X", "Y"], outputs=["Out"])
+def _fmin(ctx, ins, attrs):
+    return {"Out": [jnp.fmin(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("remainder", inputs=["X", "Y"], outputs=["Out"], grad=None)
+def _remainder(ctx, ins, attrs):
+    return {"Out": [jnp.remainder(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("heaviside", inputs=["X", "Y"], outputs=["Out"], grad=None)
+def _heaviside(ctx, ins, attrs):
+    return {"Out": [jnp.heaviside(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("logit", inputs=["X"], outputs=["Out"])
+def _logit(ctx, ins, attrs):
+    eps = float(attrs.get("eps", 0.0))
+    x = ins["X"][0]
+    if eps > 0:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return {"Out": [jnp.log(x) - jnp.log1p(-x)]}
+
+
+@register_op("logaddexp", inputs=["X", "Y"], outputs=["Out"])
+def _logaddexp(ctx, ins, attrs):
+    return {"Out": [jnp.logaddexp(ins["X"][0], ins["Y"][0])]}
+
+
+def _axis_of(attrs):
+    a = attrs.get("axis", attrs.get("dim", None))
+    if a in (None, [], ()):
+        return None
+    return tuple(a) if isinstance(a, (list, tuple)) else int(a)
+
+
+@register_op("nansum", inputs=["X"], outputs=["Out"], grad=None)
+def _nansum(ctx, ins, attrs):
+    return {"Out": [jnp.nansum(ins["X"][0], axis=_axis_of(attrs),
+                               keepdims=bool(attrs.get("keep_dim", False)))]}
+
+
+@register_op("nanmean", inputs=["X"], outputs=["Out"], grad=None)
+def _nanmean(ctx, ins, attrs):
+    return {"Out": [jnp.nanmean(ins["X"][0], axis=_axis_of(attrs),
+                                keepdims=bool(attrs.get("keep_dim", False)))]}
+
+
+@register_op("reduce_amax", inputs=["X"], outputs=["Out"], grad=None)
+def _amax(ctx, ins, attrs):
+    return {"Out": [jnp.amax(ins["X"][0], axis=_axis_of(attrs),
+                             keepdims=bool(attrs.get("keep_dim", False)))]}
+
+
+@register_op("reduce_amin", inputs=["X"], outputs=["Out"], grad=None)
+def _amin(ctx, ins, attrs):
+    return {"Out": [jnp.amin(ins["X"][0], axis=_axis_of(attrs),
+                             keepdims=bool(attrs.get("keep_dim", False)))]}
+
+
+@register_op("median", inputs=["X"], outputs=["Out"], grad=None)
+def _median(ctx, ins, attrs):
+    return {"Out": [jnp.median(ins["X"][0], axis=_axis_of(attrs),
+                               keepdims=bool(attrs.get("keep_dim", False)))]}
+
+
+@register_op("quantile", inputs=["X"], outputs=["Out"], grad=None)
+def _quantile(ctx, ins, attrs):
+    q = attrs["q"]
+    return {"Out": [jnp.quantile(
+        ins["X"][0], jnp.asarray(q), axis=_axis_of(attrs),
+        keepdims=bool(attrs.get("keep_dim", False)))]}
+
+
+@register_op("reduce_std", inputs=["X"], outputs=["Out"])
+def _std(ctx, ins, attrs):
+    return {"Out": [jnp.std(
+        ins["X"][0], axis=_axis_of(attrs),
+        ddof=1 if attrs.get("unbiased", True) else 0,
+        keepdims=bool(attrs.get("keep_dim", False)))]}
+
+
+@register_op("reduce_var", inputs=["X"], outputs=["Out"])
+def _var(ctx, ins, attrs):
+    return {"Out": [jnp.var(
+        ins["X"][0], axis=_axis_of(attrs),
+        ddof=1 if attrs.get("unbiased", True) else 0,
+        keepdims=bool(attrs.get("keep_dim", False)))]}
+
+
+@register_op("brelu", inputs=["X"], outputs=["Out"])
+def _brelu(ctx, ins, attrs):
+    lo = float(attrs.get("t_min", 0.0))
+    hi = float(attrs.get("t_max", 24.0))
+    return {"Out": [jnp.clip(ins["X"][0], lo, hi)]}
+
+
+@register_op("soft_relu", inputs=["X"], outputs=["Out"])
+def _soft_relu(ctx, ins, attrs):
+    t = float(attrs.get("threshold", 40.0))
+    x = jnp.clip(ins["X"][0], -t, t)
+    return {"Out": [jnp.log1p(jnp.exp(x))]}
+
+
+@register_op("logcumsumexp", inputs=["X"], outputs=["Out"])
+def _logcumsumexp(ctx, ins, attrs):
+    axis = int(attrs.get("axis", -1))
+    x = ins["X"][0]
+    m = jnp.max(x, axis=axis, keepdims=True)
+    return {"Out": [jnp.log(jnp.cumsum(jnp.exp(x - m), axis=axis)) + m]}
+
+
+@register_op("gcd", inputs=["X", "Y"], outputs=["Out"], grad=None)
+def _gcd(ctx, ins, attrs):
+    return {"Out": [jnp.gcd(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("lcm", inputs=["X", "Y"], outputs=["Out"], grad=None)
+def _lcm(ctx, ins, attrs):
+    return {"Out": [jnp.lcm(ins["X"][0], ins["Y"][0])]}

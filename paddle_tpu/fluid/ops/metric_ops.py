@@ -1,5 +1,7 @@
-"""Metric ops (cf. paddle/fluid/operators/metrics/accuracy_op.cc, auc_op.cc)."""
+"""Metric ops (cf. paddle/fluid/operators/metrics/accuracy_op.cc,
+auc_op.cc, precision_recall_op.cc, detection_map_op.cc)."""
 
+import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_op
@@ -22,3 +24,164 @@ def _accuracy(ctx, ins, attrs):
     total = jnp.array(indices.shape[0], dtype=jnp.int32)
     acc = correct.astype(jnp.float32) / total.astype(jnp.float32)
     return {"Accuracy": [acc], "Correct": [correct], "Total": [total]}
+
+
+@register_op("auc", inputs=["Predict", "Label", "StatPos", "StatNeg"],
+             outputs=["AUC", "StatPosOut", "StatNegOut"], grad=None,
+             stateful_out_slots=("StatPosOut", "StatNegOut"))
+def _auc(ctx, ins, attrs):
+    """cf. metrics/auc_op.cc: streaming ROC-AUC over score-histogram
+    buckets.  StatPos/StatNeg [num_thresholds+1] accumulate positive /
+    negative counts per bucket across batches; AUC is the trapezoid sum
+    over the accumulated histogram."""
+    pred = ins["Predict"][0]
+    label = ins["Label"][0].reshape(-1)
+    pos_score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
+        else pred.reshape(-1)
+    stat_pos, stat_neg = ins["StatPos"][0], ins["StatNeg"][0]
+    n_th = stat_pos.shape[0] - 1
+    bucket = jnp.clip((pos_score * n_th).astype(jnp.int32), 0, n_th)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    stat_pos = stat_pos.at[bucket].add(is_pos)
+    stat_neg = stat_neg.at[bucket].add(1.0 - is_pos)
+    # descending-threshold sweep: accumulate TP/FP from the top bucket
+    pos_rev = jnp.cumsum(stat_pos[::-1])
+    neg_rev = jnp.cumsum(stat_neg[::-1])
+    tot_pos, tot_neg = pos_rev[-1], neg_rev[-1]
+    # trapezoid: sum over buckets of d(FP) * (TP_prev + TP_cur) / 2
+    tp_prev = jnp.concatenate([jnp.zeros(1, pos_rev.dtype), pos_rev[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros(1, neg_rev.dtype), neg_rev[:-1]])
+    area = jnp.sum((neg_rev - fp_prev) * (pos_rev + tp_prev) / 2.0)
+    denom = tot_pos * tot_neg
+    auc = jnp.where(denom > 0, area / jnp.maximum(denom, 1.0), 0.0)
+    return {"AUC": [auc.astype(jnp.float32)[None]],
+            "StatPosOut": [stat_pos], "StatNegOut": [stat_neg]}
+
+
+@register_op("precision_recall",
+             inputs=["MaxProbs", "Indices", "Labels", "Weights",
+                     "StatesInfo"],
+             outputs=["BatchMetrics", "AccumMetrics", "AccumStatesInfo"],
+             grad=None, stateful_out_slots=("AccumStatesInfo",))
+def _precision_recall(ctx, ins, attrs):
+    """cf. metrics/precision_recall_op.cc: multi-class macro/micro
+    precision/recall/F1.  StatesInfo [C, 4] accumulates per-class
+    (TP, FP, TN, FN); BatchMetrics/AccumMetrics are
+    [macro-P, macro-R, macro-F1, micro-P, micro-R, micro-F1]."""
+    idx = ins["Indices"][0].reshape(-1)
+    labels = ins["Labels"][0].reshape(-1)
+    C = int(attrs["class_number"])
+    w = (ins["Weights"][0].reshape(-1)
+         if ins.get("Weights") else jnp.ones_like(idx, jnp.float32))
+    states = (ins["StatesInfo"][0] if ins.get("StatesInfo")
+              else jnp.zeros((C, 4), jnp.float32))
+
+    pred_oh = jax.nn.one_hot(idx, C, dtype=jnp.float32) * w[:, None]
+    lab_oh = jax.nn.one_hot(labels, C, dtype=jnp.float32) * w[:, None]
+    tp = jnp.sum(pred_oh * (idx == labels).astype(jnp.float32)[:, None],
+                 axis=0)
+    fp = jnp.sum(pred_oh, axis=0) - tp
+    fn = jnp.sum(lab_oh, axis=0) - tp
+    tn = jnp.sum(w) - tp - fp - fn
+    batch = jnp.stack([tp, fp, tn, fn], axis=1)
+
+    def metrics(st):
+        tp_, fp_, _tn, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        p = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-12), 0)
+        r = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12), 0)
+        f1 = jnp.where(p + r > 0, 2 * p * r / jnp.maximum(p + r, 1e-12), 0)
+        mp, mr, mf = jnp.mean(p), jnp.mean(r), jnp.mean(f1)
+        stp, sfp, sfn = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
+        up = jnp.where(stp + sfp > 0, stp / jnp.maximum(stp + sfp, 1e-12), 0)
+        ur = jnp.where(stp + sfn > 0, stp / jnp.maximum(stp + sfn, 1e-12), 0)
+        uf = jnp.where(up + ur > 0, 2 * up * ur / jnp.maximum(up + ur, 1e-12), 0)
+        return jnp.stack([mp, mr, mf, up, ur, uf]).astype(jnp.float32)
+
+    accum = states + batch
+    return {"BatchMetrics": [metrics(batch)],
+            "AccumMetrics": [metrics(accum)],
+            "AccumStatesInfo": [accum]}
+
+
+@register_op("detection_map",
+             inputs=["DetectRes", "Label"],
+             outputs=["MAP"], grad=None)
+def _detection_map(ctx, ins, attrs):
+    """cf. metrics/detection_map_op.cc (simplified single-batch form).
+
+    DetectRes: [N, M, 6] = (label, score, x1, y1, x2, y2), label < 0 pads.
+    Label (ground truth): [N, G, 5] = (label, x1, y1, x2, y2), label < 0
+    pads.  Computes mean average precision over classes at
+    `overlap_threshold` IoU with the 11-point (ap_type="11point") or
+    integral interpolation — the matching is the reference greedy
+    best-IoU assignment, vectorized per class."""
+    det = ins["DetectRes"][0]
+    gt = ins["Label"][0]
+    thr = float(attrs.get("overlap_threshold", 0.5))
+    ap_type = attrs.get("ap_type", "integral")
+    C = int(attrs["class_num"])
+    N, M, _ = det.shape
+    G = gt.shape[1]
+
+    def box_iou(a, b):
+        # a [M,4], b [G,4]
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        area_a = jnp.clip(a[:, 2] - a[:, 0], 0) * jnp.clip(
+            a[:, 3] - a[:, 1], 0)
+        area_b = jnp.clip(b[:, 2] - b[:, 0], 0) * jnp.clip(
+            b[:, 3] - b[:, 1], 0)
+        return inter / jnp.maximum(
+            area_a[:, None] + area_b[None, :] - inter, 1e-10)
+
+    # IoU is class-independent: compute [N, M, G] ONCE outside the
+    # per-class vmap
+    iou_all = jax.vmap(
+        lambda i: box_iou(det[i, :, 2:6], gt[i, :, 1:5]))(
+            jnp.arange(N))                                       # [N, M, G]
+
+    def per_class(c):
+        # flatten all images' detections of class c, sort by score desc
+        dlab, dsc = det[..., 0], det[..., 1]
+        sel = (dlab == c)
+        scores = jnp.where(sel, dsc, -jnp.inf).reshape(-1)      # [N*M]
+        order = jnp.argsort(-scores)
+        img_of = jnp.repeat(jnp.arange(N), M)[order]
+        slot_of = jnp.tile(jnp.arange(M), N)[order]
+        valid = scores[order] > -jnp.inf
+        glab = gt[..., 0]
+        gt_sel = (glab == c)                                     # [N, G]
+        npos = jnp.sum(gt_sel)
+
+        def step(used, k):
+            i, m, ok = img_of[k], slot_of[k], valid[k]
+            ious = jnp.where(gt_sel[i] & ~used[i], iou_all[i, m], -1.0)
+            j = jnp.argmax(ious)
+            hit = ok & (ious[j] >= thr)
+            used = used.at[i, j].set(used[i, j] | hit)
+            tp = jnp.where(hit, 1.0, 0.0) * ok
+            fp = jnp.where(hit, 0.0, 1.0) * ok
+            return used, (tp, fp)
+
+        used0 = jnp.zeros((N, G), bool)
+        _, (tps, fps) = jax.lax.scan(step, used0, jnp.arange(N * M))
+        ctp, cfp = jnp.cumsum(tps), jnp.cumsum(fps)
+        rec = ctp / jnp.maximum(npos, 1)
+        prec = ctp / jnp.maximum(ctp + cfp, 1e-10)
+        if ap_type == "11point":
+            pts = jnp.linspace(0, 1, 11)
+            pmax = jax.vmap(
+                lambda r: jnp.max(jnp.where(rec >= r, prec, 0.0)))(pts)
+            ap = jnp.mean(pmax)
+        else:  # integral
+            d_rec = jnp.diff(jnp.concatenate([jnp.zeros(1), rec]))
+            ap = jnp.sum(d_rec * prec)
+        return jnp.where(npos > 0, ap, -1.0)
+
+    aps = jax.vmap(per_class)(jnp.arange(C))
+    have = aps >= 0
+    mAP = jnp.sum(jnp.where(have, aps, 0.0)) / jnp.maximum(
+        jnp.sum(have), 1)
+    return {"MAP": [mAP.astype(jnp.float32)[None]]}

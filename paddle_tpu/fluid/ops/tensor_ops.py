@@ -433,3 +433,181 @@ def _tensor_array_read(ctx, ins, attrs):
         (1,) + arr.shape[1:],
     )
     return {"Out": [out[0]]}
+
+
+# ---------------------------------------------------------------------------
+# tensor/loss breadth tail (reference crop_tensor_op.cc, unbind_op.cc,
+# size_op.cc, gather_tree_op.cc, partial_sum/concat, center_loss_op.cc,
+# teacher_student_sigmoid_loss_op.cc, fsp_op.cc,
+# squared_l2_distance_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("crop_tensor", inputs=["X"], outputs=["Out"])
+def _crop_tensor(ctx, ins, attrs):
+    x = ins["X"][0]
+    offsets = attrs.get("offsets") or [0] * x.ndim
+    shape = attrs["shape"]
+    shape = [x.shape[i] - offsets[i] if s in (-1, 0) else s
+             for i, s in enumerate(shape)]
+    import jax
+
+    return {"Out": [jax.lax.dynamic_slice(x, tuple(offsets), tuple(shape))]}
+
+
+@register_op("unbind", inputs=["X"], outputs=["Out"], grad=None)
+def _unbind(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", 0))
+    n = x.shape[axis]
+    return {"Out": [jnp.squeeze(s, axis)
+                    for s in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("size", inputs=["Input"], outputs=["Out"], grad=None)
+def _size(ctx, ins, attrs):
+    import numpy as _np
+
+    return {"Out": [jnp.asarray(int(_np.prod(ins["Input"][0].shape)),
+                                jnp.int64)]}
+
+
+@register_op("gather_tree", inputs=["Ids", "Parents"], outputs=["Out"],
+             grad=None)
+def _gather_tree(ctx, ins, attrs):
+    """cf. gather_tree_op.cc (beam search backtrace): walk parents from
+    the last step to recover full beams."""
+    import jax
+
+    ids, parents = ins["Ids"][0], ins["Parents"][0]  # [T, B, W]
+    T = ids.shape[0]
+    beams = jnp.arange(ids.shape[2])[None, :].repeat(ids.shape[1], 0)
+
+    def step(beam, t):
+        out = jnp.take_along_axis(ids[t], beam, axis=1)
+        prev = jnp.take_along_axis(parents[t], beam, axis=1)
+        return prev, out
+
+    _, outs = jax.lax.scan(step, beams, jnp.arange(T - 1, -1, -1))
+    return {"Out": [outs[::-1]]}
+
+
+@register_op("masked_fill", inputs=["X", "Mask"], outputs=["Out"],
+             no_grad_slots=("Mask",))
+def _masked_fill(ctx, ins, attrs):
+    x, m = ins["X"][0], ins["Mask"][0]
+    return {"Out": [jnp.where(m.astype(bool), jnp.asarray(
+        attrs.get("value", 0.0), x.dtype), x)]}
+
+
+@register_op("partial_sum", inputs=["X"], outputs=["Out"])
+def _partial_sum(ctx, ins, attrs):
+    start = int(attrs.get("start_index", 0))
+    length = int(attrs.get("length", -1))
+    parts = []
+    for x in ins["X"]:
+        end = x.shape[1] if length < 0 else start + length
+        parts.append(x[:, start:end])
+    return {"Out": [sum(parts)]}
+
+
+@register_op("partial_concat", inputs=["X"], outputs=["Out"])
+def _partial_concat(ctx, ins, attrs):
+    start = int(attrs.get("start_index", 0))
+    length = int(attrs.get("length", -1))
+    parts = []
+    for x in ins["X"]:
+        end = x.shape[1] if length < 0 else start + length
+        parts.append(x[:, start:end])
+    return {"Out": [jnp.concatenate(parts, axis=1)]}
+
+
+@register_op("center_loss",
+             inputs=["X", "Label", "Centers", "CenterUpdateRate"],
+             outputs=["Loss", "SampleCenterDiff", "CentersOut"],
+             no_grad_slots=("Label", "Centers", "CenterUpdateRate"),
+             stateful_out_slots=("CentersOut",))
+def _center_loss(ctx, ins, attrs):
+    """cf. center_loss_op.cc: pull features toward running class centers;
+    centers update by the mean diff of their batch members."""
+    x = ins["X"][0]                     # [N, D]
+    label = ins["Label"][0].reshape(-1)
+    centers = ins["Centers"][0]         # [C, D]
+    alpha = ins["CenterUpdateRate"][0].reshape(-1)[0]
+    diff = x - centers[label]
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    if attrs.get("need_update", True):
+        cnt = jnp.zeros((centers.shape[0],), jnp.float32).at[label].add(1.0)
+        upd = jnp.zeros_like(centers).at[label].add(diff)
+        centers = centers + alpha * upd / (cnt[:, None] + 1.0)
+    return {"Loss": [loss], "SampleCenterDiff": [diff],
+            "CentersOut": [centers]}
+
+
+@register_op("dice_loss", inputs=["X", "Label"], outputs=["Out"],
+             no_grad_slots=("Label",))
+def _dice_loss(ctx, ins, attrs):
+    """cf. layers/loss dice_loss: 1 - 2|X∩L| / (|X|+|L|) per batch row."""
+    x = ins["X"][0]
+    label = ins["Label"][0].astype(x.dtype)
+    eps = float(attrs.get("epsilon", 1e-5))
+    red = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * label, axis=red)
+    union = jnp.sum(x, axis=red) + jnp.sum(label, axis=red)
+    return {"Out": [1.0 - (2 * inter + eps) / (union + eps)]}
+
+
+@register_op("teacher_student_sigmoid_loss", inputs=["X", "Label"],
+             outputs=["Y"], no_grad_slots=("Label",))
+def _ts_sigmoid_loss(ctx, ins, attrs):
+    """cf. teacher_student_sigmoid_loss_op.cc (CTR distillation)."""
+    x = ins["X"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1)
+    soft_max_up = float(attrs.get("soft_max_up_bound", 15.0))
+    soft_max_lo = float(attrs.get("soft_max_lower_bound", -15.0))
+    xc = jnp.clip(x, soft_max_lo, soft_max_up)
+    # teacher part (label in (0,1)): sigmoid CE against the soft label;
+    # student part (label 0/1): plain logistic loss
+    ce = jnp.maximum(xc, 0) - xc * label + jnp.log1p(jnp.exp(-jnp.abs(xc)))
+    return {"Y": [ce[:, None]]}
+
+
+@register_op("npair_loss", inputs=["Anchor", "Positive", "Labels"],
+             outputs=["Out"], no_grad_slots=("Labels",))
+def _npair_loss(ctx, ins, attrs):
+    """cf. layers npair_loss: cross-entropy over anchor-positive
+    similarities + L2 reg."""
+    import jax
+
+    a = ins["Anchor"][0]
+    p = ins["Positive"][0]
+    labels = ins["Labels"][0].reshape(-1)
+    l2 = float(attrs.get("l2_reg", 0.002))
+    sim = a @ p.T                       # [N, N]
+    t = (labels[:, None] == labels[None, :]).astype(a.dtype)
+    t = t / jnp.sum(t, axis=1, keepdims=True)
+    xe = -jnp.sum(t * jax.nn.log_softmax(sim, axis=1), axis=1)
+    reg = l2 * (jnp.sum(a * a) + jnp.sum(p * p)) / a.shape[0]
+    return {"Out": [jnp.mean(xe) + reg]}
+
+
+@register_op("fsp", inputs=["X", "Y"], outputs=["Out"])
+def _fsp(ctx, ins, attrs):
+    """cf. fsp_op.cc (distillation flow matrix): per-sample normalized
+    Gram matrix between two feature maps."""
+    x, y = ins["X"][0], ins["Y"][0]     # [N, C1, H, W], [N, C2, H, W]
+    n, c1, h, w = x.shape
+    c2 = y.shape[1]
+    xf = x.reshape(n, c1, h * w)
+    yf = y.reshape(n, c2, h * w)
+    return {"Out": [jnp.einsum("nch,ndh->ncd", xf, yf) / (h * w)]}
+
+
+@register_op("squared_l2_distance", inputs=["X", "Y"],
+             outputs=["Out", "sub_result"])
+def _squared_l2_distance(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - y
+    red = tuple(range(1, sub.ndim))
+    return {"Out": [jnp.sum(sub * sub, axis=red, keepdims=False)[:, None]],
+            "sub_result": [sub]}

@@ -1,0 +1,750 @@
+"""Numpy-oracle OpTests for the breadth batch: linalg decompositions,
+math tail, interpolate modes, pad2d/3d, metric ops (auc/precision_recall/
+detection_map), RPN/FPN detection tail, tensor/loss extras (reference
+OpTest pattern: outputs pinned by independent numpy computation)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_single_op
+
+
+def _r(rng, *shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+
+
+def test_linalg_decompositions(rng):
+    a = _r(rng, 6, 4)
+    outs, _ = run_single_op("qr", {"X": a}, {}, ["Q", "R"])
+    np.testing.assert_allclose(outs["Q"] @ outs["R"], a, atol=1e-5)
+
+    outs, _ = run_single_op("svd", {"X": a}, {}, ["U", "S", "VH"])
+    np.testing.assert_allclose(
+        outs["U"] @ np.diag(outs["S"]) @ outs["VH"], a, atol=1e-5)
+
+    sym = a.T @ a
+    outs, _ = run_single_op("eigh", {"X": sym}, {},
+                            ["Eigenvalues", "Eigenvectors"])
+    w, v = np.linalg.eigh(sym)
+    np.testing.assert_allclose(outs["Eigenvalues"], w, atol=1e-4)
+    outs2, _ = run_single_op("eigvalsh", {"X": sym}, {}, ["Eigenvalues"])
+    np.testing.assert_allclose(outs2["Eigenvalues"], w, atol=1e-4)
+
+
+def test_linalg_det_solve(rng):
+    a = _r(rng, 4, 4) + 4 * np.eye(4, dtype=np.float32)
+    outs, _ = run_single_op("determinant", {"Input": a}, {}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], np.linalg.det(a), rtol=1e-4)
+
+    outs, _ = run_single_op("slogdeterminant", {"Input": a}, {},
+                            ["Sign", "Out"])
+    sign, logdet = np.linalg.slogdet(a)
+    np.testing.assert_allclose(outs["Sign"], sign, rtol=1e-5)
+    np.testing.assert_allclose(outs["Out"], logdet, rtol=1e-4)
+
+    b = _r(rng, 4, 2)
+    outs, _ = run_single_op("solve", {"X": a, "Y": b}, {}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], np.linalg.solve(a, b),
+                               rtol=1e-3, atol=1e-4)
+
+    m = _r(rng, 5, 3)
+    outs, _ = run_single_op("pinv", {"X": m}, {}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], np.linalg.pinv(m), rtol=1e-3,
+                               atol=1e-4)
+
+    outs, _ = run_single_op("lstsq", {"X": m, "Y": _r(rng, 5, 2)}, {},
+                            ["Solution", "Residuals"])
+    assert outs["Solution"].shape == (3, 2)
+
+    outs, _ = run_single_op("matrix_rank", {"X": m}, {}, ["Out"])
+    assert int(outs["Out"]) == np.linalg.matrix_rank(m)
+
+    outs, _ = run_single_op("mv", {"X": a, "Vec": _r(rng, 4)}, {}, ["Out"])
+    assert outs["Out"].shape == (4,)
+
+    outs, _ = run_single_op("lu", {"X": a}, {}, ["Out", "Pivots"])
+    assert outs["Out"].shape == (4, 4) and outs["Pivots"].shape == (4,)
+
+
+def test_cholesky_solve(rng):
+    a = _r(rng, 4, 4)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    L = np.linalg.cholesky(spd).astype(np.float32)
+    b = _r(rng, 4, 2)
+    outs, _ = run_single_op("cholesky_solve", {"X": b, "Y": L},
+                            {"upper": False}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], np.linalg.solve(spd, b),
+                               rtol=1e-3, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# math tail
+# ---------------------------------------------------------------------------
+
+_MATH_BIN = [
+    ("elementwise_fmax", np.fmax), ("elementwise_fmin", np.fmin),
+    ("remainder", np.remainder), ("heaviside", np.heaviside),
+    ("logaddexp", np.logaddexp),
+]
+
+
+@pytest.mark.parametrize("op,fn", _MATH_BIN, ids=[o for o, _ in _MATH_BIN])
+def test_math_binary(rng, op, fn):
+    x, y = _r(rng, 3, 4), _r(rng, 3, 4) + 0.5
+    outs, _ = run_single_op(op, {"X": x, "Y": y}, {}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], fn(x, y), rtol=1e-5, atol=1e-6)
+
+
+def test_math_reductions(rng):
+    x = _r(rng, 3, 5)
+    x[0, 0] = np.nan
+    for op, fn in [("nansum", np.nansum), ("nanmean", np.nanmean)]:
+        outs, _ = run_single_op(op, {"X": x}, {"axis": 1}, ["Out"])
+        np.testing.assert_allclose(outs["Out"], fn(x, axis=1), rtol=1e-5)
+    y = _r(rng, 4, 6)
+    for op, fn in [("reduce_amax", np.amax), ("reduce_amin", np.amin),
+                   ("median", np.median)]:
+        outs, _ = run_single_op(op, {"X": y}, {"axis": 1}, ["Out"])
+        np.testing.assert_allclose(outs["Out"], fn(y, axis=1), rtol=1e-5)
+    outs, _ = run_single_op("quantile", {"X": y}, {"q": 0.3, "axis": 1},
+                            ["Out"])
+    np.testing.assert_allclose(outs["Out"], np.quantile(y, 0.3, axis=1),
+                               rtol=1e-4)
+    for op, fn in [("reduce_std", np.std), ("reduce_var", np.var)]:
+        outs, _ = run_single_op(op, {"X": y},
+                                {"axis": 1, "unbiased": True}, ["Out"])
+        np.testing.assert_allclose(outs["Out"], fn(y, axis=1, ddof=1),
+                                   rtol=1e-4)
+
+
+def test_math_unary_extras(rng):
+    p = rng.uniform(0.05, 0.95, (3, 4)).astype(np.float32)
+    outs, _ = run_single_op("logit", {"X": p}, {}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], np.log(p / (1 - p)),
+                               rtol=1e-4, atol=1e-5)
+    check_grad("logit", {"X": p.astype(np.float64)}, {}, ["Out"], ["X"])
+
+    x = _r(rng, 3, 4)
+    outs, _ = run_single_op("brelu", {"X": x * 10},
+                            {"t_min": 1.0, "t_max": 4.0}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], np.clip(x * 10, 1, 4))
+
+    outs, _ = run_single_op("soft_relu", {"X": x}, {}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], np.log1p(np.exp(x)),
+                               rtol=1e-5, atol=1e-6)
+
+    outs, _ = run_single_op("logcumsumexp", {"X": x}, {"axis": 1}, ["Out"])
+    np.testing.assert_allclose(
+        outs["Out"], np.log(np.cumsum(np.exp(x), axis=1)), rtol=1e-4,
+        atol=1e-5)
+
+    a = rng.randint(1, 40, (3, 4))
+    b = rng.randint(1, 40, (3, 4))
+    outs, _ = run_single_op("gcd", {"X": a, "Y": b}, {}, ["Out"])
+    np.testing.assert_array_equal(outs["Out"], np.gcd(a, b))
+    outs, _ = run_single_op("lcm", {"X": a, "Y": b}, {}, ["Out"])
+    np.testing.assert_array_equal(outs["Out"], np.lcm(a, b))
+
+
+# ---------------------------------------------------------------------------
+# interpolate / pad / channel ops
+# ---------------------------------------------------------------------------
+
+
+def test_interp_linear_ramp_exact(rng):
+    """Linear functions are reproduced exactly by (tri)linear resampling
+    with align_corners=True — an oracle independent of any resize lib."""
+    w = 8
+    x = np.arange(w, dtype=np.float32)[None, None, :] * 2.0 + 1.0
+    outs, _ = run_single_op("linear_interp", {"X": x},
+                            {"out_w": 15, "align_corners": True}, ["Out"])
+    expect = np.linspace(x[0, 0, 0], x[0, 0, -1], 15)
+    np.testing.assert_allclose(outs["Out"][0, 0], expect, rtol=1e-5)
+
+    d = h = w = 4
+    grid = np.mgrid[0:d, 0:h, 0:w].astype(np.float32)
+    vol = (1.5 * grid[0] + 0.5 * grid[1] - grid[2])[None, None]
+    outs, _ = run_single_op(
+        "trilinear_interp", {"X": vol},
+        {"out_d": 7, "out_h": 7, "out_w": 7, "align_corners": True},
+        ["Out"])
+    g7 = np.mgrid[0:7, 0:7, 0:7].astype(np.float32) * (3.0 / 6.0)
+    expect = (1.5 * g7[0] + 0.5 * g7[1] - g7[2])
+    np.testing.assert_allclose(outs["Out"][0, 0], expect, atol=1e-4)
+
+
+def test_bicubic_identity_and_shape(rng):
+    x = _r(rng, 1, 2, 6, 6)
+    outs, _ = run_single_op("bicubic_interp", {"X": x},
+                            {"out_h": 6, "out_w": 6}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], x, atol=1e-5)
+    outs, _ = run_single_op("bicubic_interp", {"X": x},
+                            {"out_h": 12, "out_w": 9}, ["Out"])
+    assert outs["Out"].shape == (1, 2, 12, 9)
+
+
+def test_pad2d_pad3d(rng):
+    x = _r(rng, 2, 3, 4, 5)
+    outs, _ = run_single_op(
+        "pad2d", {"X": x},
+        {"paddings": [1, 2, 3, 0], "mode": "constant", "pad_value": 7.0},
+        ["Out"])
+    expect = np.pad(x, ((0, 0), (0, 0), (1, 2), (3, 0)),
+                    constant_values=7.0)
+    np.testing.assert_array_equal(outs["Out"], expect)
+    outs, _ = run_single_op("pad2d", {"X": x},
+                            {"paddings": [1, 1, 1, 1], "mode": "reflect"},
+                            ["Out"])
+    np.testing.assert_array_equal(
+        outs["Out"], np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)),
+                            mode="reflect"))
+
+    v = _r(rng, 1, 2, 3, 4, 5)
+    outs, _ = run_single_op(
+        "pad3d", {"X": v},
+        {"paddings": [1, 0, 0, 1, 2, 0], "mode": "replicate"}, ["Out"])
+    np.testing.assert_array_equal(
+        outs["Out"], np.pad(v, ((0, 0), (0, 0), (1, 0), (0, 1), (2, 0)),
+                            mode="edge"))
+
+
+def test_channel_ops(rng):
+    x = _r(rng, 2, 8, 4, 4)
+    outs, _ = run_single_op("shuffle_channel", {"X": x}, {"group": 2},
+                            ["Out"])
+    expect = x.reshape(2, 2, 4, 4, 4).transpose(0, 2, 1, 3, 4).reshape(
+        2, 8, 4, 4)
+    np.testing.assert_array_equal(outs["Out"], expect)
+
+    # pixel_unshuffle inverts pixel_shuffle
+    y = _r(rng, 2, 4, 6, 6)
+    shuf, _ = run_single_op("pixel_shuffle", {"X": y},
+                            {"upscale_factor": 2}, ["Out"])
+    unshuf, _ = run_single_op("pixel_unshuffle", {"X": shuf["Out"]},
+                              {"downscale_factor": 2}, ["Out"])
+    np.testing.assert_array_equal(unshuf["Out"], y)
+
+    # maxout
+    outs, _ = run_single_op("maxout", {"X": x}, {"groups": 2}, ["Out"])
+    np.testing.assert_array_equal(
+        outs["Out"], x.reshape(2, 4, 2, 4, 4).max(axis=2))
+
+
+def test_temporal_shift(rng):
+    n, t, c, h, w = 2, 4, 8, 2, 2
+    x = _r(rng, n * t, c, h, w)
+    outs, _ = run_single_op("temporal_shift", {"X": x},
+                            {"seg_num": t, "shift_ratio": 0.25}, ["Out"])
+    xr = x.reshape(n, t, c, h, w)
+    expect = np.zeros_like(xr)
+    c1, c2 = c // 4, c // 2
+    expect[:, :-1, :c1] = xr[:, 1:, :c1]      # shift back
+    expect[:, 1:, c1:c2] = xr[:, :-1, c1:c2]  # shift forward
+    expect[:, :, c2:] = xr[:, :, c2:]
+    np.testing.assert_array_equal(outs["Out"], expect.reshape(n * t, c, h, w))
+
+
+def test_lrn(rng):
+    x = _r(rng, 2, 6, 3, 3)
+    outs, _ = run_single_op(
+        "lrn", {"X": x}, {"n": 5, "k": 2.0, "alpha": 1e-4, "beta": 0.75},
+        ["Out"])
+    expect = np.zeros_like(x)
+    for ci in range(6):
+        lo, hi = max(0, ci - 2), min(6, ci + 3)
+        den = 2.0 + 1e-4 * np.sum(x[:, lo:hi] ** 2, axis=1)
+        expect[:, ci] = x[:, ci] / den ** 0.75
+    np.testing.assert_allclose(outs["Out"], expect, rtol=1e-4, atol=1e-6)
+
+
+def test_row_conv(rng):
+    B, T, D, K = 2, 6, 3, 3
+    x, f = _r(rng, B, T, D), _r(rng, K, D)
+    lens = np.array([6, 4], np.int64)
+    outs, _ = run_single_op("row_conv",
+                            {"X": x, "Filter": f, "SeqLens": lens}, {},
+                            ["Out"])
+    expect = np.zeros_like(x)
+    for b in range(B):
+        for t in range(int(lens[b])):
+            for i in range(K):
+                if t + i < int(lens[b]):
+                    expect[b, t] += x[b, t + i] * f[i]
+    np.testing.assert_allclose(outs["Out"], expect, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# metric ops
+# ---------------------------------------------------------------------------
+
+
+def test_auc_matches_rank_oracle(rng):
+    n, buckets = 400, 4096
+    scores = rng.rand(n).astype(np.float32)
+    labels = (rng.rand(n) < scores).astype(np.int64)  # correlated
+    stat = np.zeros(buckets + 1, np.float32)
+    outs, _ = run_single_op(
+        "auc", {"Predict": scores[:, None], "Label": labels[:, None],
+                "StatPos": stat.copy(), "StatNeg": stat.copy()},
+        {}, ["AUC", "StatPosOut", "StatNegOut"])
+    # exact rank-based AUC oracle
+    pos = scores[labels == 1]
+    neg = scores[labels == 0]
+    cmp_ = (pos[:, None] > neg[None, :]).sum() \
+        + 0.5 * (pos[:, None] == neg[None, :]).sum()
+    oracle = cmp_ / (len(pos) * len(neg))
+    np.testing.assert_allclose(float(outs["AUC"][0]), oracle, atol=2e-3)
+
+
+def test_auc_streaming_accumulates(rng):
+    buckets = 1024
+    sp = np.zeros(buckets + 1, np.float32)
+    sn = np.zeros(buckets + 1, np.float32)
+    all_s, all_l = [], []
+    for i in range(3):
+        s = rng.rand(100).astype(np.float32)
+        l = (rng.rand(100) < s).astype(np.int64)
+        outs, _ = run_single_op(
+            "auc", {"Predict": s[:, None], "Label": l[:, None],
+                    "StatPos": sp, "StatNeg": sn},
+            {}, ["AUC", "StatPosOut", "StatNegOut"])
+        sp, sn = outs["StatPosOut"], outs["StatNegOut"]
+        all_s.append(s)
+        all_l.append(l)
+    s = np.concatenate(all_s)
+    l = np.concatenate(all_l)
+    pos, neg = s[l == 1], s[l == 0]
+    oracle = ((pos[:, None] > neg[None, :]).sum()
+              + 0.5 * (pos[:, None] == neg[None, :]).sum()) / (
+                  len(pos) * len(neg))
+    np.testing.assert_allclose(float(outs["AUC"][0]), oracle, atol=5e-3)
+
+
+def test_precision_recall(rng):
+    C, n = 4, 60
+    idx = rng.randint(0, C, n).astype(np.int64)
+    lab = rng.randint(0, C, n).astype(np.int64)
+    probs = rng.rand(n).astype(np.float32)
+    outs, _ = run_single_op(
+        "precision_recall",
+        {"MaxProbs": probs[:, None], "Indices": idx[:, None],
+         "Labels": lab[:, None]},
+        {"class_number": C}, ["BatchMetrics", "AccumMetrics",
+                              "AccumStatesInfo"])
+    # numpy oracle
+    P, R = [], []
+    stp = sfp = sfn = 0.0
+    for c in range(C):
+        tp = np.sum((idx == c) & (lab == c))
+        fp = np.sum((idx == c) & (lab != c))
+        fn = np.sum((idx != c) & (lab == c))
+        P.append(tp / (tp + fp) if tp + fp else 0.0)
+        R.append(tp / (tp + fn) if tp + fn else 0.0)
+        stp += tp
+        sfp += fp
+        sfn += fn
+    bm = outs["BatchMetrics"]
+    np.testing.assert_allclose(bm[0], np.mean(P), rtol=1e-4)
+    np.testing.assert_allclose(bm[1], np.mean(R), rtol=1e-4)
+    np.testing.assert_allclose(bm[3], stp / (stp + sfp), rtol=1e-4)
+    np.testing.assert_allclose(bm[4], stp / (stp + sfn), rtol=1e-4)
+
+
+def test_detection_map_perfect_and_miss():
+    # one image, 2 classes; det 0 matches gt exactly, det 1 misses
+    det = np.array([[[0, 0.9, 0, 0, 10, 10],
+                     [1, 0.8, 50, 50, 60, 60]]], np.float32)
+    gt = np.array([[[0, 0, 0, 10, 10],
+                    [1, 0, 0, 10, 10]]], np.float32)
+    outs, _ = run_single_op(
+        "detection_map", {"DetectRes": det, "Label": gt},
+        {"class_num": 2, "overlap_threshold": 0.5, "ap_type": "integral"},
+        ["MAP"])
+    # class 0: AP=1; class 1: AP=0 -> mAP 0.5
+    np.testing.assert_allclose(float(outs["MAP"][0]), 0.5, atol=1e-5)
+
+    det2 = np.array([[[0, 0.9, 0, 0, 10, 10],
+                      [1, 0.8, 0, 0, 10, 10]]], np.float32)
+    outs, _ = run_single_op(
+        "detection_map", {"DetectRes": det2, "Label": gt},
+        {"class_num": 2, "overlap_threshold": 0.5, "ap_type": "integral"},
+        ["MAP"])
+    np.testing.assert_allclose(float(outs["MAP"][0]), 1.0, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# detection tail
+# ---------------------------------------------------------------------------
+
+
+def test_generate_proposals_properties(rng):
+    N, A, H, W = 1, 3, 4, 4
+    scores = rng.rand(N, A, H, W).astype(np.float32)
+    deltas = (0.1 * rng.randn(N, A * 4, H, W)).astype(np.float32)
+    base = np.array([[0, 0, 15, 15], [4, 4, 11, 11], [2, 2, 13, 13]],
+                    np.float32)
+    anchors = np.tile(base[None, None], (H, W, 1, 1)).reshape(H, W, A, 4)
+    im_info = np.array([[32, 32, 1.0]], np.float32)
+    outs, _ = run_single_op(
+        "generate_proposals",
+        {"Scores": scores, "BboxDeltas": deltas, "ImInfo": im_info,
+         "Anchors": anchors},
+        {"pre_nms_topN": 24, "post_nms_topN": 8, "nms_thresh": 0.6},
+        ["RpnRois", "RpnRoiProbs"])
+    rois, probs = outs["RpnRois"][0], outs["RpnRoiProbs"][0]
+    assert rois.shape == (8, 4) and probs.shape == (8,)
+    # scores descend, boxes clipped to image
+    valid = probs > 0
+    pv = probs[valid]
+    assert np.all(pv[:-1] >= pv[1:] - 1e-6)
+    assert rois[valid].min() >= 0 and rois[valid].max() <= 31
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([
+        [0, 0, 16, 16],      # tiny -> min level
+        [0, 0, 224, 224],    # refer scale -> level 4
+        [0, 0, 1000, 1000],  # huge -> max level
+    ], np.float32)
+    outs, _ = run_single_op(
+        "distribute_fpn_proposals", {"FpnRois": rois},
+        {"min_level": 2, "max_level": 5, "refer_scale": 224,
+         "refer_level": 4},
+        ["MultiFpnRois", "RestoreIndex", "LevelIds"])
+    lvls = outs["LevelIds"]
+    assert list(lvls) == [2, 4, 5]
+    restore = outs["RestoreIndex"][:, 0]
+    np.testing.assert_array_equal(outs["MultiFpnRois"][restore], rois)
+
+
+def test_collect_fpn_proposals(rng):
+    r1, r2 = _r(rng, 4, 4), _r(rng, 4, 4)
+    s1 = np.array([0.9, 0.1, 0.5, 0.3], np.float32)
+    s2 = np.array([0.8, 0.2, 0.6, 0.4], np.float32)
+    outs, _ = run_single_op(
+        "collect_fpn_proposals",
+        {"MultiLevelRois": [r1, r2], "MultiLevelScores": [s1, s2]},
+        {"post_nms_topN": 3}, ["FpnRois"])
+    allr = np.concatenate([r1, r2])
+    alls = np.concatenate([s1, s2])
+    np.testing.assert_allclose(outs["FpnRois"],
+                               allr[np.argsort(-alls)[:3]])
+
+
+def test_sigmoid_focal_loss(rng):
+    N, C = 6, 3
+    x = _r(rng, N, C)
+    label = rng.randint(0, C + 1, (N, 1)).astype(np.int64)
+    fg = np.array([max((label > 0).sum(), 1)], np.int64)
+    outs, _ = run_single_op(
+        "sigmoid_focal_loss", {"X": x, "Label": label, "FgNum": fg},
+        {"gamma": 2.0, "alpha": 0.25}, ["Out"])
+    p = 1 / (1 + np.exp(-x))
+    t = (label == (np.arange(C) + 1)[None, :]).astype(np.float32)
+    ce = -(t * np.log(p) + (1 - t) * np.log(1 - p))
+    pt = t * p + (1 - t) * (1 - p)
+    at = t * 0.25 + (1 - t) * 0.75
+    expect = at * (1 - pt) ** 2 * ce / fg[0]
+    np.testing.assert_allclose(outs["Out"], expect, rtol=1e-3, atol=1e-5)
+
+
+def test_polygon_box_transform(rng):
+    x = _r(rng, 1, 4, 2, 3)
+    outs, _ = run_single_op("polygon_box_transform", {"Input": x}, {},
+                            ["Output"])
+    for i in range(2):
+        for j in range(3):
+            np.testing.assert_allclose(
+                outs["Output"][0, 0, i, j], j * 4.0 - x[0, 0, i, j],
+                rtol=1e-5)
+            np.testing.assert_allclose(
+                outs["Output"][0, 1, i, j], i * 4.0 - x[0, 1, i, j],
+                rtol=1e-5)
+
+
+def test_target_assign():
+    x = np.arange(2 * 3 * 2, dtype=np.float32).reshape(2, 3, 2)
+    match = np.array([[0, -1, 2, 1], [1, 1, -1, 0]], np.int64)
+    outs, _ = run_single_op(
+        "target_assign", {"X": x, "MatchIndices": match},
+        {"mismatch_value": -5.0}, ["Out", "OutWeight"])
+    assert outs["Out"].shape == (2, 4, 2)
+    np.testing.assert_array_equal(outs["Out"][0, 0], x[0, 0])
+    np.testing.assert_array_equal(outs["Out"][0, 1], [-5, -5])
+    np.testing.assert_array_equal(outs["OutWeight"][0, :, 0], [1, 0, 1, 1])
+
+
+# ---------------------------------------------------------------------------
+# tensor / loss extras
+# ---------------------------------------------------------------------------
+
+
+def test_tensor_extras(rng):
+    x = _r(rng, 4, 6)
+    outs, _ = run_single_op("crop_tensor", {"X": x},
+                            {"offsets": [1, 2], "shape": [2, 3]}, ["Out"])
+    np.testing.assert_array_equal(outs["Out"], x[1:3, 2:5])
+
+    outs, _ = run_single_op("size", {"Input": x}, {}, ["Out"])
+    assert int(outs["Out"]) == 24
+
+    m = (rng.rand(4, 6) > 0.5)
+    outs, _ = run_single_op("masked_fill", {"X": x, "Mask": m},
+                            {"value": 9.0}, ["Out"])
+    np.testing.assert_array_equal(outs["Out"], np.where(m, 9.0, x))
+
+    a, b = _r(rng, 2, 6), _r(rng, 2, 6)
+    outs, _ = run_single_op("partial_sum", {"X": [a, b]},
+                            {"start_index": 1, "length": 3}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], a[:, 1:4] + b[:, 1:4])
+    outs, _ = run_single_op("partial_concat", {"X": [a, b]},
+                            {"start_index": 1, "length": 3}, ["Out"])
+    np.testing.assert_allclose(outs["Out"],
+                               np.concatenate([a[:, 1:4], b[:, 1:4]], 1))
+
+
+def test_gather_tree():
+    # T=3, B=1, W=2 beams
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+    parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int64)
+    outs, _ = run_single_op("gather_tree", {"Ids": ids, "Parents": parents},
+                            {}, ["Out"])
+    # beam 0 at t=2 came from parent 1: path = ids[0][p(p)], ids[1][1]=4, 5
+    np.testing.assert_array_equal(outs["Out"][:, 0, 0], [1, 4, 5])
+    np.testing.assert_array_equal(outs["Out"][:, 0, 1], [1, 3, 6])
+
+
+def test_center_loss(rng):
+    N, D, C = 5, 4, 3
+    x = _r(rng, N, D)
+    label = rng.randint(0, C, (N, 1)).astype(np.int64)
+    centers = _r(rng, C, D)
+    alpha = np.array([0.5], np.float32)
+    outs, _ = run_single_op(
+        "center_loss",
+        {"X": x, "Label": label, "Centers": centers,
+         "CenterUpdateRate": alpha},
+        {"need_update": True},
+        ["Loss", "SampleCenterDiff", "CentersOut"])
+    diff = x - centers[label[:, 0]]
+    np.testing.assert_allclose(
+        outs["Loss"], 0.5 * np.sum(diff ** 2, 1, keepdims=True), rtol=1e-4)
+    # center update oracle
+    new_c = centers.copy()
+    for c in range(C):
+        sel = label[:, 0] == c
+        if sel.any():
+            new_c[c] += 0.5 * diff[sel].sum(0) / (sel.sum() + 1.0)
+    np.testing.assert_allclose(outs["CentersOut"], new_c, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_losses(rng):
+    x = rng.uniform(0.1, 0.9, (3, 4, 4)).astype(np.float32)
+    lab = (rng.rand(3, 4, 4) > 0.5).astype(np.float32)
+    outs, _ = run_single_op("dice_loss", {"X": x, "Label": lab},
+                            {"epsilon": 1e-5}, ["Out"])
+    inter = (x * lab).sum((1, 2))
+    union = x.sum((1, 2)) + lab.sum((1, 2))
+    np.testing.assert_allclose(outs["Out"],
+                               1 - (2 * inter + 1e-5) / (union + 1e-5),
+                               rtol=1e-4)
+
+    logits = _r(rng, 6, 1)
+    soft = rng.uniform(0, 1, (6, 1)).astype(np.float32)
+    outs, _ = run_single_op("teacher_student_sigmoid_loss",
+                            {"X": logits, "Label": soft}, {}, ["Y"])
+    z = logits.reshape(-1)
+    l = soft.reshape(-1)
+    expect = np.maximum(z, 0) - z * l + np.log1p(np.exp(-np.abs(z)))
+    np.testing.assert_allclose(outs["Y"][:, 0], expect, rtol=1e-4,
+                               atol=1e-5)
+
+    a, p = _r(rng, 4, 5), _r(rng, 4, 5)
+    labels = np.array([0, 1, 0, 2], np.int64)
+    outs, _ = run_single_op("npair_loss",
+                            {"Anchor": a, "Positive": p, "Labels": labels},
+                            {"l2_reg": 0.002}, ["Out"])
+    sim = a @ p.T
+    t = (labels[:, None] == labels[None, :]).astype(np.float32)
+    t = t / t.sum(1, keepdims=True)
+    lse = np.log(np.exp(sim - sim.max(1, keepdims=True)).sum(1)) \
+        + sim.max(1)
+    xe = (-(t * (sim - lse[:, None])).sum(1)).mean()
+    reg = 0.002 * ((a ** 2).sum() + (p ** 2).sum()) / 4
+    np.testing.assert_allclose(float(outs["Out"]), xe + reg, rtol=1e-4)
+
+
+def test_fsp_and_sq_l2(rng):
+    x, y = _r(rng, 2, 3, 4, 4), _r(rng, 2, 5, 4, 4)
+    outs, _ = run_single_op("fsp", {"X": x, "Y": y}, {}, ["Out"])
+    expect = np.einsum("nchw,ndhw->ncd", x.reshape(2, 3, 4, 4),
+                       y.reshape(2, 5, 4, 4)) / 16.0
+    np.testing.assert_allclose(outs["Out"], expect, rtol=1e-4)
+
+    a, b = _r(rng, 3, 4), _r(rng, 3, 4)
+    outs, _ = run_single_op("squared_l2_distance", {"X": a, "Y": b}, {},
+                            ["Out", "sub_result"])
+    np.testing.assert_allclose(outs["Out"][:, 0],
+                               ((a - b) ** 2).sum(1), rtol=1e-4)
+
+
+def test_unbind(rng):
+    # variadic output: exercise the lowering directly
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid.core.registry import LowerContext, get_op_def
+
+    x = _r(rng, 3, 4, 2)
+    outs = get_op_def("unbind").lower(
+        LowerContext(), {"X": [jnp.asarray(x)]}, {"axis": 0})
+    assert len(outs["Out"]) == 3
+    for i in range(3):
+        np.testing.assert_array_equal(np.asarray(outs["Out"][i]), x[i])
+
+
+# ---------------------------------------------------------------------------
+# layer-level smoke: wrappers build + run inside a program
+# ---------------------------------------------------------------------------
+
+
+def test_layer_wrappers_run(rng):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 4, 8, 8], append_batch_size=False)
+        r1 = layers.resize_bilinear(x, out_shape=[16, 16])
+        r2 = layers.resize_bicubic(x, out_shape=[4, 4])
+        p = layers.pad2d(x, [1, 1, 2, 2], mode="reflect")
+        l = layers.lrn(x)
+        m = layers.maxout(x, groups=2)
+        s = layers.shuffle_channel(x, group=2)
+        u = layers.pixel_unshuffle(x, downscale_factor=2)
+        c = layers.crop_tensor(x, shape=[-1, 2, 4, 4], offsets=[0, 1, 2, 2])
+        fetches = [r1, r2, p, l, m, s, u, c]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = rng.randn(2, 4, 8, 8).astype(np.float32)
+    outs = exe.run(main, feed={"x": xv}, fetch_list=fetches)
+    assert outs[0].shape == (2, 4, 16, 16)
+    assert outs[1].shape == (2, 4, 4, 4)
+    assert outs[2].shape == (2, 4, 10, 12)
+    assert outs[4].shape == (2, 2, 8, 8)
+    assert outs[6].shape == (2, 16, 4, 4)
+    assert outs[7].shape == (2, 2, 4, 4)
+
+
+def test_auc_layer_streaming(rng):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pred = layers.data("pred", shape=[-1, 1], append_batch_size=False)
+        label = layers.data("label", shape=[-1, 1], dtype="int64",
+                            append_batch_size=False)
+        auc_out, _states = layers.auc(pred, label, num_thresholds=1023)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    all_s, all_l = [], []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i in range(3):
+            s = rng.rand(80, 1).astype(np.float32)
+            l = (rng.rand(80, 1) < s).astype(np.int64)
+            all_s.append(s)
+            all_l.append(l)
+            (aucv,) = exe.run(main, feed={"pred": s, "label": l},
+                              fetch_list=[auc_out])
+    s = np.concatenate(all_s).reshape(-1)
+    l = np.concatenate(all_l).reshape(-1)
+    pos, neg = s[l == 1], s[l == 0]
+    oracle = ((pos[:, None] > neg[None, :]).sum()
+              + 0.5 * (pos[:, None] == neg[None, :]).sum()) / (
+                  len(pos) * len(neg))
+    np.testing.assert_allclose(float(aucv[0]), oracle, atol=5e-3)
+
+
+def test_detection_layers_build(rng):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        scores = layers.data("s", shape=[-1, 3, 4, 4],
+                             append_batch_size=False)
+        deltas = layers.data("d", shape=[-1, 12, 4, 4],
+                             append_batch_size=False)
+        im_info = layers.data("ii", shape=[-1, 3], append_batch_size=False)
+        anchors = layers.data("a", shape=[4, 4, 3, 4],
+                              append_batch_size=False)
+        var = layers.data("v", shape=[4, 4, 3, 4], append_batch_size=False)
+        rois, probs = layers.detection.generate_proposals(
+            scores, deltas, im_info, anchors, var,
+            pre_nms_top_n=16, post_nms_top_n=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    outs = exe.run(main, feed={
+        "s": rng.rand(1, 3, 4, 4).astype(np.float32),
+        "d": (0.1 * rng.randn(1, 12, 4, 4)).astype(np.float32),
+        "ii": np.array([[32, 32, 1.0]], np.float32),
+        "a": np.tile(np.array([[0, 0, 15, 15]], np.float32),
+                     (4, 4, 3, 1)).reshape(4, 4, 3, 4),
+        "v": np.ones((4, 4, 3, 4), np.float32),
+    }, fetch_list=[rois, probs])
+    assert outs[0].shape == (1, 4, 4)
+
+
+def test_metric_classes():
+    from paddle_tpu.fluid import metrics
+
+    ce = metrics.ChunkEvaluator()
+    ce.update(np.array([10]), np.array([8]), np.array([6]))
+    p, r, f1 = ce.eval()
+    assert p == 0.6 and r == 0.75
+    np.testing.assert_allclose(f1, 2 * 0.6 * 0.75 / 1.35)
+
+    ed = metrics.EditDistance()
+    ed.update(np.array([[0.0], [2.0], [1.0]]), np.array([3]))
+    avg, err = ed.eval()
+    assert avg == 1.0 and err == pytest.approx(2 / 3)
+
+    dm = metrics.DetectionMAP()
+    dm.update(0.5)
+    dm.update(0.7)
+    assert dm.eval() == pytest.approx(0.6)
+
+
+def test_box_decoder_and_assign(rng):
+    R, C = 5, 3
+    prior = np.abs(_r(rng, R, 4)) * 10
+    prior[:, 2:] += prior[:, :2] + 5  # well-formed boxes
+    pvar = np.full((R, 4), 0.1, np.float32)
+    target = (0.1 * rng.randn(R, C * 4)).astype(np.float32)
+    score = rng.rand(R, C).astype(np.float32)
+    outs, _ = run_single_op(
+        "box_decoder_and_assign",
+        {"PriorBox": prior, "PriorBoxVar": pvar, "TargetBox": target,
+         "BoxScore": score}, {}, ["DecodeBox", "OutputAssignBox"])
+    assert outs["DecodeBox"].shape == (R, C * 4)
+    best = score.argmax(1)
+    for r in range(R):
+        np.testing.assert_allclose(
+            outs["OutputAssignBox"][r],
+            outs["DecodeBox"][r, best[r] * 4:(best[r] + 1) * 4], rtol=1e-5)
+
+
+def test_matrix_rank_absolute_tol(rng):
+    # singular values ~ [100, 0.5]: absolute tol=1.0 must give rank 1
+    u, _ = np.linalg.qr(_r(rng, 2, 2))
+    v, _ = np.linalg.qr(_r(rng, 2, 2))
+    m = (u @ np.diag([100.0, 0.5]) @ v).astype(np.float32)
+    outs, _ = run_single_op("matrix_rank", {"X": m}, {"tol": 1.0}, ["Out"])
+    assert int(outs["Out"]) == 1
