@@ -19,34 +19,82 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 
-def _flops_per_token(cfg, params):
-    """Training FLOPs/token: 6 per matmul-param + exact attention term.
+def _marginal_step_time(step, state, batches, k_short, k_long, reps):
+    """Shared timing harness: min-of-segments marginal step time.
 
-    Matmul params = everything except embedding gather tables
-    (position/token-type) and the word embedding, which IS counted because
-    BertForPretraining ties it to the MLM output projection (one matmul
-    use).  LayerNorm scales/biases are counted too — they are a <0.1%
-    overstatement, dwarfed by what padding/masking understates.
-    Attention scores+context: 2*S*h MACs per token per layer forward
-    (S*h for QK^T + S*h for AV) = 4*S*h FLOPs, 3x for fwd+bwd
-    = 12*L*S*h per token (S = sequence length).
+    Each segment chains K steps through the donated state and ends with a
+    host fetch of the loss VALUE, so a segment cannot finish before the
+    device executed every step in it (honest regardless of how the
+    platform implements block_until_ready — the axon tunnel's did not
+    wait in round 1, implying 179% MFU).  The marginal cost between long
+    and short segments cancels the fixed per-segment dispatch/fetch RTT a
+    production input pipeline would overlap.  Returns (dt, dt_worst,
+    state); dt_worst includes all fixed overhead.
     """
+    def seg(k, i0):
+        nonlocal state
+        t0 = time.perf_counter()
+        loss = None
+        for i in range(i0, i0 + k):
+            state, loss = step(state, batches[i % len(batches)])
+        lv = float(loss)
+        if not np.isfinite(lv):
+            raise RuntimeError("bench loss went non-finite")
+        return time.perf_counter() - t0
+
+    shorts, longs = [], []
+    i0 = 0
+    for _ in range(reps):
+        shorts.append(seg(k_short, i0))
+        i0 += k_short
+        longs.append(seg(k_long, i0))
+        i0 += k_long
+    dt = (min(longs) - min(shorts)) / (k_long - k_short)
+    dt_worst = max(longs) / k_long
+    # plain raise, not assert: the guards must survive python -O
+    if dt <= 0:
+        raise RuntimeError(
+            "non-positive marginal step time (%.1f ms): RTT noise swamped "
+            "the measurement; segment times shorts=%s longs=%s"
+            % (dt * 1e3, shorts, longs))
+    return dt, dt_worst, state
+
+
+def _flops_per_step(cfg, params, B, S, P):
+    """Training FLOPs for one step: 6 per matmul-param-use + exact
+    attention term.
+
+    The MLM head (tied word-embedding decoder + the D x D mlm_transform)
+    runs only on the P masked positions per sequence (the reference
+    BERT/ERNIE static graph gathers mask_pos before the decoder); the
+    transformer trunk runs on all S positions.  Embedding gather tables
+    (word/position/token-type lookups) cost no matmul FLOPs.
+    Attention scores+context: 2*S*h MACs per token per layer forward
+    = 12*L*S*h FLOPs per token for fwd+bwd.
+    """
+    d, v = cfg.hidden_size, cfg.vocab_size
+    head = v * d + d * d + d + v  # tied decoder + mlm_transform (+biases)
     gather_only = 0
-    matmul = 0
-    for name, v in params.items():
-        n = int(np.prod(v.shape))
-        if "position" in name or "token_type" in name:
+    trunk = 0
+    for name, arr in params.items():
+        n = int(np.prod(arr.shape))
+        if ("position" in name or "token_type" in name
+                or "word" in name or "mlm" in name):
             gather_only += n
         else:
-            matmul += n
-    attn = 12.0 * cfg.num_hidden_layers * 1.0 * cfg.hidden_size
-    return lambda seq_len: 6.0 * matmul + attn * seq_len, matmul, gather_only
+            trunk += n
+    attn = 12.0 * cfg.num_hidden_layers * cfg.hidden_size * S
+    per_token_trunk = 6.0 * trunk + attn
+    per_masked = 6.0 * head
+    total = B * S * per_token_trunk + B * P * per_masked
+    return total, trunk, head
 
 
 def main():
@@ -66,15 +114,15 @@ def main():
             intermediate_size=3072, max_position_embeddings=512,
             hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
         )
-        # B=16 is the single-chip MXU sweet spot (B=8: 37.5% MFU, B=16:
-        # 39.2%, B=32: 37.9% measured on v5e)
-        B, S = 16, 512
+        # masked-position MLM shrinks the logits buffer ~6x, which is what
+        # previously capped the batch at 16; B is env-sweepable
+        B, S, P = int(os.getenv("BENCH_B", "32")), 512, 80
         k_short, k_long, reps = 10, 30, 2
         # bf16 peak TFLOP/s for one v5e chip (public spec: 197 bf16)
         peak = 197e12
     else:  # CPU smoke path so the bench never hangs off-TPU
         cfg = models.BertConfig.tiny()
-        B, S = 4, 32
+        B, S, P = 4, 32, 8
         k_short, k_long, reps = 1, 3, 1
         peak = 1e12
 
@@ -87,6 +135,7 @@ def main():
             logits, nsp_logits = m(
                 batch["input_ids"], batch["token_type_ids"],
                 batch["position_ids"],
+                masked_positions=batch["masked_positions"],
             )
             return m.loss(
                 logits, nsp_logits, batch["mlm_labels"],
@@ -99,22 +148,27 @@ def main():
         )
         state = step.init()
         n_params = sum(int(np.prod(v.shape)) for v in state["params"].values())
-        per_tok, matmul_params, gather_params = _flops_per_token(
-            cfg, state["params"]
+        flops_step, trunk_params, head_params = _flops_per_step(
+            cfg, state["params"], B, S, P
         )
 
         rng = np.random.RandomState(0)
 
         def make_batch():
+            pos = np.stack([
+                np.sort(rng.choice(S, size=P, replace=False))
+                for _ in range(B)
+            ]).astype(np.int32)
             return {
                 "input_ids": rng.randint(
                     0, cfg.vocab_size, (B, S)).astype(np.int32),
                 "token_type_ids": np.zeros((B, S), np.int32),
                 "position_ids": np.tile(
                     np.arange(S, dtype=np.int32), (B, 1)),
+                "masked_positions": pos,
                 "mlm_labels": rng.randint(
-                    0, cfg.vocab_size, (B, S)).astype(np.int32),
-                "mlm_weights": (rng.rand(B, S) < 0.15).astype(np.float32),
+                    0, cfg.vocab_size, (B, P)).astype(np.int32),
+                "mlm_weights": np.ones((B, P), np.float32),
                 "nsp_labels": rng.randint(0, 2, (B, 1)).astype(np.int32),
             }
 
@@ -125,55 +179,24 @@ def main():
             state, loss = step(state, batches[i % 4])
         float(loss)
 
-        # Timing: segments of K chained steps, each ending with a host
-        # fetch of the loss *value*.  The final loss depends on the whole
-        # donated-state chain, so a segment cannot finish before the device
-        # executed every step in it — each segment time is an honest lower
-        # bound regardless of how the platform implements
-        # block_until_ready (the axon remote tunnel's did not wait in
-        # round 1, implying 179% MFU).  Steady-state step time is the
-        # marginal cost between a long and a short segment, which cancels
-        # the fixed per-segment dispatch/fetch RTT (~150 ms over the
-        # tunnel) that a production input pipeline would overlap.
-        def timed_segment(k, i0):
-            t0 = time.perf_counter()
-            nonlocal state
-            loss = None
-            for i in range(i0, i0 + k):
-                state, loss = step(state, batches[i % 4])
-            lv = float(loss)
-            if not np.isfinite(lv):
-                raise RuntimeError("bench loss went non-finite")
-            return time.perf_counter() - t0
+        # pre-place the batches on device (a production input pipeline
+        # double-buffers transfers; over the axon tunnel an in-loop
+        # device_put would bill network bandwidth to the step time)
+        batches = [step.place_batch(b) for b in batches]
 
-        shorts, longs = [], []
-        i0 = 0
-        for _ in range(reps):
-            shorts.append(timed_segment(k_short, i0))
-            i0 += k_short
-            longs.append(timed_segment(k_long, i0))
-            i0 += k_long
-        dt = (min(longs) - min(shorts)) / (k_long - k_short)
-        dt_worst = max(longs) / k_long  # includes all fixed overhead
-        # plain raise, not assert: the guards must survive python -O
-        if dt <= 0:
-            raise RuntimeError(
-                "non-positive marginal step time (%.1f ms): RTT noise "
-                "swamped the measurement; segment times shorts=%s longs=%s"
-                % (dt * 1e3, shorts, longs)
-            )
+        dt, dt_worst, state = _marginal_step_time(
+            step, state, batches, k_short, k_long, reps)
 
     tokens_per_sec = B * S / dt
-    flops_per_tok = per_tok(S)
-    mfu = tokens_per_sec * flops_per_tok / peak
+    mfu = (flops_step / dt) / peak
     print(
-        "bench: marginal step %.2f ms over %dx(%d,%d)-step segments "
-        "(conservative incl. dispatch RTT: %.2f ms), %.0f tokens/s, "
-        "params=%.1fM (matmul %.1fM, gather-only %.1fM), "
-        "%.0f MFLOP/token, implied MFU %.1f%%"
-        % (dt * 1e3, reps, k_short, k_long, dt_worst * 1e3,
-           tokens_per_sec, n_params / 1e6, matmul_params / 1e6,
-           gather_params / 1e6, flops_per_tok / 1e6, mfu * 100),
+        "bench: B=%d S=%d P=%d marginal step %.2f ms over %dx(%d,%d)-step "
+        "segments (conservative incl. dispatch RTT: %.2f ms), %.0f "
+        "tokens/s, params=%.1fM (trunk %.1fM, head %.1fM on P rows), "
+        "%.1f GFLOP/step, implied MFU %.1f%%"
+        % (B, S, P, dt * 1e3, reps, k_short, k_long, dt_worst * 1e3,
+           tokens_per_sec, n_params / 1e6, trunk_params / 1e6,
+           head_params / 1e6, flops_step / 1e9, mfu * 100),
         file=sys.stderr,
     )
     if mfu > 1.0:
@@ -181,12 +204,82 @@ def main():
             "implied MFU %.1f%% exceeds physical peak — measurement or FLOP "
             "accounting is wrong; refusing to report" % (mfu * 100)
         )
-    print(json.dumps({
+
+    resnet = None
+    if on_tpu or os.getenv("BENCH_RESNET"):
+        try:
+            resnet = _bench_resnet(on_tpu, peak)
+        except Exception as e:  # the headline metric must still report
+            print("resnet bench failed: %r" % (e,), file=sys.stderr)
+
+    out = {
         "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.35, 4),
-    }))
+    }
+    if resnet is not None:
+        out["extra"] = resnet
+    print(json.dumps(out))
+
+
+def _bench_resnet(on_tpu, peak):
+    """Milestone-5 metric (BASELINE.md): ResNet-50 train images/sec on one
+    chip.  FLOP model: 4.09 GFLOP forward per 224x224 image (the standard
+    published count for ResNet-50 v1.5), x3 for fwd+bwd."""
+    import time
+
+    import jax
+
+    from paddle_tpu import distributed as dist
+    from paddle_tpu import models
+    from paddle_tpu.fluid import dygraph, layers
+    from paddle_tpu.fluid.optimizer import MomentumOptimizer
+
+    if on_tpu:
+        B, HW, k_short, k_long, reps = (
+            int(os.getenv("BENCH_RESNET_B", "64")), 224, 10, 30, 2)
+        depth, flops_img = 50, 3 * 4.089e9
+    else:
+        B, HW, k_short, k_long, reps = 4, 32, 1, 3, 1
+        depth, flops_img = 18, 3 * 0.3e9
+
+    with dygraph.guard():
+        model = models.ResNet(depth=depth, num_classes=1000)
+        opt = MomentumOptimizer(learning_rate=0.1, momentum=0.9)
+        mesh = dist.auto_mesh(1)
+
+        def loss_fn(m, batch):
+            logits = m(batch["image"])
+            return layers.mean(layers.softmax_with_cross_entropy(
+                logits, batch["label"]))
+
+        step = dist.ShardedTrainStep(
+            model, opt, loss_fn, mesh, zero_stage=0,
+            amp="bf16" if on_tpu else None,
+        )
+        state = step.init()
+        rng = np.random.RandomState(0)
+        batches = [{
+            "image": rng.randn(B, 3, HW, HW).astype(np.float32),
+            "label": rng.randint(0, 1000, (B, 1)).astype(np.int32),
+        } for _ in range(2)]
+        for i in range(2):
+            state, loss = step(state, batches[i % 2])
+        float(loss)
+        batches = [step.place_batch(b) for b in batches]
+
+        dt, _dt_worst, state = _marginal_step_time(
+            step, state, batches, k_short, k_long, reps)
+    imgs = B / dt
+    mfu = imgs * flops_img / peak
+    print("resnet%d bench: B=%d step %.2f ms, %.1f images/s, implied "
+          "MFU %.1f%%" % (depth, B, dt * 1e3, imgs, mfu * 100),
+          file=sys.stderr)
+    return {
+        "resnet50_train_images_per_sec_per_chip": round(imgs, 2),
+        "resnet50_implied_mfu": round(mfu, 4),
+    }
 
 
 if __name__ == "__main__":

@@ -89,7 +89,12 @@ def megatron_rule():
     """
     return ShardingRule(
         rules=[
-            (r"(q_proj|k_proj|v_proj|fc1|mlm_transform)\.weight", (None, "tp")),
+            # fused qkv stays REPLICATED under tp: its q/k/v slice
+            # boundaries (d, 2d) do not align with contiguous tp shards of
+            # the 3d output dim unless tp % 3 == 0, and the resharding
+            # collectives would cost more than the sharding saves
+            (r"(q_proj|k_proj|v_proj|fc1|mlm_transform)\.weight",
+             (None, "tp")),
             (r"(q_proj|k_proj|v_proj|fc1)\.bias", ("tp",)),
             (r"(out_proj|fc2)\.weight", ("tp", None)),
             # MoE experts shard on ep (gate replicated); w1 column-parallel

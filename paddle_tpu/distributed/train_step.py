@@ -290,10 +290,11 @@ class ShardedTrainStep:
                 # scaling).  AD transposes the param cast, so grads arrive
                 # already fp32 for the update ops.
                 def amp_loss(p32, batch, key):
-                    p16 = jax.tree.map(
-                        lambda x: x.astype(jnp.bfloat16)
-                        if x.dtype == jnp.float32 else x, p32)
-                    return loss_of(p16, batch, key).astype(jnp.float32)
+                    cast = (lambda x: x.astype(jnp.bfloat16)
+                            if x.dtype == jnp.float32 else x)
+                    p16 = jax.tree.map(cast, p32)
+                    b16 = jax.tree.map(cast, batch)
+                    return loss_of(p16, b16, key).astype(jnp.float32)
 
                 loss, grads = jax.value_and_grad(amp_loss)(params, batch, key)
             else:
@@ -325,6 +326,15 @@ class ShardedTrainStep:
             out_shardings=(state_sh, loss_sh),
             donate_argnums=(0,),
         )
+
+    def place_batch(self, batch):
+        """Pre-place a host batch on the mesh with the step's feed
+        shardings (double-buffer staging: call on batch t+1 while step t
+        runs; __call__ then sees correctly-placed arrays and skips the
+        transfer)."""
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        sh = self._batch_sharding(batch)
+        return {k: jax.device_put(v, sh[k]) for k, v in batch.items()}
 
     def __call__(self, train_state, batch):
         batch = {k: jnp.asarray(v) for k, v in batch.items()}

@@ -197,6 +197,16 @@ class Layer:
     def set_state_dict(self, state_dict, include_sublayers=True):
         own = self.state_dict(include_sublayers)
         missing = [k for k in own if k not in state_dict]
+        if missing:
+            import warnings
+
+            warnings.warn(
+                "set_state_dict: %d parameter(s) missing from the "
+                "checkpoint were left at their current values: %s%s"
+                % (len(missing), ", ".join(missing[:5]),
+                   "..." if len(missing) > 5 else ""),
+                stacklevel=2,
+            )
         for name, var in own.items():
             if name not in state_dict:
                 continue

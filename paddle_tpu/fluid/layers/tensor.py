@@ -174,6 +174,12 @@ def gather(input, index, axis=0):
     return append_simple_op("gather", {"X": input, "Index": index}, {"axis": axis})
 
 
+def take_along_axis(input, index, axis):
+    return append_simple_op(
+        "take_along_axis", {"Input": input, "Index": index},
+        {"Axis": int(axis)}, out_slots=("Result",))
+
+
 def gather_nd(input, index):
     return append_simple_op("gather_nd", {"X": input, "Index": index})
 

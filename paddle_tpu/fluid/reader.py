@@ -129,6 +129,19 @@ class BatchSampler:
         return (self.n + self.batch_size - 1) // self.batch_size
 
 
+def _mp_worker_main(dataset, collate, task_q, res_q):
+    """DataLoader worker entry (module-level: spawn pickles it)."""
+    while True:
+        item = task_q.get()
+        if item is None:
+            return
+        i, idx = item
+        try:
+            res_q.put((i, collate([dataset[j] for j in idx]), None))
+        except Exception as e:  # surface in the parent
+            res_q.put((i, None, "%s: %s" % (type(e).__name__, e)))
+
+
 def default_collate(items):
     """list of tuples -> tuple of stacked arrays."""
     transposed = list(zip(*items))
@@ -151,6 +164,7 @@ class DataLoader:
         self.feed_list = feed_list
         self.capacity = max(2, capacity)
         self.collate_fn = collate_fn or default_collate
+        self.num_workers = max(0, int(num_workers))
         self._gen = None
         if dataset is not None:
             self.batch_sampler = batch_sampler or BatchSampler(
@@ -185,8 +199,89 @@ class DataLoader:
         if self._gen is not None:
             yield from self._gen()
             return
+        if self.num_workers > 0:
+            yield from self._mp_batches()
+            return
         for indices in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _mp_batches(self):
+        """Multiprocess map-style loading (reference dataloader_iter.py
+        _DataLoaderIterMultiProcess capability): N spawned workers pull
+        index lists from a task queue and push collated numpy batches
+        back; the parent reassembles them IN ORDER.
+
+        Spawn (not fork): the parent runs a multithreaded JAX runtime and
+        forking it is the textbook deadlock; spawn requires the dataset /
+        collate_fn to be picklable, same contract as the reference's
+        multiprocess workers.  Tasks are issued through a bounded window
+        so a straggler batch cannot let the others run arbitrarily far
+        ahead (the in-order buffer stays <= window batches), and the
+        result wait polls worker liveness so a killed worker raises
+        instead of hanging the trainer."""
+        import multiprocessing as mp
+        import queue as _queue
+
+        ctx = mp.get_context("spawn")
+        batches = list(self.batch_sampler)
+        if not batches:
+            return
+        workers = min(self.num_workers, len(batches))
+        window = max(2 * workers, self.capacity)
+        task_q = ctx.Queue()
+        res_q = ctx.Queue()
+
+        procs = [
+            ctx.Process(
+                target=_mp_worker_main,
+                args=(self.dataset, self.collate_fn, task_q, res_q),
+                daemon=True,
+            )
+            for _ in range(workers)
+        ]
+        for p in procs:
+            p.start()
+        issued = 0
+        done_sent = 0
+
+        def issue_up_to(limit):
+            nonlocal issued, done_sent
+            while issued < min(limit, len(batches)):
+                task_q.put((issued, batches[issued]))
+                issued += 1
+            if issued == len(batches) and done_sent < len(procs):
+                for _ in range(len(procs) - done_sent):
+                    task_q.put(None)
+                done_sent = len(procs)
+
+        try:
+            issue_up_to(window)
+            pending = {}
+            next_i = 0
+            received = 0
+            while received < len(batches):
+                try:
+                    i, b, e = res_q.get(timeout=5.0)
+                except _queue.Empty:
+                    if not any(p.is_alive() for p in procs):
+                        raise RuntimeError(
+                            "all DataLoader workers died without "
+                            "delivering results (OOM-killed?)")
+                    continue
+                received += 1
+                if e is not None:
+                    raise RuntimeError(
+                        "DataLoader worker failed on batch %d: %s" % (i, e))
+                pending[i] = b
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+                    issue_up_to(next_i + window)
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join(timeout=5)
 
     def __iter__(self):
         q = queue.Queue(maxsize=self.capacity)

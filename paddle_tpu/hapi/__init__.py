@@ -4,5 +4,12 @@ Capability parity: reference `python/paddle/incubate/hapi/` — `model.py`
 (Model.fit/evaluate/predict with static+dygraph adapters), `callbacks.py`.
 """
 
-from .callbacks import Callback, ModelCheckpoint, ProgBarLogger  # noqa: F401
+from . import datasets, text, vision  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRSchedulerCallback,
+    ModelCheckpoint,
+    ProgBarLogger,
+)
 from .model import Model  # noqa: F401

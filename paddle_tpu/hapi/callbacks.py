@@ -69,3 +69,76 @@ class ModelCheckpoint(Callback):
             import os
 
             self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+
+class EarlyStopping(Callback):
+    """cf. reference (2.0) EarlyStopping: stop fit() when a monitored
+    value stops improving; optionally restore the best weights."""
+
+    def __init__(self, monitor="loss", mode="min", patience=0,
+                 min_delta=0.0, baseline=None, save_best_model=True):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best = save_best_model
+        if mode not in ("min", "max"):
+            mode = "min"
+        self.mode = mode
+        self.stopped_epoch = None
+
+    def on_train_begin(self, logs=None):
+        import numpy as np
+
+        self.wait = 0
+        self.best = (np.inf if self.mode == "min" else -np.inf) \
+            if self.baseline is None else self.baseline
+        self._best_state = None
+        self.model.stop_training = False
+
+    def _improved(self, cur):
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur)
+        if self._improved(cur):
+            self.best = cur
+            self.wait = 0
+            if self.save_best:
+                import jax.numpy as jnp
+
+                self._best_state = {
+                    k: jnp.asarray(v.data)
+                    for k, v in self.model.network.state_dict().items()
+                }
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.model.stop_training = True
+                self.stopped_epoch = epoch
+
+    def on_train_end(self, logs=None):
+        if self.save_best and self._best_state is not None:
+            sd = self.model.network.state_dict()
+            for k, v in self._best_state.items():
+                sd[k].data = v
+
+
+class LRSchedulerCallback(Callback):
+    """Step an LR schedule (callable epoch -> lr) on each epoch end."""
+
+    def __init__(self, schedule):
+        self.schedule = schedule
+
+    def on_epoch_end(self, epoch, logs=None):
+        lr = float(self.schedule(epoch))
+        opt = self.model._optimizer
+        if hasattr(opt, "set_lr"):
+            opt.set_lr(lr)
+        else:
+            opt._learning_rate = lr

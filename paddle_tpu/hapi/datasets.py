@@ -1,0 +1,83 @@
+"""hapi.datasets: map-style datasets over the reader-creator corpus
+modules (cf. reference `incubate/hapi/datasets/` MNIST/Flowers/IMDB —
+each wraps the legacy paddle.dataset readers into indexable datasets)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _ArrayDataset:
+    """Indexable (x, y) dataset; also iterable as (x, y) batches source
+    for Model.fit via the (xs, ys) tuple protocol."""
+
+    def __init__(self, xs, ys):
+        self.xs = np.asarray(xs)
+        self.ys = np.asarray(ys)
+
+    def __len__(self):
+        return len(self.xs)
+
+    def __getitem__(self, i):
+        return self.xs[i], self.ys[i]
+
+    def as_arrays(self):
+        return self.xs, self.ys
+
+
+class MNIST(_ArrayDataset):
+    """cf. hapi/datasets/mnist.py: mode train|test, images [N,1,28,28]."""
+
+    def __init__(self, mode="train", n=None):
+        from ..dataset import mnist
+
+        reader = mnist.train() if mode == "train" else mnist.test()
+        xs, ys = [], []
+        for img, label in reader():
+            xs.append(np.asarray(img, np.float32).reshape(1, 28, 28))
+            ys.append(int(label))
+            if n is not None and len(xs) >= n:
+                break
+        super().__init__(np.stack(xs), np.asarray(ys, np.int64))
+
+
+class Cifar10(_ArrayDataset):
+    def __init__(self, mode="train", n=None):
+        from ..dataset import cifar
+
+        reader = cifar.train10() if mode == "train" else cifar.test10()
+        xs, ys = [], []
+        for img, label in reader():
+            xs.append(np.asarray(img, np.float32).reshape(3, 32, 32))
+            ys.append(int(label))
+            if n is not None and len(xs) >= n:
+                break
+        super().__init__(np.stack(xs), np.asarray(ys, np.int64))
+
+
+class Imdb:
+    """cf. hapi/datasets/imdb.py: padded id sequences + labels."""
+
+    def __init__(self, mode="train", seq_len=64, n=None):
+        from ..dataset import imdb
+
+        reader = imdb.train() if mode == "train" else imdb.test()
+        xs, ys = [], []
+        for seq, label in reader():
+            arr = np.zeros(seq_len, np.int64)
+            arr[: min(len(seq), seq_len)] = seq[:seq_len]
+            xs.append(arr)
+            ys.append(int(label))
+            if n is not None and len(xs) >= n:
+                break
+        self.xs = np.stack(xs)
+        self.ys = np.asarray(ys, np.int64)
+
+    def __len__(self):
+        return len(self.xs)
+
+    def __getitem__(self, i):
+        return self.xs[i], self.ys[i]
+
+    def as_arrays(self):
+        return self.xs, self.ys

@@ -40,6 +40,7 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        self.stop_training = False  # set by EarlyStopping
 
     def prepare(self, optimizer=None, loss_function=None, metrics=None):
         """cf. reference Model.prepare(optimizer, loss, metrics)."""
@@ -49,11 +50,18 @@ class Model:
         return self
 
     # -- steps ----------------------------------------------------------
+    @staticmethod
+    def _wrap_inputs(inputs):
+        """A network may take one array or a list of feature arrays."""
+        if isinstance(inputs, (list, tuple)):
+            return [to_variable(np.asarray(a)) for a in inputs]
+        return [to_variable(np.asarray(inputs))]
+
     def train_batch(self, inputs, labels):
-        x = to_variable(np.asarray(inputs))
+        xs = self._wrap_inputs(inputs)
         y = to_variable(np.asarray(labels))
         self.network.train()
-        pred = self.network(x)
+        pred = self.network(*xs)
         loss = self._loss(pred, y)
         loss.backward()
         self._optimizer.minimize(loss, parameter_list=self.network.parameters())
@@ -63,24 +71,29 @@ class Model:
     def eval_batch(self, inputs, labels):
         self.network.eval()
         with dygraph.no_grad():
-            pred = self.network(to_variable(np.asarray(inputs)))
+            pred = self.network(*self._wrap_inputs(inputs))
             loss = self._loss(pred, to_variable(np.asarray(labels)))
         return float(loss.numpy()), pred.numpy()
 
     def predict_batch(self, inputs):
         self.network.eval()
         with dygraph.no_grad():
-            return self.network(to_variable(np.asarray(inputs))).numpy()
+            return self.network(*self._wrap_inputs(inputs)).numpy()
 
     # -- loops ----------------------------------------------------------
     def fit(self, train_data, eval_data=None, batch_size=32, epochs=1,
-            verbose=1, callbacks=None, shuffle=True, log_freq=10):
+            eval_freq=1, verbose=1, callbacks=None, shuffle=True,
+            log_freq=10):
+        """cf. reference Model.fit: epochs over train_data with eval every
+        `eval_freq` epochs, callbacks driving logging/checkpoint/early
+        stop (reference model.py fit + callbacks.py)."""
         cbs = list(callbacks or [])
         if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
             cbs.append(ProgBarLogger(log_freq=log_freq, verbose=verbose))
         for c in cbs:
             c.set_model(self)
             c.on_train_begin()
+        self.stop_training = False
         history = {"loss": []}
         for epoch in range(epochs):
             for c in cbs:
@@ -98,13 +111,16 @@ class Model:
                     c.on_train_batch_end(step, {"loss": loss})
             logs = {"loss": float(np.mean(losses))}
             logs.update(self._eval_metrics())
-            if eval_data is not None:
+            if eval_data is not None and (
+                    epoch % max(eval_freq, 1) == 0 or epoch == epochs - 1):
                 logs["eval_loss"] = self.evaluate(
                     eval_data, batch_size=batch_size, verbose=0
                 )["loss"]
             history["loss"].append(logs["loss"])
             for c in cbs:
                 c.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
         for c in cbs:
             c.on_train_end()
         return history
@@ -123,8 +139,9 @@ class Model:
 
     def predict(self, test_data, batch_size=32):
         outs = []
-        for batch in _to_batches((test_data, test_data), batch_size):
-            outs.append(self.predict_batch(batch[0]))
+        n = len(test_data)
+        for i in range(0, n, batch_size):
+            outs.append(self.predict_batch(test_data[i:i + batch_size]))
         return np.concatenate(outs, axis=0)
 
     # -- metrics --------------------------------------------------------

@@ -1,0 +1,8 @@
+"""hapi.text: text model zoo exposure (cf. reference
+`incubate/hapi/text/` bert/transformer modules)."""
+
+from ..models.bert import BertConfig, BertForPretraining, BertModel
+from ..models.transformer import Transformer, TransformerConfig
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "Transformer", "TransformerConfig"]

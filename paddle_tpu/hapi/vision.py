@@ -1,0 +1,43 @@
+"""hapi.vision: model zoo + transforms exposure (cf. reference
+`incubate/hapi/vision/models/` lenet/resnet/vgg/mobilenet and
+`vision/transforms/`)."""
+
+from ..models.lenet import LeNet5
+from ..models.resnet import ResNet, resnet18, resnet34, resnet50, resnet101
+
+LeNet = LeNet5  # reference hapi name
+
+__all__ = ["LeNet", "LeNet5", "ResNet", "resnet18", "resnet34",
+           "resnet50", "resnet101", "transforms"]
+
+
+class transforms:
+    """Minimal functional transforms (cf. hapi/vision/transforms):
+    compose, normalize, resize over numpy batches."""
+
+    @staticmethod
+    def normalize(x, mean, std):
+        import numpy as np
+
+        mean = np.asarray(mean, np.float32).reshape(1, -1, 1, 1)
+        std = np.asarray(std, np.float32).reshape(1, -1, 1, 1)
+        return (np.asarray(x, np.float32) - mean) / std
+
+    @staticmethod
+    def resize(x, size):
+        import jax
+        import numpy as np
+
+        x = np.asarray(x, np.float32)
+        n, c = x.shape[:2]
+        return np.asarray(jax.image.resize(
+            x, (n, c, size[0], size[1]), method="linear"))
+
+    class Compose:
+        def __init__(self, fns):
+            self.fns = list(fns)
+
+        def __call__(self, x):
+            for f in self.fns:
+                x = f(x)
+            return x

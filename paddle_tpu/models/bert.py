@@ -63,17 +63,56 @@ def _winit(cfg):
     return ParamAttr(initializer=NormalInitializer(0.0, cfg.initializer_range))
 
 
+def convert_legacy_qkv_state_dict(state_dict, target_keys):
+    """Fuse pre-fusion checkpoints (separate q_proj/k_proj/v_proj weights)
+    into the fused qkv_proj layout so old checkpoints keep loading."""
+    import numpy as np
+
+    def val(v):
+        return np.asarray(getattr(v, "data", v))
+
+    out = dict(state_dict)
+    for key in target_keys:
+        if not key.endswith("qkv_proj.weight") or key in out:
+            continue
+        base = key[: -len("qkv_proj.weight")]
+        try:
+            w = [val(out.pop(base + p + "_proj.weight"))
+                 for p in ("q", "k", "v")]
+            b = [val(out.pop(base + p + "_proj.bias"))
+                 for p in ("q", "k", "v")]
+        except KeyError:
+            continue
+        out[key] = np.concatenate(w, axis=1)
+        out[base + "qkv_proj.bias"] = np.concatenate(b, axis=0)
+    return out
+
+
+class _QkvCompatMixin:
+    def set_state_dict(self, state_dict, include_sublayers=True):
+        state_dict = convert_legacy_qkv_state_dict(
+            state_dict, self.state_dict(include_sublayers).keys())
+        return super().set_state_dict(state_dict, include_sublayers)
+
+
 class MultiHeadAttention(dygraph.Layer):
     """Self/cross attention over the fused flash_attention op."""
 
-    def __init__(self, cfg, d_model=None, n_head=None, dropout=None):
+    def __init__(self, cfg, d_model=None, n_head=None, dropout=None,
+                 self_attention=False):
         super().__init__()
         d = d_model or cfg.hidden_size
         self.n_head = n_head or cfg.num_attention_heads
         self.d_head = d // self.n_head
-        self.q_proj = dygraph.Linear(d, d, param_attr=_winit(cfg))
-        self.k_proj = dygraph.Linear(d, d, param_attr=_winit(cfg))
-        self.v_proj = dygraph.Linear(d, d, param_attr=_winit(cfg))
+        self.fused_qkv = bool(self_attention)
+        if self.fused_qkv:
+            # self-attention: ONE fused [D, 3D] projection (one MXU matmul
+            # instead of three; megatron fused-qkv column-parallel layout)
+            self.qkv_proj = dygraph.Linear(d, 3 * d, param_attr=_winit(cfg))
+        else:
+            self.q_proj = dygraph.Linear(d, d, param_attr=_winit(cfg))
+            self.k_proj = dygraph.Linear(d, d, param_attr=_winit(cfg))
+            self.v_proj = dygraph.Linear(d, d, param_attr=_winit(cfg))
         self.out_proj = dygraph.Linear(d, d, param_attr=_winit(cfg))
         self.dropout = dygraph.Dropout(
             dropout if dropout is not None else cfg.attention_probs_dropout_prob,
@@ -91,9 +130,20 @@ class MultiHeadAttention(dygraph.Layer):
         value = value if value is not None else key
         q_len = int(query.shape[1])
         kv_len = int(key.shape[1])
-        q = self._split(self.q_proj(query), q_len)
-        k = self._split(self.k_proj(key), kv_len)
-        v = self._split(self.v_proj(value), kv_len)
+        if self.fused_qkv:
+            if key is not query or value is not key:
+                raise ValueError(
+                    "fused-qkv attention is self-attention only; build "
+                    "with self_attention=False for cross attention")
+            qkv = self.qkv_proj(query)           # [B, S, 3D]
+            d = self.n_head * self.d_head
+            q = self._split(layers.slice(qkv, [2], [0], [d]), q_len)
+            k = self._split(layers.slice(qkv, [2], [d], [2 * d]), kv_len)
+            v = self._split(layers.slice(qkv, [2], [2 * d], [3 * d]), kv_len)
+        else:
+            q = self._split(self.q_proj(query), q_len)
+            k = self._split(self.k_proj(key), kv_len)
+            v = self._split(self.v_proj(value), kv_len)
         ins = {"Q": q, "K": k, "V": v}
         if attn_bias is not None:
             ins["Bias"] = attn_bias
@@ -128,7 +178,7 @@ class TransformerEncoderLayer(dygraph.Layer):
     def __init__(self, cfg):
         super().__init__()
         d = cfg.hidden_size
-        self.attn = MultiHeadAttention(cfg)
+        self.attn = MultiHeadAttention(cfg, self_attention=True)
         self.ln1 = dygraph.LayerNorm(d)
         self.fc1 = dygraph.Linear(d, cfg.intermediate_size, param_attr=_winit(cfg))
         self.fc2 = dygraph.Linear(cfg.intermediate_size, d, param_attr=_winit(cfg))
@@ -171,7 +221,7 @@ class BertEmbeddings(dygraph.Layer):
         return self.dropout(self.ln(emb))
 
 
-class BertModel(dygraph.Layer):
+class BertModel(_QkvCompatMixin, dygraph.Layer):
     def __init__(self, cfg: BertConfig):
         super().__init__()
         self.cfg = cfg
@@ -214,7 +264,7 @@ def _first_token(h):
     return layers.reshape(s, [0, int(h.shape[-1])])
 
 
-class BertForPretraining(dygraph.Layer):
+class BertForPretraining(_QkvCompatMixin, dygraph.Layer):
     """MLM + NSP heads (BERT pretrain objective; ERNIE-1.0 uses the same
     framework path with different masking)."""
 
@@ -231,11 +281,27 @@ class BertForPretraining(dygraph.Layer):
         self.nsp = dygraph.Linear(d, 2, param_attr=_winit(cfg))
 
     def forward(self, input_ids, token_type_ids, position_ids,
-                attention_mask=None, segment_ids=None):
+                attention_mask=None, segment_ids=None,
+                masked_positions=None):
+        """masked_positions: optional [B, P] int positions of the masked
+        tokens.  When given, the MLM head runs only on those P rows
+        (reference BERT/ERNIE static graph gathers mask_pos before the
+        decoder matmul) — the full-vocab projection drops from S to P
+        positions, ~15-20% of total pretrain FLOPs at S=512."""
         seq, pooled = self.bert(
             input_ids, token_type_ids, position_ids, attention_mask,
             segment_ids=segment_ids,
         )
+        if masked_positions is not None:
+            import numpy as _np
+
+            if isinstance(masked_positions, _np.ndarray):
+                from ..fluid.dygraph import to_variable
+
+                masked_positions = to_variable(masked_positions)
+            idx = layers.reshape(
+                masked_positions, list(masked_positions.shape) + [1])
+            seq = layers.take_along_axis(seq, idx, axis=1)  # [B, P, D]
         h = self.mlm_ln(self.mlm_transform(seq))
         logits = layers.matmul(
             h, self.bert.embeddings.word.weight, transpose_y=True

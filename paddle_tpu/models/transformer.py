@@ -78,6 +78,7 @@ class EncoderLayer(dygraph.Layer):
         super().__init__()
         self.ln1 = dygraph.LayerNorm(cfg.d_model)
         self.attn = MultiHeadAttention(bcfg, d_model=cfg.d_model,
+                                       self_attention=True,
                                        n_head=cfg.n_head, dropout=cfg.dropout)
         self.ln2 = dygraph.LayerNorm(cfg.d_model)
         self.ffn = _FFN(cfg, bcfg)
@@ -92,6 +93,7 @@ class DecoderLayer(dygraph.Layer):
         super().__init__()
         self.ln1 = dygraph.LayerNorm(cfg.d_model)
         self.self_attn = MultiHeadAttention(bcfg, d_model=cfg.d_model,
+                                            self_attention=True,
                                             n_head=cfg.n_head, dropout=cfg.dropout)
         self.ln2 = dygraph.LayerNorm(cfg.d_model)
         self.cross_attn = MultiHeadAttention(bcfg, d_model=cfg.d_model,

@@ -1,0 +1,103 @@
+"""HAPI Model.fit/evaluate/predict + callbacks + vision/text/datasets
+(reference incubate/hapi/model.py, callbacks.py, datasets/, vision/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.hapi as hapi
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.fluid.optimizer import AdamOptimizer
+
+
+def _loss_fn(pred, label):
+    from paddle_tpu.fluid import layers
+
+    return layers.mean(
+        layers.softmax_with_cross_entropy(pred, layers.reshape(label,
+                                                               [-1, 1])))
+
+
+def test_hapi_fit_mnist_with_callbacks(tmp_path, capsys):
+    with dygraph.guard():
+        ds = hapi.datasets.MNIST(mode="train", n=256)
+        eval_ds = hapi.datasets.MNIST(mode="test", n=64)
+        model = hapi.Model(hapi.vision.LeNet())
+        model.prepare(AdamOptimizer(learning_rate=1e-3), _loss_fn)
+        ckpt_dir = str(tmp_path / "ckpts")
+        os.makedirs(ckpt_dir)
+        es = hapi.EarlyStopping(monitor="loss", patience=10)
+        hist = model.fit(
+            ds.as_arrays(), eval_data=eval_ds.as_arrays(),
+            batch_size=64, epochs=3, eval_freq=2, log_freq=2,
+            callbacks=[hapi.ModelCheckpoint(save_freq=1,
+                                            save_dir=ckpt_dir), es])
+        assert len(hist["loss"]) == 3
+        assert hist["loss"][-1] < hist["loss"][0]
+        # checkpoints written per epoch
+        assert os.path.exists(os.path.join(ckpt_dir, "0.pdparams"))
+        # eval scheduled on epochs 0, 2 (freq 2) and the last epoch
+        out = capsys.readouterr().out
+        assert "epoch 0" in out and "epoch 2 end" in out
+
+        # predict + evaluate round out the API
+        preds = model.predict(eval_ds.xs[:32], batch_size=16)
+        assert preds.shape[0] == 32
+        ev = model.evaluate(eval_ds.as_arrays(), batch_size=32)
+        assert np.isfinite(ev["loss"])
+
+        # save / load round trip
+        path = str(tmp_path / "m")
+        model.save(path)
+        model2 = hapi.Model(hapi.vision.LeNet())
+        model2.prepare(AdamOptimizer(learning_rate=1e-3), _loss_fn)
+        model2.load(path)
+        p2 = model2.predict(eval_ds.xs[:8], batch_size=8)
+        np.testing.assert_allclose(p2, preds[:8], rtol=1e-5, atol=1e-6)
+
+
+def test_hapi_early_stopping_restores_best(tmp_path):
+    """EarlyStopping halts on a plateauing metric and restores the best
+    weights (reference 2.0 EarlyStopping semantics)."""
+
+    with dygraph.guard():
+        ds = hapi.datasets.MNIST(mode="train", n=128)
+        model = hapi.Model(hapi.vision.LeNet())
+        model.prepare(AdamOptimizer(learning_rate=1e-3), _loss_fn)
+        # min_delta=0.2: once per-epoch improvement drops under 0.2 the
+        # patience counter runs out and fit halts early
+        es = hapi.EarlyStopping(monitor="loss", patience=1, min_delta=0.2,
+                                save_best_model=True)
+        hist = model.fit(ds.as_arrays(), batch_size=64, epochs=12,
+                         verbose=0, callbacks=[es])
+        assert len(hist["loss"]) < 12, "early stopping never triggered"
+        assert es.stopped_epoch is not None
+        # best-weight restore leaves the model near its best epoch
+        ev = model.evaluate(ds.as_arrays(), batch_size=64)
+        assert ev["loss"] <= es.best + 0.2
+
+
+def test_hapi_lr_scheduler_callback():
+    with dygraph.guard():
+        ds = hapi.datasets.MNIST(mode="train", n=64)
+        model = hapi.Model(hapi.vision.LeNet())
+        opt = AdamOptimizer(learning_rate=1e-3)
+        model.prepare(opt, _loss_fn)
+        sched = hapi.LRSchedulerCallback(lambda ep: 1e-3 * (0.5 ** ep))
+        model.fit(ds.as_arrays(), batch_size=32, epochs=3, verbose=0,
+                  callbacks=[sched])
+        lr_var = opt._global_learning_rate()
+        lr = float(np.asarray(getattr(lr_var, "data", lr_var)).reshape(-1)[0])
+        np.testing.assert_allclose(lr, 1e-3 * 0.25, rtol=1e-6)
+
+
+def test_hapi_text_and_vision_zoo_exposed():
+    assert hapi.text.BertModel is not None
+    assert hapi.text.Transformer is not None
+    assert hapi.vision.resnet50 is not None
+    x = np.random.RandomState(0).rand(2, 3, 8, 8).astype(np.float32)
+    n = hapi.vision.transforms.normalize(x, [0.5] * 3, [0.5] * 3)
+    assert n.shape == x.shape
+    r = hapi.vision.transforms.resize(x, (16, 16))
+    assert r.shape == (2, 3, 16, 16)

@@ -148,3 +148,39 @@ def test_flash_attention_causal():
     ))
     # position 0 attends only to key 0 -> output equals v[0]
     np.testing.assert_allclose(out[0, 0, 0], v[0, 0, 0], rtol=1e-5)
+
+
+def test_bert_masked_positions_matches_full_head():
+    """The gathered MLM head (masked_positions) must produce exactly the
+    full head's logits at those positions (reference mask_pos gather)."""
+    import jax
+
+    from paddle_tpu import models
+    from paddle_tpu.fluid import dygraph
+
+    cfg = models.BertConfig.tiny()
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    rng = np.random.RandomState(0)
+    B, S, P = 2, 16, 4
+    ids = rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    tt = np.zeros((B, S), np.int32)
+    pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    mpos = np.stack([np.sort(rng.choice(S, P, replace=False))
+                     for _ in range(B)]).astype(np.int32)
+    with dygraph.guard():
+        import paddle_tpu.fluid.framework as fw
+
+        fw._dygraph_tracer._base_key = jax.random.PRNGKey(3)
+        from paddle_tpu.fluid.dygraph import to_variable
+
+        model = models.BertForPretraining(cfg)
+        model.eval()
+        full, _ = model(to_variable(ids), to_variable(tt), to_variable(pos))
+        gathered, _ = model(to_variable(ids), to_variable(tt),
+                            to_variable(pos), masked_positions=mpos)
+        fullv = np.asarray(full.data)
+        gv = np.asarray(gathered.data)
+    for b in range(B):
+        np.testing.assert_allclose(
+            gv[b], fullv[b, mpos[b]], rtol=1e-4, atol=1e-5)
