@@ -81,9 +81,11 @@ class DatasetBase:
         ]
 
     def set_pipe_command(self, cmd):
-        # reference pipes raw bytes through a preprocessor subprocess; the
-        # native engine reads text directly — accepted for API parity
+        """Preprocessing subprocess per file (reference pipe_command,
+        data_feed.cc): the engine reads each file through `cmd < file`."""
         self._pipe_command = cmd
+        if self._handle is not None:
+            self._lib.ds_set_pipe_command(self._handle, cmd.encode())
 
     # -- engine ---------------------------------------------------------
     def _ensure_handle(self):
@@ -106,6 +108,8 @@ class DatasetBase:
             files, len(my_files), schema, len(self._slots),
             self._thread_num,
         )
+        if getattr(self, "_pipe_command", None):
+            lib.ds_set_pipe_command(self._handle, self._pipe_command.encode())
 
     def __del__(self):
         if getattr(self, "_handle", None):
@@ -216,14 +220,63 @@ class InMemoryDataset(DatasetBase):
 
 
 class QueueDataset(DatasetBase):
-    """cf. reference QueueDataset: streaming (no resident store).  The
-    native engine loads shards lazily on first iteration."""
+    """cf. reference QueueDataset: TRUE streaming through the engine's
+    bounded channel — reader threads parse files into a fixed-capacity
+    queue while the trainer consumes, so resident memory is O(capacity +
+    shuffle window) and the corpus may exceed RAM (reference
+    InMemoryDataFeed channel architecture, data_feed.h:291)."""
+
+    def set_queue_capacity(self, capacity):
+        self._channel_capacity = int(capacity)
+
+    def set_shuffle_window(self, window, seed=0):
+        """Bounded window shuffle applied on the consumer side of the
+        channel (streaming cannot globally sort; same trade as the
+        reference's channel shuffle)."""
+        self._stream_shuffle = (int(window), int(seed))
+
+    def _next_stream_batch(self):
+        lib = self._lib
+        nslots = len(self._slots)
+        counts = (ctypes.c_int64 * nslots)()
+        actual = lib.ds_stream_next_batch_sizes(
+            self._handle, self._batch_size, counts)
+        if actual == 0:
+            return None
+        bufs = []
+        lods = []
+        buf_ptrs = (ctypes.c_void_p * nslots)()
+        lod_ptrs = (ctypes.POINTER(ctypes.c_int64) * nslots)()
+        for s, (_name, is_float) in enumerate(self._slots):
+            dtype = np.float32 if is_float else np.int64
+            arr = np.empty(max(int(counts[s]), 1), dtype=dtype)
+            lod = np.empty(actual + 1, dtype=np.int64)
+            bufs.append(arr)
+            lods.append(lod)
+            buf_ptrs[s] = arr.ctypes.data_as(ctypes.c_void_p)
+            lod_ptrs[s] = lod.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        lib.ds_stream_fill_batch(self._handle, buf_ptrs, lod_ptrs)
+        return {
+            name: (bufs[s][: int(counts[s])], lods[s])
+            for s, (name, _f) in enumerate(self._slots)
+        }
 
     def __iter__(self):
         self._ensure_handle()
-        if self._lib.ds_memory_data_size(self._handle) == 0:
-            self._lib.ds_load_into_memory(self._handle)
-        yield from super().__iter__()
+        lib = self._lib
+        if getattr(self, "_stream_shuffle", None):
+            win, seed = self._stream_shuffle
+            lib.ds_set_shuffle_buffer(self._handle, win, seed)
+        lib.ds_start_streaming(
+            self._handle, getattr(self, "_channel_capacity", 1024))
+        try:
+            while True:
+                batch = self._next_stream_batch()
+                if batch is None:
+                    return
+                yield batch
+        finally:
+            lib.ds_stop_streaming(self._handle)
 
 
 def pad_batch(values, lod, pad_value=0, max_len=None):

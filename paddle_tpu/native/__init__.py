@@ -68,5 +68,21 @@ def get_lib():
         ctypes.c_void_p, ctypes.c_int,
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
     ]
+    # streaming (out-of-core) API
+    lib.ds_set_pipe_command.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ds_set_shuffle_buffer.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64,
+    ]
+    lib.ds_start_streaming.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.ds_stop_streaming.argtypes = [ctypes.c_void_p]
+    lib.ds_stream_next_batch_sizes.restype = ctypes.c_int
+    lib.ds_stream_next_batch_sizes.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.ds_stream_fill_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+    ]
     _lib = lib
     return _lib

@@ -15,11 +15,14 @@
 
 #include <algorithm>
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <fstream>
+#include <memory>
 #include <mutex>
 #include <random>
 #include <sstream>
@@ -35,6 +38,49 @@ struct Sample {
   std::vector<std::vector<int64_t>> ivals;
 };
 
+// Bounded MPMC channel (reference framework/channel.h): parse threads push,
+// the trainer pops; capacity bounds resident memory in streaming mode so a
+// corpus larger than RAM flows through without LoadIntoMemory.
+class Channel {
+ public:
+  explicit Channel(size_t capacity) : cap_(capacity) {}
+
+  // returns false when closed and drained
+  bool Pop(Sample* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return !q_.empty() || closed_; });
+    if (q_.empty()) return false;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    not_full_.notify_one();
+    return true;
+  }
+
+  // returns false if the channel was closed while waiting
+  bool Push(Sample&& s) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&] { return q_.size() < cap_ || closed_; });
+    if (closed_) return false;
+    q_.emplace_back(std::move(s));
+    not_empty_.notify_one();
+    return true;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> g(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+ private:
+  size_t cap_;
+  std::deque<Sample> q_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  bool closed_ = false;
+};
+
 struct Dataset {
   std::vector<std::string> files;
   std::vector<int> slot_is_float;  // schema: 1 = float slot, 0 = int64
@@ -43,7 +89,37 @@ struct Dataset {
   std::mutex mu;
   std::atomic<int64_t> error_lines{0};
   size_t cursor = 0;
+  // optional preprocessing subprocess per file (reference pipe_command,
+  // data_feed.cc LoadIntoMemory `shell_get_command_output`)
+  std::string pipe_command;
+  // streaming (out-of-core) state
+  std::unique_ptr<Channel> channel;
+  std::vector<std::thread> readers;
+  std::atomic<int> live_readers{0};
+  // window-shuffle buffer for streaming mode (reference channel-level
+  // shuffle; bounded, unlike a full in-memory sort)
+  size_t shuffle_buffer = 0;
+  std::mt19937_64 stream_rng{0};
+  std::vector<Sample> shuffle_window;
+  std::vector<Sample> stream_buf;  // staging for the next batch pop
 };
+
+// One sample from the stream, through the bounded shuffle window when
+// enabled.  Returns false when the channel is closed and drained.
+bool pop_stream_sample(Dataset* ds, Sample* out) {
+  if (ds->shuffle_buffer <= 1) return ds->channel->Pop(out);
+  while (ds->shuffle_window.size() < ds->shuffle_buffer) {
+    Sample s;
+    if (!ds->channel->Pop(&s)) break;
+    ds->shuffle_window.emplace_back(std::move(s));
+  }
+  if (ds->shuffle_window.empty()) return false;
+  size_t i = ds->stream_rng() % ds->shuffle_window.size();
+  *out = std::move(ds->shuffle_window[i]);
+  ds->shuffle_window[i] = std::move(ds->shuffle_window.back());
+  ds->shuffle_window.pop_back();
+  return true;
+}
 
 bool parse_line(const std::string& line, const std::vector<int>& schema,
                 Sample* out) {
@@ -68,27 +144,98 @@ bool parse_line(const std::string& line, const std::vector<int>& schema,
   return true;
 }
 
+// POSIX-safe single-quote escaping for shell interpolation.
+std::string shell_quote(const std::string& s) {
+  std::string out = "'";
+  for (char c : s) {
+    if (c == '\'')
+      out += "'\\''";
+    else
+      out += c;
+  }
+  out += "'";
+  return out;
+}
+
+// Iterate the lines of one file, optionally through the preprocessing
+// subprocess (pipe_command).  fn returns false to stop early (e.g. the
+// consumer closed the channel).  Returns false if the source cannot open.
+template <typename Fn>
+bool for_each_line(const Dataset* ds, const std::string& path, Fn&& fn) {
+  if (!ds->pipe_command.empty()) {
+    std::string cmd = ds->pipe_command + " < " + shell_quote(path);
+    FILE* p = popen(cmd.c_str(), "r");
+    if (!p) return false;
+    // accumulate until newline: fgets chunks are NOT whole lines for
+    // records longer than the buffer
+    std::string pending;
+    char buf[1 << 16];
+    bool keep_going = true;
+    while (keep_going && fgets(buf, sizeof(buf), p)) {
+      pending += buf;
+      size_t pos;
+      while (keep_going && (pos = pending.find('\n')) != std::string::npos) {
+        std::string line = pending.substr(0, pos);
+        pending.erase(0, pos + 1);
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        keep_going = fn(line);
+      }
+    }
+    if (keep_going && !pending.empty()) fn(pending);  // last unterminated line
+    pclose(p);
+    return true;
+  }
+  std::ifstream in(path);
+  if (!in.is_open()) return false;
+  std::string line;
+  while (std::getline(in, line))
+    if (!fn(line)) break;
+  return true;
+}
+
 void load_worker(Dataset* ds, size_t begin, size_t step) {
   std::vector<Sample> local;
   for (size_t fi = begin; fi < ds->files.size(); fi += step) {
-    std::ifstream in(ds->files[fi]);
-    if (!in.is_open()) {
-      ds->error_lines.fetch_add(1);
-      continue;
-    }
-    std::string line;
-    while (std::getline(in, line)) {
-      if (line.empty()) continue;
+    bool ok = for_each_line(ds, ds->files[fi], [&](const std::string& line) {
+      if (line.empty()) return true;
       Sample s;
       if (parse_line(line, ds->slot_is_float, &s)) {
         local.emplace_back(std::move(s));
       } else {
         ds->error_lines.fetch_add(1);
       }
-    }
+      return true;
+    });
+    if (!ok) ds->error_lines.fetch_add(1);
   }
   std::lock_guard<std::mutex> g(ds->mu);
   for (auto& s : local) ds->samples.emplace_back(std::move(s));
+}
+
+// Streaming reader: parse straight into the bounded channel — resident
+// memory is O(channel capacity), not corpus size (reference
+// InMemoryDataFeed channel path / QueueDataset semantics).  A closed
+// channel (consumer abandoned the stream) stops the reader immediately
+// instead of scanning the rest of the corpus into a void.
+void stream_worker(Dataset* ds, size_t begin, size_t step) {
+  bool open = true;
+  for (size_t fi = begin; open && fi < ds->files.size(); fi += step) {
+    bool ok = for_each_line(ds, ds->files[fi], [&](const std::string& line) {
+      if (line.empty()) return true;
+      Sample s;
+      if (parse_line(line, ds->slot_is_float, &s)) {
+        if (!ds->channel->Push(std::move(s))) {
+          open = false;
+          return false;
+        }
+      } else {
+        ds->error_lines.fetch_add(1);
+      }
+      return true;
+    });
+    if (!ok) ds->error_lines.fetch_add(1);
+  }
+  if (ds->live_readers.fetch_sub(1) == 1) ds->channel->Close();
 }
 
 }  // namespace
@@ -105,7 +252,12 @@ void* ds_create(const char** files, int nfiles, const int* schema, int nslots,
   return ds;
 }
 
-void ds_destroy(void* h) { delete static_cast<Dataset*>(h); }
+void ds_stop_streaming(void* h);  // fwd decl (defined below)
+
+void ds_destroy(void* h) {
+  ds_stop_streaming(h);  // join reader threads before freeing
+  delete static_cast<Dataset*>(h);
+}
 
 // cf. DatasetImpl::LoadIntoMemory: one worker per file shard.
 void ds_load_into_memory(void* h) {
@@ -168,6 +320,91 @@ int ds_next_batch_sizes(void* h, int batch_size, int64_t* out_counts) {
 }
 
 // bufs[s]: caller-allocated value buffer; lods[s]: int64 buffer [actual+1]
+// -- streaming (out-of-core) API --------------------------------------
+
+void ds_set_pipe_command(void* h, const char* cmd) {
+  static_cast<Dataset*>(h)->pipe_command = cmd ? cmd : "";
+}
+
+void ds_set_shuffle_buffer(void* h, int64_t window, uint64_t seed) {
+  auto* ds = static_cast<Dataset*>(h);
+  ds->shuffle_buffer = window > 0 ? static_cast<size_t>(window) : 0;
+  ds->stream_rng.seed(seed);
+}
+
+// Launch reader threads parsing files into a bounded channel.  Resident
+// memory = O(capacity + shuffle window), independent of corpus size.
+void ds_start_streaming(void* h, int64_t channel_capacity) {
+  auto* ds = static_cast<Dataset*>(h);
+  ds->channel.reset(new Channel(
+      channel_capacity > 0 ? static_cast<size_t>(channel_capacity) : 1024));
+  int n = std::min<int>(ds->nthreads,
+                        std::max<size_t>(ds->files.size(), 1));
+  ds->live_readers.store(n);
+  for (int t = 0; t < n; ++t)
+    ds->readers.emplace_back(stream_worker, ds, t, n);
+}
+
+void ds_stop_streaming(void* h) {
+  auto* ds = static_cast<Dataset*>(h);
+  if (ds->channel) ds->channel->Close();
+  for (auto& t : ds->readers)
+    if (t.joinable()) t.join();
+  ds->readers.clear();
+  ds->channel.reset();
+  ds->shuffle_window.clear();
+  ds->stream_buf.clear();
+}
+
+// Two-phase batch pop mirroring the in-memory API: stage up to
+// batch_size samples from the stream, report per-slot totals.
+int ds_stream_next_batch_sizes(void* h, int batch_size,
+                               int64_t* out_counts) {
+  auto* ds = static_cast<Dataset*>(h);
+  if (!ds->channel) return 0;
+  ds->stream_buf.clear();
+  for (int b = 0; b < batch_size; ++b) {
+    Sample s;
+    if (!pop_stream_sample(ds, &s)) break;
+    ds->stream_buf.emplace_back(std::move(s));
+  }
+  if (ds->stream_buf.empty()) return 0;
+  size_t nslots = ds->slot_is_float.size();
+  for (size_t s = 0; s < nslots; ++s) {
+    int64_t total = 0;
+    for (const auto& smp : ds->stream_buf)
+      total += ds->slot_is_float[s] ? smp.fvals[s].size()
+                                    : smp.ivals[s].size();
+    out_counts[s] = total;
+  }
+  return static_cast<int>(ds->stream_buf.size());
+}
+
+void ds_stream_fill_batch(void* h, void** bufs, int64_t** lods) {
+  auto* ds = static_cast<Dataset*>(h);
+  size_t nslots = ds->slot_is_float.size();
+  for (size_t s = 0; s < nslots; ++s) {
+    int64_t off = 0;
+    lods[s][0] = 0;
+    for (size_t b = 0; b < ds->stream_buf.size(); ++b) {
+      const Sample& smp = ds->stream_buf[b];
+      if (ds->slot_is_float[s]) {
+        const auto& v = smp.fvals[s];
+        std::memcpy(static_cast<float*>(bufs[s]) + off, v.data(),
+                    v.size() * sizeof(float));
+        off += v.size();
+      } else {
+        const auto& v = smp.ivals[s];
+        std::memcpy(static_cast<int64_t*>(bufs[s]) + off, v.data(),
+                    v.size() * sizeof(int64_t));
+        off += v.size();
+      }
+      lods[s][b + 1] = off;
+    }
+  }
+  ds->stream_buf.clear();
+}
+
 void ds_fill_batch(void* h, int batch_size, void** bufs, int64_t** lods) {
   auto* ds = static_cast<Dataset*>(h);
   size_t n = ds->samples.size();

@@ -244,3 +244,71 @@ def test_infer_from_dataset_does_not_touch_params(tmp_path):
         exe.infer_from_dataset(main, ds, fetch_list=[loss])
         after = np.asarray(scope.find_var(pname))
     np.testing.assert_array_equal(before, after)
+
+
+# ---------------------------------------------------------------------------
+# streaming engine (bounded channel, out-of-core; reference channel.h +
+# QueueDataset semantics)
+# ---------------------------------------------------------------------------
+
+
+def test_queue_dataset_true_streaming_small_channel(tmp_path):
+    """All samples arrive through a channel of capacity 4 — resident
+    engine memory is bounded by the channel, not the corpus."""
+    files, _ = _write_slot_files(tmp_path, nfiles=3, lines_per_file=20)
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    ds.set_filelist(files)
+    ds.set_batch_size(7)
+    ds.set_thread(2)
+    ds.set_use_var(_make_vars())
+    ds.set_queue_capacity(4)
+    total = 0
+    labels = []
+    for batch in ds:
+        vals, lod = batch["label"]
+        total += len(lod) - 1
+        labels.extend(float(v) for v in vals)
+    assert total == 60
+    # nothing was materialized in the in-memory store
+    assert ds._lib.ds_memory_data_size(ds._handle) == 0
+    # re-iteration streams again from the files
+    assert sum(len(b["label"][1]) - 1 for b in ds) == 60
+
+
+def test_queue_dataset_shuffle_window_changes_order(tmp_path):
+    files, _ = _write_slot_files(tmp_path, nfiles=1, lines_per_file=50)
+
+    def run(window):
+        ds = DatasetFactory().create_dataset("QueueDataset")
+        ds.set_filelist(files)
+        ds.set_batch_size(50)
+        ds.set_use_var(_make_vars())
+        if window:
+            ds.set_shuffle_window(window, seed=5)
+        out = []
+        for b in ds:
+            out.extend(float(v) for v in b["label"][0])
+        return out
+
+    plain = run(0)
+    shuffled = run(16)
+    assert sorted(plain) == sorted(shuffled)  # same multiset
+    assert plain != shuffled                  # order differs
+
+
+def test_pipe_command_preprocessing(tmp_path):
+    """pipe_command runs each file through a shell preprocessor
+    (reference data_feed pipe_command): sed doubles the label slot."""
+    path = str(tmp_path / "p.txt")
+    with open(path, "w") as f:
+        f.write("2 5 7 1 0.5\n")
+        f.write("1 3 1 0.25\n")
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    ds.set_filelist([path])
+    ds.set_batch_size(4)
+    ds.set_use_var(_make_vars())
+    ds.set_pipe_command("sed 's/0.5$/0.75/'")
+    labels = []
+    for b in ds:
+        labels.extend(round(float(v), 4) for v in b["label"][0])
+    assert 0.75 in labels and 0.25 in labels and 0.5 not in labels
