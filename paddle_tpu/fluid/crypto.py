@@ -1,0 +1,94 @@
+"""Encrypted model IO (capability parity: reference
+`paddle/fluid/framework/io/crypto/` — AES-GCM cipher + CipherFactory used
+to encrypt `__model__`/params files for deployment).
+
+This environment ships no AES library, so the cipher is an HMAC-SHA256
+counter-mode stream (PRF keystream XOR) with an HMAC integrity tag —
+same interface and deployment flow (encrypt the saved model directory,
+decrypt at load), documented as not AES-interoperable with the
+reference's files.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+
+_MAGIC = b"PTPUENC1"
+
+
+def _keystream(key: bytes, nonce: bytes, n: int):
+    out = bytearray()
+    counter = 0
+    while len(out) < n:
+        block = hmac.new(
+            key, nonce + counter.to_bytes(8, "big"), hashlib.sha256
+        ).digest()
+        out.extend(block)
+        counter += 1
+    return bytes(out[:n])
+
+
+def _norm_key(key) -> bytes:
+    if isinstance(key, str):
+        key = key.encode()
+    return hashlib.sha256(key).digest()
+
+
+def _xor(data: bytes, ks: bytes) -> bytes:
+    import numpy as np
+
+    return np.bitwise_xor(
+        np.frombuffer(data, np.uint8), np.frombuffer(ks, np.uint8)
+    ).tobytes()
+
+
+def encrypt_bytes(data: bytes, key) -> bytes:
+    k = _norm_key(key)
+    nonce = os.urandom(16)
+    ct = _xor(data, _keystream(k, nonce, len(data)))
+    tag = hmac.new(k, nonce + ct, hashlib.sha256).digest()
+    return _MAGIC + nonce + tag + ct
+
+
+def decrypt_bytes(blob: bytes, key) -> bytes:
+    if not blob.startswith(_MAGIC):
+        raise ValueError("not an encrypted model blob")
+    k = _norm_key(key)
+    nonce = blob[8:24]
+    tag = blob[24:56]
+    ct = blob[56:]
+    if not hmac.compare_digest(
+            tag, hmac.new(k, nonce + ct, hashlib.sha256).digest()):
+        raise ValueError("wrong key or corrupted encrypted model")
+    return _xor(ct, _keystream(k, nonce, len(ct)))
+
+
+def encrypt_file(path, key, out_path=None):
+    with open(path, "rb") as f:
+        blob = encrypt_bytes(f.read(), key)
+    with open(out_path or path, "wb") as f:
+        f.write(blob)
+
+
+def decrypt_file(path, key, out_path=None):
+    with open(path, "rb") as f:
+        data = decrypt_bytes(f.read(), key)
+    with open(out_path or path, "wb") as f:
+        f.write(data)
+
+
+def encrypt_inference_model(dirname, key):
+    """Encrypt every file of a save_inference_model directory in place
+    (reference deploy flow: ship only ciphertext)."""
+    for name in os.listdir(dirname):
+        encrypt_file(os.path.join(dirname, name), key)
+
+
+def decrypt_inference_model(dirname, key, out_dirname=None):
+    out_dirname = out_dirname or dirname
+    os.makedirs(out_dirname, exist_ok=True)
+    for name in os.listdir(dirname):
+        decrypt_file(os.path.join(dirname, name), key,
+                     os.path.join(out_dirname, name))

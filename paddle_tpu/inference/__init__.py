@@ -20,3 +20,4 @@ from .predictor import (  # noqa: F401
     export_stablehlo,
     load_stablehlo,
 )
+from .server import InferenceServer  # noqa: F401

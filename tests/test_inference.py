@@ -68,3 +68,111 @@ def test_stablehlo_export_roundtrip(saved_model, tmp_path):
     served = load_stablehlo(export_dir)
     out, = served({"x": x_new})
     np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# serving runner (reference AnalysisPredictor serving + capi/go surface ->
+# batching front end + HTTP JSON endpoint)
+# ---------------------------------------------------------------------------
+
+
+def _train_and_save(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 8], append_batch_size=False)
+        pred = layers.fc(layers.fc(x, 16, act="relu"), 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    path = str(tmp_path / "srv.model")
+    fluid.io.save_inference_model(path, ["x"], [pred], exe, main)
+    return path
+
+
+def test_inference_server_batches_concurrent_requests(tmp_path):
+    import threading
+
+    from paddle_tpu.inference import AnalysisConfig, create_predictor
+    from paddle_tpu.inference.server import InferenceServer
+
+    path = _train_and_save(tmp_path)
+    pred = create_predictor(AnalysisConfig(path))
+    server = InferenceServer(pred, max_batch=16, batch_timeout_ms=20).start()
+    try:
+        rng = np.random.RandomState(0)
+        xs = [rng.randn(2, 8).astype(np.float32) for _ in range(8)]
+        direct = [pred.run({"x": x})[0] for x in xs]
+        results = [None] * 8
+
+        def call(i):
+            results[i] = server.infer({"x": xs[i]})[0]
+
+        ts = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for got, want in zip(results, direct):
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    finally:
+        server.stop()
+
+
+def test_inference_server_http_endpoint(tmp_path):
+    import json as _json
+    import urllib.request
+
+    from paddle_tpu.inference import AnalysisConfig, create_predictor
+    from paddle_tpu.inference.server import InferenceServer
+
+    path = _train_and_save(tmp_path)
+    pred = create_predictor(AnalysisConfig(path))
+    server = InferenceServer(pred).start()
+    httpd = server.serve_http(port=0, block=False)
+    try:
+        port = httpd.server_address[1]
+        x = np.random.RandomState(1).randn(3, 8).astype(np.float32)
+        body = _json.dumps({
+            "inputs": {"x": x.tolist()},
+            "dtypes": {"x": "float32"},
+        }).encode()
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/predict" % port, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = _json.loads(resp.read())
+        want = pred.run({"x": x})[0]
+        np.testing.assert_allclose(
+            np.asarray(out["outputs"][0], np.float32), want,
+            rtol=1e-5, atol=1e-6)
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/health" % port, timeout=10) as resp:
+            assert _json.loads(resp.read())["status"] == "ok"
+    finally:
+        httpd.shutdown()
+        server.stop()
+
+
+def test_encrypted_model_round_trip(tmp_path):
+    """Encrypt a saved model dir, fail on wrong key, load after decrypt
+    (reference io/crypto capability)."""
+    from paddle_tpu.fluid import crypto
+
+    path = _train_and_save(tmp_path)
+    x = np.random.RandomState(2).randn(2, 8).astype(np.float32)
+    from paddle_tpu.inference import AnalysisConfig, create_predictor
+
+    want = create_predictor(AnalysisConfig(path)).run({"x": x})[0]
+
+    crypto.encrypt_inference_model(path, key="s3cret")
+    # ciphertext is not loadable
+    with pytest.raises(Exception):
+        create_predictor(AnalysisConfig(path))
+    # wrong key detected by the integrity tag
+    with pytest.raises(ValueError, match="wrong key|corrupted"):
+        crypto.decrypt_inference_model(
+            path, key="nope", out_dirname=str(tmp_path / "bad"))
+    dec = str(tmp_path / "dec")
+    crypto.decrypt_inference_model(path, key="s3cret", out_dirname=dec)
+    got = create_predictor(AnalysisConfig(dec)).run({"x": x})[0]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
