@@ -26,7 +26,7 @@ import jax.numpy as jnp
 
 
 def gpipe(stage_fn, n_stages, n_micro, axis_name="pp",
-          first_fn=None, last_fn=None):
+          first_fn=None, last_fn=None, remat=False):
     """Build a pipelined apply: (stacked_params_local, xs[, first_params,
     last_params]) -> ys.
 
@@ -48,7 +48,17 @@ def gpipe(stage_fn, n_stages, n_micro, axis_name="pp",
         returns ys: [n_micro, mb, ...] head outputs, identical on every
         shard (accumulated on the last stage, ONE psum broadcast at the
         end — no per-tick ring traffic).
+
+    remat=True wraps stage_fn in jax.checkpoint: the backward pass then
+    stores only each tick's stage INPUT and recomputes the interior,
+    bounding activation memory per microbatch to one activation tensor —
+    the memory property 1F1B scheduling buys (reference SectionWorker
+    holds <= n_stages live microbatches) at the cost of one extra
+    forward, without hand-scheduling backward interleaving inside the
+    scan.
     """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
 
     def pipelined(params_local, xs, first_params=None, last_params=None):
         # drop the sharded stage dim: each shard holds exactly one stage

@@ -92,10 +92,12 @@ def megatron_rule():
             # fused qkv stays REPLICATED under tp: its q/k/v slice
             # boundaries (d, 2d) do not align with contiguous tp shards of
             # the 3d output dim unless tp % 3 == 0, and the resharding
-            # collectives would cost more than the sharding saves
-            (r"(q_proj|k_proj|v_proj|fc1|mlm_transform)\.weight",
+            # collectives would cost more than the sharding saves.  The
+            # (^|[._]) anchor keeps 'qkv_proj' from matching the v_proj
+            # rule while still matching name components like 'enc0_fc1'.
+            (r"(^|[._])(q_proj|k_proj|v_proj|fc1|mlm_transform)\.weight",
              (None, "tp")),
-            (r"(q_proj|k_proj|v_proj|fc1)\.bias", ("tp",)),
+            (r"(^|[._])(q_proj|k_proj|v_proj|fc1)\.bias", ("tp",)),
             (r"(out_proj|fc2)\.weight", ("tp", None)),
             # MoE experts shard on ep (gate replicated); w1 column-parallel
             # on tp (shard d_hidden), w2 row-parallel (contract d_hidden

@@ -290,11 +290,14 @@ class ShardedTrainStep:
                 # scaling).  AD transposes the param cast, so grads arrive
                 # already fp32 for the update ops.
                 def amp_loss(p32, batch, key):
-                    cast = (lambda x: x.astype(jnp.bfloat16)
-                            if x.dtype == jnp.float32 else x)
-                    p16 = jax.tree.map(cast, p32)
-                    b16 = jax.tree.map(cast, batch)
-                    return loss_of(p16, b16, key).astype(jnp.float32)
+                    # params only: batch tensors (labels, loss weights)
+                    # keep fp32 — float MODEL inputs meet bf16 params at
+                    # the op level (conv lowering aligns input dtype to
+                    # the filter, the AMP white-list behavior)
+                    p16 = jax.tree.map(
+                        lambda x: x.astype(jnp.bfloat16)
+                        if x.dtype == jnp.float32 else x, p32)
+                    return loss_of(p16, batch, key).astype(jnp.float32)
 
                 loss, grads = jax.value_and_grad(amp_loss)(params, batch, key)
             else:

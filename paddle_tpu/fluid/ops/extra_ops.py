@@ -592,6 +592,8 @@ def _pixel_shuffle(ctx, ins, attrs):
 @register_op("conv3d", inputs=["Input", "Filter"], outputs=["Output"])
 def _conv3d(ctx, ins, attrs):
     x, f = ins["Input"][0], ins["Filter"][0]  # NCDHW, OI dhw
+    if x.dtype != f.dtype and jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(f.dtype)  # AMP: input follows the filter's precision
     s = attrs.get("strides", [1, 1, 1])
     p = attrs.get("paddings", [0, 0, 0])
     d = attrs.get("dilations", [1, 1, 1])

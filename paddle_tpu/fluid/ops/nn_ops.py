@@ -24,6 +24,10 @@ def _pair(v):
 def _conv2d(ctx, ins, attrs):
     """NCHW conv (cf. conv_op.cc).  groups>1 -> feature_group_count."""
     x, w = ins["Input"][0], ins["Filter"][0]
+    # AMP white-list behavior: a float input meets a lower-precision
+    # filter (bf16 params under amp) at the filter's dtype
+    if x.dtype != w.dtype and jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(w.dtype)
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = attrs.get("paddings", [0, 0])
     dilations = _pair(attrs.get("dilations", [1, 1]))
@@ -70,6 +74,8 @@ def _conv2d_transpose(ctx, ins, attrs):
     (H-1)*stride - 2*pad + dilation*(kh-1) + 1.
     """
     x, w = ins["Input"][0], ins["Filter"][0]
+    if x.dtype != w.dtype and jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(w.dtype)  # AMP: input follows the filter's precision
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))

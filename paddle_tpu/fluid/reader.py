@@ -259,14 +259,27 @@ class DataLoader:
             pending = {}
             next_i = 0
             received = 0
+            stalled_polls = 0
             while received < len(batches):
                 try:
                     i, b, e = res_q.get(timeout=5.0)
+                    stalled_polls = 0
                 except _queue.Empty:
-                    if not any(p.is_alive() for p in procs):
+                    dead = sum(1 for p in procs if not p.is_alive())
+                    if dead == len(procs):
                         raise RuntimeError(
                             "all DataLoader workers died without "
                             "delivering results (OOM-killed?)")
+                    if dead:
+                        # a dead worker took its in-flight task with it;
+                        # no result can ever unblock next_i — fail fast
+                        # instead of hanging the trainer
+                        stalled_polls += 1
+                        if stalled_polls >= 2:
+                            raise RuntimeError(
+                                "%d DataLoader worker(s) died and the "
+                                "stream stalled (batch %d never arrived)"
+                                % (dead, next_i))
                     continue
                 received += 1
                 if e is not None:

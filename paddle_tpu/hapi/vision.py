@@ -3,12 +3,15 @@
 `vision/transforms/`)."""
 
 from ..models.lenet import LeNet5
+from ..models.mobilenet import MobileNetV1, mobilenet_v1
 from ..models.resnet import ResNet, resnet18, resnet34, resnet50, resnet101
+from ..models.vgg import VGG, vgg16, vgg19
 
 LeNet = LeNet5  # reference hapi name
 
 __all__ = ["LeNet", "LeNet5", "ResNet", "resnet18", "resnet34",
-           "resnet50", "resnet101", "transforms"]
+           "resnet50", "resnet101", "VGG", "vgg16", "vgg19",
+           "MobileNetV1", "mobilenet_v1", "transforms"]
 
 
 class transforms:

@@ -12,5 +12,7 @@ stack dispatches per mode), so one definition serves both executors.
 from .bert import BertConfig, BertForPretraining, BertModel  # noqa: F401
 from .moe import MoEFFN  # noqa: F401
 from .lenet import LeNet5  # noqa: F401
+from .mobilenet import MobileNetV1, mobilenet_v1  # noqa: F401
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101  # noqa: F401
 from .transformer import Transformer, TransformerConfig  # noqa: F401
+from .vgg import VGG, vgg16, vgg19  # noqa: F401

@@ -1,0 +1,60 @@
+"""MobileNetV1 (cf. reference hapi `vision/models/mobilenetv1.py`):
+depthwise-separable conv stacks — the depthwise step uses grouped conv
+(groups == channels), which the conv2d lowering maps to XLA's
+feature_group_count."""
+
+from ..fluid import dygraph, layers
+
+
+class _ConvBN(dygraph.Layer):
+    def __init__(self, in_ch, out_ch, k, stride=1, groups=1):
+        super().__init__()
+        self.conv = dygraph.Conv2D(
+            in_ch, out_ch, k, stride=stride, padding=(k - 1) // 2,
+            groups=groups, bias_attr=False)
+        self.bn = dygraph.BatchNorm(out_ch, act="relu")
+
+    def forward(self, x):
+        return self.bn(self.conv(x))
+
+
+class _DepthwiseSeparable(dygraph.Layer):
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        self.dw = _ConvBN(in_ch, in_ch, 3, stride=stride, groups=in_ch)
+        self.pw = _ConvBN(in_ch, out_ch, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(dygraph.Layer):
+    def __init__(self, num_classes=1000, scale=1.0, in_channels=3):
+        super().__init__()
+
+        def c(n):
+            return max(int(n * scale), 8)
+
+        self.stem = _ConvBN(in_channels, c(32), 3, stride=2)
+        cfg = [
+            (c(32), c(64), 1), (c(64), c(128), 2), (c(128), c(128), 1),
+            (c(128), c(256), 2), (c(256), c(256), 1), (c(256), c(512), 2),
+            (c(512), c(512), 1), (c(512), c(512), 1), (c(512), c(512), 1),
+            (c(512), c(512), 1), (c(512), c(512), 1), (c(512), c(1024), 2),
+            (c(1024), c(1024), 1),
+        ]
+        self.blocks = dygraph.LayerList(
+            [_DepthwiseSeparable(i, o, s) for i, o, s in cfg])
+        self.head = dygraph.Linear(c(1024), num_classes)
+        self._feat = c(1024)
+
+    def forward(self, x):
+        x = self.stem(x)
+        for b in self.blocks:
+            x = b(x)
+        x = layers.pool2d(x, global_pooling=True, pool_type="avg")
+        return self.head(layers.reshape(x, [0, self._feat]))
+
+
+def mobilenet_v1(**kw):
+    return MobileNetV1(**kw)

@@ -1,0 +1,69 @@
+"""VGG family (cf. reference book test image_classification's vgg16 recipe
+`tests/book/test_image_classification.py` vgg16_bn_drop and hapi
+`vision/models/vgg.py`)."""
+
+from ..fluid import dygraph, layers
+
+_CFGS = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+         "M", 512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+         512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(dygraph.Layer):
+    def __init__(self, depth=16, num_classes=1000, in_channels=3,
+                 batch_norm=True, dropout=0.5):
+        super().__init__()
+        if depth not in _CFGS:
+            raise ValueError("VGG depth must be one of %s" % list(_CFGS))
+        blocks = []
+        ch = in_channels
+        for v in _CFGS[depth]:
+            if v == "M":
+                blocks.append(("pool", None))
+            else:
+                conv = dygraph.Conv2D(ch, v, 3, padding=1,
+                                      bias_attr=not batch_norm)
+                bn = dygraph.BatchNorm(v, act="relu") if batch_norm else None
+                blocks.append(("conv", (conv, bn)))
+                ch = v
+        self._blocks = blocks
+        # register sublayers for the parameter tree
+        for i, (kind, mods) in enumerate(blocks):
+            if kind == "conv":
+                conv, bn = mods
+                setattr(self, "conv%d" % i, conv)
+                if bn is not None:
+                    setattr(self, "bn%d" % i, bn)
+        self.dropout = dygraph.Dropout(dropout)
+        self.fc1 = dygraph.Linear(512, 512, act="relu")
+        self.fc2 = dygraph.Linear(512, 512, act="relu")
+        self.head = dygraph.Linear(512, num_classes)
+
+    def forward(self, x):
+        for kind, mods in self._blocks:
+            if kind == "pool":
+                x = layers.pool2d(x, pool_size=2, pool_stride=2,
+                                  pool_type="max")
+            else:
+                conv, bn = mods
+                x = conv(x)
+                x = bn(x) if bn is not None else layers.relu(x)
+        x = layers.pool2d(x, global_pooling=True, pool_type="avg")
+        x = layers.reshape(x, [0, 512])
+        x = self.dropout(self.fc1(x))
+        x = self.dropout(self.fc2(x))
+        return self.head(x)
+
+
+def vgg16(**kw):
+    return VGG(depth=16, **kw)
+
+
+def vgg19(**kw):
+    return VGG(depth=19, **kw)

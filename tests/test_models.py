@@ -184,3 +184,28 @@ def test_bert_masked_positions_matches_full_head():
     for b in range(B):
         np.testing.assert_allclose(
             gv[b], fullv[b, mpos[b]], rtol=1e-4, atol=1e-5)
+
+
+def test_vgg_and_mobilenet_forward_and_train():
+    """New vision zoo members produce logits and take a training step."""
+    from paddle_tpu.fluid.dygraph import to_variable
+    from paddle_tpu.fluid.optimizer import SGDOptimizer
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, (2, 1)).astype(np.int64)
+    with dygraph.guard():
+        for net in (models.VGG(depth=11, num_classes=10),
+                    models.MobileNetV1(num_classes=10, scale=0.25)):
+            net.train()
+            logits = net(to_variable(x))
+            assert logits.shape == (2, 10)
+            from paddle_tpu.fluid import layers as L
+
+            loss = L.mean(L.softmax_with_cross_entropy(
+                logits, to_variable(y)))
+            loss.backward()
+            SGDOptimizer(0.01).minimize(
+                loss, parameter_list=net.parameters())
+            net.clear_gradients()
+            assert np.isfinite(float(loss.numpy()))
