@@ -92,3 +92,68 @@ def get_rank():
 
 def get_world_size():
     return ParallelEnv().world_size
+
+
+def spawn(func, args=(), nprocs=None, started_port=None):
+    """cf. reference `paddle.distributed.spawn`: run `func(rank, *args)`
+    in nprocs processes wired with the PADDLE_* env contract (the
+    programmatic twin of `python -m paddle_tpu.distributed.launch`).
+    Returns once every process exits; raises if any failed."""
+    import multiprocessing as mp
+    import os
+    import socket
+
+    nprocs = int(nprocs or os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    if started_port is None:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        started_port = s.getsockname()[1]
+        s.close()
+    from .launch import get_cluster_endpoints
+
+    endpoints = ",".join(
+        get_cluster_endpoints(["127.0.0.1"], started_port, nprocs))
+
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=_spawn_main,
+                         args=(func, rank, args, nprocs, endpoints,
+                               started_port),
+             ) for rank in range(nprocs)]
+    for p in procs:
+        p.start()
+    # monitored join: a crashed rank terminates the group and raises
+    # instead of leaving siblings (and this parent) blocked at a
+    # rendezvous forever
+    import time as _time
+
+    try:
+        while any(p.is_alive() for p in procs):
+            for i, p in enumerate(procs):
+                if not p.is_alive() and p.exitcode not in (0, None):
+                    for q in procs:
+                        if q.is_alive():
+                            q.terminate()
+                    raise RuntimeError(
+                        "spawned rank %d exited nonzero (%s); terminated "
+                        "the remaining ranks" % (i, p.exitcode))
+            _time.sleep(0.1)
+    finally:
+        for p in procs:
+            p.join(timeout=5)
+    bad = [i for i, p in enumerate(procs) if p.exitcode != 0]
+    if bad:
+        raise RuntimeError(
+            "spawned ranks %s exited nonzero (%s)"
+            % (bad, [procs[i].exitcode for i in bad]))
+
+
+def _spawn_main(func, rank, args, nprocs, endpoints, started_port):
+    """Module-level spawn target (picklable)."""
+    import os
+
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_TRAINER_ENDPOINTS"] = endpoints
+    os.environ["PADDLE_CURRENT_ENDPOINT"] = (
+        "127.0.0.1:%d" % (started_port + rank))
+    func(rank, *args)

@@ -330,3 +330,50 @@ class DataLoader:
         if self._gen is not None:
             raise TypeError("generator-fed DataLoader has no length")
         return len(self.batch_sampler)
+
+
+class DistributedBatchSampler(BatchSampler):
+    """cf. reference `paddle.io.DistributedBatchSampler`: each rank
+    iterates its own 1/nranks slice of the (optionally shuffled) index
+    space, padded so every rank sees the same number of batches."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False, seed=None):
+        super().__init__(dataset=dataset, shuffle=shuffle,
+                         batch_size=batch_size, drop_last=drop_last,
+                         seed=seed)
+        if num_replicas is None or rank is None:
+            import os
+
+            num_replicas = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+            rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self.nranks = max(int(num_replicas), 1)
+        self.rank = int(rank)
+        self.epoch = 0
+        self._seed_base = int(seed or 0)
+
+    def set_epoch(self, epoch):
+        """Reshuffle deterministically per epoch (reference contract)."""
+        self.epoch = int(epoch)
+
+    def __iter__(self):
+        idx = np.arange(self.n)
+        if self.shuffle:
+            np.random.RandomState(
+                (self._seed_base or 0) + self.epoch).shuffle(idx)
+        # pad (tiling if needed) to a multiple of nranks so every rank
+        # yields equally many batches even when pad > dataset size
+        per = (self.n + self.nranks - 1) // self.nranks
+        padded = np.resize(idx, per * self.nranks)
+        local = padded[self.rank::self.nranks]
+        for i in range(0, len(local), self.batch_size):
+            b = local[i:i + self.batch_size]
+            if len(b) < self.batch_size and self.drop_last:
+                return
+            yield list(b)
+
+    def __len__(self):
+        per = (self.n + self.nranks - 1) // self.nranks
+        if self.drop_last:
+            return per // self.batch_size
+        return (per + self.batch_size - 1) // self.batch_size
