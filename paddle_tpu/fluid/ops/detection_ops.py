@@ -634,3 +634,151 @@ def _target_assign(ctx, ins, attrs):
         neg = ins["NegIndices"][0]  # [N, P] 0/1 mask of negatives
         weight = jnp.maximum(weight, neg[..., None].astype(jnp.float32))
     return {"Out": [out], "OutWeight": [weight]}
+
+
+@register_op("roi_pool", inputs=["X", "ROIs"], outputs=["Out"],
+             no_grad_slots=("ROIs",))
+def _roi_pool(ctx, ins, attrs):
+    """cf. roi_pool_op.cc: max pooling over each roi's bin grid
+    (quantized boundaries, unlike roi_align's bilinear samples)."""
+    x, rois = ins["X"][0], ins["ROIs"][0]     # [N,C,H,W], [R,4] (batch 0)
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    feat = x[0]                                # single-image contract
+
+    def one_roi(roi):
+        x1 = jnp.round(roi[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def one_bin(i, j):
+            by0 = y1 + (i * rh) // ph
+            by1 = y1 + ((i + 1) * rh + ph - 1) // ph
+            bx0 = x1 + (j * rw) // pw
+            bx1 = x1 + ((j + 1) * rw + pw - 1) // pw
+            m = ((ys >= by0) & (ys < jnp.maximum(by1, by0 + 1)))[:, None] \
+                & ((xs >= bx0) & (xs < jnp.maximum(bx1, bx0 + 1)))[None, :]
+            neg = jnp.finfo(feat.dtype).min
+            return jnp.max(jnp.where(m[None], feat, neg), axis=(1, 2))
+
+        rows = jnp.stack([
+            jnp.stack([one_bin(i, j) for j in range(pw)], axis=1)
+            for i in range(ph)
+        ], axis=1)                              # [C, ph, pw]
+        return rows
+
+    return {"Out": [jax.vmap(one_roi)(rois)]}
+
+
+@register_op("psroi_pool", inputs=["X", "ROIs"], outputs=["Out"],
+             no_grad_slots=("ROIs",))
+def _psroi_pool(ctx, ins, attrs):
+    """cf. psroi_pool_op.cc (R-FCN): position-sensitive average pooling —
+    bin (i, j) reads channel group (i*pw + j)."""
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    oc = int(attrs["output_channels"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+    feat = x[0].reshape(ph * pw, oc, H, W) if C == ph * pw * oc else None
+    if feat is None:
+        raise ValueError("psroi_pool needs C == pooled_h*pooled_w*out_ch")
+    ys = jnp.arange(H)
+    xs = jnp.arange(W)
+
+    def one_roi(roi):
+        x1 = roi[0] * scale
+        y1 = roi[1] * scale
+        x2 = roi[2] * scale
+        y2 = roi[3] * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+
+        def one_bin(i, j):
+            by0 = y1 + rh * i / ph
+            by1 = y1 + rh * (i + 1) / ph
+            bx0 = x1 + rw * j / pw
+            bx1 = x1 + rw * (j + 1) / pw
+            m = ((ys >= jnp.floor(by0)) & (ys < jnp.ceil(by1)))[:, None] \
+                & ((xs >= jnp.floor(bx0)) & (xs < jnp.ceil(bx1)))[None, :]
+            g = feat[i * pw + j]                # [oc, H, W]
+            cnt = jnp.maximum(jnp.sum(m), 1)
+            return jnp.sum(jnp.where(m[None], g, 0), axis=(1, 2)) / cnt
+
+        return jnp.stack([
+            jnp.stack([one_bin(i, j) for j in range(pw)], axis=1)
+            for i in range(ph)
+        ], axis=1)                              # [oc, ph, pw]
+
+    return {"Out": [jax.vmap(one_roi)(rois)]}
+
+
+@register_op("affine_channel", inputs=["X", "Scale", "Bias"],
+             outputs=["Out"])
+def _affine_channel(ctx, ins, attrs):
+    """cf. affine_channel_op.cc: per-channel x*scale + bias (frozen-BN)."""
+    x = ins["X"][0]
+    s = ins["Scale"][0].reshape(1, -1, 1, 1)
+    b = ins["Bias"][0].reshape(1, -1, 1, 1)
+    return {"Out": [x * s + b]}
+
+
+@register_op("matrix_nms", inputs=["BBoxes", "Scores"], outputs=["Out"],
+             grad=None)
+def _matrix_nms(ctx, ins, attrs):
+    """cf. matrix_nms_op.cc (SOLOv2): parallel soft-NMS — each candidate's
+    score decays by its max IoU with any higher-scored same-class
+    candidate (gaussian or linear kernel), no sequential suppression.
+    Static output [N, keep_top_k, 6] with label -1 padding."""
+    bboxes = ins["BBoxes"][0]                   # [N, M, 4]
+    scores = ins["Scores"][0]                   # [N, C, M]
+    thr = float(attrs.get("score_threshold", 0.05))
+    post = int(attrs.get("post_threshold", 0) or 0)
+    nms_top_k = int(attrs.get("nms_top_k", 100))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    use_gauss = bool(attrs.get("use_gaussian", True))
+    sigma = float(attrs.get("gaussian_sigma", 2.0))
+    bg = int(attrs.get("background_label", -1))
+    N, C, M = scores.shape
+    K = min(nms_top_k, M)
+
+    def per_image(boxes, sc):
+        def per_class(c_scores, cid):
+            top_s, top_i = jax.lax.top_k(c_scores, K)
+            top_b = boxes[top_i]
+            iou = _pairwise_iou(top_b, top_b)
+            # decay[i] = prod over j<i of kernel(iou_ji); matrix form uses
+            # the max IoU among higher-scored candidates
+            upper = jnp.triu(iou, k=1)          # j suppresses i>j
+            max_iou = jnp.max(upper, axis=0)
+            if use_gauss:
+                decay = jnp.exp(-(max_iou ** 2) / sigma)
+            else:
+                decay = 1.0 - max_iou
+            s2 = top_s * decay
+            s2 = jnp.where(top_s > thr, s2, 0.0)
+            lab = jnp.full((K,), cid, jnp.float32)
+            return jnp.concatenate(
+                [lab[:, None], s2[:, None], top_b], axis=1)  # [K, 6]
+
+        cls_ids = [c for c in range(C) if c != bg]
+        allc = jnp.concatenate(
+            [per_class(sc[c], c) for c in cls_ids], axis=0)
+        order = jnp.argsort(-allc[:, 1])
+        out = allc[order[:keep_top_k]]
+        pad = keep_top_k - out.shape[0]
+        if pad > 0:
+            out = jnp.concatenate(
+                [out, jnp.full((pad, 6), -1.0, out.dtype)], axis=0)
+        return jnp.where(out[:, 1:2] > max(post, 0),
+                         out, out.at[:, 0].set(-1.0))
+
+    return {"Out": [jax.vmap(per_image)(bboxes, scores)]}

@@ -808,3 +808,95 @@ def _row_conv(ctx, ins, attrs):
     pad = jnp.pad(xm, ((0, 0), (0, K - 1), (0, 0)))
     out = sum(pad[:, i:i + T] * f[i][None, None, :] for i in range(K))
     return {"Out": [jnp.where(mask, out, 0)]}
+
+
+@register_op("im2sequence", inputs=["X"], outputs=["Out"])
+def _im2sequence(ctx, ins, attrs):
+    """cf. im2sequence_op.cc (OCR): image patches -> sequence rows,
+    [N, C, H, W] -> [N * oh * ow, C * kh * kw]."""
+    x = ins["X"][0]
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs.get("strides", [1, 1])
+    p = attrs.get("paddings", [0, 0, 0, 0])  # up, left, down, right
+    x = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))  # [N, C*kh*kw, oh, ow]
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    return {"Out": [out]}
+
+
+@register_op("spp", inputs=["X"], outputs=["Out"])
+def _spp(ctx, ins, attrs):
+    """cf. spp_op.cc: spatial pyramid pooling — concat pooled levels
+    1x1, 2x2, ..., 2^(L-1) bins."""
+    x = ins["X"][0]
+    levels = int(attrs.get("pyramid_height", 3))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        ys = (jnp.arange(h) * bins) // h        # bin id per row
+        xs = (jnp.arange(w) * bins) // w
+        for by in range(bins):
+            for bx in range(bins):
+                m = (ys == by)[:, None] & (xs == bx)[None, :]
+                if ptype == "max":
+                    neg = jnp.finfo(x.dtype).min
+                    v = jnp.max(jnp.where(m[None, None], x, neg),
+                                axis=(2, 3))
+                else:
+                    cnt = jnp.maximum(jnp.sum(m), 1)
+                    v = jnp.sum(jnp.where(m[None, None], x, 0),
+                                axis=(2, 3)) / cnt
+                outs.append(v)
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("fold", inputs=["X"], outputs=["Y"])
+def _fold(ctx, ins, attrs):
+    """cf. fold_op.cc: col2im — inverse of unfold, overlaps summed."""
+    x = ins["X"][0]                             # [N, C*kh*kw, L]
+    oh, ow = attrs["output_sizes"]
+    kh, kw = attrs["kernel_sizes"]
+    sh, sw = attrs.get("strides", [1, 1])
+    ph, pw_ = attrs.get("paddings", [0, 0])[:2] if attrs.get(
+        "paddings") else (0, 0)
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    nh = (oh + 2 * ph - kh) // sh + 1
+    nw = (ow + 2 * pw_ - kw) // sw + 1
+    x = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw_), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            ys = i + sh * jnp.arange(nh)
+            xs = j + sw * jnp.arange(nw)
+            out = out.at[:, :, ys[:, None], xs[None, :]].add(x[:, :, i, j])
+    return {"Y": [out[:, :, ph:ph + oh, pw_:pw_ + ow]]}
+
+
+@register_op("random_crop", inputs=["X"], outputs=["Out"],
+             needs_rng=True, grad=None)
+def _random_crop(ctx, ins, attrs):
+    """cf. random_crop_op.cc: random spatial crop to `shape` (trailing
+    dims)."""
+    import jax
+
+    x = ins["X"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    lead = x.ndim - len(shape)
+    key = ctx.rng()
+    starts = []
+    for i, s in enumerate(shape):
+        hi = x.shape[lead + i] - s
+        k, key = jax.random.split(key)
+        starts.append(jax.random.randint(k, (), 0, hi + 1))
+    idx = (jnp.int32(0),) * lead + tuple(
+        s.astype(jnp.int32) for s in starts)
+    sizes = x.shape[:lead] + tuple(shape)
+    return {"Out": [jax.lax.dynamic_slice(x, idx, sizes)]}

@@ -357,3 +357,71 @@ def _gcd(ctx, ins, attrs):
 @register_op("lcm", inputs=["X", "Y"], outputs=["Out"], grad=None)
 def _lcm(ctx, ins, attrs):
     return {"Out": [jnp.lcm(ins["X"][0], ins["Y"][0])]}
+
+
+@register_op("addcmul", inputs=["Input", "Tensor1", "Tensor2"],
+             outputs=["Out"])
+def _addcmul(ctx, ins, attrs):
+    v = float(attrs.get("value", 1.0))
+    return {"Out": [ins["Input"][0]
+                    + v * ins["Tensor1"][0] * ins["Tensor2"][0]]}
+
+
+@register_op("lerp", inputs=["X", "Y", "Weight"], outputs=["Out"])
+def _lerp(ctx, ins, attrs):
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    return {"Out": [x + w * (y - x)]}
+
+
+@register_op("i0", inputs=["X"], outputs=["Out"])
+def _i0(ctx, ins, attrs):
+    from jax.scipy.special import i0
+
+    return {"Out": [i0(ins["X"][0])]}
+
+
+@register_op("i1", inputs=["X"], outputs=["Out"])
+def _i1(ctx, ins, attrs):
+    from jax.scipy.special import i1
+
+    return {"Out": [i1(ins["X"][0])]}
+
+
+@register_op("isinf", inputs=["X"], outputs=["Out"], grad=None)
+def _isinf(ctx, ins, attrs):
+    return {"Out": [jnp.isinf(ins["X"][0])]}
+
+
+@register_op("l1_norm", inputs=["X"], outputs=["Out"])
+def _l1_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.abs(ins["X"][0]))]}
+
+
+@register_op("frobenius_norm", inputs=["X"], outputs=["Out"])
+def _frobenius_norm(ctx, ins, attrs):
+    axis = attrs.get("axis")
+    return {"Out": [jnp.sqrt(jnp.sum(
+        ins["X"][0] ** 2,
+        axis=tuple(axis) if axis else None,
+        keepdims=bool(attrs.get("keep_dim", False))))]}
+
+
+@register_op("modified_huber_loss", inputs=["X", "Y"],
+             outputs=["Out", "IntermediateVal"], no_grad_slots=("Y",))
+def _modified_huber_loss(ctx, ins, attrs):
+    """cf. modified_huber_loss_op.cc: binary classification loss on
+    margin z = (2y-1)*x: max(0,1-z)^2 for z >= -1, else -4z."""
+    x = ins["X"][0].reshape(-1)
+    y = ins["Y"][0].reshape(-1).astype(x.dtype)
+    z = (2.0 * y - 1.0) * x
+    loss = jnp.where(z >= -1.0, jnp.maximum(0.0, 1.0 - z) ** 2, -4.0 * z)
+    return {"Out": [loss[:, None]], "IntermediateVal": [z[:, None]]}
+
+
+@register_op("clip_by_norm", inputs=["X"], outputs=["Out"])
+def _clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    mx = float(attrs["max_norm"])
+    norm = jnp.sqrt(jnp.sum(x * x))
+    return {"Out": [jnp.where(norm > mx, x * (mx / jnp.maximum(norm, 1e-12)),
+                              x)]}

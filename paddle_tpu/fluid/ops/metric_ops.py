@@ -185,3 +185,26 @@ def _detection_map(ctx, ins, attrs):
     mAP = jnp.sum(jnp.where(have, aps, 0.0)) / jnp.maximum(
         jnp.sum(have), 1)
     return {"MAP": [mAP.astype(jnp.float32)[None]]}
+
+
+@register_op("mean_iou", inputs=["Predictions", "Labels"],
+             outputs=["OutMeanIou", "OutWrong", "OutCorrect"], grad=None)
+def _mean_iou(ctx, ins, attrs):
+    """cf. metrics mean_iou_op.cc: mean intersection-over-union across
+    segmentation classes present in prediction or label."""
+    pred = ins["Predictions"][0].reshape(-1)
+    lab = ins["Labels"][0].reshape(-1)
+    C = int(attrs["num_classes"])
+    inter = jnp.zeros((C,), jnp.float32).at[
+        jnp.where(pred == lab, pred, C - 1)
+    ].add(jnp.where(pred == lab, 1.0, 0.0))
+    area_p = jnp.zeros((C,), jnp.float32).at[pred].add(1.0)
+    area_l = jnp.zeros((C,), jnp.float32).at[lab].add(1.0)
+    union = area_p + area_l - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+    correct = inter.astype(jnp.int64)
+    wrong = (area_p - inter).astype(jnp.int64)
+    return {"OutMeanIou": [miou[None].astype(jnp.float32)],
+            "OutWrong": [wrong], "OutCorrect": [correct]}

@@ -611,3 +611,135 @@ def _squared_l2_distance(ctx, ins, attrs):
     red = tuple(range(1, sub.ndim))
     return {"Out": [jnp.sum(sub * sub, axis=red, keepdims=False)[:, None]],
             "sub_result": [sub]}
+
+
+@register_op("take", inputs=["X", "Index"], outputs=["Out"],
+             no_grad_slots=("Index",))
+def _take(ctx, ins, attrs):
+    """cf. take (2.x): flat-index gather with clip/wrap modes."""
+    x, idx = ins["X"][0].reshape(-1), ins["Index"][0]
+    mode = attrs.get("mode", "raise")
+    n = x.shape[0]
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    else:  # raise / clip both clamp under jit (no host asserts)
+        idx = jnp.clip(idx, -n, n - 1)
+    return {"Out": [x[idx.astype(jnp.int32)]]}
+
+
+@register_op("index_add", inputs=["X", "Index", "AddValue"],
+             outputs=["Out"], no_grad_slots=("Index",))
+def _index_add(ctx, ins, attrs):
+    axis = int(attrs.get("axis", 0))
+    x, idx, v = ins["X"][0], ins["Index"][0], ins["AddValue"][0]
+    x = jnp.moveaxis(x, axis, 0)
+    v = jnp.moveaxis(v, axis, 0)
+    out = x.at[idx.astype(jnp.int32)].add(v)
+    return {"Out": [jnp.moveaxis(out, 0, axis)]}
+
+
+@register_op("index_put", inputs=["X", "Index", "Value"],
+             outputs=["Out"], no_grad_slots=("Index",))
+def _index_put(ctx, ins, attrs):
+    x, v = ins["X"][0], ins["Value"][0]
+    idx = tuple(i.astype(jnp.int32) for i in ins["Index"])
+    if attrs.get("accumulate", False):
+        return {"Out": [x.at[idx].add(v)]}
+    return {"Out": [x.at[idx].set(v)]}
+
+
+@register_op("fill_diagonal", inputs=["X"], outputs=["Out"], grad=None)
+def _fill_diagonal(ctx, ins, attrs):
+    x = ins["X"][0]
+    v = attrs.get("value", 0.0)
+    n = min(x.shape[-2], x.shape[-1])
+    i = jnp.arange(n)
+    return {"Out": [x.at[..., i, i].set(jnp.asarray(v, x.dtype))]}
+
+
+@register_op("diagonal", inputs=["Input"], outputs=["Out"])
+def _diagonal(ctx, ins, attrs):
+    return {"Out": [jnp.diagonal(
+        ins["Input"][0], offset=int(attrs.get("offset", 0)),
+        axis1=int(attrs.get("axis1", 0)),
+        axis2=int(attrs.get("axis2", 1)))]}
+
+
+@register_op("rot90", inputs=["X"], outputs=["Out"])
+def _rot90(ctx, ins, attrs):
+    axes = attrs.get("axes", [0, 1])
+    return {"Out": [jnp.rot90(ins["X"][0], k=int(attrs.get("k", 1)),
+                              axes=tuple(axes))]}
+
+
+@register_op("pad_constant_like", inputs=["X", "Y"], outputs=["Out"],
+             no_grad_slots=("X",))
+def _pad_constant_like(ctx, ins, attrs):
+    """cf. pad_constant_like_op.cc: pad Y up to X's shape."""
+    x, y = ins["X"][0], ins["Y"][0]
+    cfg = tuple((0, int(a) - int(b)) for a, b in zip(x.shape, y.shape))
+    return {"Out": [jnp.pad(y, cfg, constant_values=float(
+        attrs.get("pad_value", 0.0)))]}
+
+
+@register_op("shuffle_batch", inputs=["X"], outputs=["Out", "ShuffleIdx"],
+             needs_rng=True, grad=None)
+def _shuffle_batch(ctx, ins, attrs):
+    """cf. shuffle_batch_op.cc: random permutation of dim-0 rows."""
+    import jax
+
+    x = ins["X"][0]
+    perm = jax.random.permutation(ctx.rng(), x.shape[0])
+    return {"Out": [x[perm]], "ShuffleIdx": [perm.astype(jnp.int64)]}
+
+
+@register_op("sampling_id", inputs=["X"], outputs=["Out"],
+             needs_rng=True, grad=None)
+def _sampling_id(ctx, ins, attrs):
+    """cf. sampling_id_op.cc: sample one category per row of a
+    probability matrix."""
+    import jax
+
+    p = ins["X"][0]
+    ids = jax.random.categorical(ctx.rng(), jnp.log(p + 1e-20), axis=-1)
+    return {"Out": [ids.astype(jnp.int64)]}
+
+
+@register_op("uniform_random_batch_size_like", inputs=["Input"],
+             outputs=["Out"], needs_rng=True, grad=None)
+def _uniform_random_bsl(ctx, ins, attrs):
+    import jax
+
+    from ..core.dtypes import to_jnp
+
+    x = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[int(attrs.get("input_dim_idx", 0))] = x.shape[
+        int(attrs.get("output_dim_idx", 0))]
+    return {"Out": [jax.random.uniform(
+        ctx.rng(), tuple(shape),
+        dtype=to_jnp(attrs.get("dtype", "float32")),
+        minval=float(attrs.get("min", -1.0)),
+        maxval=float(attrs.get("max", 1.0)))]}
+
+
+@register_op("batch_fc", inputs=["Input", "W", "Bias"], outputs=["Out"])
+def _batch_fc(ctx, ins, attrs):
+    """cf. batch_fc_op.cc: per-slot fc — [S, B, I] x [S, I, O] + [S, 1, O]."""
+    x, w = ins["Input"][0], ins["W"][0]
+    out = jnp.einsum("sbi,sio->sbo", x, w)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return {"Out": [out]}
+
+
+@register_op("expand_v2", inputs=["X"], outputs=["Out"])
+def _expand_v2(ctx, ins, attrs):
+    """cf. expand_v2_op.cc: broadcast to `shape`; -1 keeps the input dim
+    (input aligned to the right of shape)."""
+    x = ins["X"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    in_shape = (1,) * (len(shape) - x.ndim) + x.shape
+    target = tuple(
+        int(i) if s == -1 else s for s, i in zip(shape, in_shape))
+    return {"Out": [jnp.broadcast_to(x, target)]}

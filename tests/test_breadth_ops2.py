@@ -1,0 +1,259 @@
+"""Oracle tests for the second breadth batch (roi/psroi pooling,
+matrix_nms, affine_channel, im2sequence, spp, fold, mean_iou, tensor and
+math extras)."""
+
+import numpy as np
+import pytest
+
+from op_test import run_single_op
+
+
+def _r(rng, *shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+def test_math_extras(rng):
+    x, t1, t2 = _r(rng, 3, 4), _r(rng, 3, 4), _r(rng, 3, 4)
+    outs, _ = run_single_op(
+        "addcmul", {"Input": x, "Tensor1": t1, "Tensor2": t2},
+        {"value": 0.5}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], x + 0.5 * t1 * t2, rtol=1e-5)
+
+    w = rng.rand(3, 4).astype(np.float32)
+    outs, _ = run_single_op("lerp", {"X": x, "Y": t1, "Weight": w}, {},
+                            ["Out"])
+    np.testing.assert_allclose(outs["Out"], x + w * (t1 - x), rtol=1e-5)
+
+    from scipy import special as sp  # scipy ships with jax's deps
+
+    outs, _ = run_single_op("i0", {"X": x}, {}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], sp.i0(x), rtol=1e-4)
+    outs, _ = run_single_op("i1", {"X": x}, {}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], sp.i1(x), rtol=1e-4)
+
+    y = x.copy()
+    y[0, 0] = np.inf
+    outs, _ = run_single_op("isinf", {"X": y}, {}, ["Out"])
+    assert outs["Out"][0, 0] and not outs["Out"][1, 1]
+
+    outs, _ = run_single_op("l1_norm", {"X": x}, {}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], np.abs(x).sum(), rtol=1e-5)
+    outs, _ = run_single_op("frobenius_norm", {"X": x}, {}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], np.sqrt((x ** 2).sum()),
+                               rtol=1e-5)
+
+    mx = 1.5
+    outs, _ = run_single_op("clip_by_norm", {"X": x * 10},
+                            {"max_norm": mx}, ["Out"])
+    np.testing.assert_allclose(
+        np.sqrt((outs["Out"] ** 2).sum()), mx, rtol=1e-4)
+
+
+def test_modified_huber_loss(rng):
+    x = _r(rng, 6, 1)
+    y = (rng.rand(6, 1) > 0.5).astype(np.float32)
+    outs, _ = run_single_op("modified_huber_loss", {"X": x, "Y": y}, {},
+                            ["Out", "IntermediateVal"])
+    z = (2 * y - 1) * x
+    expect = np.where(z >= -1, np.maximum(0, 1 - z) ** 2, -4 * z)
+    np.testing.assert_allclose(outs["Out"], expect, rtol=1e-5)
+
+
+def test_tensor_extras2(rng):
+    x = _r(rng, 3, 4)
+    idx = np.array([0, 5, 11, -1], np.int64)
+    outs, _ = run_single_op("take", {"X": x, "Index": idx}, {}, ["Out"])
+    np.testing.assert_allclose(outs["Out"], x.reshape(-1)[idx], rtol=1e-6)
+
+    v = _r(rng, 2, 4)
+    outs, _ = run_single_op(
+        "index_add",
+        {"X": x, "Index": np.array([0, 2], np.int64), "AddValue": v},
+        {"axis": 0}, ["Out"])
+    expect = x.copy()
+    expect[[0, 2]] += v
+    np.testing.assert_allclose(outs["Out"], expect, rtol=1e-5)
+
+    m = _r(rng, 4, 4)
+    outs, _ = run_single_op("fill_diagonal", {"X": m}, {"value": 9.0},
+                            ["Out"])
+    expect = m.copy()
+    np.fill_diagonal(expect, 9.0)
+    np.testing.assert_allclose(outs["Out"], expect)
+
+    outs, _ = run_single_op("diagonal", {"Input": m}, {"offset": 1},
+                            ["Out"])
+    np.testing.assert_allclose(outs["Out"], np.diagonal(m, offset=1))
+
+    outs, _ = run_single_op("rot90", {"X": m}, {"k": 1, "axes": [0, 1]},
+                            ["Out"])
+    np.testing.assert_allclose(outs["Out"], np.rot90(m))
+
+    big, small = _r(rng, 3, 5), _r(rng, 2, 3)
+    outs, _ = run_single_op("pad_constant_like",
+                            {"X": big, "Y": small}, {"pad_value": 2.0},
+                            ["Out"])
+    expect = np.full((3, 5), 2.0, np.float32)
+    expect[:2, :3] = small
+    np.testing.assert_allclose(outs["Out"], expect)
+
+    outs, _ = run_single_op("expand_v2", {"X": _r(rng, 1, 4)},
+                            {"shape": [3, -1]}, ["Out"])
+    assert outs["Out"].shape == (3, 4)
+
+
+def test_shuffle_and_sampling_ops(rng):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    outs, _ = run_single_op("shuffle_batch", {"X": x}, {},
+                            ["Out", "ShuffleIdx"])
+    assert sorted(outs["Out"].reshape(-1).tolist()) == list(range(8))
+    np.testing.assert_allclose(
+        outs["Out"].reshape(-1), x.reshape(-1)[outs["ShuffleIdx"]])
+
+    p = np.zeros((4, 5), np.float32)
+    p[:, 2] = 1.0  # deterministic: category 2
+    outs, _ = run_single_op("sampling_id", {"X": p}, {}, ["Out"])
+    assert (outs["Out"] == 2).all()
+
+    outs, _ = run_single_op(
+        "uniform_random_batch_size_like", {"Input": _r(rng, 6, 3)},
+        {"shape": [0, 7], "min": 0.0, "max": 1.0}, ["Out"])
+    assert outs["Out"].shape == (6, 7)
+    assert 0 <= outs["Out"].min() and outs["Out"].max() <= 1
+
+
+def test_batch_fc(rng):
+    x, w, b = _r(rng, 2, 3, 4), _r(rng, 2, 4, 5), _r(rng, 2, 1, 5)
+    outs, _ = run_single_op("batch_fc", {"Input": x, "W": w, "Bias": b},
+                            {}, ["Out"])
+    np.testing.assert_allclose(
+        outs["Out"], np.einsum("sbi,sio->sbo", x, w) + b, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# vision
+# ---------------------------------------------------------------------------
+
+
+def test_roi_pool_oracle(rng):
+    x = _r(rng, 1, 2, 8, 8)
+    rois = np.array([[0, 0, 3, 3], [2, 2, 7, 7]], np.float32)
+    outs, _ = run_single_op(
+        "roi_pool", {"X": x, "ROIs": rois},
+        {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
+        ["Out"])
+    got = outs["Out"]
+    assert got.shape == (2, 2, 2, 2)
+    # oracle for roi 0 bin (0,0): rows 0..1, cols 0..1 of a 4x4 roi
+    np.testing.assert_allclose(got[0, :, 0, 0],
+                               x[0, :, 0:2, 0:2].max(axis=(1, 2)),
+                               rtol=1e-5)
+    np.testing.assert_allclose(got[0, :, 1, 1],
+                               x[0, :, 2:4, 2:4].max(axis=(1, 2)),
+                               rtol=1e-5)
+
+
+def test_psroi_pool_shape_and_average(rng):
+    ph = pw = 2
+    oc = 3
+    x = _r(rng, 1, ph * pw * oc, 6, 6)
+    rois = np.array([[0, 0, 6, 6]], np.float32)
+    outs, _ = run_single_op(
+        "psroi_pool", {"X": x, "ROIs": rois},
+        {"pooled_height": ph, "pooled_width": pw, "output_channels": oc,
+         "spatial_scale": 1.0}, ["Out"])
+    got = outs["Out"]
+    assert got.shape == (1, oc, ph, pw)
+    # bin (0,0) averages group-0 channels over rows/cols 0..2
+    grp0 = x[0, :oc, 0:3, 0:3]
+    np.testing.assert_allclose(got[0, :, 0, 0], grp0.mean(axis=(1, 2)),
+                               rtol=1e-4)
+
+
+def test_affine_channel(rng):
+    x = _r(rng, 2, 3, 4, 4)
+    s = _r(rng, 3)
+    b = _r(rng, 3)
+    outs, _ = run_single_op("affine_channel",
+                            {"X": x, "Scale": s, "Bias": b}, {}, ["Out"])
+    np.testing.assert_allclose(
+        outs["Out"], x * s[None, :, None, None] + b[None, :, None, None],
+        rtol=1e-5)
+
+
+def test_matrix_nms_decay(rng):
+    # two overlapping boxes of one class: the lower-scored one decays
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 9.5],
+                       [50, 50, 60, 60]]], np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)  # [1, C=1, M=3]
+    outs, _ = run_single_op(
+        "matrix_nms", {"BBoxes": boxes, "Scores": scores},
+        {"score_threshold": 0.05, "nms_top_k": 3, "keep_top_k": 3,
+         "use_gaussian": True, "gaussian_sigma": 0.5,
+         "background_label": -1},
+        ["Out"])
+    got = outs["Out"][0]          # [3, 6]
+    assert got[0, 1] == pytest.approx(0.9, abs=1e-5)  # top survives intact
+    # the overlapping second box decayed hard; the far box decayed ~0
+    decayed = got[got[:, 0] >= 0]
+    far = decayed[np.isclose(decayed[:, 2], 50)]
+    near = decayed[np.isclose(decayed[:, 1], decayed[:, 1].min())]
+    assert far[0, 1] == pytest.approx(0.7, abs=1e-3)
+    assert near[0, 1] < 0.2  # heavy gaussian decay for IoU ~0.95
+
+
+def test_im2sequence(rng):
+    x = _r(rng, 1, 2, 4, 4)
+    outs, _ = run_single_op(
+        "im2sequence", {"X": x},
+        {"kernels": [2, 2], "strides": [2, 2]}, ["Out"])
+    got = outs["Out"]
+    assert got.shape == (4, 8)
+    np.testing.assert_allclose(
+        got[0], x[0, :, 0:2, 0:2].reshape(-1), rtol=1e-6)
+
+
+def test_spp(rng):
+    x = _r(rng, 2, 3, 8, 8)
+    outs, _ = run_single_op("spp", {"X": x},
+                            {"pyramid_height": 2, "pooling_type": "max"},
+                            ["Out"])
+    got = outs["Out"]
+    assert got.shape == (2, 3 * (1 + 4))
+    np.testing.assert_allclose(got[:, :3], x.max(axis=(2, 3)), rtol=1e-5)
+    np.testing.assert_allclose(
+        got[:, 3:6], x[:, :, :4, :4].max(axis=(2, 3)), rtol=1e-5)
+
+
+def test_fold_inverts_unfold_counts(rng):
+    x = _r(rng, 1, 2, 6, 6)
+    unf, _ = run_single_op(
+        "unfold", {"X": x},
+        {"kernel_sizes": [2, 2], "strides": [2, 2]}, ["Y"])
+    fold, _ = run_single_op(
+        "fold", {"X": unf["Y"]},
+        {"output_sizes": [6, 6], "kernel_sizes": [2, 2],
+         "strides": [2, 2]}, ["Y"])
+    # non-overlapping stride == kernel: fold(unfold(x)) == x
+    np.testing.assert_allclose(fold["Y"], x, rtol=1e-6)
+
+
+def test_random_crop(rng):
+    x = _r(rng, 2, 3, 8, 8)
+    outs, _ = run_single_op("random_crop", {"X": x}, {"shape": [5, 5]},
+                            ["Out"])
+    assert outs["Out"].shape == (2, 3, 5, 5)
+
+
+def test_mean_iou(rng):
+    C = 3
+    pred = np.array([0, 0, 1, 1, 2, 2], np.int32)
+    lab = np.array([0, 1, 1, 1, 2, 0], np.int32)
+    outs, _ = run_single_op(
+        "mean_iou", {"Predictions": pred, "Labels": lab},
+        {"num_classes": C}, ["OutMeanIou", "OutWrong", "OutCorrect"])
+    # class ious: 0: inter 1, union 3 -> 1/3; 1: inter 2, union 3 -> 2/3;
+    # 2: inter 1, union 2 -> 1/2
+    expect = (1 / 3 + 2 / 3 + 1 / 2) / 3
+    np.testing.assert_allclose(float(outs["OutMeanIou"][0]), expect,
+                               rtol=1e-5)
