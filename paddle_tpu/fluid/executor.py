@@ -178,6 +178,19 @@ class _LoweredBlock:
                         and global0 % dp_total == 0):
                     self.feed_shardings[n] = NamedSharding(jmesh, P("dp"))
                 else:
+                    if (mesh.has_axis("dp") and dp_total > 1 and nproc > 1
+                            and len(shp) >= 1 and global0 > 0):
+                        # a replicated feed is stitched by treating each
+                        # process's LOCAL batch as the full global value —
+                        # with per-rank data that silently builds an
+                        # inconsistent array; refuse rather than corrupt
+                        raise ValueError(
+                            "GSPMD feed %r (local shape %s) cannot be "
+                            "sharded over the dp axis (global dim0 %d %% "
+                            "dp %d != 0) in a multi-process run; pad the "
+                            "batch to a dp-divisible size or feed "
+                            "identical data on every rank via a "
+                            "0-d/scalar var" % (n, shp, global0, dp_total))
                     self.feed_shardings[n] = repl
             self.state_shardings = {
                 n: _sharding_for(n)

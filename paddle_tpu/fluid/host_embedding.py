@@ -38,6 +38,22 @@ def _bucket(n):
     return b
 
 
+def _global_bucket(n):
+    """Bucket size agreed across ALL processes: allgather each rank's
+    count and bucket the max, so every rank pads its exchange buffers to
+    the same shape (process_allgather requires identical per-process
+    shapes; ranks with uneven batches would otherwise hang)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return _bucket(n)
+    from jax.experimental import multihost_utils
+
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray([n], np.int64)))
+    return _bucket(int(counts.max()))
+
+
 class HostEmbedding:
     """One host-resident row-sharded table + its optimizer state."""
 
@@ -82,7 +98,7 @@ class HostEmbedding:
         from jax.experimental import multihost_utils
 
         # 1 round: gather every rank's (padded) request list
-        P = _bucket(len(uniq))
+        P = _global_bucket(len(uniq))
         req = np.full((P,), -1, np.int64)
         req[: len(uniq)] = uniq
         all_req = np.asarray(multihost_utils.process_allgather(req))
@@ -109,10 +125,15 @@ class HostEmbedding:
                 % (self.num_rows, self.name))
         P = _bucket(max(len(uniq), 1))
         pulled = np.zeros((P, self.dim), self.dtype)
-        if uniq.size:
-            pulled[: len(uniq)] = self._gather_rows(uniq)
-            if self.padding_idx is not None:
-                pulled[: len(uniq)][uniq == self.padding_idx] = 0
+        if uniq.size or self.nproc > 1:
+            # nproc>1: join the exchange even with zero local ids — peers
+            # are blocked in the same collective and a rank that skipped
+            # it would hang them
+            rows = self._gather_rows(uniq)
+            if uniq.size:
+                pulled[: len(uniq)] = rows
+                if self.padding_idx is not None:
+                    pulled[: len(uniq)][uniq == self.padding_idx] = 0
         return pulled, inv.reshape(ids.shape).astype(np.int64), uniq
 
     def push(self, uniq, grad_rows, lr=None):
@@ -128,7 +149,7 @@ class HostEmbedding:
             from jax.experimental import multihost_utils
 
             # exchange (uniq, grad) pairs via the same gather trick
-            P = _bucket(len(uniq))
+            P = _global_bucket(len(uniq))
             req = np.full((P,), -1, np.int64)
             req[: len(uniq)] = uniq
             gpad = np.zeros((P, self.dim), np.float32)

@@ -741,12 +741,12 @@ def _matrix_nms(ctx, ins, attrs):
     bboxes = ins["BBoxes"][0]                   # [N, M, 4]
     scores = ins["Scores"][0]                   # [N, C, M]
     thr = float(attrs.get("score_threshold", 0.05))
-    post = int(attrs.get("post_threshold", 0) or 0)
+    post = float(attrs.get("post_threshold", 0.0))
     nms_top_k = int(attrs.get("nms_top_k", 100))
     keep_top_k = int(attrs.get("keep_top_k", 100))
-    use_gauss = bool(attrs.get("use_gaussian", True))
+    use_gauss = bool(attrs.get("use_gaussian", False))
     sigma = float(attrs.get("gaussian_sigma", 2.0))
-    bg = int(attrs.get("background_label", -1))
+    bg = int(attrs.get("background_label", 0))
     N, C, M = scores.shape
     K = min(nms_top_k, M)
 
@@ -778,7 +778,7 @@ def _matrix_nms(ctx, ins, attrs):
         if pad > 0:
             out = jnp.concatenate(
                 [out, jnp.full((pad, 6), -1.0, out.dtype)], axis=0)
-        return jnp.where(out[:, 1:2] > max(post, 0),
+        return jnp.where(out[:, 1:2] > post,
                          out, out.at[:, 0].set(-1.0))
 
     return {"Out": [jax.vmap(per_image)(bboxes, scores)]}

@@ -714,8 +714,8 @@ def _uniform_random_bsl(ctx, ins, attrs):
 
     x = ins["Input"][0]
     shape = list(attrs["shape"])
-    shape[int(attrs.get("input_dim_idx", 0))] = x.shape[
-        int(attrs.get("output_dim_idx", 0))]
+    shape[int(attrs.get("output_dim_idx", 0))] = x.shape[
+        int(attrs.get("input_dim_idx", 0))]
     return {"Out": [jax.random.uniform(
         ctx.rng(), tuple(shape),
         dtype=to_jnp(attrs.get("dtype", "float32")),

@@ -130,16 +130,19 @@ class BatchSampler:
 
 
 def _mp_worker_main(dataset, collate, task_q, res_q):
-    """DataLoader worker entry (module-level: spawn pickles it)."""
+    """DataLoader worker entry (module-level: spawn pickles it).
+
+    Persistent across epochs: tasks carry an epoch tag that is echoed
+    back so the parent can discard results of an abandoned epoch."""
     while True:
         item = task_q.get()
         if item is None:
             return
-        i, idx = item
+        epoch, i, idx = item
         try:
-            res_q.put((i, collate([dataset[j] for j in idx]), None))
+            res_q.put((epoch, i, collate([dataset[j] for j in idx]), None))
         except Exception as e:  # surface in the parent
-            res_q.put((i, None, "%s: %s" % (type(e).__name__, e)))
+            res_q.put((epoch, i, None, "%s: %s" % (type(e).__name__, e)))
 
 
 def default_collate(items):
@@ -166,6 +169,8 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate
         self.num_workers = max(0, int(num_workers))
         self._gen = None
+        self._pool = None        # persistent mp worker pool (lazily started)
+        self._mp_epoch = 0
         if dataset is not None:
             self.batch_sampler = batch_sampler or BatchSampler(
                 dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
@@ -218,83 +223,131 @@ class DataLoader:
         so a straggler batch cannot let the others run arbitrarily far
         ahead (the in-order buffer stays <= window batches), and the
         result wait polls worker liveness so a killed worker raises
-        instead of hanging the trainer."""
-        import multiprocessing as mp
+        instead of hanging the trainer.
+
+        The worker pool PERSISTS across epochs (spawn + dataset pickling
+        cost is paid once per DataLoader, not once per epoch); epochs are
+        distinguished by a generation tag so results of an abandoned
+        epoch are discarded, and close() tears the pool down.  Two
+        consequences, both matching the reference's persistent workers:
+        only ONE live iterator per DataLoader — starting a new iteration
+        invalidates the previous one (it raises on next use) — and the
+        dataset is pickled once at pool start, so mutating it between
+        epochs has no effect on workers (call close() to force a
+        respawn)."""
         import queue as _queue
 
-        ctx = mp.get_context("spawn")
         batches = list(self.batch_sampler)
         if not batches:
             return
-        workers = min(self.num_workers, len(batches))
-        window = max(2 * workers, self.capacity)
+        procs, task_q, res_q = self._ensure_pool()
+        self._mp_epoch += 1
+        epoch = self._mp_epoch
+        window = max(2 * len(procs), self.capacity)
+        issued = 0
+
+        def issue_up_to(limit):
+            nonlocal issued
+            while issued < min(limit, len(batches)):
+                task_q.put((epoch, issued, batches[issued]))
+                issued += 1
+
+        issue_up_to(window)
+        pending = {}
+        next_i = 0
+        received = 0
+        stalled_polls = 0
+        while received < len(batches):
+            if self._mp_epoch != epoch:
+                raise RuntimeError(
+                    "this DataLoader iterator was invalidated by a newer "
+                    "iteration (one live iterator per DataLoader when "
+                    "num_workers > 0)")
+            try:
+                ep, i, b, e = res_q.get(timeout=5.0)
+                stalled_polls = 0
+                if ep != epoch:
+                    if ep == self._mp_epoch:
+                        # belongs to the iterator that invalidated us —
+                        # hand it back before we raise at the loop top
+                        res_q.put((ep, i, b, e))
+                    continue         # stale result of an abandoned epoch
+            except _queue.Empty:
+                dead = sum(1 for p in procs if not p.is_alive())
+                if dead == len(procs):
+                    self.close()
+                    raise RuntimeError(
+                        "all DataLoader workers died without "
+                        "delivering results (OOM-killed?)")
+                if dead:
+                    # a dead worker took its in-flight task with it;
+                    # no result can ever unblock next_i — fail fast
+                    # instead of hanging the trainer
+                    stalled_polls += 1
+                    if stalled_polls >= 2:
+                        self.close()
+                        raise RuntimeError(
+                            "%d DataLoader worker(s) died and the "
+                            "stream stalled (batch %d never arrived)"
+                            % (dead, next_i))
+                continue
+            received += 1
+            if e is not None:
+                raise RuntimeError(
+                    "DataLoader worker failed on batch %d: %s" % (i, e))
+            pending[i] = b
+            while next_i in pending:
+                yield pending.pop(next_i)
+                next_i += 1
+                issue_up_to(next_i + window)
+
+    def _ensure_pool(self):
+        """Start (once) and return the persistent worker pool."""
+        if self._pool is not None:
+            procs = self._pool[0]
+            if all(p.is_alive() for p in procs):
+                return self._pool
+            self.close()                     # respawn a broken pool
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
         task_q = ctx.Queue()
         res_q = ctx.Queue()
-
         procs = [
             ctx.Process(
                 target=_mp_worker_main,
                 args=(self.dataset, self.collate_fn, task_q, res_q),
                 daemon=True,
             )
-            for _ in range(workers)
+            for _ in range(self.num_workers)
         ]
         for p in procs:
             p.start()
-        issued = 0
-        done_sent = 0
+        self._pool = (procs, task_q, res_q)
+        return self._pool
 
-        def issue_up_to(limit):
-            nonlocal issued, done_sent
-            while issued < min(limit, len(batches)):
-                task_q.put((issued, batches[issued]))
-                issued += 1
-            if issued == len(batches) and done_sent < len(procs):
-                for _ in range(len(procs) - done_sent):
-                    task_q.put(None)
-                done_sent = len(procs)
-
-        try:
-            issue_up_to(window)
-            pending = {}
-            next_i = 0
-            received = 0
-            stalled_polls = 0
-            while received < len(batches):
-                try:
-                    i, b, e = res_q.get(timeout=5.0)
-                    stalled_polls = 0
-                except _queue.Empty:
-                    dead = sum(1 for p in procs if not p.is_alive())
-                    if dead == len(procs):
-                        raise RuntimeError(
-                            "all DataLoader workers died without "
-                            "delivering results (OOM-killed?)")
-                    if dead:
-                        # a dead worker took its in-flight task with it;
-                        # no result can ever unblock next_i — fail fast
-                        # instead of hanging the trainer
-                        stalled_polls += 1
-                        if stalled_polls >= 2:
-                            raise RuntimeError(
-                                "%d DataLoader worker(s) died and the "
-                                "stream stalled (batch %d never arrived)"
-                                % (dead, next_i))
-                    continue
-                received += 1
-                if e is not None:
-                    raise RuntimeError(
-                        "DataLoader worker failed on batch %d: %s" % (i, e))
-                pending[i] = b
-                while next_i in pending:
-                    yield pending.pop(next_i)
-                    next_i += 1
-                    issue_up_to(next_i + window)
-        finally:
-            for p in procs:
+    def close(self):
+        """Tear down the persistent worker pool (idempotent)."""
+        if self._pool is None:
+            return
+        procs, task_q, _ = self._pool
+        self._pool = None
+        for p in procs:
+            if p.is_alive():
+                task_q.put(None)
+        for p in procs:
+            p.join(timeout=1)
+        for p in procs:
+            if p.is_alive():
                 p.terminate()
-            for p in procs:
-                p.join(timeout=5)
+        for p in procs:
+            p.join(timeout=5)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def __iter__(self):
         q = queue.Queue(maxsize=self.capacity)
