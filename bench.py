@@ -238,7 +238,7 @@ def _bench_resnet(on_tpu, peak):
 
     if on_tpu:
         B, HW, k_short, k_long, reps = (
-            int(os.getenv("BENCH_RESNET_B", "64")), 224, 10, 30, 2)
+            int(os.getenv("BENCH_RESNET_B", "128")), 224, 10, 30, 2)
         depth, flops_img = 50, 3 * 4.089e9
     else:
         B, HW, k_short, k_long, reps = 4, 32, 1, 3, 1

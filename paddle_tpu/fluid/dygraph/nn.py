@@ -62,9 +62,11 @@ class Conv2D(Layer):
 
     def __init__(self, num_channels, num_filters, filter_size, stride=1,
                  padding=0, dilation=1, groups=1, param_attr=None,
-                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32",
+                 data_format="NCHW"):
         super().__init__(dtype=dtype)
         self._act = act
+        self._data_format = data_format
         self._stride = _pair(stride)
         self._padding = _pair(padding)
         self._dilation = _pair(dilation)
@@ -99,12 +101,14 @@ class Conv2D(Layer):
                 "paddings": self._padding,
                 "dilations": self._dilation,
                 "groups": self._groups,
+                "data_format": self._data_format,
             },
             out_slots=("Output",),
         )
         if self.bias is not None:
+            axis = 1 if self._data_format == "NCHW" else 3
             out = append_simple_op(
-                "elementwise_add", {"X": out, "Y": self.bias}, {"axis": 1}
+                "elementwise_add", {"X": out, "Y": self.bias}, {"axis": axis}
             )
         if self._act:
             out = append_simple_op(self._act, {"X": out}, {})
@@ -116,7 +120,7 @@ class Pool2D(Layer):
 
     def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
                  pool_padding=0, global_pooling=False, use_cudnn=True,
-                 ceil_mode=False, exclusive=True):
+                 ceil_mode=False, exclusive=True, data_format="NCHW"):
         super().__init__()
         self._attrs = {
             "pooling_type": pool_type,
@@ -126,6 +130,7 @@ class Pool2D(Layer):
             "global_pooling": global_pooling,
             "ceil_mode": ceil_mode,
             "exclusive": exclusive,
+            "data_format": data_format,
         }
 
     def forward(self, input):

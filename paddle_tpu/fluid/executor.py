@@ -181,16 +181,19 @@ class _LoweredBlock:
                     if (mesh.has_axis("dp") and dp_total > 1 and nproc > 1
                             and len(shp) >= 1 and global0 > 0):
                         # a replicated feed is stitched by treating each
-                        # process's LOCAL batch as the full global value —
-                        # with per-rank data that silently builds an
-                        # inconsistent array; refuse rather than corrupt
-                        raise ValueError(
+                        # process's LOCAL value as the full global value —
+                        # correct only when every rank feeds identical
+                        # data (constant tables etc.); warn about the
+                        # contract rather than silently corrupt
+                        import warnings
+
+                        warnings.warn(
                             "GSPMD feed %r (local shape %s) cannot be "
                             "sharded over the dp axis (global dim0 %d %% "
-                            "dp %d != 0) in a multi-process run; pad the "
-                            "batch to a dp-divisible size or feed "
-                            "identical data on every rank via a "
-                            "0-d/scalar var" % (n, shp, global0, dp_total))
+                            "dp %d != 0); treating it as REPLICATED from "
+                            "this process's local value — every rank must "
+                            "feed identical data for this to be consistent"
+                            % (n, shp, global0, dp_total), stacklevel=3)
                     self.feed_shardings[n] = repl
             self.state_shardings = {
                 n: _sharding_for(n)

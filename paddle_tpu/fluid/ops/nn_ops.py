@@ -22,7 +22,11 @@ def _pair(v):
 
 @register_op("conv2d", inputs=["Input", "Filter"], outputs=["Output"])
 def _conv2d(ctx, ins, attrs):
-    """NCHW conv (cf. conv_op.cc).  groups>1 -> feature_group_count."""
+    """Conv (cf. conv_op.cc).  groups>1 -> feature_group_count.
+
+    data_format NCHW (reference default) or NHWC — on TPU the NHWC form
+    keeps channels on the lane (minor) dimension, which is what XLA's MXU
+    tiling wants; the filter stays OIHW (paddle layout) either way."""
     x, w = ins["Input"][0], ins["Filter"][0]
     # AMP white-list behavior: a float input meets a lower-precision
     # filter (bf16 params under amp) at the filter's dtype
@@ -32,6 +36,7 @@ def _conv2d(ctx, ins, attrs):
     pads = attrs.get("paddings", [0, 0])
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = int(attrs.get("groups", 1))
+    fmt = attrs.get("data_format", attrs.get("data_layout", "NCHW"))
     if len(pads) == 2:
         padding = [(pads[0], pads[0]), (pads[1], pads[1])]
     else:  # [top, bottom, left, right]
@@ -49,7 +54,7 @@ def _conv2d(ctx, ins, attrs):
         padding=padding,
         rhs_dilation=dilations,
         feature_group_count=groups,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(fmt, "OIHW", fmt),
     )
     return {"Output": [out]}
 
@@ -80,11 +85,17 @@ def _conv2d_transpose(ctx, ins, attrs):
     pads = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = int(attrs.get("groups", 1))
-    if groups != 1:
-        raise NotImplementedError("grouped conv2d_transpose")
     kh, kw = int(w.shape[2]), int(w.shape[3])
-    # IOHW -> OIHW with spatial flip
-    w_t = jnp.flip(jnp.swapaxes(w, 0, 1), axis=(2, 3))
+    if groups == 1:
+        # IOHW -> OIHW with spatial flip
+        w_t = jnp.flip(jnp.swapaxes(w, 0, 1), axis=(2, 3))
+    else:
+        # paddle filter [Cin, Cout/g, kh, kw]: per group swap I/O + flip,
+        # concat along O so feature_group_count=g sees [Cout, Cin/g, k, k]
+        cin = int(w.shape[0])
+        wg = w.reshape(groups, cin // groups, w.shape[1], kh, kw)
+        wg = jnp.flip(jnp.swapaxes(wg, 1, 2), axis=(3, 4))
+        w_t = wg.reshape(groups * int(w.shape[1]), cin // groups, kh, kw)
     padding = [
         (dilations[0] * (kh - 1) - pads[0], dilations[0] * (kh - 1) - pads[0]),
         (dilations[1] * (kw - 1) - pads[1], dilations[1] * (kw - 1) - pads[1]),
@@ -96,6 +107,7 @@ def _conv2d_transpose(ctx, ins, attrs):
         padding=padding,
         lhs_dilation=strides,
         rhs_dilation=dilations,
+        feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
     )
     return {"Output": [out]}
@@ -103,27 +115,34 @@ def _conv2d_transpose(ctx, ins, attrs):
 
 @register_op("pool2d", inputs=["X"], outputs=["Out"])
 def _pool2d(ctx, ins, attrs):
-    """max/avg pooling via reduce_window (cf. pool_op.cc)."""
+    """max/avg pooling via reduce_window (cf. pool_op.cc); NCHW or NHWC."""
     x = ins["X"][0]
     ptype = attrs.get("pooling_type", "max")
     ksize = _pair(attrs.get("ksize", [2, 2]))
     strides = _pair(attrs.get("strides", ksize))
     pads = _pair(attrs.get("paddings", [0, 0]))
+    fmt = attrs.get("data_format", attrs.get("data_layout", "NCHW"))
+    h_ax, w_ax = (2, 3) if fmt == "NCHW" else (1, 2)
     if attrs.get("global_pooling", False):
-        ksize = (x.shape[2], x.shape[3])
+        ksize = (x.shape[h_ax], x.shape[w_ax])
         strides = ksize
         pads = (0, 0)
     if attrs.get("adaptive", False):
         oh, ow = ksize
-        ih, iw = x.shape[2], x.shape[3]
+        ih, iw = x.shape[h_ax], x.shape[w_ax]
         if ih % oh or iw % ow:
-            raise NotImplementedError("adaptive pool with non-divisible sizes")
+            return _adaptive_pool_general(x, ptype, (oh, ow), h_ax)
         ksize = (ih // oh, iw // ow)
         strides = ksize
         pads = (0, 0)
-    window = (1, 1) + ksize
-    strides4 = (1, 1) + strides
-    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if fmt == "NCHW":
+        window = (1, 1) + ksize
+        strides4 = (1, 1) + strides
+        padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    else:
+        window = (1,) + ksize + (1,)
+        strides4 = (1,) + strides + (1,)
+        padding = ((0, 0), (pads[0], pads[0]), (pads[1], pads[1]), (0, 0))
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, padding)
@@ -140,6 +159,102 @@ def _pool2d(ctx, ins, attrs):
     return {"Out": [out.astype(x.dtype)]}
 
 
+def _adaptive_pool_general(x, ptype, osize, h_ax):
+    """Adaptive pool with non-divisible bins (cf. pool_op.cc AdaptStartIndex/
+    AdaptEndIndex): bin i covers [floor(i*I/O), ceil((i+1)*I/O))."""
+    oh, ow = osize
+    ih, iw = x.shape[h_ax], x.shape[h_ax + 1]
+
+    def bins(i_size, o_size):
+        return [(i * i_size // o_size, -(-(i + 1) * i_size // o_size))
+                for i in range(o_size)]
+
+    red = jnp.max if ptype == "max" else jnp.mean
+    rows = []
+    for r0, r1 in bins(ih, oh):
+        cols = []
+        for c0, c1 in bins(iw, ow):
+            sl = [slice(None)] * x.ndim
+            sl[h_ax] = slice(r0, r1)
+            sl[h_ax + 1] = slice(c0, c1)
+            cols.append(red(x[tuple(sl)], axis=(h_ax, h_ax + 1)))
+        rows.append(jnp.stack(cols, axis=h_ax))
+    out = jnp.stack(rows, axis=h_ax)
+    return {"Out": [out.astype(x.dtype)]}
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train_fused(x, scale, bias, c_axis, eps):
+    y, m, rstd = _bn_train_fwd_impl(x, scale, bias, c_axis, eps)
+    return y, m, rstd
+
+
+def _bn_train_fwd_impl(x, scale, bias, c_axis, eps):
+    """One-pass batch-norm training forward.
+
+    TPU note: mean and E[x^2] are sibling reduces over the same input, so
+    XLA fuses them into ONE read of x (jnp.var would serialize a second
+    pass); the normalize is then a single fused multiply-add in x's dtype.
+    The hand-written VJP below keeps the backward to two passes (one
+    fused reduce pair over (dy, dy*x), one elementwise dx pass) instead
+    of the larger graph JAX AD would emit.  cf. batch_norm_op.cc,
+    batch_norm_op.cu (cuDNN fused path).
+
+    Numerical robustness: plain E[x^2]-E[x]^2 cancels catastrophically
+    when |mean| >> std, so the pass reduces (x-s) and (x-s)^2 where s is
+    one sample per channel (x[0,...,0,:]) — a free shift within ~std of
+    the true mean, bounding the relative cancellation error by
+    ~eps*(1 + (m-s)^2/var) ~ 1e-6 instead of eps*m^2/var."""
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = tuple(x.shape[c_axis] if i == c_axis else 1
+                   for i in range(x.ndim))
+    idx = tuple(slice(None) if i == c_axis else 0 for i in range(x.ndim))
+    shift = jax.lax.stop_gradient(x[idx].astype(jnp.float32))
+    xs = x.astype(jnp.float32) - shift.reshape(bshape)
+    d = jnp.mean(xs, axis=axes)          # sibling reduces: one pass
+    d2 = jnp.mean(jnp.square(xs), axis=axes)
+    m = shift + d
+    v = jnp.maximum(d2 - d * d, 0.0)
+    rstd = jax.lax.rsqrt(v + eps)
+    s32 = scale.astype(jnp.float32)
+    k = (s32 * rstd).astype(x.dtype)
+    c = (bias.astype(jnp.float32) - m * s32 * rstd).astype(x.dtype)
+    y = x * k.reshape(bshape) + c.reshape(bshape)
+    return y, m, rstd
+
+
+def _bn_train_f(x, scale, bias, c_axis, eps):
+    y, m, rstd = _bn_train_fwd_impl(x, scale, bias, c_axis, eps)
+    return (y, m, rstd), (x, scale, m, rstd)
+
+
+def _bn_train_b(c_axis, eps, saved, cts):
+    dy = cts[0]  # running-stat EMA outputs are stop_gradient'd by callers
+    x, scale, m, rstd = saved
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = tuple(x.shape[c_axis] if i == c_axis else 1
+                   for i in range(x.ndim))
+    n = x.size // x.shape[c_axis]
+    s_dy = jnp.sum(dy, axis=axes, dtype=jnp.float32)
+    s_dyx = jnp.sum((dy * x).astype(jnp.float32), axis=axes)
+    dgamma = (s_dyx - m * s_dy) * rstd
+    dbeta = s_dy
+    s32 = scale.astype(jnp.float32)
+    k = (s32 * rstd).astype(x.dtype)
+    g1 = (s_dy / n).astype(x.dtype)
+    g2 = (dgamma * rstd / n).astype(x.dtype)
+    mb = m.astype(x.dtype)
+    dx = (k.reshape(bshape) * (dy - g1.reshape(bshape))
+          - (k * g2).reshape(bshape) * (x - mb.reshape(bshape)))
+    return dx, dgamma.astype(scale.dtype), dbeta.astype(scale.dtype)
+
+
+_bn_train_fused.defvjp(_bn_train_f, _bn_train_b)
+
+
 @register_op(
     "batch_norm",
     inputs=["X", "Scale", "Bias", "Mean", "Variance"],
@@ -149,40 +264,45 @@ def _pool2d(ctx, ins, attrs):
 )
 def _batch_norm(ctx, ins, attrs):
     """cf. batch_norm_op.cc.  Training: batch stats + EMA update of running
-    stats (MeanOut/VarianceOut alias the Mean/Variance persistables)."""
+    stats (MeanOut/VarianceOut alias the Mean/Variance persistables).
+    The training path runs the fused one-pass implementation above."""
     x = ins["X"][0]
     scale, bias = ins["Scale"][0], ins["Bias"][0]
     mean, var = ins["Mean"][0], ins["Variance"][0]
     momentum = attrs.get("momentum", 0.9)
-    eps = attrs.get("epsilon", 1e-5)
+    eps = float(attrs.get("epsilon", 1e-5))
     is_test = attrs.get("is_test", False) or ctx.is_test
-    layout = attrs.get("data_layout", "NCHW")
+    layout = attrs.get("data_layout", attrs.get("data_format", "NCHW"))
     c_axis = 1 if layout == "NCHW" else x.ndim - 1
-    reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
     bshape = tuple(x.shape[c_axis] if i == c_axis else 1 for i in range(x.ndim))
 
     if is_test:
-        use_mean, use_var = mean, var
-        mean_out, var_out = mean, var
-        saved_mean = mean
-        saved_var = var
-    else:
-        cf = x.astype(jnp.float32)
-        use_mean = jnp.mean(cf, axis=reduce_axes)
-        use_var = jnp.var(cf, axis=reduce_axes)
-        mean_out = mean * momentum + use_mean * (1 - momentum)
-        var_out = var * momentum + use_var * (1 - momentum)
-        saved_mean = use_mean
-        saved_var = use_var
-    inv_std = jax.lax.rsqrt(use_var.astype(jnp.float32) + eps)
-    xh = (x.astype(jnp.float32) - use_mean.reshape(bshape)) * inv_std.reshape(bshape)
-    y = xh * scale.reshape(bshape) + bias.reshape(bshape)
+        inv_std = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+        xh = (x.astype(jnp.float32) - mean.reshape(bshape)) \
+            * inv_std.reshape(bshape)
+        y = xh * scale.reshape(bshape) + bias.reshape(bshape)
+        return {
+            "Y": [y.astype(x.dtype)],
+            "MeanOut": [mean],
+            "VarianceOut": [var],
+            "SavedMean": [mean.astype(jnp.float32)],
+            "SavedVariance": [inv_std.astype(jnp.float32)],
+        }
+
+    y, use_mean, inv_std = _bn_train_fused(x, scale, bias, c_axis, eps)
+    sm = jax.lax.stop_gradient(use_mean)
+    sv = jax.lax.stop_gradient(
+        jnp.maximum(1.0 / jnp.square(inv_std) - eps, 0.0))
+    mean_out = mean * momentum + sm * (1 - momentum)
+    var_out = var * momentum + sv * (1 - momentum)
     return {
-        "Y": [y.astype(x.dtype)],
+        "Y": [y],
         "MeanOut": [mean_out.astype(mean.dtype)],
         "VarianceOut": [var_out.astype(var.dtype)],
-        "SavedMean": [saved_mean.astype(jnp.float32)],
-        "SavedVariance": [inv_std.astype(jnp.float32)],
+        # Saved* are non-differentiable auxiliaries (the fused VJP only
+        # propagates Y's cotangent, matching batch_norm_grad_op)
+        "SavedMean": [sm.astype(jnp.float32)],
+        "SavedVariance": [jax.lax.stop_gradient(inv_std).astype(jnp.float32)],
     }
 
 

@@ -403,3 +403,122 @@ class TestEmbeddingPadding:
         )
         assert np.all(out[0] == 0.0)  # row 9 == vocab-1 is the padding row
         assert np.any(out[1] != 0.0)
+
+
+class TestDataFormatNHWC:
+    """NHWC paths added for the TPU-fast ResNet trunk (conv2d/pool2d/
+    batch_norm data_format attr) must agree with the NCHW reference."""
+
+    def test_conv2d_nhwc_matches_nchw(self):
+        from op_test import run_single_op
+
+        x = _rand(2, 3, 6, 6)
+        w = _rand(4, 3, 3, 3, seed=1)
+        ref, _ = run_single_op(
+            "conv2d", {"Input": x, "Filter": w},
+            {"strides": [2, 2], "paddings": [1, 1]}, ["Output"])
+        got, _ = run_single_op(
+            "conv2d", {"Input": x.transpose(0, 2, 3, 1), "Filter": w},
+            {"strides": [2, 2], "paddings": [1, 1], "data_format": "NHWC"},
+            ["Output"])
+        np.testing.assert_allclose(
+            got["Output"].transpose(0, 3, 1, 2), ref["Output"],
+            rtol=1e-4, atol=1e-5)
+
+    def test_pool2d_nhwc_matches_nchw(self):
+        from op_test import run_single_op
+
+        x = _rand(2, 3, 6, 6)
+        for ptype in ("max", "avg"):
+            ref, _ = run_single_op(
+                "pool2d", {"X": x},
+                {"pooling_type": ptype, "ksize": [3, 3], "strides": [2, 2],
+                 "paddings": [1, 1]}, ["Out"])
+            got, _ = run_single_op(
+                "pool2d", {"X": x.transpose(0, 2, 3, 1)},
+                {"pooling_type": ptype, "ksize": [3, 3], "strides": [2, 2],
+                 "paddings": [1, 1], "data_format": "NHWC"}, ["Out"])
+            np.testing.assert_allclose(
+                got["Out"].transpose(0, 3, 1, 2), ref["Out"],
+                rtol=1e-5, atol=1e-5)
+
+    def test_batch_norm_nhwc_train_and_grad(self):
+        from op_test import run_single_op
+
+        x = _rand(4, 3, 2, 5)  # NHWC: C=5
+        scale = _rand(5, seed=1)
+        bias = _rand(5, seed=2)
+        mean = np.zeros(5, np.float32)
+        var = np.ones(5, np.float32)
+        mu = x.mean(axis=(0, 1, 2))
+        v = x.var(axis=(0, 1, 2))
+        ref = ((x - mu) / np.sqrt(v + 1e-5)) * scale + bias
+        outs, _ = run_single_op(
+            "batch_norm",
+            {"X": x, "Scale": scale, "Bias": bias, "Mean": mean,
+             "Variance": var},
+            {"momentum": 0.9, "epsilon": 1e-5, "data_layout": "NHWC"},
+            ["Y"])
+        np.testing.assert_allclose(outs["Y"], ref, rtol=1e-4, atol=1e-4)
+        # EMA outputs
+        outs2, _ = run_single_op(
+            "batch_norm",
+            {"X": x, "Scale": scale, "Bias": bias, "Mean": mean,
+             "Variance": var},
+            {"momentum": 0.9, "epsilon": 1e-5, "data_layout": "NHWC"},
+            ["MeanOut", "VarianceOut"])
+        np.testing.assert_allclose(outs2["MeanOut"], 0.1 * mu, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(outs2["VarianceOut"], 0.9 + 0.1 * v,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_batch_norm_fused_grad_matches_numeric(self):
+        x = _rand(3, 4, 2, 2)  # NCHW path goes through the same custom vjp
+        scale = np.ones(4, np.float32) + 0.1 * _rand(4, seed=3)
+        bias = _rand(4, seed=4)
+        mean = np.zeros(4, np.float32)
+        var = np.ones(4, np.float32)
+        check_grad(
+            "batch_norm",
+            {"X": x, "Scale": scale, "Bias": bias, "Mean": mean,
+             "Variance": var},
+            {"momentum": 0.9, "epsilon": 1e-5},
+            ["Y"], ["X", "Scale", "Bias"], rtol=2e-2, atol=2e-3,
+        )
+
+
+class TestGroupedConvTransposeAndAdaptivePool:
+    def test_grouped_conv2d_transpose(self):
+        import torch
+        import torch.nn.functional as F
+
+        x = _rand(2, 4, 4, 4)
+        w = _rand(4, 3, 3, 3, seed=1)  # [Cin, Cout/g, kh, kw], g=2
+        ref = F.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(w), stride=2, padding=1,
+            groups=2).numpy()
+        check_output(
+            "conv2d_transpose", {"Input": x, "Filter": w},
+            {"strides": [2, 2], "paddings": [1, 1], "groups": 2},
+            {"Output": ref}, rtol=1e-4, atol=1e-4,
+        )
+
+    def test_adaptive_pool_non_divisible(self):
+        from op_test import run_single_op
+
+        x = _rand(1, 2, 7, 5)
+        for ptype in ("max", "avg"):
+            outs, _ = run_single_op(
+                "pool2d", {"X": x},
+                {"pooling_type": ptype, "ksize": [3, 2], "adaptive": True},
+                ["Out"])
+            got = outs["Out"]
+            assert got.shape == (1, 2, 3, 2)
+            red = np.max if ptype == "max" else np.mean
+            for i in range(3):
+                r0, r1 = i * 7 // 3, -(-(i + 1) * 7 // 3)
+                for j in range(2):
+                    c0, c1 = j * 5 // 2, -(-(j + 1) * 5 // 2)
+                    ref = red(x[:, :, r0:r1, c0:c1], axis=(2, 3))
+                    np.testing.assert_allclose(got[:, :, i, j], ref,
+                                               rtol=1e-5, atol=1e-5)
