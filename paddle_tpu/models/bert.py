@@ -120,9 +120,10 @@ class MultiHeadAttention(dygraph.Layer):
         )
 
     def _split(self, x, seq_len):
-        # [B, S, D] -> [B, H, S, Dh]
-        x = layers.reshape(x, [0, seq_len, self.n_head, self.d_head])
-        return layers.transpose(x, [0, 2, 1, 3])
+        # [B, S, D] -> [B, S, H, Dh]: the flash op consumes BSHD natively
+        # so no [B,H,S,D] head transpose is ever materialized (8 relayout
+        # passes per layer saved vs the head-major layout)
+        return layers.reshape(x, [0, seq_len, self.n_head, self.d_head])
 
     def forward(self, query, key=None, value=None, attn_bias=None,
                 causal=False, segment_ids=None):
@@ -165,9 +166,9 @@ class MultiHeadAttention(dygraph.Layer):
         ctxv = append_simple_op(
             "flash_attention",
             ins,
-            {"scale": self.d_head ** -0.5, "causal": causal},
+            {"scale": self.d_head ** -0.5, "causal": causal,
+             "layout": "BSHD"},
         )
-        ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
         ctxv = layers.reshape(ctxv, [0, q_len, self.n_head * self.d_head])
         return self.dropout(self.out_proj(ctxv))
 

@@ -54,7 +54,19 @@ def _naive_attention(q, k, v, bias, scale, causal):
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
 
-def _use_pallas(q, k, bias):
+def naive_attention_with_layout(q, k, v, bias, scale, causal,
+                                layout="BHSD"):
+    """Single place that adapts the BHSD-native naive composition to a
+    BSHD caller (used by the dispatch below and the pallas fallbacks)."""
+    if layout == "BSHD":
+        out = _naive_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), bias, scale, causal)
+        return out.transpose(0, 2, 1, 3)
+    return _naive_attention(q, k, v, bias, scale, causal)
+
+
+def _use_pallas(q, k, bias, layout="BHSD"):
     if jax.default_backend() != "tpu":
         return False
     # the head dim is never split (its block equals the full dim), so any
@@ -63,25 +75,29 @@ def _use_pallas(q, k, bias):
     # biases.  Non-128-divisible sequence lengths are fine — the kernel
     # pads to the block and slices (flash_attention pad path); below ~192
     # the naive composition wins.
-    sq, dim = q.shape[-2], q.shape[-1]
-    sk = k.shape[-2]
+    s_ax = -2 if layout == "BHSD" else -3
+    sq, dim = q.shape[s_ax], q.shape[-1]
+    sk = k.shape[s_ax]
     if bias is not None and bias.shape[-2] != 1:
         return False
     return dim % 64 == 0 and sq >= 192 and sk >= 192
 
 
 def scaled_dot_product_attention(q, k, v, bias=None, segment_ids=None,
-                                 scale=None, causal=False):
-    """q/k/v: [batch, heads, seq, head_dim].  segment_ids: None, [B, S], or
-    (q_seg, kv_seg) — attention stays within equal segment ids (packing)."""
+                                 scale=None, causal=False, layout="BHSD"):
+    """q/k/v: [batch, heads, seq, head_dim] (layout="BHSD") or
+    [batch, seq, heads, head_dim] ("BSHD" — the TPU-fast layout: the
+    pallas kernel reads it natively so no head transpose is ever
+    materialized).  segment_ids: None, [B, S], or (q_seg, kv_seg) —
+    attention stays within equal segment ids (packing)."""
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
-    if _use_pallas(q, k, bias):
+    if _use_pallas(q, k, bias, layout):
         from .pallas.attention import flash_attention
 
         return flash_attention(q, k, v, bias=bias, segment_ids=segment_ids,
-                               scale=scale, causal=causal)
+                               scale=scale, causal=causal, layout=layout)
     if segment_ids is not None:
         sb = _segment_bias(segment_ids)
         bias = sb if bias is None else bias + sb
-    return _naive_attention(q, k, v, bias, scale, causal)
+    return naive_attention_with_layout(q, k, v, bias, scale, causal, layout)

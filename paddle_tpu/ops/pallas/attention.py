@@ -9,7 +9,12 @@ a row-parallel kernel, dK/dV (and the padding-bias gradient) in a
 column-parallel kernel, each recomputing P blockwise from (Q, K, LSE) —
 the standard flash backward, O(S) memory end to end.
 
-Layout: [BH, S, D] (batch*heads flattened).  Supported in-kernel:
+Layouts: "BHSD" ([B, H, S, D] head-major, flattened to [BH, S, D] for the
+kernel) or "BSHD" ([B, S, H, D] — the natural output of a [B,S,HD] qkv
+projection reshape; the kernel blocks the native 4D array with the head
+on a unit grid axis, so the model never materializes the [B,H,S,D]
+transpose that otherwise costs 8 relayout passes per transformer layer).
+Supported in-kernel:
   - causal masking,
   - a broadcastable additive bias of shape [BH, 1, Sk] (padding masks),
   - packed-batch segment ids ([BH, Sq], [BH, Sk]): token i attends token j
@@ -49,6 +54,24 @@ def _pick_block(s):
 
 
 def _block_sizes(sq, sk):
+    import os
+
+    ov = os.getenv("PADDLE_TPU_FLASH_BLOCKS")  # "bq,bk" tuning override
+    if ov:
+        import warnings
+
+        try:
+            bq, bk = (int(t) for t in ov.split(","))
+        except ValueError:
+            raise ValueError(
+                "PADDLE_TPU_FLASH_BLOCKS must be 'bq,bk' (two ints), got "
+                "%r" % ov) from None
+        if sq % bq == 0 and sk % bk == 0:
+            return bq, bk
+        warnings.warn(
+            "PADDLE_TPU_FLASH_BLOCKS=%s does not divide (Sq=%d, Sk=%d); "
+            "falling back to the default block sizes" % (ov, sq, sk),
+            stacklevel=3)
     return _pick_block(sq), _pick_block(sk)
 
 
@@ -90,6 +113,34 @@ def _split_refs(refs, has_bias, has_seg):
     return q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, refs[idx:]
 
 
+def _ld(ref):
+    """Load a q/k/v/o/do block as [rows, d] for either layout's block
+    shape: (1, rows, d) in BHSD-flat, (1, rows, 1, d) in BSHD."""
+    return ref[0] if len(ref.shape) == 3 else ref[0, :, 0, :]
+
+
+def _st(ref, val):
+    if len(ref.shape) == 3:
+        ref[0, :, :] = val
+    else:
+        ref[0, :, 0, :] = val
+
+
+def _row_spec(rows, d, layout, h, pos):
+    """BlockSpec for a row-blocked [.., S, D] tensor in either layout.
+    pos: which positional grid arg (1 or 2) carries this tensor's row
+    block index — the fwd/dq grids are (g, i, j), the dkv grid (g, j, i)."""
+    if layout == "BHSD":
+        if pos == 1:
+            return pl.BlockSpec((1, rows, d), lambda g, a, b: (g, a, 0))
+        return pl.BlockSpec((1, rows, d), lambda g, a, b: (g, b, 0))
+    if pos == 1:
+        return pl.BlockSpec(
+            (1, rows, 1, d), lambda g, a, b: (g // h, a, g % h, 0))
+    return pl.BlockSpec(
+        (1, rows, 1, d), lambda g, a, b: (g // h, b, g % h, 0))
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -112,9 +163,9 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
     i = pl.program_id(1)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)  # [bq, d]
-        k = k_ref[0].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0].astype(jnp.float32)  # [bk, d]
+        q = _ld(q_ref).astype(jnp.float32)  # [bq, d]
+        k = _ld(k_ref).astype(jnp.float32)  # [bk, d]
+        v = _ld(v_ref).astype(jnp.float32)  # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -148,29 +199,37 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
         # accumulating p = exp(0) = 1 garbage; emit zeros, keep lse at
         # NEG_INF so the backward zeroes it too
         dead = m_ref[:, 0] <= NEG_INF / 2
-        o_ref[0, :, :] = jnp.where(dead[:, None], 0.0, o).astype(o_ref.dtype)
+        _st(o_ref, jnp.where(dead[:, None], 0.0, o).astype(o_ref.dtype))
         lse = jnp.where(dead, NEG_INF, m_ref[:, 0] + jnp.log(safe_l))
         lse_ref[0, :, :] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
 def _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret,
-         coff=0):
-    """Returns (out [bh,sq,d], lse [bh,sq,128] row-broadcast).
+         coff=0, layout="BHSD"):
+    """Returns (out, lse [bh,sq,128] row-broadcast); out is [bh,sq,d]
+    (BHSD) or [b,sq,h,d] (BSHD).
 
     qseg: [B, sq, 128] lane-broadcast ids; kseg: [B, 8, sk] sublane-
     broadcast (B = bh // n_head; the index map divides by n_head so the
     ids are not replicated per head in HBM)."""
-    bh, sq, d = q.shape
-    sk = k.shape[1]
+    if layout == "BHSD":
+        bh, sq, d = q.shape
+        sk = k.shape[1]
+        out_sds = jax.ShapeDtypeStruct((bh, sq, d), q.dtype)
+    else:
+        b, sq, h_, d = q.shape
+        sk = k.shape[1]
+        bh = b * h_
+        out_sds = jax.ShapeDtypeStruct((b, sq, h_, d), q.dtype)
     bq, bk = _block_sizes(sq, sk)
     nq, nk = sq // bq, sk // bk
     has_bias, has_seg = bias is not None, qseg is not None
     h = n_head
 
     in_specs = [
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        _row_spec(bq, d, layout, h, 1),
+        _row_spec(bk, d, layout, h, 2),
+        _row_spec(bk, d, layout, h, 2),
     ]
     args = [q, k, v]
     if has_bias:
@@ -194,11 +253,11 @@ def _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret,
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            _row_spec(bq, d, layout, h, 1),
             pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            out_sds,
             jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
         ],
         scratch_shapes=[
@@ -230,11 +289,11 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        o = o_ref[0].astype(jnp.float32)
+        q = _ld(q_ref).astype(jnp.float32)
+        k = _ld(k_ref).astype(jnp.float32)
+        v = _ld(v_ref).astype(jnp.float32)
+        do = _ld(do_ref).astype(jnp.float32)
+        o = _ld(o_ref).astype(jnp.float32)
         lse = lse_ref[0, :, 0]  # [bq] logsumexp rows
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -263,7 +322,7 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
 
     @pl.when(j == nk - 1)
     def _finalize():
-        dq_ref[0, :, :] = acc_ref[...].astype(dq_ref.dtype)
+        _st(dq_ref, acc_ref[...].astype(dq_ref.dtype))
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg,
@@ -289,11 +348,11 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg,
             db_acc[...] = jnp.zeros_like(db_acc)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        o = o_ref[0].astype(jnp.float32)
+        q = _ld(q_ref).astype(jnp.float32)
+        k = _ld(k_ref).astype(jnp.float32)
+        v = _ld(v_ref).astype(jnp.float32)
+        do = _ld(do_ref).astype(jnp.float32)
+        o = _ld(o_ref).astype(jnp.float32)
         lse = lse_ref[0, :, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -327,8 +386,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg,
 
     @pl.when(i == nq - 1)
     def _finalize():
-        dk_ref[0, :, :] = dk_acc[...].astype(dk_ref.dtype)
-        dv_ref[0, :, :] = dv_acc[...].astype(dv_ref.dtype)
+        _st(dk_ref, dk_acc[...].astype(dk_ref.dtype))
+        _st(dv_ref, dv_acc[...].astype(dv_ref.dtype))
         if db_ref is not None:
             db_ref[0, 0, :] = db_acc[0, :].astype(db_ref.dtype)
 
@@ -339,8 +398,10 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg,
 
 
 def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
-                    causal=False, interpret=None):
-    """q/k/v: [B, H, S, D].  bias: None or broadcastable [B, 1/H, 1, Sk].
+                    causal=False, interpret=None, layout="BHSD"):
+    """q/k/v: [B, H, S, D] (layout="BHSD") or [B, S, H, D] ("BSHD" — no
+    head transpose anywhere).  bias: None or broadcastable
+    [B, 1/H, 1, Sk].
     segment_ids: None, a [B, S] int array (self-attention packing), or a
     (q_seg [B, Sq], kv_seg [B, Sk]) pair — attention is confined to equal
     segment ids.
@@ -351,12 +412,34 @@ def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
     never split (its block always equals the full dim) so any 64-multiple
     works — non-64-multiples run the naive composition (never silently
     truncates either way)."""
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
     if scale is None:
-        scale = d ** -0.5
+        scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if layout == "BSHD" and not interpret:
+        # Mosaic requires the last-two block dims to divide (8, 128) or
+        # equal the array dims — a (1, bq, 1, d) head-sliced block is
+        # illegal, so on real TPU the BSHD API transposes to head-major
+        # around the kernel (XLA fuses these with neighbours; measured
+        # cheaper than strided sublane reads inside the kernel)
+        out = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), bias=bias, segment_ids=segment_ids,
+            scale=scale, causal=causal, interpret=interpret, layout="BHSD")
+        return out.transpose(0, 2, 1, 3)
+    if layout == "BHSD":
+        b, h, sq, d = q.shape
+        sk = k.shape[2]
+        s_ax = 2
+    else:
+        b, sq, h, d = q.shape
+        sk = k.shape[1]
+        s_ax = 1
+
+    def _pad_s(x, p):
+        pads = [(0, 0)] * x.ndim
+        pads[s_ax] = (0, p)
+        return jnp.pad(x, pads)
 
     # pad seq lengths up to the 128 block so _pick_block always succeeds
     sq_orig, sk_orig = sq, sk
@@ -365,9 +448,9 @@ def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
         from ..attention import NEG_INF as _NI
         from ..attention import normalize_segment_ids as _norm
 
-        q = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0)))
-        k = jnp.pad(k, ((0, 0), (0, 0), (0, pk), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, 0), (0, pk), (0, 0)))
+        q = _pad_s(q, pq)
+        k = _pad_s(k, pk)
+        v = _pad_s(v, pk)
         if pk:
             # mask padded keys for every query (additive bias row)
             key_pad = jnp.concatenate(
@@ -413,11 +496,17 @@ def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
         if segment_ids is not None:
             sb = _segment_bias(segment_ids)
             bias = sb if bias is None else bias + sb
-        return _naive_attention(q, k, v, bias, scale, causal)
+        from ..attention import naive_attention_with_layout
 
-    qf = q.reshape(b * h, sq, d)
-    kf = k.reshape(b * h, sk, d)
-    vf = v.reshape(b * h, sk, d)
+        return naive_attention_with_layout(q, k, v, bias, scale, causal,
+                                           layout)
+
+    if layout == "BHSD":
+        qf = q.reshape(b * h, sq, d)
+        kf = k.reshape(b * h, sk, d)
+        vf = v.reshape(b * h, sk, d)
+    else:
+        qf, kf, vf = q, k, v
     bf = None
     if bias is not None:
         bf = jnp.broadcast_to(bias, (b, h, 1, sk)).reshape(b * h, 1, sk)
@@ -436,39 +525,46 @@ def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
 
     coff = sk_orig - sq_orig  # bottom-right causal alignment (original S)
     out = _flash_core(qf, kf, vf, bf, qsegf, ksegf, h, scale, causal,
-                      interpret, coff)
-    out = out.reshape(b, h, sq, d)
-    return out[:, :, :sq_orig] if sq != sq_orig else out
+                      interpret, coff, layout)
+    if layout == "BHSD":
+        out = out.reshape(b, h, sq, d)
+        return out[:, :, :sq_orig] if sq != sq_orig else out
+    return out[:, :sq_orig] if sq != sq_orig else out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
 def _flash_core(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret,
-                coff):
+                coff, layout="BHSD"):
     out, _ = _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal,
-                  interpret, coff)
+                  interpret, coff, layout)
     return out
 
 
 def _flash_core_fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal,
-                    interpret, coff):
+                    interpret, coff, layout="BHSD"):
     out, lse = _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal,
-                    interpret, coff)
+                    interpret, coff, layout)
     return out, (q, k, v, bias, qseg, kseg, out, lse)
 
 
-def _flash_core_bwd(n_head, scale, causal, interpret, coff, res, g):
+def _flash_core_bwd(n_head, scale, causal, interpret, coff, layout, res, g):
     q, k, v, bias, qseg, kseg, out, lse2d = res
     h = n_head
-    bh, sq, d = q.shape
-    sk = k.shape[1]
+    if layout == "BHSD":
+        bh, sq, d = q.shape
+        sk = k.shape[1]
+    else:
+        b_, sq, h_, d = q.shape
+        sk = k.shape[1]
+        bh = b_ * h_
     bq, bk = _block_sizes(sq, sk)
     nq, nk = sq // bq, sk // bk
     has_bias, has_seg = bias is not None, qseg is not None
 
     dq_specs = [
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # q
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # k
-        pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),  # v
+        _row_spec(bq, d, layout, h, 1),  # q
+        _row_spec(bk, d, layout, h, 2),  # k
+        _row_spec(bk, d, layout, h, 2),  # v
     ]
     args = [q, k, v]
     if has_bias:
@@ -483,8 +579,8 @@ def _flash_core_bwd(n_head, scale, causal, interpret, coff, res, g):
         )
         args.extend([qseg, kseg])
     dq_specs += [
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # o
-        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),  # do
+        _row_spec(bq, d, layout, h, 1),  # o
+        _row_spec(bq, d, layout, h, 1),  # do
         pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),  # lse rows
     ]
     args += [out, g, lse2d]
@@ -495,17 +591,17 @@ def _flash_core_bwd(n_head, scale, causal, interpret, coff, res, g):
         ),
         grid=(bh, nq, nk),
         in_specs=dq_specs,
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_specs=_row_spec(bq, d, layout, h, 1),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(*args)
 
     # column-parallel pass: lse/o/do blocks follow the INNER grid dim (i)
     kv_specs = [
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # q
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # k
-        pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),  # v
+        _row_spec(bq, d, layout, h, 2),  # q
+        _row_spec(bk, d, layout, h, 1),  # k
+        _row_spec(bk, d, layout, h, 1),  # v
     ]
     if has_bias:
         kv_specs.append(pl.BlockSpec((1, 1, bk), lambda b, j, i: (b, 0, j)))
@@ -517,16 +613,17 @@ def _flash_core_bwd(n_head, scale, causal, interpret, coff, res, g):
             pl.BlockSpec((1, 8, bk), lambda b, j, i: (b // h, 0, j))
         )
     kv_specs += [
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # o
-        pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),  # do
+        _row_spec(bq, d, layout, h, 2),  # o
+        _row_spec(bq, d, layout, h, 2),  # do
         pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),  # lse
     ]
-    dk_spec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
-    dv_spec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
-    out_specs = [dk_spec, dv_spec]
+    out_specs = [
+        _row_spec(bk, d, layout, h, 1),  # dk
+        _row_spec(bk, d, layout, h, 1),  # dv
+    ]
     out_shape = [
-        jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-        jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        jax.ShapeDtypeStruct(k.shape, k.dtype),
+        jax.ShapeDtypeStruct(v.shape, v.dtype),
     ]
     scratch = [
         pltpu.VMEM((bk, d), jnp.float32),

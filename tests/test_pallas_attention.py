@@ -196,3 +196,47 @@ def test_flash_head_dim_64():
                                                False) ** 2).sum())(q)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_bshd_layout_matches_bhsd(causal):
+    """BSHD (no-transpose) layout must agree with the BHSD path, forward
+    and gradients, with segments + bias."""
+    B, H, S, D = 2, 3, 256, 64
+    q, k, v = (_rand((B, H, S, D), i) for i in range(3))
+    bias = jnp.where(
+        jnp.arange(S)[None, None, None, :] < S - 17, 0.0, -1e30
+    ).astype(jnp.float32) * jnp.ones((B, 1, 1, S))
+    seg = jnp.asarray(
+        np.random.RandomState(7).randint(0, 3, (B, S)).cumsum(axis=1) // 7
+    )
+
+    def f_bhsd(q, k, v):
+        return flash_attention(q, k, v, bias=bias, segment_ids=seg,
+                               causal=causal, interpret=True).sum()
+
+    def f_bshd(q, k, v):
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        return flash_attention(qt, kt, vt, bias=bias, segment_ids=seg,
+                               causal=causal, interpret=True,
+                               layout="BSHD").sum()
+
+    o1, g1 = jax.value_and_grad(f_bhsd, argnums=(0, 1, 2))(q, k, v)
+    o2, g2 = jax.value_and_grad(f_bshd, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(float(o1), float(o2), rtol=1e-4)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_bshd_pad_path():
+    B, H, S, D = 1, 2, 200, 64  # pads to 256
+    q, k, v = (_rand((B, S, H, D), i) for i in range(3))
+    out = flash_attention(q, k, v, interpret=True, layout="BSHD")
+    ref = _naive_attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), None, D ** -0.5, False
+    ).transpose(0, 2, 1, 3)
+    assert out.shape == (B, S, H, D)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
