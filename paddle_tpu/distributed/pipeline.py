@@ -119,41 +119,39 @@ def gpipe(stage_fn, n_stages, n_micro, axis_name="pp",
 
 
 class PipelineOptimizer:
-    """Static-graph API parity (cf. reference optimizer.py:3632).
+    """Static-graph pipeline parallelism (cf. reference optimizer.py:3632).
 
-    The reference splits by device_guard annotations and runs section
-    threads; under XLA a single-host "pipeline" with no pp mesh axis
-    degenerates to microbatch accumulation — which is exactly
-    GradientMergeOptimizer.  For real stage parallelism use
-    distributed.pipeline.gpipe inside a ShardedTrainStep-style jit (mesh
-    pp axis), which subsumes SectionWorker entirely.
+    Usage matches the reference: annotate the forward with
+    ``fluid.device_guard("gpu:<stage>")`` sections, wrap the inner
+    optimizer, minimize, then run the program on an Executor whose mesh
+    has a ``pp`` axis — the mesh-mode Executor partitions the loss
+    ancestors into stages and runs them in a GPipe microbatch schedule
+    with `ppermute` boundary handoff (`fluid/pipeline_static.py`; the
+    reference's SectionWorker threads + scope queues,
+    `section_worker.cc:142`, become one SPMD scan).  Feed the FULL batch
+    per run(): each run executes num_microbatches microbatches and does
+    ONE optimizer update, exactly the reference PipelineTrainer contract.
+
+    Without a pp mesh the program still runs correctly as a plain
+    single-device step (same update given the same full batch) — only
+    the stage parallelism is absent.
     """
 
     def __init__(self, optimizer, num_microbatches=1):
-        import warnings
-
-        from ..fluid.optimizer import GradientMergeOptimizer
-
-        warnings.warn(
-            "PipelineOptimizer on the static-graph path runs MICROBATCH "
-            "ACCUMULATION (GradientMerge), not stage parallelism: the "
-            "program executes whole on each device and device_guard "
-            "annotations are ignored. For real pipeline parallelism use "
-            "distributed.pipeline.gpipe (optionally with first_fn/last_fn "
-            "heterogeneous stages) under a mesh with a 'pp' axis, e.g. via "
-            "ShardedTrainStep.",
-            stacklevel=2,
-        )
-        self._inner = GradientMergeOptimizer(
-            optimizer, k_steps=num_microbatches, avg=True
-        )
-        self._num_microbatches = num_microbatches
+        self._inner = optimizer
+        self._num_microbatches = int(num_microbatches)
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        return self._inner.minimize(
+        res = self._inner.minimize(
             loss, startup_program, parameter_list, no_grad_set
         )
+        prog = loss.block.program
+        prog._pipeline = {
+            "n_micro": self._num_microbatches,
+            "loss": loss.name,
+        }
+        return res

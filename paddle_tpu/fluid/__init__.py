@@ -36,6 +36,7 @@ from .framework import (  # noqa: F401
     Variable,
     default_main_program,
     default_startup_program,
+    device_guard,
     in_dygraph_mode,
     program_guard,
 )

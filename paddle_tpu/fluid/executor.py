@@ -136,6 +136,29 @@ class _LoweredBlock:
         self.state_ro = [n for n in state_in if n not in set(state_out)]
 
         is_test = program._is_test
+
+        # static pipeline parallelism: a PipelineOptimizer-marked program
+        # on a mesh with a pp axis runs device_guard stages in a GPipe
+        # schedule (see fluid/pipeline_static.py)
+        pp_meta = getattr(program, "_pipeline", None)
+        if (pp_meta and mesh is not None and mesh.has_axis("pp")
+                and mesh.axis_size("pp") > 1):
+            from jax.sharding import PartitionSpec as _P
+
+            from .pipeline_static import build_pipeline_jit
+
+            self.gspmd = False
+            self.is_pipeline = True
+            # feeds replicate: every pp shard dynamically indexes its own
+            # microbatches out of the full local batch
+            self.feed_specs = {n: _P() for n in self.feed_names}
+            self._jitted = build_pipeline_jit(
+                program, block, ops, self.feed_names, feed_shapes,
+                self.fetch_names, state_in, state_out, self.state_donate,
+                self.state_ro, scope, mesh, pp_meta["n_micro"],
+                pp_meta["loss"], is_test)
+            return
+
         # GSPMD mode (program flagged by distributed.static_sharding):
         # ONE logical program jitted with per-var in/out shardings taken
         # from Variable.dist_attr — XLA partitions the computation and
@@ -228,10 +251,15 @@ class _LoweredBlock:
             # per-feed spec: shard dim 0 over dp when this process's LOCAL
             # feed divides over its addressable devices; otherwise
             # replicate (same fallback as the dp_devices path)
+            # a mesh without a "dp" axis (e.g. a pure-pp mesh reused for
+            # an unannotated program) replicates feeds and maps fetches
+            # over its first axis instead of crashing on the dp name
+            rank_axis = "dp" if mesh.has_axis("dp") else mesh.axis_names[0]
             self.feed_specs = {}
             for n in feed_names:
                 shp = feed_shapes.get(n, ())
-                if len(shp) >= 1 and shp[0] > 0 and shp[0] % local_dev == 0:
+                if (mesh.has_axis("dp") and len(shp) >= 1 and shp[0] > 0
+                        and shp[0] % local_dev == 0):
                     self.feed_specs[n] = P("dp")
                 else:
                     self.feed_specs[n] = P()
@@ -251,7 +279,7 @@ class _LoweredBlock:
 
                 if fold_rank:
                     rng_key = jax.random.fold_in(
-                        rng_key, jax.lax.axis_index("dp")
+                        rng_key, jax.lax.axis_index(rank_axis)
                     )
                 env = dict(feed_vals)
                 env.update(donate_state)
@@ -274,7 +302,7 @@ class _LoweredBlock:
                     P(),
                     P(),
                 ),
-                out_specs=([P("dp")] * len(fetch_names), P()),
+                out_specs=([P(rank_axis)] * len(fetch_names), P()),
                 check_vma=False,
             )
             self._jitted = jax.jit(sharded, donate_argnums=(1,))
@@ -456,7 +484,8 @@ class Executor:
         for n, val in new_state.items():
             scope.set(n, val)
 
-        if entry.mesh is not None and not entry.gspmd:
+        if (entry.mesh is not None and not entry.gspmd
+                and not getattr(entry, "is_pipeline", False)):
             # fetches carry a leading per-rank dim; a process can only read
             # its addressable shards, so return the LOCAL ranks' values
             # (shape [n_local_ranks, ...]) — reference multi-trainer

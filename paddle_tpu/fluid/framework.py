@@ -316,6 +316,8 @@ class Block:
     # -- ops ----------------------------------------------------------------
     def append_op(self, type, inputs=None, outputs=None, attrs=None, infer=True):
         op = Operator(self, type, inputs, outputs, attrs)
+        if _current_device is not None and "op_device" not in op.attrs:
+            op.attrs["op_device"] = _current_device
         self.ops.append(op)
         if infer:
             self._infer_op(op)
@@ -430,6 +432,10 @@ class Program:
         # over via copy.copy below; the flag must follow it)
         if getattr(self, "_gspmd", False):
             p._gspmd = True
+        # pipeline marker carries over too: an eval clone on a pp mesh
+        # still runs the staged forward (no grads/updates)
+        if getattr(self, "_pipeline", None):
+            p._pipeline = dict(self._pipeline)
         from .ops import OPTIMIZER_OP_TYPES
 
         for b in self.blocks:
@@ -557,6 +563,40 @@ def reset_default_programs():
     global _main_program, _startup_program
     _main_program = Program()
     _startup_program = Program()
+
+
+_current_device = None
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Tag ops appended inside with an `op_device` attr (cf. reference
+    framework.py:5420).  Accepted forms mirror the reference: "cpu",
+    "gpu:N" (and "tpu:N" as the native spelling) — the pipeline
+    partitioner reads the :N suffix as the STAGE index; the executor
+    itself places nothing (XLA owns placement), so the annotation is
+    purely a partitioning directive."""
+    global _current_device
+    if device is not None and device != "cpu":
+        dev, _, idx = device.partition(":")
+        if dev not in ("gpu", "tpu", "xpu") or not idx.isdigit():
+            raise ValueError(
+                "device_guard expects 'cpu' or '<gpu|tpu|xpu>:<index>', "
+                "got %r" % device)
+    old = _current_device
+    _current_device = device
+    try:
+        yield
+    finally:
+        _current_device = old
+
+
+def device_stage_index(op_device):
+    """Stage index from an op_device annotation, or None."""
+    if not op_device or op_device == "cpu":
+        return None
+    _, _, idx = op_device.partition(":")
+    return int(idx) if idx.isdigit() else None
 
 
 _dygraph_tracer = None

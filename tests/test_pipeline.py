@@ -175,20 +175,9 @@ def test_gpipe_training_loss_parity():
     assert lp[-1] < lp[0]
 
 
-def test_pipeline_optimizer_warns_accumulation_only():
-    """The degenerate static path must NOT be silent (honest API)."""
-    import pytest as _pytest
-
-    from paddle_tpu.distributed.pipeline import PipelineOptimizer
-    from paddle_tpu.fluid.optimizer import SGDOptimizer
-
-    with _pytest.warns(UserWarning, match="MICROBATCH ACCUMULATION"):
-        PipelineOptimizer(SGDOptimizer(0.1), num_microbatches=2)
-
-
 def test_pipeline_optimizer_api_parity():
-    """PipelineOptimizer(opt, num_microbatches) exists and microbatches
-    accumulate (degenerate single-host path = gradient merge)."""
+    """PipelineOptimizer(opt, num_microbatches) exists; without a pp mesh
+    the program runs as a plain full-batch step."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.distributed.pipeline import PipelineOptimizer
     from paddle_tpu.fluid import layers
@@ -233,3 +222,188 @@ def test_gpipe_remat_matches():
     g1 = make(True)(ws)
     np.testing.assert_allclose(np.asarray(g0), np.asarray(g1),
                                rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# device_guard -> real static-graph pipeline parallelism
+# (reference optimizer.py:3632 PipelineOptimizer + section_worker.cc:142)
+# ---------------------------------------------------------------------------
+
+
+def _build_staged_mlp(seed=17, D=8, H=16, n_extra_fwd=True):
+    """2-stage MLP: stage 0 = fc1+relu (gpu:0), stage 1 = fc2+loss (gpu:1)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, D], append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        with fluid.device_guard("gpu:0"):
+            h = layers.fc(x, size=H, act="relu",
+                          param_attr="pp_fc1.w", bias_attr="pp_fc1.b")
+        with fluid.device_guard("gpu:1"):
+            pred = layers.fc(h, size=1,
+                             param_attr="pp_fc2.w", bias_attr="pp_fc2.b")
+            loss = layers.reduce_mean(layers.square(pred - y))
+    return main, startup, loss
+
+
+def _run_staged(mesh, n_micro, steps=6, seed_data=3):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed.pipeline import PipelineOptimizer
+    from paddle_tpu.fluid.optimizer import MomentumOptimizer
+
+    main, startup, loss = _build_staged_mlp()
+    with fluid.program_guard(main, startup):
+        opt = PipelineOptimizer(
+            MomentumOptimizer(learning_rate=0.05, momentum=0.9),
+            num_microbatches=n_micro)
+        opt.minimize(loss, startup)
+    rng = np.random.RandomState(seed_data)
+    B = 16
+    xs = rng.randn(steps, B, 8).astype(np.float32)
+    w = rng.randn(8, 1).astype(np.float32)
+    ys = xs @ w + 0.01 * rng.randn(steps, B, 1).astype(np.float32)
+    scope = fluid.Scope()
+    exe = fluid.Executor(mesh=mesh)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for t in range(steps):
+            (lv,) = exe.run(main, feed={"x": xs[t], "y": ys[t]},
+                            fetch_list=[loss])
+            losses.append(float(np.mean(lv)))
+    params = {n: np.asarray(scope.find_var(n))
+              for n in ("pp_fc1.w", "pp_fc2.w", "pp_fc1.b", "pp_fc2.b")}
+    return losses, params
+
+
+def test_static_pipeline_loss_parity_vs_single_device():
+    """device_guard 2-stage program on a pp=2 mesh matches the plain
+    single-device run of the SAME program (reference test_dist_base
+    loss-parity pattern)."""
+    pipe_losses, pipe_params = _run_staged(
+        dist.DeviceMesh({"pp": 2}), n_micro=4)
+    base_losses, base_params = _run_staged(None, n_micro=4)
+    np.testing.assert_allclose(pipe_losses, base_losses, rtol=2e-4,
+                               atol=2e-5)
+    for n in base_params:
+        np.testing.assert_allclose(pipe_params[n], base_params[n],
+                                   rtol=2e-4, atol=2e-5)
+    assert pipe_losses[-1] < pipe_losses[0]
+
+
+def test_static_pipeline_skip_connection_threads_through():
+    """A var produced at stage 0 and consumed at stage 2 rides the
+    boundary union across the middle stage."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed.pipeline import PipelineOptimizer
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.optimizer import SGDOptimizer
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 8], append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        with fluid.device_guard("gpu:0"):
+            h0 = layers.fc(x, size=8, act="relu",
+                           param_attr="sk_fc0.w", bias_attr="sk_fc0.b")
+        with fluid.device_guard("gpu:1"):
+            h1 = layers.fc(h0, size=8, act="relu",
+                           param_attr="sk_fc1.w", bias_attr="sk_fc1.b")
+        with fluid.device_guard("gpu:2"):
+            h2 = h1 + h0  # skip connection from stage 0
+            pred = layers.fc(h2, size=1,
+                             param_attr="sk_fc2.w", bias_attr="sk_fc2.b")
+            loss = layers.reduce_mean(layers.square(pred - y))
+        opt = PipelineOptimizer(SGDOptimizer(0.05), num_microbatches=4)
+        opt.minimize(loss, startup)
+
+    def run(mesh):
+        rng = np.random.RandomState(9)
+        xs = rng.randn(4, 8, 8).astype(np.float32)
+        ys = rng.randn(4, 8, 1).astype(np.float32)
+        scope = fluid.Scope()
+        exe = fluid.Executor(mesh=mesh)
+        out = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for t in range(4):
+                (lv,) = exe.run(main, feed={"x": xs[t], "y": ys[t]},
+                                fetch_list=[loss])
+                out.append(float(np.mean(lv)))
+        return out
+
+    pipe = run(dist.DeviceMesh({"pp": 4}))
+    base = run(None)
+    np.testing.assert_allclose(pipe, base, rtol=2e-4, atol=2e-5)
+
+
+def test_static_pipeline_rejects_stateful_forward():
+    import pytest as _pytest
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed.pipeline import PipelineOptimizer
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.optimizer import SGDOptimizer
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 6], append_batch_size=False)
+        with fluid.device_guard("gpu:0"):
+            h = layers.batch_norm(layers.fc(x, size=6))
+        with fluid.device_guard("gpu:1"):
+            loss = layers.reduce_mean(layers.square(h))
+        PipelineOptimizer(SGDOptimizer(0.1), 2).minimize(loss, startup)
+    scope = fluid.Scope()
+    exe = fluid.Executor(mesh=dist.DeviceMesh({"pp": 2}))
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with _pytest.raises(Exception, match="persistable|stateful"):
+            exe.run(main, feed={"x": np.zeros((8, 6), np.float32)},
+                    fetch_list=[loss])
+
+
+def test_static_pipeline_eval_clone_and_aux_metric_error():
+    """clone(for_test=True) keeps the pipeline marker and runs the staged
+    forward on the pp mesh; a metric on a stage activation raises the
+    targeted limitation error."""
+    import pytest as _pytest
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed.pipeline import PipelineOptimizer
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.optimizer import SGDOptimizer
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 8], append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        with fluid.device_guard("gpu:0"):
+            h = layers.fc(x, size=8, act="relu", param_attr="ev_fc1.w")
+        with fluid.device_guard("gpu:1"):
+            pred = layers.fc(h, size=1, param_attr="ev_fc2.w")
+            loss = layers.reduce_mean(layers.square(pred - y))
+        err = layers.reduce_mean(pred)  # aux metric on a stage activation
+        PipelineOptimizer(SGDOptimizer(0.05), 2).minimize(loss, startup)
+    test_prog = main.clone(for_test=True)
+    assert getattr(test_prog, "_pipeline", None)
+
+    mesh = dist.DeviceMesh({"pp": 2})
+    rng = np.random.RandomState(4)
+    feed = {"x": rng.randn(8, 8).astype(np.float32),
+            "y": rng.randn(8, 1).astype(np.float32)}
+    scope = fluid.Scope()
+    exe = fluid.Executor(mesh=mesh)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (ltr,) = exe.run(main, feed=feed, fetch_list=[loss])
+        (lev,) = exe.run(test_prog, feed=feed, fetch_list=[loss])
+        assert np.isfinite(float(np.mean(lev)))
+        # aux metric on a stage activation -> targeted error
+        with _pytest.raises(Exception, match="not an ancestor of the loss"):
+            exe.run(main, feed=feed, fetch_list=[loss, err])
