@@ -20,6 +20,8 @@ from . import (  # noqa: F401
     reduce_ops,
     rnn_ops,
     sequence_ops,
+    tail_nn_ops,
+    tail_ops,
     tensor_ops,
 )
 from .optimizer_ops import OPTIMIZER_OP_TYPES  # noqa: F401

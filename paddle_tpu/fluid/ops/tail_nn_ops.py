@@ -1,0 +1,786 @@
+"""Op-tail batch 2: NN / detection / RNN ops (round-4 audit list).
+
+deformable_conv is a gather+bilinear-sample composition (the reference's
+hand CUDA im2col-with-offsets, deformable_conv_op.cu, becomes XLA gathers
+that fuse); pooling-with-index ops stack strided window slices and argmax
+over the window axis (static shapes, no select-and-scatter).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import get_op_def, register_op
+from .nn_ops import _pair
+
+
+# ---------------------------------------------------------------------------
+# convolution family
+# ---------------------------------------------------------------------------
+
+
+@register_op("conv3d_transpose", inputs=["Input", "Filter"],
+             outputs=["Output"])
+def _conv3d_transpose(ctx, ins, attrs):
+    """cf. conv_transpose_op.cc (3-D): NCDHW, filter [Cin, Cout/g, kd,
+    kh, kw]; fractionally-strided conv like the 2-D op."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    if x.dtype != w.dtype and jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(w.dtype)
+    strides = attrs.get("strides", [1, 1, 1])
+    pads = attrs.get("paddings", [0, 0, 0])
+    dils = attrs.get("dilations", [1, 1, 1])
+    strides = tuple(int(s) for s in strides)
+    pads = tuple(int(p) for p in pads)
+    dils = tuple(int(d) for d in dils)
+    groups = int(attrs.get("groups", 1))
+    ks = tuple(int(s) for s in w.shape[2:])
+    cin = int(w.shape[0])
+    wg = w.reshape((groups, cin // groups) + tuple(w.shape[1:]))
+    wg = jnp.flip(jnp.swapaxes(wg, 1, 2), axis=(3, 4, 5))
+    w_t = wg.reshape((groups * int(w.shape[1]), cin // groups) + ks)
+    padding = [(dils[i] * (ks[i] - 1) - pads[i],
+                dils[i] * (ks[i] - 1) - pads[i]) for i in range(3)]
+    out = jax.lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1, 1), padding=padding,
+        lhs_dilation=strides, rhs_dilation=dils,
+        feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+    )
+    return {"Output": [out]}
+
+
+def _bilinear_sample_nchw(img, y, x):
+    """img [C, H, W]; y/x arbitrary same-shaped float coords -> [C, ...].
+    Out-of-range samples are 0 (deformable_conv border semantics)."""
+    H, W = img.shape[1], img.shape[2]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1, wx1 = y - y0, x - x0
+    wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+
+    def tap(yy, xx, wgt):
+        inb = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+        yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        v = img[:, yc, xc]                       # [C, ...]
+        return v * (wgt * inb.astype(img.dtype))[None]
+
+    return (tap(y0, x0, wy0 * wx0) + tap(y0, x0 + 1, wy0 * wx1)
+            + tap(y0 + 1, x0, wy1 * wx0) + tap(y0 + 1, x0 + 1, wy1 * wx1))
+
+
+def _deformable_conv_impl(ctx, ins, attrs, with_mask):
+    x, offset, w = ins["Input"][0], ins["Offset"][0], ins["Filter"][0]
+    mask = ins["Mask"][0] if (with_mask and ins.get("Mask")) else None
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dils = _pair(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1))
+    dg = int(attrs.get("deformable_groups", 1))
+    B, C, H, W = x.shape
+    Cout, Cg, kh, kw = w.shape
+    Ho = (H + 2 * pads[0] - (dils[0] * (kh - 1) + 1)) // strides[0] + 1
+    Wo = (W + 2 * pads[1] - (dils[1] * (kw - 1) + 1)) // strides[1] + 1
+    off = offset.reshape(B, dg, kh * kw, 2, Ho, Wo)
+    if mask is not None:
+        msk = mask.reshape(B, dg, kh * kw, Ho, Wo)
+
+    oy = jnp.arange(Ho) * strides[0] - pads[0]
+    ox = jnp.arange(Wo) * strides[1] - pads[1]
+
+    def one_image(img, off_b, msk_b):
+        # img [C,H,W]; off_b [dg, k*k, 2, Ho, Wo]
+        cols = []
+        for t in range(kh * kw):
+            ky, kx = t // kw, t % kw
+            ys = oy[:, None] + ky * dils[0] + off_b[:, t, 0]   # [dg,Ho,Wo]
+            xs = ox[None, :] + kx * dils[1] + off_b[:, t, 1]
+            per_dg = []
+            cpg = C // dg
+            for d in range(dg):
+                v = _bilinear_sample_nchw(
+                    img[d * cpg:(d + 1) * cpg], ys[d], xs[d])
+                if msk_b is not None:
+                    v = v * msk_b[d, t][None]
+                per_dg.append(v)
+            cols.append(jnp.concatenate(per_dg, axis=0))  # [C,Ho,Wo]
+        return jnp.stack(cols, axis=1)           # [C, k*k, Ho, Wo]
+
+    if mask is not None:
+        patches = jax.vmap(one_image)(x, off, msk)
+    else:
+        patches = jax.vmap(
+            lambda img, off_b: one_image(img, off_b, None))(x, off)
+    # grouped contraction: w [Cout, C/g, kh*kw]
+    wf = w.reshape(Cout, Cg, kh * kw)
+    cpg_o = Cout // groups
+    cpg_i = C // groups
+    outs = []
+    for g in range(groups):
+        pg = patches[:, g * cpg_i:(g + 1) * cpg_i]
+        wg = wf[g * cpg_o:(g + 1) * cpg_o]
+        outs.append(jnp.einsum("bckhw,ock->bohw", pg, wg))
+    return {"Output": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("deformable_conv", inputs=["Input", "Offset", "Mask", "Filter"],
+             outputs=["Output"])
+def _deformable_conv(ctx, ins, attrs):
+    """cf. deformable_conv_op.cc (v2: modulated, with Mask)."""
+    return _deformable_conv_impl(ctx, ins, attrs, with_mask=True)
+
+
+@register_op("deformable_conv_v1", inputs=["Input", "Offset", "Filter"],
+             outputs=["Output"])
+def _deformable_conv_v1(ctx, ins, attrs):
+    """cf. deformable_conv_v1_op.cc (no modulation mask)."""
+    return _deformable_conv_impl(ctx, ins, attrs, with_mask=False)
+
+
+# ---------------------------------------------------------------------------
+# pooling with indices / unpool / crop / space_to_depth
+# ---------------------------------------------------------------------------
+
+
+def _window_slices(x, ksize, strides, pads, spatial_start):
+    """Stack k-window strided slices -> [.., prod(k), Ho..]; also return
+    the GLOBAL flat index each slice position corresponds to."""
+    nd = len(ksize)
+    pad_cfg = [(0, 0)] * spatial_start + [(pads[i], pads[i] + ksize[i])
+                                          for i in range(nd)]
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, pad_cfg, constant_values=neg)
+    in_sp = x.shape[spatial_start:]
+    out_sp = [(in_sp[i] + 2 * pads[i] - ksize[i]) // strides[i] + 1
+              for i in range(nd)]
+    slices, gidx = [], []
+    import itertools
+
+    for taps in itertools.product(*[range(k) for k in ksize]):
+        sl = [slice(None)] * spatial_start
+        for i in range(nd):
+            sl.append(slice(taps[i], taps[i] + out_sp[i] * strides[i],
+                            strides[i]))
+        slices.append(xp[tuple(sl)])
+        # global index of this tap at each output position
+        coords = []
+        for i in range(nd):
+            c = jnp.arange(out_sp[i]) * strides[i] + taps[i] - pads[i]
+            coords.append(c)
+        flat = jnp.zeros(tuple(out_sp), jnp.int32)
+        mul = 1
+        for i in range(nd - 1, -1, -1):
+            shape = [1] * nd
+            shape[i] = out_sp[i]
+            flat = flat + coords[i].reshape(shape).astype(jnp.int32) * mul
+            mul *= in_sp[i]
+        gidx.append(flat)
+    return jnp.stack(slices, axis=spatial_start), jnp.stack(gidx, 0), out_sp
+
+
+@register_op("max_pool2d_with_index", inputs=["X"], outputs=["Out", "Mask"],
+             no_grad_slots=())
+def _max_pool2d_with_index(ctx, ins, attrs):
+    """cf. pool_with_index_op.cc: max pool emitting the flat in-plane
+    index of each max (consumed by unpool / the exact backward)."""
+    x = ins["X"][0]
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", ksize))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = (x.shape[2], x.shape[3])
+        strides = ksize
+        pads = (0, 0)
+    stacked, gidx, out_sp = _window_slices(x, ksize, strides, pads, 2)
+    am = jnp.argmax(stacked, axis=2)             # [B, C, Ho, Wo]
+    out = jnp.max(stacked, axis=2)
+    mask = jnp.take_along_axis(
+        gidx[None, None], am[:, :, None], axis=2)[:, :, 0]
+    return {"Out": [out.astype(x.dtype)], "Mask": [mask.astype(jnp.int32)]}
+
+
+@register_op("max_pool3d_with_index", inputs=["X"], outputs=["Out", "Mask"])
+def _max_pool3d_with_index(ctx, ins, attrs):
+    """cf. pool_with_index_op.cc (3-D NCDHW)."""
+    x = ins["X"][0]
+    k = attrs.get("ksize", [2, 2, 2])
+    ksize = tuple(int(v) for v in k)
+    strides = tuple(int(v) for v in attrs.get("strides", ksize))
+    pads = tuple(int(v) for v in attrs.get("paddings", [0, 0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = tuple(x.shape[2:])
+        strides = ksize
+        pads = (0, 0, 0)
+    stacked, gidx, out_sp = _window_slices(x, ksize, strides, pads, 2)
+    am = jnp.argmax(stacked, axis=2)
+    out = jnp.max(stacked, axis=2)
+    mask = jnp.take_along_axis(
+        gidx[None, None], am[:, :, None], axis=2)[:, :, 0]
+    return {"Out": [out.astype(x.dtype)], "Mask": [mask.astype(jnp.int32)]}
+
+
+@register_op("unpool", inputs=["X", "Indices"], outputs=["Out"],
+             no_grad_slots=("Indices",))
+def _unpool(ctx, ins, attrs):
+    """cf. unpool_op.cc: scatter pooled values back to their recorded max
+    positions (indices are flat in-plane, matching
+    max_pool2d_with_index)."""
+    x, idx = ins["X"][0], ins["Indices"][0]
+    B, C, Hi, Wi = x.shape
+    Ho, Wo = (int(s) for s in attrs["unpooled_shape"])
+
+    def plane(v, i):
+        return jnp.zeros((Ho * Wo,), v.dtype).at[i.reshape(-1)].add(
+            v.reshape(-1)).reshape(Ho, Wo)
+
+    out = jax.vmap(jax.vmap(plane))(x, idx.astype(jnp.int32))
+    return {"Out": [out]}
+
+
+@register_op("crop", inputs=["X", "Y", "Offsets"], outputs=["Out"],
+             no_grad_slots=("Y", "Offsets"))
+def _crop(ctx, ins, attrs):
+    """cf. crop_op.cc: static slice at `offsets` with `shape` (attr or the
+    shape of Y)."""
+    import numpy as np
+
+    x = ins["X"][0]
+    if ins.get("Y"):
+        shape = ins["Y"][0].shape
+    else:
+        shape = tuple(int(s) for s in attrs["shape"])
+    if ins.get("Offsets"):
+        off = jax.core.concrete_or_error(
+            None, ins["Offsets"][0],
+            "crop Offsets must be graph-time constants under XLA")
+        off = tuple(int(v) for v in np.asarray(off))
+    else:
+        off = tuple(int(v) for v in attrs.get("offsets", [0] * x.ndim))
+    sl = tuple(slice(off[i], off[i] + shape[i]) for i in range(x.ndim))
+    return {"Out": [x[sl]]}
+
+
+@register_op("space_to_depth", inputs=["X"], outputs=["Out"])
+def _space_to_depth(ctx, ins, attrs):
+    """cf. space_to_depth_op.cc: NCHW blocksize rearrange."""
+    x = ins["X"][0]
+    bs = int(attrs.get("blocksize", 2))
+    B, C, H, W = x.shape
+    x = x.reshape(B, C, H // bs, bs, W // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": [x.reshape(B, C * bs * bs, H // bs, W // bs)]}
+
+
+# ---------------------------------------------------------------------------
+# sampled / hierarchical losses, RNN variant
+# ---------------------------------------------------------------------------
+
+
+@register_op("nce", inputs=["Input", "Label", "Weight", "Bias",
+                            "SampleWeight"],
+             outputs=["Cost", "SampleLogits", "SampleLabels"],
+             needs_rng=True, no_grad_slots=("Label", "SampleWeight"))
+def _nce(ctx, ins, attrs):
+    """cf. nce_op.cc: noise-contrastive estimation with a uniform negative
+    sampler (sampler attr 0; custom_dist falls back to uniform,
+    documented)."""
+    x, label, w = ins["Input"][0], ins["Label"][0], ins["Weight"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    num_neg = int(attrs.get("num_neg_samples", 10))
+    total = int(attrs.get("num_total_classes", w.shape[0]))
+    B = x.shape[0]
+    nt = label.shape[1] if label.ndim > 1 else 1
+    lab = label.reshape(B, nt).astype(jnp.int32)
+    negs = jax.random.randint(ctx.rng(), (B, num_neg), 0, total)
+    samples = jnp.concatenate([lab, negs], axis=1)       # [B, nt+S]
+    logits = jnp.einsum("bd,bsd->bs", x, w[samples])
+    if bias is not None:
+        logits = logits + bias[samples]
+    labels_out = jnp.concatenate(
+        [jnp.ones((B, nt), jnp.int32), jnp.zeros((B, num_neg), jnp.int32)],
+        axis=1)
+    # NCE posterior (cf. nce_op.h): the classifier scores
+    # logit' = logit - log(k * q) with uniform noise q = 1/total;
+    # -log sigmoid(logit') for positives, -log(1 - sigmoid) for negatives
+    q = 1.0 / total
+    logits_adj = logits - jnp.log(num_neg * q)
+    lse = jnp.logaddexp(0.0, logits_adj)         # log(1 + e^l')
+    logp_model = logits_adj - lse                # log sigmoid
+    logp_noise = -lse                            # log(1 - sigmoid)
+    cost = -(jnp.sum(logp_model[:, :nt], axis=1)
+             + jnp.sum(logp_noise[:, nt:], axis=1))
+    if ins.get("SampleWeight"):
+        cost = cost * ins["SampleWeight"][0].reshape(-1)
+    return {"Cost": [cost[:, None]],
+            "SampleLogits": [logits], "SampleLabels": [samples]}
+
+
+@register_op("hierarchical_sigmoid",
+             inputs=["X", "Label", "W", "Bias", "PathTable", "PathCode"],
+             outputs=["Out", "PreOut"],
+             no_grad_slots=("Label", "PathTable", "PathCode"))
+def _hierarchical_sigmoid(ctx, ins, attrs):
+    """cf. hierarchical_sigmoid_op.cc: default complete binary tree over
+    num_classes (heap indexing, matching MatrixBitCodeFunctor), or a
+    custom tree via PathTable/PathCode."""
+    x, label, w = ins["X"][0], ins["Label"][0], ins["W"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    B = x.shape[0]
+    lab = label.reshape(-1).astype(jnp.int32)
+    if ins.get("PathTable"):
+        table = ins["PathTable"][0].astype(jnp.int32)    # [B, L]
+        code = ins["PathCode"][0].astype(jnp.float32)    # [B, L]
+        valid = (table >= 0).astype(jnp.float32)
+        idx = jnp.maximum(table, 0)
+    else:
+        num_classes = int(attrs["num_classes"])
+        L = max(1, int(jnp.ceil(jnp.log2(num_classes))))
+        # heap code of (label + num_classes): bits below the leading one
+        node = lab + num_classes
+        bits = []
+        parents = []
+        for d in range(L):
+            bits.append(node % 2)
+            node = node // 2
+            parents.append(node)
+        # path from just-below-root down: reference walks calc_index =
+        # parent - 1 per level while parent > 1
+        idx_l, code_l, valid_l = [], [], []
+        for d in range(L - 1, -1, -1):
+            p = parents[d]
+            valid_l.append((p >= 1).astype(jnp.float32))
+            idx_l.append(jnp.maximum(p - 1, 0))
+            code_l.append(bits[d].astype(jnp.float32))
+        idx = jnp.stack(idx_l, axis=1)
+        code = jnp.stack(code_l, axis=1)
+        valid = jnp.stack(valid_l, axis=1)
+    pre = jnp.einsum("bd,bld->bl", x, w[idx])
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[idx]
+    # per-node sigmoid CE toward the path code bit
+    ce = jnp.logaddexp(0.0, pre) - code * pre
+    out = jnp.sum(ce * valid, axis=1, keepdims=True)
+    return {"Out": [out], "PreOut": [pre]}
+
+
+@register_op("lstmp",
+             inputs=["Input", "Weight", "ProjWeight", "Bias", "H0", "C0",
+                     "SeqLens"],
+             outputs=["Projection", "Cell", "LastH", "LastC"],
+             no_grad_slots=("SeqLens",))
+def _lstmp(ctx, ins, attrs):
+    """cf. lstmp_op.cc: LSTM with a recurrent projection layer — the
+    hidden state fed back (and emitted) is h_proj = act(h @ ProjWeight),
+    ProjWeight [D, P], recurrent Weight [P, 4D]."""
+    from .rnn_ops import _act, _scan_rnn
+
+    x = ins["Input"][0]
+    W = ins["Weight"][0]                          # [P, 4D]
+    Wp = ins["ProjWeight"][0]                     # [D, P]
+    D = Wp.shape[0]
+    P = Wp.shape[1]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    use_peep = bool(attrs.get("use_peepholes", False))
+    peep = None
+    if use_peep:
+        b = bias.reshape(-1)
+        peep = (b[4 * D:5 * D], b[5 * D:6 * D], b[6 * D:])
+    acts = (_act(attrs.get("gate_activation", "sigmoid")),
+            _act(attrs.get("cell_activation", "tanh")),
+            _act(attrs.get("candidate_activation", "tanh")))
+    proj_act = _act(attrs.get("proj_activation", "identity"))
+    B = x.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((B, P), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((B, D), x.dtype)
+    lens = ins["SeqLens"][0] if ins.get("SeqLens") else None
+
+    act_gate, act_cell, act_cand = acts
+
+    def step(carry, xt):
+        hp, c = carry
+        # _lstm_cell infers the cell width from the carry, which here is
+        # the PROJECTED state [B, P] — inline the cell with explicit D
+        g = xt + hp @ W
+        if bias is not None:
+            g = g + bias.reshape(-1)[: 4 * D]
+        gc, gi, gf, go = (g[..., :D], g[..., D:2 * D],
+                          g[..., 2 * D:3 * D], g[..., 3 * D:])
+        if peep is not None:
+            w_ic, w_fc, w_oc = peep
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        c_new = act_cand(gc) * act_gate(gi) + c * act_gate(gf)
+        if peep is not None:
+            go = go + c_new * peep[2]
+        h_new = act_gate(go) * act_cell(c_new)
+        hp_new = proj_act(h_new @ Wp)
+        return (hp_new, c_new), (hp_new, c_new)
+
+    (last_h, last_c), (hs, cs) = _scan_rnn(
+        step, x, lens, (h0, c0), attrs.get("is_reverse", False))
+    return {"Projection": [hs], "Cell": [cs],
+            "LastH": [last_h], "LastC": [last_c]}
+
+
+# ---------------------------------------------------------------------------
+# detection tail
+# ---------------------------------------------------------------------------
+
+
+@register_op("prroi_pool", inputs=["X", "ROIs", "BatchRoINums"],
+             outputs=["Out"], no_grad_slots=("ROIs", "BatchRoINums"))
+def _prroi_pool(ctx, ins, attrs):
+    """cf. prroi_pool_op.cc (Precise RoI Pooling): bin value = integral
+    of the bilinearly-interpolated feature over the bin / bin area.
+    Numerics note: the integral here is a dense 8x8-sample midpoint
+    approximation per bin (documented; the oracle test uses the same
+    quadrature).  ROIs are [R, 4] with a batch id per row in
+    BatchRoINums-free mode (single image) or [R, 5] (batch_id, x1, y1,
+    x2, y2)."""
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    S = 8  # quadrature points per bin side
+    if rois.shape[1] == 5:
+        bids = rois[:, 0].astype(jnp.int32)
+        boxes = rois[:, 1:]
+    else:
+        boxes = rois
+        if ins.get("BatchRoINums"):
+            counts = ins["BatchRoINums"][0].reshape(-1).astype(jnp.int32)
+            ends = jnp.cumsum(counts)             # [B]
+            r = jnp.arange(rois.shape[0])
+            bids = jnp.sum(
+                (r[:, None] >= ends[None, :]).astype(jnp.int32), axis=1)
+        else:
+            bids = jnp.zeros((rois.shape[0],), jnp.int32)
+
+    def one(bid, box):
+        img = x[bid]
+        x1, y1, x2, y2 = box * scale
+        bw = jnp.maximum(x2 - x1, 1e-6) / pw
+        bh = jnp.maximum(y2 - y1, 1e-6) / ph
+        ys = y1 + (jnp.arange(ph)[:, None] +
+                   (jnp.arange(S)[None, :] + 0.5) / S) * bh
+        xs = x1 + (jnp.arange(pw)[:, None] +
+                   (jnp.arange(S)[None, :] + 0.5) / S) * bw
+        yy = ys.reshape(-1)[:, None]              # [ph*S, 1]
+        xx = xs.reshape(-1)[None, :]              # [1, pw*S]
+        v = _bilinear_sample_nchw(
+            img, jnp.broadcast_to(yy, (ph * S, pw * S)),
+            jnp.broadcast_to(xx, (ph * S, pw * S)))  # [C, ph*S, pw*S]
+        v = v.reshape(v.shape[0], ph, S, pw, S).mean(axis=(2, 4))
+        return v
+
+    return {"Out": [jax.vmap(one)(bids, boxes)]}
+
+
+@register_op("yolov3_loss",
+             inputs=["X", "GTBox", "GTLabel", "GTScore"],
+             outputs=["Loss", "ObjectnessMask", "GTMatchMask"],
+             no_grad_slots=("GTBox", "GTLabel", "GTScore"))
+def _yolov3_loss(ctx, ins, attrs):
+    """cf. yolov3_loss_op.cc: per-anchor xywh (sq/CE), objectness and
+    class losses on the matched cells; anchors whose best IoU with any gt
+    exceeds ignore_thresh are excluded from the negative objectness
+    term."""
+    x = ins["X"][0]                     # [B, A*(5+C), H, W]
+    gtbox = ins["GTBox"][0]             # [B, G, 4] (cx, cy, w, h), 0..1
+    gtlabel = ins["GTLabel"][0]         # [B, G]
+    anchors = [int(a) for a in attrs["anchors"]]
+    mask_idx = [int(a) for a in attrs.get("anchor_mask",
+                                          range(len(anchors) // 2))]
+    C = int(attrs["class_num"])
+    ignore = float(attrs.get("ignore_thresh", 0.7))
+    down = int(attrs.get("downsample_ratio", 32))
+    B, _, H, W = x.shape
+    A = len(mask_idx)
+    inp = H * down
+    x = x.reshape(B, A, 5 + C, H, W)
+    raw_xy = x[:, :, 0:2]
+    pred_xy = jax.nn.sigmoid(raw_xy)
+    pred_wh = x[:, :, 2:4]
+    pred_obj = x[:, :, 4]
+    pred_cls = x[:, :, 5:]
+    gtscore = (ins["GTScore"][0] if ins.get("GTScore")
+               else jnp.ones(gtlabel.shape, jnp.float32))
+
+    all_anchors = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    sel_anchors = all_anchors[jnp.asarray(mask_idx)]
+
+    # gt -> responsible anchor (best IoU of centered boxes over ALL
+    # anchors, reference behavior) and cell
+    gw = gtbox[..., 2] * inp
+    gh = gtbox[..., 3] * inp
+    inter = (jnp.minimum(gw[..., None], all_anchors[:, 0])
+             * jnp.minimum(gh[..., None], all_anchors[:, 1]))
+    union = gw[..., None] * gh[..., None] \
+        + all_anchors[:, 0] * all_anchors[:, 1] - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)  # [B,G]
+    gi = jnp.clip((gtbox[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gtbox[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    has_gt = (gtbox[..., 2] > 0) & (gtbox[..., 3] > 0)
+
+    # scatter gt targets into the grid
+    def per_image(rxy, pxy, pwh, pobj, pcls, box, lab, score, bst, ci,
+                  cj, hg):
+        # local anchor index (or -1 when the best anchor isn't in mask)
+        local = -jnp.ones_like(bst)
+        for li, mi in enumerate(mask_idx):
+            local = jnp.where(bst == mi, li, local)
+        on = hg & (local >= 0)
+        tx = box[:, 0] * W - ci
+        ty = box[:, 1] * H - cj
+        tw = jnp.log(jnp.maximum(
+            box[:, 2] * inp / jnp.maximum(sel_anchors[
+                jnp.maximum(local, 0), 0], 1e-9), 1e-9))
+        th = jnp.log(jnp.maximum(
+            box[:, 3] * inp / jnp.maximum(sel_anchors[
+                jnp.maximum(local, 0), 1], 1e-9), 1e-9))
+        tscale = (2.0 - box[:, 2] * box[:, 3]) * score
+
+        obj_mask = jnp.zeros((A, H, W))
+        match = -jnp.ones((box.shape[0],), jnp.int32)
+        loss = 0.0
+        la = jnp.maximum(local, 0)
+        onf = on.astype(jnp.float32)
+        # coordinate + class losses gathered at (la, cj, ci); the BCE
+        # runs on the RAW logits (logit(clip(sigmoid(.))) would zero the
+        # gradient once the sigmoid saturates in fp32)
+        rxg = rxy[la, 0, cj, ci]
+        ryg = rxy[la, 1, cj, ci]
+        pwg = pwh[la, 0, cj, ci]
+        phg = pwh[la, 1, cj, ci]
+        bce = lambda p, t: (jnp.logaddexp(0.0, p) - t * p)
+        # reference uses sigmoid-CE on x/y and L1 on w/h
+        loss = loss + jnp.sum(
+            onf * tscale * (bce(rxg, tx) + bce(ryg, ty)))
+        loss = loss + jnp.sum(onf * tscale * (jnp.abs(pwg - tw)
+                                              + jnp.abs(phg - th)))
+        cls_logit = pcls[la, :, cj, ci]           # [G, C]
+        onehot = jax.nn.one_hot(lab, C)
+        loss = loss + jnp.sum(
+            onf[:, None] * score[:, None]
+            * (jnp.logaddexp(0.0, cls_logit) - onehot * cls_logit))
+        obj_mask = obj_mask.at[la, cj, ci].max(onf)
+        match = jnp.where(on, la, match)
+
+        # negative objectness: anchors with best-gt IoU > ignore excluded
+        cx = (jnp.arange(W)[None, None, :] + pxy[:, 0]) / W
+        cy = (jnp.arange(H)[None, :, None] + pxy[:, 1]) / H
+        pw_ = jnp.exp(pwh[:, 0]) * sel_anchors[:, 0, None, None] / inp
+        ph_ = jnp.exp(pwh[:, 1]) * sel_anchors[:, 1, None, None] / inp
+        px1, px2 = cx - pw_ / 2, cx + pw_ / 2
+        py1, py2 = cy - ph_ / 2, cy + ph_ / 2
+        gx1 = box[:, 0] - box[:, 2] / 2
+        gx2 = box[:, 0] + box[:, 2] / 2
+        gy1 = box[:, 1] - box[:, 3] / 2
+        gy2 = box[:, 1] + box[:, 3] / 2
+        ix = jnp.maximum(
+            jnp.minimum(px2[..., None], gx2) - jnp.maximum(
+                px1[..., None], gx1), 0)
+        iy = jnp.maximum(
+            jnp.minimum(py2[..., None], gy2) - jnp.maximum(
+                py1[..., None], gy1), 0)
+        inter2 = ix * iy
+        area_p = (px2 - px1) * (py2 - py1)
+        area_g = (gx2 - gx1) * (gy2 - gy1)
+        iou = inter2 / jnp.maximum(
+            area_p[..., None] + area_g - inter2, 1e-9)
+        best_iou = jnp.max(jnp.where(hg, iou, 0.0), axis=-1)
+        noobj = (best_iou <= ignore).astype(jnp.float32) * (1 - obj_mask)
+        loss = loss + jnp.sum(
+            obj_mask * (jnp.logaddexp(0.0, pobj) - pobj))
+        loss = loss + jnp.sum(noobj * jnp.logaddexp(0.0, pobj))
+        return loss, obj_mask + noobj * 0.0, match
+
+    loss, omask, match = jax.vmap(per_image)(
+        raw_xy, pred_xy, pred_wh, pred_obj, pred_cls, gtbox,
+        gtlabel.astype(jnp.int32), gtscore, best, gi, gj, has_gt)
+    return {"Loss": [loss], "ObjectnessMask": [omask],
+            "GTMatchMask": [match]}
+
+
+@register_op("multiclass_nms2", inputs=["BBoxes", "Scores"],
+             outputs=["Out", "Index"], grad=None)
+def _multiclass_nms2(ctx, ins, attrs):
+    """cf. multiclass_nms_op.cc (v2 adds the kept-box Index output; same
+    static [N, keep_top_k, 6] redesign as multiclass_nms, Index = -1 in
+    empty slots)."""
+    res = get_op_def("multiclass_nms").lower(ctx, ins, attrs)
+    out = res["Out"][0]
+    # index of the kept box within its image's flattened (class, box)
+    # score list is not tracked by the static path; emit slot validity
+    # (-1 padding, row index otherwise) as the index surrogate
+    keep = out[..., 0] >= 0
+    idx = jnp.where(
+        keep, jnp.broadcast_to(jnp.arange(out.shape[1]), keep.shape), -1)
+    return {"Out": [out], "Index": [idx.astype(jnp.int32)[..., None]]}
+
+
+@register_op("ctc_align", inputs=["Input"], outputs=["Output"], grad=None)
+def _ctc_align(ctx, ins, attrs):
+    """cf. ctc_align_op.cc: merge repeats then drop blanks; STATIC
+    redesign pads the tail with `padding_value` (default 0)."""
+    x = ins["Input"][0]
+    blank = int(attrs.get("blank", 0))
+    padv = int(attrs.get("padding_value", 0))
+    T = x.shape[-1]
+
+    def one(seq):
+        prev = jnp.concatenate([jnp.asarray([-1], seq.dtype), seq[:-1]])
+        keep = (seq != prev) & (seq != blank)
+        order = jnp.argsort(~keep, stable=True)   # kept first, stable
+        vals = jnp.where(keep, seq, padv)[order]
+        return jnp.where(jnp.arange(T) < jnp.sum(keep), vals, padv)
+
+    out = jax.vmap(one)(x.reshape(-1, T)).reshape(x.shape)
+    return {"Output": [out]}
+
+
+@register_op("positive_negative_pair",
+             inputs=["Score", "Label", "QueryID"],
+             outputs=["PositivePair", "NegativePair", "NeutralPair"],
+             grad=None)
+def _positive_negative_pair(ctx, ins, attrs):
+    """cf. positive_negative_pair_op.cc: within each query, count ordered
+    pairs where score order agrees (pos) / disagrees (neg) / ties
+    (neutral) with label order."""
+    s = ins["Score"][0].reshape(-1)
+    lab = ins["Label"][0].reshape(-1)
+    q = ins["QueryID"][0].reshape(-1)
+    same_q = q[:, None] == q[None, :]
+    lab_gt = lab[:, None] > lab[None, :]
+    s_diff = s[:, None] - s[None, :]
+    pos = jnp.sum(same_q & lab_gt & (s_diff > 0))
+    neg = jnp.sum(same_q & lab_gt & (s_diff < 0))
+    neu = jnp.sum(same_q & lab_gt & (s_diff == 0))
+    f = lambda v: v.astype(jnp.float32).reshape(1, 1)
+    return {"PositivePair": [f(pos)], "NegativePair": [f(neg)],
+            "NeutralPair": [f(neu)]}
+
+
+@register_op("mine_hard_examples",
+             inputs=["ClsLoss", "MatchIndices"],
+             outputs=["NegIndices", "UpdatedMatchIndices"], grad=None)
+def _mine_hard_examples(ctx, ins, attrs):
+    """cf. mine_hard_examples_op.cc (max_negative mining): per image,
+    select the highest-loss unmatched priors as negatives, at most
+    neg_pos_ratio * num_matched.  STATIC redesign: NegIndices is
+    [N, P] padded with -1."""
+    loss = ins["ClsLoss"][0]                      # [N, P]
+    match = ins["MatchIndices"][0]                # [N, P], -1 = unmatched
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    N, P = loss.shape
+
+    def one(l, m):
+        unmatched = m < 0
+        n_pos = jnp.sum(m >= 0)
+        n_neg = jnp.minimum(
+            (ratio * n_pos).astype(jnp.int32), jnp.sum(unmatched))
+        order = jnp.argsort(-jnp.where(unmatched, l, -jnp.inf))
+        keep = jnp.arange(P) < n_neg
+        negs = jnp.where(keep, order, -1)
+        # negatives stay -1 in updated match indices (already are)
+        return negs.astype(jnp.int32), m
+
+    negs, upd = jax.vmap(one)(loss, match)
+    return {"NegIndices": [negs], "UpdatedMatchIndices": [upd]}
+
+
+@register_op("similarity_focus", inputs=["X"], outputs=["Out"], grad=None)
+def _similarity_focus(ctx, ins, attrs):
+    """cf. similarity_focus_op.cc: for each selected channel (axis=1,
+    indexes attr), mark the (h, w) argmax per remaining dim pair with 1
+    producing a binary focus mask of X's shape."""
+    x = ins["X"][0]                               # [B, C, H, W]
+    indexes = [int(i) for i in attrs.get("indexes", [0])]
+    B, C, H, W = x.shape
+    mask = jnp.zeros_like(x)
+    for ci in indexes:
+        plane = x[:, ci]                          # [B, H, W]
+        # per row: max column; per column: max row (reference's
+        # row/column coverage procedure approximated by union of
+        # per-row and per-column argmax cells)
+        col_of_row = jnp.argmax(plane, axis=2)    # [B, H]
+        row_of_col = jnp.argmax(plane, axis=1)    # [B, W]
+        m = jnp.zeros((B, H, W))
+        m = m.at[jnp.arange(B)[:, None], jnp.arange(H)[None, :],
+                 col_of_row].set(1.0)
+        m = m.at[jnp.arange(B)[:, None], row_of_col,
+                 jnp.arange(W)[None, :]].set(1.0)
+        mask = mask.at[:, ci].set(m.astype(x.dtype))
+    # broadcast the union mask over unselected channels (reference
+    # shares the focus across the channel dim)
+    union = jnp.max(mask, axis=1, keepdims=True)
+    return {"Out": [jnp.broadcast_to(union, x.shape).astype(x.dtype)]}
+
+
+@register_op("broadcast", inputs=["X"], outputs=["Out"])
+def _broadcast(ctx, ins, attrs):
+    """cf. collective broadcast_op.cc: alias of c_broadcast semantics —
+    under SPMD every shard already holds the root's value after the
+    param-init broadcast, so this is the identity in-graph."""
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op(
+    "fused_batch_norm_act",
+    inputs=["X", "Scale", "Bias", "Mean", "Variance"],
+    outputs=["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+    no_grad_slots=("Mean", "Variance"),
+    stateful_out_slots=("MeanOut", "VarianceOut"),
+)
+def _fused_batch_norm_act(ctx, ins, attrs):
+    """cf. fused/fused_bn_activation_op.cc: batch_norm + activation in one
+    op (the fusion itself is XLA's job; this keeps the graph-level API)."""
+    res = get_op_def("batch_norm").lower(ctx, ins, attrs)
+    act = attrs.get("act_type", "relu")
+    res["Y"] = [get_op_def(act).lower(ctx, {"X": res["Y"]}, {})["Out"][0]]
+    return res
+
+
+@register_op(
+    "inplace_abn",
+    inputs=["X", "Scale", "Bias", "Mean", "Variance"],
+    outputs=["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+    no_grad_slots=("Mean", "Variance"),
+    stateful_out_slots=("MeanOut", "VarianceOut"),
+)
+def _inplace_abn(ctx, ins, attrs):
+    """cf. inplace_abn_op.cc: activated batch norm — in-place-ness is an
+    allocator concern XLA owns; semantics = batch_norm + activation
+    (identity / leaky_relu / elu per the reference attr)."""
+    res = get_op_def("batch_norm").lower(ctx, ins, attrs)
+    act = attrs.get("activation", "identity")
+    y = res["Y"][0]
+    if act == "leaky_relu":
+        alpha = float(attrs.get("alpha", 0.01))
+        y = jnp.where(y >= 0, y, alpha * y)
+    elif act == "elu":
+        alpha = float(attrs.get("alpha", 1.0))
+        y = jnp.where(y >= 0, y, alpha * (jnp.exp(y) - 1.0))
+    elif act not in ("identity", "", None):
+        y = get_op_def(act).lower(ctx, {"X": [y]}, {})["Out"][0]
+    res["Y"] = [y]
+    return res
+
+
+@register_op("tensor_array_to_tensor", inputs=["X"], outputs=["Out",
+                                                              "OutIndex"],
+             grad=None)
+def _tensor_array_to_tensor(ctx, ins, attrs):
+    """cf. tensor_array_to_tensor_op.cc: concat/stack the array's written
+    slots along `axis`."""
+    arr = ins["X"]
+    axis = int(attrs.get("axis", 0))
+    if bool(attrs.get("use_stack", False)):
+        out = jnp.stack(arr, axis=axis)
+    else:
+        out = jnp.concatenate(arr, axis=axis)
+    sizes = jnp.asarray([a.shape[axis] for a in arr], jnp.int32)
+    return {"Out": [out], "OutIndex": [sizes]}
