@@ -10,4 +10,13 @@ zero-arg callables producing example generators, exactly what
 pointing the loaders at downloaded files; the consuming code is unchanged.
 """
 
-from . import cifar, conll05, imdb, mnist, movielens, uci_housing  # noqa: F401
+from . import (  # noqa: F401
+    cifar,
+    conll05,
+    imdb,
+    mnist,
+    movielens,
+    uci_housing,
+    wmt14,
+    wmt16,
+)
