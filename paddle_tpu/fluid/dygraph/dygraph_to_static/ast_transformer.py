@@ -579,6 +579,121 @@ def _rewrite_test(expr):
 # ---------------------------------------------------------------------------
 
 
+class ListTransformer(ast.NodeTransformer):
+    """cf. reference list_transformer.py: `l.append(x)` statements become
+    `l = _jst.convert_append(l, x)` — the reassignment makes `l` a
+    loop-carried var for LoopTransformer, and convert_append picks plain
+    list vs tensor-array semantics at trace time.  MUST run before the
+    loop passes."""
+
+    def visit_Expr(self, node):
+        self.generic_visit(node)
+        call = node.value
+        if (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "append"
+                and isinstance(call.func.value, ast.Name)
+                and len(call.args) == 1 and not call.keywords):
+            tgt = call.func.value.id
+            return ast.Assign(
+                targets=[_name(tgt, ast.Store())],
+                value=_jst_call("convert_append",
+                                [_name(tgt), call.args[0]]),
+            )
+        return node
+
+
+class PrintTransformer(ast.NodeTransformer):
+    """cf. reference print_transformer.py: print(...) -> _jst.convert_print
+    (layers.Print for Variables — visible from inside the compiled
+    program — plain print otherwise)."""
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Name) and node.func.id == "print"
+                and not node.keywords):
+            return _jst_call("convert_print", list(node.args))
+        return node
+
+
+class CastTransformer(ast.NodeTransformer):
+    """cf. reference cast_transformer.py: int(x)/float(x)/bool(x) on
+    Variables become layers.cast; len(x) becomes convert_len."""
+
+    _MAP = {"int": "int64", "float": "float32", "bool": "bool"}
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Name) and not node.keywords
+                and len(node.args) == 1):
+            if node.func.id in self._MAP:
+                return _jst_call(
+                    "convert_cast",
+                    [node.args[0],
+                     ast.Constant(value=self._MAP[node.func.id])])
+            if node.func.id == "len":
+                return _jst_call("convert_len", [node.args[0]])
+        return node
+
+
+class AssertTransformer(ast.NodeTransformer):
+    """cf. reference assert_transformer.py."""
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        args = [node.test]
+        args.append(node.msg if node.msg is not None
+                    else ast.Constant(value=None))
+        return ast.Expr(value=_jst_call("convert_assert", args))
+
+
+class TensorShapeTransformer(ast.NodeTransformer):
+    """cf. reference tensor_shape_transformer.py: `x.shape` reads go
+    through convert_shape (static tuple when fully known, layers.shape
+    tensor when any dim is dynamic; non-Variables pass through)."""
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+        if node.attr == "shape" and isinstance(node.ctx, ast.Load):
+            return _jst_call("convert_shape", [node.value])
+        return node
+
+
+class CallTransformer(ast.NodeTransformer):
+    """cf. reference call_transformer.py: user-function calls route
+    through _jst.convert_call, which AST-transforms the callee
+    recursively (so `if tensor:`-style control flow inside helpers also
+    converts); builtins / fluid APIs pass through untouched at runtime.
+    Runs LAST so the other passes' generated calls are recognizable."""
+
+    _SKIP = {"print", "len", "int", "float", "bool", "range", "super",
+             "isinstance", "getattr", "setattr", "hasattr", "enumerate",
+             "zip", "list", "tuple", "dict", "min", "max", "abs", "sum",
+             "type", "id", "repr", "str"}
+
+    def _is_jst(self, func):
+        return (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == _JST)
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        f = node.func
+        if self._is_jst(f):
+            return node
+        if isinstance(f, ast.Name):
+            if f.id in self._SKIP or f.id.startswith("_"):
+                return node
+            node.func = _jst_call("convert_call", [f])
+            return node
+        if isinstance(f, ast.Attribute) and not f.attr.startswith("_"):
+            # method-style calls (self.helper(x), module.fn(x)) convert
+            # too; convert_call leaves non-convertibles untouched
+            node.func = _jst_call("convert_call", [f])
+            return node
+        return node
+
+
 def transform_function(fn):
     """Source-rewrite `fn` through the pass pipeline; returns the new
     callable (or None when source is unavailable — builtins, lambdas from
@@ -607,11 +722,17 @@ def transform_function(fn):
     ]
 
     for pass_cls in (
+        ListTransformer,          # append->assign BEFORE loop-var capture
         BreakContinueTransformer,
         ForToWhileTransformer,
         LoopTransformer,
         IfElseTransformer,
         # BoolOp rewriting happens inside Loop/IfElse on test exprs only
+        PrintTransformer,
+        CastTransformer,
+        AssertTransformer,
+        TensorShapeTransformer,
+        CallTransformer,          # LAST: wraps remaining user calls
     ):
         tree = pass_cls().visit(tree)
     ast.fix_missing_locations(tree)

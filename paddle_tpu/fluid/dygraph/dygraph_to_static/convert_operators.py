@@ -267,3 +267,139 @@ def _to_bool_tensor(x):
     if getattr(x, "dtype", "bool") != "bool":
         return t.cast(x, "bool")
     return x
+
+
+# ---------------------------------------------------------------------------
+# round-4 transformers' runtime targets: print / cast / len / assert /
+# shape / append / call (reference print_transformer.py,
+# cast_transformer.py, assert_transformer.py, tensor_shape_transformer.py,
+# list_transformer.py, call_transformer.py)
+# ---------------------------------------------------------------------------
+
+
+def convert_print(*args):
+    """Variables print from inside the compiled program (layers.Print);
+    everything else prints natively.  Argument ORDER is preserved: each
+    tensor's Print op carries the non-tensor args since the previous
+    tensor as its message."""
+    if not any(_is_tensor(a) for a in args):
+        print(*args)
+        return None
+    from ...layers import tensor as tensor_layers
+
+    pending = []
+    for a in args:
+        if _is_tensor(a):
+            tensor_layers.Print(a, message=" ".join(pending))
+            pending = []
+        else:
+            pending.append(str(a))
+    if pending:
+        print(*pending)
+    return None
+
+
+_CAST_PY = {"int64": int, "float32": float, "bool": bool}
+
+
+def convert_cast(x, dtype):
+    if _is_tensor(x):
+        from ...layers import tensor as tensor_layers
+
+        return tensor_layers.cast(x, dtype)
+    return _CAST_PY[dtype](x)
+
+
+def convert_len(x):
+    if _is_tensor(x):
+        d0 = x.shape[0]
+        if d0 is not None and int(d0) >= 0:
+            return int(d0)
+        from ...layers import tensor as tensor_layers
+
+        return tensor_layers.slice(tensor_layers.shape(x), [0], [0], [1])
+    return len(x)
+
+
+def convert_shape(x):
+    """Static tuple when fully known; layers.shape tensor otherwise;
+    non-Variables (numpy etc.) pass through to their own .shape."""
+    if not _is_tensor(x):
+        return x.shape
+    dims = list(x.shape)
+    if all(d is not None and int(d) >= 0 for d in dims):
+        return tuple(int(d) for d in dims)
+    from ...layers import tensor as tensor_layers
+
+    return tensor_layers.shape(x)
+
+
+def convert_assert(cond, msg=None):
+    if _is_tensor(cond):
+        from ...layers import control_flow as cf
+
+        return cf.Assert(cond, summarize=10,
+                         message=str(msg) if msg is not None else "")
+    assert cond, msg
+    return None
+
+
+def convert_append(lst, x):
+    """Plain appendables mutate IN PLACE and return themselves (the
+    rebinding the transformer emits then preserves aliasing while still
+    marking the name as loop-carried); tensor arrays
+    (layers.create_array) get array_write-at-length append."""
+    if hasattr(lst, "append"):
+        lst.append(x)
+        return lst
+    from ...layers import control_flow as cf
+
+    cf.array_write(x, cf.array_length(lst), lst)
+    return lst
+
+
+import weakref
+
+_CALL_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def convert_call(fn):
+    """Recursively AST-convert a called user function (reference
+    convert_call, `dygraph_to_static/convert_call_func.py`): functions
+    with retrievable source transform once (cached per function OBJECT,
+    so distinct closures of one def stay distinct); bound methods unwrap
+    to their __func__ and rebind; builtins, layer APIs, framework
+    internals, and classes pass through untouched."""
+    import types
+
+    if isinstance(fn, types.MethodType):
+        conv = convert_call(fn.__func__)
+        if conv is fn.__func__:
+            return fn
+        return types.MethodType(conv, fn.__self__)
+    if not isinstance(fn, types.FunctionType):
+        return fn  # builtins, classes, arbitrary callables
+    mod = getattr(fn, "__module__", "") or ""
+    if mod.startswith("paddle_tpu") or mod.startswith("jax") \
+            or mod.startswith("numpy"):
+        return fn
+    if getattr(fn, "__dy2st_source__", None):
+        return fn  # already transformed
+    try:
+        hit = _CALL_CACHE.get(fn)
+    except TypeError:
+        hit = None
+    if hit is not None:
+        return hit
+    from .ast_transformer import transform_function
+
+    try:
+        new_fn = transform_function(fn)
+    except Exception:
+        new_fn = None
+    out = new_fn or fn
+    try:
+        _CALL_CACHE[fn] = out
+    except TypeError:
+        pass
+    return out

