@@ -642,8 +642,14 @@ class AssertTransformer(ast.NodeTransformer):
     def visit_Assert(self, node):
         self.generic_visit(node)
         args = [node.test]
-        args.append(node.msg if node.msg is not None
-                    else ast.Constant(value=None))
+        if node.msg is not None:
+            # lazy message (python semantics: only evaluated on failure)
+            args.append(ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=node.msg))
+        else:
+            args.append(ast.Constant(value=None))
         return ast.Expr(value=_jst_call("convert_assert", args))
 
 

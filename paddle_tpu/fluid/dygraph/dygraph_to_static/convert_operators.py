@@ -335,12 +335,16 @@ def convert_shape(x):
 
 
 def convert_assert(cond, msg=None):
+    """msg may be a zero-arg lambda (lazy python semantics — evaluated
+    only when needed: on failure, or at trace time for tensor conds)."""
     if _is_tensor(cond):
         from ...layers import control_flow as cf
 
+        m = msg() if callable(msg) else msg
         return cf.Assert(cond, summarize=10,
-                         message=str(msg) if msg is not None else "")
-    assert cond, msg
+                         message=str(m) if m is not None else "")
+    if not cond:
+        raise AssertionError(msg() if callable(msg) else msg)
     return None
 
 

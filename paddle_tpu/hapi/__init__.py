@@ -7,9 +7,11 @@ Capability parity: reference `python/paddle/incubate/hapi/` — `model.py`
 from . import datasets, text, vision  # noqa: F401
 from .callbacks import (  # noqa: F401
     Callback,
+    CSVLogger,
     EarlyStopping,
     LRSchedulerCallback,
     ModelCheckpoint,
     ProgBarLogger,
+    ReduceLROnPlateau,
 )
-from .model import Model  # noqa: F401
+from .model import Input, Model, summary  # noqa: F401

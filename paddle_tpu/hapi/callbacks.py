@@ -110,12 +110,7 @@ class EarlyStopping(Callback):
             self.best = cur
             self.wait = 0
             if self.save_best:
-                import jax.numpy as jnp
-
-                self._best_state = {
-                    k: jnp.asarray(v.data)
-                    for k, v in self.model.network.state_dict().items()
-                }
+                self._best_state = self.model.get_weights()
         else:
             self.wait += 1
             if self.wait > self.patience:
@@ -124,9 +119,7 @@ class EarlyStopping(Callback):
 
     def on_train_end(self, logs=None):
         if self.save_best and self._best_state is not None:
-            sd = self.model.network.state_dict()
-            for k, v in self._best_state.items():
-                sd[k].data = v
+            self.model.set_weights(self._best_state)
 
 
 class LRSchedulerCallback(Callback):
@@ -142,3 +135,89 @@ class LRSchedulerCallback(Callback):
             opt.set_lr(lr)
         else:
             opt._learning_rate = lr
+
+
+class ReduceLROnPlateau(Callback):
+    """cf. reference (2.0) ReduceLROnPlateau: shrink the LR by `factor`
+    when the monitored value plateaus for `patience` epochs."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=3,
+                 min_delta=1e-4, min_lr=0.0, mode="min", verbose=0):
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = int(patience)
+        self.min_delta = abs(min_delta)
+        self.min_lr = float(min_lr)
+        self.mode = mode if mode in ("min", "max") else "min"
+        self.verbose = verbose
+
+    def on_train_begin(self, logs=None):
+        import numpy as np
+
+        self.best = np.inf if self.mode == "min" else -np.inf
+        self.wait = 0
+
+    def _improved(self, cur):
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def _get_lr(self, opt):
+        lr = getattr(opt, "_learning_rate", None)
+        return float(lr) if isinstance(lr, (int, float)) else None
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if self._improved(float(cur)):
+            self.best = float(cur)
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait > self.patience:
+            opt = self.model._optimizer
+            lr = self._get_lr(opt)
+            if lr is not None and lr > self.min_lr:
+                new_lr = max(lr * self.factor, self.min_lr)
+                if hasattr(opt, "set_lr"):
+                    opt.set_lr(new_lr)
+                else:
+                    opt._learning_rate = new_lr
+                if self.verbose:
+                    print("ReduceLROnPlateau: lr %.2e -> %.2e"
+                          % (lr, new_lr))
+            self.wait = 0
+
+
+class CSVLogger(Callback):
+    """Append per-epoch logs to a CSV file (VisualDL-callback capability
+    without the dashboard dependency)."""
+
+    def __init__(self, path, append=False):
+        self.path = path
+        self.append = append
+        self._keys = None
+
+    def on_train_begin(self, logs=None):
+        if not self.append:
+            open(self.path, "w").close()
+            self._keys = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        import os
+
+        logs = logs or {}
+        if self._keys is None:
+            self._keys = sorted(logs.keys())
+            try:
+                need_header = os.path.getsize(self.path) == 0
+            except OSError:
+                need_header = True
+            if need_header:
+                with open(self.path, "a") as f:
+                    f.write(",".join(["epoch"] + self._keys) + "\n")
+        with open(self.path, "a") as f:
+            f.write(",".join(
+                [str(epoch)] + ["%g" % float(logs.get(k, float("nan")))
+                                for k in self._keys]) + "\n")

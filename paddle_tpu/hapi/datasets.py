@@ -11,24 +11,30 @@ class _ArrayDataset:
     """Indexable (x, y) dataset; also iterable as (x, y) batches source
     for Model.fit via the (xs, ys) tuple protocol."""
 
-    def __init__(self, xs, ys):
+    def __init__(self, xs, ys, transform=None):
         self.xs = np.asarray(xs)
         self.ys = np.asarray(ys)
+        self.transform = transform
 
     def __len__(self):
         return len(self.xs)
 
     def __getitem__(self, i):
-        return self.xs[i], self.ys[i]
+        x = self.xs[i]
+        if self.transform is not None:
+            x = self.transform(x)
+        return x, self.ys[i]
 
     def as_arrays(self):
         return self.xs, self.ys
 
 
 class MNIST(_ArrayDataset):
-    """cf. hapi/datasets/mnist.py: mode train|test, images [N,1,28,28]."""
+    """cf. hapi/datasets/mnist.py: mode train|test, images [N,1,28,28];
+    `transform` applies per sample at __getitem__ (reference dataset
+    transform contract)."""
 
-    def __init__(self, mode="train", n=None):
+    def __init__(self, mode="train", n=None, transform=None):
         from ..dataset import mnist
 
         reader = mnist.train() if mode == "train" else mnist.test()
@@ -38,11 +44,12 @@ class MNIST(_ArrayDataset):
             ys.append(int(label))
             if n is not None and len(xs) >= n:
                 break
-        super().__init__(np.stack(xs), np.asarray(ys, np.int64))
+        super().__init__(np.stack(xs), np.asarray(ys, np.int64),
+                         transform=transform)
 
 
 class Cifar10(_ArrayDataset):
-    def __init__(self, mode="train", n=None):
+    def __init__(self, mode="train", n=None, transform=None):
         from ..dataset import cifar
 
         reader = cifar.train10() if mode == "train" else cifar.test10()
@@ -52,7 +59,42 @@ class Cifar10(_ArrayDataset):
             ys.append(int(label))
             if n is not None and len(xs) >= n:
                 break
-        super().__init__(np.stack(xs), np.asarray(ys, np.int64))
+        super().__init__(np.stack(xs), np.asarray(ys, np.int64),
+                         transform=transform)
+
+
+class WMT14:
+    """cf. hapi-era translation dataset: padded (src, tgt_in, tgt_out)
+    triples over the dataset.wmt14 reader."""
+
+    def __init__(self, dict_size=30, mode="train", src_len=12, trg_len=12,
+                 n=None):
+        from ..dataset import wmt14
+
+        reader = (wmt14.train(dict_size) if mode == "train"
+                  else wmt14.test(dict_size))
+        srcs, tins, touts = [], [], []
+        for s, ti, to in reader():
+            srcs.append(_pad(s, src_len))
+            tins.append(_pad(ti, trg_len))
+            touts.append(_pad(to, trg_len))
+            if n is not None and len(srcs) >= n:
+                break
+        self.src = np.stack(srcs)
+        self.tgt_in = np.stack(tins)
+        self.tgt_out = np.stack(touts)
+
+    def __len__(self):
+        return len(self.src)
+
+    def __getitem__(self, i):
+        return self.src[i], self.tgt_in[i], self.tgt_out[i]
+
+
+def _pad(seq, n, pad=0):
+    a = np.full(n, pad, np.int64)
+    a[: min(len(seq), n)] = seq[:n]
+    return a
 
 
 class Imdb:

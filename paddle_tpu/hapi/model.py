@@ -1,22 +1,39 @@
-"""hapi.Model: fit/evaluate/predict over a dygraph Layer.
+"""hapi.Model: fit/evaluate/predict over a network, static OR dygraph.
 
 Capability parity: reference `incubate/hapi/model.py` — Model wraps a
-network + optimizer + loss + metrics; fit() iterates a DataLoader (or
-arrays), runs train steps, drives callbacks; evaluate()/predict();
-save()/load() of params + optimizer state.
-
-TPU-first: the dygraph path IS the jit path (lowerings are traceable), so
-one adapter serves both modes; large-scale training goes through
-distributed.ShardedTrainStep with the same Layer.
+network + optimizer + loss + metrics with TWO adapters chosen by the
+execution mode at prepare() time (reference StaticGraphAdapter /
+DynamicGraphAdapter, model.py:156,594): under `dygraph.guard()` batches
+run eagerly; otherwise prepare() builds train/eval/predict Programs from
+the declared `Input` specs (eval program cloned for_test BEFORE minimize,
+the reference's clone discipline) and fit() drives an Executor.
+fit()/evaluate()/predict() and the callback stream are adapter-agnostic.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from ..fluid import dygraph, layers
+from ..fluid import dygraph
 from ..fluid.dygraph import to_variable
 from .callbacks import Callback, ProgBarLogger
+
+
+class Input:
+    """cf. reference hapi.Input: a feed-var spec (shape with None/-1
+    batch dims, dtype, name)."""
+
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = list(shape or [])
+        self.dtype = dtype
+        self.name = name
+
+    def _to_feed_var(self, default_name):
+        from ..fluid import layers
+
+        shape = [(-1 if s in (None, -1) else int(s)) for s in self.shape]
+        return layers.data(self.name or default_name, shape=shape,
+                           dtype=self.dtype, append_batch_size=False)
 
 
 def _to_batches(data, batch_size, shuffle=False, seed=None):
@@ -34,51 +51,205 @@ def _to_batches(data, batch_size, shuffle=False, seed=None):
         yield xs[j], ys[j]
 
 
-class Model:
-    def __init__(self, network, inputs=None, labels=None):
-        self.network = network
-        self._optimizer = None
-        self._loss = None
-        self._metrics = []
-        self.stop_training = False  # set by EarlyStopping
+class _DygraphAdapter:
+    """Eager per-batch execution (reference DynamicGraphAdapter)."""
 
-    def prepare(self, optimizer=None, loss_function=None, metrics=None):
-        """cf. reference Model.prepare(optimizer, loss, metrics)."""
-        self._optimizer = optimizer
-        self._loss = loss_function
-        self._metrics = list(metrics or [])
-        return self
-
-    # -- steps ----------------------------------------------------------
-    @staticmethod
-    def _wrap_inputs(inputs):
-        """A network may take one array or a list of feature arrays."""
-        if isinstance(inputs, (list, tuple)):
-            return [to_variable(np.asarray(a)) for a in inputs]
-        return [to_variable(np.asarray(inputs))]
+    def __init__(self, model):
+        self.m = model
 
     def train_batch(self, inputs, labels):
-        xs = self._wrap_inputs(inputs)
+        m = self.m
+        xs = _wrap_vars(inputs)
         y = to_variable(np.asarray(labels))
-        self.network.train()
-        pred = self.network(*xs)
-        loss = self._loss(pred, y)
+        m.network.train()
+        pred = m.network(*xs)
+        loss = m._loss(pred, y)
         loss.backward()
-        self._optimizer.minimize(loss, parameter_list=self.network.parameters())
-        self.network.clear_gradients()
+        m._optimizer.minimize(loss, parameter_list=m.network.parameters())
+        m.network.clear_gradients()
         return float(loss.numpy()), pred.numpy()
 
     def eval_batch(self, inputs, labels):
-        self.network.eval()
+        m = self.m
+        m.network.eval()
         with dygraph.no_grad():
-            pred = self.network(*self._wrap_inputs(inputs))
-            loss = self._loss(pred, to_variable(np.asarray(labels)))
+            pred = m.network(*_wrap_vars(inputs))
+            loss = m._loss(pred, to_variable(np.asarray(labels)))
         return float(loss.numpy()), pred.numpy()
 
     def predict_batch(self, inputs):
-        self.network.eval()
+        self.m.network.eval()
         with dygraph.no_grad():
-            return self.network(*self._wrap_inputs(inputs)).numpy()
+            return self.m.network(*_wrap_vars(inputs)).numpy()
+
+    def save(self, path):
+        dygraph.save_dygraph(self.m.network.state_dict(), path)
+        if self.m._optimizer is not None and hasattr(
+                self.m._optimizer, "state_dict"):
+            try:
+                dygraph.save_dygraph(self.m._optimizer.state_dict(), path)
+            except Exception:
+                pass
+
+    def load(self, path):
+        params, _ = dygraph.load_dygraph(path)
+        self.m.network.set_state_dict(params)
+
+
+class _StaticGraphAdapter:
+    """Program-building execution (reference StaticGraphAdapter,
+    model.py:156): one train program (forward + loss + optimizer), an
+    eval clone taken BEFORE minimize, and a predict program; all three
+    share the startup program / scope so parameters are common."""
+
+    def __init__(self, model):
+        import paddle_tpu.fluid as fluid
+        from ..fluid import layers
+
+        self.m = model
+        m = model
+        if not m._inputs:
+            raise ValueError(
+                "static-graph Model needs inputs=[hapi.Input(...)] specs "
+                "(reference Model(network, inputs, labels) contract)")
+        # the network's Layers created their parameter VARS in the
+        # default main program (and init ops in the default startup) at
+        # construction time — CLONE both so this model's forward/loss/
+        # optimizer ops live in a private program and a second static
+        # Model in the same process cannot collide
+        self.main = fluid.default_main_program().clone()
+        self.startup = fluid.default_startup_program().clone()
+        self.scope = fluid.Scope()
+        with fluid.program_guard(self.main, self.startup):
+            in_vars = [
+                spec._to_feed_var("hapi_x%d" % i)
+                for i, spec in enumerate(m._inputs)
+            ]
+            label_vars = [
+                spec._to_feed_var("hapi_y%d" % i)
+                for i, spec in enumerate(m._labels or [])
+            ]
+            pred = m.network(*in_vars)
+            self._pred_name = pred.name
+            self._feed_names = [v.name for v in in_vars]
+            self._label_names = [v.name for v in label_vars]
+            # predict/eval program: forward only, cloned before backward
+            self.test_prog = self.main.clone(for_test=True)
+            if m._loss is not None:
+                loss = m._loss(pred, *label_vars)
+                self._loss_name = loss.name
+                # eval clone WITH loss but before optimizer ops
+                self.eval_prog = self.main.clone(for_test=True)
+                if m._optimizer is not None:
+                    m._optimizer.minimize(loss)
+        self.exe = fluid.Executor()
+        with fluid.scope_guard(self.scope):
+            self.exe.run(self.startup)
+
+    def _feed(self, inputs, labels=None):
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        feed = {n: np.asarray(a) for n, a in zip(self._feed_names, ins)}
+        if labels is not None:
+            labs = labels if isinstance(labels, (list, tuple)) else [labels]
+            feed.update({
+                n: np.asarray(a) for n, a in zip(self._label_names, labs)
+            })
+        return feed
+
+    def train_batch(self, inputs, labels):
+        import paddle_tpu.fluid as fluid
+
+        with fluid.scope_guard(self.scope):
+            loss, pred = self.exe.run(
+                self.main, feed=self._feed(inputs, labels),
+                fetch_list=[self._loss_name, self._pred_name])
+        return float(np.mean(loss)), np.asarray(pred)
+
+    def eval_batch(self, inputs, labels):
+        import paddle_tpu.fluid as fluid
+
+        with fluid.scope_guard(self.scope):
+            loss, pred = self.exe.run(
+                self.eval_prog, feed=self._feed(inputs, labels),
+                fetch_list=[self._loss_name, self._pred_name])
+        return float(np.mean(loss)), np.asarray(pred)
+
+    def predict_batch(self, inputs):
+        import paddle_tpu.fluid as fluid
+
+        with fluid.scope_guard(self.scope):
+            (pred,) = self.exe.run(
+                self.test_prog, feed=self._feed(inputs),
+                fetch_list=[self._pred_name])
+        return np.asarray(pred)
+
+    def save(self, path):
+        state = {
+            n: np.asarray(self.scope.find_var(n))
+            for n in self.scope.local_names()
+            if self.scope.has(n)
+        }
+        np.savez(path + ".pdparams.npz", **state)
+
+    def load(self, path):
+        data = np.load(path + ".pdparams.npz")
+        for n in data.files:
+            self.scope.set(n, data[n])
+
+
+def _wrap_vars(inputs):
+    """A network may take one array or a list of feature arrays."""
+    if isinstance(inputs, (list, tuple)):
+        return [to_variable(np.asarray(a)) for a in inputs]
+    return [to_variable(np.asarray(inputs))]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _as_spec_list(inputs)
+        self._labels = _as_spec_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._adapter = None
+        self.stop_training = False  # set by EarlyStopping
+
+    @property
+    def mode(self):
+        return "dygraph" if isinstance(self._adapter, _DygraphAdapter) \
+            else "static"
+
+    def prepare(self, optimizer=None, loss_function=None, metrics=None):
+        """cf. reference Model.prepare(optimizer, loss, metrics); picks
+        the adapter from the CURRENT execution mode (in_dygraph_mode)."""
+        from ..fluid import framework
+
+        self._optimizer = optimizer
+        self._loss = loss_function
+        self._metrics = list(metrics or [])
+        if framework.in_dygraph_mode():
+            self._adapter = _DygraphAdapter(self)
+        else:
+            self._adapter = _StaticGraphAdapter(self)
+        return self
+
+    def _ensure_prepared(self):
+        if self._adapter is None:
+            raise RuntimeError("call Model.prepare(...) before training")
+
+    # -- steps ----------------------------------------------------------
+    def train_batch(self, inputs, labels):
+        self._ensure_prepared()
+        return self._adapter.train_batch(inputs, labels)
+
+    def eval_batch(self, inputs, labels):
+        self._ensure_prepared()
+        return self._adapter.eval_batch(inputs, labels)
+
+    def predict_batch(self, inputs):
+        self._ensure_prepared()
+        return self._adapter.predict_batch(inputs)
 
     # -- loops ----------------------------------------------------------
     def fit(self, train_data, eval_data=None, batch_size=32, epochs=1,
@@ -87,6 +258,7 @@ class Model:
         """cf. reference Model.fit: epochs over train_data with eval every
         `eval_freq` epochs, callbacks driving logging/checkpoint/early
         stop (reference model.py fit + callbacks.py)."""
+        self._ensure_prepared()
         cbs = list(callbacks or [])
         if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
             cbs.append(ProgBarLogger(log_freq=log_freq, verbose=verbose))
@@ -99,6 +271,8 @@ class Model:
             for c in cbs:
                 c.on_epoch_begin(epoch)
             losses = []
+            for m in self._metrics:
+                m.reset()
             for step, (bx, by) in enumerate(
                 _to_batches(train_data, batch_size, shuffle, seed=epoch)
             ):
@@ -126,6 +300,7 @@ class Model:
         return history
 
     def evaluate(self, eval_data, batch_size=32, verbose=0):
+        self._ensure_prepared()
         losses = []
         for m in self._metrics:
             m.reset()
@@ -138,6 +313,7 @@ class Model:
         return out
 
     def predict(self, test_data, batch_size=32):
+        self._ensure_prepared()
         outs = []
         n = len(test_data)
         for i in range(0, n, batch_size):
@@ -161,16 +337,92 @@ class Model:
     def _eval_metrics(self):
         out = {}
         for m in self._metrics:
+            name = getattr(m, "name", None) or getattr(m, "_name", "metric")
             try:
-                out[m._name] = m.eval()
+                val = (m.accumulate() if hasattr(m, "accumulate")
+                       else m.eval())
             except ValueError:
-                pass  # metric saw no batches
+                continue  # metric saw no batches
+            out[name if isinstance(name, str) else "metric"] = val
         return out
+
+    def get_weights(self):
+        """Mode-agnostic snapshot of all parameter arrays (used by
+        EarlyStopping best-weight restore)."""
+        self._ensure_prepared()
+        if isinstance(self._adapter, _DygraphAdapter):
+            return {k: np.asarray(v.data)
+                    for k, v in self.network.state_dict().items()}
+        sc = self._adapter.scope
+        return {n: np.asarray(sc.find_var(n))
+                for n in sc.local_names() if sc.has(n)}
+
+    def set_weights(self, weights):
+        self._ensure_prepared()
+        if isinstance(self._adapter, _DygraphAdapter):
+            sd = self.network.state_dict()
+            for k, v in weights.items():
+                if k in sd:
+                    import jax.numpy as jnp
+
+                    sd[k].data = jnp.asarray(v)
+            return
+        for n, v in weights.items():
+            self._adapter.scope.set(n, v)
+
+    def summary(self, input_shapes=None):
+        """Per-layer parameter table (reference Model.summary)."""
+        return summary(self.network, input_shapes)
 
     # -- persistence ----------------------------------------------------
     def save(self, path):
-        dygraph.save_dygraph(self.network.state_dict(), path)
+        self._ensure_prepared()
+        self._adapter.save(path)
 
     def load(self, path):
-        params, _ = dygraph.load_dygraph(path)
-        self.network.set_state_dict(params)
+        self._ensure_prepared()
+        self._adapter.load(path)
+
+
+def _as_spec_list(specs):
+    if specs is None:
+        return []
+    if isinstance(specs, Input):
+        return [specs]
+    return list(specs)
+
+
+def summary(network, input_shapes=None):
+    """cf. reference (2.0) paddle.summary / hapi Model.summary: per-layer
+    parameter table + totals for a dygraph Layer tree."""
+    rows = []
+    total = 0
+    trainable = 0
+
+    def visit(layer, prefix):
+        nonlocal total, trainable
+        own = 0
+        for name, p in layer._parameters.items() if hasattr(
+                layer, "_parameters") else []:
+            n = int(np.prod(p.shape))
+            own += n
+            total += n
+            if not getattr(p, "stop_gradient", False):
+                trainable += n
+        rows.append((prefix or type(layer).__name__,
+                     type(layer).__name__, own))
+        for name, sub in getattr(layer, "_sub_layers", {}).items():
+            visit(sub, "%s/%s" % (prefix, name) if prefix else name)
+
+    visit(network, "")
+    lines = ["%-40s %-20s %12s" % ("Layer (path)", "Type", "Params"),
+             "-" * 74]
+    for path, ty, n in rows:
+        lines.append("%-40s %-20s %12d" % (path[:40], ty[:20], n))
+    lines.append("-" * 74)
+    lines.append("Total params: %d" % total)
+    lines.append("Trainable params: %d" % trainable)
+    text = "\n".join(lines)
+    print(text)
+    return {"total_params": total, "trainable_params": trainable,
+            "layers": len(rows)}

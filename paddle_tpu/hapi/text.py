@@ -1,8 +1,154 @@
-"""hapi.text: text model zoo exposure (cf. reference
-`incubate/hapi/text/` bert/transformer modules)."""
+"""hapi.text: text encoders + classification heads (cf. reference
+`incubate/hapi/text/text.py` — BasicLSTMCell/BasicGRUCell/RNN encoders,
+CNNEncoder, BOWEncoder — plus the large pretrained models re-exported
+from the zoo).
 
+Each encoder is a dygraph Layer mapping padded id batches [B, T]
+(+ optional seq_lens) to a fixed-size representation [B, D]; the
+`TextClassifier` head composes any encoder with an MLP classifier — the
+reference's sentiment / pairwise-matching model skeletons."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..fluid import dygraph, layers
+from ..fluid.layer_helper import ParamAttr
 from ..models.bert import BertConfig, BertForPretraining, BertModel
 from ..models.transformer import Transformer, TransformerConfig
 
-__all__ = ["BertConfig", "BertModel", "BertForPretraining",
-           "Transformer", "TransformerConfig"]
+__all__ = [
+    "BOWEncoder", "CNNEncoder", "GRUEncoder", "LSTMEncoder",
+    "TextClassifier",
+    "BertConfig", "BertModel", "BertForPretraining",
+    "Transformer", "TransformerConfig",
+]
+
+
+def _mask(ids, pad_id):
+    m = layers.cast(layers.not_equal(
+        ids, layers.fill_constant_batch_size_like(
+            ids, [-1, 1], "int64", pad_id)), "float32")
+    return layers.unsqueeze(m, [2])            # [B, T, 1]
+
+
+class BOWEncoder(dygraph.Layer):
+    """Bag-of-words: masked mean of embeddings (cf. reference
+    BOWEncoder)."""
+
+    def __init__(self, vocab_size, emb_dim, pad_id=0):
+        super().__init__()
+        self.emb = dygraph.Embedding([vocab_size, emb_dim])
+        self.pad_id = pad_id
+        self.output_dim = emb_dim
+
+    def forward(self, ids, seq_lens=None):
+        e = self.emb(ids)                       # [B, T, E]
+        m = _mask(ids, self.pad_id)
+        summed = layers.reduce_sum(e * m, dim=1)
+        denom = layers.reduce_sum(m, dim=1) + 1e-6
+        return summed / denom
+
+
+class CNNEncoder(dygraph.Layer):
+    """Conv-over-time + max pool (cf. reference CNNEncoder: one Conv2D
+    over the [B, 1, T, E] view with a full-width kernel)."""
+
+    def __init__(self, vocab_size, emb_dim, num_filters=64, filter_size=3,
+                 pad_id=0):
+        super().__init__()
+        self.emb = dygraph.Embedding([vocab_size, emb_dim])
+        self.conv = dygraph.Conv2D(
+            1, num_filters, (filter_size, emb_dim),
+            padding=(filter_size // 2, 0))
+        self.pad_id = pad_id
+        self.output_dim = num_filters
+
+    def forward(self, ids, seq_lens=None):
+        e = self.emb(ids)                       # [B, T, E]
+        m = _mask(ids, self.pad_id)
+        e = e * m
+        h = self.conv(layers.unsqueeze(e, [1]))  # [B, F, T', 1]
+        h = layers.relu(h)
+        return layers.reduce_max(h, dim=[2, 3])  # [B, F]
+
+
+class GRUEncoder(dygraph.Layer):
+    """Embedding -> projection -> dynamic GRU, last state (cf. reference
+    DynamicGRU-based encoders)."""
+
+    def __init__(self, vocab_size, emb_dim, hidden, pad_id=0):
+        super().__init__()
+        self.emb = dygraph.Embedding([vocab_size, emb_dim])
+        self.proj = dygraph.Linear(emb_dim, 3 * hidden, bias_attr=False)
+        self.hidden = hidden
+        self.pad_id = pad_id
+        self.output_dim = hidden
+        h = hidden
+        std = 1.0 / np.sqrt(h)
+        from ..fluid.initializer import UniformInitializer
+
+        self.w = self.create_parameter(
+            [h, 3 * h],
+            attr=ParamAttr(initializer=UniformInitializer(-std, std)))
+        self.b = self.create_parameter([1, 3 * h], is_bias=True)
+
+    def forward(self, ids, seq_lens=None):
+        from ..fluid.layers.common import append_simple_op
+
+        x = self.proj(self.emb(ids))            # [B, T, 3H]
+        ins = {"Input": x, "Weight": self.w, "Bias": self.b}
+        if seq_lens is not None:
+            ins["SeqLens"] = seq_lens
+        hidden, last = append_simple_op(
+            "gru", ins, {}, out_slots=("Hidden", "LastH"))
+        return last
+
+
+class LSTMEncoder(dygraph.Layer):
+    """Embedding -> projection -> LSTM, last hidden (cf. reference
+    BasicLSTMCell/RNN encoder)."""
+
+    def __init__(self, vocab_size, emb_dim, hidden, pad_id=0):
+        super().__init__()
+        self.emb = dygraph.Embedding([vocab_size, emb_dim])
+        self.proj = dygraph.Linear(emb_dim, 4 * hidden, bias_attr=False)
+        self.hidden = hidden
+        self.pad_id = pad_id
+        self.output_dim = hidden
+        h = hidden
+        std = 1.0 / np.sqrt(h)
+        from ..fluid.initializer import UniformInitializer
+
+        self.w = self.create_parameter(
+            [h, 4 * h],
+            attr=ParamAttr(initializer=UniformInitializer(-std, std)))
+        self.b = self.create_parameter([1, 4 * h], is_bias=True)
+
+    def forward(self, ids, seq_lens=None):
+        from ..fluid.layers.common import append_simple_op
+
+        x = self.proj(self.emb(ids))            # [B, T, 4H]
+        ins = {"Input": x, "Weight": self.w, "Bias": self.b}
+        if seq_lens is not None:
+            ins["SeqLens"] = seq_lens
+        hidden, cell, last_h, last_c = append_simple_op(
+            "lstm", ins, {}, out_slots=("Hidden", "Cell", "LastH", "LastC"))
+        return last_h
+
+
+class TextClassifier(dygraph.Layer):
+    """Encoder + MLP head (cf. reference hapi text model skeletons:
+    sentiment classifier over any encoder)."""
+
+    def __init__(self, encoder, num_classes, hidden=None):
+        super().__init__()
+        self.encoder = encoder
+        d = encoder.output_dim
+        h = hidden or max(d // 2, num_classes * 2)
+        self.fc1 = dygraph.Linear(d, h, act="relu")
+        self.fc2 = dygraph.Linear(h, num_classes)
+
+    def forward(self, ids, seq_lens=None):
+        rep = self.encoder(ids, seq_lens)
+        return self.fc2(self.fc1(rep))

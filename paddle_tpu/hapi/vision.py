@@ -1,6 +1,16 @@
-"""hapi.vision: model zoo + transforms exposure (cf. reference
+"""hapi.vision: model zoo + a real transforms pipeline (cf. reference
 `incubate/hapi/vision/models/` lenet/resnet/vgg/mobilenet and
-`vision/transforms/`)."""
+`incubate/hapi/vision/transforms/transforms.py`).
+
+Transforms are CLASS pipelines over per-sample numpy images — CHW float
+arrays (the repo-wide layout) — composable with `Compose`; the legacy
+batch-functional helpers (`normalize`/`resize` staticmethods) remain for
+back-compat.
+"""
+
+from __future__ import annotations
+
+import numpy as np
 
 from ..models.lenet import LeNet5
 from ..models.mobilenet import MobileNetV1, mobilenet_v1
@@ -14,14 +24,192 @@ __all__ = ["LeNet", "LeNet5", "ResNet", "resnet18", "resnet34",
            "MobileNetV1", "mobilenet_v1", "transforms"]
 
 
+def _chw(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[None]
+    return img
+
+
+class _Transform:
+    def __call__(self, img):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class Compose(_Transform):
+    """cf. reference transforms.Compose."""
+
+    def __init__(self, fns):
+        self.fns = list(fns)
+
+    def __call__(self, img):
+        for f in self.fns:
+            img = f(img)
+        return img
+
+
+class Resize(_Transform):
+    """Bilinear resize to (h, w) (cf. transforms.Resize)."""
+
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        import jax
+
+        img = _chw(img).astype(np.float32)
+        c = img.shape[0]
+        return np.asarray(jax.image.resize(
+            img, (c,) + self.size, method="linear"))
+
+
+class CenterCrop(_Transform):
+    """cf. transforms.CenterCrop."""
+
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = _chw(img)
+        h, w = img.shape[1:]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return img[:, i:i + th, j:j + tw]
+
+
+class RandomCrop(_Transform):
+    """cf. transforms.RandomCrop (optional zero padding first)."""
+
+    def __init__(self, size, padding=0, seed=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = int(padding)
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        img = _chw(img)
+        if self.padding:
+            p = self.padding
+            img = np.pad(img, ((0, 0), (p, p), (p, p)))
+        h, w = img.shape[1:]
+        th, tw = self.size
+        i = self._rng.randint(0, max(h - th, 0) + 1)
+        j = self._rng.randint(0, max(w - tw, 0) + 1)
+        return img[:, i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(_Transform):
+    """cf. transforms.RandomHorizontalFlip."""
+
+    def __init__(self, prob=0.5, seed=None):
+        self.prob = float(prob)
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        img = _chw(img)
+        if self._rng.rand() < self.prob:
+            return img[:, :, ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip(_Transform):
+    def __init__(self, prob=0.5, seed=None):
+        self.prob = float(prob)
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        img = _chw(img)
+        if self._rng.rand() < self.prob:
+            return img[:, ::-1, :].copy()
+        return img
+
+
+class BrightnessTransform(_Transform):
+    """cf. transforms.BrightnessTransform: scale by U[max(0,1-v), 1+v]."""
+
+    def __init__(self, value, seed=None):
+        self.value = float(value)
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        a = self._rng.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return _chw(img).astype(np.float32) * a
+
+
+class ContrastTransform(_Transform):
+    """cf. transforms.ContrastTransform: blend with the mean."""
+
+    def __init__(self, value, seed=None):
+        self.value = float(value)
+        self._rng = np.random.RandomState(seed)
+
+    def __call__(self, img):
+        img = _chw(img).astype(np.float32)
+        a = self._rng.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return img * a + img.mean() * (1 - a)
+
+
+class ColorJitter(_Transform):
+    """Brightness + contrast jitter (cf. transforms.ColorJitter, minus
+    the HSV hue/saturation legs which need color-space conversion)."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, seed=None):
+        self._t = Compose([
+            BrightnessTransform(brightness, seed=seed),
+            ContrastTransform(
+                contrast, seed=None if seed is None else seed + 1),
+        ])
+
+    def __call__(self, img):
+        return self._t(img)
+
+
+class Normalize(_Transform):
+    """cf. transforms.Normalize: per-channel (x - mean) / std."""
+
+    def __init__(self, mean, std):
+        self.mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self.std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def __call__(self, img):
+        return (_chw(img).astype(np.float32) - self.mean) / self.std
+
+
+class Permute(_Transform):
+    """HWC -> CHW (cf. transforms.Permute)."""
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if img.ndim == 2:
+            return img[None].astype(np.float32)
+        return np.transpose(img, (2, 0, 1)).astype(np.float32)
+
+
+ToTensor = Permute  # 2.0 name: HWC uint8/float -> CHW float
+
+
 class transforms:
-    """Minimal functional transforms (cf. hapi/vision/transforms):
-    compose, normalize, resize over numpy batches."""
+    """Namespace matching `hapi.vision.transforms`: the transform classes
+    above plus the legacy batch-functional helpers."""
+
+    Compose = Compose
+    Resize = Resize
+    CenterCrop = CenterCrop
+    RandomCrop = RandomCrop
+    RandomHorizontalFlip = RandomHorizontalFlip
+    RandomVerticalFlip = RandomVerticalFlip
+    BrightnessTransform = BrightnessTransform
+    ContrastTransform = ContrastTransform
+    ColorJitter = ColorJitter
+    Normalize = Normalize
+    Permute = Permute
+    ToTensor = ToTensor
 
     @staticmethod
     def normalize(x, mean, std):
-        import numpy as np
-
         mean = np.asarray(mean, np.float32).reshape(1, -1, 1, 1)
         std = np.asarray(std, np.float32).reshape(1, -1, 1, 1)
         return (np.asarray(x, np.float32) - mean) / std
@@ -29,18 +217,8 @@ class transforms:
     @staticmethod
     def resize(x, size):
         import jax
-        import numpy as np
 
         x = np.asarray(x, np.float32)
         n, c = x.shape[:2]
         return np.asarray(jax.image.resize(
             x, (n, c, size[0], size[1]), method="linear"))
-
-    class Compose:
-        def __init__(self, fns):
-            self.fns = list(fns)
-
-        def __call__(self, x):
-            for f in self.fns:
-                x = f(x)
-            return x

@@ -5,14 +5,113 @@ classes (scalar-accumulating) remain available under their names."""
 import numpy as np
 
 from ..fluid.metrics import (  # noqa: F401
-    Auc,
     CompositeMetric,
     MetricBase,
-    Precision,
-    Recall,
 )
 
 Metric = MetricBase  # 2.0 alias
+
+
+class Precision:
+    """cf. paddle.metric.Precision (2.0): binary precision over
+    (pred, label) batches — pred is a probability/score in [0, 1] (or
+    logits thresholded at 0.5 after sigmoid-free comparison with 0.5),
+    label is 0/1."""
+
+    def __init__(self, name="precision"):
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds.numpy() if hasattr(preds, "numpy") else preds)
+        y = np.asarray(
+            labels.numpy() if hasattr(labels, "numpy") else labels
+        ).reshape(-1)
+        pos = (p.reshape(-1) > 0.5)
+        self.tp += int(np.sum(pos & (y == 1)))
+        self.fp += int(np.sum(pos & (y != 1)))
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+    eval = accumulate
+
+
+class Recall:
+    """cf. paddle.metric.Recall (2.0)."""
+
+    def __init__(self, name="recall"):
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds.numpy() if hasattr(preds, "numpy") else preds)
+        y = np.asarray(
+            labels.numpy() if hasattr(labels, "numpy") else labels
+        ).reshape(-1)
+        pos = (p.reshape(-1) > 0.5)
+        self.tp += int(np.sum(pos & (y == 1)))
+        self.fn += int(np.sum(~pos & (y == 1)))
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+    eval = accumulate
+
+
+class Auc:
+    """cf. paddle.metric.Auc (2.0): histogram-bucketed ROC AUC over
+    (pred [N, 2] or [N], label) batches."""
+
+    def __init__(self, num_thresholds=4095, name="auc"):
+        self.num_thresholds = int(num_thresholds)
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        n = self.num_thresholds + 1
+        self._pos = np.zeros(n, np.int64)
+        self._neg = np.zeros(n, np.int64)
+
+    def update(self, preds, labels):
+        p = np.asarray(preds.numpy() if hasattr(preds, "numpy") else preds)
+        y = np.asarray(
+            labels.numpy() if hasattr(labels, "numpy") else labels
+        ).reshape(-1)
+        if p.ndim == 2 and p.shape[1] == 2:
+            p = p[:, 1]
+        p = p.reshape(-1)
+        idx = np.clip((p * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        np.add.at(self._pos, idx[y == 1], 1)
+        np.add.at(self._neg, idx[y != 1], 1)
+
+    def accumulate(self):
+        # sum over buckets of trapezoid areas, descending threshold
+        tot_pos = self._pos.sum()
+        tot_neg = self._neg.sum()
+        if not tot_pos or not tot_neg:
+            return 0.0
+        tp = np.cumsum(self._pos[::-1])
+        fp = np.cumsum(self._neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        tpr = np.concatenate([[0.0], tpr])
+        fpr = np.concatenate([[0.0], fpr])
+        return float(np.sum((fpr[1:] - fpr[:-1])
+                            * (tpr[1:] + tpr[:-1]) / 2.0))
+
+    eval = accumulate
 
 
 class Accuracy:

@@ -379,9 +379,6 @@ def test_convert_call_distinct_closures_and_methods():
         out = f(to_variable(np.zeros((2,), np.float32)))
         assert float(np.asarray(out.data)[0]) == pytest.approx(3.0)
 
-    def test_list_aliasing():
-        pass
-
     @declarative
     def g(x):
         acc = []

@@ -101,3 +101,200 @@ def test_hapi_text_and_vision_zoo_exposed():
     assert n.shape == x.shape
     r = hapi.vision.transforms.resize(x, (16, 16))
     assert r.shape == (2, 3, 16, 16)
+
+
+# ---------------------------------------------------------------------------
+# round-4: static-graph adapter, transforms pipeline, text encoders,
+# 2.0 metric classes (reference incubate/hapi/model.py StaticGraphAdapter,
+# vision/transforms/transforms.py, text/text.py, paddle/metric/metrics.py)
+# ---------------------------------------------------------------------------
+
+
+def _mnist_arrays(n=128, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.rand(n, 1, 28, 28).astype(np.float32)
+    ys = rng.randint(0, 10, (n, 1)).astype(np.int64)
+    # plant a learnable signal: class k brightens a distinct patch
+    for i in range(n):
+        k = ys[i, 0]
+        xs[i, 0, k * 2:(k + 1) * 2 + 2, :8] += 2.0
+    return xs, ys
+
+
+def _ce_loss(pred, label):
+    from paddle_tpu.fluid import layers
+
+    return layers.mean(layers.softmax_with_cross_entropy(pred, label))
+
+
+def test_hapi_static_mode_fit_mnist(tmp_path):
+    """Model.fit in STATIC mode (no dygraph guard): programs built from
+    Input specs, trained via Executor, save/load round trip."""
+    from paddle_tpu import hapi
+    from paddle_tpu.models.lenet import LeNet5
+
+    xs, ys = _mnist_arrays()
+    net = LeNet5(num_classes=10)
+    model = hapi.Model(
+        net,
+        inputs=[hapi.Input([None, 1, 28, 28], "float32", "img")],
+        labels=[hapi.Input([None, 1], "int64", "lbl")],
+    )
+    import paddle_tpu.fluid as fluid
+
+    model.prepare(optimizer=fluid.optimizer.AdamOptimizer(2e-3),
+                  loss_function=_ce_loss,
+                  metrics=[fluid.metrics.Accuracy()])
+    assert model.mode == "static"
+    hist = model.fit((xs, ys), batch_size=32, epochs=4, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0] * 0.7
+    ev = model.evaluate((xs, ys), batch_size=64)
+    assert ev["loss"] < hist["loss"][0]
+    pred = model.predict(xs[:16], batch_size=8)
+    assert pred.shape == (16, 10)
+    model.save(str(tmp_path / "static_ck"))
+    # perturb then restore
+    import numpy as _np
+
+    model._adapter.scope.set(
+        net.state_dict() and list(model._adapter.scope.local_names())[0],
+        _np.zeros_like(_np.asarray(model._adapter.scope.find_var(
+            list(model._adapter.scope.local_names())[0]))))
+    model.load(str(tmp_path / "static_ck"))
+    ev2 = model.evaluate((xs, ys), batch_size=64)
+    assert abs(ev2["loss"] - ev["loss"]) < 1e-4
+
+
+def test_hapi_both_modes_same_api(tmp_path):
+    """The SAME fit() call trains in dygraph mode under the guard."""
+    from paddle_tpu import hapi
+    from paddle_tpu.fluid import dygraph
+    from paddle_tpu.models.lenet import LeNet5
+    import paddle_tpu.fluid as fluid
+
+    xs, ys = _mnist_arrays(n=64, seed=1)
+    with dygraph.guard():
+        model = hapi.Model(LeNet5(num_classes=10))
+        model.prepare(optimizer=fluid.optimizer.AdamOptimizer(2e-3),
+                      loss_function=_ce_loss)
+        assert model.mode == "dygraph"
+        hist = model.fit((xs, ys), batch_size=32, epochs=3, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_vision_transform_pipeline():
+    from paddle_tpu.hapi.vision import transforms as T
+
+    img = np.random.RandomState(0).rand(28, 28, 3).astype(np.float32)
+    pipe = T.Compose([
+        T.ToTensor(),                     # HWC -> CHW
+        T.Resize(32),
+        T.RandomCrop(28, padding=2, seed=3),
+        T.RandomHorizontalFlip(prob=1.0),
+        T.ColorJitter(brightness=0.2, contrast=0.2, seed=5),
+        T.Normalize([0.5] * 3, [0.25] * 3),
+    ])
+    out = pipe(img)
+    assert out.shape == (3, 28, 28)
+    # deterministic flip: applying twice with prob=1 restores orientation
+    f = T.RandomHorizontalFlip(prob=1.0)
+    x = T.ToTensor()(img)
+    np.testing.assert_allclose(f(f(x)), x)
+    c = T.CenterCrop(20)(x)
+    assert c.shape == (3, 20, 20)
+
+
+def test_text_encoders_train():
+    from paddle_tpu import hapi
+    from paddle_tpu.fluid import dygraph
+    from paddle_tpu.hapi.text import (
+        BOWEncoder, CNNEncoder, GRUEncoder, LSTMEncoder, TextClassifier)
+    import paddle_tpu.fluid as fluid
+
+    rng = np.random.RandomState(0)
+    V, T, n = 50, 12, 96
+    xs = rng.randint(2, V, (n, T)).astype(np.int64)
+    ys = (xs[:, 0] % 2).reshape(-1, 1).astype(np.int64)  # first-token parity
+
+    for enc_cls in (BOWEncoder, CNNEncoder, GRUEncoder, LSTMEncoder):
+        with dygraph.guard():
+            enc = (enc_cls(V, 16) if enc_cls in (BOWEncoder, CNNEncoder)
+                   else enc_cls(V, 16, 24))
+            net = TextClassifier(enc, num_classes=2)
+            model = hapi.Model(net)
+            model.prepare(optimizer=fluid.optimizer.AdamOptimizer(5e-3),
+                          loss_function=_ce_loss)
+            hist = model.fit((xs, ys), batch_size=32, epochs=3, verbose=0)
+            assert hist["loss"][-1] < hist["loss"][0], enc_cls.__name__
+
+
+def test_metric_20_classes():
+    from paddle_tpu import metric
+
+    p = metric.Precision()
+    r = metric.Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.6])
+    labels = np.array([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert p.accumulate() == pytest.approx(2 / 3)   # tp=2 (0.9,0.6), fp=1
+    assert r.accumulate() == pytest.approx(2 / 3)   # fn=1 (0.2)
+    a = metric.Auc()
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 2, 2000)
+    scores = np.clip(y * 0.6 + rng.rand(2000) * 0.5, 0, 1)  # informative
+    a.update(scores, y)
+    assert 0.8 < a.accumulate() <= 1.0
+    # chance-level scores ~ 0.5
+    a.reset()
+    a.update(rng.rand(2000), y)
+    assert 0.4 < a.accumulate() < 0.6
+
+
+def test_summary_and_new_callbacks(tmp_path):
+    from paddle_tpu import hapi
+    from paddle_tpu.fluid import dygraph
+    from paddle_tpu.models.lenet import LeNet5
+    import paddle_tpu.fluid as fluid
+
+    xs, ys = _mnist_arrays(n=64, seed=2)
+    csv = tmp_path / "log.csv"
+    with dygraph.guard():
+        net = LeNet5(num_classes=10)
+        info = hapi.summary(net)
+        assert info["total_params"] > 10000 and info["layers"] >= 4
+        model = hapi.Model(net)
+        opt = fluid.optimizer.SGDOptimizer(0.5)
+        model.prepare(optimizer=opt, loss_function=_ce_loss)
+        model.fit((xs, ys), batch_size=32, epochs=6, verbose=0,
+                  callbacks=[
+                      hapi.ReduceLROnPlateau(patience=0, factor=0.5,
+                                             monitor="loss"),
+                      hapi.CSVLogger(str(csv)),
+                  ])
+        lines = csv.read_text().strip().splitlines()
+        assert lines[0].startswith("epoch") and len(lines) >= 3
+
+
+def test_two_static_models_coexist():
+    """Private program clones: a second static Model trains without
+    colliding with the first (review regression)."""
+    from paddle_tpu import hapi
+    from paddle_tpu.models.lenet import LeNet5
+    import paddle_tpu.fluid as fluid
+
+    xs, ys = _mnist_arrays(n=32, seed=3)
+
+    def make():
+        m = hapi.Model(
+            LeNet5(num_classes=10),
+            inputs=[hapi.Input([None, 1, 28, 28], "float32")],
+            labels=[hapi.Input([None, 1], "int64")])
+        m.prepare(optimizer=fluid.optimizer.AdamOptimizer(1e-3),
+                  loss_function=_ce_loss)
+        return m
+
+    m1, m2 = make(), make()
+    l1, _ = m1.train_batch(xs, ys)
+    l2, _ = m2.train_batch(xs, ys)
+    assert np.isfinite(l1) and np.isfinite(l2)
