@@ -579,3 +579,48 @@ def auc(input, label, num_thresholds=4095, topk=1, slide_steps=1):
     helper.main_program.current_block().append_op(
         "assign", inputs={"X": [neg_out.name]}, outputs={"Out": [names[1]]})
     return auc_out, [pos_out, neg_out]
+
+
+def create_tmp_var(name, dtype, shape):
+    """Pre-create an output Variable for py_func (reference test helper
+    pattern, `tests/unittests/test_py_func_op.py`)."""
+    from .. import framework
+
+    block = framework.default_main_program().current_block()
+    return block.create_var(name=name, shape=list(shape), dtype=dtype)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """cf. reference layers.py_func (`operators/py_func_op.cc`): run a
+    user Python callable as a graph op via a host callback.
+
+    x: Variable or list of Variables (forward inputs); out: pre-created
+    Variable(s) declaring the output shapes/dtypes (`create_tmp_var`);
+    backward_func(*inputs, *outputs, *out_grads) -> input grads enables
+    gradients through the op (without it, grads stop).  The callables
+    live in a process-global registry (ids in the op attrs), so programs
+    with py_func replay in-process only — the reference limitation."""
+    from ..layer_helper import LayerHelper
+    from ..ops.py_func_op import register_callables
+
+    if skip_vars_in_backward_input:
+        raise NotImplementedError(
+            "py_func skip_vars_in_backward_input is not supported: the "
+            "backward callable always receives (*inputs, *outputs, "
+            "*out_grads); drop the unused args in backward_func instead")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    fid = register_callables(func, backward_func)
+    helper = LayerHelper("py_func")
+    helper.append_op(
+        type="py_func",
+        inputs={"X": [v.name for v in xs]},
+        outputs={"Out": [v.name for v in outs]},
+        attrs={
+            "func_id": fid,
+            "out_specs": [
+                (list(v.shape), str(v.dtype)) for v in outs
+            ],
+        },
+    )
+    return out

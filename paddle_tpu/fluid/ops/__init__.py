@@ -15,6 +15,7 @@ from . import (  # noqa: F401
     metric_ops,
     nn_ops,
     optimizer_ops,
+    py_func_op,
     quant_ops,
     random_ops,
     reduce_ops,

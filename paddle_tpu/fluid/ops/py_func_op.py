@@ -1,0 +1,129 @@
+"""py_func: user Python callables as graph ops (host callbacks).
+
+Capability parity: reference `operators/py_func_op.cc` +
+`layers/nn.py py_func` — the ONE place C++ calls back into Python.
+TPU-first: the forward callable runs through `jax.pure_callback` (XLA
+host callback with declared output shapes/dtypes); a registered
+`backward_func` becomes the op's custom VJP, itself a pure_callback.
+Like the reference (which registers callables in a process-global table
+keyed by an integer id, py_func_op.cc PyFuncRegistry), programs carrying
+py_func ops serialize the ID only — they replay in-process but not
+across processes.
+
+This is also the template for the CUSTOM-OP story: `register_op` (see
+`core/registry.py`) is the public extension point — a user module can
+register a new op type with a JAX lowering (grads via JAX AD or a
+custom_vjp inside the lowering) and drive it from layers; see
+tests/test_py_func_and_custom_op.py for the worked example (reference
+`tests/custom_op/`)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+# process-global callable table (reference PyFuncRegistry).  Re-registering
+# the SAME (forward, backward) pair returns the existing id, so rebuilding
+# a program in a loop does not grow the table; truly distinct closures do
+# accumulate for the process lifetime (the reference has the same
+# property) — clear_registry() is the escape hatch for long-lived servers.
+_REGISTRY: dict = {}
+_IDS_BY_PAIR: dict = {}
+_NEXT_ID = [0]
+
+
+def register_callables(forward_fn, backward_fn=None):
+    """Register (forward, backward) callables; returns the integer id the
+    op's attrs carry."""
+    key = (id(forward_fn), id(backward_fn))
+    hit = _IDS_BY_PAIR.get(key)
+    if hit is not None and _REGISTRY.get(hit) == (forward_fn, backward_fn):
+        return hit
+    _NEXT_ID[0] += 1
+    _REGISTRY[_NEXT_ID[0]] = (forward_fn, backward_fn)
+    _IDS_BY_PAIR[key] = _NEXT_ID[0]
+    return _NEXT_ID[0]
+
+
+def clear_registry():
+    """Drop every registered callable (programs holding py_func ops stop
+    replaying afterwards)."""
+    _REGISTRY.clear()
+    _IDS_BY_PAIR.clear()
+
+
+def _as_arrays(vals):
+    return tuple(np.asarray(v) for v in vals)
+
+
+@register_op("py_func", inputs=["X"], outputs=["Out"])
+def _py_func(ctx, ins, attrs):
+    fid = int(attrs["func_id"])
+    if fid not in _REGISTRY:
+        raise RuntimeError(
+            "py_func callable id %d is not registered in this process "
+            "(py_func programs replay in-process only, like the "
+            "reference PyFuncRegistry)" % fid)
+    fwd, bwd = _REGISTRY[fid]
+    xs = tuple(ins["X"])
+    out_specs = attrs["out_specs"]  # [(shape, dtype), ...]
+    batch = int(xs[0].shape[0]) if xs and xs[0].ndim else 1
+
+    def _resolve(shp):
+        # -1 dims follow the first input's batch (batch_size_like rule)
+        return tuple(batch if int(d) < 0 else int(d) for d in shp)
+
+    structs = [
+        jax.ShapeDtypeStruct(_resolve(shp), np.dtype(dt))
+        for shp, dt in out_specs
+    ]
+
+    def host_fwd(*arrs):
+        res = fwd(*_as_arrays(arrs))
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(
+            np.asarray(r, dtype=s.dtype).reshape(s.shape)
+            for r, s in zip(res, structs)
+        )
+
+    def call_fwd(*xs_):
+        out = jax.pure_callback(host_fwd, tuple(structs), *xs_)
+        return tuple(out)
+
+    if bwd is None:
+        outs = call_fwd(*(jax.lax.stop_gradient(x) for x in xs))
+        return {"Out": list(outs)}
+
+    @jax.custom_vjp
+    def f(*xs_):
+        return call_fwd(*xs_)
+
+    def f_fwd(*xs_):
+        outs = call_fwd(*xs_)
+        return outs, (xs_, outs)
+
+    def f_bwd(saved, douts):
+        xs_, outs = saved
+        x_structs = tuple(
+            jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs_
+        )
+
+        def host_bwd(*arrs):
+            # reference convention: backward_func(*inputs, *outputs,
+            # *out_grads) -> grads for each input
+            res = bwd(*_as_arrays(arrs))
+            res = res if isinstance(res, (list, tuple)) else [res]
+            return tuple(
+                np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                for r, s in zip(res, x_structs)
+            )
+
+        gx = jax.pure_callback(
+            host_bwd, x_structs, *(xs_ + outs + tuple(douts)))
+        return tuple(gx)
+
+    f.defvjp(f_fwd, f_bwd)
+    return {"Out": list(f(*xs))}
