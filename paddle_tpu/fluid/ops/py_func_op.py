@@ -10,6 +10,13 @@ keyed by an integer id, py_func_op.cc PyFuncRegistry), programs carrying
 py_func ops serialize the ID only — they replay in-process but not
 across processes.
 
+Callables must be PURE (deterministic, side-effect-free): this
+framework's program-rewrite autodiff re-derives the forward inside the
+gradient computation, so with a backward_func the forward callback can
+run twice per step (XLA deduplicates identical callbacks when it can) —
+a stateful callable would hand backward_func outputs from a different
+invocation than the forward pass used.
+
 This is also the template for the CUSTOM-OP story: `register_op` (see
 `core/registry.py`) is the public extension point — a user module can
 register a new op type with a JAX lowering (grads via JAX AD or a
@@ -84,6 +91,11 @@ def _py_func(ctx, ins, attrs):
     def host_fwd(*arrs):
         res = fwd(*_as_arrays(arrs))
         res = res if isinstance(res, (list, tuple)) else [res]
+        if len(res) != len(structs):
+            raise ValueError(
+                "py_func forward returned %d output(s) but %d out var(s) "
+                "were declared (reference py_func_op.cc errors the same "
+                "way)" % (len(res), len(structs)))
         return tuple(
             np.asarray(r, dtype=s.dtype).reshape(s.shape)
             for r, s in zip(res, structs)
@@ -116,6 +128,10 @@ def _py_func(ctx, ins, attrs):
             # *out_grads) -> grads for each input
             res = bwd(*_as_arrays(arrs))
             res = res if isinstance(res, (list, tuple)) else [res]
+            if len(res) != len(x_structs):
+                raise ValueError(
+                    "py_func backward returned %d gradient(s) for %d "
+                    "input(s)" % (len(res), len(x_structs)))
             return tuple(
                 np.asarray(r, dtype=s.dtype).reshape(s.shape)
                 for r, s in zip(res, x_structs)

@@ -32,19 +32,126 @@ def start_profiler(state="All", tracer_option="Default", log_dir=None):
     _state["active"] = True
 
 
+def _collect_events(trace_dir):
+    """Parse the jax trace's .trace.json.gz files -> chrome trace events."""
+    import glob
+    import gzip
+    import json
+
+    events = []
+    for f in sorted(glob.glob(
+            os.path.join(trace_dir, "**", "*.trace.json.gz"),
+            recursive=True)):
+        try:
+            data = json.load(gzip.open(f))
+        except (OSError, ValueError):
+            continue
+        events.extend(data.get("traceEvents", []))
+    return events
+
+
+def _aggregate(events):
+    """Per-op totals from complete ('X') events, split host/device by the
+    process name metadata (the chrome-trace layout jax emits)."""
+    import re
+
+    pids = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pids[e.get("pid")] = (e.get("args") or {}).get("name", "")
+    rows = {}
+    for e in events:
+        if e.get("ph") != "X" or not e.get("name"):
+            continue
+        pname = pids.get(e.get("pid"), "")
+        dev = "TPU" in pname or "device" in pname.lower() \
+            or "GPU" in pname
+        base = re.sub(r"\.\d+$", "", e["name"])
+        key = (base, dev)
+        dur = float(e.get("dur", 0.0))
+        r = rows.get(key)
+        if r is None:
+            rows[key] = [1, dur, dur, dur]       # calls, total, min, max
+        else:
+            r[0] += 1
+            r[1] += dur
+            r[2] = min(r[2], dur)
+            r[3] = max(r[3], dur)
+    return rows
+
+
+_SORT_KEYS = {"total": 1, "calls": 0, "min": 2, "max": 3,
+              "default": 1, None: 1}
+
+
+def summary_table(trace_dir_or_events, sorted_key="total", max_rows=40):
+    """The reference's aggregated per-op profile table
+    (`platform/profiler.cc` PrintProfiler) from a captured trace (dir
+    path or pre-collected chrome events)."""
+    if sorted_key not in _SORT_KEYS and sorted_key != "ave":
+        raise ValueError(
+            "sorted_key must be one of total/calls/min/max/ave/default, "
+            "got %r (reference stop_profiler contract)" % (sorted_key,))
+    events = (trace_dir_or_events
+              if isinstance(trace_dir_or_events, list)
+              else _collect_events(trace_dir_or_events))
+    rows = _aggregate(events)
+    if not rows:
+        return "Profile: no events captured"
+
+    def keyfn(item):
+        (name, dev), r = item
+        if sorted_key == "ave":
+            return r[1] / max(r[0], 1)
+        return r[_SORT_KEYS.get(sorted_key, 1)]
+
+    items = sorted(rows.items(), key=keyfn, reverse=True)[:max_rows]
+    total_all = sum(r[1] for r in rows.values()) or 1.0
+    lines = [
+        "------------------------->     Profiling Report     "
+        "<-------------------------",
+        "%-44s %-6s %8s %12s %10s %10s %10s %8s"
+        % ("Event", "Place", "Calls", "Total(us)", "Min(us)", "Max(us)",
+           "Ave(us)", "Ratio"),
+    ]
+    for (name, dev), (calls, tot, mn, mx) in items:
+        lines.append(
+            "%-44s %-6s %8d %12.1f %10.1f %10.1f %10.1f %7.2f%%"
+            % (name[:44], "Device" if dev else "Host", calls, tot, mn, mx,
+               tot / max(calls, 1), 100.0 * tot / total_all))
+    return "\n".join(lines)
+
+
+def export_chrome_tracing(trace_dir_or_events, out_path):
+    """Write a plain chrome://tracing JSON (the reference
+    `tools/timeline.py:115` output format) from the captured trace (dir
+    path or pre-collected events)."""
+    import json
+
+    events = (trace_dir_or_events
+              if isinstance(trace_dir_or_events, list)
+              else _collect_events(trace_dir_or_events))
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return out_path
+
+
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
-    """cf. reference stop_profiler: ends the trace; the trace directory
-    path is recorded at `profile_path` (chrome://tracing-compatible
-    .trace.json.gz files live under it, cf. tools/timeline.py output)."""
+    """cf. reference stop_profiler(sorted_key, profile_path): ends the
+    trace, PRINTS the aggregated per-op table (sorted_key in
+    total/calls/min/max/ave, reference profiler.cc table), and writes a
+    chrome://tracing-loadable JSON to `profile_path` (the
+    tools/timeline.py output)."""
     import jax
 
     if not _state["active"]:
         return
     jax.profiler.stop_trace()
     _state["active"] = False
+    events = _collect_events(_state["dir"])   # parse the trace ONCE
+    print(summary_table(events, sorted_key or "total"))
     try:
-        with open(profile_path, "w") as f:
-            f.write(_state["dir"] or "")
+        export_chrome_tracing(events, profile_path)
     except OSError:
         pass
     return _state["dir"]

@@ -246,3 +246,46 @@ def test_barrier_id_reuse_raises(tmp_path):
         b.wait("once")
     b.wait()  # auto ids never collide
     b.wait()
+
+
+def test_profiler_op_table_and_chrome_trace(tmp_path, capsys):
+    """stop_profiler prints the reference-style aggregated per-op table
+    (profiler.cc PrintProfiler) and exports a chrome://tracing-loadable
+    JSON (tools/timeline.py:115 parity)."""
+    import json
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers, profiler
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 16], append_batch_size=False)
+        h = layers.fc(x, size=32, act="relu")
+        loss = layers.reduce_mean(layers.square(h))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+
+    trace_json = tmp_path / "chrome_trace.json"
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        profiler.start_profiler("All", log_dir=str(tmp_path / "trace"))
+        with profiler.RecordEvent("my_train_region"):
+            for _ in range(3):
+                exe.run(main, feed={"x": rng.randn(8, 16).astype("float32")},
+                        fetch_list=[loss])
+        profiler.stop_profiler(sorted_key="total",
+                               profile_path=str(trace_json))
+
+    out = capsys.readouterr().out
+    assert "Profiling Report" in out
+    assert "Calls" in out and "Total(us)" in out and "Ratio" in out
+    # at least one real event row beyond the header
+    body = [l for l in out.splitlines() if "%" in l]
+    assert body, out
+    # chrome trace loads and contains complete events
+    data = json.loads(trace_json.read_text())
+    evts = data["traceEvents"]
+    assert any(e.get("ph") == "X" for e in evts)
+    names = {e.get("name") for e in evts}
+    assert any(n and "my_train_region" in str(n) for n in names)
