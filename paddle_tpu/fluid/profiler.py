@@ -17,7 +17,7 @@ import contextlib
 import os
 import tempfile
 
-_state = {"dir": None, "active": False}
+_state = {"dir": None, "active": False, "preexisting": frozenset()}
 
 
 def start_profiler(state="All", tracer_option="Default", log_dir=None):
@@ -28,22 +28,33 @@ def start_profiler(state="All", tracer_option="Default", log_dir=None):
     if _state["active"]:
         return
     _state["dir"] = log_dir or tempfile.mkdtemp(prefix="paddle_tpu_prof_")
+    # a reused log_dir keeps earlier sessions' trace files around (jax
+    # writes each session under a fresh timestamped subdir) — snapshot
+    # what exists so stop_profiler aggregates THIS session only
+    _state["preexisting"] = frozenset(_trace_files(_state["dir"]))
     jax.profiler.start_trace(_state["dir"])
     _state["active"] = True
 
 
-def _collect_events(trace_dir):
-    """Parse the jax trace's .trace.json.gz files -> chrome trace events."""
+def _trace_files(trace_dir):
     import glob
+
+    return sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True))
+
+
+def _collect_events(trace_dir, exclude=frozenset()):
+    """Parse the jax trace's .trace.json.gz files -> chrome trace events."""
     import gzip
     import json
 
     events = []
-    for f in sorted(glob.glob(
-            os.path.join(trace_dir, "**", "*.trace.json.gz"),
-            recursive=True)):
+    for f in _trace_files(trace_dir):
+        if f in exclude:
+            continue
         try:
-            data = json.load(gzip.open(f))
+            with gzip.open(f) as fh:
+                data = json.load(fh)
         except (OSError, ValueError):
             continue
         events.extend(data.get("traceEvents", []))
@@ -146,10 +157,16 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 
     if not _state["active"]:
         return
+    sorted_key = sorted_key or "total"
+    if sorted_key not in _SORT_KEYS and sorted_key != "ave":
+        raise ValueError(
+            "sorted_key must be one of total/calls/min/max/ave/default, "
+            "got %r" % (sorted_key,))
     jax.profiler.stop_trace()
     _state["active"] = False
-    events = _collect_events(_state["dir"])   # parse the trace ONCE
-    print(summary_table(events, sorted_key or "total"))
+    events = _collect_events(                  # parse the trace ONCE,
+        _state["dir"], exclude=_state["preexisting"])  # this session only
+    print(summary_table(events, sorted_key))
     try:
         export_chrome_tracing(events, profile_path)
     except OSError:

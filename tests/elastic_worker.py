@@ -1,0 +1,121 @@
+"""Elastic-drill worker: DP training with heartbeats + numbered
+checkpoints + optional fault injection.
+
+Reference capability being drilled: `heart_beat_monitor.h:54`
+(LostWorkerMonitor) + `incubate/fleet/collective/__init__.py:236-333`
+(checkpoint_N save/load with TrainStatus) — the checkpoint-restart
+elasticity model.  Env knobs:
+
+  ELASTIC_WORKSPACE    shared dir (heartbeats + checkpoints + results)
+  ELASTIC_KILL_RANK/ELASTIC_KILL_STEP   fault injection (os._exit mid-run)
+  ELASTIC_EPOCHS       total epochs the JOB must complete (resume-aware)
+"""
+
+import json
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
+os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import distributed as dist
+    from paddle_tpu import fleet
+    from paddle_tpu.fleet import checkpoint as fleet_ckpt
+    from paddle_tpu.distributed.monitor import HeartBeatMonitor
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.transpiler.collective import GradAllReduce
+
+    ws = os.environ["ELASTIC_WORKSPACE"]
+    rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+    nranks = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    kill_rank = int(os.getenv("ELASTIC_KILL_RANK", "-1"))
+    kill_step = int(os.getenv("ELASTIC_KILL_STEP", "-1"))
+    epochs = int(os.getenv("ELASTIC_EPOCHS", "8"))
+    steps_per_epoch = 4
+
+    hb = HeartBeatMonitor(ws, rank, nranks, interval_s=0.2, timeout_s=1.5)
+    hb.start()
+
+    if nranks > 1:
+        dist.init_parallel_env()
+
+    rng = np.random.RandomState(99)
+    G = 16
+    w_true = rng.randn(6, 1).astype(np.float32)
+    data = []
+    for e in range(epochs):
+        xs = rng.randn(steps_per_epoch, G, 6).astype(np.float32)
+        data.append((xs, xs @ w_true))
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main_p, startup):
+        x = layers.data("x", shape=[-1, 6], append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        pred = layers.fc(layers.fc(x, 16, act="relu"), 1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+
+    if nranks > 1:
+        GradAllReduce().transpile(
+            startup_program=startup, main_program=main_p,
+            rank=rank,
+            endpoints=os.getenv("PADDLE_TRAINER_ENDPOINTS", "").split(","),
+            current_endpoint=os.getenv("PADDLE_CURRENT_ENDPOINT"),
+        )
+        mesh = dist.DeviceMesh({"dp": nranks}, devices=jax.devices())
+    else:
+        mesh = None
+
+    ckpt_root = os.path.join(ws, "ckpt")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace(), mesh=mesh)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        status = fleet_ckpt.load_check_point(
+            exe, ckpt_root, main_program=main_p)
+        start_epoch = (status._epoch_no + 1) if status is not None else 0
+        resumed_from = status._epoch_no if status is not None else -1
+
+        B = G // nranks
+        lo, hi = rank * B, (rank + 1) * B
+        gstep = 0
+        for e in range(start_epoch, epochs):
+            for t in range(steps_per_epoch):
+                if rank == kill_rank and e * steps_per_epoch + t == kill_step:
+                    os._exit(17)   # simulated hardware loss
+                xs, ys = data[e]
+                (lv,) = exe.run(
+                    main_p, feed={"x": xs[t, lo:hi], "y": ys[t, lo:hi]},
+                    fetch_list=[loss])
+                losses.append(float(np.mean(lv)))
+                gstep += 1
+            if rank == 0:
+                fleet_ckpt.save_check_point(
+                    exe, ckpt_root,
+                    fleet_ckpt.TrainStatus(e),
+                    main_program=main_p)
+    hb.complete()
+    hb.stop()
+    with open(os.path.join(ws, "result_%d_%d.json"
+                           % (rank, int(os.getenv("ELASTIC_GEN", "0")))),
+              "w") as f:
+        json.dump({"losses": losses, "resumed_from": resumed_from,
+                   "start_epoch": start_epoch}, f)
+
+
+if __name__ == "__main__":
+    main()
