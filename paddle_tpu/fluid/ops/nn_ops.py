@@ -499,8 +499,13 @@ def _switch_moe(ctx, ins, attrs):
     inserts the all-to-alls the dispatch implies.
 
     X: [tokens, d]; GateW: [d, E]; W1: [E, d, h]; B1: [E, h];
-    W2: [E, h, d]; B2: [E, d].  attrs: capacity_factor (default 1.25).
-    AuxLoss: load-balancing loss (mean over experts of fraction*prob * E).
+    W2: [E, h, d]; B2: [E, d].  attrs: capacity_factor (default 1.25),
+    top_k (1 = Switch, 2 = GShard top-2 with renormalized gates),
+    z_loss_weight (router z-loss, ST-MoE: mean(logsumexp(logits)^2),
+    folded into AuxLoss).
+    AuxLoss: load-balancing loss (fraction*prob * E over the RANK-0
+    routing choice, the Switch/GShard convention) + z_loss_weight *
+    z_loss.
     """
     x = ins["X"][0]
     gw = ins["GateW"][0]
@@ -508,37 +513,60 @@ def _switch_moe(ctx, ins, attrs):
     w2, b2 = ins["W2"][0], ins["B2"][0]
     t, d = x.shape
     e = gw.shape[1]
-    cap = int(attrs.get("capacity_factor", 1.25) * t / e + 1)
+    top_k = int(attrs.get("top_k", 1))
+    cap = int(attrs.get("capacity_factor", 1.25) * top_k * t / e + 1)
 
     xf = x.astype(jnp.float32)
     logits = xf @ gw.astype(jnp.float32)  # [t, E]
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)  # [t]
-    gate = jnp.max(probs, axis=-1)  # [t]
 
-    # position of each token within its expert's capacity buffer
-    onehot = jax.nn.one_hot(expert, e, dtype=jnp.int32)  # [t, E]
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # [t, E], -1 elsewhere
-    pos_in_exp = jnp.sum(pos * onehot, axis=-1)  # [t]
-    keep = pos_in_exp < cap
+    # top-k routing choices (GShard: rank-0 tokens claim capacity first)
+    masked = probs
+    chosen, gates = [], []
+    for _ in range(top_k):
+        exp_r = jnp.argmax(masked, axis=-1)          # [t]
+        chosen.append(exp_r)
+        gates.append(jnp.take_along_axis(
+            probs, exp_r[:, None], axis=1)[:, 0])
+        masked = masked * (1.0 - jax.nn.one_hot(exp_r, e))
+    if top_k > 1:                                    # renormalize gates
+        denom = sum(gates) + 1e-9
+        gates = [g / denom for g in gates]
 
-    # dispatch tensor [t, E, cap]
-    disp = (
-        jax.nn.one_hot(expert, e, dtype=jnp.float32)[:, :, None]
-        * jax.nn.one_hot(jnp.where(keep, pos_in_exp, cap), cap + 1,
-                         dtype=jnp.float32)[:, None, :cap]
-    )
-    xin = jnp.einsum("tec,td->ecd", disp, xf)  # [E, cap, d]
+    # capacity positions over ALL choices: rank-0 assignments occupy
+    # buffers before rank-1 (concatenate along the token axis)
+    onehots = [jax.nn.one_hot(c, e, dtype=jnp.int32) for c in chosen]
+    stacked = jnp.concatenate(onehots, axis=0)       # [k*t, E]
+    pos_all = jnp.cumsum(stacked, axis=0) * stacked - 1
+
+    out = jnp.zeros((t, d), jnp.float32)
+    xin = jnp.zeros((e, cap, d), jnp.float32)
+    disps = []
+    for r in range(top_k):
+        pos_r = jnp.sum(pos_all[r * t:(r + 1) * t] * onehots[r], axis=-1)
+        keep = pos_r < cap
+        disp = (
+            onehots[r].astype(jnp.float32)[:, :, None]
+            * jax.nn.one_hot(jnp.where(keep, pos_r, cap), cap + 1,
+                             dtype=jnp.float32)[:, None, :cap]
+        )
+        disps.append(disp)
+        xin = xin + jnp.einsum("tec,td->ecd", disp, xf)
     h = jnp.einsum("ecd,edh->ech", xin, w1.astype(jnp.float32))
     h = jax.nn.gelu(h + b1.astype(jnp.float32)[:, None, :])
     y = jnp.einsum("ech,ehd->ecd", h, w2.astype(jnp.float32))
     y = y + b2.astype(jnp.float32)[:, None, :]
-    out = jnp.einsum("tec,ecd->td", disp, y) * gate[:, None]
+    for r in range(top_k):
+        out = out + jnp.einsum("tec,ecd->td", disps[r], y)             * gates[r][:, None]
 
-    # Switch load-balancing aux loss
-    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)  # [E]
+    # Switch/GShard load-balancing aux loss over the rank-0 choice
+    frac = jnp.mean(onehots[0].astype(jnp.float32), axis=0)  # [E]
     prob_mean = jnp.mean(probs, axis=0)  # [E]
     aux = jnp.sum(frac * prob_mean) * e
+    zw = float(attrs.get("z_loss_weight", 0.0))
+    if zw:
+        z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+        aux = aux + zw * z
     return {"Out": [out.astype(x.dtype)], "AuxLoss": [aux]}
 
 

@@ -13,13 +13,16 @@ from ..fluid.layers.common import append_simple_op
 
 
 class MoEFFN(dygraph.Layer):
-    """Switch-style top-1 routed FFN."""
+    """Routed FFN: top_k=1 (Switch) or 2 (GShard, renormalized gates),
+    capacity-factor token dropping, optional ST-MoE router z-loss."""
 
     def __init__(self, d_model, d_hidden, num_experts, capacity_factor=1.25,
-                 param_attr=None):
+                 top_k=1, z_loss_weight=0.0, param_attr=None):
         super().__init__()
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
+        self.top_k = int(top_k)
+        self.z_loss_weight = float(z_loss_weight)
         self.gate = self.create_parameter([d_model, num_experts], attr=param_attr)
         self.w1 = self.create_parameter([num_experts, d_model, d_hidden],
                                         attr=param_attr)
@@ -41,8 +44,35 @@ class MoEFFN(dygraph.Layer):
                 "W1": self.w1, "B1": self.b1,
                 "W2": self.w2, "B2": self.b2,
             },
-            {"capacity_factor": self.capacity_factor},
+            {"capacity_factor": self.capacity_factor,
+             "top_k": self.top_k, "z_loss_weight": self.z_loss_weight},
             out_slots=("Out", "AuxLoss"),
         )
         self.aux_loss = aux
         return layers.reshape(out, shape[:-1] + [d])
+
+
+class MoEEncoderLayer(dygraph.Layer):
+    """Transformer encoder block whose FFN is a routed MoEFFN (post-LN,
+    BERT style) — the transformer-integrated MoE story.  `aux_loss`
+    carries the router losses for the training objective."""
+
+    def __init__(self, cfg, num_experts, capacity_factor=1.25, top_k=2,
+                 z_loss_weight=1e-3):
+        super().__init__()
+        from .bert import MultiHeadAttention
+
+        d = cfg.hidden_size
+        self.attn = MultiHeadAttention(cfg, self_attention=True)
+        self.ln1 = dygraph.LayerNorm(d)
+        self.moe = MoEFFN(d, cfg.intermediate_size, num_experts,
+                          capacity_factor=capacity_factor, top_k=top_k,
+                          z_loss_weight=z_loss_weight)
+        self.ln2 = dygraph.LayerNorm(d)
+        self.aux_loss = None
+
+    def forward(self, x, attn_bias=None):
+        h = self.ln1(x + self.attn(x, attn_bias=attn_bias))
+        m = self.moe(h)
+        self.aux_loss = self.moe.aux_loss
+        return self.ln2(h + m)

@@ -126,6 +126,17 @@ def _st(ref, val):
         ref[0, :, 0, :] = val
 
 
+def _recompute_lse(s):
+    """Full-row logsumexp from a score tile that covers the whole row
+    (single-block schedule) — matches the forward's dead-row handling."""
+    m = jnp.max(s, axis=1)
+    safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    l = jnp.sum(jnp.where(s <= NEG_INF / 2, 0.0,
+                          jnp.exp(s - safe_m[:, None])), axis=1)
+    return jnp.where(m <= NEG_INF / 2, NEG_INF,
+                     safe_m + jnp.log(jnp.maximum(l, 1e-30)))
+
+
 def _row_spec(rows, d, layout, h, pos):
     """BlockSpec for a row-blocked [.., S, D] tensor in either layout.
     pos: which positional grid arg (1 or 2) carries this tensor's row
@@ -147,7 +158,7 @@ def _row_spec(rows, d, layout, h, pos):
 
 
 def _fwd_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
-                coff=0):
+                coff=0, emit_lse=True):
     (q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, tail) = _split_refs(
         refs, has_bias, has_seg
     )
@@ -200,14 +211,24 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
         # NEG_INF so the backward zeroes it too
         dead = m_ref[:, 0] <= NEG_INF / 2
         _st(o_ref, jnp.where(dead[:, None], 0.0, o).astype(o_ref.dtype))
-        lse = jnp.where(dead, NEG_INF, m_ref[:, 0] + jnp.log(safe_l))
-        lse_ref[0, :, :] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
+        if emit_lse:
+            lse = jnp.where(dead, NEG_INF, m_ref[:, 0] + jnp.log(safe_l))
+            lse_ref[0, :, :] = jnp.broadcast_to(lse[:, None],
+                                                lse_ref.shape[1:])
+        else:
+            # single-block schedule: the backward recomputes lse from the
+            # full score row — emit a token buffer instead of the [sq,128]
+            # broadcast residual (saves ~3 full-tensor passes per layer)
+            lse_ref[0, :, :] = jnp.zeros(lse_ref.shape[1:], jnp.float32)
 
 
 def _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret,
          coff=0, layout="BHSD"):
-    """Returns (out, lse [bh,sq,128] row-broadcast); out is [bh,sq,d]
-    (BHSD) or [b,sq,h,d] (BSHD).
+    """Returns (out, lse); out is [bh,sq,d] (BHSD) or [b,sq,h,d] (BSHD);
+    lse is the [bh,sq,128] row-broadcast residual, EXCEPT on the
+    single-block schedule (nq==nk==1) where it is a (bh,8,128) zero
+    token and the backward kernels recompute lse from the full score
+    row (recompute_lse=True).
 
     qseg: [B, sq, 128] lane-broadcast ids; kseg: [B, 8, sk] sublane-
     broadcast (B = bh // n_head; the index map divides by n_head so the
@@ -244,21 +265,26 @@ def _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret,
         )
         args.extend([qseg, kseg])
 
+    emit_lse = not (nq == 1 and nk == 1)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-        has_bias=has_bias, has_seg=has_seg, coff=coff,
+        has_bias=has_bias, has_seg=has_seg, coff=coff, emit_lse=emit_lse,
     )
+    lse_rows = bq if emit_lse else 8
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=[
             _row_spec(bq, d, layout, h, 1),
-            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, lse_rows, 128),
+                         (lambda b, i, j: (b, i, 0)) if emit_lse
+                         else (lambda b, i, j: (b, 0, 0))),
         ],
         out_shape=[
             out_sds,
-            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+            jax.ShapeDtypeStruct(
+                (bh, sq if emit_lse else 8, 128), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),  # running row max
@@ -276,7 +302,7 @@ def _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret,
 
 
 def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
-                   coff=0):
+                   coff=0, recompute_lse=False):
     (q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, tail) = _split_refs(
         refs, has_bias, has_seg
     )
@@ -294,13 +320,17 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
         v = _ld(v_ref).astype(jnp.float32)
         do = _ld(do_ref).astype(jnp.float32)
         o = _ld(o_ref).astype(jnp.float32)
-        lse = lse_ref[0, :, 0]  # [bq] logsumexp rows
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         s = _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j,
                          bq, bk, coff)
+        if recompute_lse:
+            # single-block schedule: this tile IS the full score row
+            lse = _recompute_lse(s)
+        else:
+            lse = lse_ref[0, :, 0]  # [bq] logsumexp rows
         # explicit zero where masked: with a fully-masked row lse is
         # NEG_INF and exp(s - lse) would resurrect p = 1
         p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse[:, None]))
@@ -326,7 +356,7 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg,
-                    coff=0):
+                    coff=0, recompute_lse=False):
     (q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, tail) = _split_refs(
         refs, has_bias, has_seg
     )
@@ -353,13 +383,16 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg,
         v = _ld(v_ref).astype(jnp.float32)
         do = _ld(do_ref).astype(jnp.float32)
         o = _ld(o_ref).astype(jnp.float32)
-        lse = lse_ref[0, :, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         s = _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, i, j,
                          bq, bk, coff)
+        if recompute_lse:
+            lse = _recompute_lse(s)
+        else:
+            lse = lse_ref[0, :, 0]
         p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse[:, None]))
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -560,6 +593,14 @@ def _flash_core_bwd(n_head, scale, causal, interpret, coff, layout, res, g):
     bq, bk = _block_sizes(sq, sk)
     nq, nk = sq // bq, sk // bk
     has_bias, has_seg = bias is not None, qseg is not None
+    fast = nq == 1 and nk == 1      # lse recomputed in-kernel (see _fwd)
+
+    def _lse_spec(order):
+        if fast:
+            return pl.BlockSpec((1, 8, 128), lambda b, a, c: (b, 0, 0))
+        if order == "ij":
+            return pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0))
+        return pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0))
 
     dq_specs = [
         _row_spec(bq, d, layout, h, 1),  # q
@@ -581,13 +622,14 @@ def _flash_core_bwd(n_head, scale, causal, interpret, coff, layout, res, g):
     dq_specs += [
         _row_spec(bq, d, layout, h, 1),  # o
         _row_spec(bq, d, layout, h, 1),  # do
-        pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),  # lse rows
+        _lse_spec("ij"),  # lse rows (token buffer on the fast path)
     ]
     args += [out, g, lse2d]
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
             has_bias=has_bias, has_seg=has_seg, coff=coff,
+            recompute_lse=fast,
         ),
         grid=(bh, nq, nk),
         in_specs=dq_specs,
@@ -615,7 +657,7 @@ def _flash_core_bwd(n_head, scale, causal, interpret, coff, layout, res, g):
     kv_specs += [
         _row_spec(bq, d, layout, h, 2),  # o
         _row_spec(bq, d, layout, h, 2),  # do
-        pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),  # lse
+        _lse_spec("ji"),  # lse
     ]
     out_specs = [
         _row_spec(bk, d, layout, h, 1),  # dk
@@ -637,6 +679,7 @@ def _flash_core_bwd(n_head, scale, causal, interpret, coff, layout, res, g):
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nq=nq,
             has_bias=has_bias, has_seg=has_seg, coff=coff,
+            recompute_lse=fast,
         ),
         grid=(bh, nk, nq),
         in_specs=kv_specs,

@@ -78,3 +78,104 @@ def test_moe_expert_parallel_loss_parity():
     single = run({"n_devices": 1})
     ep = run({"n_devices": 8, "ep": 4})
     np.testing.assert_allclose(single, ep, rtol=2e-3, atol=2e-4)
+
+
+def test_moe_top2_matches_dense_mixture_with_big_capacity():
+    """With capacity >> tokens, top-2 routing equals the dense two-expert
+    softmax mixture computed directly in numpy."""
+    import numpy as np
+
+    from op_test import run_single_op
+
+    rng = np.random.RandomState(0)
+    t, d, h, e = 10, 6, 8, 4
+    x = rng.randn(t, d).astype(np.float32)
+    gw = rng.randn(d, e).astype(np.float32)
+    w1 = rng.randn(e, d, h).astype(np.float32) * 0.3
+    b1 = rng.randn(e, h).astype(np.float32) * 0.1
+    w2 = rng.randn(e, h, d).astype(np.float32) * 0.3
+    b2 = rng.randn(e, d).astype(np.float32) * 0.1
+
+    outs, _ = run_single_op(
+        "switch_moe",
+        {"X": x, "GateW": gw, "W1": w1, "B1": b1, "W2": w2, "B2": b2},
+        {"capacity_factor": 50.0, "top_k": 2, "z_loss_weight": 0.0},
+        ["Out", "AuxLoss"])
+
+    def gelu(v):
+        from scipy.stats import norm
+        return v * norm.cdf(v)
+
+    logits = x @ gw
+    probs = np.exp(logits - logits.max(1, keepdims=True))
+    probs /= probs.sum(1, keepdims=True)
+    ref = np.zeros_like(x)
+    for i in range(t):
+        order = np.argsort(-probs[i])[:2]
+        g = probs[i][order]
+        g = g / g.sum()
+        for r, ei in enumerate(order):
+            hmid = gelu(x[i] @ w1[ei] + b1[ei])
+            ref[i] += g[r] * (hmid @ w2[ei] + b2[ei])
+    np.testing.assert_allclose(outs["Out"], ref, rtol=2e-3, atol=2e-3)
+
+
+def test_moe_z_loss_folds_into_aux():
+    import numpy as np
+
+    from op_test import run_single_op
+
+    rng = np.random.RandomState(1)
+    t, d, h, e = 6, 4, 4, 3
+    ins = {"X": rng.randn(t, d).astype(np.float32),
+           "GateW": rng.randn(d, e).astype(np.float32),
+           "W1": rng.randn(e, d, h).astype(np.float32),
+           "B1": np.zeros((e, h), np.float32),
+           "W2": rng.randn(e, h, d).astype(np.float32),
+           "B2": np.zeros((e, d), np.float32)}
+    a0, _ = run_single_op("switch_moe", ins,
+                          {"top_k": 1, "z_loss_weight": 0.0}, ["AuxLoss"])
+    a1, _ = run_single_op("switch_moe", ins,
+                          {"top_k": 1, "z_loss_weight": 0.5}, ["AuxLoss"])
+    logits = ins["X"] @ ins["GateW"]
+    z = np.mean(np.log(np.exp(logits).sum(1)) ** 2)
+    np.testing.assert_allclose(float(a1["AuxLoss"]) - float(a0["AuxLoss"]),
+                               0.5 * z, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_encoder_layer_trains():
+    """Transformer-integrated MoE: a mini encoder stack with routed FFNs
+    trains with the router losses in the objective."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import models
+    from paddle_tpu.fluid import dygraph, layers
+    from paddle_tpu.models.bert import BertConfig
+    from paddle_tpu.models.moe import MoEEncoderLayer
+
+    cfg = BertConfig.tiny()
+    with dygraph.guard():
+        layer = MoEEncoderLayer(cfg, num_experts=4, top_k=2,
+                                z_loss_weight=1e-3)
+        emb = dygraph.Embedding([32, cfg.hidden_size])
+        head = dygraph.Linear(cfg.hidden_size, 2)
+        opt = fluid.optimizer.AdamOptimizer(5e-3)
+        params = (layer.parameters() + emb.parameters()
+                  + head.parameters())
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 32, (8, 8)).astype(np.int64)
+        lab = (ids[:, 0] % 2).reshape(-1, 1).astype(np.int64)
+        losses = []
+        for _ in range(12):
+            h = layer(emb(dygraph.to_variable(ids)))
+            logits = head(layers.reduce_mean(h, dim=1))
+            loss = layers.mean(layers.softmax_with_cross_entropy(
+                logits, dygraph.to_variable(lab)))
+            total = loss + 0.01 * layer.aux_loss
+            total.backward()
+            opt.minimize(total, parameter_list=params)
+            for p in params:
+                p.clear_gradient()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], losses
