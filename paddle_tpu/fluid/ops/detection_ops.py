@@ -782,3 +782,218 @@ def _matrix_nms(ctx, ins, attrs):
                          out, out.at[:, 0].set(-1.0))
 
     return {"Out": [jax.vmap(per_image)(bboxes, scores)]}
+
+
+def _bbox_deltas(anchors, gt):
+    """Standard (dx, dy, dw, dh) encoding of gt vs anchors [..., 4]."""
+    aw = anchors[..., 2] - anchors[..., 0] + 1e-9
+    ah = anchors[..., 3] - anchors[..., 1] + 1e-9
+    ax = anchors[..., 0] + aw * 0.5
+    ay = anchors[..., 1] + ah * 0.5
+    gw = gt[..., 2] - gt[..., 0] + 1e-9
+    gh = gt[..., 3] - gt[..., 1] + 1e-9
+    gx = gt[..., 0] + gw * 0.5
+    gy = gt[..., 1] + gh * 0.5
+    return jnp.stack([(gx - ax) / aw, (gy - ay) / ah,
+                      jnp.log(gw / aw), jnp.log(gh / ah)], axis=-1)
+
+
+def _assign_anchor_labels(anchors, gtbox, has_gt, pos_thr, neg_thr):
+    """IoU matching core shared by the target-assign ops: returns
+    (labels [A] in {1,0,-1}, matched gt index [A], max IoU [A]).
+    Anchors matching no gt well enough stay -1 (ignore)."""
+    iou = _pairwise_iou(anchors, gtbox)            # [A, G]
+    iou = jnp.where(has_gt[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)              # [A]
+    best_iou = jnp.max(iou, axis=1)
+    labels = jnp.full((anchors.shape[0],), -1, jnp.int32)
+    labels = jnp.where(best_iou < neg_thr, 0, labels)
+    labels = jnp.where(best_iou >= pos_thr, 1, labels)
+    # every gt's best anchor is positive (reference rule), ties included
+    per_gt_best = jnp.max(iou, axis=0)             # [G]
+    is_gt_best = jnp.any(
+        (iou >= per_gt_best[None, :] - 1e-6) & (iou > 0)
+        & has_gt[None, :], axis=1)
+    labels = jnp.where(is_gt_best, 1, labels)
+    return labels, best_gt, best_iou
+
+
+def _subsample(key, labels, want_pos, want_total, use_random):
+    """Cap positives at want_pos and negatives at want_total - n_pos by
+    flipping the excess to -1 (ignore).  use_random permutes with the
+    PER-IMAGE key; otherwise the lowest anchor indices win."""
+    a = labels.shape[0]
+    if use_random:
+        order = jax.random.permutation(key, a)
+    else:
+        order = jnp.arange(a)
+    rank_of = jnp.zeros((a,), jnp.int32).at[order].set(
+        jnp.arange(a, dtype=jnp.int32))
+    pos = labels == 1
+
+    def keep_first(mask, k):
+        r = jnp.where(mask, rank_of, a + 1)
+        kth = jnp.sort(r)[jnp.maximum(k - 1, 0)]
+        return mask & (r <= jnp.where(k > 0, kth, -1))
+
+    keep_pos = keep_first(pos, jnp.minimum(want_pos, jnp.sum(pos)))
+    n_pos = jnp.sum(keep_pos)
+    neg = labels == 0
+    keep_neg = keep_first(neg, jnp.minimum(want_total - n_pos,
+                                           jnp.sum(neg)))
+    out = jnp.full_like(labels, -1)
+    out = jnp.where(keep_pos, 1, out)
+    out = jnp.where(keep_neg, 0, out)
+    return out
+
+
+@register_op("rpn_target_assign",
+             inputs=["Anchor", "GtBoxes", "IsCrowd", "ImInfo"],
+             outputs=["TargetLabel", "TargetBBox", "BBoxInsideWeight",
+                      "ScoreIndex", "LocationIndex"],
+             needs_rng=True, grad=None)
+def _rpn_target_assign(ctx, ins, attrs):
+    """cf. rpn_target_assign_op.cc.  STATIC redesign: instead of the
+    LoD-compacted [F]/[F+B] index tensors, every output is anchor-dense
+    per image — TargetLabel [N, A] in {1, 0, -1=ignore}, TargetBBox
+    [N, A, 4] deltas (valid where label==1), BBoxInsideWeight [N, A, 4]
+    (1 on positives).  ScoreIndex/LocationIndex become {0,1} masks
+    [N, A] marking scored (label>=0) / localized (label==1) anchors."""
+    anchors = ins["Anchor"][0]                     # [A, 4]
+    gtbox = ins["GtBoxes"][0]                      # [N, G, 4]
+    crowd = (ins["IsCrowd"][0] if ins.get("IsCrowd") else None)
+    batch = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    pos_thr = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_thr = float(attrs.get("rpn_negative_overlap", 0.3))
+    use_random = bool(attrs.get("use_random", True))
+
+    def per_image(gt, crowd_row, key):
+        has_gt = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+        if crowd_row is not None:
+            has_gt = has_gt & (crowd_row.reshape(-1) == 0)
+        labels, best_gt, _ = _assign_anchor_labels(
+            anchors, gt, has_gt, pos_thr, neg_thr)
+        labels = _subsample(key, labels, int(batch * fg_frac), batch,
+                            use_random)
+        deltas = _bbox_deltas(anchors, gt[best_gt])
+        w = (labels == 1).astype(jnp.float32)[:, None]
+        return (labels, deltas * w, jnp.broadcast_to(w, deltas.shape),
+                (labels >= 0).astype(jnp.int32),
+                (labels == 1).astype(jnp.int32))
+
+    keys = jax.random.split(ctx.rng(), gtbox.shape[0])  # per-image keys
+    if crowd is not None:
+        outs = jax.vmap(per_image)(gtbox, crowd, keys)
+    else:
+        outs = jax.vmap(
+            lambda g, k: per_image(g, None, k))(gtbox, keys)
+    lab, tb, biw, sidx, lidx = outs
+    return {"TargetLabel": [lab], "TargetBBox": [tb],
+            "BBoxInsideWeight": [biw], "ScoreIndex": [sidx],
+            "LocationIndex": [lidx]}
+
+
+@register_op("retinanet_target_assign",
+             inputs=["Anchor", "GtBoxes", "GtLabels", "IsCrowd", "ImInfo"],
+             outputs=["TargetLabel", "TargetBBox", "BBoxInsideWeight",
+                      "ForegroundNumber", "ScoreIndex", "LocationIndex"],
+             grad=None)
+def _retinanet_target_assign(ctx, ins, attrs):
+    """cf. retinanet_target_assign_op.cc: like RPN assign but every
+    non-ignored anchor is scored (focal loss, no subsampling) and
+    TargetLabel carries the CLASS id (0 = background).  Same anchor-dense
+    static redesign as rpn_target_assign."""
+    anchors = ins["Anchor"][0]
+    gtbox = ins["GtBoxes"][0]                      # [N, G, 4]
+    gtlab = ins["GtLabels"][0]                     # [N, G] (>=1)
+    pos_thr = float(attrs.get("positive_overlap", 0.5))
+    neg_thr = float(attrs.get("negative_overlap", 0.4))
+
+    def per_image(gt, gl):
+        has_gt = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+        labels, best_gt, _ = _assign_anchor_labels(
+            anchors, gt, has_gt, pos_thr, neg_thr)
+        cls = jnp.where(labels == 1,
+                        gl.reshape(-1)[best_gt].astype(jnp.int32),
+                        jnp.where(labels == 0, 0, -1))
+        deltas = _bbox_deltas(anchors, gt[best_gt])
+        w = (labels == 1).astype(jnp.float32)[:, None]
+        fg = jnp.sum(labels == 1).astype(jnp.int32).reshape(1)
+        return (cls, deltas * w, jnp.broadcast_to(w, deltas.shape), fg,
+                (labels >= 0).astype(jnp.int32),
+                (labels == 1).astype(jnp.int32))
+
+    cls, tb, biw, fg, sidx, lidx = jax.vmap(per_image)(gtbox, gtlab)
+    return {"TargetLabel": [cls], "TargetBBox": [tb],
+            "BBoxInsideWeight": [biw], "ForegroundNumber": [fg],
+            "ScoreIndex": [sidx], "LocationIndex": [lidx]}
+
+
+@register_op("generate_proposal_labels",
+             inputs=["RpnRois", "GtClasses", "IsCrowd", "GtBoxes",
+                     "ImInfo"],
+             outputs=["Rois", "LabelsInt32", "BboxTargets",
+                      "BboxInsideWeights", "BboxOutsideWeights"],
+             needs_rng=True, grad=None)
+def _generate_proposal_labels(ctx, ins, attrs):
+    """cf. generate_proposal_labels_op.cc: sample second-stage RoIs with
+    class + regression targets.  STATIC redesign: outputs are dense over
+    the input proposals [N, R] — LabelsInt32 in {class, 0=bg, -1=unused},
+    BboxTargets [N, R, 4*C] one-hot-per-class deltas, inside weights 1
+    on the matched class slot of foregrounds, outside weights 1 on every
+    sampled (label >= 0) roi's slot."""
+    rois = ins["RpnRois"][0]                       # [N, R, 4]
+    gtcls = ins["GtClasses"][0]                    # [N, G]
+    gtbox = ins["GtBoxes"][0]                      # [N, G, 4]
+    bs = int(attrs.get("batch_size_per_im", 512))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_thr = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    ncls = int(attrs.get("class_nums", 81))
+    use_random = bool(attrs.get("use_random", True))
+
+    crowd = ins["IsCrowd"][0] if ins.get("IsCrowd") else None
+
+    def per_image(pr, gt, gl, crowd_row, key):
+        has_gt = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+        if crowd_row is not None:
+            has_gt = has_gt & (crowd_row.reshape(-1) == 0)
+        iou = _pairwise_iou(pr, gt)
+        # invalid gts contribute IoU 0 (not -1): an image with no valid
+        # gt still samples its proposals as BACKGROUND (reference
+        # generate_proposal_labels behavior)
+        iou = jnp.where(has_gt[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        labels = jnp.full((pr.shape[0],), -1, jnp.int32)
+        labels = jnp.where((best_iou < bg_hi) & (best_iou >= bg_lo),
+                           0, labels)
+        labels = jnp.where(best_iou >= fg_thr, 1, labels)
+        labels = _subsample(key, labels, int(bs * fg_frac), bs, use_random)
+        cls = jnp.where(labels == 1,
+                        gl.reshape(-1)[best_gt].astype(jnp.int32),
+                        jnp.where(labels == 0, 0, -1))
+        deltas = _bbox_deltas(pr, gt[best_gt])
+        onehot = jax.nn.one_hot(jnp.maximum(cls, 0), ncls)  # [R, C]
+        fgw = (labels == 1).astype(jnp.float32)[:, None]
+        tgt = (onehot[:, :, None] * deltas[:, None, :] * fgw[:, :, None]
+               ).reshape(pr.shape[0], 4 * ncls)
+        biw = (onehot[:, :, None] * fgw[:, :, None]
+               * jnp.ones((1, 1, 4))).reshape(pr.shape[0], 4 * ncls)
+        scored = (labels >= 0).astype(jnp.float32)[:, None]
+        bow = (onehot[:, :, None] * scored[:, :, None]
+               * jnp.ones((1, 1, 4))).reshape(pr.shape[0], 4 * ncls)
+        return pr, cls, tgt, biw, bow
+
+    keys = jax.random.split(ctx.rng(), rois.shape[0])
+    if crowd is not None:
+        r, c, t, bi, bo = jax.vmap(per_image)(
+            rois, gtbox, gtcls, crowd, keys)
+    else:
+        r, c, t, bi, bo = jax.vmap(
+            lambda p, g, gl, k: per_image(p, g, gl, None, k))(
+            rois, gtbox, gtcls, keys)
+    return {"Rois": [r], "LabelsInt32": [c], "BboxTargets": [t],
+            "BboxInsideWeights": [bi], "BboxOutsideWeights": [bo]}

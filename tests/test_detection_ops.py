@@ -163,3 +163,118 @@ def test_bipartite_match_greedy():
     np.testing.assert_allclose(
         outs["ColToRowMatchDist"][0], [0.9, 0.7, 0.0], rtol=1e-5
     )
+
+
+# --- round-4: training-side target assignment ------------------------------
+
+
+def test_rpn_target_assign_labels_and_deltas():
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [100, 100, 110, 110], [21, 21, 31, 31]],
+                       np.float32)
+    gt = np.array([[[19, 19, 31, 31], [0, 0, 0, 0]]], np.float32)
+    outs, _ = run_single_op(
+        "rpn_target_assign",
+        {"Anchor": anchors, "GtBoxes": gt,
+         "ImInfo": np.array([[128, 128, 1]], np.float32)},
+        {"rpn_positive_overlap": 0.7, "rpn_negative_overlap": 0.3,
+         "rpn_batch_size_per_im": 4, "use_random": False},
+        ["TargetLabel", "TargetBBox", "BBoxInsideWeight",
+         "LocationIndex"])
+    lab = outs["TargetLabel"][0]
+    # anchor 1 and 3 overlap the gt strongly -> positive; 0/2 negative
+    assert lab[1] == 1 and lab[3] == 1, lab
+    assert lab[0] == 0 and lab[2] == 0, lab
+    # deltas on a positive anchor match the closed form
+    a = anchors[1]
+    g = gt[0, 0]
+    aw, ah = a[2] - a[0], a[3] - a[1]
+    gw, gh = g[2] - g[0], g[3] - g[1]
+    ref = [((g[0] + gw / 2) - (a[0] + aw / 2)) / aw,
+           ((g[1] + gh / 2) - (a[1] + ah / 2)) / ah,
+           np.log(gw / aw), np.log(gh / ah)]
+    np.testing.assert_allclose(outs["TargetBBox"][0, 1], ref, rtol=1e-4,
+                               atol=1e-4)
+    # inside weights 1 exactly on positives
+    np.testing.assert_allclose(outs["BBoxInsideWeight"][0, 1],
+                               np.ones(4), rtol=1e-6)
+    np.testing.assert_allclose(outs["BBoxInsideWeight"][0, 0],
+                               np.zeros(4), rtol=1e-6)
+    np.testing.assert_array_equal(outs["LocationIndex"][0],
+                                  (lab == 1).astype(np.int32))
+
+
+def test_rpn_target_assign_subsampling_caps_batch():
+    rng = np.random.RandomState(0)
+    anchors = np.concatenate(
+        [np.tile([[5, 5, 15, 15]], (6, 1)) + rng.rand(6, 4),
+         np.tile([[50, 50, 60, 60]], (10, 1)) + rng.rand(10, 4)],
+        axis=0).astype(np.float32)
+    gt = np.array([[[5, 5, 15, 15]]], np.float32)
+    outs, _ = run_single_op(
+        "rpn_target_assign",
+        {"Anchor": anchors, "GtBoxes": gt,
+         "ImInfo": np.array([[64, 64, 1]], np.float32)},
+        {"rpn_batch_size_per_im": 4, "rpn_fg_fraction": 0.5,
+         "use_random": False},
+        ["TargetLabel"])
+    lab = outs["TargetLabel"][0]
+    assert (lab == 1).sum() <= 2          # fg capped at batch*fraction
+    assert (lab >= 0).sum() <= 4          # total capped at batch
+
+
+def test_retinanet_target_assign_class_labels():
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [100, 100, 110, 110]], np.float32)
+    gt = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+    gl = np.array([[3, 7]], np.int64)
+    outs, _ = run_single_op(
+        "retinanet_target_assign",
+        {"Anchor": anchors, "GtBoxes": gt, "GtLabels": gl,
+         "ImInfo": np.array([[128, 128, 1]], np.float32)},
+        {"positive_overlap": 0.5, "negative_overlap": 0.4},
+        ["TargetLabel", "ForegroundNumber"])
+    lab = outs["TargetLabel"][0]
+    assert lab[0] == 3 and lab[1] == 7    # class ids, not binary
+    assert lab[2] == 0                    # background
+    assert int(outs["ForegroundNumber"][0, 0]) == 2
+
+
+def test_generate_proposal_labels_targets():
+    rois = np.array([[[0, 0, 10, 10], [50, 50, 60, 60],
+                      [200, 200, 210, 210]]], np.float32)
+    gt = np.array([[[1, 1, 11, 11]]], np.float32)
+    gtc = np.array([[5]], np.int64)
+    C = 8
+    outs, _ = run_single_op(
+        "generate_proposal_labels",
+        {"RpnRois": rois, "GtClasses": gtc, "GtBoxes": gt,
+         "ImInfo": np.array([[256, 256, 1]], np.float32)},
+        {"batch_size_per_im": 3, "fg_fraction": 0.5, "fg_thresh": 0.5,
+         "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0, "class_nums": C,
+         "use_random": False},
+        ["LabelsInt32", "BboxTargets", "BboxInsideWeights"])
+    lab = outs["LabelsInt32"][0]
+    assert lab[0] == 5                    # matched roi carries gt class
+    assert (lab[1] == 0) and (lab[2] == 0)
+    # targets live only on the matched class's 4-slot block
+    tgt = outs["BboxTargets"][0, 0].reshape(C, 4)
+    biw = outs["BboxInsideWeights"][0, 0].reshape(C, 4)
+    assert np.abs(tgt[5]).sum() > 0
+    assert np.abs(np.delete(tgt, 5, axis=0)).sum() == 0
+    np.testing.assert_allclose(biw[5], np.ones(4))
+    assert np.abs(np.delete(biw, 5, axis=0)).sum() == 0
+
+
+def test_generate_proposal_labels_no_gt_samples_background():
+    rois = np.array([[[0, 0, 10, 10], [50, 50, 60, 60]]], np.float32)
+    gt = np.zeros((1, 1, 4), np.float32)          # all-padding gt
+    gtc = np.zeros((1, 1), np.int64)
+    outs, _ = run_single_op(
+        "generate_proposal_labels",
+        {"RpnRois": rois, "GtClasses": gtc, "GtBoxes": gt,
+         "ImInfo": np.array([[64, 64, 1]], np.float32)},
+        {"batch_size_per_im": 2, "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
+         "bg_thresh_lo": 0.0, "class_nums": 4, "use_random": False},
+        ["LabelsInt32"])
+    assert (outs["LabelsInt32"][0] == 0).all()    # background, not ignored
