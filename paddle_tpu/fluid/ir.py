@@ -1,0 +1,186 @@
+"""IR pass framework: Pass / registry / pattern rewriting over Programs.
+
+Capability parity: reference `framework/ir/` — `ir::Pass` (`ir/pass.h`),
+`PassRegistry`, `GraphPatternDetector` (`ir/graph_pattern_detector.h`)
+and the fusion passes built on them (`conv_bn_fuse_pass.cc`,
+`fc_fuse_pass.cc`, ...).
+
+TPU-first scope note: the reference's ~35k LoC of fusion passes exist to
+hand-schedule kernels XLA fuses automatically (SURVEY §7 marks them
+subsumed), so this framework keeps the PUBLIC machinery — write a Pass,
+register it, match op patterns, rewrite the program — with a small set
+of passes that are genuinely useful at the PROGRAM level (dead-op
+elimination, op-level fusions that swap in fused ops the op library
+really has).  Programs here are the JSON Program/Block/Op IR
+(fluid/framework.py), so passes are plain Python over `block.ops`.
+"""
+
+from __future__ import annotations
+
+from . import framework
+
+_PASS_REGISTRY: dict = {}
+
+
+class Pass:
+    """cf. ir/pass.h: named transform over a Program; `set(...)` carries
+    attributes (reference Pass::Set)."""
+
+    name = None
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    def apply(self, program):
+        """Transform `program` IN PLACE and return it."""
+        raise NotImplementedError
+
+
+def register_pass(cls):
+    """Decorator: register a Pass subclass by its `name`."""
+    if not getattr(cls, "name", None):
+        raise ValueError("a Pass must define a class-level `name`")
+    _PASS_REGISTRY[cls.name] = cls
+    return cls
+
+
+def get_pass(name):
+    """cf. PassRegistry::Instance().Get."""
+    if name not in _PASS_REGISTRY:
+        raise KeyError(
+            "no pass named %r (registered: %s)"
+            % (name, ", ".join(sorted(_PASS_REGISTRY))))
+    return _PASS_REGISTRY[name]()
+
+
+def apply_passes(program, names):
+    """Run a pass pipeline (cf. PassBuilder) over the program."""
+    for n in names:
+        p = n if isinstance(n, Pass) else get_pass(n)
+        program = p.apply(program)
+    return program
+
+
+# ---------------------------------------------------------------------------
+# pattern detection (cf. ir/graph_pattern_detector.h, reduced to the
+# op-chain patterns the JSON IR needs)
+# ---------------------------------------------------------------------------
+
+
+def consumers_of(block, var_name):
+    """Ops reading var_name, with their indices."""
+    out = []
+    for i, op in enumerate(block.ops):
+        if var_name in op.all_input_names():
+            out.append((i, op))
+    return out
+
+
+def match_chain(block, types):
+    """Find (i0, [op...]) chains where op_k's FIRST output feeds op_{k+1}
+    as its only consumer — the linear patterns fusion passes match
+    (cf. GraphPatternDetector chains)."""
+    matches = []
+    ops = block.ops
+    for i, op in enumerate(ops):
+        if op.type != types[0]:
+            continue
+        chain = [op]
+        ok = True
+        cur = op
+        for want in types[1:]:
+            outs = cur.all_output_names()
+            if not outs:
+                ok = False
+                break
+            link = outs[0]
+            cons = consumers_of(block, link)
+            if len(cons) != 1 or cons[0][1].type != want:
+                ok = False
+                break
+            cur = cons[0][1]
+            chain.append(cur)
+        if ok:
+            matches.append((i, chain))
+    return matches
+
+
+# ---------------------------------------------------------------------------
+# passes
+# ---------------------------------------------------------------------------
+
+
+@register_pass
+class DeadOpEliminationPass(Pass):
+    """Remove ops whose outputs are never consumed, fetched, or
+    persistable (cf. the reference's eager-deletion/memory passes — at
+    the program level the equivalent hygiene is deleting dead ops so the
+    executor never lowers them).  Set("keep", [names]) protects extra
+    vars (e.g. a fetch list known ahead of time)."""
+
+    name = "dead_op_elimination"
+
+    _SIDE_EFFECT_OPS = {"print", "assert", "py_func", "save", "load",
+                        "c_broadcast", "c_allreduce_sum", "send", "recv"}
+
+    def apply(self, program):
+        keep = set(self.get("keep", []))
+        block = program.current_block()
+        changed = True
+        while changed:
+            changed = False
+            live = set(keep)
+            for op in block.ops:
+                live.update(op.all_input_names())
+            for v in block.vars.values():
+                if getattr(v, "persistable", False):
+                    live.add(v.name)
+            kept_ops = []
+            for op in block.ops:
+                outs = op.all_output_names()
+                if (op.type in self._SIDE_EFFECT_OPS or not outs
+                        or any(o in live for o in outs)
+                        or op.attrs.get("op_role") == "optimize"):
+                    kept_ops.append(op)
+                else:
+                    changed = True
+            block.ops[:] = kept_ops
+        program._bump()
+        return program
+
+
+@register_pass
+class BatchNormActFusePass(Pass):
+    """batch_norm + act (sole consumer) -> fused_batch_norm_act — a real
+    PatternDetector-style rewrite targeting an op the library ships
+    (cf. reference fused_bn_activation and conv_bn_fuse_pass.cc
+    machinery; the arithmetic fusion itself is XLA's job, this keeps the
+    program one op shorter and the pattern API exercised)."""
+
+    name = "batch_norm_act_fuse"
+
+    _ACTS = ("relu", "sigmoid", "tanh")
+
+    def apply(self, program):
+        block = program.current_block()
+        for act in self._ACTS:
+            while True:
+                matches = match_chain(block, ["batch_norm", act])
+                if not matches:
+                    break
+                _, (bn, act_op) = matches[0]
+                bn.type = "fused_batch_norm_act"
+                bn.attrs["act_type"] = act
+                # the fused op's Y takes the activation's output name
+                act_out = act_op.all_output_names()[0]
+                bn.outputs["Y"] = [act_out]
+                block.ops.remove(act_op)
+        program._bump()
+        return program

@@ -862,18 +862,29 @@ def _rpn_target_assign(ctx, ins, attrs):
     anchors = ins["Anchor"][0]                     # [A, 4]
     gtbox = ins["GtBoxes"][0]                      # [N, G, 4]
     crowd = (ins["IsCrowd"][0] if ins.get("IsCrowd") else None)
+    iminfo = ins["ImInfo"][0]                      # [N, 3] (h, w, scale)
     batch = int(attrs.get("rpn_batch_size_per_im", 256))
     fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
     pos_thr = float(attrs.get("rpn_positive_overlap", 0.7))
     neg_thr = float(attrs.get("rpn_negative_overlap", 0.3))
+    straddle = float(attrs.get("rpn_straddle_thresh", 0.0))
     use_random = bool(attrs.get("use_random", True))
+    if crowd is None:
+        crowd = jnp.zeros(gtbox.shape[:2], jnp.int32)
 
-    def per_image(gt, crowd_row, key):
+    def per_image(gt, crowd_row, im, key):
         has_gt = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
-        if crowd_row is not None:
-            has_gt = has_gt & (crowd_row.reshape(-1) == 0)
+        has_gt = has_gt & (crowd_row.reshape(-1) == 0)
         labels, best_gt, _ = _assign_anchor_labels(
             anchors, gt, has_gt, pos_thr, neg_thr)
+        # straddle filter (reference default 0): anchors crossing the
+        # image boundary by more than the threshold never train
+        if straddle >= 0:
+            inside = ((anchors[:, 0] >= -straddle)
+                      & (anchors[:, 1] >= -straddle)
+                      & (anchors[:, 2] < im[1] + straddle)
+                      & (anchors[:, 3] < im[0] + straddle))
+            labels = jnp.where(inside, labels, -1)
         labels = _subsample(key, labels, int(batch * fg_frac), batch,
                             use_random)
         deltas = _bbox_deltas(anchors, gt[best_gt])
@@ -883,12 +894,8 @@ def _rpn_target_assign(ctx, ins, attrs):
                 (labels == 1).astype(jnp.int32))
 
     keys = jax.random.split(ctx.rng(), gtbox.shape[0])  # per-image keys
-    if crowd is not None:
-        outs = jax.vmap(per_image)(gtbox, crowd, keys)
-    else:
-        outs = jax.vmap(
-            lambda g, k: per_image(g, None, k))(gtbox, keys)
-    lab, tb, biw, sidx, lidx = outs
+    lab, tb, biw, sidx, lidx = jax.vmap(per_image)(
+        gtbox, crowd, iminfo, keys)
     return {"TargetLabel": [lab], "TargetBBox": [tb],
             "BBoxInsideWeight": [biw], "ScoreIndex": [sidx],
             "LocationIndex": [lidx]}
@@ -907,11 +914,14 @@ def _retinanet_target_assign(ctx, ins, attrs):
     anchors = ins["Anchor"][0]
     gtbox = ins["GtBoxes"][0]                      # [N, G, 4]
     gtlab = ins["GtLabels"][0]                     # [N, G] (>=1)
+    rcrowd = (ins["IsCrowd"][0] if ins.get("IsCrowd")
+              else jnp.zeros(gtbox.shape[:2], jnp.int32))
     pos_thr = float(attrs.get("positive_overlap", 0.5))
     neg_thr = float(attrs.get("negative_overlap", 0.4))
 
-    def per_image(gt, gl):
+    def per_image(gt, gl, crowd_row):
         has_gt = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
+        has_gt = has_gt & (crowd_row.reshape(-1) == 0)
         labels, best_gt, _ = _assign_anchor_labels(
             anchors, gt, has_gt, pos_thr, neg_thr)
         cls = jnp.where(labels == 1,
@@ -924,7 +934,8 @@ def _retinanet_target_assign(ctx, ins, attrs):
                 (labels >= 0).astype(jnp.int32),
                 (labels == 1).astype(jnp.int32))
 
-    cls, tb, biw, fg, sidx, lidx = jax.vmap(per_image)(gtbox, gtlab)
+    cls, tb, biw, fg, sidx, lidx = jax.vmap(per_image)(
+        gtbox, gtlab, rcrowd)
     return {"TargetLabel": [cls], "TargetBBox": [tb],
             "BBoxInsideWeight": [biw], "ForegroundNumber": [fg],
             "ScoreIndex": [sidx], "LocationIndex": [lidx]}
@@ -954,12 +965,15 @@ def _generate_proposal_labels(ctx, ins, attrs):
     ncls = int(attrs.get("class_nums", 81))
     use_random = bool(attrs.get("use_random", True))
 
-    crowd = ins["IsCrowd"][0] if ins.get("IsCrowd") else None
+    crowd = (ins["IsCrowd"][0] if ins.get("IsCrowd")
+             else jnp.zeros(gtbox.shape[:2], jnp.int32))
 
     def per_image(pr, gt, gl, crowd_row, key):
+        # reference behavior: the gt boxes themselves join the candidate
+        # RoIs, so every valid gt is a foreground sample from step 0
+        pr = jnp.concatenate([pr, gt], axis=0)
         has_gt = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
-        if crowd_row is not None:
-            has_gt = has_gt & (crowd_row.reshape(-1) == 0)
+        has_gt = has_gt & (crowd_row.reshape(-1) == 0)
         iou = _pairwise_iou(pr, gt)
         # invalid gts contribute IoU 0 (not -1): an image with no valid
         # gt still samples its proposals as BACKGROUND (reference
@@ -988,12 +1002,6 @@ def _generate_proposal_labels(ctx, ins, attrs):
         return pr, cls, tgt, biw, bow
 
     keys = jax.random.split(ctx.rng(), rois.shape[0])
-    if crowd is not None:
-        r, c, t, bi, bo = jax.vmap(per_image)(
-            rois, gtbox, gtcls, crowd, keys)
-    else:
-        r, c, t, bi, bo = jax.vmap(
-            lambda p, g, gl, k: per_image(p, g, gl, None, k))(
-            rois, gtbox, gtcls, keys)
+    r, c, t, bi, bo = jax.vmap(per_image)(rois, gtbox, gtcls, crowd, keys)
     return {"Rois": [r], "LabelsInt32": [c], "BboxTargets": [t],
             "BboxInsideWeights": [bi], "BboxOutsideWeights": [bo]}

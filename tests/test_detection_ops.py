@@ -250,13 +250,14 @@ def test_generate_proposal_labels_targets():
         "generate_proposal_labels",
         {"RpnRois": rois, "GtClasses": gtc, "GtBoxes": gt,
          "ImInfo": np.array([[256, 256, 1]], np.float32)},
-        {"batch_size_per_im": 3, "fg_fraction": 0.5, "fg_thresh": 0.5,
+        {"batch_size_per_im": 4, "fg_fraction": 0.5, "fg_thresh": 0.5,
          "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0, "class_nums": C,
          "use_random": False},
         ["LabelsInt32", "BboxTargets", "BboxInsideWeights"])
     lab = outs["LabelsInt32"][0]
     assert lab[0] == 5                    # matched roi carries gt class
     assert (lab[1] == 0) and (lab[2] == 0)
+    assert lab[3] == 5                    # the appended gt box itself
     # targets live only on the matched class's 4-slot block
     tgt = outs["BboxTargets"][0, 0].reshape(C, 4)
     biw = outs["BboxInsideWeights"][0, 0].reshape(C, 4)
@@ -274,7 +275,11 @@ def test_generate_proposal_labels_no_gt_samples_background():
         "generate_proposal_labels",
         {"RpnRois": rois, "GtClasses": gtc, "GtBoxes": gt,
          "ImInfo": np.array([[64, 64, 1]], np.float32)},
-        {"batch_size_per_im": 2, "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
+        {"batch_size_per_im": 4, "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
          "bg_thresh_lo": 0.0, "class_nums": 4, "use_random": False},
         ["LabelsInt32"])
-    assert (outs["LabelsInt32"][0] == 0).all()    # background, not ignored
+    # candidates = proposals + appended gt rows; with no valid gt ALL
+    # sampled candidates are background, none foreground/ignored
+    lab = outs["LabelsInt32"][0]
+    assert lab.shape == (3,)                      # R + G candidates
+    assert (lab == 0).all()
