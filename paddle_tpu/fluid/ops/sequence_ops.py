@@ -372,3 +372,39 @@ def _segment_pool(ctx, ins, attrs):
             denom = jnp.sqrt(denom)
         out = out / denom[..., None]
     return {"Out": [out]}
+
+
+@register_op("sequence_topk_avg_pooling",
+             inputs=["X", "RowLens", "ColLens"], outputs=["Out"],
+             no_grad_slots=("RowLens", "ColLens"))
+def _sequence_topk_avg_pooling(ctx, ins, attrs):
+    """cf. sequence_topk_avg_pooling_op.cc (match-matrix pooling): for
+    each row position and channel, average the top-k column values —
+    out[..., c*K + i] = sum(top_{topks[i]}) / topks[i] (the reference
+    divides by the FULL k, sequence_topk_avg_pooling_op.h:147).
+
+    PADDED redesign of the LoD layout: X [B, C, R, Co] with optional
+    RowLens/ColLens [B] masking the ragged tails; Out [B, R, C*K]."""
+    x = ins["X"][0]
+    b, c, r, co = x.shape
+    topks = [int(k) for k in attrs["topks"]]
+    col_lens = (ins["ColLens"][0].reshape(-1)
+                if ins.get("ColLens") else jnp.full((b,), co))
+    row_lens = (ins["RowLens"][0].reshape(-1)
+                if ins.get("RowLens") else jnp.full((b,), r))
+
+    col_mask = _valid_mask(col_lens, co)                     # [B, Co]
+    xm = jnp.where(col_mask[:, None, None, :], x.astype(jnp.float32),
+                   -jnp.inf)
+    kmax = min(max(topks), co)
+    vals, _ = jax.lax.top_k(xm, kmax)                        # [B,C,R,kmax]
+    vals = jnp.where(jnp.isfinite(vals), vals, 0.0)          # pads -> 0
+    csum = jnp.cumsum(vals, axis=-1)
+    cols = []
+    for k in topks:
+        idx = min(k, co) - 1
+        cols.append(csum[..., idx] / k)                      # [B, C, R]
+    out = jnp.stack(cols, axis=-1)                           # [B,C,R,K]
+    out = out.transpose(0, 2, 1, 3).reshape(b, r, c * len(topks))
+    out = out * _valid_mask(row_lens, r)[:, :, None]
+    return {"Out": [out.astype(x.dtype)]}

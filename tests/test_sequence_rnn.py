@@ -447,3 +447,32 @@ class TestRNNLayers:
         names = {v.name for v in main.global_block.vars.values()
                  if isinstance(v, Parameter)}
         assert "cellw_x" in names and "cellw_h" in names
+
+
+def test_sequence_topk_avg_pooling():
+    from op_test import run_single_op
+
+    rng = np.random.RandomState(0)
+    B, C, R, Co = 2, 3, 4, 5
+    x = rng.randn(B, C, R, Co).astype(np.float32)
+    col_lens = np.array([5, 3], np.int64)
+    row_lens = np.array([4, 2], np.int64)
+    topks = [1, 3]
+    outs, _ = run_single_op(
+        "sequence_topk_avg_pooling",
+        {"X": x, "RowLens": row_lens, "ColLens": col_lens},
+        {"topks": topks, "channel_num": C}, ["Out"])
+    got = outs["Out"]
+    assert got.shape == (B, R, C * len(topks))
+    for b in range(B):
+        for r in range(R):
+            for c in range(C):
+                row = x[b, c, r, :col_lens[b]]
+                top = np.sort(row)[::-1]
+                for i, k in enumerate(topks):
+                    ref = top[:k].sum() / k
+                    if r >= row_lens[b]:
+                        ref = 0.0
+                    np.testing.assert_allclose(
+                        got[b, r, c * len(topks) + i], ref,
+                        rtol=1e-5, atol=1e-5)
