@@ -398,7 +398,11 @@ def _sequence_topk_avg_pooling(ctx, ins, attrs):
                    -jnp.inf)
     kmax = min(max(topks), co)
     vals, _ = jax.lax.top_k(xm, kmax)                        # [B,C,R,kmax]
-    vals = jnp.where(jnp.isfinite(vals), vals, 0.0)          # pads -> 0
+    # zero the PAD positions by position (col_lens), not by finiteness —
+    # a legitimate -inf/NaN in a valid column must propagate
+    pos_ok = (jnp.arange(kmax)[None, :]
+              < jnp.minimum(col_lens, kmax)[:, None])        # [B, kmax]
+    vals = jnp.where(pos_ok[:, None, None, :], vals, 0.0)
     csum = jnp.cumsum(vals, axis=-1)
     cols = []
     for k in topks:
@@ -408,3 +412,17 @@ def _sequence_topk_avg_pooling(ctx, ins, attrs):
     out = out.transpose(0, 2, 1, 3).reshape(b, r, c * len(topks))
     out = out * _valid_mask(row_lens, r)[:, :, None]
     return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("match_matrix_tensor", inputs=["X", "Y", "W"],
+             outputs=["Out", "Tmp"])
+def _match_matrix_tensor(ctx, ins, attrs):
+    """cf. match_matrix_tensor_op.cc: per-channel bilinear match matrix
+    out[b, t, i, j] = x[b, i] @ W[:, t, :] @ y[b, j] for text-matching
+    pairs (feeds sequence_topk_avg_pooling).  PADDED redesign: X
+    [B, Lx, D], Y [B, Ly, D], W [D, dim_t, D] -> Out [B, dim_t, Lx, Ly]
+    (ragged tails are the caller's mask, as with the pooling op)."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["W"][0]
+    tmp = jnp.einsum("bid,dte->bite", x, w)       # [B, Lx, T, D]
+    out = jnp.einsum("bite,bje->btij", tmp, y)    # [B, T, Lx, Ly]
+    return {"Out": [out], "Tmp": [tmp]}

@@ -455,7 +455,7 @@ def test_sequence_topk_avg_pooling():
     rng = np.random.RandomState(0)
     B, C, R, Co = 2, 3, 4, 5
     x = rng.randn(B, C, R, Co).astype(np.float32)
-    col_lens = np.array([5, 3], np.int64)
+    col_lens = np.array([5, 2], np.int64)   # batch 1: col_len < max(topks)
     row_lens = np.array([4, 2], np.int64)
     topks = [1, 3]
     outs, _ = run_single_op(
@@ -476,3 +476,20 @@ def test_sequence_topk_avg_pooling():
                     np.testing.assert_allclose(
                         got[b, r, c * len(topks) + i], ref,
                         rtol=1e-5, atol=1e-5)
+
+
+def test_match_matrix_tensor():
+    from op_test import check_grad, run_single_op
+
+    rng = np.random.RandomState(1)
+    B, Lx, Ly, D, T = 2, 3, 4, 5, 2
+    x = rng.randn(B, Lx, D).astype(np.float32)
+    y = rng.randn(B, Ly, D).astype(np.float32)
+    w = rng.randn(D, T, D).astype(np.float32)
+    outs, _ = run_single_op("match_matrix_tensor",
+                            {"X": x, "Y": y, "W": w}, {"dim_t": T},
+                            ["Out"])
+    ref = np.einsum("bid,dte,bje->btij", x, w, y)
+    np.testing.assert_allclose(outs["Out"], ref, rtol=1e-4, atol=1e-5)
+    check_grad("match_matrix_tensor", {"X": x, "Y": y, "W": w},
+               {"dim_t": T}, ["Out"], ["X", "W"], rtol=1e-2, atol=1e-2)
