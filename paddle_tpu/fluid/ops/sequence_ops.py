@@ -400,8 +400,7 @@ def _sequence_topk_avg_pooling(ctx, ins, attrs):
     vals, _ = jax.lax.top_k(xm, kmax)                        # [B,C,R,kmax]
     # zero the PAD positions by position (col_lens), not by finiteness —
     # a legitimate -inf/NaN in a valid column must propagate
-    pos_ok = (jnp.arange(kmax)[None, :]
-              < jnp.minimum(col_lens, kmax)[:, None])        # [B, kmax]
+    pos_ok = _valid_mask(col_lens, kmax)                     # [B, kmax]
     vals = jnp.where(pos_ok[:, None, None, :], vals, 0.0)
     csum = jnp.cumsum(vals, axis=-1)
     cols = []

@@ -1,0 +1,89 @@
+// C++ training demo (capability parity: reference `train/demo/` and
+// `train/test_train_recognize_digits.cc` — a pure-C++ program that loads
+// a program and drives the train loop against the framework runtime).
+//
+// In this TPU-first design the runtime IS Python+XLA (one language by
+// design, SURVEY §2.1 "Pybind layer: n/a"), so the native demo embeds
+// the CPython interpreter the way the reference links libpaddle: the
+// C++ main owns the process, builds the regression program through the
+// embedded runtime, runs the training loop step by step from C++, and
+// reads the fetched losses back as C doubles.
+//
+// Build + run (see tests/test_native_train_demo.py, which does this):
+//   g++ -O2 train_demo.cc $(python3-config --includes) \
+//       $(python3-config --ldflags --embed) -o train_demo
+//   ./train_demo
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+static const char* kBuild = R"PY(
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+main, startup = fluid.Program(), fluid.Program()
+main.random_seed = startup.random_seed = 7
+with fluid.program_guard(main, startup):
+    x = layers.data("x", shape=[-1, 13], append_batch_size=False)
+    y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+    pred = layers.fc(layers.fc(x, 32, act="relu"), 1)
+    loss = layers.reduce_mean(layers.square(pred - y))
+    fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+
+scope = fluid.Scope()
+exe = fluid.Executor()
+_sg = fluid.scope_guard(scope)
+_sg.__enter__()
+exe.run(startup)
+
+rng = np.random.RandomState(0)
+_w = rng.randn(13, 1).astype("float32")
+
+def train_step():
+    xb = rng.randn(32, 13).astype("float32")
+    yb = xb @ _w
+    (lv,) = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+    return float(np.mean(lv))
+)PY";
+
+static double run_step(PyObject* globals) {
+  PyObject* r = PyRun_String("train_step()", Py_eval_input, globals, globals);
+  if (!r) {
+    PyErr_Print();
+    std::exit(2);
+  }
+  double v = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return v;
+}
+
+int main() {
+  Py_Initialize();
+  PyObject* m = PyImport_AddModule("__main__");
+  PyObject* g = PyModule_GetDict(m);
+  // CPU backend: the demo must run anywhere the library does
+  PyRun_String("import os; os.environ.setdefault('JAX_PLATFORMS','cpu')",
+               Py_file_input, g, g);
+  if (!PyRun_String(kBuild, Py_file_input, g, g)) {
+    PyErr_Print();
+    return 2;
+  }
+  double first = -1, last = -1;
+  for (int step = 0; step < 40; ++step) {
+    last = run_step(g);
+    if (step == 0) first = last;
+    if (step % 10 == 0) std::printf("step %d loss %.4f\n", step, last);
+  }
+  std::printf("first %.4f final %.4f\n", first, last);
+  if (!(last < first * 0.2)) {
+    std::fprintf(stderr, "loss did not converge\n");
+    return 1;
+  }
+  std::printf("C++ training demo OK\n");
+  Py_Finalize();
+  return 0;
+}

@@ -492,4 +492,5 @@ def test_match_matrix_tensor():
     ref = np.einsum("bid,dte,bje->btij", x, w, y)
     np.testing.assert_allclose(outs["Out"], ref, rtol=1e-4, atol=1e-5)
     check_grad("match_matrix_tensor", {"X": x, "Y": y, "W": w},
-               {"dim_t": T}, ["Out"], ["X", "W"], rtol=1e-2, atol=1e-2)
+               {"dim_t": T}, ["Out"], ["X", "Y", "W"], rtol=1e-2,
+               atol=1e-2)
