@@ -74,3 +74,170 @@ class MSELoss(Layer):
 
     def forward(self, input, label):
         return functional.mse_loss(input, label, self._reduction)
+
+
+# ---------------------------------------------------------------------------
+# 2.0 argument-convention layers (reference python/paddle/nn/layer/*.py:
+# in_channels/out_channels/kernel_size names over the same lowerings)
+# ---------------------------------------------------------------------------
+
+
+class Conv2D(Layer):  # noqa: F811 — shadows the fluid-signature import
+    """cf. paddle.nn.Conv2D (2.0 signature): in_channels, out_channels,
+    kernel_size, stride, padding, dilation, groups.  The fluid-signature
+    class remains at fluid.dygraph.Conv2D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None,
+                 data_format="NCHW"):
+        super().__init__()
+        from ..fluid.dygraph import Conv2D as _C
+
+        self._c = _C(in_channels, out_channels, kernel_size, stride=stride,
+                     padding=padding, dilation=dilation, groups=groups,
+                     bias_attr=bias_attr, data_format=data_format)
+
+    def forward(self, x):
+        return self._c(x)
+
+
+Conv2d = Conv2D  # torch-style alias
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self._args = (kernel_size, stride or kernel_size, padding)
+
+    def forward(self, x):
+        k, s, p = self._args
+        return functional.max_pool2d(x, k, s, p)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 exclusive=True):
+        super().__init__()
+        self._args = (kernel_size, stride or kernel_size, padding,
+                      exclusive)
+
+    def forward(self, x):
+        k, s, p, e = self._args
+        return functional.avg_pool2d(x, k, s, p, e)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self._size = output_size
+
+    def forward(self, x):
+        return functional.adaptive_avg_pool2d(x, self._size)
+
+
+class BatchNorm2D(Layer):
+    """cf. paddle.nn.BatchNorm2D: num_features-first 2.0 signature."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 data_format="NCHW"):
+        super().__init__()
+        from ..fluid.dygraph import BatchNorm as _BN
+
+        self._bn = _BN(num_features, momentum=momentum, epsilon=epsilon,
+                       data_layout=data_format)
+
+    def forward(self, x):
+        return self._bn(x)
+
+
+BatchNorm1D = BatchNorm2D  # same op; rank comes from the input
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self._a = (start_axis, stop_axis)
+
+    def forward(self, x):
+        from ..fluid import layers as _L
+
+        start, stop = self._a
+        nd = len(x.shape)
+        stop = stop % nd
+        dims = list(x.shape)
+        merged = 1
+        known = True
+        for d in dims[start:stop + 1]:
+            if d is None or int(d) < 0:
+                known = False
+                break
+            merged *= int(d)
+        new_shape = (dims[:start]
+                     + [merged if known else -1]
+                     + dims[stop + 1:])
+        new_shape = [int(d) if d is not None else -1 for d in new_shape]
+        return _L.reshape(x, new_shape)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._ns = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self._ns)
+
+
+class SiLU(Layer):
+    def forward(self, x):
+        return functional.silu(x)
+
+
+Swish = SiLU
+
+
+class Hardswish(Layer):
+    def forward(self, x):
+        return functional.hardswish(x)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._r = reduction
+
+    def forward(self, input, label):
+        return functional.l1_loss(input, label, self._r)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction="mean", delta=1.0):
+        super().__init__()
+        self._r, self._d = reduction, delta
+
+    def forward(self, input, label):
+        return functional.smooth_l1_loss(input, label, self._r, self._d)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._r = reduction
+
+    def forward(self, logit, label):
+        return functional.binary_cross_entropy_with_logits(
+            logit, label, self._r)
+
+
+class NLLLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._r = reduction
+
+    def forward(self, log_prob, label):
+        return functional.nll_loss(log_prob, label, self._r)

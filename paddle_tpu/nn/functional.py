@@ -73,3 +73,104 @@ def normalize(x, p=2, axis=1, epsilon=1e-12):
 
 def pad(x, paddings, value=0.0):
     return _L.pad(x, paddings, pad_value=value)
+
+
+def relu6(x):
+    return _L.relu6(x)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _L.leaky_relu(x, alpha=negative_slope)
+
+
+def silu(x):
+    return x * _L.sigmoid(x)
+
+
+swish = silu
+
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train"):
+    # 2.0 spells the infer-scaling mode "downscale_in_infer"; the fluid
+    # attr is "downgrade_in_infer"
+    fluid_mode = ("downgrade_in_infer" if mode == "downscale_in_infer"
+                  else mode)
+    if not training:
+        # downgrade mode scales by (1-p) at inference (op eval path)
+        if fluid_mode == "downgrade_in_infer" and p:
+            return x * (1.0 - p)
+        return x
+    if p == 0:
+        return x
+    return _L.dropout(x, dropout_prob=p, dropout_implementation=fluid_mode,
+                      is_test=False)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0):
+    return _L.pool2d(x, pool_size=kernel_size, pool_type="max",
+                     pool_stride=stride or kernel_size,
+                     pool_padding=padding)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True):
+    return _L.pool2d(x, pool_size=kernel_size, pool_type="avg",
+                     pool_stride=stride or kernel_size,
+                     pool_padding=padding, exclusive=exclusive)
+
+
+def adaptive_avg_pool2d(x, output_size):
+    return _L.adaptive_pool2d(x, output_size, pool_type="avg")
+
+
+def l1_loss(input, label, reduction="mean"):
+    d = _L.abs(input - label)
+    if reduction == "mean":
+        return _L.reduce_mean(d)
+    if reduction == "sum":
+        return _L.reduce_sum(d)
+    return d
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = _L.abs(input - label)
+    q = _L.clip(d, 0.0, float(delta))
+    v = 0.5 * q * q + delta * (d - q)
+    if reduction == "mean":
+        return _L.reduce_mean(v)
+    if reduction == "sum":
+        return _L.reduce_sum(v)
+    return v
+
+
+def binary_cross_entropy_with_logits(logit, label, reduction="mean"):
+    # stable: max(l,0) - l*y + log(1 + exp(-|l|))
+    v = _L.relu(logit) - logit * label + _L.log(
+        1.0 + _L.exp(-_L.abs(logit)))
+    if reduction == "mean":
+        return _L.reduce_mean(v)
+    if reduction == "sum":
+        return _L.reduce_sum(v)
+    return v
+
+
+def log_softmax(x, axis=-1):
+    return _L.log_softmax(x, axis=axis)  # stable x - logsumexp lowering
+
+
+def nll_loss(log_prob, label, reduction="mean"):
+    """Classes on axis 1 for rank > 2 inputs (paddle.nn.NLLLoss
+    convention); rank-2 inputs have classes last."""
+    nd = len(log_prob.shape)
+    if nd > 2:
+        # [N, C, d1..] -> [N, d1.., C]
+        perm = [0] + list(range(2, nd)) + [1]
+        log_prob = _L.transpose(log_prob, perm)
+    c = int(log_prob.shape[-1])
+    flat = _L.reshape(log_prob, [-1, c])
+    oh = _L.one_hot(_L.reshape(label, [-1, 1]), c)
+    v = -_L.reduce_sum(oh * flat, dim=-1)
+    if reduction == "mean":
+        return _L.reduce_mean(v)
+    if reduction == "sum":
+        return _L.reduce_sum(v)
+    return v

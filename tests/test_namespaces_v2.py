@@ -82,3 +82,115 @@ def test_metric_namespace():
     labels = np.array([[0], [1]], np.int64)
     m.update(preds, labels)  # raw (pred, label) form
     assert m.eval() == 1.0
+
+
+def test_nn_20_layers_train_lenet_style():
+    """2.0-convention layers (Conv2d/MaxPool2D/BatchNorm2D/Flatten +
+    losses) compose into a trainable net (reference paddle.nn surface)."""
+    import numpy as np
+
+    import paddle_tpu
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import nn
+    from paddle_tpu.fluid import dygraph
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.net = nn.Sequential(
+                nn.Conv2d(1, 4, 3, padding=1),
+                nn.BatchNorm2D(4),
+                nn.ReLU(),
+                nn.MaxPool2D(2),
+                nn.Conv2d(4, 8, 3, padding=1),
+                nn.LeakyReLU(0.1),
+                nn.AdaptiveAvgPool2D(1),
+                nn.Flatten(),
+                nn.Linear(8, 3),
+            )
+
+        def forward(self, x):
+            return self.net(x)
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(24, 1, 8, 8).astype(np.float32)
+    ys = rng.randint(0, 3, (24, 1)).astype(np.int64)
+    for i in range(24):
+        xs[i, 0, ys[i, 0] * 2:(ys[i, 0] + 1) * 2] += 2.0
+    with dygraph.guard():
+        net = Net()
+        ce = nn.CrossEntropyLoss()
+        opt = fluid.optimizer.AdamOptimizer(5e-3)
+        losses = []
+        for _ in range(15):
+            logits = net(dygraph.to_variable(xs))
+            loss = ce(logits, dygraph.to_variable(ys))
+            loss.backward()
+            opt.minimize(loss, parameter_list=net.parameters())
+            net.clear_gradients()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0], losses
+
+
+def test_nn_functional_losses_match_numpy():
+    import numpy as np
+
+    from paddle_tpu import nn
+    from paddle_tpu.fluid import dygraph
+
+    rng = np.random.RandomState(1)
+    a = rng.randn(6, 4).astype(np.float32)
+    b = rng.randn(6, 4).astype(np.float32)
+    y01 = (rng.rand(6, 4) > 0.5).astype(np.float32)
+    with dygraph.guard():
+        av, bv = dygraph.to_variable(a), dygraph.to_variable(b)
+        yv = dygraph.to_variable(y01)
+        np.testing.assert_allclose(
+            float(nn.functional.l1_loss(av, bv).numpy()),
+            np.abs(a - b).mean(), rtol=1e-5)
+        d = np.abs(a - b)
+        sl1 = np.where(d < 1.0, 0.5 * d * d, d - 0.5).mean()
+        np.testing.assert_allclose(
+            float(nn.functional.smooth_l1_loss(av, bv).numpy()),
+            sl1, rtol=1e-5)
+        bce = (np.maximum(a, 0) - a * y01 + np.log1p(np.exp(-np.abs(a)))
+               ).mean()
+        np.testing.assert_allclose(
+            float(nn.functional.binary_cross_entropy_with_logits(
+                av, yv).numpy()), bce, rtol=1e-5)
+
+
+def test_nn_20_review_regressions():
+    """log_softmax stability, Flatten stop_axis, dropout infer scaling,
+    Conv2D 2.0 keywords, NLLLoss channel axis."""
+    import numpy as np
+
+    from paddle_tpu import nn
+    from paddle_tpu.fluid import dygraph
+
+    with dygraph.guard():
+        # log_softmax with large spread is exact, not epsilon-clamped
+        x = dygraph.to_variable(np.array([[0.0, 100.0]], np.float32))
+        ls = nn.functional.log_softmax(x).numpy()
+        np.testing.assert_allclose(ls[0, 0], -100.0, rtol=1e-5)
+        # Flatten honors stop_axis
+        t = dygraph.to_variable(np.zeros((2, 3, 4, 5), np.float32))
+        assert tuple(nn.Flatten(1, 2)(t).shape) == (2, 12, 5)
+        assert tuple(nn.Flatten(0, 1)(t).shape) == (6, 4, 5)
+        # dropout downscale_in_infer scales at inference
+        v = dygraph.to_variable(np.ones((4,), np.float32))
+        out = nn.functional.dropout(v, p=0.5, training=False,
+                                    mode="downscale_in_infer").numpy()
+        np.testing.assert_allclose(out, 0.5 * np.ones(4), rtol=1e-6)
+        # Conv2D accepts 2.0 keywords
+        conv = nn.Conv2D(in_channels=1, out_channels=2, kernel_size=3,
+                         padding=1)
+        y = conv(dygraph.to_variable(np.zeros((1, 1, 4, 4), np.float32)))
+        assert tuple(y.shape) == (1, 2, 4, 4)
+        # NLLLoss with classes on axis 1 (segmentation layout)
+        lp = dygraph.to_variable(
+            np.log(np.full((2, 3, 2, 2), 1 / 3, np.float32)))
+        lab = dygraph.to_variable(np.zeros((2, 2, 2), np.int64))
+        v = nn.NLLLoss()(lp, lab)
+        np.testing.assert_allclose(float(v.numpy()), np.log(3.0),
+                                   rtol=1e-5)
