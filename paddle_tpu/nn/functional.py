@@ -11,9 +11,9 @@ log_softmax = _L.log_softmax
 dropout = _L.dropout
 elu = _L.elu
 selu = _L.selu
-leaky_relu = _L.leaky_relu
 mish = _L.mish
 silu = _L.silu
+swish = silu
 softplus = _L.softplus
 softsign = _L.softsign
 
@@ -83,13 +83,6 @@ def leaky_relu(x, negative_slope=0.01):
     return _L.leaky_relu(x, alpha=negative_slope)
 
 
-def silu(x):
-    return x * _L.sigmoid(x)
-
-
-swish = silu
-
-
 def dropout(x, p=0.5, training=True, mode="upscale_in_train"):
     # 2.0 spells the infer-scaling mode "downscale_in_infer"; the fluid
     # attr is "downgrade_in_infer"
@@ -132,9 +125,11 @@ def l1_loss(input, label, reduction="mean"):
 
 
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    """paddle 2.0 formula: 0.5*z^2/delta for z < delta, else
+    z - 0.5*delta."""
     d = _L.abs(input - label)
     q = _L.clip(d, 0.0, float(delta))
-    v = 0.5 * q * q + delta * (d - q)
+    v = 0.5 * q * q / delta + (d - q)
     if reduction == "mean":
         return _L.reduce_mean(v)
     if reduction == "sum":
@@ -153,13 +148,10 @@ def binary_cross_entropy_with_logits(logit, label, reduction="mean"):
     return v
 
 
-def log_softmax(x, axis=-1):
-    return _L.log_softmax(x, axis=axis)  # stable x - logsumexp lowering
-
-
 def nll_loss(log_prob, label, reduction="mean"):
     """Classes on axis 1 for rank > 2 inputs (paddle.nn.NLLLoss
-    convention); rank-2 inputs have classes last."""
+    convention); rank-2 inputs have classes last.  reduction='none'
+    returns the label-shaped per-element loss."""
     nd = len(log_prob.shape)
     if nd > 2:
         # [N, C, d1..] -> [N, d1.., C]
@@ -173,4 +165,6 @@ def nll_loss(log_prob, label, reduction="mean"):
         return _L.reduce_mean(v)
     if reduction == "sum":
         return _L.reduce_sum(v)
-    return v
+    lab_shape = [int(s) if s is not None and int(s) >= 0 else -1
+                 for s in label.shape]
+    return _L.reshape(v, lab_shape)

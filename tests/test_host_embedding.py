@@ -122,7 +122,12 @@ def test_host_embedding_matches_dense_updates():
         exe.run(dstartup)
         import jax.numpy as jnp
 
-        s2.set("dense_table", jnp.asarray(table._rows))  # identical init
+        # EXPLICIT copy: jnp.asarray may zero-copy-alias the numpy
+        # buffer on CPU (alignment-dependent), and the host session's
+        # push mutates table._rows in place — aliasing made the "dense
+        # twin" see one host-SGD step early (the rare full-suite flake)
+        init_rows = table._rows.copy()
+        s2.set("dense_table", jnp.asarray(init_rows))
         # identical fc init: deep-copy from the host-program scope (the
         # session donates s1's buffers, so sharing objects would alias a
         # to-be-deleted array)
@@ -133,6 +138,11 @@ def test_host_embedding_matches_dense_updates():
         (l_host,) = sess.run({"ids": idv, "y": yv}, fetch_list=[loss],
                              lr=0.2)
     with fluid.scope_guard(s2):
+        # guard against buffer aliasing regressions: the dense table
+        # must still hold the PRE-update snapshot after the host step
+        np.testing.assert_allclose(
+            np.asarray(s2.find_var("dense_table")), init_rows,
+            err_msg="dense_table aliased the live host table")
         (l_dense,) = exe.run(dmain, feed={"ids": idv, "y": yv},
                              fetch_list=[loss_d])
         new_dense = np.asarray(s2.find_var("dense_table"))
