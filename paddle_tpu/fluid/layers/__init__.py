@@ -34,6 +34,7 @@ from .learning_rate_scheduler import (  # noqa: F401
     polynomial_decay,
 )
 from . import detection  # noqa: F401
+from .detection import *  # noqa: F401,F403  (top-level like the reference)
 from .crf import (  # noqa: F401
     chunk_eval,
     crf_decoding,

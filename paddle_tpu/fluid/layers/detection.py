@@ -3,6 +3,15 @@ detection.py): thin wrappers over the registered detection ops."""
 
 from .common import append_simple_op
 
+__all__ = [
+    "prior_box", "density_prior_box", "anchor_generator", "yolo_box",
+    "multiclass_nms", "multiclass_nms2", "roi_align", "bipartite_match",
+    "generate_proposals", "iou_similarity", "box_coder", "box_clip",
+    "polygon_box_transform", "detection_map", "sigmoid_focal_loss",
+    "target_assign", "box_decoder_and_assign", "collect_fpn_proposals",
+    "distribute_fpn_proposals",
+]
+
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
               variance=None, flip=False, clip=False, steps=None,
@@ -82,6 +91,21 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
          "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
          "background_label": background_label},
         stop_gradient=True)
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k,
+                    keep_top_k, nms_threshold=0.3, background_label=0,
+                    return_index=False):
+    """cf. python/paddle/fluid/layers/detection.py multiclass_nms2: NMS
+    that can also return the kept-box Index (image_idx * M + box_idx into
+    the flattened input batch; -1 in empty slots)."""
+    out, idx = append_simple_op(
+        "multiclass_nms2", {"BBoxes": bboxes, "Scores": scores},
+        {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+         "background_label": background_label},
+        out_slots=("Out", "Index"), stop_gradient=True)
+    return (out, idx) if return_index else out
 
 
 def roi_align(input, rois, pooled_height=1, pooled_width=1,

@@ -236,20 +236,19 @@ def _yolo_box(ctx, ins, attrs):
     }
 
 
-@register_op("multiclass_nms", inputs=["BBoxes", "Scores"], outputs=["Out"],
-             grad=None)
-def _multiclass_nms(ctx, ins, attrs):
-    """cf. multiclass_nms_op.cc.  STATIC-shape redesign: returns
-    [N, keep_top_k, 6] = (label, score, x1, y1, x2, y2) with label = -1
-    in empty slots (the reference emits a LoD-compacted variable-length
-    list, impossible under XLA).  Suppression is the O(K^2) IoU mask
-    matrix over the per-class top-K, not a sequential greedy loop."""
-    bboxes, scores = ins["BBoxes"][0], ins["Scores"][0]
-    # bboxes [N, M, 4], scores [N, C, M]
+def multiclass_nms_core(bboxes, scores, attrs):
+    """Shared NMS core for multiclass_nms / multiclass_nms2.  STATIC-shape
+    redesign: returns (out [N, keep_top_k, 6] = (label, score, x1, y1, x2,
+    y2) with label = -1 in empty slots, src [N, keep_top_k] = source box
+    index into M, -1 in empty slots).  The reference emits a LoD-compacted
+    variable-length list, impossible under XLA.  Suppression is the O(K^2)
+    IoU mask matrix over the per-class top-K, not a sequential greedy
+    loop."""
     score_threshold = float(attrs.get("score_threshold", 0.0))
     nms_threshold = float(attrs.get("nms_threshold", 0.3))
     nms_top_k = int(attrs.get("nms_top_k", 64))
     keep_top_k = int(attrs.get("keep_top_k", 100))
+    background = int(attrs.get("background_label", 0))
     n, m, _ = bboxes.shape
     c = scores.shape[1]
     k = min(nms_top_k, m)
@@ -263,29 +262,45 @@ def _multiclass_nms(ctx, ins, attrs):
             higher = jnp.triu(jnp.ones((k, k), jnp.bool_), 1).T
             sup = jnp.any((iou > nms_threshold) & higher, axis=1)
             keep = (~sup) & (vals > score_threshold)
-            return jnp.where(keep, vals, -1.0), cand
+            return jnp.where(keep, vals, -1.0), cand, idx
 
-        cls_vals, cls_boxes = jax.vmap(one_class)(sc)  # [C,k], [C,k,4]
+        cls_vals, cls_boxes, cls_src = jax.vmap(one_class)(sc)
+        if 0 <= background < c:
+            # the reference skips the background class entirely
+            # (multiclass_nms_op.cc NMSFast: c == background_label)
+            cls_vals = cls_vals.at[background].set(-1.0)
         labels = jnp.broadcast_to(
             jnp.arange(c, dtype=jnp.float32)[:, None], (c, k)
         )
         flat_scores = cls_vals.reshape(-1)
         flat_boxes = cls_boxes.reshape(-1, 4)
         flat_labels = labels.reshape(-1)
+        flat_src = cls_src.reshape(-1)
         kk = min(keep_top_k, flat_scores.shape[0])
         top_vals, top_idx = jax.lax.top_k(flat_scores, kk)
+        valid = top_vals > 0
         out = jnp.concatenate([
-            jnp.where(top_vals[:, None] > 0,
-                      flat_labels[top_idx][:, None], -1.0),
+            jnp.where(valid[:, None], flat_labels[top_idx][:, None], -1.0),
             top_vals[:, None],
             flat_boxes[top_idx],
         ], axis=1)  # [kk, 6]
+        src = jnp.where(valid, flat_src[top_idx], -1).astype(jnp.int32)
         if kk < keep_top_k:
             pad = jnp.full((keep_top_k - kk, 6), -1.0, out.dtype)
             out = jnp.concatenate([out, pad], axis=0)
-        return out
+            src = jnp.concatenate(
+                [src, jnp.full((keep_top_k - kk,), -1, jnp.int32)])
+        return out, src
 
-    return {"Out": [jax.vmap(one_image)(bboxes, scores)]}
+    return jax.vmap(one_image)(bboxes, scores)
+
+
+@register_op("multiclass_nms", inputs=["BBoxes", "Scores"], outputs=["Out"],
+             grad=None)
+def _multiclass_nms(ctx, ins, attrs):
+    """cf. multiclass_nms_op.cc — see multiclass_nms_core."""
+    out, _ = multiclass_nms_core(ins["BBoxes"][0], ins["Scores"][0], attrs)
+    return {"Out": [out]}
 
 
 @register_op("roi_align", inputs=["X", "ROIs"], outputs=["Out"],
@@ -798,12 +813,18 @@ def _bbox_deltas(anchors, gt):
                       jnp.log(gw / aw), jnp.log(gh / ah)], axis=-1)
 
 
-def _assign_anchor_labels(anchors, gtbox, has_gt, pos_thr, neg_thr):
+def _assign_anchor_labels(anchors, gtbox, has_gt, pos_thr, neg_thr,
+                          anchor_valid=None):
     """IoU matching core shared by the target-assign ops: returns
     (labels [A] in {1,0,-1}, matched gt index [A], max IoU [A]).
-    Anchors matching no gt well enough stay -1 (ignore)."""
+    Anchors matching no gt well enough stay -1 (ignore).  anchor_valid
+    [A] masks anchors out BEFORE assignment (reference straddle filter
+    order), so the per-gt best-anchor rule runs over valid anchors
+    only; invalid anchors end -1."""
     iou = _pairwise_iou(anchors, gtbox)            # [A, G]
     iou = jnp.where(has_gt[None, :], iou, -1.0)
+    if anchor_valid is not None:
+        iou = jnp.where(anchor_valid[:, None], iou, -1.0)
     best_gt = jnp.argmax(iou, axis=1)              # [A]
     best_iou = jnp.max(iou, axis=1)
     labels = jnp.full((anchors.shape[0],), -1, jnp.int32)
@@ -815,6 +836,8 @@ def _assign_anchor_labels(anchors, gtbox, has_gt, pos_thr, neg_thr):
         (iou >= per_gt_best[None, :] - 1e-6) & (iou > 0)
         & has_gt[None, :], axis=1)
     labels = jnp.where(is_gt_best, 1, labels)
+    if anchor_valid is not None:
+        labels = jnp.where(anchor_valid, labels, -1)
     return labels, best_gt, best_iou
 
 
@@ -875,16 +898,18 @@ def _rpn_target_assign(ctx, ins, attrs):
     def per_image(gt, crowd_row, im, key):
         has_gt = (gt[:, 2] > gt[:, 0]) & (gt[:, 3] > gt[:, 1])
         has_gt = has_gt & (crowd_row.reshape(-1) == 0)
-        labels, best_gt, _ = _assign_anchor_labels(
-            anchors, gt, has_gt, pos_thr, neg_thr)
-        # straddle filter (reference default 0): anchors crossing the
-        # image boundary by more than the threshold never train
+        # straddle filter (reference default 0) runs BEFORE assignment:
+        # anchors crossing the image boundary are excluded up front so a
+        # gt whose best anchor straddles still gets its best IN-BOUNDS
+        # anchor forced positive (reference order)
+        inside = None
         if straddle >= 0:
             inside = ((anchors[:, 0] >= -straddle)
                       & (anchors[:, 1] >= -straddle)
                       & (anchors[:, 2] < im[1] + straddle)
                       & (anchors[:, 3] < im[0] + straddle))
-            labels = jnp.where(inside, labels, -1)
+        labels, best_gt, _ = _assign_anchor_labels(
+            anchors, gt, has_gt, pos_thr, neg_thr, anchor_valid=inside)
         labels = _subsample(key, labels, int(batch * fg_frac), batch,
                             use_random)
         deltas = _bbox_deltas(anchors, gt[best_gt])

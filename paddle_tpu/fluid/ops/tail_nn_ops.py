@@ -227,13 +227,17 @@ def _max_pool3d_with_index(ctx, ins, attrs):
 def _unpool(ctx, ins, attrs):
     """cf. unpool_op.cc: scatter pooled values back to their recorded max
     positions (indices are flat in-plane, matching
-    max_pool2d_with_index)."""
+    max_pool2d_with_index).  Scatter mode is .set (overwrite), not .add:
+    with overlapping windows (stride < ksize) two pooled cells can record
+    the same source index; the reference writes the value once, and since
+    duplicated indices carry the identical source value, overwrite is
+    exact where summing would double it."""
     x, idx = ins["X"][0], ins["Indices"][0]
     B, C, Hi, Wi = x.shape
     Ho, Wo = (int(s) for s in attrs["unpooled_shape"])
 
     def plane(v, i):
-        return jnp.zeros((Ho * Wo,), v.dtype).at[i.reshape(-1)].add(
+        return jnp.zeros((Ho * Wo,), v.dtype).at[i.reshape(-1)].set(
             v.reshape(-1)).reshape(Ho, Wo)
 
     out = jax.vmap(jax.vmap(plane))(x, idx.astype(jnp.int32))
@@ -609,16 +613,17 @@ def _yolov3_loss(ctx, ins, attrs):
              outputs=["Out", "Index"], grad=None)
 def _multiclass_nms2(ctx, ins, attrs):
     """cf. multiclass_nms_op.cc (v2 adds the kept-box Index output; same
-    static [N, keep_top_k, 6] redesign as multiclass_nms, Index = -1 in
-    empty slots)."""
-    res = get_op_def("multiclass_nms").lower(ctx, ins, attrs)
-    out = res["Out"][0]
-    # index of the kept box within its image's flattened (class, box)
-    # score list is not tracked by the static path; emit slot validity
-    # (-1 padding, row index otherwise) as the index surrogate
-    keep = out[..., 0] >= 0
-    idx = jnp.where(
-        keep, jnp.broadcast_to(jnp.arange(out.shape[1]), keep.shape), -1)
+    static [N, keep_top_k, 6] redesign as multiclass_nms).  Index matches
+    the reference's [N,C,M]-score path addressing: image_idx * M + box_idx
+    into the flattened batch of input boxes (-1 in empty slots), so code
+    that gathers per-box features with Index reads the right rows."""
+    from .detection_ops import multiclass_nms_core
+
+    bboxes = ins["BBoxes"][0]
+    out, src = multiclass_nms_core(bboxes, ins["Scores"][0], attrs)
+    m = bboxes.shape[1]
+    offs = (jnp.arange(out.shape[0], dtype=jnp.int32) * m)[:, None]
+    idx = jnp.where(src >= 0, src + offs, -1)
     return {"Out": [out], "Index": [idx.astype(jnp.int32)[..., None]]}
 
 

@@ -26,7 +26,10 @@ axis:
 Limitations (explicit, erroring): forward stage ops may not write
 persistable state (batch_norm running stats would need a sequential
 carry across microbatches), and the local batch must divide
-num_microbatches.
+num_microbatches.  Full-batch parity holds for mean- AND sum-reduction
+losses: the loss reduction is detected from the program
+(`_loss_reduction_kind`) and microbatch losses are averaged or summed
+accordingly; unrecognized reductions default to mean.
 """
 
 from __future__ import annotations
@@ -101,6 +104,39 @@ def split_forward_stages(ops, loss_name, n_stages):
     return stage_ops, aux_ops, opt_ops, boundary, produced_at
 
 
+def _loss_reduction_kind(ops, loss_name):
+    """'mean' or 'sum': how the program reduces the per-example loss.
+
+    Full-batch parity of the microbatched schedule depends on it: for a
+    mean loss, mean-of-microbatch-losses == full-batch loss (equal
+    microbatches); for a sum loss the microbatch losses must be SUMMED or
+    the loss/grads shrink by 1/num_microbatches.  Walks back from the
+    loss var through reduction-neutral ops (scale/cast/assign) to the
+    first reducing op; unrecognized producers default to 'mean' (the
+    overwhelmingly common convention)."""
+    produced_by = {}
+    for op in ops:
+        for n in op.all_output_names():
+            produced_by[n] = op
+    name = loss_name
+    for _ in range(16):                       # bounded walk-back
+        op = produced_by.get(name)
+        if op is None:
+            break
+        if op.type in ("mean", "reduce_mean"):
+            return "mean"
+        if op.type == "reduce_sum":
+            return "sum"
+        if op.type in ("scale", "cast", "assign", "share_data"):
+            ins = op.all_input_names()
+            if not ins:
+                break
+            name = ins[0]
+            continue
+        break
+    return "mean"
+
+
 def _check_no_stateful_forward(stage_ops, block, scope):
     for sops in stage_ops:
         for op in sops:
@@ -128,6 +164,7 @@ def build_pipeline_jit(program, block, ops, feed_names, feed_shapes,
     stage_ops, aux_ops, opt_ops, boundary, produced_at = \
         split_forward_stages(ops, loss_name, n_stages)
     _check_no_stateful_forward(stage_ops, block, scope)
+    loss_reduction = _loss_reduction_kind(ops, loss_name)
 
     # prune aux (non-loss-ancestor) ops nothing consumes, then reject the
     # survivors that read stage activations with a targeted diagnostic
@@ -261,8 +298,11 @@ def build_pipeline_jit(program, block, ops, feed_names, feed_shapes,
         (_, acc), _ = jax.lax.scan(
             tick, (bnd0, jnp.float32(0)),
             jnp.arange(n_micro + n_stages - 1))
-        # only the last stage accumulated; the psum broadcasts the total
-        return jax.lax.psum(acc, "pp") / n_micro
+        # only the last stage accumulated; the psum broadcasts the total.
+        # mean losses average over microbatches (== full-batch mean);
+        # sum losses just sum (== full-batch sum) — see _loss_reduction_kind
+        total = jax.lax.psum(acc, "pp")
+        return total / n_micro if loss_reduction == "mean" else total
 
     sharded_loss = jax.shard_map(
         pp_forward,

@@ -248,9 +248,18 @@ class DataLoader:
 
         def issue_up_to(limit):
             nonlocal issued
+            if self._mp_epoch != epoch:
+                return                       # superseded: stop issuing work
             while issued < min(limit, len(batches)):
                 task_q.put((epoch, issued, batches[issued]))
                 issued += 1
+
+        def check_live():
+            if self._mp_epoch != epoch:
+                raise RuntimeError(
+                    "this DataLoader iterator was invalidated by a newer "
+                    "iteration (one live iterator per DataLoader when "
+                    "num_workers > 0)")
 
         issue_up_to(window)
         pending = {}
@@ -258,11 +267,7 @@ class DataLoader:
         received = 0
         stalled_polls = 0
         while received < len(batches):
-            if self._mp_epoch != epoch:
-                raise RuntimeError(
-                    "this DataLoader iterator was invalidated by a newer "
-                    "iteration (one live iterator per DataLoader when "
-                    "num_workers > 0)")
+            check_live()
             try:
                 ep, i, b, e = res_q.get(timeout=5.0)
                 stalled_polls = 0
@@ -299,6 +304,10 @@ class DataLoader:
             while next_i in pending:
                 yield pending.pop(next_i)
                 next_i += 1
+                # a newer iterator may have invalidated us while we were
+                # suspended at the yield — stop issuing and raise NOW, not
+                # several buffered batches later
+                check_live()
                 issue_up_to(next_i + window)
 
     def _ensure_pool(self):

@@ -120,7 +120,7 @@ def test_multiclass_nms_suppresses_overlaps():
     outs, _ = run_single_op(
         "multiclass_nms", {"BBoxes": boxes, "Scores": scores},
         {"score_threshold": 0.01, "nms_threshold": 0.5, "nms_top_k": 3,
-         "keep_top_k": 5},
+         "keep_top_k": 5, "background_label": -1},
         ["Out"],
     )
     out = outs["Out"][0]  # [5, 6]
@@ -283,3 +283,118 @@ def test_generate_proposal_labels_no_gt_samples_background():
     lab = outs["LabelsInt32"][0]
     assert lab.shape == (3,)                      # R + G candidates
     assert (lab == 0).all()
+
+
+# --- round-5: NMS reference-compat + Index semantics ------------------------
+
+
+def _reference_greedy_nms(boxes, scores, score_thr, nms_thr, nms_top_k,
+                          keep_top_k, background=0):
+    """Sequential greedy NMS, the reference algorithm
+    (multiclass_nms_op.cc NMSFast + keep_top_k re-sort), for ONE image.
+    Returns list of (label, score, box_idx)."""
+    selected = []  # (label, score, idx)
+    C, M = scores.shape
+    for c in range(C):
+        if c == background:                # reference skips background
+            continue
+        order = np.argsort(-scores[c], kind="stable")[:nms_top_k]
+        kept = []
+        for i in order:
+            if scores[c, i] <= score_thr:
+                continue
+            ok = True
+            for j in kept:
+                if _iou(boxes[i:i + 1], boxes[j:j + 1])[0, 0] > nms_thr:
+                    ok = False
+                    break
+            if ok:
+                kept.append(i)
+        selected += [(c, scores[c, i], i) for i in kept]
+    selected.sort(key=lambda t: -t[1])
+    return selected[:keep_top_k]
+
+
+def test_multiclass_nms_masked_consumer_matches_reference_set():
+    """Weak-item pin: the fixed-shape [N, keep_top_k, 6] output, consumed
+    through the label>=0 mask, recovers exactly the detection set the
+    reference's LoD-compacted variable-length output carries on a shared
+    fixture."""
+    r = np.random.RandomState(7)
+    N, M, C = 2, 12, 3
+    boxes = np.sort(r.rand(N, M, 2, 2) * 60, axis=2).reshape(N, M, 4)
+    boxes[..., 2:] += 1.0
+    boxes = boxes.astype(np.float32)
+    scores = r.rand(N, C, M).astype(np.float32)
+    attrs = {"score_threshold": 0.3, "nms_threshold": 0.4,
+             "nms_top_k": 8, "keep_top_k": 6}
+    outs, _ = run_single_op(
+        "multiclass_nms", {"BBoxes": boxes, "Scores": scores},
+        attrs, ["Out"])
+    for n in range(N):
+        ref = _reference_greedy_nms(
+            boxes[n], scores[n], attrs["score_threshold"],
+            attrs["nms_threshold"], attrs["nms_top_k"],
+            attrs["keep_top_k"])
+        out = outs["Out"][n]
+        kept = out[out[:, 0] >= 0]              # the masked-consumer view
+        assert len(kept) == len(ref), (kept, ref)
+        # same (label, score) multiset, same boxes, score-descending
+        got = sorted(
+            [(int(l), round(float(s), 5)) for l, s in kept[:, :2]])
+        want = sorted([(c, round(float(s), 5)) for c, s, _ in ref])
+        assert got == want
+        for (c, s, i), row in zip(ref, kept):
+            assert int(row[0]) == c
+            np.testing.assert_allclose(row[2:], boxes[n, i], rtol=1e-6)
+
+
+def test_multiclass_nms2_index_gathers_source_boxes():
+    """Index = image_idx * M + box_idx into the flattened input batch
+    (reference [N,C,M] addressing, multiclass_nms_op.cc offset = i * M):
+    gathering input boxes with Index must reproduce the output boxes."""
+    r = np.random.RandomState(11)
+    N, M, C = 2, 10, 2
+    boxes = np.sort(r.rand(N, M, 2, 2) * 40, axis=2).reshape(N, M, 4)
+    boxes[..., 2:] += 1.0
+    boxes = boxes.astype(np.float32)
+    scores = r.rand(N, C, M).astype(np.float32)
+    outs, _ = run_single_op(
+        "multiclass_nms2", {"BBoxes": boxes, "Scores": scores},
+        {"score_threshold": 0.25, "nms_threshold": 0.5, "nms_top_k": 6,
+         "keep_top_k": 5},
+        ["Out", "Index"])
+    out, idx = outs["Out"], outs["Index"][..., 0]
+    flat = boxes.reshape(-1, 4)
+    valid = out[..., 0] >= 0
+    assert ((idx >= 0) == valid).all()
+    # every valid slot's Index points at its own source box
+    np.testing.assert_allclose(
+        flat[idx[valid]], out[valid][:, 2:], rtol=1e-6)
+    # and Index rows stay inside their own image's [i*M, (i+1)*M) range
+    for n in range(N):
+        v = idx[n][valid[n]]
+        assert ((v >= n * M) & (v < (n + 1) * M)).all()
+
+
+def test_rpn_target_assign_straddle_before_best_anchor_forcing():
+    """ADVICE r4: with rpn_straddle_thresh=0 a gt whose BEST anchor
+    crosses the image border must still get its best IN-BOUNDS anchor
+    forced positive (reference filters straddlers before assignment)."""
+    # anchor 0 straddles the border and overlaps the gt best; anchor 1 is
+    # in-bounds with moderate (sub-threshold) overlap; anchor 2 is far.
+    anchors = np.array([[-5, -5, 12, 12],      # straddler, best IoU
+                        [0, 0, 10, 10],        # in-bounds, IoU ~0.47
+                        [30, 30, 40, 40]], np.float32)
+    gt = np.array([[[1, 1, 12, 12]]], np.float32)
+    outs, _ = run_single_op(
+        "rpn_target_assign",
+        {"Anchor": anchors, "GtBoxes": gt,
+         "ImInfo": np.array([[20, 20, 1]], np.float32)},
+        {"rpn_positive_overlap": 0.7, "rpn_negative_overlap": 0.3,
+         "rpn_batch_size_per_im": 4, "rpn_straddle_thresh": 0.0,
+         "use_random": False},
+        ["TargetLabel"])
+    lab = outs["TargetLabel"][0]
+    assert lab[0] == -1, lab   # straddler excluded entirely
+    assert lab[1] == 1, lab    # best in-bounds anchor forced positive

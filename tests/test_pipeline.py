@@ -407,3 +407,51 @@ def test_static_pipeline_eval_clone_and_aux_metric_error():
         # aux metric on a stage activation -> targeted error
         with _pytest.raises(Exception, match="not an ancestor of the loss"):
             exe.run(main, feed=feed, fetch_list=[loss, err])
+
+
+def test_static_pipeline_sum_loss_parity():
+    """ADVICE r4: sum-reduction losses must NOT shrink by
+    1/num_microbatches — microbatch losses are summed, not averaged
+    (_loss_reduction_kind detects reduce_sum)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.distributed.pipeline import PipelineOptimizer
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.optimizer import SGDOptimizer
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 23
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 8], append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        with fluid.device_guard("gpu:0"):
+            h = layers.fc(x, size=8, act="relu",
+                          param_attr="sl_fc0.w", bias_attr="sl_fc0.b")
+        with fluid.device_guard("gpu:1"):
+            pred = layers.fc(h, size=1,
+                             param_attr="sl_fc1.w", bias_attr="sl_fc1.b")
+            loss = layers.reduce_sum(layers.square(pred - y))
+        PipelineOptimizer(SGDOptimizer(0.01),
+                          num_microbatches=4).minimize(loss, startup)
+
+    def run(mesh):
+        rng = np.random.RandomState(4)
+        xs = rng.randn(4, 8, 8).astype(np.float32)
+        ys = rng.randn(4, 8, 1).astype(np.float32)
+        scope = fluid.Scope()
+        exe = fluid.Executor(mesh=mesh)
+        out = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for t in range(4):
+                (lv,) = exe.run(main, feed={"x": xs[t], "y": ys[t]},
+                                fetch_list=[loss])
+                out.append(float(np.mean(lv)))
+        params = {n: np.asarray(scope.find_var(n))
+                  for n in ("sl_fc0.w", "sl_fc1.w")}
+        return out, params
+
+    pipe, pp = run(dist.DeviceMesh({"pp": 2}))
+    base, bp = run(None)
+    np.testing.assert_allclose(pipe, base, rtol=2e-4, atol=2e-4)
+    for n in bp:
+        np.testing.assert_allclose(pp[n], bp[n], rtol=2e-4, atol=2e-4)

@@ -275,6 +275,25 @@ def test_max_pool2d_with_index_and_unpool():
                rtol=1e-2, atol=1e-3)
 
 
+def test_unpool_overlapping_windows_writes_not_sums():
+    """ADVICE r4: stride < ksize lets two pooled cells record the SAME
+    max index; the scatter must overwrite (reference single write), not
+    sum the duplicates."""
+    # one dominant peak: every overlapping window picks index 5 (=[1,1])
+    x = np.zeros((1, 1, 3, 3), np.float32)
+    x[0, 0, 1, 1] = 7.0
+    outs, _ = run_single_op(
+        "max_pool2d_with_index", {"X": x},
+        {"ksize": [2, 2], "strides": [1, 1]}, ["Out", "Mask"])
+    assert (outs["Mask"] == 4).all()          # all 4 windows hit (1,1)
+    outs2, _ = run_single_op(
+        "unpool", {"X": outs["Out"], "Indices": outs["Mask"]},
+        {"unpooled_shape": [3, 3]}, ["Out"])
+    up = outs2["Out"][0, 0]
+    assert up[1, 1] == 7.0                    # written once, not 28.0
+    assert up.sum() == 7.0
+
+
 def test_max_pool3d_with_index():
     x = _rand(1, 2, 4, 4, 4)
     outs, _ = run_single_op(
