@@ -1,3 +1,9 @@
-"""Model compression (reference `python/paddle/fluid/contrib/slim/`)."""
+"""Model compression (reference `python/paddle/fluid/contrib/slim/`):
+quantization, filter pruning, knowledge distillation, NAS, and the
+Compressor strategy driver."""
 
+from . import core  # noqa: F401
+from . import distillation  # noqa: F401
+from . import nas  # noqa: F401
+from . import prune  # noqa: F401
 from . import quantization  # noqa: F401

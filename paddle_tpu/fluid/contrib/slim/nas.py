@@ -1,0 +1,167 @@
+"""Neural architecture search: SA controller + search space + driver.
+
+Capability parity: reference `contrib/slim/searcher/controller.py:1`
+(EvolutionaryController / SAController — simulated-annealing token
+search), `contrib/slim/nas/search_space.py:1` (SearchSpace abstract:
+init_tokens / range_table / create_net), and
+`contrib/slim/nas/light_nas_strategy.py:1` + `controller_server.py:1` +
+`search_agent.py:1` (the search loop).
+
+TPU-first scope note: the reference splits the controller into a socket
+server + agents because its trial workers are separate GPU processes;
+here trials are jit-compiled programs launched from one host process, so
+`SANAS` runs the controller in process and the server/agent pair is
+subsumed.  A `constrain_func` hook covers the reference's FLOPs/latency
+constraint filtering.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["SearchSpace", "EvolutionaryController", "SAController",
+           "SANAS"]
+
+
+class SearchSpace:
+    """cf. nas/search_space.py SearchSpace: a token-vector model space."""
+
+    def init_tokens(self):
+        """The starting token vector."""
+        raise NotImplementedError("Abstract method.")
+
+    def range_table(self):
+        """range_table()[i] = number of choices for tokens[i]."""
+        raise NotImplementedError("Abstract method.")
+
+    def create_net(self, tokens):
+        """Build the network for `tokens`.  Returns whatever the reward
+        function consumes (the reference returns startup/train/eval
+        programs + metrics)."""
+        raise NotImplementedError("Abstract method.")
+
+    def get_model_latency(self, program):
+        """Optional latency model for constraint search."""
+        raise NotImplementedError("Abstract method.")
+
+
+class EvolutionaryController:
+    """cf. searcher/controller.py EvolutionaryController."""
+
+    def update(self, tokens, reward):
+        raise NotImplementedError("Abstract method.")
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        raise NotImplementedError("Abstract method.")
+
+    def next_tokens(self):
+        raise NotImplementedError("Abstract method.")
+
+
+class SAController(EvolutionaryController):
+    """cf. searcher/controller.py SAController: accept a worse solution
+    with probability exp((reward - best) / temperature), temperature
+    decaying geometrically — classic simulated annealing over the token
+    vector; one random position mutates per step."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_try_number=300, seed=None):
+        self._range_table = range_table
+        self._reduce_rate = float(reduce_rate)
+        self._init_temperature = float(init_temperature)
+        self._max_try_number = int(max_try_number)
+        self._rng = np.random.RandomState(seed)
+        self._reward = -np.inf
+        self._tokens = None
+        self._max_reward = -np.inf
+        self._best_tokens = None
+        self._iter = 0
+        self._constrain_func = None
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens)
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        self._iter += 1
+        temperature = self._init_temperature * \
+            self._reduce_rate ** self._iter
+        if reward > self._reward or self._rng.random_sample() <= math.exp(
+                min((reward - self._reward) / max(temperature, 1e-12), 0.0)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self, control_token=None):
+        tokens = list(control_token) if control_token else list(self._tokens)
+        # only positions with >1 choice can mutate (range 1 = fixed slot)
+        mutable = [i for i, r in enumerate(self._range_table) if r > 1]
+        if not mutable:
+            return list(tokens)
+
+        def mutate():
+            new_tokens = list(tokens)
+            index = mutable[self._rng.randint(len(mutable))]
+            new_tokens[index] = (
+                new_tokens[index]
+                + self._rng.randint(self._range_table[index] - 1) + 1
+            ) % self._range_table[index]
+            return new_tokens
+
+        new_tokens = mutate()
+        if self._constrain_func is None:
+            return new_tokens
+        for _ in range(self._max_try_number):
+            if self._constrain_func(new_tokens):
+                return new_tokens
+            new_tokens = mutate()
+        # constraint exhausted every proposal: stay at the (valid)
+        # current tokens rather than hand back a violating vector
+        return list(tokens)
+
+
+class SANAS:
+    """The search loop (reference LightNASStrategy + controller server /
+    search agent, run in process — see module docstring).
+
+    Usage::
+
+        nas = SANAS(space, reward_fn, search_steps=50)
+        best_tokens, best_reward = nas.search()
+
+    reward_fn(net, tokens) -> float consumes whatever space.create_net
+    returned (train a few steps, eval, return the metric)."""
+
+    def __init__(self, search_space, reward_fn, search_steps=100,
+                 controller=None, constrain_func=None, seed=None):
+        self._space = search_space
+        self._reward_fn = reward_fn
+        self._steps = int(search_steps)
+        self._controller = controller or SAController(seed=seed)
+        self._controller.reset(search_space.range_table(),
+                               search_space.init_tokens(), constrain_func)
+        self.history = []          # (tokens, reward) per trial
+
+    def search(self):
+        tokens = list(self._space.init_tokens())
+        for _ in range(self._steps):
+            net = self._space.create_net(tokens)
+            reward = float(self._reward_fn(net, tokens))
+            self.history.append((list(tokens), reward))
+            self._controller.update(tokens, reward)
+            tokens = self._controller.next_tokens()
+        return self._controller.best_tokens, self._controller.max_reward
