@@ -13,10 +13,16 @@ pointing the loaders at downloaded files; the consuming code is unchanged.
 from . import (  # noqa: F401
     cifar,
     conll05,
+    flowers,
+    image,
     imdb,
+    imikolov,
     mnist,
     movielens,
+    mq2007,
+    sentiment,
     uci_housing,
+    voc2012,
     wmt14,
     wmt16,
 )

@@ -1,0 +1,54 @@
+"""Flowers-102 reader (reference `python/paddle/dataset/flowers.py:1`):
+3x224x224 float image + int label in [0, 102), train/test/valid splits,
+optional mapper applied per sample.  Synthetic separable classes
+(hue-blob position encodes the class), deterministic per split."""
+
+import numpy as np
+
+__all__ = ["train", "test", "valid"]
+
+_CLASSES = 102
+
+
+def _make(n, seed):
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, _CLASSES, size=(n,)).astype(np.int64)
+    imgs = rs.rand(n, 3, 224, 224).astype(np.float32) * 0.2
+    for i, c in enumerate(labels):
+        ch = int(c) % 3
+        r, col = divmod(int(c) // 3, 6)
+        imgs[i, ch, 20 + r * 32: 52 + r * 32,
+             20 + col * 32: 52 + col * 32] += 0.8
+    return imgs, labels
+
+
+def _creator(n, seed, mapper=None):
+    def reader():
+        x, y = _make(n, seed)
+        for i in range(n):
+            sample = (x[i].reshape(-1), int(y[i]))
+            yield mapper(sample) if mapper is not None else sample
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
+          n=256):
+    if not cycle:
+        return _creator(n, seed=61, mapper=mapper)
+
+    def reader():
+        while True:
+            for s in _creator(n, seed=61, mapper=mapper)():
+                yield s
+
+    return reader
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
+         n=64):
+    return _creator(n, seed=62, mapper=mapper)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True, n=64):
+    return _creator(n, seed=63, mapper=mapper)

@@ -1,0 +1,91 @@
+"""Image augmentation utilities (reference `python/paddle/dataset/
+image.py:61`): resize_short, to_chw, center_crop, random_crop,
+left_right_flip, simple_transform on HWC numpy arrays.
+
+TPU-first note: these run in the HOST data pipeline (reader workers),
+exactly like the reference's cv2-based versions; the resize here is
+pure-numpy bilinear, so no cv2 dependency (none in this image)."""
+
+import numpy as np
+
+__all__ = ["resize_short", "to_chw", "center_crop", "random_crop",
+           "left_right_flip", "simple_transform"]
+
+
+def _resize(im, h, w):
+    """Bilinear resize of an HW or HWC float array."""
+    im = np.asarray(im, np.float32)
+    sh, sw = im.shape[:2]
+    ys = (np.arange(h) + 0.5) * sh / h - 0.5
+    xs = (np.arange(w) + 0.5) * sw / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, sh - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, sw - 1)
+    y1 = np.minimum(y0 + 1, sh - 1)
+    x1 = np.minimum(x0 + 1, sw - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :]
+    if im.ndim == 3:
+        wy, wx = wy[..., None], wx[..., None]
+    a = im[y0][:, x0]
+    b = im[y0][:, x1]
+    c = im[y1][:, x0]
+    d = im[y1][:, x1]
+    return a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx \
+        + c * wy * (1 - wx) + d * wy * wx
+
+
+def resize_short(im, size):
+    """Scale so the SHORTER edge becomes `size` (aspect preserved)."""
+    h, w = im.shape[:2]
+    scale = float(size) / min(h, w)
+    return _resize(im, int(round(h * scale)), int(round(w * scale)))
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return np.asarray(im).transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    if h < size or w < size:
+        raise ValueError(
+            "center_crop size %d exceeds image %dx%d" % (size, h, w))
+    y0 = (h - size) // 2
+    x0 = (w - size) // 2
+    return im[y0: y0 + size, x0: x0 + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    if h < size or w < size:
+        raise ValueError(
+            "random_crop size %d exceeds image %dx%d" % (size, h, w))
+    y0 = np.random.randint(0, h - size + 1)
+    x0 = np.random.randint(0, w - size + 1)
+    return im[y0: y0 + size, x0: x0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize_short + (random crop + coin-flip mirror | center crop) +
+    HWC->CHW + optional mean subtraction (reference image.py:327)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color=is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color=is_color)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype("float32")
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and im.ndim == 3 and is_color:
+            mean = mean[:, np.newaxis, np.newaxis]
+        im -= mean
+    return im

@@ -1,0 +1,61 @@
+"""MQ2007 LETOR ranking reader (reference `python/paddle/dataset/
+mq2007.py:1`): per-query documents with 46-dim features and 0..2
+relevance, served in pointwise / pairwise / listwise formats.  Synthetic
+queries whose relevance is a noisy linear function of the features,
+deterministic per split."""
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+_FDIM = 46
+
+
+def _queries(n_queries, seed):
+    rs = np.random.RandomState(seed)
+    # ONE relevance function shared by all splits (else train and test
+    # would rank by different ground truths and nothing generalizes)
+    w = np.random.RandomState(100).randn(_FDIM) / np.sqrt(_FDIM)
+    out = []
+    for _ in range(n_queries):
+        nd = int(rs.randint(5, 20))
+        feats = rs.randn(nd, _FDIM).astype(np.float32)
+        score = feats @ w + 0.1 * rs.randn(nd)
+        rel = np.digitize(score, [-0.4, 0.6]).astype(np.int64)  # 0..2
+        out.append((feats, rel))
+    return out
+
+
+def _creator(n_queries, seed, format):
+    def pointwise():
+        for feats, rel in _queries(n_queries, seed):
+            for i in range(len(rel)):
+                yield int(rel[i]), feats[i]
+
+    def pairwise():
+        for feats, rel in _queries(n_queries, seed):
+            for i in range(len(rel)):
+                for j in range(len(rel)):
+                    if rel[i] > rel[j]:
+                        yield 1, feats[i], feats[j]
+
+    def listwise():
+        for feats, rel in _queries(n_queries, seed):
+            yield rel.tolist(), feats
+
+    if format == "pointwise":
+        return pointwise
+    if format == "pairwise":
+        return pairwise
+    if format == "listwise":
+        return listwise
+    raise ValueError(
+        "format must be pointwise/pairwise/listwise, got %r" % format)
+
+
+def train(format="pairwise", n_queries=32):
+    return _creator(n_queries, seed=101, format=format)
+
+
+def test(format="pairwise", n_queries=8):
+    return _creator(n_queries, seed=102, format=format)

@@ -1,0 +1,48 @@
+"""NLTK movie-review sentiment reader (reference `python/paddle/dataset/
+sentiment.py:1`): (word-id list, 0/1 polarity) pairs + get_word_dict.
+Synthetic: a sentiment-bearing vocabulary where polar words decide the
+label, deterministic per split."""
+
+import numpy as np
+
+__all__ = ["train", "test", "get_word_dict"]
+
+_VOCAB = 600
+_POS = list(range(10, 40))        # positive word ids
+_NEG = list(range(40, 70))        # negative word ids
+
+
+def get_word_dict():
+    """word -> id, most frequent first (reference sorts by frequency)."""
+    return {"w%d" % i: i for i in range(_VOCAB)}
+
+
+def _make(n, seed):
+    rs = np.random.RandomState(seed)
+    data = []
+    for _ in range(n):
+        label = int(rs.randint(0, 2))
+        ln = int(rs.randint(6, 40))
+        words = rs.randint(70, _VOCAB, size=(ln,)).tolist()
+        polar = _POS if label == 1 else _NEG
+        for _ in range(max(1, ln // 5)):
+            words[int(rs.randint(0, ln))] = int(
+                polar[int(rs.randint(0, len(polar)))])
+        data.append(([int(w) for w in words], label))
+    return data
+
+
+def _creator(n, seed):
+    def reader():
+        for words, label in _make(n, seed):
+            yield words, label
+
+    return reader
+
+
+def train(n=256):
+    return _creator(n, seed=81)
+
+
+def test(n=64):
+    return _creator(n, seed=82)

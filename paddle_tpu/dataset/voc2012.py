@@ -1,0 +1,45 @@
+"""VOC2012 segmentation reader (reference `python/paddle/dataset/
+voc2012.py:1`): (image [3, H, W] float, label mask [H, W] int in
+[0, 21)) pairs, train/test/val splits.  Synthetic: each image carries a
+colored rectangle whose mask is the class id, deterministic per split."""
+
+import numpy as np
+
+__all__ = ["train", "test", "val"]
+
+_CLASSES = 21
+_H = _W = 64
+
+
+def _make(n, seed):
+    rs = np.random.RandomState(seed)
+    imgs = rs.rand(n, 3, _H, _W).astype(np.float32) * 0.2
+    masks = np.zeros((n, _H, _W), np.int64)
+    for i in range(n):
+        c = rs.randint(1, _CLASSES)
+        y0, x0 = rs.randint(4, _H // 2), rs.randint(4, _W // 2)
+        h, w = rs.randint(8, _H // 2), rs.randint(8, _W // 2)
+        imgs[i, c % 3, y0: y0 + h, x0: x0 + w] += 0.7
+        masks[i, y0: y0 + h, x0: x0 + w] = c
+    return imgs, masks
+
+
+def _creator(n, seed):
+    def reader():
+        x, m = _make(n, seed)
+        for i in range(n):
+            yield x[i], m[i]
+
+    return reader
+
+
+def train(n=64):
+    return _creator(n, seed=71)
+
+
+def test(n=16):
+    return _creator(n, seed=72)
+
+
+def val(n=16):
+    return _creator(n, seed=73)

@@ -26,6 +26,26 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True):
     )
 
 
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """cf. reference layers/tensor.py create_parameter: a standalone
+    trainable parameter (startup-initialized persistable var)."""
+    import copy
+
+    from ..layer_helper import LayerHelper, ParamAttr
+
+    helper = LayerHelper("create_parameter")
+    if attr is False:
+        return None
+    attr = ParamAttr._to_attr(attr)       # str/Initializer/None -> ParamAttr
+    if name is not None and attr.name is None:
+        attr = copy.copy(attr)            # never mutate the caller's attr
+        attr.name = name
+    return helper.create_parameter(attr, list(shape), dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
 def fill_constant(shape, dtype, value, name=None):
     return append_simple_op(
         "fill_constant",

@@ -20,6 +20,7 @@ from . import (  # noqa: F401
     random_ops,
     reduce_ops,
     rnn_ops,
+    search_ops,
     sequence_ops,
     tail_nn_ops,
     tail_ops,

@@ -1,0 +1,147 @@
+"""Dataset-tail readers power real training/eval (reference
+`python/paddle/dataset/tests/` patterns): flowers, voc2012, sentiment,
+imikolov, mq2007, image utils."""
+
+import numpy as np
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu import dataset
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.optimizer import AdamOptimizer
+
+
+def test_flowers_reader_trains_classifier():
+    r = dataset.flowers.train(n=96)
+    first = next(r())
+    assert first[0].shape == (3 * 224 * 224,)
+    assert 0 <= first[1] < 102
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[3 * 224 * 224])
+        label = layers.data("label", shape=[1], dtype="int64")
+        logits = layers.fc(layers.fc(img, size=32, act="relu"), size=102)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        AdamOptimizer(1e-3).minimize(loss)
+    exe = fluid.Executor()
+    losses = []
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(3):
+            for batch in paddle_tpu.batch(r, batch_size=32)():
+                x = np.stack([b[0] for b in batch])
+                y = np.array([[b[1]] for b in batch], np.int64)
+                lv, = exe.run(main, feed={"img": x, "label": y},
+                              fetch_list=[loss])
+                losses.append(float(np.mean(lv)))
+    assert losses[-1] < losses[0]
+    # mapper hook (reference train(mapper=...)) applies per sample
+    seen = []
+    m = dataset.flowers.train(mapper=lambda s: (s[0] * 0, s[1]), n=4)
+    for x, y in m():
+        seen.append(float(np.abs(x).sum()))
+    assert seen == [0.0] * 4
+
+
+def test_voc2012_reader_masks_align():
+    got = 0
+    for img, mask in dataset.voc2012.train(n=8)():
+        assert img.shape == (3, 64, 64) and mask.shape == (64, 64)
+        assert mask.max() < 21
+        c = int(mask.max())
+        assert c >= 1
+        # the bright rectangle sits exactly where the mask says
+        region = img[c % 3][mask == c]
+        rest = img[c % 3][mask == 0]
+        assert region.mean() > rest.mean() + 0.3
+        got += 1
+    assert got == 8
+    assert len(list(dataset.voc2012.val()())) == 16
+
+
+def test_sentiment_reader_is_learnable():
+    wd = dataset.sentiment.get_word_dict()
+    assert len(wd) == 600
+    # bag-of-words logistic regression separates the polar vocabulary
+    V = len(wd)
+
+    def bow(reader, n):
+        X = np.zeros((n, V), np.float32)
+        y = np.zeros((n,), np.int64)
+        for i, (words, label) in enumerate(reader()):
+            for w in words:
+                X[i, w] += 1
+            y[i] = label
+        return X, y
+
+    Xtr, ytr = bow(dataset.sentiment.train(n=256), 256)
+    Xte, yte = bow(dataset.sentiment.test(n=64), 64)
+    w = np.zeros((V,))
+    for _ in range(200):
+        p = 1 / (1 + np.exp(-(Xtr @ w)))
+        w += 0.1 * Xtr.T @ (ytr - p) / len(ytr)
+    acc = np.mean(((Xte @ w) > 0).astype(int) == yte)
+    assert acc > 0.8, acc
+
+
+def test_imikolov_ngram_and_seq():
+    wd = dataset.imikolov.build_dict()
+    assert "<unk>" in wd and "<e>" in wd
+    grams = list(dataset.imikolov.train(wd, 5, n_sentences=32)())
+    assert grams and all(len(g) == 5 for g in grams)
+    vocab_n = max(wd.values()) + 1
+    assert all(0 <= w < vocab_n for g in grams for w in g)
+    # seq mode: target is source shifted by one, ends with <e>
+    for src, tgt in dataset.imikolov.train(
+            wd, 5, dataset.imikolov.DataType.SEQ, n_sentences=8)():
+        assert len(src) == len(tgt)
+        assert src[1:] == tgt[:-1]
+        assert tgt[-1] == wd["<e>"]
+
+
+def test_mq2007_formats_and_ranking_signal():
+    # pointwise: (rel, feat); listwise: (rels, feats) grouped by query
+    p = list(dataset.mq2007.train(format="pointwise", n_queries=8)())
+    assert all(f.shape == (46,) and 0 <= r <= 2 for r, f in p)
+    li = list(dataset.mq2007.train(format="listwise", n_queries=8)())
+    assert len(li) == 8
+    assert all(len(rels) == feats.shape[0] for rels, feats in li)
+    # pairwise: first doc of the pair is the more relevant one, and a
+    # linear scorer trained on the pairs ranks held-out pairs well
+    pairs = list(dataset.mq2007.train(format="pairwise", n_queries=24)())
+    assert all(lbl == 1 for lbl, a, b in pairs)
+    w = np.zeros(46)
+    for _ in range(30):
+        for _, a, b in pairs:
+            if (a - b) @ w <= 1:                       # hinge
+                w += 0.01 * (a - b)
+    test_pairs = list(dataset.mq2007.test(format="pairwise")())
+    acc = np.mean([float((a - b) @ w > 0) for _, a, b in test_pairs])
+    assert acc > 0.75, acc
+
+
+def test_image_utils_oracles():
+    from paddle_tpu.dataset import image as im
+
+    x = np.arange(6 * 8 * 3, dtype=np.float32).reshape(6, 8, 3)
+    r = im.resize_short(x, 12)                         # short edge 6 -> 12
+    assert r.shape == (12, 16, 3)
+    # bilinear resize preserves the global mean (roughly)
+    assert abs(r.mean() - x.mean()) < 1.0
+    assert im.to_chw(x).shape == (3, 6, 8)
+    c = im.center_crop(x, 4)
+    np.testing.assert_allclose(c, x[1:5, 2:6])
+    f = im.left_right_flip(x)
+    np.testing.assert_allclose(f[:, 0], x[:, -1])
+    np.random.seed(0)
+    t = im.simple_transform(x, 12, 8, is_train=True)
+    assert t.shape == (3, 8, 8) and t.dtype == np.float32
+    t2 = im.simple_transform(x, 12, 8, is_train=False,
+                             mean=[1.0, 2.0, 3.0])
+    ref = im.to_chw(im.center_crop(im.resize_short(x, 12), 8)).astype(
+        np.float32) - np.array([1, 2, 3], np.float32)[:, None, None]
+    np.testing.assert_allclose(t2, ref, rtol=1e-6)
+    rc = im.random_crop(x, 4)
+    assert rc.shape == (4, 4, 3)

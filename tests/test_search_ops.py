@@ -1,0 +1,311 @@
+"""Oracles for the search-ranking/tree op tail (reference unittest
+patterns: test_lod_reset_op.py, test_filter_by_instag_op.py,
+test_sample_logits_op.py, test_rank_attention_op.py,
+test_tree_conv_op.py, test_var_conv_2d.py, test_pyramid_hash_op.py)."""
+
+import numpy as np
+
+from op_test import check_grad, run_single_op
+
+rng = np.random.RandomState(5)
+
+
+def test_lod_reset_identity_data_new_lens():
+    x = rng.randn(6, 1).astype(np.float32)
+    # reference Example 2: offsets via Y
+    outs, _ = run_single_op(
+        "lod_reset", {"X": x, "Y": np.array([0, 2, 6], np.int32)}, {},
+        ["Out", "OutLens"])
+    np.testing.assert_allclose(outs["Out"], x)
+    np.testing.assert_array_equal(outs["OutLens"], [2, 4])
+    # reference Example 1: offsets via attr
+    outs, _ = run_single_op(
+        "lod_reset", {"X": x}, {"target_lod": [0, 4, 6]},
+        ["Out", "OutLens"])
+    np.testing.assert_array_equal(outs["OutLens"], [4, 2])
+    check_grad("lod_reset", {"X": x, "Y": np.array([0, 3, 6], np.int32)},
+               {}, ["Out", "OutLens"], ["X"], rtol=1e-2, atol=1e-3)
+
+
+def test_filter_by_instag_masks_dropped_sequences():
+    # 4 sequences of 1/2/3/4 rows; tags 1,2,1,2; filter tag = 2
+    x = np.arange(10 * 3, dtype=np.float32).reshape(10, 3)
+    lens = np.array([1, 2, 3, 4], np.int64)
+    tags = np.array([[1, -1], [2, -1], [1, -1], [2, 3]], np.int64)
+    outs, _ = run_single_op(
+        "filter_by_instag",
+        {"Ins": x, "SeqLens": lens, "InsTag": tags,
+         "FilterTag": np.array([2], np.int64)},
+        {"out_val_if_empty": 0}, ["Out", "LossWeight", "IndexMap"])
+    np.testing.assert_array_equal(outs["IndexMap"], [0, 1, 0, 1])
+    np.testing.assert_allclose(outs["LossWeight"].reshape(-1), [0, 1, 0, 1])
+    out = outs["Out"]
+    np.testing.assert_allclose(out[0], 0)              # seq0 dropped
+    np.testing.assert_allclose(out[1:3], x[1:3])       # seq1 kept
+    np.testing.assert_allclose(out[3:6], 0)            # seq2 dropped
+    np.testing.assert_allclose(out[6:10], x[6:10])     # seq3 kept
+    # grad flows only through kept rows
+    _, grads = run_single_op(
+        "filter_by_instag",
+        {"Ins": x, "SeqLens": lens, "InsTag": tags,
+         "FilterTag": np.array([2], np.int64)},
+        {}, ["Out", "LossWeight", "IndexMap"], grad_of=[("Ins", 0)])
+    g = grads["ins_0@GRAD"]
+    assert np.all(g[1:3] == 1) and np.all(g[6:10] == 1)
+    assert np.all(g[0] == 0) and np.all(g[3:6] == 0)
+
+
+def test_sample_logits_structure_and_correction():
+    n, k, nt, s = 4, 50, 1, 8
+    logits = rng.randn(n, k).astype(np.float32)
+    labels = rng.randint(0, k, (n, nt)).astype(np.int64)
+    outs, _ = run_single_op(
+        "sample_logits", {"Logits": logits, "Labels": labels},
+        {"num_samples": s, "remove_accidental_hits": True},
+        ["Samples", "Probabilities", "SampledLogits", "SampledLabels"])
+    samples = outs["Samples"]
+    assert samples.shape == (n, nt + s)
+    np.testing.assert_array_equal(samples[:, :nt], labels)   # true first
+    # negatives are shared across the batch and DISTINCT (uniq contract)
+    negs = samples[0, nt:]
+    assert len(set(negs.tolist())) == s
+    np.testing.assert_array_equal(samples[:, nt:],
+                                  np.tile(negs, (n, 1)))
+    # probability is the log-uniform q(k)
+    q = (np.log(samples + 2.0) - np.log(samples + 1.0)) / np.log(k + 1.0)
+    np.testing.assert_allclose(outs["Probabilities"], q, rtol=1e-5)
+    # sampled logits = logits[sample] - log q, except accidental hits
+    sl = outs["SampledLogits"]
+    for i in range(n):
+        for j in range(nt + s):
+            c = samples[i, j]
+            want = logits[i, c] - np.log(q[i, j])
+            if j >= nt and c in labels[i]:
+                assert sl[i, j] < -1e19                  # knocked out
+            else:
+                np.testing.assert_allclose(sl[i, j], want, rtol=2e-5,
+                                           atol=1e-5)
+    np.testing.assert_array_equal(outs["SampledLabels"],
+                                  np.tile(np.arange(nt), (n, 1)))
+
+
+def test_sample_logits_customized_samples():
+    n, k, nt, s = 2, 10, 1, 3
+    logits = rng.randn(n, k).astype(np.float32)
+    labels = rng.randint(0, k, (n, nt)).astype(np.int64)
+    cs = rng.randint(0, k, (n, nt + s)).astype(np.int64)
+    cs[:, :nt] = labels
+    cp = np.full((n, nt + s), 0.1, np.float32)
+    outs, _ = run_single_op(
+        "sample_logits",
+        {"Logits": logits, "Labels": labels, "CustomizedSamples": cs,
+         "CustomizedProbabilities": cp},
+        {"num_samples": s, "use_customized_samples": True,
+         "remove_accidental_hits": False},
+        ["Samples", "Probabilities", "SampledLogits", "SampledLabels"])
+    np.testing.assert_array_equal(outs["Samples"], cs)
+    want = np.take_along_axis(logits, cs, 1) - np.log(0.1)
+    np.testing.assert_allclose(outs["SampledLogits"], want, rtol=1e-5)
+
+
+def _np_rank_attention(x, ro, param, max_rank):
+    """Ported oracle (reference test_rank_attention_op.py
+    np_rank_attention)."""
+    n, d = x.shape
+    p = param.shape[1]
+    out = np.zeros((n, p), np.float64)
+    for i in range(n):
+        lower = ro[i, 0] - 1
+        if lower < 0:
+            continue
+        for kk in range(max_rank):
+            faster = ro[i, 2 * kk + 1] - 1
+            if faster < 0:
+                continue
+            index = ro[i, 2 * kk + 2]
+            blk = param[(lower * max_rank + faster) * d:
+                        (lower * max_rank + faster + 1) * d]
+            out[i] += x[index] @ blk
+    return out
+
+
+def test_rank_attention_matches_oracle():
+    max_rank, d, p = 3, 4, 5
+    # 2 pvs: ranks [2, 1] and [1, 3, 2] -> 5 instances
+    ro = np.full((5, 1 + 2 * max_rank), -1, np.int32)
+    pv0, pv1 = [0, 1], [2, 3, 4]
+    for group in (pv0, pv1):
+        ranks = list(range(1, len(group) + 1))
+        for a, ins_i in enumerate(group):
+            ro[ins_i, 0] = ranks[a]
+            for kk, peer in enumerate(group):
+                ro[ins_i, 2 * kk + 1] = ranks[kk]
+                ro[ins_i, 2 * kk + 2] = peer
+    x = rng.randn(5, d).astype(np.float32)
+    param = rng.randn(max_rank * max_rank * d, p).astype(np.float32)
+    outs, _ = run_single_op(
+        "rank_attention", {"X": x, "RankOffset": ro, "RankParam": param},
+        {"MaxRank": max_rank}, ["Out", "InputHelp", "InsRank"])
+    want = _np_rank_attention(x.astype(np.float64), ro,
+                              param.astype(np.float64), max_rank)
+    np.testing.assert_allclose(outs["Out"], want, rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(outs["InsRank"].reshape(-1),
+                                  ro[:, 0].astype(np.float32))
+    # RankParam is the trainable input (reference grad op)
+    check_grad("rank_attention",
+               {"X": x, "RankOffset": ro, "RankParam": param},
+               {"MaxRank": max_rank}, ["Out", "InputHelp", "InsRank"],
+               ["RankParam"], rtol=2e-2, atol=1e-2)
+
+
+def _np_tree_conv(nodes, edges, w, max_depth):
+    """Ported oracle (reference test_tree_conv_op.py naive patches)."""
+    b, n, f = nodes.shape
+    _, _, o, c = w.shape
+    wt = np.transpose(w, (1, 0, 2, 3))                 # [3, F, O, C]
+    out = np.zeros((b, n, o, c))
+    for bi in range(b):
+        og = [[] for _ in range(n + 2)]
+        for e0, e1 in edges[bi]:
+            if e0 > 0 and e1 > 0:
+                og[int(e0)].append(int(e1))
+
+        def patch_of(u):
+            collected = [(u, 1, 1, 0)]
+
+            def rec(node, depth):
+                if depth > max_depth:
+                    return
+                l = len(og[node])
+                for idx, ch in enumerate(og[node], 1):
+                    if depth + 1 < max_depth:
+                        collected.append((ch, idx, l, depth + 1))
+                        rec(ch, depth + 1)
+            rec(u, 0)
+            return collected
+
+        for u in range(1, n + 1):
+            res = np.zeros((o, c))
+            for (node, idx, l, depth) in patch_of(u):
+                eta_t = float(max_depth - depth) / max_depth
+                eta_l = (1 - eta_t) * (0.5 if l == 1
+                                       else (idx - 1.0) / (l - 1.0))
+                eta_r = (1 - eta_t) * (1 - eta_l)
+                eta = np.array([eta_l, eta_r, eta_t]).reshape(3, 1)
+                wmix = np.tensordot(eta, wt, axes=([0], [0]))[0]
+                res += np.tensordot(nodes[bi, node - 1], wmix, axes=1)
+            out[bi, u - 1] = res
+    return out
+
+
+def test_tree_conv_matches_oracle():
+    n, f, o, c, depth, b = 9, 3, 2, 2, 2, 2
+    adj = np.array([1, 2, 1, 3, 1, 4, 2, 5, 2, 6, 4, 7, 7, 8, 7, 9],
+                   np.int32).reshape(1, 8, 2)
+    adj = np.tile(adj, (b, 1, 1))
+    nodes = rng.randn(b, n, f).astype(np.float32)
+    w = rng.randn(f, 3, o, c).astype(np.float32)
+    outs, _ = run_single_op(
+        "tree_conv", {"NodesVector": nodes, "EdgeSet": adj, "Filter": w},
+        {"max_depth": depth}, ["Out"])
+    want = _np_tree_conv(nodes.astype(np.float64), adj,
+                         w.astype(np.float64), depth)
+    np.testing.assert_allclose(outs["Out"], want, rtol=1e-4, atol=1e-4)
+    # deeper receptive field
+    outs3, _ = run_single_op(
+        "tree_conv", {"NodesVector": nodes, "EdgeSet": adj, "Filter": w},
+        {"max_depth": 3}, ["Out"])
+    want3 = _np_tree_conv(nodes.astype(np.float64), adj,
+                          w.astype(np.float64), 3)
+    np.testing.assert_allclose(outs3["Out"], want3, rtol=1e-4, atol=1e-4)
+    check_grad("tree_conv",
+               {"NodesVector": nodes, "EdgeSet": adj, "Filter": w},
+               {"max_depth": depth}, ["Out"], ["NodesVector", "Filter"],
+               rtol=2e-2, atol=1e-2)
+
+
+def _np_var_conv_2d(x, rows, cols, w, kh, kw, sh, sw):
+    """Dense-layout port of the reference Im2Col + gemm oracle."""
+    b, c, hm, wm = x.shape
+    o = w.shape[0]
+    ho = (hm - 1) // sh + 1
+    wo = (wm - 1) // sw + 1
+    out = np.zeros((b, o, ho, wo))
+    wf = w.reshape(o, c, kh, kw)
+    for bi in range(b):
+        h, ww = int(rows[bi]), int(cols[bi])
+        if h == 0 or ww == 0:
+            continue
+        toy, tox = (h - 1) // sh + 1, (ww - 1) // sw + 1
+        for oy in range(toy):
+            for ox in range(tox):
+                acc = np.zeros(o)
+                for z in range(c):
+                    for ky in range(kh):
+                        for kx in range(kw):
+                            iy = oy * sh + ky - kh // 2
+                            ix = ox * sw + kx - kw // 2
+                            if 0 <= iy < h and 0 <= ix < ww:
+                                acc += wf[:, z, ky, kx] * x[bi, z, iy, ix]
+                out[bi, :, oy, ox] = acc
+    return out
+
+
+def test_var_conv_2d_matches_oracle():
+    b, c, hm, wm, o = 2, 3, 5, 6, 4
+    kh, kw, sh, sw = 2, 3, 1, 2
+    rows = np.array([4, 5], np.int64)
+    cols = np.array([6, 3], np.int64)
+    x = rng.randn(b, c, hm, wm).astype(np.float32)
+    w = rng.randn(o, c * kh * kw).astype(np.float32)
+    outs, _ = run_single_op(
+        "var_conv_2d",
+        {"X": x, "RowLens": rows, "ColLens": cols, "W": w},
+        {"KernelH": kh, "KernelW": kw, "StrideH": sh, "StrideW": sw},
+        ["Out"])
+    want = _np_var_conv_2d(x.astype(np.float64), rows, cols,
+                           w.astype(np.float64), kh, kw, sh, sw)
+    np.testing.assert_allclose(outs["Out"], want, rtol=1e-4, atol=1e-4)
+    check_grad("var_conv_2d",
+               {"X": x, "RowLens": rows, "ColLens": cols, "W": w},
+               {"KernelH": kh, "KernelW": kw, "StrideH": sh,
+                "StrideW": sw},
+               ["Out"], ["X", "W"], rtol=2e-2, atol=1e-2)
+
+
+def test_pyramid_hash_shapes_determinism_and_masking():
+    b, t, space, rand_len, num_emb = 2, 6, 256, 4, 8
+    toks = rng.randint(0, 1000, (b, t)).astype(np.int32)
+    lens = np.array([6, 3], np.int64)
+    w = rng.randn(space, 1).astype(np.float32)
+    attrs = {"num_emb": num_emb, "rand_len": rand_len,
+             "pyramid_layer": 3, "space_len": space}
+    outs, _ = run_single_op(
+        "pyramid_hash",
+        {"X": toks, "SeqLens": lens, "W": w}, attrs, ["Out"])
+    out = outs["Out"]
+    assert out.shape == (b, t, num_emb)
+    # deterministic: same inputs, same embedding
+    outs2, _ = run_single_op(
+        "pyramid_hash", {"X": toks, "SeqLens": lens, "W": w}, attrs,
+        ["Out"])
+    np.testing.assert_allclose(out, outs2["Out"])
+    # positions whose every gram crosses the sequence end embed to zero
+    np.testing.assert_allclose(out[1, 2:], 0.0)        # len 3: t>=2 dead
+    assert np.abs(out[1, 0]).sum() > 0
+    # different token at a position changes (only) grams covering it
+    toks2 = toks.copy()
+    toks2[0, 5] = toks[0, 5] + 7
+    outs3, _ = run_single_op(
+        "pyramid_hash", {"X": toks2, "SeqLens": lens, "W": w}, attrs,
+        ["Out"])
+    assert np.abs(outs3["Out"][0, 5] - out[0, 5]).sum() > 0 or \
+        np.abs(outs3["Out"][0, 4] - out[0, 4]).sum() > 0
+    np.testing.assert_allclose(outs3["Out"][0, :3], out[0, :3])
+    # the table is trainable
+    check_grad("pyramid_hash",
+               {"X": toks[:1, :4], "SeqLens": np.array([4], np.int64),
+                "W": w[:64]},
+               {"num_emb": 4, "rand_len": 2, "pyramid_layer": 2,
+                "space_len": 64},
+               ["Out"], ["W"], rtol=5e-2, atol=1e-2)
