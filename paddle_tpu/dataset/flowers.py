@@ -47,7 +47,15 @@ def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
 
 def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
          n=64):
-    return _creator(n, seed=62, mapper=mapper)
+    if not cycle:
+        return _creator(n, seed=62, mapper=mapper)
+
+    def reader():
+        while True:
+            for s in _creator(n, seed=62, mapper=mapper)():
+                yield s
+
+    return reader
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=True, n=64):

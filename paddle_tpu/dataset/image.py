@@ -26,10 +26,9 @@ def _resize(im, h, w):
     wx = np.clip(xs - x0, 0, 1)[None, :]
     if im.ndim == 3:
         wy, wx = wy[..., None], wx[..., None]
-    a = im[y0][:, x0]
-    b = im[y0][:, x1]
-    c = im[y1][:, x0]
-    d = im[y1][:, x1]
+    ry0, ry1 = im[y0], im[y1]                 # gather rows once
+    a, b = ry0[:, x0], ry0[:, x1]
+    c, d = ry1[:, x0], ry1[:, x1]
     return a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx \
         + c * wy * (1 - wx) + d * wy * wx
 

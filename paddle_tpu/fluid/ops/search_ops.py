@@ -148,6 +148,13 @@ def _rank_attention(ctx, ins, attrs):
     ro = ins["RankOffset"][0].astype(jnp.int32)        # [N, 1+2*M]
     param = ins["RankParam"][0]                        # [M*M*D, P]
     max_rank = (ro.shape[1] - 1) // 2
+    attr_rank = int(attrs.get("MaxRank", max_rank))
+    if attr_rank != max_rank:
+        raise ValueError(
+            "rank_attention: MaxRank attr (%d) must equal the peer-slot "
+            "count implied by RankOffset width (%d = (%d-1)/2); the "
+            "parameter grid is MaxRank x MaxRank blocks"
+            % (attr_rank, max_rank, ro.shape[1]))
     n, d = x.shape
     p = param.shape[1]
     param3 = param.reshape(max_rank * max_rank, d, p)

@@ -1,0 +1,72 @@
+"""Python side of the C-ABI inference surface (see native/infer_capi.cc).
+
+Capability parity: reference `inference/capi/c_api.cc:1` +
+`pd_predictor.cc` — a C API over the AnalysisPredictor so C/Go services
+link inference in process.  Here the C shim embeds CPython (the
+train_demo.cc pattern) and calls these functions; data crosses the
+boundary as raw pointers + shapes (the reference's ZeroCopyTensor
+contract: no serialization, the C caller owns input buffers, the library
+owns output buffers until the next run/delete)."""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+_PREDICTORS = {}
+_NEXT = [1]
+
+# PD_DataType codes, matching reference paddle_c_api.h enum order
+_DTYPES = {0: np.float32, 1: np.int32, 2: np.int64, 3: np.uint8}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def create(model_dir):
+    """Load an inference model dir; returns an integer handle (0 on
+    failure paths raise — the C side maps exceptions to NULL)."""
+    from . import AnalysisConfig, create_predictor
+
+    pred = create_predictor(AnalysisConfig(model_dir))
+    h = _NEXT[0]
+    _NEXT[0] += 1
+    _PREDICTORS[h] = {"pred": pred, "outputs": None}
+    return h
+
+
+def input_names(h):
+    return list(_PREDICTORS[h]["pred"].get_input_names())
+
+
+def output_names(h):
+    return list(_PREDICTORS[h]["pred"].get_output_names())
+
+
+def run(h, addrs, shapes, dtype_codes):
+    """addrs: list of int pointers, shapes: list of int lists.  Returns
+    (out_addrs, out_shapes, out_dtype_codes); output arrays stay alive
+    inside the handle until the next run() or free()."""
+    entry = _PREDICTORS[h]
+    feeds = []
+    for addr, shape, code in zip(addrs, shapes, dtype_codes):
+        dt = _DTYPES[int(code)]
+        n = int(np.prod(shape)) if shape else 1
+        ctype = np.ctypeslib.as_ctypes_type(dt) * n
+        buf = ctype.from_address(int(addr))
+        feeds.append(np.frombuffer(buf, dtype=dt).reshape(shape).copy())
+    outs = entry["pred"].run(feeds)
+    outs = [np.ascontiguousarray(o) for o in outs]
+    for o in outs:
+        if o.dtype not in _CODES:
+            raise TypeError(
+                "output dtype %s has no PD_DataType code; supported: %s"
+                % (o.dtype, sorted(str(np.dtype(v)) for v in
+                                   _DTYPES.values())))
+    entry["outputs"] = outs                    # keep buffers alive
+    return ([int(o.ctypes.data) for o in outs],
+            [list(o.shape) for o in outs],
+            [_CODES[o.dtype] for o in outs])
+
+
+def free(h):
+    _PREDICTORS.pop(int(h), None)

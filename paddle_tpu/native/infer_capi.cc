@@ -1,0 +1,163 @@
+// C-ABI inference library (capability parity: reference
+// inference/capi/c_api.cc + pd_predictor.cc).  Builds a .so exporting
+// the PD_* surface in paddle_tpu_capi.h; a C (or Go, via cgo) service
+// links it and runs inference IN PROCESS — the embedded-CPython pattern
+// proven by train_demo.cc, wrapped behind a stable C boundary.
+//
+// Build (see tests/test_native_infer_capi.py):
+//   g++ -O2 -shared -fPIC infer_capi.cc $(python3-config --includes) \
+//       $(python3-config --ldflags --embed) -o libpaddle_tpu_capi.so
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "paddle_tpu_capi.h"
+
+namespace {
+
+PyObject* g_bridge = nullptr;   // paddle_tpu.inference.capi_bridge
+std::string g_name_scratch;     // returned name storage
+
+// Every entry point may be called from ANY thread (Go/cgo dispatches on
+// arbitrary OS threads), so each one takes the GIL; PD_Init releases the
+// GIL it acquired via Py_Initialize so other threads can get it.
+class GilGuard {
+ public:
+  GilGuard() : state_(PyGILState_Ensure()) {}
+  ~GilGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+PyObject* Call(const char* fn, PyObject* args) {
+  PyObject* f = PyObject_GetAttrString(g_bridge, fn);
+  if (!f) return nullptr;
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (!r) PyErr_Print();
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+int PD_Init(void) {
+  if (g_bridge) return 0;
+  if (!Py_IsInitialized()) {
+    Py_Initialize();
+    PyObject* bridge =
+        PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+    if (!bridge) {
+      PyErr_Print();
+      return 1;
+    }
+    g_bridge = bridge;
+    PyEval_SaveThread();  // release the init thread's GIL for all comers
+    return 0;
+  }
+  GilGuard gil;
+  g_bridge = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+  if (!g_bridge) {
+    PyErr_Print();
+    return 1;
+  }
+  return 0;
+}
+
+int64_t PD_CreatePredictor(const char* model_dir) {
+  if (PD_Init() != 0) return 0;
+  GilGuard gil;
+  PyObject* r = Call("create", Py_BuildValue("(s)", model_dir));
+  if (!r) return 0;
+  int64_t h = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return h;
+}
+
+static int NameCount(int64_t pred, const char* fn) {
+  GilGuard gil;
+  PyObject* r = Call(fn, Py_BuildValue("(L)", pred));
+  if (!r) return -1;
+  int n = static_cast<int>(PyList_Size(r));
+  Py_DECREF(r);
+  return n;
+}
+
+static const char* NameAt(int64_t pred, const char* fn, int i) {
+  GilGuard gil;
+  PyObject* r = Call(fn, Py_BuildValue("(L)", pred));
+  if (!r) return nullptr;
+  PyObject* item = PyList_GetItem(r, i);  // borrowed
+  if (!item) {
+    Py_DECREF(r);
+    return nullptr;
+  }
+  g_name_scratch = PyUnicode_AsUTF8(item);
+  Py_DECREF(r);
+  return g_name_scratch.c_str();
+}
+
+int PD_GetInputNum(int64_t pred) { return NameCount(pred, "input_names"); }
+int PD_GetOutputNum(int64_t pred) { return NameCount(pred, "output_names"); }
+const char* PD_GetInputName(int64_t pred, int i) {
+  return NameAt(pred, "input_names", i);
+}
+const char* PD_GetOutputName(int64_t pred, int i) {
+  return NameAt(pred, "output_names", i);
+}
+
+int PD_Run(int64_t pred, const PD_TensorView* ins, int n_in,
+           PD_TensorView* outs, int* n_out, int max_out) {
+  GilGuard gil;
+  PyObject* addrs = PyList_New(n_in);
+  PyObject* shapes = PyList_New(n_in);
+  PyObject* dtypes = PyList_New(n_in);
+  for (int i = 0; i < n_in; ++i) {
+    PyList_SetItem(addrs, i,
+                   PyLong_FromVoidPtr(const_cast<void*>(ins[i].data)));
+    PyObject* shp = PyList_New(ins[i].ndim);
+    for (int d = 0; d < ins[i].ndim; ++d)
+      PyList_SetItem(shp, d, PyLong_FromLongLong(ins[i].shape[d]));
+    PyList_SetItem(shapes, i, shp);
+    PyList_SetItem(dtypes, i, PyLong_FromLong(ins[i].dtype));
+  }
+  PyObject* r =
+      Call("run", Py_BuildValue("(LNNN)", pred, addrs, shapes, dtypes));
+  if (!r) return 1;
+  PyObject *oaddrs, *oshapes, *odtypes;
+  if (!PyArg_ParseTuple(r, "OOO", &oaddrs, &oshapes, &odtypes)) {
+    Py_DECREF(r);
+    return 1;
+  }
+  int n = static_cast<int>(PyList_Size(oaddrs));
+  if (n > max_out) {
+    Py_DECREF(r);
+    return 2;
+  }
+  for (int i = 0; i < n; ++i) {
+    outs[i].data = PyLong_AsVoidPtr(PyList_GetItem(oaddrs, i));
+    PyObject* shp = PyList_GetItem(oshapes, i);
+    outs[i].ndim = static_cast<int>(PyList_Size(shp));
+    for (int d = 0; d < outs[i].ndim && d < 8; ++d)
+      outs[i].shape[d] = PyLong_AsLongLong(PyList_GetItem(shp, d));
+    outs[i].dtype =
+        static_cast<PD_DataType>(PyLong_AsLong(PyList_GetItem(odtypes, i)));
+  }
+  *n_out = n;
+  Py_DECREF(r);
+  return 0;
+}
+
+void PD_DeletePredictor(int64_t pred) {
+  GilGuard gil;
+  PyObject* r = Call("free", Py_BuildValue("(L)", pred));
+  Py_XDECREF(r);
+}
+
+}  // extern "C"

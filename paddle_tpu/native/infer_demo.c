@@ -1,0 +1,67 @@
+/* Pure-C inference client (capability parity: reference
+ * inference/capi/tests + go/paddle/predictor.go usage pattern): link
+ * libpaddle_tpu_capi.so, load a saved inference model, run a batch, and
+ * print the outputs for the test harness to compare against the Python
+ * Predictor. */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <model_dir> <input.bin>\n", argv[0]);
+    return 1;
+  }
+  if (PD_Init() != 0) return 2;
+  int64_t pred = PD_CreatePredictor(argv[1]);
+  if (!pred) return 3;
+
+  int n_in = PD_GetInputNum(pred);
+  printf("inputs %d:", n_in);
+  for (int i = 0; i < n_in; ++i) printf(" %s", PD_GetInputName(pred, i));
+  printf("\noutputs %d:", PD_GetOutputNum(pred));
+  for (int i = 0; i < PD_GetOutputNum(pred); ++i)
+    printf(" %s", PD_GetOutputName(pred, i));
+  printf("\n");
+
+  /* input.bin: int64 ndim, int64 dims..., float32 data (one tensor) */
+  FILE* f = fopen(argv[2], "rb");
+  if (!f) return 4;
+  int64_t ndim = 0;
+  if (fread(&ndim, sizeof(int64_t), 1, f) != 1) return 4;
+  PD_TensorView in;
+  in.ndim = (int)ndim;
+  in.dtype = PD_FLOAT32;
+  int64_t numel = 1;
+  for (int d = 0; d < in.ndim; ++d) {
+    if (fread(&in.shape[d], sizeof(int64_t), 1, f) != 1) return 4;
+    numel *= in.shape[d];
+  }
+  float* data = (float*)malloc(numel * sizeof(float));
+  if (fread(data, sizeof(float), numel, f) != (size_t)numel) return 4;
+  fclose(f);
+  in.data = data;
+
+  PD_TensorView outs[8];
+  int n_out = 0;
+  if (PD_Run(pred, &in, 1, outs, &n_out, 8) != 0) return 5;
+  for (int i = 0; i < n_out; ++i) {
+    int64_t n = 1;
+    for (int d = 0; d < outs[i].ndim; ++d) n *= outs[i].shape[d];
+    printf("out %d shape", i);
+    for (int d = 0; d < outs[i].ndim; ++d)
+      printf(" %lld", (long long)outs[i].shape[d]);
+    printf(" data");
+    const float* p = (const float*)outs[i].data;
+    for (int64_t j = 0; j < n; ++j) printf(" %.6f", p[j]);
+    printf("\n");
+  }
+  /* second run with the same input must reuse the compiled program */
+  if (PD_Run(pred, &in, 1, outs, &n_out, 8) != 0) return 6;
+  printf("second run ok\n");
+  PD_DeletePredictor(pred);
+  free(data);
+  printf("C inference demo OK\n");
+  return 0;
+}

@@ -1,0 +1,56 @@
+/* C ABI for in-process inference (capability parity: reference
+ * inference/capi/paddle_c_api.h — PD_NewPredictor / PD_PredictorRun /
+ * ZeroCopyTensor — reduced to the pointer+shape contract a C or Go
+ * service needs to link inference without a network hop).
+ *
+ * Lifetime: input buffers belong to the caller and are copied during
+ * PD_Run; output buffers belong to the library and stay valid until the
+ * next PD_Run on the same predictor or PD_DeletePredictor. */
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum PD_DataType {
+  PD_FLOAT32 = 0,
+  PD_INT32 = 1,
+  PD_INT64 = 2,
+  PD_UINT8 = 3
+} PD_DataType;
+
+typedef struct PD_TensorView {
+  void* data;          /* element buffer */
+  int64_t shape[8];    /* dims, row-major */
+  int ndim;
+  PD_DataType dtype;
+} PD_TensorView;
+
+/* Initialize the embedded runtime (idempotent; PD_CreatePredictor calls
+ * it automatically).  Returns 0 on success. */
+int PD_Init(void);
+
+/* Load an inference model directory (save_inference_model layout).
+ * Returns an opaque handle, or 0 on failure. */
+int64_t PD_CreatePredictor(const char* model_dir);
+
+int PD_GetInputNum(int64_t pred);
+int PD_GetOutputNum(int64_t pred);
+/* Returned strings are owned by the library; copy before the next call. */
+const char* PD_GetInputName(int64_t pred, int i);
+const char* PD_GetOutputName(int64_t pred, int i);
+
+/* Run inference: n_in input views in declared feed order.  On success
+ * fills outs[0..*n_out) (library-owned buffers) and returns 0. */
+int PD_Run(int64_t pred, const PD_TensorView* ins, int n_in,
+           PD_TensorView* outs, int* n_out, int max_out);
+
+void PD_DeletePredictor(int64_t pred);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_CAPI_H_ */
