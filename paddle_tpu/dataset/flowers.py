@@ -22,40 +22,32 @@ def _make(n, seed):
     return imgs, labels
 
 
-def _creator(n, seed, mapper=None):
+def _creator(n, seed, mapper=None, cycle=False):
     def reader():
         x, y = _make(n, seed)
         for i in range(n):
             sample = (x[i].reshape(-1), int(y[i]))
             yield mapper(sample) if mapper is not None else sample
 
-    return reader
+    if not cycle:
+        return reader
+
+    def cycled():
+        while True:
+            for s in reader():
+                yield s
+
+    return cycled
 
 
 def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
           n=256):
-    if not cycle:
-        return _creator(n, seed=61, mapper=mapper)
-
-    def reader():
-        while True:
-            for s in _creator(n, seed=61, mapper=mapper)():
-                yield s
-
-    return reader
+    return _creator(n, seed=61, mapper=mapper, cycle=cycle)
 
 
 def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
          n=64):
-    if not cycle:
-        return _creator(n, seed=62, mapper=mapper)
-
-    def reader():
-        while True:
-            for s in _creator(n, seed=62, mapper=mapper)():
-                yield s
-
-    return reader
+    return _creator(n, seed=62, mapper=mapper, cycle=cycle)
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=True, n=64):

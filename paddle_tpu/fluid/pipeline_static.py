@@ -23,9 +23,14 @@ axis:
     pipeline-computed grads under the program's own @GRAD names, so the
     user's optimizer/LR-schedule semantics are preserved verbatim.
 
-Limitations (explicit, erroring): forward stage ops may not write
-persistable state (batch_norm running stats would need a sequential
-carry across microbatches), and the local batch must divide
+Persistable vars written by forward stages (batch_norm running stats)
+are threaded through the scan as carries — microbatch-SEQUENTIAL, the
+reference SectionWorker's order (`framework/section_worker.cc:142`) —
+and the owning stage's final value is delta-psum'd to every shard, so
+pipelined CNNs with batch norm train with the same running-stat
+trajectory as a single device stepping microbatches in order.
+
+Limitations (explicit, erroring): the local batch must divide
 num_microbatches.  Full-batch parity holds for mean- AND sum-reduction
 losses: the loss reduction is detected from the program
 (`_loss_reduction_kind`) and microbatch losses are averaged or summed
@@ -137,17 +142,21 @@ def _loss_reduction_kind(ops, loss_name):
     return "mean"
 
 
-def _check_no_stateful_forward(stage_ops, block, scope):
+def _stateful_forward_vars(stage_ops, block, scope):
+    """Persistable vars WRITTEN by forward stage ops (batch_norm running
+    stats).  The reference's SectionWorker carries these sequentially
+    across microbatches (`framework/section_worker.cc:142`); here they
+    become scan carries — microbatch m+1's stage sees microbatch m's
+    update, the SectionWorker order exactly."""
+    out = []
     for sops in stage_ops:
         for op in sops:
             for n in op.all_output_names():
                 v = block._find_var_recursive(n)
-                if (v is not None and v.persistable) or scope.has(n):
-                    raise ValueError(
-                        "static pipeline: forward op %r writes persistable "
-                        "var %r (e.g. batch_norm running stats); stateful "
-                        "forward ops are not supported on the pipeline "
-                        "path" % (op.type, n))
+                if ((v is not None and v.persistable) or scope.has(n)) \
+                        and n not in out:
+                    out.append(n)
+    return out
 
 
 def build_pipeline_jit(program, block, ops, feed_names, feed_shapes,
@@ -163,7 +172,7 @@ def build_pipeline_jit(program, block, ops, feed_names, feed_shapes,
     n_stages = mesh.axis_size("pp")
     stage_ops, aux_ops, opt_ops, boundary, produced_at = \
         split_forward_stages(ops, loss_name, n_stages)
-    _check_no_stateful_forward(stage_ops, block, scope)
+    stat_names = _stateful_forward_vars(stage_ops, block, scope)
     loss_reduction = _loss_reduction_kind(ops, loss_name)
 
     # prune aux (non-loss-ancestor) ops nothing consumes, then reject the
@@ -256,9 +265,12 @@ def build_pipeline_jit(program, block, ops, feed_names, feed_shapes,
         s = jax.lax.axis_index("pp")
         env_base = dict(const_params)
         env_base.update(train_params)
+        # persistable vars written by forward stages (BN running stats)
+        # ride the scan carry: microbatch-SEQUENTIAL, like SectionWorker
+        stats0 = {n: env_base[n] for n in stat_names}
 
         def tick(carry, t):
-            bnd, acc = carry
+            bnd, acc, stats = carry
             bnd = jax.tree.map(
                 lambda a: jax.lax.ppermute(a, "pp", perm), bnd)
             mb = jnp.clip(t - s, 0, n_micro - 1)
@@ -270,9 +282,11 @@ def build_pipeline_jit(program, block, ops, feed_names, feed_shapes,
             }
 
             def run_stage(si):
-                def f(bnd_in):
+                def f(operand):
+                    bnd_in, stats_in = operand
                     env = dict(env_base)
                     env.update(feeds_t)
+                    env.update(stats_in)     # carried stats win
                     env.update(bnd_in)
                     ctx = LowerContext(
                         base_key=jax.random.fold_in(
@@ -282,33 +296,48 @@ def build_pipeline_jit(program, block, ops, feed_names, feed_shapes,
                     out = {n: env.get(n, bnd_in[n]) for n in boundary}
                     lv = (env[loss_name].astype(jnp.float32)
                           if si == loss_stage else jnp.float32(0))
-                    return out, jnp.asarray(lv, jnp.float32).reshape(())
+                    new_stats = {
+                        n: jax.lax.stop_gradient(env.get(n, stats_in[n]))
+                        for n in stat_names
+                    }
+                    return (out, jnp.asarray(lv, jnp.float32).reshape(()),
+                            new_stats)
                 return f
 
-            new_bnd, lv = jax.lax.switch(
-                s, [run_stage(i) for i in range(n_stages)], bnd)
+            new_bnd, lv, new_stats = jax.lax.switch(
+                s, [run_stage(i) for i in range(n_stages)], (bnd, stats))
             new_bnd = jax.tree.map(
                 lambda new, old: jnp.where(valid, new, old),
                 new_bnd, bnd)
+            new_stats = jax.tree.map(
+                lambda new, old: jnp.where(valid, new, old),
+                new_stats, stats)
             acc = acc + jnp.where(valid, lv, 0.0)
-            return (new_bnd, acc), None
+            return (new_bnd, acc, new_stats), None
 
         bnd0 = jax.tree.map(
             lambda sd: jnp.zeros(sd.shape, sd.dtype), dict(bnd_structs))
-        (_, acc), _ = jax.lax.scan(
-            tick, (bnd0, jnp.float32(0)),
+        (_, acc, stats_end), _ = jax.lax.scan(
+            tick, (bnd0, jnp.float32(0), stats0),
             jnp.arange(n_micro + n_stages - 1))
         # only the last stage accumulated; the psum broadcasts the total.
         # mean losses average over microbatches (== full-batch mean);
         # sum losses just sum (== full-batch sum) — see _loss_reduction_kind
         total = jax.lax.psum(acc, "pp")
-        return total / n_micro if loss_reduction == "mean" else total
+        # each stat var was updated only on its owning stage's shard; the
+        # delta-psum replicates the owner's final value everywhere
+        stats_final = {
+            n: stats0[n] + jax.lax.psum(stats_end[n] - stats0[n], "pp")
+            for n in stat_names
+        }
+        loss = total / n_micro if loss_reduction == "mean" else total
+        return loss, stats_final
 
     sharded_loss = jax.shard_map(
         pp_forward,
         mesh=jmesh,
         in_specs=(P(), P(), P(), P()),
-        out_specs=P(),
+        out_specs=(P(), {n: P() for n in stat_names}),
         check_vma=False,
     )
 
@@ -331,15 +360,17 @@ def build_pipeline_jit(program, block, ops, feed_names, feed_shapes,
         const_params = {n: v for n, v in params.items()
                         if n not in train_params}
         if grad_params:
-            loss_val, grads = jax.value_and_grad(sharded_loss)(
+            (loss_val, stat_vals), grads = jax.value_and_grad(
+                sharded_loss, has_aux=True)(
                 train_params, const_params, mb_feeds, rng_key)
         else:  # eval clone: staged forward only, no updates
-            loss_val = sharded_loss(train_params, const_params, mb_feeds,
-                                    rng_key)
+            loss_val, stat_vals = sharded_loss(
+                train_params, const_params, mb_feeds, rng_key)
             grads = {}
 
         opt_env = dict(params)
         opt_env.update(aux_env)
+        opt_env.update(stat_vals)        # carried running stats persist
         for p, g in grads.items():
             opt_env[p + GRAD_SUFFIX] = g.astype(params[p].dtype)
         opt_ctx = LowerContext(base_key=rng_key, is_test=is_test)

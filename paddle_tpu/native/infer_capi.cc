@@ -10,7 +10,8 @@
 
 #include <Python.h>
 
-#include <cstring>
+#include <map>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -19,10 +20,17 @@
 namespace {
 
 PyObject* g_bridge = nullptr;   // paddle_tpu.inference.capi_bridge
-std::string g_name_scratch;     // returned name storage
+std::once_flag g_init_once;
+int g_init_rc = 1;
+
+// name lists cached per predictor: PD_Get*Name returns pointers that
+// stay valid until PD_DeletePredictor (no shared scratch to dangle
+// under multithreaded callers)
+std::mutex g_names_mu;
+std::map<std::pair<int64_t, bool>, std::vector<std::string>> g_names;
 
 // Every entry point may be called from ANY thread (Go/cgo dispatches on
-// arbitrary OS threads), so each one takes the GIL; PD_Init releases the
+// arbitrary OS threads), so each one takes the GIL; init releases the
 // GIL it acquired via Py_Initialize so other threads can get it.
 class GilGuard {
  public:
@@ -33,9 +41,14 @@ class GilGuard {
   PyGILState_STATE state_;
 };
 
+// Caller must hold the GIL.  Consumes args.
 PyObject* Call(const char* fn, PyObject* args) {
   PyObject* f = PyObject_GetAttrString(g_bridge, fn);
-  if (!f) return nullptr;
+  if (!f) {
+    PyErr_Print();            // clear the pending AttributeError
+    Py_XDECREF(args);
+    return nullptr;
+  }
   PyObject* r = PyObject_CallObject(f, args);
   Py_DECREF(f);
   Py_XDECREF(args);
@@ -43,31 +56,57 @@ PyObject* Call(const char* fn, PyObject* args) {
   return r;
 }
 
-}  // namespace
-
-extern "C" {
-
-int PD_Init(void) {
-  if (g_bridge) return 0;
+void InitOnce() {
   if (!Py_IsInitialized()) {
     Py_Initialize();
-    PyObject* bridge =
-        PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
-    if (!bridge) {
+    g_bridge = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+    if (!g_bridge) {
       PyErr_Print();
-      return 1;
+      g_init_rc = 1;
+      PyEval_SaveThread();
+      return;
     }
-    g_bridge = bridge;
+    g_init_rc = 0;
     PyEval_SaveThread();  // release the init thread's GIL for all comers
-    return 0;
+    return;
   }
   GilGuard gil;
   g_bridge = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
   if (!g_bridge) {
     PyErr_Print();
-    return 1;
+    g_init_rc = 1;
+    return;
   }
-  return 0;
+  g_init_rc = 0;
+}
+
+const std::vector<std::string>* Names(int64_t pred, bool inputs) {
+  {
+    std::lock_guard<std::mutex> lk(g_names_mu);
+    auto it = g_names.find({pred, inputs});
+    if (it != g_names.end()) return &it->second;
+  }
+  GilGuard gil;
+  PyObject* r = Call(inputs ? "input_names" : "output_names",
+                     Py_BuildValue("(L)", pred));
+  if (!r) return nullptr;
+  std::vector<std::string> v;
+  for (Py_ssize_t i = 0; i < PyList_Size(r); ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    v.push_back(s ? s : "");
+  }
+  Py_DECREF(r);
+  std::lock_guard<std::mutex> lk(g_names_mu);
+  return &(g_names[{pred, inputs}] = std::move(v));
+}
+
+}  // namespace
+
+extern "C" {
+
+int PD_Init(void) {
+  std::call_once(g_init_once, InitOnce);
+  return g_init_rc;
 }
 
 int64_t PD_CreatePredictor(const char* model_dir) {
@@ -80,36 +119,26 @@ int64_t PD_CreatePredictor(const char* model_dir) {
   return h;
 }
 
-static int NameCount(int64_t pred, const char* fn) {
-  GilGuard gil;
-  PyObject* r = Call(fn, Py_BuildValue("(L)", pred));
-  if (!r) return -1;
-  int n = static_cast<int>(PyList_Size(r));
-  Py_DECREF(r);
-  return n;
+int PD_GetInputNum(int64_t pred) {
+  const auto* v = Names(pred, true);
+  return v ? static_cast<int>(v->size()) : -1;
 }
 
-static const char* NameAt(int64_t pred, const char* fn, int i) {
-  GilGuard gil;
-  PyObject* r = Call(fn, Py_BuildValue("(L)", pred));
-  if (!r) return nullptr;
-  PyObject* item = PyList_GetItem(r, i);  // borrowed
-  if (!item) {
-    Py_DECREF(r);
-    return nullptr;
-  }
-  g_name_scratch = PyUnicode_AsUTF8(item);
-  Py_DECREF(r);
-  return g_name_scratch.c_str();
+int PD_GetOutputNum(int64_t pred) {
+  const auto* v = Names(pred, false);
+  return v ? static_cast<int>(v->size()) : -1;
 }
 
-int PD_GetInputNum(int64_t pred) { return NameCount(pred, "input_names"); }
-int PD_GetOutputNum(int64_t pred) { return NameCount(pred, "output_names"); }
 const char* PD_GetInputName(int64_t pred, int i) {
-  return NameAt(pred, "input_names", i);
+  const auto* v = Names(pred, true);
+  if (!v || i < 0 || i >= static_cast<int>(v->size())) return nullptr;
+  return (*v)[i].c_str();
 }
+
 const char* PD_GetOutputName(int64_t pred, int i) {
-  return NameAt(pred, "output_names", i);
+  const auto* v = Names(pred, false);
+  if (!v || i < 0 || i >= static_cast<int>(v->size())) return nullptr;
+  return (*v)[i].c_str();
 }
 
 int PD_Run(int64_t pred, const PD_TensorView* ins, int n_in,
@@ -141,10 +170,15 @@ int PD_Run(int64_t pred, const PD_TensorView* ins, int n_in,
     return 2;
   }
   for (int i = 0; i < n; ++i) {
-    outs[i].data = PyLong_AsVoidPtr(PyList_GetItem(oaddrs, i));
     PyObject* shp = PyList_GetItem(oshapes, i);
-    outs[i].ndim = static_cast<int>(PyList_Size(shp));
-    for (int d = 0; d < outs[i].ndim && d < 8; ++d)
+    int ndim = static_cast<int>(PyList_Size(shp));
+    if (ndim > 8) {           // PD_TensorView.shape holds at most 8 dims
+      Py_DECREF(r);
+      return 3;
+    }
+    outs[i].data = PyLong_AsVoidPtr(PyList_GetItem(oaddrs, i));
+    outs[i].ndim = ndim;
+    for (int d = 0; d < ndim; ++d)
       outs[i].shape[d] = PyLong_AsLongLong(PyList_GetItem(shp, d));
     outs[i].dtype =
         static_cast<PD_DataType>(PyLong_AsLong(PyList_GetItem(odtypes, i)));
@@ -155,6 +189,11 @@ int PD_Run(int64_t pred, const PD_TensorView* ins, int n_in,
 }
 
 void PD_DeletePredictor(int64_t pred) {
+  {
+    std::lock_guard<std::mutex> lk(g_names_mu);
+    g_names.erase({pred, true});
+    g_names.erase({pred, false});
+  }
   GilGuard gil;
   PyObject* r = Call("free", Py_BuildValue("(L)", pred));
   Py_XDECREF(r);

@@ -30,6 +30,11 @@ int main(int argc, char** argv) {
   if (!f) return 4;
   int64_t ndim = 0;
   if (fread(&ndim, sizeof(int64_t), 1, f) != 1) return 4;
+  if (ndim < 1 || ndim > 8) {
+    fprintf(stderr, "bad input file: ndim %lld out of [1, 8]\n",
+            (long long)ndim);
+    return 4;
+  }
   PD_TensorView in;
   in.ndim = (int)ndim;
   in.dtype = PD_FLOAT32;
@@ -39,6 +44,7 @@ int main(int argc, char** argv) {
     numel *= in.shape[d];
   }
   float* data = (float*)malloc(numel * sizeof(float));
+  if (!data) return 4;
   if (fread(data, sizeof(float), numel, f) != (size_t)numel) return 4;
   fclose(f);
   in.data = data;
