@@ -22,9 +22,11 @@ Passes, in order (each output is plain AST the next pass understands):
   tensors have no Python truthiness, while pure-Python guards keep
   short-circuit semantics.)
 
-`if`/`while` containing `return` keep Python semantics (a tensor condition
-then raises Variable.__bool__'s guidance error) — data-dependent early
-return has no XLA analogue; assign-then-return instead.
+Early `return` (pass 0, ReturnTransformer) is rewritten to
+assign-then-return — a return-value var + taken-flag, downstream
+statements guarded, loops broken — so data-dependent returns under
+tensor conditions become cond outputs like any other assignment
+(reference return_transformer.py).
 """
 
 from __future__ import annotations
@@ -194,6 +196,161 @@ def _ensure_defined(names):
             )
         )
     return out
+
+
+# ---------------------------------------------------------------------------
+# pass 0: early return -> assign-then-return
+# ---------------------------------------------------------------------------
+
+RET_VAL = "__dy2st_ret_val"
+
+
+class _ReturnUnsupported(Exception):
+    pass
+
+
+class ReturnTransformer:
+    """cf. reference return_transformer.py: early `return` becomes
+    assign-then-return.  The rewrite is continuation-style so EVERY path
+    assigns the return var (convert_ifelse then merges real values, never
+    a None placeholder):
+
+    * `if t: return A` followed by more statements -> the remaining
+      statements move into the else-continuation; both branches end
+      assigning `__dy2st_ret_val`, and ONE `return __dy2st_ret_val`
+      remains at the end of the function.
+    * `return A` inside a loop -> a per-return flag + `break` (the
+      BreakContinue pass folds the break into the loop condition); after
+      the loop a dispatch chain evaluates A under `if flag:` — sound
+      because break exits immediately, so the loop-carried names still
+      hold their values from the breaking iteration.
+    * a path that falls off the function end assigns None (merging None
+      with a tensor under a TENSOR condition then raises the cond
+      structural-mismatch guidance, the same restriction as any
+      diverging branch outputs).
+
+    Returns nested under a second loop level fall back to the untouched
+    function (plain tracing; a tensor condition there raises the
+    Variable.__bool__ guidance error).  Runs FIRST, on the outermost
+    function only (nested defs convert separately via convert_call)."""
+
+    def __init__(self):
+        self._uid = 0
+
+    def _fresh(self):
+        self._uid += 1
+        return "__dy2st_retflag_%d" % self._uid
+
+    def transform(self, fdef):
+        import copy
+
+        body = fdef.body
+        early = False
+        for i, s in enumerate(body):
+            if isinstance(s, ast.Return) and i == len(body) - 1:
+                continue               # single trailing return: fine as is
+            if _has_return([s]):
+                early = True
+                break
+        if not early:
+            return fdef
+        # rewrite a COPY: _rw_block mutates nodes in place, and the
+        # unsupported-fallback must trace the pristine original
+        try:
+            fdef.body = self._rw_block(copy.deepcopy(body)) + [
+                ast.Return(value=_name(RET_VAL))
+            ]
+        except _ReturnUnsupported:
+            pass                       # plain tracing fallback
+        return fdef
+
+    def _rw_block(self, stmts):
+        """Rewrite so every path through `stmts` assigns RET_VAL."""
+        import copy
+
+        out = []
+        for idx, s in enumerate(stmts):
+            rest = stmts[idx + 1:]
+            if isinstance(s, ast.Return):
+                out.append(ast.Assign(
+                    targets=[_name(RET_VAL, ast.Store())],
+                    value=s.value or ast.Constant(value=None)))
+                return out             # rest is unreachable
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or not _has_return([s]):
+                out.append(s)
+                continue
+            if isinstance(s, ast.If):
+                # each branch gets its OWN copy of the continuation:
+                # later in-place passes must not see aliased nodes
+                s.body = self._rw_block(list(s.body)
+                                        + copy.deepcopy(rest))
+                s.orelse = self._rw_block(list(s.orelse)
+                                          + copy.deepcopy(rest))
+                out.append(s)
+                return out
+            if isinstance(s, (ast.While, ast.For)):
+                flags = self._rw_loop(s)
+                out.extend(
+                    ast.Assign(targets=[_name(f, ast.Store())],
+                               value=ast.Constant(value=False))
+                    for f, _ in flags)
+                out.append(s)
+                # post-loop dispatch: which return (if any) fired?
+                node = self._rw_block(rest)
+                for f, value in reversed(flags):
+                    node = [ast.If(
+                        test=_name(f),
+                        body=[ast.Assign(
+                            targets=[_name(RET_VAL, ast.Store())],
+                            value=value)],
+                        orelse=node)]
+                out.extend(node)
+                return out
+            if isinstance(s, ast.With):
+                # a return under `with` would skip __exit__ ordering in
+                # the rewrite; keep Python semantics via fallback
+                raise _ReturnUnsupported
+            out.append(s)
+        out.append(ast.Assign(targets=[_name(RET_VAL, ast.Store())],
+                              value=ast.Constant(value=None)))
+        return out
+
+    def _rw_loop(self, loop):
+        """Replace each `return A` in the loop body (one loop level) with
+        `flag = True; break`; returns [(flag, A)] in source order."""
+        flags = []
+
+        def rw(stmts, depth):
+            out = []
+            for s in stmts:
+                if isinstance(s, ast.Return):
+                    if depth > 0:
+                        raise _ReturnUnsupported   # nested-loop return
+                    f = self._fresh()
+                    flags.append((f, s.value or ast.Constant(value=None)))
+                    out.append(ast.Assign(
+                        targets=[_name(f, ast.Store())],
+                        value=ast.Constant(value=True)))
+                    out.append(ast.Break())
+                    continue
+                if isinstance(s,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        or not _has_return([s]):
+                    out.append(s)
+                    continue
+                if isinstance(s, ast.If):
+                    s.body = rw(s.body, depth)
+                    s.orelse = rw(s.orelse, depth)
+                elif isinstance(s, (ast.While, ast.For)):
+                    s.body = rw(s.body, depth + 1)
+                else:
+                    raise _ReturnUnsupported
+                out.append(s)
+            return out
+
+        loop.body = rw(loop.body, 0)
+        return flags
 
 
 # ---------------------------------------------------------------------------
@@ -584,7 +741,24 @@ class ListTransformer(ast.NodeTransformer):
     `l = _jst.convert_append(l, x)` — the reassignment makes `l` a
     loop-carried var for LoopTransformer, and convert_append picks plain
     list vs tensor-array semantics at trace time.  MUST run before the
-    loop passes."""
+    loop passes.
+
+    Appends inside NESTED defs are left as real `.append` calls: the
+    reassignment would turn a closed-over list into an unbound local
+    (closure mutation needs `nonlocal`), while genuine Python append on
+    the closure cell works at trace time."""
+
+    def __init__(self):
+        self._depth = 0
+
+    def visit_FunctionDef(self, node):
+        if self._depth == 0:           # the function being transformed
+            self._depth += 1
+            self.generic_visit(node)
+            self._depth -= 1
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
 
     def visit_Expr(self, node):
         self.generic_visit(node)
@@ -726,6 +900,18 @@ def transform_function(fn):
     fdef.decorator_list = [
         d for d in fdef.decorator_list if not _is_declarative(d)
     ]
+
+    # pass 0 applies per function DEF — the outer one and every nested
+    # def (a nested def's source is unavailable to convert_call once the
+    # outer function is re-exec'd from transformed source, so its
+    # control flow must convert IN PLACE here; the later passes already
+    # descend into nested defs).  Children first: the outer restructure
+    # may duplicate a nested def node, and a second transform of an
+    # already-rewritten def is a no-op.
+    for fd in reversed([n for n in ast.walk(fdef)
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))]):
+        ReturnTransformer().transform(fd)
 
     for pass_cls in (
         ListTransformer,          # append->assign BEFORE loop-var capture

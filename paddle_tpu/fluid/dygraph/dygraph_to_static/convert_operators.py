@@ -61,6 +61,13 @@ def convert_ifelse(pred, true_fn, false_fn, names, orig_vals):
 
         holder = {}
 
+        def _is_var_tuple(v):
+            # a tuple/list slot carrying at least one tensor (mixed
+            # tensor/python-scalar tuples count: the scalars must agree
+            # between branches, checked at stitch time)
+            return (isinstance(v, (tuple, list)) and v
+                    and any(isinstance(e, Variable) for e in v))
+
         def wrap(fn, tag, lift):
             def inner():
                 vals = list(fn(*orig_vals))
@@ -71,7 +78,16 @@ def convert_ifelse(pred, true_fn, false_fn, names, orig_vals):
                         for v in vals
                     ]
                 holder[tag] = vals
-                return [v for v in vals if isinstance(v, Variable)]
+                flat = []
+                for v in vals:
+                    if isinstance(v, Variable):
+                        flat.append(v)
+                    elif _is_var_tuple(v):
+                        # a structured slot (e.g. `return a, b` merged by
+                        # the return rewrite): its tensors ride the cond
+                        # outputs and the structure rebuilds at stitch
+                        flat.extend(e for e in v if isinstance(e, Variable))
+                return flat
 
             return inner
 
@@ -104,6 +120,34 @@ def convert_ifelse(pred, true_fn, false_fn, names, orig_vals):
         for i, name in enumerate(names):
             tv, fv = t_vals[i], f_vals[i]
             t_tensor, f_tensor = isinstance(tv, Variable), isinstance(fv, Variable)
+            if _is_var_tuple(tv) or _is_var_tuple(fv):
+                ok = (type(tv) is type(fv)
+                      and _is_var_tuple(tv) and _is_var_tuple(fv)
+                      and len(tv) == len(fv)
+                      and all(isinstance(a, Variable)
+                              == isinstance(b, Variable)
+                              for a, b in zip(tv, fv)))
+                if ok:
+                    rebuilt = []
+                    for a, b in zip(tv, fv):
+                        if isinstance(a, Variable):
+                            rebuilt.append(outs[oi])
+                            oi += 1
+                        elif a == b:   # python element: must agree
+                            rebuilt.append(a)
+                        else:
+                            ok = False
+                            break
+                if not ok:
+                    raise TypeError(
+                        "@declarative: variable '%s' is a tensor "
+                        "tuple/list of mismatched structure between "
+                        "branches of a data-dependent `if` (%r vs %r); "
+                        "tensor positions and python elements must match"
+                        % (name, tv, fv)
+                    )
+                result.append(type(tv)(rebuilt))
+                continue
             if t_tensor != f_tensor:
                 raise TypeError(
                     "@declarative: variable '%s' is a tensor in one branch "

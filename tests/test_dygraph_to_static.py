@@ -390,3 +390,266 @@ def test_convert_call_distinct_closures_and_methods():
     with dygraph.guard():
         out = g(to_variable(np.zeros((1,), np.float32)))
         assert float(np.asarray(out.data)[0]) == pytest.approx(3.0)
+
+
+# --- round-5: early return (reference return_transformer.py patterns) -------
+
+
+def test_early_return_under_tensor_if():
+    """reference test_return.py test_return_if: a data-dependent early
+    return becomes a cond output, ONE cached program serves both paths."""
+    @declarative
+    def f(x):
+        if layers.reduce_sum(x) > 0:
+            return x * 2.0
+        return x - 1.0
+
+    with dygraph.guard():
+        pos = np.ones((2, 3), np.float32)
+        neg = -np.ones((2, 3), np.float32)
+        np.testing.assert_allclose(np.asarray(f(to_variable(pos)).data),
+                                   pos * 2.0)
+        np.testing.assert_allclose(np.asarray(f(to_variable(neg)).data),
+                                   neg - 1.0)
+    assert len(f.program_cache) == 1
+    traced = next(iter(f.program_cache.values()))
+    assert "cond" in _collect_op_types(traced)
+
+
+def test_early_return_skips_downstream_statements():
+    """reference test_return.py test_return_in_if: code after the taken
+    return must not affect the result."""
+    @declarative
+    def f(x):
+        y = x * 1.0
+        if layers.reduce_sum(x) > 0:
+            return y + 100.0
+        y = y * 3.0
+        return y
+
+    with dygraph.guard():
+        pos = np.ones((2, 2), np.float32)
+        neg = -np.ones((2, 2), np.float32)
+        np.testing.assert_allclose(np.asarray(f(to_variable(pos)).data),
+                                   pos + 100.0)
+        np.testing.assert_allclose(np.asarray(f(to_variable(neg)).data),
+                                   neg * 3.0)
+
+
+def test_early_return_elif_chain():
+    """reference test_return.py test_return_if_elif_else pattern."""
+    @declarative
+    def f(x):
+        s = layers.reduce_sum(x)
+        if s > 10.0:
+            return x * 4.0
+        elif s > 0:
+            return x * 2.0
+        return x * 0.5
+
+    with dygraph.guard():
+        big = np.full((2, 3), 10.0, np.float32)
+        small = np.ones((2, 3), np.float32)
+        neg = -np.ones((2, 3), np.float32)
+        np.testing.assert_allclose(np.asarray(f(to_variable(big)).data),
+                                   big * 4.0)
+        np.testing.assert_allclose(np.asarray(f(to_variable(small)).data),
+                                   small * 2.0)
+        np.testing.assert_allclose(np.asarray(f(to_variable(neg)).data),
+                                   neg * 0.5)
+    assert len(f.program_cache) == 1
+
+
+def test_early_return_inside_tensor_loop():
+    """reference test_return.py test_return_in_while: return inside a
+    converted loop breaks the loop and carries the value out; the
+    post-loop dispatch evaluates the return expression from the
+    loop-carried state at break time."""
+    @declarative
+    def f(x):
+        while layers.reduce_sum(x) < 6.0:
+            x = x + 1.0
+            if layers.reduce_sum(x) > 4.0:
+                return x * 10.0
+        return x
+
+    with dygraph.guard():
+        out = f(to_variable(np.zeros((2,), np.float32)))
+        # sum climbs 2 per iter; first sum > 4 is 6 at x = [3,3] -> *10
+        np.testing.assert_allclose(np.asarray(out.data), [30.0, 30.0])
+    traced = next(iter(f.program_cache.values()))
+    assert "while_loop_op" in _collect_op_types(traced)
+
+
+def test_early_return_in_python_range_loop_unrolls():
+    """A python-range loop with a tensor-guarded return unrolls at trace
+    time into per-iteration conds — correct values, static control
+    flow."""
+    @declarative
+    def f(x):
+        for _ in range(8):
+            x = x + 1.0
+            if layers.reduce_sum(x) > 6.0:
+                return x * 10.0
+        return x
+
+    with dygraph.guard():
+        out = f(to_variable(np.zeros((2,), np.float32)))
+        # sum after k increments = 2k; 2k > 6 first at k = 4 -> [4,4]*10
+        np.testing.assert_allclose(np.asarray(out.data), [40.0, 40.0])
+    traced = next(iter(f.program_cache.values()))
+    assert "cond" in _collect_op_types(traced)
+
+
+def test_early_return_tuple_values():
+    """reference test_return.py test_return_tuple pattern: structured
+    returns merge across paths."""
+    @declarative
+    def f(x):
+        if layers.reduce_sum(x) > 0:
+            return x * 2.0, x + 1.0
+        return x * 3.0, x - 1.0
+
+    with dygraph.guard():
+        pos = np.ones((2,), np.float32)
+        neg = -np.ones((2,), np.float32)
+        a, b = f(to_variable(pos))
+        np.testing.assert_allclose(np.asarray(a.data), pos * 2.0)
+        np.testing.assert_allclose(np.asarray(b.data), pos + 1.0)
+        a, b = f(to_variable(neg))
+        np.testing.assert_allclose(np.asarray(a.data), neg * 3.0)
+        np.testing.assert_allclose(np.asarray(b.data), neg - 1.0)
+
+
+def test_early_return_python_condition_stays_python():
+    """A plain-Python early return keeps trace-time semantics (two cache
+    entries NOT needed — the flag guard folds at trace time)."""
+    @declarative
+    def f(x, flag):
+        if flag:                       # python bool, trace-time
+            return x + 10.0
+        return x
+
+    with dygraph.guard():
+        x = np.ones((2,), np.float32)
+        np.testing.assert_allclose(
+            np.asarray(f(to_variable(x), True).data), x + 10.0)
+        np.testing.assert_allclose(
+            np.asarray(f(to_variable(x), False).data), x)
+
+
+def test_nested_closure_with_early_return():
+    """reference test_closure_analysis / convert_call pattern: a nested
+    def closing over an enclosing local converts recursively, including
+    ITS early return."""
+    @declarative
+    def f(x):
+        scale = 3.0
+
+        def inner(v):
+            if layers.reduce_sum(v) > 0:
+                return v * scale
+            return v - scale
+
+        return inner(x) + 1.0
+
+    with dygraph.guard():
+        pos = np.ones((2,), np.float32)
+        neg = -np.ones((2,), np.float32)
+        np.testing.assert_allclose(np.asarray(f(to_variable(pos)).data),
+                                   pos * 3.0 + 1.0)
+        np.testing.assert_allclose(np.asarray(f(to_variable(neg)).data),
+                                   neg - 3.0 + 1.0)
+
+
+def test_closure_mutation_of_enclosing_list():
+    """reference test_closure_analysis pattern: a helper mutating an
+    enclosing list (closure side effect) keeps Python semantics at trace
+    time while tensor math still records ops."""
+    @declarative
+    def f(x):
+        acc = []
+
+        def push(v):
+            acc.append(v * 2.0)
+
+        push(x)
+        push(x + 1.0)
+        return acc[0] + acc[1]
+
+    with dygraph.guard():
+        x = np.ones((2,), np.float32)
+        np.testing.assert_allclose(np.asarray(f(to_variable(x)).data),
+                                   x * 2.0 + (x + 1.0) * 2.0)
+
+
+def test_early_return_continuation_not_aliased():
+    """Review r5: the continuation duplicated into both if-branches must
+    be independent AST — a loop with break in the shared continuation
+    still converts on every path."""
+    def make(a, b):
+        @declarative
+        def f(x):
+            if a:                      # python flags via closure snapshot
+                if b:
+                    return x * 2.0
+            i = 0
+            while i < 3:
+                if i == 2:
+                    break
+                i = i + 1
+            return x + float(i)
+        return f
+
+    with dygraph.guard():
+        x = np.ones((2,), np.float32)
+        np.testing.assert_allclose(
+            np.asarray(make(False, False)(to_variable(x)).data), x + 2.0)
+        np.testing.assert_allclose(
+            np.asarray(make(True, True)(to_variable(x)).data), x * 2.0)
+        np.testing.assert_allclose(
+            np.asarray(make(True, False)(to_variable(x)).data), x + 2.0)
+
+
+def test_early_return_with_statement_falls_back_cleanly():
+    """Review r5: a `return` under `with` falls back to the PRISTINE
+    function (python semantics), not a half-rewritten one."""
+    import contextlib
+
+    def make(flag):
+        @declarative
+        def g(x):
+            if flag:                   # python flag via closure snapshot
+                return x * 2.0
+            with contextlib.nullcontext():
+                return x + 1.0
+        return g
+
+    with dygraph.guard():
+        x = np.ones((2,), np.float32)
+        np.testing.assert_allclose(
+            np.asarray(make(True)(to_variable(x)).data), x * 2.0)
+        np.testing.assert_allclose(
+            np.asarray(make(False)(to_variable(x)).data), x + 1.0)
+
+
+def test_mixed_tuple_merges_across_tensor_branches():
+    """Review r5: a (tensor, python scalar) tuple var assigned in both
+    branches of a tensor `if` merges when structure and scalars agree."""
+    @declarative
+    def f(x):
+        if layers.reduce_sum(x) > 0:
+            pair = (x * 2.0, 5)
+        else:
+            pair = (x * 3.0, 5)
+        return pair[0] * float(pair[1])
+
+    with dygraph.guard():
+        pos = np.ones((2,), np.float32)
+        neg = -np.ones((2,), np.float32)
+        np.testing.assert_allclose(np.asarray(f(to_variable(pos)).data),
+                                   pos * 10.0)
+        np.testing.assert_allclose(np.asarray(f(to_variable(neg)).data),
+                                   neg * 15.0)
+    traced = next(iter(f.program_cache.values()))
+    assert "cond" in _collect_op_types(traced)
