@@ -229,13 +229,24 @@ class ReturnTransformer:
       structural-mismatch guidance, the same restriction as any
       diverging branch outputs).
 
-    Returns nested under a second loop level fall back to the untouched
-    function (plain tracing; a tensor condition there raises the
-    Variable.__bool__ guidance error).  Runs FIRST, on the outermost
-    function only (nested defs convert separately via convert_call)."""
+    Returns nested under a second loop level — or functions whose guard
+    nesting would blow the continuation duplication past a size cap —
+    fall back to the untouched function (plain tracing; a tensor
+    condition there raises the Variable.__bool__ guidance error).
+
+    Runs FIRST.  transform_function applies one instance per FunctionDef
+    node, outer AND nested: a nested def's source is unavailable to
+    convert_call once the outer function re-execs from transformed
+    source, so its returns must rewrite here."""
+
+    # continuation statements may duplicate into both if-branches; cap
+    # the total copies so guard-clause-heavy functions can't go
+    # exponential (past the cap: pristine-function fallback)
+    MAX_COPIED_STMTS = 2000
 
     def __init__(self):
         self._uid = 0
+        self._copied = 0
 
     def _fresh(self):
         self._uid += 1
@@ -283,6 +294,10 @@ class ReturnTransformer:
             if isinstance(s, ast.If):
                 # each branch gets its OWN copy of the continuation:
                 # later in-place passes must not see aliased nodes
+                self._copied += 2 * sum(
+                    1 for r in rest for _ in ast.walk(r))
+                if self._copied > self.MAX_COPIED_STMTS:
+                    raise _ReturnUnsupported    # exponential guard chain
                 s.body = self._rw_block(list(s.body)
                                         + copy.deepcopy(rest))
                 s.orelse = self._rw_block(list(s.orelse)
@@ -743,19 +758,36 @@ class ListTransformer(ast.NodeTransformer):
     list vs tensor-array semantics at trace time.  MUST run before the
     loop passes.
 
-    Appends inside NESTED defs are left as real `.append` calls: the
-    reassignment would turn a closed-over list into an unbound local
-    (closure mutation needs `nonlocal`), while genuine Python append on
-    the closure cell works at trace time."""
+    In a NESTED def, only appends to the def's OWN locals rewrite; a
+    free (closed-over) name keeps the real `.append` call — the
+    reassignment would turn it into an unbound local (closure mutation
+    needs `nonlocal`), while genuine Python append on the closure cell
+    works at trace time."""
 
     def __init__(self):
-        self._depth = 0
+        self._locals = None      # None = outer function (always rewrite)
+
+    def _nested_locals(self, node):
+        args = node.args
+        names = set(_assigned_names(node.body))
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            names.add(a.arg)
+        if args.vararg:
+            names.add(args.vararg.arg)
+        if args.kwarg:
+            names.add(args.kwarg.arg)
+        return names
 
     def visit_FunctionDef(self, node):
-        if self._depth == 0:           # the function being transformed
-            self._depth += 1
+        if self._locals is None:       # the function being transformed
+            self._locals = False
             self.generic_visit(node)
-            self._depth -= 1
+            self._locals = None
+        else:
+            prev = self._locals
+            self._locals = self._nested_locals(node)
+            self.generic_visit(node)
+            self._locals = prev
         return node
 
     visit_AsyncFunctionDef = visit_FunctionDef
@@ -769,6 +801,8 @@ class ListTransformer(ast.NodeTransformer):
                 and isinstance(call.func.value, ast.Name)
                 and len(call.args) == 1 and not call.keywords):
             tgt = call.func.value.id
+            if isinstance(self._locals, set) and tgt not in self._locals:
+                return node            # free name in a nested def
             return ast.Assign(
                 targets=[_name(tgt, ast.Store())],
                 value=_jst_call("convert_append",

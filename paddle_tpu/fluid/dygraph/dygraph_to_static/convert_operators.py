@@ -128,12 +128,20 @@ def convert_ifelse(pred, true_fn, false_fn, names, orig_vals):
                               == isinstance(b, Variable)
                               for a, b in zip(tv, fv)))
                 if ok:
+                    def _same(a, b):
+                        if a is b:
+                            return True
+                        try:
+                            return bool(a == b)
+                        except Exception:
+                            return False   # ambiguous (e.g. ndarray)
+
                     rebuilt = []
                     for a, b in zip(tv, fv):
                         if isinstance(a, Variable):
                             rebuilt.append(outs[oi])
                             oi += 1
-                        elif a == b:   # python element: must agree
+                        elif _same(a, b):  # python element: must agree
                             rebuilt.append(a)
                         else:
                             ok = False

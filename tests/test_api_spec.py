@@ -1,0 +1,41 @@
+"""API freeze checker (reference `paddle/fluid/API.spec` +
+`tools/diff_api.py` pattern): the live public surface must match the
+reviewed API.spec file exactly — any add/remove/signature change fails
+here until API.spec is regenerated (a reviewed act)."""
+
+import difflib
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_public_api_matches_spec():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import gen_api_spec
+
+    live = gen_api_spec.generate().splitlines(keepends=True)
+    with open(os.path.join(REPO, "API.spec")) as f:
+        frozen = f.readlines()
+    if live != frozen:
+        diff = "".join(difflib.unified_diff(
+            frozen, live, fromfile="API.spec (reviewed)",
+            tofile="live surface", n=0))
+        raise AssertionError(
+            "public API surface changed without review:\n%s\n"
+            "If the change is intended, regenerate with "
+            "`python tools/gen_api_spec.py` and commit API.spec."
+            % diff[:8000])
+
+
+def test_spec_has_expected_scale():
+    """Sanity: the spec pins the real surface, not a truncated one."""
+    with open(os.path.join(REPO, "API.spec")) as f:
+        lines = f.read().splitlines()
+    ops = [l for l in lines if l.startswith("op ")]
+    apis = [l for l in lines
+            if l and not l.startswith(("#", "##", "op "))]
+    assert len(ops) >= 460, len(ops)
+    assert len(apis) >= 900, len(apis)
+    assert "op multiclass_nms" in ops
+    assert any(l.startswith("paddle_tpu.fluid.layers.fc ") for l in apis)

@@ -653,3 +653,77 @@ def test_mixed_tuple_merges_across_tensor_branches():
                                    neg * 15.0)
     traced = next(iter(f.program_cache.values()))
     assert "cond" in _collect_op_types(traced)
+
+
+def test_nested_def_local_list_append_still_rewrites():
+    """Review r5: a nested helper's OWN local list still gets the
+    convert_append rewrite (only closed-over names keep real .append)."""
+    @declarative
+    def f(x):
+        def tail_sums(v):
+            acc = []
+            for i in range(3):
+                acc.append(layers.reduce_sum(v) + float(i))
+            return acc[0] + acc[1] + acc[2]
+
+        return tail_sums(x)
+
+    with dygraph.guard():
+        x = np.ones((2,), np.float32)
+        out = f(to_variable(x))
+        assert float(np.asarray(out.data)) == pytest.approx(2*3 + 0+1+2)
+
+
+def test_deep_guard_chain_falls_back_not_hangs():
+    """Review r5: many sequential guard clauses must not explode the
+    continuation duplication — past the cap the function falls back to
+    pristine tracing (python flags still work)."""
+    import time
+
+    def make(k):
+        src_flags = ", ".join("f%d" % i for i in range(16))
+        body = "\n".join(
+            "    if f%d:\n        if f%d:\n            return x + %d.0"
+            % (i, i, i) for i in range(16))
+        code = ("def g(x, %s):\n%s\n    return x\n" % (src_flags, body))
+        ns = {}
+        exec(code, ns)
+        return ns["g"]
+
+    from paddle_tpu.fluid.dygraph.dygraph_to_static import (
+        ast_transformer as at,
+    )
+
+    g = make(16)
+    t0 = time.monotonic()
+    new = at.transform_function(g)
+    dt = time.monotonic() - t0
+    assert dt < 10.0, "transform took %.1fs (blowup not capped)" % dt
+    # fallback keeps python semantics
+    fn = new if new is not None else g
+    assert fn(1.0, *([False] * 16)) == 1.0
+    args = [False] * 16
+    args[3] = True
+    assert fn(1.0, *args) == 4.0
+
+
+def test_mixed_tuple_with_ndarray_element_merges():
+    """Review r5: a shared non-scalar python element (ndarray) in a
+    tuple slot must not crash the ambiguous-truth comparison."""
+    meta = np.array([1.0, 2.0], np.float32)
+
+    @declarative
+    def f(x):
+        if layers.reduce_sum(x) > 0:
+            pair = (x * 2.0, meta)
+        else:
+            pair = (x * 3.0, meta)
+        return pair[0] + float(pair[1][0])
+
+    with dygraph.guard():
+        pos = np.ones((2,), np.float32)
+        neg = -np.ones((2,), np.float32)
+        np.testing.assert_allclose(np.asarray(f(to_variable(pos)).data),
+                                   pos * 2.0 + 1.0)
+        np.testing.assert_allclose(np.asarray(f(to_variable(neg)).data),
+                                   neg * 3.0 + 1.0)

@@ -1,0 +1,111 @@
+"""Generate API.spec — the frozen public-API surface.
+
+Capability parity: reference `paddle/fluid/API.spec:1` +
+`tools/diff_api.py:1` (the API is pinned in a reviewed file; CI fails on
+any unreviewed signature change).  Run `python tools/gen_api_spec.py`
+to refresh the file AFTER reviewing the diff; `tests/test_api_spec.py`
+is the checker.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the reviewed public surface: module path -> spec prefix
+PUBLIC_MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.fluid",
+    "paddle_tpu.fluid.layers",
+    "paddle_tpu.fluid.layers.detection",
+    "paddle_tpu.fluid.optimizer",
+    "paddle_tpu.fluid.initializer",
+    "paddle_tpu.fluid.io",
+    "paddle_tpu.fluid.metrics",
+    "paddle_tpu.fluid.clip",
+    "paddle_tpu.fluid.regularizer",
+    "paddle_tpu.fluid.profiler",
+    "paddle_tpu.fluid.dygraph",
+    "paddle_tpu.fluid.contrib.mixed_precision",
+    "paddle_tpu.fluid.contrib.slim.prune",
+    "paddle_tpu.fluid.contrib.slim.distillation",
+    "paddle_tpu.fluid.contrib.slim.nas",
+    "paddle_tpu.fluid.contrib.slim.core",
+    "paddle_tpu.nn",
+    "paddle_tpu.nn.functional",
+    "paddle_tpu.tensor",
+    "paddle_tpu.metric",
+    "paddle_tpu.distributed",
+    "paddle_tpu.fleet",
+    "paddle_tpu.inference",
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def _entries_for(modname):
+    import importlib
+
+    mod = importlib.import_module(modname)
+    out = []
+    names = getattr(mod, "__all__", None) or [
+        n for n in dir(mod) if not n.startswith("_")
+    ]
+    for n in sorted(set(names)):
+        obj = getattr(mod, n, None)
+        if obj is None or inspect.ismodule(obj):
+            continue
+        if inspect.isclass(obj):
+            out.append("%s.%s.__init__ %s"
+                       % (modname, n, _sig(obj.__init__)))
+            for mn, mv in sorted(vars(obj).items()):
+                if mn.startswith("_") or not callable(mv):
+                    continue
+                out.append("%s.%s.%s %s" % (modname, n, mn, _sig(mv)))
+        elif callable(obj):
+            out.append("%s.%s %s" % (modname, n, _sig(obj)))
+    return out
+
+
+def generate():
+    lines = [
+        "# API.spec — frozen public surface (cf. reference "
+        "paddle/fluid/API.spec).",
+        "# Regenerate with `python tools/gen_api_spec.py` AFTER reviewing "
+        "the change;",
+        "# tests/test_api_spec.py diffs this file against the live "
+        "surface.",
+    ]
+    for m in PUBLIC_MODULES:
+        lines.append("")
+        lines.append("## %s" % m)
+        lines.extend(_entries_for(m))
+    # the op registry is public extension surface: pin the op NAMES
+    import paddle_tpu.fluid.ops  # noqa: F401  (registers everything)
+    from paddle_tpu.fluid.core.registry import registered_ops
+
+    lines.append("")
+    lines.append("## op registry")
+    for n in sorted(registered_ops()):
+        lines.append("op %s" % n)
+    return "\n".join(lines) + "\n"
+
+
+if __name__ == "__main__":
+    spec = generate()
+    path = os.path.join(REPO, "API.spec")
+    with open(path, "w") as f:
+        f.write(spec)
+    n_ops = spec.count("\nop ")
+    n_api = sum(1 for l in spec.splitlines()
+                if l and not l.startswith(("#", "##", "op ")))
+    print("wrote %s: %d API entries, %d ops" % (path, n_api, n_ops))
