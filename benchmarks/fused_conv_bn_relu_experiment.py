@@ -1,0 +1,230 @@
+"""PERF.md r4's one declared-untested lever: a fused conv+BN+relu pallas
+pipeline for ResNet.  The tractable instance is the 1x1 conv (an
+[M, K] x [K, N] matmul over B*H*W rows) with the BN scale/shift + relu
+epilogue fused into the matmul's output tiles — ResNet-50's bottleneck
+blocks are mostly 1x1 convs, and BN stat reduces are the measured VPU
+bottleneck.
+
+Measures, on the real chip:
+  A. XLA composition: conv1x1 -> fused BN train normalize -> relu
+     (what models/resnet.py runs today);
+  B. pallas fused kernel: matmul with the BN+relu epilogue in-kernel
+     (inference-style affine: scale/shift precomputed);
+  C. the same A but inference-style affine (apples-to-apples with B).
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/fused_conv_bn_relu_experiment.py
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# representative mid-network 1x1 conv: [128, 56, 56, 64] -> 256
+B, H, W, K, N = 128, 56, 56, 64, 256
+M = B * H * W
+BM, BN, BK = 512, 256, 64
+
+
+def fused_kernel(x_ref, w_ref, scale_ref, shift_ref, o_ref, acc_ref, *,
+                 nk):
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[...].astype(jnp.float32), w_ref[...].astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(kk == nk - 1)
+    def _():
+        y = acc_ref[...] * scale_ref[0, :][None, :] \
+            + shift_ref[0, :][None, :]
+        o_ref[...] = jnp.maximum(y, 0.0).astype(o_ref.dtype)
+
+
+def pallas_fused(x, w, scale, shift):
+    nk = K // BK
+    return pl.pallas_call(
+        functools.partial(fused_kernel, nk=nk),
+        grid=(M // BM, N // BN, nk),
+        in_specs=[
+            pl.BlockSpec((BM, BK), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((BK, BN), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, BN), lambda i, j, kk: (0, j)),
+            pl.BlockSpec((1, BN), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((BM, BN), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((BM, BN), jnp.float32)],
+    )(x, w, scale, shift)
+
+
+def bench(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    # chain with data dependence + one host fetch (tunnel-honest)
+    def seg(n, x0):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(n):
+            o = fn(x0, *args[1:])
+            x0 = (x0 + o[: x0.shape[0], : x0.shape[1]].astype(x0.dtype)
+                  * 0.0)
+        float(jnp.sum(o[:1, :1].astype(jnp.float32)))
+        return time.perf_counter() - t0
+    shorts = [seg(5, args[0]) for _ in range(3)]
+    longs = [seg(20, args[0]) for _ in range(3)]
+    return (min(longs) - min(shorts)) / 15 * 1e3
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(M, K).astype(np.float32) * 0.5).astype(
+        jnp.bfloat16)
+    w = jnp.asarray(rng.randn(K, N).astype(np.float32) * 0.1).astype(
+        jnp.bfloat16)
+    gamma = jnp.asarray(rng.rand(N).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(N).astype(np.float32) * 0.1)
+    scale = gamma.reshape(1, N)
+    shift = beta.reshape(1, N)
+
+    @jax.jit
+    def xla_affine(x, w, scale, shift):
+        y = (x @ w).astype(jnp.float32)
+        return jnp.maximum(y * scale + shift, 0.0).astype(x.dtype)
+
+    @jax.jit
+    def xla_bn_train(x, w, gamma, beta):
+        y = (x @ w).astype(jnp.float32)
+        mu = jnp.mean(y, axis=0)
+        var = jnp.mean(y * y, axis=0) - mu * mu
+        yn = (y - mu) * jax.lax.rsqrt(var + 1e-5)
+        return jnp.maximum(yn * gamma + beta, 0.0).astype(x.dtype)
+
+    jit_fused = jax.jit(pallas_fused)
+
+    t_aff = bench(xla_affine, x, w, scale, shift)
+    t_bn = bench(xla_bn_train, x, w, gamma, beta)
+    t_pl = bench(jit_fused, x, w, scale, shift)
+
+    # correctness of the pallas kernel vs the XLA affine composition
+    got = np.asarray(jit_fused(x, w, scale, shift), np.float32)
+    want = np.asarray(xla_affine(x, w, scale, shift), np.float32)
+    err = np.abs(got - want).max()
+    gflop = 2 * M * K * N / 1e9
+    print("1x1 conv %dx%d @ %dx%d (%.1f GFLOP)" % (M, K, K, N, gflop))
+    print("XLA matmul+affine+relu : %7.3f ms  (%.0f TFLOP/s)"
+          % (t_aff, gflop / t_aff))
+    print("XLA matmul+BN-train+relu: %7.3f ms  (%.0f TFLOP/s)"
+          % (t_bn, gflop / t_bn))
+    print("pallas fused mm+bn+relu: %7.3f ms  (%.0f TFLOP/s)  maxerr %.4f"
+          % (t_pl, gflop / t_pl, err))
+
+
+if __name__ == "__main__":
+    main()
+
+
+# -- train-mode variant: matmul emits (y, col-sum, col-sumsq) in one
+# pass; normalize+relu is a second elementwise pass (BN train stats
+# depend on ALL rows, so a single fused pass is impossible by data
+# dependence — the question is whether the pallas stat epilogue beats
+# XLA's own fused reduce)
+
+
+def fused_stats_kernel(x_ref, w_ref, o_ref, s1_ref, s2_ref, acc_ref, *,
+                       nk, nm):
+    kk = pl.program_id(2)
+    i = pl.program_id(0)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[...].astype(jnp.float32), w_ref[...].astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(kk == nk - 1)
+    def _():
+        y = acc_ref[...]
+        o_ref[...] = y.astype(o_ref.dtype)
+        part1 = jnp.sum(y, axis=0)[None, :]
+        part2 = jnp.sum(y * y, axis=0)[None, :]
+
+        @pl.when(i == 0)
+        def _z():
+            s1_ref[...] = jnp.zeros_like(s1_ref)
+            s2_ref[...] = jnp.zeros_like(s2_ref)
+
+        s1_ref[...] += part1
+        s2_ref[...] += part2
+
+
+def pallas_mm_stats(x, w):
+    nk = K // BK
+    nm = M // BM
+    return pl.pallas_call(
+        functools.partial(fused_stats_kernel, nk=nk, nm=nm),
+        grid=(nm, N // BN, nk),
+        in_specs=[
+            pl.BlockSpec((BM, BK), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((BK, BN), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((BM, BN), lambda i, j, kk: (i, j)),
+            pl.BlockSpec((1, BN), lambda i, j, kk: (0, j)),
+            pl.BlockSpec((1, BN), lambda i, j, kk: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((M, N), x.dtype),
+            jax.ShapeDtypeStruct((1, N), jnp.float32),
+            jax.ShapeDtypeStruct((1, N), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((BM, BN), jnp.float32)],
+    )(x, w)
+
+
+def train_mode_extra():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(M, K).astype(np.float32) * 0.5).astype(
+        jnp.bfloat16)
+    w = jnp.asarray(rng.randn(K, N).astype(np.float32) * 0.1).astype(
+        jnp.bfloat16)
+    gamma = jnp.asarray(rng.rand(N).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(N).astype(np.float32) * 0.1)
+
+    @jax.jit
+    def pallas_bn_train(x, w, gamma, beta):
+        y, s1, s2 = pallas_mm_stats(x, w)
+        mu = s1[0] / M
+        var = s2[0] / M - mu * mu
+        yn = (y.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + 1e-5)
+        return jnp.maximum(yn * gamma + beta, 0.0).astype(x.dtype)
+
+    @jax.jit
+    def xla_bn_train(x, w, gamma, beta):
+        y = (x @ w).astype(jnp.float32)
+        mu = jnp.mean(y, axis=0)
+        var = jnp.mean(y * y, axis=0) - mu * mu
+        yn = (y - mu) * jax.lax.rsqrt(var + 1e-5)
+        return jnp.maximum(yn * gamma + beta, 0.0).astype(x.dtype)
+
+    t_pl = bench(pallas_bn_train, x, w, gamma, beta)
+    t_xla = bench(xla_bn_train, x, w, gamma, beta)
+    got = np.asarray(pallas_bn_train(x, w, gamma, beta), np.float32)
+    want = np.asarray(xla_bn_train(x, w, gamma, beta), np.float32)
+    err = np.abs(got - want).max()
+    print("TRAIN-mode (stats + normalize pass):")
+    print("  XLA   : %7.3f ms" % t_xla)
+    print("  pallas: %7.3f ms  maxerr %.4f" % (t_pl, err))
+
+
+if __name__ == "__main__":
+    train_mode_extra()

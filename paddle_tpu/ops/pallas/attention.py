@@ -33,6 +33,7 @@ kernels through the pallas interpreter for testing.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +47,17 @@ NEG_INF = -1e30
 _FALLBACK_WARNED: set = set()
 
 
+def _acc_dtype():
+    """Accumulator dtype for the MULTI-block schedules' running/cross-
+    block accumulators (fwd acc, dq acc, dk/dv acc).  f32 by default;
+    `PADDLE_TPU_FLASH_ACC=bf16` halves accumulator VMEM at a documented
+    accuracy cost (see test_pallas_attention tolerance policy).  Row
+    max/sum statistics always stay f32 — they are tiny and their error
+    compounds through every block's softmax rescale."""
+    return (jnp.bfloat16 if os.getenv("PADDLE_TPU_FLASH_ACC") == "bf16"
+            else jnp.float32)
+
+
 def _pick_block(s):
     for b in (512, 256, 128):
         if s % b == 0:
@@ -54,8 +66,6 @@ def _pick_block(s):
 
 
 def _block_sizes(sq, sk):
-    import os
-
     ov = os.getenv("PADDLE_TPU_FLASH_BLOCKS")  # "bq,bk" tuning override
     if ov:
         import warnings
@@ -189,10 +199,13 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
         p = jnp.exp(s - m_new[:, None])  # [bq, bk]
         corr = jnp.exp(m_prev - m_new)  # [bq]
         l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
-        acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        acc_ref[...] = (
+            acc_ref[...].astype(jnp.float32) * corr[:, None]
+            + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        ).astype(acc_ref.dtype)
         m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
@@ -205,7 +218,7 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
     def _finalize():
         l = l_ref[:, 0]
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o = acc_ref[...] / safe_l[:, None]
+        o = acc_ref[...].astype(jnp.float32) / safe_l[:, None]
         # a row whose every score was masked (m stuck at NEG_INF) has been
         # accumulating p = exp(0) = 1 garbage; emit zeros, keep lse at
         # NEG_INF so the backward zeroes it too
@@ -289,7 +302,7 @@ def _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret,
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),  # running row max
             pltpu.VMEM((bq, 128), jnp.float32),  # running row sum
-            pltpu.VMEM((bq, d), jnp.float32),  # output accumulator
+            pltpu.VMEM((bq, d), _acc_dtype()),  # output accumulator
         ],
         interpret=interpret,
     )(*args)
@@ -340,10 +353,12 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
         )  # [bq, bk]
         delta = jnp.sum(do * o, axis=1)  # [bq]
         ds = p * (dp - delta[:, None]) * scale
-        acc_ref[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        acc_ref[...] = (
+            acc_ref[...].astype(jnp.float32) + jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        ).astype(acc_ref.dtype)
 
     if causal:
         pl.when((j * bk) <= (i * bq + bq - 1 + coff))(_compute)
@@ -394,10 +409,12 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg,
         else:
             lse = lse_ref[0, :, 0]
         p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse[:, None]))
-        dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bk, d]
+        dv_acc[...] = (
+            dv_acc[...].astype(jnp.float32) + jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        ).astype(dv_acc.dtype)  # [bk, d]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -405,10 +422,12 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg,
         delta = jnp.sum(do * o, axis=1)
         ds_raw = p * (dp - delta[:, None])  # d bias (unscaled) [bq, bk]
         ds = ds_raw * scale
-        dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bk, d]
+        dk_acc[...] = (
+            dk_acc[...].astype(jnp.float32) + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        ).astype(dk_acc.dtype)  # [bk, d]
         if db_acc is not None:
             db_acc[0:1, :] = db_acc[0:1, :] + jnp.sum(ds_raw, axis=0)[None, :]
 
@@ -423,6 +442,121 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg,
         _st(dv_ref, dv_acc[...].astype(dv_ref.dtype))
         if db_ref is not None:
             db_ref[0, 0, :] = db_acc[0, :].astype(db_ref.dtype)
+
+
+def _row_spec1(rows, d, layout, h):
+    """Single-grid-axis BlockSpec (the fused single-block backward)."""
+    if layout == "BHSD":
+        return pl.BlockSpec((1, rows, d), lambda g: (g, 0, 0))
+    return pl.BlockSpec((1, rows, 1, d), lambda g: (g // h, 0, g % h, 0))
+
+
+def _bwd_fused_kernel(*refs, scale, causal, bq, bk, has_bias, has_seg,
+                      coff=0):
+    """Single-block schedule (nq == nk == 1): dq, dk, dv (and dbias) in
+    ONE kernel.  The two-kernel flash backward recomputes the score
+    matrix, softmax, and dP twice — once row-parallel for dQ, once
+    column-parallel for dK/dV; when one block covers the whole row there
+    is no accumulation across blocks, so a fused kernel shares s/p/dp/ds
+    and does 5 matmuls instead of 7 (plus one exp instead of two).
+    This is the flagship S=512 shape's schedule."""
+    (q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, tail) = _split_refs(
+        refs, has_bias, has_seg
+    )
+    if has_bias:
+        o_ref, do_ref, dq_ref, dk_ref, dv_ref, db_ref = tail
+    else:
+        o_ref, do_ref, dq_ref, dk_ref, dv_ref = tail
+        db_ref = None
+    q = _ld(q_ref).astype(jnp.float32)
+    k = _ld(k_ref).astype(jnp.float32)
+    v = _ld(v_ref).astype(jnp.float32)
+    do = _ld(do_ref).astype(jnp.float32)
+    o = _ld(o_ref).astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = _apply_masks(s, bias_ref, qseg_ref, kseg_ref, causal, 0, 0,
+                     bq, bk, coff)
+    lse = _recompute_lse(s)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse[:, None]))
+    dv = jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bk, d]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bq, bk]
+    delta = jnp.sum(do * o, axis=1)  # [bq]
+    ds_raw = p * (dp - delta[:, None])
+    ds = ds_raw * scale
+    _st(dq_ref, jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dq_ref.dtype))
+    _st(dk_ref, jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dk_ref.dtype))
+    _st(dv_ref, dv.astype(dv_ref.dtype))
+    if db_ref is not None:
+        db_ref[0, 0, :] = jnp.sum(ds_raw, axis=0).astype(db_ref.dtype)
+
+
+def _bwd_fused(q, k, v, bias, qseg, kseg, out, g, h, scale, causal,
+               interpret, coff, layout, bq, bk, bh):
+    has_bias, has_seg = bias is not None, qseg is not None
+    in_specs = [
+        _row_spec1(bq, q.shape[-1], layout, h),   # q
+        _row_spec1(bk, q.shape[-1], layout, h),   # k
+        _row_spec1(bk, q.shape[-1], layout, h),   # v
+    ]
+    args = [q, k, v]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, 1, bk), lambda g_: (g_, 0, 0)))
+        args.append(bias)
+    if has_seg:
+        in_specs.append(
+            pl.BlockSpec((1, bq, 128), lambda g_: (g_ // h, 0, 0)))
+        in_specs.append(
+            pl.BlockSpec((1, 8, bk), lambda g_: (g_ // h, 0, 0)))
+        args.extend([qseg, kseg])
+    in_specs += [
+        _row_spec1(bq, q.shape[-1], layout, h),   # o
+        _row_spec1(bq, q.shape[-1], layout, h),   # do
+    ]
+    args += [out, g]   # lse is recomputed in-kernel: no residual input
+    out_specs = [
+        _row_spec1(bq, q.shape[-1], layout, h),   # dq
+        _row_spec1(bk, q.shape[-1], layout, h),   # dk
+        _row_spec1(bk, q.shape[-1], layout, h),   # dv
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct(k.shape, k.dtype),
+        jax.ShapeDtypeStruct(v.shape, v.dtype),
+    ]
+    if has_bias:
+        out_specs.append(pl.BlockSpec((1, 1, bk), lambda g_: (g_, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct(bias.shape, bias.dtype))
+    res = pl.pallas_call(
+        functools.partial(
+            _bwd_fused_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+            has_bias=has_bias, has_seg=has_seg, coff=coff,
+        ),
+        grid=(bh,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*args)
+    if has_bias:
+        dq, dk, dv, dbias = res
+    else:
+        (dq, dk, dv), dbias = res, None
+    return dq, dk, dv, dbias
 
 
 # ---------------------------------------------------------------------------
@@ -595,6 +729,16 @@ def _flash_core_bwd(n_head, scale, causal, interpret, coff, layout, res, g):
     has_bias, has_seg = bias is not None, qseg is not None
     fast = nq == 1 and nk == 1      # lse recomputed in-kernel (see _fwd)
 
+    if fast and os.getenv("PADDLE_TPU_FLASH_FUSED_BWD", "1") != "0":
+        dq, dk, dv, dbias = _bwd_fused(
+            q, k, v, bias, qseg, kseg, out, g, h, scale, causal,
+            interpret, coff, layout, bq, bk, bh)
+        dqseg = (np.zeros(qseg.shape, jax.dtypes.float0)
+                 if qseg is not None else None)
+        dkseg = (np.zeros(kseg.shape, jax.dtypes.float0)
+                 if kseg is not None else None)
+        return dq, dk, dv, dbias, dqseg, dkseg
+
     def _lse_spec(order):
         if fast:
             return pl.BlockSpec((1, 8, 128), lambda b, a, c: (b, 0, 0))
@@ -635,7 +779,7 @@ def _flash_core_bwd(n_head, scale, causal, interpret, coff, layout, res, g):
         in_specs=dq_specs,
         out_specs=_row_spec(bq, d, layout, h, 1),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, d), _acc_dtype())],
         interpret=interpret,
     )(*args)
 
@@ -668,8 +812,8 @@ def _flash_core_bwd(n_head, scale, causal, interpret, coff, layout, res, g):
         jax.ShapeDtypeStruct(v.shape, v.dtype),
     ]
     scratch = [
-        pltpu.VMEM((bk, d), jnp.float32),
-        pltpu.VMEM((bk, d), jnp.float32),
+        pltpu.VMEM((bk, d), _acc_dtype()),
+        pltpu.VMEM((bk, d), _acc_dtype()),
     ]
     if has_bias:
         out_specs.append(pl.BlockSpec((1, 1, bk), lambda b, j, i: (b, 0, j)))

@@ -240,3 +240,89 @@ def test_bshd_pad_path():
     assert out.shape == (B, S, H, D)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_bf16_accumulator_flag_tolerance_policy(monkeypatch):
+    """PADDLE_TPU_FLASH_ACC=bf16 trades accumulator precision for VMEM
+    on MULTI-block schedules.  Tolerance policy (the reference AMP
+    white_list pattern — looser, documented bounds for a reduced-
+    precision mode): forward rtol 2e-2 vs the f32-accumulator kernel;
+    gradients rtol 5e-2.  The default (f32) path must be unaffected by
+    the flag machinery."""
+    rng = np.random.RandomState(0)
+    B, H, S, D = 1, 2, 1024, 64    # S=1024, block 512 -> 2x2 blocks
+    q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    scale = D ** -0.5
+
+    def run(acc):
+        if acc:
+            monkeypatch.setenv("PADDLE_TPU_FLASH_ACC", acc)
+        else:
+            monkeypatch.delenv("PADDLE_TPU_FLASH_ACC", raising=False)
+
+        def f(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, scale=scale, causal=True,
+                                interpret=True) * 0.01)
+
+        out = flash_attention(q, k, v, scale=scale, causal=True,
+                              interpret=True)
+        grads = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        return out, grads
+
+    out32, g32 = run(None)
+    out16, g16 = run("bf16")
+    # f32 path tracks the oracle tightly
+    ref = _naive_attention(q, k, v, None, scale, True)
+    np.testing.assert_allclose(np.asarray(out32), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    # bf16 accumulators: documented looser bounds
+    np.testing.assert_allclose(np.asarray(out16), np.asarray(out32),
+                               rtol=2e-2, atol=2e-2)
+    for a, b, name in zip(g16, g32, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-2, atol=5e-2,
+            err_msg="bf16-acc grad tolerance exceeded for %s" % name)
+
+
+def test_fused_single_block_backward_matches_two_kernel(monkeypatch):
+    """The fused single-block backward (PADDLE_TPU_FLASH_FUSED_BWD,
+    default on) must produce the same gradients as the two-kernel
+    schedule on the shapes it serves (nq == nk == 1), including bias and
+    segment ids."""
+    rng = np.random.RandomState(3)
+    B, H, S, D = 2, 2, 128, 64
+    q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    bias = jnp.asarray(
+        np.where(rng.rand(B, 1, 1, S) < 0.2, -1e30, 0.0).astype(np.float32))
+    scale = D ** -0.5
+
+    seg = jnp.asarray(
+        np.repeat(np.arange(4), S // 4)[None, :].repeat(B, 0)
+        .astype(np.int32))            # 4 packed segments per row
+
+    def grads(fused, with_seg):
+        monkeypatch.setenv("PADDLE_TPU_FLASH_FUSED_BWD",
+                           "1" if fused else "0")
+
+        def f(q, k, v, bias):
+            return jnp.sum(
+                flash_attention(q, k, v, bias=bias,
+                                segment_ids=seg if with_seg else None,
+                                scale=scale, causal=True,
+                                interpret=True) * 0.01)
+
+        return jax.grad(f, argnums=(0, 1, 2, 3))(q, k, v, bias)
+
+    for with_seg in (False, True):
+        gf = grads(True, with_seg)
+        gt = grads(False, with_seg)
+        for a, b, name in zip(gf, gt, ["q", "k", "v", "bias"]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                err_msg="fused-bwd grad mismatch for %s (seg=%s)"
+                        % (name, with_seg))
