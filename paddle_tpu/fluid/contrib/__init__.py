@@ -1,6 +1,17 @@
-"""contrib: mixed precision (AMP) + slim (quantization).
+"""contrib: mixed precision, slim compression, decoder library,
+extend_optimizer, and program-stat utilities.
 
 Capability parity: reference `python/paddle/fluid/contrib/`.
 """
 
+from . import decoder  # noqa: F401
+from . import extend_optimizer  # noqa: F401
 from . import mixed_precision, slim  # noqa: F401
+from .extend_optimizer import (  # noqa: F401
+    extend_with_decoupled_weight_decay,
+)
+from .utils_stat import (  # noqa: F401
+    memory_usage,
+    op_freq_statistic,
+    summary,
+)

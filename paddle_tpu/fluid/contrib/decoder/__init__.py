@@ -1,0 +1,8 @@
+"""Decoder library (reference `contrib/decoder/`)."""
+
+from .beam_search_decoder import (  # noqa: F401
+    BeamSearchDecoder,
+    InitState,
+    StateCell,
+    TrainingDecoder,
+)

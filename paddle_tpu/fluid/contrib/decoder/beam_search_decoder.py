@@ -1,0 +1,202 @@
+"""Decoder library: state cells, training decoder, beam-search decoder.
+
+Capability parity: reference `contrib/decoder/beam_search_decoder.py:1`
+(InitState / StateCell / TrainingDecoder / BeamSearchDecoder — a
+user-defined recurrent state cell decoded teacher-forced for training
+and by beam search for inference).
+
+TPU-first redesign: the reference builds DynamicRNN/LoD machinery with
+per-step variable-length candidate pruning.  Here decoding is a STATIC
+unroll to max_len over dense [B(, beam)] tensors — the XLA-friendly
+shape discipline every other sequence feature in this framework uses —
+driving the dense `beam_search` / `beam_search_decode` ops
+(`ops/rnn_ops.py`); finished beams carry their end token and frozen
+score exactly like the reference's pruning, without data-dependent
+shapes."""
+
+from __future__ import annotations
+
+from ... import layers
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]
+
+
+class InitState:
+    """cf. reference InitState: the initial value of one recurrent
+    state — an existing Variable, or a constant built like `init_boot`
+    (same batch) with `shape[-1]`/`value`."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is not None:
+            width = (shape or init_boot.shape)[-1]
+            self._init = layers.fill_constant_batch_size_like(
+                init_boot, [-1, int(width)], dtype, float(value))
+        else:
+            raise ValueError(
+                "InitState needs `init` or `init_boot` (a same-batch "
+                "variable to size the constant state from)")
+        self._need_reorder = need_reorder
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class StateCell:
+    """cf. reference StateCell: named inputs + named recurrent states +
+    a user `@state_updater` that reads inputs/states and set_state()s
+    the new values; `out_state` names the state exposed to scoring."""
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._input_names = list(inputs)
+        self._states = {n: s.value for n, s in states.items()}
+        self._out_state = out_state
+        self._cur_inputs = dict(inputs)
+        self._updater = None
+
+    def state_updater(self, fn):
+        self._updater = fn
+        return fn
+
+    def get_state(self, name):
+        if name not in self._states:
+            raise KeyError(
+                "unknown state %r (have %s)" % (name,
+                                                sorted(self._states)))
+        return self._states[name]
+
+    def set_state(self, name, value):
+        self._states[name] = value
+
+    def get_input(self, name):
+        v = self._cur_inputs.get(name)
+        if v is None:
+            raise KeyError("input %r not provided for this step" % name)
+        return v
+
+    def compute_state(self, inputs):
+        """Run the updater for one step with `inputs` bound."""
+        if self._updater is None:
+            raise RuntimeError(
+                "StateCell has no updater; decorate one with "
+                "@cell.state_updater")
+        self._cur_inputs = dict(inputs)
+        self._updater(self)
+
+    def out_state(self):
+        return self._states[self._out_state]
+
+    def snapshot(self):
+        return dict(self._states)
+
+    def restore(self, snap):
+        self._states = dict(snap)
+
+
+class TrainingDecoder:
+    """cf. reference TrainingDecoder: teacher-forced decoding.  Static
+    redesign: `decode(step_inputs, n_steps)` unrolls the cell over the
+    time dimension of dense [B, T, ...] inputs and returns the stacked
+    per-step outputs [B, T, ...] of `output_fn(cell)`."""
+
+    def __init__(self, state_cell, name=None):
+        self._cell = state_cell
+
+    def decode(self, step_inputs, n_steps, output_fn=None):
+        outs = []
+        for t in range(n_steps):
+            feed = {
+                n: layers.reshape(
+                    layers.slice(v, axes=[1], starts=[t], ends=[t + 1]),
+                    [-1] + [int(s) for s in v.shape[2:]])
+                for n, v in step_inputs.items()
+            }
+            self._cell.compute_state(feed)
+            o = (output_fn(self._cell) if output_fn
+                 else self._cell.out_state())
+            outs.append(layers.unsqueeze(o, [1]))
+        return layers.concat(outs, axis=1)
+
+
+class BeamSearchDecoder:
+    """cf. reference BeamSearchDecoder: decode the cell by beam search.
+
+    The user supplies `embedding_fn(prev_ids [B*beam, 1]) -> {input
+    name: value}` and `logits_fn(cell) -> [B*beam, V]`.  `decode()`
+    tiles every state over the beams, steps max_len times through the
+    dense `beam_search` op (log-softmax scores accumulated; parents
+    reorder the states via a one-hot matmul), and backtracks with
+    `beam_search_decode` into ([B, beam, T] ids, [B, beam] scores)."""
+
+    def __init__(self, state_cell, embedding_fn, logits_fn, beam_size,
+                 end_id, max_len, go_id=None):
+        self._cell = state_cell
+        self._embedding_fn = embedding_fn
+        self._logits_fn = logits_fn
+        self._beam = int(beam_size)
+        self._end = int(end_id)
+        self._max_len = int(max_len)
+        self._go = int(go_id if go_id is not None else end_id)
+
+    def _tile(self, v):
+        """[B, ...] -> [B*beam, ...] (repeat each row beam times)."""
+        beam = self._beam
+        expanded = layers.expand(
+            layers.unsqueeze(v, [1]), [1, beam] + [1] * (len(v.shape) - 1))
+        return layers.reshape(
+            expanded, [-1] + [int(s) for s in v.shape[1:]])
+
+    def decode(self):
+        beam = self._beam
+        cell = self._cell
+        for n, s in cell.snapshot().items():
+            cell.set_state(n, self._tile(s))
+        any_state = cell.out_state()
+
+        pre_ids = layers.reshape(
+            layers.fill_constant_batch_size_like(
+                any_state, [-1, 1], "int64", self._go),
+            [-1, beam])                              # [B, beam] of GO
+        neg = layers.fill_constant_batch_size_like(
+            pre_ids, [-1, beam - 1], "float32", -1e9) \
+            if beam > 1 else None
+        zero = layers.fill_constant_batch_size_like(
+            pre_ids, [-1, 1], "float32", 0.0)
+        pre_scores = (layers.concat([zero, neg], axis=1)
+                      if neg is not None else zero)
+
+        ids_steps, parent_steps = [], []
+        for _ in range(self._max_len):
+            feed = self._embedding_fn(layers.reshape(pre_ids, [-1, 1]))
+            cell.compute_state(feed)
+            logp = layers.log_softmax(self._logits_fn(cell))
+            v = int(logp.shape[-1])
+            acc = layers.elementwise_add(
+                layers.reshape(logp, [-1, beam, v]),
+                layers.unsqueeze(pre_scores, [2]))
+            sel_ids, sel_scores, parents = layers.beam_search(
+                pre_ids, pre_scores, acc, beam_size=beam,
+                end_id=self._end)
+            if beam > 1:
+                # reorder every state by the parent beam (one_hot gather;
+                # with beam == 1 the reorder is the identity)
+                oh = layers.cast(layers.one_hot(parents, beam), "float32")
+                for n, s in cell.snapshot().items():
+                    w = int(s.shape[-1])
+                    re = layers.matmul(oh,
+                                       layers.reshape(s, [-1, beam, w]))
+                    cell.set_state(n, layers.reshape(re, [-1, w]))
+            pre_ids, pre_scores = sel_ids, sel_scores
+            ids_steps.append(layers.unsqueeze(sel_ids, [0]))
+            parent_steps.append(layers.unsqueeze(parents, [0]))
+        ids = layers.concat(ids_steps, axis=0)       # [T, B, beam]
+        parents = layers.concat(parent_steps, axis=0)
+        return layers.beam_search_decode(ids, parents, pre_scores)

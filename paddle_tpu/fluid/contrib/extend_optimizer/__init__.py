@@ -1,0 +1,6 @@
+"""extend_optimizer (reference `contrib/extend_optimizer/`)."""
+
+from .extend_optimizer_with_weight_decay import (  # noqa: F401
+    DecoupledWeightDecay,
+    extend_with_decoupled_weight_decay,
+)

@@ -1,0 +1,90 @@
+"""Decoupled weight decay as an optimizer mixin.
+
+Capability parity: reference
+`contrib/extend_optimizer/extend_optimizer_with_weight_decay.py:20`
+(DecoupledWeightDecay + extend_with_decoupled_weight_decay: the AdamW
+pattern — `param -= coeff * param` applied OUTSIDE the gradient, so the
+decay is not distorted by adaptive moments)."""
+
+from __future__ import annotations
+
+from ... import framework
+from ... import layers
+
+__all__ = ["DecoupledWeightDecay", "extend_with_decoupled_weight_decay"]
+
+
+class DecoupledWeightDecay:
+    """Mixin placed BEFORE an Optimizer base (see
+    extend_with_decoupled_weight_decay): after the base update, appends
+    `param = param - coeff * param_snapshot` ops.  The snapshot is taken
+    before the base update (reference semantics: decay scales the
+    PRE-update parameter)."""
+
+    def __init__(self, coeff=0.0, apply_decay_param_fun=None, **kwargs):
+        if not isinstance(coeff, (float, framework.Variable)):
+            raise TypeError("coeff should be float or Variable.")
+        self._coeff = coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+        super().__init__(**kwargs)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(
+            loss, startup_program, parameter_list, no_grad_set)
+        block = framework.default_main_program().global_block
+        decay_start = len(block.ops)
+        scaled = []
+        if not (isinstance(self._coeff, float) and self._coeff == 0.0):
+            for param, grad in params_grads:
+                if grad is None:
+                    continue
+                if self._apply_decay_param_fun is not None and \
+                        not self._apply_decay_param_fun(param.name):
+                    continue
+                # snapshot the PRE-update parameter scaled by coeff
+                sp = (layers.scale(param, scale=float(self._coeff))
+                      if isinstance(self._coeff, float)
+                      else layers.elementwise_mul(param, self._coeff))
+                scaled.append((param, sp))
+        self.apply_gradients(params_grads)
+        for param, scaled_param in scaled:
+            updated = layers.elementwise_sub(param, scaled_param)
+            layers.assign(updated, output=param)
+        # the snapshot + decay ops belong to the update: tag them so
+        # clone(for_test=True) prunes them (else EVAL runs decay weights)
+        for op in block.ops[decay_start:]:
+            op.attrs.setdefault("op_role", "optimize")
+        return [], params_grads
+
+    def __str__(self):
+        return "%s(coeff=%s)" % (type(self).__name__, self._coeff)
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """cf. reference extend_with_decoupled_weight_decay: returns a class
+    whose constructor takes the base optimizer's args plus
+    `coeff`/`apply_decay_param_fun`.
+
+    Example::
+
+        AdamW = extend_with_decoupled_weight_decay(AdamOptimizer)
+        opt = AdamW(learning_rate=1e-3, coeff=0.01)
+    """
+    from ...optimizer import Optimizer
+
+    if not (isinstance(base_optimizer, type)
+            and issubclass(base_optimizer, Optimizer)):
+        raise TypeError("input optimizer should be a subclass of "
+                        "Optimizer")
+
+    class OptimizerWithDecoupledWeightDecay(DecoupledWeightDecay,
+                                            base_optimizer):
+        # cooperative __init__: DecoupledWeightDecay pops coeff/
+        # apply_decay_param_fun and super()s the rest into the base
+        # optimizer (pass base args by KEYWORD, e.g. learning_rate=...)
+        pass
+
+    OptimizerWithDecoupledWeightDecay.__name__ = (
+        base_optimizer.__name__ + "WithDecoupledWeightDecay")
+    return OptimizerWithDecoupledWeightDecay

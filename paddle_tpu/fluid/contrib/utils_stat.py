@@ -1,0 +1,102 @@
+"""Program inspection utilities: model summary, memory estimate, op
+frequency.
+
+Capability parity: reference `contrib/model_stat.py:40` (per-layer
+params/FLOPs table), `contrib/memory_usage_calc.py:46` (activation
+memory estimate for a batch size), `contrib/op_frequence.py:23` (op-type
+histogram)."""
+
+from __future__ import annotations
+
+_DTYPE_BYTES = {"float32": 4, "float64": 8, "float16": 2, "bfloat16": 2,
+                "int64": 8, "int32": 4, "int16": 2, "int8": 1, "uint8": 1,
+                "bool": 1}
+
+
+def _numel(shape, batch_size):
+    n = 1
+    for s in shape or ():
+        n *= batch_size if s in (-1, None) else int(s)
+    return n
+
+
+def op_freq_statistic(program):
+    """cf. op_frequence.py:23 — {op_type: count} over every block,
+    plus an (input-shapes, op) co-occurrence-free simple histogram."""
+    freq = {}
+    for block in program.blocks:
+        for op in block.ops:
+            freq[op.type] = freq.get(op.type, 0) + 1
+    return dict(sorted(freq.items(), key=lambda kv: -kv[1]))
+
+
+def memory_usage(program, batch_size):
+    """cf. memory_usage_calc.py:46 — lower/upper bound (bytes) of the
+    non-persistable (activation) memory at the given batch size.  The
+    reference brackets the allocator's behavior with a +-30% band; XLA's
+    planner typically lands well under the naive sum, so the same band
+    is reported."""
+    total = 0
+    for block in program.blocks:
+        for v in block.vars.values():
+            if getattr(v, "persistable", False) or v.shape is None:
+                continue
+            total += _numel(v.shape, batch_size) * _DTYPE_BYTES.get(
+                v.dtype, 4)
+    return total * 0.7, total * 1.3
+
+
+def summary(main_prog, batch_size=1):
+    """cf. model_stat.py:40 — print and return a per-op table of output
+    shape, #params, and FLOPs for the compute-bearing ops."""
+    rows = []
+    total_params = total_flops = 0
+    for block in main_prog.blocks:
+        for op in block.ops:
+            if op.attrs.get("op_role") in ("backward", "optimize"):
+                continue
+            params = 0
+            flops = 0
+            out_shape = None
+            for n in op.all_output_names():
+                v = block._find_var_recursive(n)
+                if v is not None and v.shape is not None:
+                    out_shape = list(v.shape)
+                    break
+            for n in op.all_input_names():
+                v = block._find_var_recursive(n)
+                if v is None or not getattr(v, "persistable", False) \
+                        or v.shape is None:
+                    continue
+                params += _numel(v.shape, 1)
+            if op.type in ("mul", "matmul", "matmul_v2") and out_shape:
+                k = None
+                for n in op.all_input_names():
+                    v = block._find_var_recursive(n)
+                    if v is not None and getattr(v, "persistable", False) \
+                            and v.shape:
+                        k = int(v.shape[0])
+                if k:
+                    flops = 2 * k * _numel(out_shape, batch_size)
+            elif op.type in ("conv2d", "depthwise_conv2d") and out_shape:
+                for n in op.all_input_names():
+                    v = block._find_var_recursive(n)
+                    if v is not None and getattr(v, "persistable", False) \
+                            and v.shape and len(v.shape) == 4:
+                        co, ci, kh, kw = (int(s) for s in v.shape)
+                        flops = 2 * ci * kh * kw * _numel(out_shape,
+                                                          batch_size)
+            if params or flops:
+                rows.append({"type": op.type, "out_shape": out_shape,
+                             "params": params, "flops": flops})
+                total_params += params
+                total_flops += flops
+    print("%-20s %-22s %12s %14s" % ("op", "out_shape", "params",
+                                     "FLOPs"))
+    for r in rows:
+        print("%-20s %-22s %12d %14d"
+              % (r["type"], r["out_shape"], r["params"], r["flops"]))
+    print("total params: %d (%.2f M)  total FLOPs: %d (%.2f G)"
+          % (total_params, total_params / 1e6, total_flops,
+             total_flops / 1e9))
+    return rows, total_params, total_flops

@@ -444,6 +444,16 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_bias, has_seg,
             db_ref[0, 0, :] = db_acc[0, :].astype(db_ref.dtype)
 
 
+def _with_seg_cotangents(dq, dk, dv, dbias, qseg, kseg):
+    """Integer segment-id inputs take float0 cotangents (shared tail of
+    both backward schedules)."""
+    dqseg = (np.zeros(qseg.shape, jax.dtypes.float0)
+             if qseg is not None else None)
+    dkseg = (np.zeros(kseg.shape, jax.dtypes.float0)
+             if kseg is not None else None)
+    return dq, dk, dv, dbias, dqseg, dkseg
+
+
 def _row_spec1(rows, d, layout, h):
     """Single-grid-axis BlockSpec (the fused single-block backward)."""
     if layout == "BHSD":
@@ -733,11 +743,7 @@ def _flash_core_bwd(n_head, scale, causal, interpret, coff, layout, res, g):
         dq, dk, dv, dbias = _bwd_fused(
             q, k, v, bias, qseg, kseg, out, g, h, scale, causal,
             interpret, coff, layout, bq, bk, bh)
-        dqseg = (np.zeros(qseg.shape, jax.dtypes.float0)
-                 if qseg is not None else None)
-        dkseg = (np.zeros(kseg.shape, jax.dtypes.float0)
-                 if kseg is not None else None)
-        return dq, dk, dv, dbias, dqseg, dkseg
+        return _with_seg_cotangents(dq, dk, dv, dbias, qseg, kseg)
 
     def _lse_spec(order):
         if fast:
@@ -837,14 +843,7 @@ def _flash_core_bwd(n_head, scale, causal, interpret, coff, layout, res, g):
     else:
         (dk, dv), dbias = res, None
 
-    # integer segment-id inputs take float0 cotangents
-    dqseg = (
-        np.zeros(qseg.shape, jax.dtypes.float0) if qseg is not None else None
-    )
-    dkseg = (
-        np.zeros(kseg.shape, jax.dtypes.float0) if kseg is not None else None
-    )
-    return dq, dk, dv, dbias, dqseg, dkseg
+    return _with_seg_cotangents(dq, dk, dv, dbias, qseg, kseg)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)

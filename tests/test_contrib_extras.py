@@ -1,0 +1,207 @@
+"""contrib extras: decoder library, decoupled weight decay, program
+stats (reference `contrib/decoder/beam_search_decoder.py`,
+`extend_optimizer/`, `model_stat.py` / `memory_usage_calc.py` /
+`op_frequence.py`)."""
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import contrib, layers
+from paddle_tpu.fluid.contrib.decoder import (
+    BeamSearchDecoder,
+    InitState,
+    StateCell,
+    TrainingDecoder,
+)
+from paddle_tpu.fluid.optimizer import AdamOptimizer, SGDOptimizer
+
+V, E, H = 12, 8, 16
+GO, EOS = 0, 1
+
+
+def _make_cell(boot):
+    """A tiny GRU-ish cell: h' = tanh(W x + U h)."""
+    cell = StateCell(
+        inputs={"x": None},
+        states={"h": InitState(init=boot)},
+        out_state="h")
+
+    @cell.state_updater
+    def updater(c):
+        x = c.get_input("x")
+        h = c.get_state("h")
+        nh = layers.tanh(
+            layers.elementwise_add(
+                layers.fc(x, size=H, param_attr="dec.w",
+                          bias_attr="dec.b"),
+                layers.fc(h, size=H, param_attr="dec.u",
+                          bias_attr=False)))
+        c.set_state("h", nh)
+
+    return cell
+
+
+def test_training_decoder_matches_manual_unroll():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    T = 4
+    with fluid.program_guard(main, startup):
+        x_seq = layers.data("x_seq", shape=[-1, T, E],
+                            append_batch_size=False)
+        boot = layers.data("boot", shape=[-1, H], append_batch_size=False)
+        cell = _make_cell(boot)
+        dec_out = TrainingDecoder(cell).decode({"x": x_seq}, n_steps=T)
+
+        # manual unroll with the SAME parameters
+        cell2 = _make_cell(boot)
+        outs = []
+        for t in range(T):
+            xt = layers.reshape(
+                layers.slice(x_seq, axes=[1], starts=[t], ends=[t + 1]),
+                [-1, E])
+            cell2.compute_state({"x": xt})
+            outs.append(layers.unsqueeze(cell2.out_state(), [1]))
+        manual = layers.concat(outs, axis=1)
+
+    rng = np.random.RandomState(0)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        a, b = exe.run(main, feed={
+            "x_seq": rng.randn(3, T, E).astype(np.float32),
+            "boot": np.zeros((3, H), np.float32),
+        }, fetch_list=[dec_out, manual])
+    assert np.asarray(a).shape == (3, T, H)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_beam_search_decoder_decodes_and_beam1_is_greedy():
+    def build(beam):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        with fluid.program_guard(main, startup):
+            boot = layers.data("boot", shape=[-1, H],
+                               append_batch_size=False)
+            cell = _make_cell(boot)
+
+            def embed(prev_ids):
+                emb = layers.embedding(prev_ids, size=[V, E],
+                                       param_attr="dec.emb")
+                return {"x": layers.reshape(emb, [-1, E])}
+
+            def logits(c):
+                return layers.fc(c.out_state(), size=V,
+                                 param_attr="dec.out_w",
+                                 bias_attr="dec.out_b")
+
+            bsd = BeamSearchDecoder(cell, embed, logits, beam_size=beam,
+                                    end_id=EOS, max_len=5, go_id=GO)
+            ids, scores = bsd.decode()
+        return main, startup, ids, scores
+
+    rng = np.random.RandomState(1)
+    boot = rng.randn(4, H).astype(np.float32)
+
+    def run(beam):
+        main, startup, ids, scores = build(beam)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            i, s = exe.run(main, feed={"boot": boot},
+                           fetch_list=[ids, scores])
+        return np.asarray(i), np.asarray(s)
+
+    ids4, scores4 = run(4)
+    assert ids4.shape == (4, 4, 5)
+    assert np.isfinite(scores4).all()
+    # beams are score-ordered best-first
+    assert (scores4[:, 0] >= scores4[:, -1] - 1e-6).all()
+
+    ids1, _ = run(1)
+    assert ids1.shape == (4, 1, 5)
+    # beam widths agree on the first step's top choice by construction
+    # of score ordering: beam-4's best path scores >= beam-1's path
+    _, s1 = run(1)
+    assert (scores4[:, 0] >= s1[:, 0] - 1e-5).all()
+
+
+def test_decoupled_weight_decay_shrinks_params():
+    AdamW = contrib.extend_with_decoupled_weight_decay(AdamOptimizer)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 4], append_batch_size=False)
+        pred = layers.fc(x, size=1, param_attr="wd.w", bias_attr=False)
+        loss = layers.reduce_mean(layers.square(pred))
+        opt = AdamW(learning_rate=0.0, coeff=0.1)   # lr 0: pure decay
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        import paddle_tpu.fluid.executor as ex
+
+        w0 = np.asarray(ex.global_scope().find_var("wd.w")).copy()
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+        w1 = np.asarray(ex.global_scope().find_var("wd.w"))
+    np.testing.assert_allclose(w1, w0 * 0.9, rtol=1e-5)
+
+    # filter hook: excluded params do not decay
+    import pytest
+
+    with pytest.raises(TypeError):
+        contrib.extend_with_decoupled_weight_decay(object)
+
+
+def test_program_stat_utils():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[1, 8, 8])
+        h = layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                          bias_attr=False)
+        h = layers.relu(h)
+        logits = layers.fc(h, size=10)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(
+                logits, layers.data("y", shape=[1], dtype="int64")))
+        SGDOptimizer(0.1).minimize(loss)
+
+    freq = contrib.op_freq_statistic(main)
+    assert freq["conv2d"] == 1 and freq["relu"] >= 1
+
+    lo, hi = contrib.memory_usage(main, batch_size=32)
+    assert 0 < lo < hi
+
+    rows, params, flops = contrib.summary(main, batch_size=1)
+    # conv: 4*1*3*3 = 36; fc: 4*8*8*10 + 10
+    assert params == 36 + 4 * 8 * 8 * 10 + 10
+    assert flops > 0
+    assert any(r["type"] == "conv2d" for r in rows)
+
+
+def test_decoupled_decay_ops_pruned_from_eval_clone():
+    """Review r5: the decay ops must carry op_role=optimize so
+    clone(for_test=True) prunes them — eval runs must NOT decay
+    weights."""
+    AdamW = contrib.extend_with_decoupled_weight_decay(AdamOptimizer)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 6
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 4], append_batch_size=False)
+        pred = layers.fc(x, size=1, param_attr="ev.w", bias_attr=False)
+        loss = layers.reduce_mean(layers.square(pred))
+        AdamW(learning_rate=0.0, coeff=0.1).minimize(loss)
+        eval_prog = main.clone(for_test=True)
+    assert all(op.type not in ("assign", "elementwise_sub")
+               for op in eval_prog.global_block.ops), [
+        op.type for op in eval_prog.global_block.ops]
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        import paddle_tpu.fluid.executor as ex
+
+        w0 = np.asarray(ex.global_scope().find_var("ev.w")).copy()
+        exe.run(eval_prog, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+        w1 = np.asarray(ex.global_scope().find_var("ev.w"))
+    np.testing.assert_allclose(w1, w0)      # eval did not touch weights

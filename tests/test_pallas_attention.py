@@ -278,6 +278,10 @@ def test_bf16_accumulator_flag_tolerance_policy(monkeypatch):
     ref = _naive_attention(q, k, v, None, scale, True)
     np.testing.assert_allclose(np.asarray(out32), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+    # the flag must actually take effect: bf16 accumulation noise makes
+    # the outputs differ (a vacuous pass would mean the knob regressed)
+    assert np.abs(np.asarray(out16) - np.asarray(out32)).max() > 0, \
+        "PADDLE_TPU_FLASH_ACC=bf16 had no effect"
     # bf16 accumulators: documented looser bounds
     np.testing.assert_allclose(np.asarray(out16), np.asarray(out32),
                                rtol=2e-2, atol=2e-2)
