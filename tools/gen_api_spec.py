@@ -31,6 +31,9 @@ PUBLIC_MODULES = [
     "paddle_tpu.fluid.profiler",
     "paddle_tpu.fluid.dygraph",
     "paddle_tpu.fluid.contrib.mixed_precision",
+    "paddle_tpu.fluid.contrib.decoder",
+    "paddle_tpu.fluid.contrib.extend_optimizer",
+    "paddle_tpu.fluid.contrib.utils_stat",
     "paddle_tpu.fluid.contrib.slim.prune",
     "paddle_tpu.fluid.contrib.slim.distillation",
     "paddle_tpu.fluid.contrib.slim.nas",
