@@ -116,7 +116,7 @@ def main():
         )
         # masked-position MLM shrinks the logits buffer ~6x, which is what
         # previously capped the batch at 16; B is env-sweepable
-        B, S, P = int(os.getenv("BENCH_B", "48")), 512, 80
+        B, S, P = int(os.getenv("BENCH_B", "60")), 512, 80
         k_short, k_long, reps = 10, 30, 2
         # bf16 peak TFLOP/s for one v5e chip (public spec: 197 bf16)
         peak = 197e12
