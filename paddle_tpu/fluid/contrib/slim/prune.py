@@ -26,7 +26,10 @@ import numpy as np
 from ...framework import Operator
 
 __all__ = ["Pruner", "StructurePruner", "prune_parameters", "sensitivity",
-           "load_sensitivities", "save_sensitivities"]
+           "load_sensitivities", "save_sensitivities",
+           "estimate_pruned_fraction", "search_uniform_ratio",
+           "get_ratios_by_sensitivity", "PruneStrategy",
+           "UniformPruneStrategy", "SensitivePruneStrategy"]
 
 
 class Pruner:
@@ -50,7 +53,10 @@ class StructurePruner(Pruner):
         criterion = self.criterions.get(name, self.criterions.get("*"))
         if axis is None:
             axis = self.pruning_axis.get(name, self.pruning_axis.get("*"))
-        prune_num = int(round(param.shape[axis] * ratio))
+        # never delete EVERY group: a zero-channel conv is a wrecked
+        # model, not a pruned one (ratio searches can drive ratio -> 1)
+        prune_num = min(int(round(param.shape[axis] * ratio)),
+                        param.shape[axis] - 1)
         reduce_dims = tuple(i for i in range(param.ndim) if i != axis)
         if criterion != "l1_norm":
             raise NotImplementedError(
@@ -333,3 +339,189 @@ def load_sensitivities(path):
     with open(path) as f:
         raw = json.load(f)
     return {p: {float(r): v for r, v in d.items()} for p, d in raw.items()}
+
+
+# ---------------------------------------------------------------------------
+# ratio search + Compressor strategies (reference prune_strategy.py:563
+# UniformPruneStrategy / :677 SensitivePruneStrategy /
+# auto_prune_strategy.py)
+# ---------------------------------------------------------------------------
+
+
+def estimate_pruned_fraction(program, scope, params, ratios):
+    """Fraction of trainable-parameter numel a prune would remove,
+    WITHOUT mutating program or scope (reference only_graph=True dry
+    run): the propagation walk runs in shape-only mode."""
+    class _CountingPruner(StructurePruner):
+        # the dry run only consumes len(idx): skip the O(numel)
+        # abs-sum + argsort ranking on every search iteration
+        def cal_pruned_idx(self, name, param, ratio, axis=None):
+            if axis is None:
+                axis = self.pruning_axis.get(name,
+                                             self.pruning_axis.get("*"))
+            n = min(int(round(param.shape[axis] * ratio)),
+                    param.shape[axis] - 1)
+            return np.arange(max(n, 0))
+
+    pp = _ProgramPruner(program, None, scope, _CountingPruner(),
+                        lazy=False)
+    new_numels = {}
+
+    def dry_prune_var(name, idx, axis):
+        if (name, axis) in pp._pruned:
+            return
+        pp._pruned.add((name, axis))
+        shape = list(np.asarray(scope.find_var(name)).shape)
+        prev = new_numels.get(name)
+        if prev is not None:
+            shape = prev
+        shape[axis] -= len(idx)
+        new_numels[name] = shape
+
+    pp._prune_var = dry_prune_var
+    for name, ratio in zip(params, ratios):
+        pp.prune_conv_filter(name, ratio)
+    before = after = 0
+    block = program.global_block
+    for v in block.vars.values():
+        if not getattr(v, "persistable", False) or not scope.has(v.name):
+            continue
+        n0 = int(np.prod(np.asarray(scope.find_var(v.name)).shape))
+        shape = new_numels.get(v.name)
+        n1 = int(np.prod(shape)) if shape is not None else n0
+        before += n0
+        after += n1
+    return 1.0 - (after / max(before, 1))
+
+
+def search_uniform_ratio(program, scope, params, target_reduction,
+                         tol=0.01, max_iters=20):
+    """Binary-search ONE ratio applied to every pruned param so the
+    model shrinks by ~target_reduction of its parameter numel
+    (reference UniformPruneStrategy._get_best_ratios).  Capped at 0.9:
+    an unreachable target saturates instead of deleting whole layers."""
+    lo, hi, ratio = 0.0, 0.9, 0.45
+    for _ in range(max_iters):
+        ratio = (lo + hi) / 2
+        got = estimate_pruned_fraction(program, scope, params,
+                                       [ratio] * len(params))
+        if abs(got - target_reduction) < tol:
+            break
+        if got > target_reduction:
+            hi = ratio
+        else:
+            lo = ratio
+    return ratio
+
+
+def get_ratios_by_sensitivity(sensitivities, target_reduction, program,
+                              scope, tol=0.015, max_iters=20):
+    """Per-param ratios from measured sensitivities (reference
+    SensitivePruneStrategy._get_best_ratios, with piecewise-linear
+    interpolation in place of the cubic leastsq fit): binary-search an
+    accuracy-loss budget; each param takes the largest measured-or-
+    interpolated ratio whose loss fits the budget, until the estimated
+    numel reduction hits the target."""
+    params = sorted(sensitivities)
+
+    def ratio_at_loss(param, budget):
+        # monotone envelope over possibly NOISY measurements: any point
+        # within budget counts (no break at the first exceedance), plus
+        # interpolation into each crossing segment
+        pts = sorted((float(r), float(l))
+                     for r, l in sensitivities[param].items())
+        best = 0.0
+        prev_r, prev_l = 0.0, 0.0
+        for r, l in pts:
+            if l <= budget:
+                best = max(best, r)
+            elif prev_l <= budget:    # budget crosses THIS segment
+                frac = (budget - prev_l) / max(l - prev_l, 1e-12)
+                best = max(best, prev_r + frac * (r - prev_r))
+            prev_r, prev_l = r, l
+        return min(max(best, 0.0), 0.9)
+
+    max_loss = max((max(d.values()) for d in sensitivities.values()),
+                   default=0.0)
+    lo, hi = 0.0, max(max_loss, 1e-6)
+    ratios = [0.0] * len(params)
+    for _ in range(max_iters):
+        budget = (lo + hi) / 2
+        ratios = [ratio_at_loss(p, budget) for p in params]
+        got = estimate_pruned_fraction(program, scope, params, ratios)
+        if abs(got - target_reduction) < tol:
+            break
+        if got > target_reduction:
+            hi = budget
+        else:
+            lo = budget
+    return dict(zip(params, ratios))
+
+
+from .core import Strategy as _Strategy
+
+
+class PruneStrategy(_Strategy):
+    """Compressor strategy base (reference prune_strategy.py
+    PruneStrategy): prunes at start_epoch; Context supplies
+    train_program/startup_program/scope."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 target_ratio=0.5, pruned_params=None):
+        super().__init__(start_epoch=start_epoch, end_epoch=end_epoch)
+        self.pruner = pruner or StructurePruner({"*": 0},
+                                                {"*": "l1_norm"})
+        self.target_ratio = float(target_ratio)
+        self.pruned_params = list(pruned_params or [])
+        self.ratios = None
+
+    def _prune(self, context, ratios):
+        prune_parameters(context.train_program, context.startup_program,
+                         context.scope, self.pruned_params, ratios,
+                         pruner=self.pruner)
+
+    def on_epoch_begin(self, context):
+        if context.epoch != self.start_epoch or self.ratios is not None:
+            return
+        self.ratios = self._get_ratios(context)
+        self._prune(context, self.ratios)
+
+
+class UniformPruneStrategy(PruneStrategy):
+    """cf. prune_strategy.py:563: one searched ratio for every param."""
+
+    def _get_ratios(self, context):
+        r = search_uniform_ratio(context.train_program, context.scope,
+                                 self.pruned_params, self.target_ratio)
+        return [r] * len(self.pruned_params)
+
+
+class SensitivePruneStrategy(PruneStrategy):
+    """cf. prune_strategy.py:677: measure per-param sensitivity with the
+    Context's eval_func, then allocate per-param ratios under one
+    accuracy-loss budget."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 target_ratio=0.5, pruned_params=None,
+                 probe_ratios=(0.2, 0.4, 0.6)):
+        super().__init__(pruner, start_epoch, end_epoch, target_ratio,
+                         pruned_params)
+        self.probe_ratios = tuple(probe_ratios)
+        self.sensitivities = None
+
+    def _get_ratios(self, context):
+        if context.eval_func is None:
+            raise ValueError(
+                "SensitivePruneStrategy needs Context.eval_func to "
+                "measure sensitivities")
+
+        def eval_fn():
+            return context.eval_func(context.eval_program, context.scope)
+
+        self.sensitivities = sensitivity(
+            context.train_program, context.scope, eval_fn,
+            self.pruned_params, ratios=self.probe_ratios)
+        ratios = get_ratios_by_sensitivity(
+            self.sensitivities, self.target_ratio,
+            context.train_program, context.scope)
+        return [ratios[p] for p in self.pruned_params]

@@ -453,3 +453,76 @@ def test_compressor_runs_strategies_in_order():
     epochs_fired = [e for tag, _, e in
                     (x for x in calls if x[0] == "eb")]
     assert epochs_fired == [1], calls
+
+
+def test_uniform_prune_strategy_in_compressor():
+    """cf. prune_strategy.py UniformPruneStrategy: the strategy searches
+    ONE ratio hitting the target parameter reduction and prunes at its
+    start epoch inside the Compressor loop; training continues after."""
+    from paddle_tpu.fluid.contrib.slim.core import Compressor
+    from paddle_tpu.fluid.contrib.slim.prune import (
+        UniformPruneStrategy,
+        estimate_pruned_fraction,
+    )
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 21
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[1, 28, 28])
+        label = layers.data("label", shape=[1], dtype="int64")
+        loss, acc, _ = _lenet(img, label, prefix="up")
+        MomentumOptimizer(0.02, 0.9).minimize(loss)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    imgs, labels = _digits(192, seed=2)
+    accs = []
+
+    def train_epoch(ctx):
+        accs.extend(_train(exe, ctx.train_program, imgs, labels, loss,
+                           acc, epochs=1))
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        strat = UniformPruneStrategy(
+            start_epoch=1, target_ratio=0.3,
+            pruned_params=["upc1.w", "upc2.w"])
+        Compressor(scope, main, startup_program=startup,
+                   train_epoch_fn=train_epoch,
+                   epochs=4).add_strategy(strat).run()
+        # strategy ran once, with a searched uniform ratio
+        assert strat.ratios is not None
+        assert strat.ratios[0] == strat.ratios[1] > 0
+        # shapes really shrank and training recovered
+        assert np.asarray(scope.find_var("upc1.w")).shape[0] < 8
+        assert np.mean(accs[-4:]) > 0.9
+        # dry-run estimator matches the direction of the target
+        frac = estimate_pruned_fraction(
+            main, scope, ["upc1.w"], [0.5])
+        assert 0 < frac < 1
+
+
+def test_sensitivity_ratio_allocation():
+    """cf. SensitivePruneStrategy._get_best_ratios: a high-sensitivity
+    param gets a LOWER ratio than an insensitive one at the same
+    target."""
+    from paddle_tpu.fluid.contrib.slim.prune import (
+        get_ratios_by_sensitivity,
+    )
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 22
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[1, 28, 28])
+        label = layers.data("label", shape=[1], dtype="int64")
+        loss, acc, _ = _lenet(img, label, prefix="sr")
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        sens = {
+            "src1.w": {0.2: 0.30, 0.4: 0.60, 0.6: 0.90},  # fragile
+            "src2.w": {0.2: 0.01, 0.4: 0.02, 0.6: 0.04},  # robust
+        }
+        ratios = get_ratios_by_sensitivity(sens, 0.25, main, scope)
+    assert ratios["src2.w"] > ratios["src1.w"]
