@@ -1,0 +1,252 @@
+"""contrib layer API: wrappers + compositions over the niche op family.
+
+Capability parity: reference `contrib/layers/nn.py:33` — the contrib
+surface for search-ranking/text-matching models (var_conv_2d,
+match_matrix_tensor, sequence_topk_avg_pooling, tree_conv,
+multiclass_nms2, search_pyramid_hash, rank_attention, shuffle_batch,
+partial_concat, partial_sum, batch_fc, tdm_child, fused_elemwise_
+activation, fused_embedding_seq_pool).
+
+TPU-first notes: the `fused_*` entries exist in the reference to dodge
+kernel-launch overhead; here they are plain compositions — XLA fuses
+them — kept for API parity.  `tdm_sampler` (PS-side negative sampling
+walking a serving tree) and `_pull_box_extended_sparse` (BoxPS lookup)
+are parameter-server serving features, subsumed per SURVEY §2.3 by the
+host-embedding capability mapping."""
+
+from __future__ import annotations
+
+from .. import layers
+from ..layers.common import append_simple_op
+from ..layers.detection import multiclass_nms2  # noqa: F401  (re-export)
+
+__all__ = [
+    "fused_elemwise_activation", "var_conv_2d", "match_matrix_tensor",
+    "sequence_topk_avg_pooling", "tree_conv", "fused_embedding_seq_pool",
+    "multiclass_nms2", "search_pyramid_hash", "shuffle_batch",
+    "partial_concat", "partial_sum", "tdm_child", "rank_attention",
+    "batch_fc",
+]
+
+
+def var_conv_2d(input, row_lens, col_lens, input_channel, output_channel,
+                filter_size, stride=1, param_attr=None, act=None,
+                dtype="float32", name=None):
+    """cf. contrib/layers/nn.py:106 + var_conv_2d_op.cc.  Dense
+    redesign: input [B, C, Hmax, Wmax] + per-sample RowLens/ColLens."""
+    from ..layer_helper import LayerHelper
+
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    helper = LayerHelper("var_conv_2d", name=name)
+    w = helper.create_parameter(
+        param_attr,
+        [output_channel, input_channel * filter_size[0] * filter_size[1]],
+        dtype=dtype)
+    out = append_simple_op(
+        "var_conv_2d",
+        {"X": input, "RowLens": row_lens, "ColLens": col_lens, "W": w},
+        {"InputChannel": input_channel, "OutputChannel": output_channel,
+         "KernelH": filter_size[0], "KernelW": filter_size[1],
+         "StrideH": stride[0], "StrideW": stride[1]})
+    return helper.append_activation(out, act)
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None):
+    """cf. contrib/layers/nn.py:223: per-channel bilinear match matrix
+    (dense [B, Lx, D] x [B, Ly, D] -> [B, T, Lx, Ly])."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("match_matrix_tensor", name=name)
+    d = int(x.shape[-1])
+    dy = int(y.shape[-1])
+    if d > 0 and dy > 0 and d != dy:
+        raise ValueError(
+            "match_matrix_tensor: x feature dim (%d) must equal y "
+            "feature dim (%d)" % (d, dy))
+    w = helper.create_parameter(param_attr, [d, channel_num, d],
+                                dtype=dtype)
+    out, tmp = append_simple_op(
+        "match_matrix_tensor", {"X": x, "Y": y, "W": w}, {},
+        out_slots=("Out", "Tmp"))
+    return helper.append_activation(out, act), tmp
+
+
+def sequence_topk_avg_pooling(input, row_lens, col_lens, topks,
+                              channel_num):
+    """cf. contrib/layers/nn.py:310 (dense [B, C, R, Co] layout)."""
+    return append_simple_op(
+        "sequence_topk_avg_pooling",
+        {"X": input, "RowLens": row_lens, "ColLens": col_lens},
+        {"topks": list(topks), "channel_num": channel_num})
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """cf. contrib/layers/nn.py:378 + tree_conv_op.cc (TBCNN)."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("tree_conv", name=name)
+    d = int(nodes_vector.shape[-1])
+    w = helper.create_parameter(param_attr, [d, 3, output_size,
+                                             num_filters])
+    out = append_simple_op(
+        "tree_conv",
+        {"NodesVector": nodes_vector, "EdgeSet": edge_set, "Filter": w},
+        {"max_depth": max_depth})
+    if bias_attr:
+        b = helper.create_parameter(bias_attr, [num_filters],
+                                    is_bias=True)
+        out = helper.append_bias_op(out, b, axis=-1)
+    return helper.append_activation(out, act)
+
+
+def search_pyramid_hash(input, seq_lens, num_emb, space_len, pyramid_layer,
+                        rand_len, drop_out_percent=0.0, is_training=False,
+                        param_attr=None, dtype="float32", name=None):
+    """cf. contrib/layers/nn.py:645 + pyramid_hash_op.cc (dense [B, T]
+    tokens + SeqLens; white/black-list filtering is PS-serving,
+    subsumed)."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("pyramid_hash", name=name)
+    w = helper.create_parameter(param_attr, [space_len, 1], dtype=dtype)
+    return append_simple_op(
+        "pyramid_hash", {"X": input, "SeqLens": seq_lens, "W": w},
+        {"num_emb": num_emb, "rand_len": rand_len,
+         "pyramid_layer": pyramid_layer, "space_len": space_len,
+         "drop_out_percent": drop_out_percent,
+         "is_training": is_training})
+
+
+def rank_attention(input, rank_offset, rank_param_shape, rank_param_attr,
+                   max_rank=3, name=None):
+    """cf. contrib/layers/nn.py:1236 + rank_attention_op.cc."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("rank_attention", name=name)
+    w = helper.create_parameter(rank_param_attr, list(rank_param_shape))
+    out, _, _ = append_simple_op(
+        "rank_attention",
+        {"X": input, "RankOffset": rank_offset, "RankParam": w},
+        {"MaxRank": max_rank},
+        out_slots=("Out", "InputHelp", "InsRank"))
+    return out
+
+
+def shuffle_batch(x, seed=None):
+    """cf. contrib/layers/nn.py:761 (shuffle_batch_op.cc): random
+    permutation of the batch rows, regenerated every step (sort a
+    uniform key column — the XLA-friendly shuffle)."""
+    r = append_simple_op(
+        "uniform_random_batch_size_like", {"Input": x},
+        {"shape": [-1, 1], "min": 0.0, "max": 1.0, "seed": seed or 0,
+         "input_dim_idx": 0, "output_dim_idx": 0})
+    order = layers.reshape(layers.argsort(r, axis=0)[1], [-1])
+    return layers.gather(x, order)
+
+
+def _partial_slices(input, start_index, length):
+    """Column slices [start_index, start_index+length) of each input;
+    length < 0 means 'to the end' — INT32_MAX end (the slice op clamps,
+    so a DYNAMIC second dim keeps its full width too)."""
+    end = (start_index + length) if length >= 0 else (2 ** 31 - 1)
+    return [layers.slice(v, axes=[1], starts=[start_index], ends=[end])
+            for v in input]
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """cf. contrib/layers/nn.py:825 (partial_concat_op.cc): concat a
+    column slice [start_index, start_index+length) of each input."""
+    return layers.concat(_partial_slices(input, start_index, length),
+                         axis=1)
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """cf. contrib/layers/nn.py:888 (partial_sum_op.cc)."""
+    return layers.sums(_partial_slices(input, start_index, length))
+
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype="int32",
+              name=None):
+    """cf. contrib/layers/nn.py:942 (tdm_child_op.cc): gather each node
+    id's children from a learned-tree info table [node_nums, child_nums]
+    (0 = no child); returns (child ids, leaf mask)."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("tdm_child", name=name)
+    info = helper.create_parameter(param_attr, [node_nums, child_nums],
+                                   dtype=dtype)
+    flat = layers.reshape(x, [-1])
+    child = layers.gather(info, flat)              # [N, child_nums]
+    child = layers.reshape(
+        child, [-1] + [int(s) for s in x.shape[1:]] + [child_nums])
+    # dense redesign of the reference LeafMask: a slot is valid when a
+    # child exists (id != 0, the reference's padding id)
+    leaf_mask = layers.cast(
+        layers.not_equal(child, layers.fill_constant([1], dtype, 0)),
+        "int32")
+    return child, leaf_mask
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """cf. contrib/layers/nn.py:42 (fused_elemwise_activation_op.cc):
+    the two reference composition modes — Unary(Binary(X, Y)) when the
+    FIRST functor is unary (e.g. ['relu', 'elementwise_add']), and
+    Binary(X, Unary(Y)) when the SECOND is unary (e.g.
+    ['elementwise_add', 'scale']).  XLA fuses the composition, so this
+    is the semantic mapping only."""
+    binary = {
+        "elementwise_add": lambda a, b: layers.elementwise_add(a, b,
+                                                               axis=axis),
+        "elementwise_mul": lambda a, b: layers.elementwise_mul(a, b,
+                                                               axis=axis),
+    }
+    unary = {
+        "relu": layers.relu,
+        "tanh": layers.tanh,
+        "sigmoid": layers.sigmoid,
+        "scale": lambda a: layers.scale(a, scale=scale),
+    }
+    if len(functor_list) != 2:
+        raise ValueError("functor_list must name exactly two functors")
+    f0, f1 = functor_list
+    if f0 in unary and f1 in binary:
+        return unary[f0](binary[f1](x, y))         # Unary(Binary(X, Y))
+    if f0 in binary and f1 in unary:
+        return binary[f0](x, unary[f1](y))         # Binary(X, Unary(Y))
+    raise ValueError(
+        "functor_list must pair one binary %s with one unary %s, got %r"
+        % (sorted(binary), sorted(unary), functor_list))
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False,
+                             padding_idx=None, combiner="sum",
+                             param_attr=None, dtype="float32"):
+    """cf. contrib/layers/nn.py:448: embedding lookup + sequence sum
+    pool in one call (XLA fuses the composition)."""
+    if combiner != "sum":
+        raise ValueError("combiner must be 'sum' (reference supports "
+                         "sum only)")
+    emb = layers.embedding(input, size=size, is_sparse=is_sparse,
+                           padding_idx=padding_idx,
+                           param_attr=param_attr, dtype=dtype)
+    return layers.reduce_sum(emb, dim=1)
+
+
+def batch_fc(input, param_size, param_attr, bias_size, bias_attr,
+             act=None):
+    """cf. contrib/layers/nn.py:1304 (batch_fc_op.cc): per-slot fc —
+    input [slot, B, in], W [slot, in, out], b [slot, 1, out]."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("batch_fc")
+    w = helper.create_parameter(param_attr, list(param_size))
+    b = helper.create_parameter(bias_attr, list(bias_size))
+    out = layers.elementwise_add(layers.matmul(input, w), b)
+    return helper.append_activation(out, act)

@@ -205,3 +205,93 @@ def test_decoupled_decay_ops_pruned_from_eval_clone():
                 fetch_list=[loss])
         w1 = np.asarray(ex.global_scope().find_var("ev.w"))
     np.testing.assert_allclose(w1, w0)      # eval did not touch weights
+
+
+def test_contrib_layers_surface():
+    """cf. contrib/layers/nn.py: the niche-op layer wrappers build and
+    run through the Executor (dense redesigns of the LoD inputs)."""
+    from paddle_tpu.fluid.contrib import layers as cl
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        # text-matching chain: match matrix -> topk avg pooling
+        xa = layers.data("xa", shape=[-1, 5, 6], append_batch_size=False)
+        yb = layers.data("yb", shape=[-1, 7, 6], append_batch_size=False)
+        mm, _tmp = cl.match_matrix_tensor(xa, yb, channel_num=3)
+        rl = layers.data("rl", shape=[-1], dtype="int64",
+                         append_batch_size=False)
+        clens = layers.data("cl", shape=[-1], dtype="int64",
+                            append_batch_size=False)
+        pooled = cl.sequence_topk_avg_pooling(mm, rl, clens,
+                                              topks=[1, 3], channel_num=3)
+        # var conv over per-sample extents
+        vx = layers.data("vx", shape=[-1, 2, 6, 6],
+                         append_batch_size=False)
+        vc = cl.var_conv_2d(vx, rl, clens, input_channel=2,
+                            output_channel=4, filter_size=3)
+        # tree conv
+        nodes = layers.data("nodes", shape=[-1, 6, 6],
+                            append_batch_size=False)
+        edges = layers.data("edges", shape=[-1, 5, 2], dtype="int32",
+                            append_batch_size=False)
+        tc = cl.tree_conv(nodes, edges, output_size=4, num_filters=2)
+        # pyramid hash embedding
+        toks = layers.data("toks", shape=[-1, 8], dtype="int32",
+                           append_batch_size=False)
+        slens = layers.data("sl", shape=[-1], dtype="int64",
+                            append_batch_size=False)
+        ph = cl.search_pyramid_hash(toks, slens, num_emb=8, space_len=512,
+                                    pyramid_layer=3, rand_len=4)
+        # batch utilities
+        x2 = layers.data("x2", shape=[-1, 6], append_batch_size=False)
+        shuf = cl.shuffle_batch(x2)
+        pc = cl.partial_concat([x2, x2], start_index=1, length=3)
+        ps = cl.partial_sum([x2, x2], start_index=0, length=2)
+        fe = cl.fused_elemwise_activation(
+            x2, x2, ["relu", "elementwise_add"])
+        ids = layers.data("ids", shape=[-1, 4, 1], dtype="int64",
+                          append_batch_size=False)
+        fp = cl.fused_embedding_seq_pool(ids, size=[50, 6])
+        child, mask = cl.tdm_child(
+            layers.reshape(ids, [-1, 4]), node_nums=50, child_nums=2)
+
+    rng = np.random.RandomState(0)
+    x2_feed = rng.randn(4, 6).astype(np.float32)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        outs = exe.run(main, feed={
+            "xa": rng.randn(2, 5, 6).astype(np.float32),
+            "yb": rng.randn(2, 7, 6).astype(np.float32),
+            "rl": np.array([5, 4], np.int64),
+            "cl": np.array([7, 6], np.int64),
+            "vx": rng.randn(2, 2, 6, 6).astype(np.float32),
+            "nodes": rng.randn(2, 6, 6).astype(np.float32),
+            "edges": np.tile(np.array(
+                [[1, 2], [1, 3], [2, 4], [2, 5], [3, 6]],
+                np.int32), (2, 1, 1)),
+            "toks": rng.randint(0, 99, (2, 8)).astype(np.int32),
+            "sl": np.array([8, 5], np.int64),
+            "x2": x2_feed,
+            "ids": rng.randint(1, 50, (3, 4, 1)).astype(np.int64),
+        }, fetch_list=[pooled, vc, tc, ph, shuf, pc, ps, fe, fp, child,
+                       mask])
+    pooled_v, vc_v, tc_v, ph_v, shuf_v, pc_v, ps_v, fe_v, fp_v, ch_v, \
+        mk_v = (np.asarray(o) for o in outs)
+    assert pooled_v.shape == (2, 5, 6)           # [B, R, C*K]
+    assert vc_v.shape[:2] == (2, 4)
+    assert tc_v.shape == (2, 6, 4, 2)
+    assert ph_v.shape == (2, 8, 8)
+    # shuffle preserves the multiset of rows
+    assert shuf_v.shape == (4, 6)
+    np.testing.assert_allclose(
+        np.sort(shuf_v, axis=0), np.sort(x2_feed, axis=0), rtol=1e-6)
+    np.testing.assert_allclose(
+        pc_v, np.concatenate([x2_feed[:, 1:4]] * 2, axis=1), rtol=1e-6)
+    np.testing.assert_allclose(ps_v, x2_feed[:, :2] * 2, rtol=1e-6)
+    np.testing.assert_allclose(fe_v, np.maximum(x2_feed * 2, 0),
+                               rtol=1e-6)          # relu(x + x)
+    assert fp_v.shape == (3, 6)
+    assert ch_v.shape == (3, 4, 2) and mk_v.shape == (3, 4, 2)
+    assert set(np.unique(mk_v)) <= {0, 1}

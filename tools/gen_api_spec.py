@@ -32,6 +32,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.fluid.dygraph",
     "paddle_tpu.fluid.contrib.mixed_precision",
     "paddle_tpu.fluid.contrib.decoder",
+    "paddle_tpu.fluid.contrib.layers",
     "paddle_tpu.fluid.contrib.extend_optimizer",
     "paddle_tpu.fluid.contrib.utils_stat",
     "paddle_tpu.fluid.contrib.slim.prune",
