@@ -63,7 +63,12 @@ def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
     helper = LayerHelper("match_matrix_tensor", name=name)
     d = int(x.shape[-1])
     dy = int(y.shape[-1])
-    if d > 0 and dy > 0 and d != dy:
+    if d <= 0 or dy <= 0:
+        raise ValueError(
+            "match_matrix_tensor: x/y feature dims must be static "
+            "(the bilinear parameter is [d, channel_num, d]); declare "
+            "the data with a concrete last dim")
+    if d != dy:
         raise ValueError(
             "match_matrix_tensor: x feature dim (%d) must equal y "
             "feature dim (%d)" % (d, dy))
@@ -152,9 +157,14 @@ def shuffle_batch(x, seed=None):
 
 def _partial_slices(input, start_index, length):
     """Column slices [start_index, start_index+length) of each input;
-    length < 0 means 'to the end' — INT32_MAX end (the slice op clamps,
-    so a DYNAMIC second dim keeps its full width too)."""
-    end = (start_index + length) if length >= 0 else (2 ** 31 - 1)
+    length < 0 means 'to the end', and a NEGATIVE start whose window
+    reaches the axis end also slices to the end (python end=0 would mean
+    position 0, not the tail).  INT32_MAX ends clamp, so dynamic second
+    dims keep their full width."""
+    if length < 0 or (start_index < 0 and start_index + length >= 0):
+        end = 2 ** 31 - 1
+    else:
+        end = start_index + length
     return [layers.slice(v, axes=[1], starts=[start_index], ends=[end])
             for v in input]
 
@@ -225,17 +235,25 @@ def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
         % (sorted(binary), sorted(unary), functor_list))
 
 
-def fused_embedding_seq_pool(input, size, is_sparse=False,
+def fused_embedding_seq_pool(input, size, seq_lens=None, is_sparse=False,
                              padding_idx=None, combiner="sum",
                              param_attr=None, dtype="float32"):
     """cf. contrib/layers/nn.py:448: embedding lookup + sequence sum
-    pool in one call (XLA fuses the composition)."""
+    pool in one call (XLA fuses the composition).  Dense redesign of the
+    LoD pool: pass `seq_lens` [B] to mask the padded tail out of the
+    sum (or use padding_idx to zero the pad embedding itself)."""
     if combiner != "sum":
         raise ValueError("combiner must be 'sum' (reference supports "
                          "sum only)")
     emb = layers.embedding(input, size=size, is_sparse=is_sparse,
                            padding_idx=padding_idx,
                            param_attr=param_attr, dtype=dtype)
+    if seq_lens is not None:
+        t = int(input.shape[1])
+        mask = layers.cast(
+            layers.sequence_mask(seq_lens, t, dtype="int64"), dtype)
+        emb = layers.elementwise_mul(emb, layers.unsqueeze(mask, [2]),
+                                     axis=0)
     return layers.reduce_sum(emb, dim=1)
 
 
@@ -248,5 +266,6 @@ def batch_fc(input, param_size, param_attr, bias_size, bias_attr,
     helper = LayerHelper("batch_fc")
     w = helper.create_parameter(param_attr, list(param_size))
     b = helper.create_parameter(bias_attr, list(bias_size))
-    out = layers.elementwise_add(layers.matmul(input, w), b)
+    out = append_simple_op("batch_fc",
+                           {"Input": input, "W": w, "Bias": b}, {})
     return helper.append_activation(out, act)

@@ -716,8 +716,11 @@ def _uniform_random_bsl(ctx, ins, attrs):
     shape = list(attrs["shape"])
     shape[int(attrs.get("output_dim_idx", 0))] = x.shape[
         int(attrs.get("input_dim_idx", 0))]
+    # nonzero seed pins the stream (random_ops._key convention)
+    seed = int(attrs.get("seed", 0))
+    key = jax.random.PRNGKey(seed) if seed else ctx.rng()
     return {"Out": [jax.random.uniform(
-        ctx.rng(), tuple(shape),
+        key, tuple(shape),
         dtype=to_jnp(attrs.get("dtype", "float32")),
         minval=float(attrs.get("min", -1.0)),
         maxval=float(attrs.get("max", 1.0)))]}

@@ -88,7 +88,12 @@ def test_capi_client_matches_python_predictor(tmp_path):
         f.write(np.ascontiguousarray(x, np.float32).tobytes())
 
     env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # CPU-only subprocess: drop the axon TPU site hook entirely — its
+    # register() initializes the tunnel plugin during `import jax`
+    # regardless of JAX_PLATFORMS, so a stuck/absent tunnel would hang
+    # this test even though it never uses the chip
+    env["PYTHONPATH"] = REPO
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     # conftest pins matmul precision to full f32 in THIS process; the
     # client process must match or conv outputs differ at the 5e-3 level

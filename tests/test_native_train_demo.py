@@ -32,7 +32,12 @@ def test_cxx_train_demo_compiles_and_converges(tmp_path):
     assert build.returncode == 0, build.stderr
 
     env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # CPU-only subprocess: drop the axon TPU site hook entirely — its
+    # register() initializes the tunnel plugin during `import jax`
+    # regardless of JAX_PLATFORMS, so a stuck/absent tunnel would hang
+    # this test even though it never uses the chip
+    env["PYTHONPATH"] = REPO
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     run = subprocess.run([exe], capture_output=True, text=True,
                          timeout=600, env=env)
