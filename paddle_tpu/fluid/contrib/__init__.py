@@ -7,6 +7,8 @@ Capability parity: reference `python/paddle/fluid/contrib/`.
 from . import decoder  # noqa: F401
 from . import extend_optimizer  # noqa: F401
 from . import layers  # noqa: F401
+from . import reader  # noqa: F401
+from .reader import distributed_batch_reader  # noqa: F401
 from . import mixed_precision, slim  # noqa: F401
 from .extend_optimizer import (  # noqa: F401
     extend_with_decoupled_weight_decay,

@@ -250,10 +250,8 @@ def fused_embedding_seq_pool(input, size, seq_lens=None, is_sparse=False,
                            param_attr=param_attr, dtype=dtype)
     if seq_lens is not None:
         t = int(input.shape[1])
-        mask = layers.cast(
-            layers.sequence_mask(seq_lens, t, dtype="int64"), dtype)
-        emb = layers.elementwise_mul(emb, layers.unsqueeze(mask, [2]),
-                                     axis=0)
+        emb = layers.elementwise_mul(
+            emb, layers.sequence_mask(seq_lens, t, dtype=dtype), axis=0)
     return layers.reduce_sum(emb, dim=1)
 
 

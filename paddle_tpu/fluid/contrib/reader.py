@@ -1,0 +1,33 @@
+"""contrib reader utilities.
+
+Capability parity: reference `contrib/reader/distributed_reader.py:21`
+(distributed_batch_reader: each trainer consumes its own 1/Nth of the
+batch stream under the PADDLE_* env contract).  `contrib/utils/`'s
+hdfs_utils map to `fluid/fs.py` (HDFS shell) and lookup_table_utils to
+the host-embedding PS capability mapping (SURVEY §2.3)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    """Shard a batch reader across trainers: trainer i yields batches
+    i, i+N, i+2N, ... (reference distributed_reader.py:21; reads the
+    same PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM env the launch module
+    sets)."""
+    trainers = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    trainer_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+    if not (0 <= trainer_id < trainers):
+        raise ValueError(
+            "PADDLE_TRAINER_ID=%d out of range for PADDLE_TRAINERS_NUM=%d"
+            % (trainer_id, trainers))
+
+    def decorated():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainers == trainer_id:
+                yield batch
+
+    return decorated

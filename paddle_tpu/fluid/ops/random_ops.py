@@ -20,6 +20,16 @@ def _key(ctx, attrs):
     return ctx.rng()
 
 
+def step_seeded_key(ctx, attrs):
+    """Seed folded into the STEP-varying key: a nonzero seed makes the
+    stream reproducible across runs while still drawing fresh values
+    every step (shuffle_batch's contract — the draw must change per
+    step; plain PRNGKey(seed) would freeze it)."""
+    seed = int(attrs.get("seed", 0))
+    key = ctx.rng()
+    return jax.random.fold_in(key, seed) if seed else key
+
+
 @register_op("gaussian_random", inputs=[], outputs=["Out"], grad=None, needs_rng=True)
 def _gaussian_random(ctx, ins, attrs):
     shape = tuple(attrs["shape"])

@@ -500,26 +500,30 @@ def _masked_fill(ctx, ins, attrs):
         attrs.get("value", 0.0), x.dtype), x)]}
 
 
-@register_op("partial_sum", inputs=["X"], outputs=["Out"])
-def _partial_sum(ctx, ins, attrs):
+def _partial_cols(ins, attrs):
+    """Column windows for partial_sum/partial_concat.  length < 0 means
+    'to the end'; a NEGATIVE start whose window reaches the axis end
+    also slices to the end (python end=0 would mean position 0)."""
     start = int(attrs.get("start_index", 0))
     length = int(attrs.get("length", -1))
     parts = []
     for x in ins["X"]:
-        end = x.shape[1] if length < 0 else start + length
+        if length < 0 or (start < 0 and start + length >= 0):
+            end = x.shape[1]
+        else:
+            end = start + length
         parts.append(x[:, start:end])
-    return {"Out": [sum(parts)]}
+    return parts
+
+
+@register_op("partial_sum", inputs=["X"], outputs=["Out"])
+def _partial_sum(ctx, ins, attrs):
+    return {"Out": [sum(_partial_cols(ins, attrs))]}
 
 
 @register_op("partial_concat", inputs=["X"], outputs=["Out"])
 def _partial_concat(ctx, ins, attrs):
-    start = int(attrs.get("start_index", 0))
-    length = int(attrs.get("length", -1))
-    parts = []
-    for x in ins["X"]:
-        end = x.shape[1] if length < 0 else start + length
-        parts.append(x[:, start:end])
-    return {"Out": [jnp.concatenate(parts, axis=1)]}
+    return {"Out": [jnp.concatenate(_partial_cols(ins, attrs), axis=1)]}
 
 
 @register_op("center_loss",
@@ -716,11 +720,10 @@ def _uniform_random_bsl(ctx, ins, attrs):
     shape = list(attrs["shape"])
     shape[int(attrs.get("output_dim_idx", 0))] = x.shape[
         int(attrs.get("input_dim_idx", 0))]
-    # nonzero seed pins the stream (random_ops._key convention)
-    seed = int(attrs.get("seed", 0))
-    key = jax.random.PRNGKey(seed) if seed else ctx.rng()
+    from .random_ops import step_seeded_key
+
     return {"Out": [jax.random.uniform(
-        key, tuple(shape),
+        step_seeded_key(ctx, attrs), tuple(shape),
         dtype=to_jnp(attrs.get("dtype", "float32")),
         minval=float(attrs.get("min", -1.0)),
         maxval=float(attrs.get("max", 1.0)))]}

@@ -295,3 +295,24 @@ def test_contrib_layers_surface():
     assert fp_v.shape == (3, 6)
     assert ch_v.shape == (3, 4, 2) and mk_v.shape == (3, 4, 2)
     assert set(np.unique(mk_v)) <= {0, 1}
+
+
+def test_distributed_batch_reader_shards_stream(monkeypatch):
+    """cf. contrib/reader/distributed_reader.py: trainer i gets batches
+    i, i+N, ... of the shared stream."""
+    from paddle_tpu.fluid.contrib import distributed_batch_reader
+
+    def reader():
+        for i in range(10):
+            yield i
+
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "3")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    assert list(distributed_batch_reader(reader)()) == [1, 4, 7]
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    assert list(distributed_batch_reader(reader)()) == [0, 3, 6, 9]
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "5")
+    import pytest
+
+    with pytest.raises(ValueError, match="out of range"):
+        distributed_batch_reader(reader)

@@ -35,6 +35,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.fluid.contrib.layers",
     "paddle_tpu.fluid.contrib.extend_optimizer",
     "paddle_tpu.fluid.contrib.utils_stat",
+    "paddle_tpu.fluid.contrib.reader",
     "paddle_tpu.fluid.contrib.slim.prune",
     "paddle_tpu.fluid.contrib.slim.distillation",
     "paddle_tpu.fluid.contrib.slim.nas",
