@@ -114,6 +114,14 @@ class BarrierMonitor:
         self._timeout = float(timeout_s)
         self._round = 0
 
+    def reset(self, barrier_id):
+        """Remove THIS rank's marker for `barrier_id` so the id can be
+        waited on again (checkpoint saves retried after a failure reuse
+        their ids — cf. incubate.checkpoint CheckpointSaver)."""
+        me = os.path.join(self._dir, "b%s_r%d" % (barrier_id, self._id))
+        if os.path.exists(me):
+            os.remove(me)
+
     def wait(self, barrier_id=None, poll_s=0.05):
         """Barrier ids must be UNIQUE per synchronization point (markers
         persist; a reused id would fall through instantly).  Omit the id

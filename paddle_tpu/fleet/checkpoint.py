@@ -4,6 +4,15 @@ Capability parity: reference `incubate/fleet/collective/__init__.py` —
 `save_check_point:236` (checkpoint_N dirs with TrainStatus epoch metadata),
 `load_check_point:287`, `clean_redundant_check_points:206`, `TrainStatus:49`.
 
+Since the incubate.checkpoint subsystem landed, this module is the thin
+fleet facade over it: `save_check_point` commits through
+`CheckpointSaver` (write-to-tmp + atomic rename + CRC32 manifest), and
+`load_check_point` loads the newest checkpoint whose integrity verifies
+— a run killed mid-save can never resume from the torn directory.  The
+on-disk layout (`checkpoint_<n>/` with a `train_status` JSON) and this
+API are unchanged; pre-subsystem checkpoints (no `meta.json`) still
+load.
+
 Sharded arrays (ShardedTrainStep state across a mesh) are saved via orbax
 (each host writes its shards — the TPU equivalent of the reference's
 pserver-side sliced save, io.py:446).
@@ -13,11 +22,7 @@ from __future__ import annotations
 
 import json
 import os
-import pickle
 import re
-import shutil
-
-import numpy as np
 
 
 class TrainStatus:
@@ -51,6 +56,13 @@ def _checkpoint_numbers(root):
     return sorted(out)
 
 
+def _saver(path, max_num_checkpoints=0, **kw):
+    from ..incubate.checkpoint.checkpoint_saver import CheckpointSaver
+
+    return CheckpointSaver(root=path,
+                           max_num_checkpoints=max_num_checkpoints, **kw)
+
+
 def get_last_checkpoint_no(root):
     """cf. reference _get_last_checkpoint_no."""
     nums = _checkpoint_numbers(root)
@@ -59,43 +71,107 @@ def get_last_checkpoint_no(root):
 
 def clean_redundant_check_points(root, reserved_num=1):
     """cf. reference clean_redundant_check_points:206."""
+    import shutil
+
     nums = _checkpoint_numbers(root)
     for n in nums[:-reserved_num] if reserved_num > 0 else nums:
         shutil.rmtree(os.path.join(root, "checkpoint_%d" % n))
 
 
-def save_check_point(executor, path, train_status, main_program=None,
-                     local_cache_path=None, remain_all_checkpoint=True):
-    """Static-graph checkpoint (cf. save_check_point:236): persistables +
-    TrainStatus into path/checkpoint_N."""
-    from ..fluid import framework, io
+class _TrainStatusFile:
+    """SerializableBase writing the legacy `train_status` JSON (kept so
+    pre-subsystem tooling and tests read the same layout)."""
 
-    n = get_last_checkpoint_no(path) + 1
-    ckpt = os.path.join(path, "checkpoint_%d" % n)
-    os.makedirs(ckpt, exist_ok=True)
-    io.save_persistables(executor, ckpt,
-                         main_program or framework.default_main_program())
-    with open(os.path.join(ckpt, "train_status"), "w") as f:
-        json.dump({"epoch_no": train_status._epoch_no}, f)
-    if not remain_all_checkpoint:
-        clean_redundant_check_points(path)
-    return n
+    def __init__(self, train_status=None):
+        self.status = train_status
+
+    def snapshot(self):
+        pass
+
+    def serialize(self, path):
+        with open(os.path.join(path, "train_status"), "w") as f:
+            json.dump({"epoch_no": self.status._epoch_no}, f)
+        return ["train_status"]
+
+    def deserialize(self, path):
+        with open(os.path.join(path, "train_status")) as f:
+            meta = json.load(f)
+        self.status = TrainStatus(meta["epoch_no"])
+
+
+def save_check_point(executor, path, train_status, main_program=None,
+                     local_cache_path=None, remain_all_checkpoint=True,
+                     fs=None, trainer_id=0, num_trainers=1, barrier=None):
+    """Static-graph checkpoint (cf. save_check_point:236): persistables +
+    TrainStatus into path/checkpoint_N, committed atomically with a CRC
+    manifest via incubate.checkpoint."""
+    from ..fluid import framework
+    from ..incubate.checkpoint.checkpoint_saver import StateSnapshot
+
+    program = main_program or framework.default_main_program()
+    from ..fluid.core.scope import global_scope
+
+    saver = _saver(path, fs=fs, local_cache_path=local_cache_path,
+                   trainer_id=trainer_id, num_trainers=num_trainers,
+                   barrier=barrier,
+                   max_num_checkpoints=0 if remain_all_checkpoint else 1)
+    # dense persistables are replicated across DP ranks: rank 0 alone
+    # writes them (two ranks writing one payload.npz would tear it);
+    # other ranks just participate in the barriers around the commit
+    slists = [] if trainer_id != 0 else [
+        StateSnapshot.from_program(program, global_scope()),
+        _TrainStatusFile(train_status),
+    ]
+    return saver.save_checkpoint(slists, epoch=train_status._epoch_no)
 
 
 def load_check_point(executor, path, main_program=None, trainer_id=None):
     """cf. load_check_point:287 — returns TrainStatus (or None if no
-    checkpoint exists)."""
-    from ..fluid import framework, io
+    checkpoint exists).  Picks the newest checkpoint whose CRC manifest
+    verifies; torn/corrupt directories are skipped (legacy dirs without
+    a manifest load as before)."""
+    from ..fluid import framework
+    from ..fluid.core.scope import global_scope
+    from ..incubate.checkpoint.checkpoint_saver import (
+        CheckpointLoadError,
+        StateSnapshot,
+    )
 
-    n = get_last_checkpoint_no(path)
-    if n < 0:
+    program = main_program or framework.default_main_program()
+    scope = global_scope()
+    saver = _saver(path)
+    snap = StateSnapshot.from_program(program, scope)
+    ts = _TrainStatusFile()
+    try:
+        meta = saver.load_checkpoint([snap, ts])
+    except CheckpointLoadError:
+        meta = _load_legacy(path, program, scope)
+        if meta is None:
+            raise
+        return TrainStatus(meta["epoch_no"])
+    if meta is None:
         return None
-    ckpt = os.path.join(path, "checkpoint_%d" % n)
-    io.load_persistables(executor, ckpt,
-                         main_program or framework.default_main_program())
-    with open(os.path.join(ckpt, "train_status")) as f:
-        meta = json.load(f)
-    return TrainStatus(meta["epoch_no"])
+    snap.restore_to_scope(scope)
+    return ts.status
+
+
+def _load_legacy(path, program, scope):
+    """Pre-subsystem checkpoint_N dirs: per-var .npy files, no
+    meta.json.  Load the newest one that has a train_status."""
+    from ..fluid import io
+
+    for n in reversed(_checkpoint_numbers(path)):
+        ckpt = os.path.join(path, "checkpoint_%d" % n)
+        status = os.path.join(ckpt, "train_status")
+        if not os.path.exists(status):
+            continue
+        try:
+            io.load_persistables(None, ckpt, program)
+            with open(status) as f:
+                return json.load(f)
+        except Exception:
+            continue
+    return None
 
 
 # ---------------------------------------------------------------------------

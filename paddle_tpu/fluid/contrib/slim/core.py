@@ -15,6 +15,9 @@ simply invalidates the executor cache via Program._bump.
 
 from __future__ import annotations
 
+import os
+import pickle
+
 __all__ = ["Context", "Strategy", "Compressor"]
 
 
@@ -76,23 +79,73 @@ class Compressor:
 
     def __init__(self, scope, train_program, startup_program=None,
                  eval_program=None, train_epoch_fn=None, eval_func=None,
-                 executor=None, optimizer=None, epochs=1):
+                 executor=None, optimizer=None, epochs=1,
+                 checkpoint_path=None):
         self.context = Context(
             train_program=train_program, startup_program=startup_program,
             eval_program=eval_program, scope=scope, executor=executor,
             eval_func=eval_func, optimizer=optimizer)
         self._train_epoch_fn = train_epoch_fn
         self._epochs = int(epochs)
+        self._checkpoint_path = checkpoint_path
         self.strategies = []
 
     def add_strategy(self, *strategies):
         self.strategies.extend(strategies)
         return self
 
+    # -- checkpoint/resume (cf. reference compressor.py:238 _save_/
+    # _load_checkpoint + init_model flow) --------------------------------
+    def _ckpt_saver(self):
+        from ....incubate.checkpoint.checkpoint_saver import CheckpointSaver
+
+        return CheckpointSaver(root=self._checkpoint_path,
+                               max_num_checkpoints=2)
+
+    def _save_checkpoint(self, epoch):
+        """Everything a resume needs: the (possibly strategy-rewritten)
+        program, the scope arrays (shapes may have been pruned), and the
+        strategies' own state — committed atomically."""
+        ctx = self.context
+        self._ckpt_saver().save_checkpoint(
+            [_CompressorState(self)], epoch=epoch,
+            extra_meta={"eval_results": ctx.eval_results,
+                        "program_hash": self._origin_hash})
+
+    def _try_resume(self):
+        """Returns the first epoch to run (0 when starting fresh).
+
+        The checkpoint must belong to THIS job: the hash of the original
+        (pre-strategy) program is pinned in the meta — resuming another
+        model's compression run raises instead of silently training the
+        wrong program (same guard auto_checkpoint uses)."""
+        if self._checkpoint_path is None or not os.path.isdir(
+                self._checkpoint_path):
+            return 0
+        state = _CompressorState(self)
+        meta = self._ckpt_saver().load_checkpoint(
+            [state], expect_program_hash=self._origin_hash)
+        if meta is None:
+            return 0
+        state.apply()
+        self.context.eval_results = meta.get("eval_results") or {}
+        return int(meta["epoch"]) + 1
+
     def run(self):
         ctx = self.context
-        for s in self.strategies:
-            s.on_compression_begin(ctx)
+        self._origin_hash = None
+        if ctx.train_program is not None:
+            from ....incubate.checkpoint.checkpoint_saver import program_hash
+
+            self._origin_hash = program_hash(ctx.train_program)
+        start_epoch = self._try_resume()
+        if start_epoch == 0:
+            for s in self.strategies:
+                s.on_compression_begin(ctx)
+        # resumed: strategies were restored mid-flight — begin hooks
+        # (teacher merge, program rewrites) are already baked into the
+        # checkpointed program/state and must not run twice
+
         def active(s, epoch):
             # [start_epoch, end_epoch); end_epoch <= start_epoch (the
             # default 0) means unbounded
@@ -100,7 +153,7 @@ class Compressor:
                 return False
             return s.end_epoch <= s.start_epoch or epoch < s.end_epoch
 
-        for epoch in range(self._epochs):
+        for epoch in range(start_epoch, self._epochs):
             ctx.epoch = epoch
             for s in self.strategies:
                 if active(s, epoch):
@@ -110,6 +163,69 @@ class Compressor:
             for s in self.strategies:
                 if active(s, epoch):
                     s.on_epoch_end(ctx)
+            if self._checkpoint_path is not None:
+                self._save_checkpoint(epoch)
         for s in self.strategies:
             s.on_compression_end(ctx)
         return ctx
+
+
+class _CompressorState:
+    """SerializableBase bundling program JSON + scope arrays + strategy
+    state into one integrity-checked payload."""
+
+    def __init__(self, compressor):
+        self._c = compressor
+
+    def snapshot(self):
+        import numpy as np
+
+        c, ctx = self._c, self._c.context
+        scope_state = {
+            n: np.asarray(ctx.scope.find_var(n))
+            for n in ctx.scope.local_names() if ctx.scope.has(n)
+        }
+        self._blob = pickle.dumps({
+            "program_json": ctx.train_program.to_json()
+            if ctx.train_program is not None else None,
+            "scope": scope_state,
+            "strategies": [
+                (type(s).__name__, dict(s.__dict__)) for s in c.strategies
+            ],
+        })
+
+    def serialize(self, path):
+        if not hasattr(self, "_blob"):
+            self.snapshot()
+        with open(os.path.join(path, "compressor.pkl"), "wb") as f:
+            f.write(self._blob)
+        return ["compressor.pkl"]
+
+    def deserialize(self, path):
+        """Parse + VALIDATE only — nothing live is touched until
+        apply(), so a pipeline mismatch leaves the compressor exactly as
+        configured (no half-restored program/scope)."""
+        with open(os.path.join(path, "compressor.pkl"), "rb") as f:
+            self._state = pickle.load(f)
+        saved = self._state["strategies"]
+        configured = [type(s).__name__ for s in self._c.strategies]
+        if [n for n, _ in saved] != configured:
+            raise RuntimeError(
+                "compressor checkpoint strategies %s do not match the "
+                "configured ones %s — resume requires the same pipeline"
+                % ([n for n, _ in saved], configured))
+
+    def apply(self):
+        c, ctx = self._c, self._c.context
+        state = self._state
+        if state["program_json"] is not None:
+            from ... import framework
+
+            ctx.train_program = framework.Program.from_json(
+                state["program_json"])
+        import jax
+
+        for n, v in state["scope"].items():
+            ctx.scope.set(n, jax.device_put(v))
+        for s, (_name, st) in zip(c.strategies, state["strategies"]):
+            s.__dict__.update(st)

@@ -1,0 +1,4 @@
+"""Incubating subsystems (cf. reference `python/paddle/fluid/incubate/`):
+capabilities that are production-real but whose API may still move."""
+
+from . import checkpoint  # noqa: F401

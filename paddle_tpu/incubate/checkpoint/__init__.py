@@ -1,0 +1,49 @@
+"""Fault-tolerant checkpointing (cf. reference
+`python/paddle/fluid/incubate/checkpoint/` — `auto_checkpoint.py`,
+`checkpoint_saver.py`).
+
+One engine serves every training style in the framework:
+
+* `CheckpointSaver` — write-to-tmp + atomic-rename commits over the
+  `fluid/fs.py` FS abstraction, per-checkpoint metadata (epoch/step,
+  program hash, payload CRC32), retention and GC of stale/partial dirs;
+* `AsyncCheckpointSaver` — the save off the critical path: the
+  device->host snapshot is synchronous (cheap), serialization + FS I/O
+  run in a background thread with at most one save in flight;
+* `train_epoch_range` / `TrainEpochRange` — auto-checkpoint keyed by
+  the program hash, so a restarted run silently resumes from the last
+  *committed* checkpoint and corrupted/partial checkpoints are skipped.
+"""
+
+from .auto_checkpoint import (  # noqa: F401
+    CHECKPOINT_DIR_ENV,
+    TrainEpochRange,
+    current_train_epoch_range,
+    train_epoch_range,
+)
+from .checkpoint_saver import (  # noqa: F401
+    AsyncCheckpointSaver,
+    CheckpointLoadError,
+    CheckpointSaveError,
+    CheckpointSaver,
+    HostEmbeddingCheckpoint,
+    PaddleModel,
+    SerializableBase,
+    StateSnapshot,
+    program_hash,
+)
+
+__all__ = [
+    "AsyncCheckpointSaver",
+    "CheckpointLoadError",
+    "CheckpointSaveError",
+    "CheckpointSaver",
+    "HostEmbeddingCheckpoint",
+    "PaddleModel",
+    "SerializableBase",
+    "StateSnapshot",
+    "TrainEpochRange",
+    "current_train_epoch_range",
+    "program_hash",
+    "train_epoch_range",
+]

@@ -1,0 +1,179 @@
+"""Auto-checkpoint: resume-transparent epoch loops keyed by program hash.
+
+Capability parity: reference
+`python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py` —
+`train_epoch_range` wraps the user's epoch loop; a restarted process
+silently fast-forwards to the first epoch after the last COMMITTED
+checkpoint of the same job (`_get_running_key` = hash of the program),
+with the checkpoint dir coming from the environment so user code does
+not change between a fresh run and a resume.
+
+TPU-first deltas from the reference: saves are asynchronous by default
+(`AsyncCheckpointSaver` — the train step never blocks on FS I/O), a
+checkpoint is only trusted if its CRC manifest verifies (torn writes
+from a preemption are skipped, falling back to the previous commit),
+and multi-host runs barrier through `distributed/monitor.py` with only
+rank 0 committing metadata.
+
+Usage::
+
+    exe.run(startup)
+    for epoch in acp.train_epoch_range(30, checkpoint_dir=root):
+        train_one_epoch(...)
+    # SIGKILL any time; rerunning the same script resumes after the
+    # last committed epoch.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from .checkpoint_saver import (
+    AsyncCheckpointSaver,
+    CheckpointSaver,
+    SerializableBase,
+    StateSnapshot,
+    program_hash,
+)
+
+CHECKPOINT_DIR_ENV = "PADDLE_TPU_CHECKPOINT_DIR"
+
+# reference parity: at most one acp range may be live at a time
+# (g_train_epoch_range in the reference)
+_g_train_epoch_range = None
+
+
+class TrainEpochRange:
+    """The resume-aware epoch iterator behind `train_epoch_range`."""
+
+    def __init__(self, max_epoch_num, name=None, checkpoint_dir=None,
+                 main_program=None, scope=None, fs=None,
+                 save_checkpoint_inter=1, max_num_checkpoints=3,
+                 async_save=True, trainer_id=None, num_trainers=None,
+                 barrier=None, extra_serializables=None, verbose=False):
+        from ...fluid import framework
+        from ...fluid.core.scope import global_scope
+
+        self._max_epoch_num = int(max_epoch_num)
+        self._program = main_program or framework.default_main_program()
+        self._scope = scope or global_scope()
+        self._inter = max(int(save_checkpoint_inter), 1)
+        self._verbose = verbose
+        self._hash = program_hash(self._program)
+        self.name = name or "acp_%s" % self._hash[:16]
+
+        root = checkpoint_dir or os.getenv(CHECKPOINT_DIR_ENV)
+        if root is None:
+            # no directory configured: plain range(), no checkpointing
+            # (reference _can_auto_checkpoint degrades the same way)
+            self._saver = None
+            self._async = None
+            self._start_epoch = 0
+            self.restored_from = -1
+            return
+
+        trainer_id = int(os.getenv("PADDLE_TRAINER_ID", "0")
+                         if trainer_id is None else trainer_id)
+        num_trainers = int(os.getenv("PADDLE_TRAINERS_NUM", "1")
+                           if num_trainers is None else num_trainers)
+        if num_trainers > 1 and barrier is None:
+            from ...distributed.monitor import BarrierMonitor
+
+            barrier = BarrierMonitor(
+                os.path.join(root, self.name), trainer_id, num_trainers)
+
+        self._rank = trainer_id
+        # dense program state is replicated across DP ranks: rank 0 alone
+        # writes payload.npz (concurrent ranks writing one filename would
+        # tear it); sharded extras (host-embedding tables etc.) carry
+        # rank-distinct filenames and save on every rank
+        self._snap = StateSnapshot.from_program(self._program, self._scope)
+        extras = list(extra_serializables or [])
+        self._serializables = [self._snap] + extras
+        self._save_serializables = (
+            self._serializables if trainer_id == 0 else extras)
+        self._saver = CheckpointSaver(
+            root=os.path.join(root, self.name), fs=fs,
+            max_num_checkpoints=max_num_checkpoints,
+            trainer_id=trainer_id, num_trainers=num_trainers,
+            barrier=barrier)
+        self._async = AsyncCheckpointSaver(self._saver) if async_save \
+            else None
+        self._restore()
+
+    # -- resume ----------------------------------------------------------
+    def _restore(self):
+        skipped = []
+        meta = self._saver.load_checkpoint(
+            self._serializables, expect_program_hash=self._hash,
+            on_skip=lambda n, why: skipped.append((n, why)))
+        for n, why in skipped:
+            print("auto_checkpoint[%s]: skipping checkpoint_%d (%s)"
+                  % (self.name, n, why), file=sys.stderr)
+        if meta is None:
+            self._start_epoch = 0
+            self.restored_from = -1
+            return
+        self._serializables[0].restore_to_scope(self._scope)
+        self.restored_from = int(meta.get("epoch", -1))
+        self._start_epoch = self.restored_from + 1
+        if self._verbose:
+            print("auto_checkpoint[%s]: resumed after epoch %d"
+                  % (self.name, self.restored_from), file=sys.stderr)
+
+    @property
+    def start_epoch(self):
+        return self._start_epoch
+
+    # -- save ------------------------------------------------------------
+    def save_checkpoint(self, epoch, step=None):
+        extra = {"program_hash": self._hash, "name": self.name}
+        if self._async is not None:
+            return self._async.save_async(
+                self._save_serializables, epoch=epoch, step=step,
+                extra_meta=extra)
+        return self._saver.save_checkpoint(
+            self._save_serializables, epoch=epoch, step=step,
+            extra_meta=extra)
+
+    def wait(self):
+        """Barrier on the in-flight async save (re-raises its error)."""
+        if self._async is not None:
+            return self._async.wait()
+
+    # -- the loop --------------------------------------------------------
+    def get(self):
+        global _g_train_epoch_range
+        _g_train_epoch_range = self
+        try:
+            for epoch in range(self._start_epoch, self._max_epoch_num):
+                yield epoch
+                if self._saver is not None and (
+                        epoch % self._inter == self._inter - 1
+                        or epoch == self._max_epoch_num - 1):
+                    self.save_checkpoint(epoch)
+        finally:
+            _g_train_epoch_range = None
+            # drain the in-flight save on EVERY exit (normal end, break,
+            # exception): the last issued checkpoint must be durable and
+            # a background save failure must never be swallowed
+            self.wait()
+
+    def __iter__(self):
+        return self.get()
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=1, **kw):
+    """Reference-parity entry point: iterate epochs with transparent
+    checkpoint/resume.  `checkpoint_dir` (or $PADDLE_TPU_CHECKPOINT_DIR)
+    enables persistence; without it this is a plain range."""
+    r = TrainEpochRange(
+        max_epoch_num, save_checkpoint_inter=save_checkpoint_inter, **kw)
+    return r.get()
+
+
+def current_train_epoch_range():
+    """The live TrainEpochRange, if an acp loop is running (reference
+    g_train_epoch_range accessor)."""
+    return _g_train_epoch_range

@@ -1,0 +1,677 @@
+"""Crash-safe checkpoint engine: atomic commits, CRC integrity, async I/O.
+
+Capability parity: reference
+`python/paddle/fluid/incubate/checkpoint/checkpoint_saver.py`
+(`SerializableBase`, `PaddleModel`, `CheckpointSaver` over the fleet FS
+clients — numbered dirs, `_serial_to_path`, cache-then-upload for remote
+FS) — extended with the crash-safety the reference leaves to HDFS
+semantics: every save lands in a `.tmp` directory and becomes visible
+only through one atomic rename, `meta.json` carries a CRC32 per payload
+file so a torn write is detected and skipped at load time, and stale
+tmp/corrupt directories are garbage-collected.
+
+Async design (cf. Orbax async checkpointing; Check-N-Run, NSDI '22):
+the device->host snapshot is taken synchronously on the training thread
+(cheap — bytes already exist on host after fetch), then serialization +
+FS writes run on a background thread with at most ONE save in flight.
+Errors surface on the next `save_async`/`wait` — a checkpoint failure
+must never be silent, but it also must not crash the train step that
+happened to overlap it.
+
+Multi-host discipline: every rank serializes its own shard files into
+the shared tmp directory and drops a per-rank manifest; rank 0 merges
+the manifests into `meta.json` and performs the commit rename; other
+ranks wait on the barrier (`distributed/monitor.py` machinery) so no
+rank can observe (or GC) a half-written checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+import zlib
+
+import numpy as np
+
+from ...fluid.fs import LocalFS
+
+META_FILE = "meta.json"
+_TMP_PREFIX = ".tmp_checkpoint_"
+_ATTEMPT_PREFIX = ".attempt_"
+_CKPT_PREFIX = "checkpoint_"
+
+
+class CheckpointSaveError(RuntimeError):
+    """A (possibly asynchronous) checkpoint save failed."""
+
+
+class CheckpointLoadError(RuntimeError):
+    """No loadable checkpoint: every candidate was corrupt/partial."""
+
+
+def program_hash(program):
+    """Stable identity of a Program's structure (auto-checkpoint key —
+    a restarted run only resumes from checkpoints of the SAME graph)."""
+    import hashlib
+
+    return hashlib.md5(program.to_json().encode("utf-8")).hexdigest()
+
+
+def _crc_file(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            crc = zlib.crc32(b, crc)
+    return crc & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Serializables
+# ---------------------------------------------------------------------------
+
+
+class SerializableBase:
+    """What CheckpointSaver saves/restores (reference parity interface).
+
+    `snapshot()` runs synchronously on the caller's thread (device->host
+    materialization); `serialize(path)` may run on a background thread
+    and returns the list of file names it wrote under `path` (they enter
+    the CRC manifest)."""
+
+    def snapshot(self):
+        pass
+
+    def serialize(self, path):
+        raise NotImplementedError
+
+    def deserialize(self, path):
+        raise NotImplementedError
+
+
+class StateSnapshot(SerializableBase):
+    """A name -> host-array dict captured at snapshot time.
+
+    The constructor copies nothing; `snapshot()` materializes every
+    value via np.asarray (device->host), so an in-flight async write
+    never races the training step mutating the scope."""
+
+    def __init__(self, values=None, filename="payload.npz"):
+        self._source = values or {}
+        self.arrays = None
+        self.filename = filename
+
+    @classmethod
+    def from_scope(cls, scope, names=None, filename="payload.npz"):
+        names = list(names) if names is not None else scope.local_names()
+        snap = cls({}, filename=filename)
+        snap._scope = scope
+        snap._names = names
+        return snap
+
+    @classmethod
+    def from_program(cls, program, scope, filename="payload.npz"):
+        names = [
+            v.name for v in program.list_vars()
+            if v.persistable and not v.is_data and scope.has(v.name)
+        ]
+        return cls.from_scope(scope, names, filename=filename)
+
+    def snapshot(self):
+        src = self._source
+        if getattr(self, "_scope", None) is not None:
+            src = {
+                n: self._scope.find_var(n)
+                for n in self._names
+                if self._scope.has(n)
+            }
+        self.arrays = {n: np.asarray(v) for n, v in src.items()}
+
+    def serialize(self, path):
+        if self.arrays is None:
+            self.snapshot()
+        np.savez(os.path.join(path, self.filename), **self.arrays)
+        return [self.filename]
+
+    def deserialize(self, path):
+        with np.load(os.path.join(path, self.filename),
+                     allow_pickle=False) as data:
+            self.arrays = {n: data[n] for n in data.files}
+        return self.arrays
+
+    def restore_to_scope(self, scope, device_put=True):
+        put = _device_put if device_put else (lambda a: a)
+        for n, a in (self.arrays or {}).items():
+            scope.set(n, put(a))
+
+
+def _device_put(arr):
+    import jax
+
+    return jax.device_put(arr)
+
+
+class PaddleModel(SerializableBase):
+    """The persistables of a static program (reference parity class)."""
+
+    def __init__(self, exe, program, scope=None):
+        from ...fluid.core.scope import global_scope
+
+        self._exe = exe
+        self._program = program
+        self._scope = scope or global_scope()
+        self._snap = StateSnapshot.from_program(program, self._scope,
+                                                filename="params.npz")
+
+    def snapshot(self):
+        self._snap.snapshot()
+
+    def serialize(self, path):
+        return self._snap.serialize(path)
+
+    def deserialize(self, path):
+        self._snap.deserialize(path)
+        self._snap.restore_to_scope(self._scope)
+
+
+class HostEmbeddingCheckpoint(SerializableBase):
+    """Host-resident embedding tables save SHARDED: each rank persists
+    only the rows it owns (`hostemb_<table>_rank<r>.npz`), the exact
+    layout `fluid/host_embedding.py` keeps them in — no gather, no
+    table-sized network traffic (the pslib sparse-table save model)."""
+
+    def __init__(self, tables, trainer_id=0):
+        # tables: iterable of HostEmbedding (or program._host_embeddings
+        # mapping name -> (table, ids_slot))
+        if isinstance(tables, dict):
+            tables = [t if not isinstance(t, tuple) else t[0]
+                      for t in tables.values()]
+        self._tables = list(tables)
+        self._rank = int(trainer_id)
+
+    def snapshot(self):
+        # rows live on host already; copy so the optimizer's in-place
+        # push during an async write can't tear the payload
+        self._shards = [
+            (t, t._rows.copy(),
+             getattr(t, "_accum", np.zeros(0)).copy())
+            for t in self._tables
+        ]
+
+    def _fname(self, table):
+        return "hostemb_%s_rank%d.npz" % (table.name, self._rank)
+
+    def serialize(self, path):
+        if not hasattr(self, "_shards"):
+            self.snapshot()
+        names = []
+        for t, rows, accum in self._shards:
+            fname = self._fname(t)
+            np.savez(os.path.join(path, fname), rows=rows, accum=accum,
+                     meta=np.asarray([t.num_rows, t.dim, self._rank,
+                                      t.nproc]))
+            names.append(fname)
+        return names
+
+    def deserialize(self, path):
+        for t in self._tables:
+            t.load(os.path.join(path, self._fname(t)))
+
+
+# ---------------------------------------------------------------------------
+# The saver
+# ---------------------------------------------------------------------------
+
+
+class CheckpointSaver:
+    """Numbered atomic checkpoints under one root directory.
+
+    Layout::
+
+        root/checkpoint_<n>/          committed (rename is the commit)
+            meta.json                 {"no", "epoch", "step",
+                                       "program_hash", "files": {..crc..}}
+            <payload files>
+        root/.tmp_checkpoint_<n>.<token>/   in-progress (GC'd)
+
+    `fs` is the fluid FS abstraction. A non-local FS (HDFSClient) gets
+    the reference's cache-then-upload flow: serialize into
+    `local_cache_path`, upload to a remote tmp dir, remote-rename to
+    commit.
+    """
+
+    def __init__(self, root, fs=None, max_num_checkpoints=3,
+                 trainer_id=0, num_trainers=1, barrier=None,
+                 local_cache_path=None):
+        self._fs = fs or LocalFS()
+        self._root = root
+        self._max_num = (int(max_num_checkpoints)
+                         if max_num_checkpoints else 0)
+        self._rank = int(trainer_id)
+        self._nranks = int(num_trainers)
+        self._barrier = barrier
+        self._cache = local_cache_path or os.path.join(
+            root if self._is_local else ".", ".checkpoint_cache")
+        if self._nranks > 1 and barrier is None:
+            raise ValueError(
+                "multi-trainer CheckpointSaver needs a barrier (e.g. "
+                "distributed.monitor.BarrierMonitor) so non-zero ranks "
+                "wait for rank 0's commit")
+        if self._nranks > 1 and not self._is_local:
+            raise ValueError(
+                "multi-trainer checkpointing requires a shared-mounted "
+                "(LocalFS-addressable) root so every rank can write its "
+                "shard into one tmp dir; mount the DFS locally or save "
+                "per-rank roots")
+
+    @property
+    def _is_local(self):
+        return isinstance(self._fs, LocalFS)
+
+    # -- directory bookkeeping ------------------------------------------
+    def _ckpt_dir(self, n):
+        return os.path.join(self._root, _CKPT_PREFIX + "%d" % n)
+
+    def _numbers(self):
+        dirs, _files = self._fs.ls_dir(self._root)
+        out = []
+        for name in dirs:
+            if name.startswith(_CKPT_PREFIX):
+                tail = name[len(_CKPT_PREFIX):]
+                if tail.isdigit():
+                    out.append(int(tail))
+        return sorted(out)
+
+    def get_checkpoint_no(self):
+        """Largest COMMITTED-and-valid checkpoint number, or -1."""
+        for n in reversed(self._numbers()):
+            if self._read_valid_meta(n) is not None:
+                return n
+        return -1
+
+    def last_checkpoint_dir_no(self):
+        """Largest checkpoint_<n> dir present, valid or not (numbering
+        must advance past a corrupt tail, never overwrite it)."""
+        nums = self._numbers()
+        return nums[-1] if nums else -1
+
+    # -- integrity -------------------------------------------------------
+    def _read_valid_meta(self, n, verify_payload=False):
+        """meta dict if checkpoint n is committed and consistent, else
+        None.  verify_payload=True re-CRCs every payload file (load
+        path); False trusts the committed meta (fast listing path)."""
+        d = self._ckpt_dir(n)
+        meta_path = os.path.join(d, META_FILE)
+        if not self._fs.is_exist(meta_path):
+            return None
+        try:
+            if self._is_local:
+                with open(meta_path) as f:
+                    meta = json.load(f)
+            else:
+                tmp = os.path.join(self._cache, "meta_%d.json" % n)
+                os.makedirs(self._cache, exist_ok=True)
+                self._fs.download(meta_path, tmp)
+                with open(tmp) as f:
+                    meta = json.load(f)
+        except (ValueError, OSError):
+            return None
+        if verify_payload and not self._verify_payload(d, meta):
+            return None
+        return meta
+
+    def _verify_payload(self, d, meta):
+        if not self._is_local:
+            # remote payloads are verified after download, per file
+            return True
+        return self._verify_local_payload(d, meta)
+
+    def _barrier_wait(self, tag):
+        """BarrierMonitor ids are one-shot (markers persist).  Save tags
+        are scoped by the per-attempt token (_agree_tmp_name), so a dead
+        attempt's markers can never collide with or satisfy a live one;
+        this wrapper is the backstop for the remaining self-collision
+        (this rank's OWN marker surviving a failure whose withdraw
+        didn't run): clear it and re-wait instead of wedging."""
+        try:
+            self._barrier.wait(tag)
+        except ValueError:
+            reset = getattr(self._barrier, "reset", None)
+            if reset is None:
+                raise
+            reset(tag)
+            self._barrier.wait(tag)
+
+    def _agree_tmp_name(self, n, timeout_s=120.0, poll_s=0.05):
+        """Rank 0 picks a fresh per-attempt token and publishes the tmp
+        dir name through an atomically-renamed pointer file; other ranks
+        poll it.  The token scopes the tmp dir AND the barrier tags to
+        THIS attempt, so a dead attempt's leftover markers/fragments can
+        never satisfy this attempt's barriers or enter its manifest
+        merge — after a double crash the worst case is a loud barrier
+        timeout (a rank that grabbed the stale pointer), never a
+        silently mixed commit."""
+        pointer = os.path.join(self._root, "%s%d.ptr" % (_ATTEMPT_PREFIX, n))
+        if self._rank == 0:
+            name = "%s%d.%s" % (_TMP_PREFIX, n, uuid.uuid4().hex[:8])
+            self._fs.mkdirs(self._root)
+            with open(pointer + ".w", "w") as f:
+                f.write(name)
+            os.replace(pointer + ".w", pointer)
+            return name
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if os.path.exists(pointer):
+                with open(pointer) as f:
+                    name = f.read().strip()
+                if name:
+                    return name
+            time.sleep(poll_s)
+        raise CheckpointSaveError(
+            "rank %d: rank 0 never published an attempt token for "
+            "checkpoint_%d (pointer %r)" % (self._rank, n, pointer))
+
+    # -- save ------------------------------------------------------------
+    def save_checkpoint(self, slists, epoch=None, step=None,
+                        extra_meta=None, no=None, snapshot=True):
+        """Serialize `slists` into checkpoint_<n>; returns n.
+
+        Atomicity: everything lands in a tmp dir; the rename to
+        checkpoint_<n> is the commit point.  Multi-trainer: all ranks
+        serialize, rank 0 merges manifests + commits, everyone barriers
+        on both sides.
+        """
+        slists = list(slists)
+        if snapshot:
+            for s in slists:
+                s.snapshot()
+        n = (self.last_checkpoint_dir_no() + 1) if no is None else int(no)
+
+        if self._nranks > 1:
+            # the tmp dir must be AGREED across ranks yet UNIQUE per
+            # attempt: rank 0 picks a fresh token and publishes it
+            tmp_name = self._agree_tmp_name(n)
+            token = tmp_name.rsplit(".", 1)[1]
+        else:
+            token = uuid.uuid4().hex[:8]
+            tmp_name = "%s%d.%s" % (_TMP_PREFIX, n, token)
+
+        if self._is_local:
+            tmp = os.path.join(self._root, tmp_name)
+            self._fs.mkdirs(tmp)
+            write_dir = tmp
+        else:
+            os.makedirs(self._cache, exist_ok=True)
+            write_dir = os.path.join(self._cache, tmp_name)
+            os.makedirs(write_dir, exist_ok=True)
+
+        manifest = {}
+        committed = False
+        try:
+            for s in slists:
+                for fname in s.serialize(write_dir):
+                    full = os.path.join(write_dir, fname)
+                    manifest[fname] = {
+                        "crc32": _crc_file(full),
+                        "size": os.path.getsize(full),
+                    }
+            if self._nranks > 1:
+                # per-rank manifest fragment; rank 0 merges after the
+                # serialization barrier
+                frag = os.path.join(write_dir,
+                                    "manifest_rank%d.json" % self._rank)
+                with open(frag, "w") as f:
+                    json.dump(manifest, f)
+                self._barrier_wait("ckpt_ser_%d.%s" % (n, token))
+                if self._rank != 0:
+                    self._barrier_wait("ckpt_commit_%d.%s" % (n, token))
+                    if not self._fs.is_exist(self._ckpt_dir(n)):
+                        raise CheckpointSaveError(
+                            "rank 0 released the commit barrier but "
+                            "checkpoint_%d was not committed" % n)
+                    committed = True
+                    return n
+                manifest = {}
+                for r in range(self._nranks):
+                    fp = os.path.join(write_dir, "manifest_rank%d.json" % r)
+                    with open(fp) as f:
+                        manifest.update(json.load(f))
+                    os.remove(fp)
+
+            meta = {
+                "no": n,
+                "epoch": epoch,
+                "step": step,
+                "time": time.time(),
+                "files": manifest,
+            }
+            meta.update(extra_meta or {})
+            with open(os.path.join(write_dir, META_FILE), "w") as f:
+                json.dump(meta, f)
+
+            final = self._ckpt_dir(n)
+            # a committed checkpoint is immutable: shutil.move onto an
+            # existing dir would NEST the tmp inside it and report
+            # success while committing nothing
+            if self._fs.is_exist(final):
+                raise CheckpointSaveError(
+                    "checkpoint_%d already exists under %r — refusing to "
+                    "overwrite a committed checkpoint" % (n, self._root))
+            if self._is_local:
+                self._fs.mv(write_dir, final)        # THE commit
+            else:
+                remote_tmp = os.path.join(self._root, tmp_name)
+                self._fs.mkdirs(self._root)
+                self._fs.upload(write_dir, remote_tmp)
+                self._fs.mv(remote_tmp, final)       # remote commit
+                LocalFS().delete(write_dir)
+            committed = True
+        except BaseException:
+            # never leave a half-commit that a reader could mistake for
+            # a checkpoint; tmp dirs are invisible to the load path by
+            # name, but delete eagerly anyway
+            if self._nranks <= 1:
+                (LocalFS() if not self._is_local else self._fs).delete(
+                    write_dir)
+            raise
+        finally:
+            if self._nranks > 1:
+                if committed and self._rank == 0:
+                    self._barrier_wait("ckpt_commit_%d.%s" % (n, token))
+                if not committed:
+                    # a FAILED attempt withdraws its own barrier markers
+                    # (the token already isolates attempts; this just
+                    # keeps the barrier workspace from accumulating)...
+                    reset = getattr(self._barrier, "reset", None)
+                    if reset is not None:
+                        reset("ckpt_ser_%d.%s" % (n, token))
+                        reset("ckpt_commit_%d.%s" % (n, token))
+                    # ...and rank 0 withdraws the attempt pointer so a
+                    # retrying peer can't grab this dead attempt's token
+                    # (it would time out loudly waiting for barriers no
+                    # one serves)
+                    if self._rank == 0:
+                        self._fs.delete(os.path.join(
+                            self._root,
+                            "%s%d.ptr" % (_ATTEMPT_PREFIX, n)))
+
+        if self._rank == 0:
+            if self._nranks > 1:
+                # every rank is past the commit barrier; the attempt
+                # pointer has served its purpose
+                self._fs.delete(os.path.join(
+                    self._root, "%s%d.ptr" % (_ATTEMPT_PREFIX, n)))
+            self.clean_redundant_checkpoints()
+            self.gc_stale_tmp()
+        return n
+
+    # -- load ------------------------------------------------------------
+    def load_checkpoint(self, slists, no=None, expect_program_hash=None,
+                        on_skip=None):
+        """Deserialize the newest VALID checkpoint into `slists`.
+
+        Walks checkpoint numbers newest-first; a checkpoint with a
+        missing/torn meta, a CRC mismatch, or (when
+        `expect_program_hash` is given) a different program hash is
+        skipped — `on_skip(no, reason)` observes each skip.  Returns the
+        meta dict, or None when the root holds no checkpoint at all.
+        Raises CheckpointLoadError when checkpoints exist but ALL are
+        unusable (silently training from scratch would be data loss).
+        """
+        nums = self._numbers() if no is None else [int(no)]
+        any_seen = False
+        for n in reversed(nums):
+            any_seen = True
+            meta = self._read_valid_meta(n, verify_payload=True)
+            if meta is None:
+                if on_skip:
+                    on_skip(n, "missing/corrupt meta or payload CRC "
+                               "mismatch")
+                continue
+            if (expect_program_hash is not None
+                    and meta.get("program_hash") not in (
+                        None, expect_program_hash)):
+                if on_skip:
+                    on_skip(n, "program hash mismatch")
+                continue
+            d = self._ckpt_dir(n)
+            if not self._is_local:
+                local = os.path.join(self._cache, "restore_%d" % n)
+                LocalFS().delete(local)
+                self._fs.download(d, local)
+                d = local
+                if not self._verify_local_payload(d, meta):
+                    if on_skip:
+                        on_skip(n, "payload CRC mismatch after download")
+                    continue
+            for s in slists:
+                s.deserialize(d)
+            return meta
+        if any_seen and nums:
+            raise CheckpointLoadError(
+                "checkpoints exist under %r but none is loadable "
+                "(all corrupt/partial or wrong program)" % (self._root,))
+        return None
+
+    def _verify_local_payload(self, d, meta):
+        for fname, rec in (meta.get("files") or {}).items():
+            path = os.path.join(d, fname)
+            if (not os.path.isfile(path)
+                    or os.path.getsize(path) != rec.get("size", -1)
+                    or _crc_file(path) != rec.get("crc32")):
+                return False
+        return True
+
+    # -- retention & GC ---------------------------------------------------
+    def clean_redundant_checkpoints(self, reserved_num=None):
+        """Keep the newest `reserved_num` (default max_num_checkpoints)
+        VALID checkpoints; also delete any committed-but-corrupt dirs
+        older than the newest valid one (they can never be loaded)."""
+        reserved = self._max_num if reserved_num is None else int(
+            reserved_num)
+        if reserved <= 0:
+            return
+        nums = self._numbers()
+        valid = [n for n in nums if self._read_valid_meta(n) is not None]
+        keep = set(valid[-reserved:])
+        newest_valid = valid[-1] if valid else -1
+        for n in nums:
+            if n in keep:
+                continue
+            if n in valid or n < newest_valid:
+                self._fs.delete(self._ckpt_dir(n))
+
+    def gc_stale_tmp(self, min_age_s=3600.0):
+        """Remove leftover `.tmp_checkpoint_*` dirs from crashed saves.
+
+        Age-gated: a live save from another rank/process must not lose
+        its tmp dir under it.  On a non-local FS the mtime is not
+        observable, so nothing is deleted — remote leftovers are an
+        operator cleanup, never an automated data-loss risk."""
+        if not self._is_local:
+            return
+        dirs, files = self._fs.ls_dir(self._root)
+        now = time.time()
+        stale_tmp = [d for d in dirs if d.startswith(_TMP_PREFIX)]
+        stale_ptr = [f for f in files if f.startswith(_ATTEMPT_PREFIX)]
+        for name in stale_tmp + stale_ptr:
+            path = os.path.join(self._root, name)
+            try:
+                age = now - os.path.getmtime(path)
+            except OSError:
+                continue
+            if age > min_age_s:
+                self._fs.delete(path)
+
+
+# ---------------------------------------------------------------------------
+# Async wrapper
+# ---------------------------------------------------------------------------
+
+
+class AsyncCheckpointSaver:
+    """Keeps checkpoint I/O off the train step.
+
+    `save_async` synchronously snapshots (device->host), then runs
+    serialization + FS writes on a daemon thread.  At most one save is
+    in flight: a second `save_async` first waits out the previous one.
+    A background failure is re-raised (as CheckpointSaveError) from the
+    NEXT save_async/wait call — never swallowed, never crashing the
+    training thread mid-step.
+    """
+
+    def __init__(self, saver: CheckpointSaver):
+        self.saver = saver
+        self._thread = None
+        self._error = None
+        self._last_no = None
+        self._lock = threading.Lock()
+
+    @property
+    def in_flight(self):
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def save_async(self, slists, epoch=None, step=None, extra_meta=None):
+        """Snapshot now, write later; returns the checkpoint number the
+        save WILL commit as."""
+        self.wait()                      # one in flight; surfaces errors
+        slists = list(slists)
+        for s in slists:
+            s.snapshot()
+        no = self.saver.last_checkpoint_dir_no() + 1
+
+        def run():
+            try:
+                self.saver.save_checkpoint(
+                    slists, epoch=epoch, step=step, extra_meta=extra_meta,
+                    no=no, snapshot=False)
+            except BaseException as e:   # surfaced on next save/wait
+                with self._lock:
+                    self._error = e
+
+        self._thread = threading.Thread(
+            target=run, name="ckpt-save-%s" % no, daemon=True)
+        self._thread.start()
+        self._last_no = no
+        return no
+
+    def wait(self):
+        """Barrier: block until the in-flight save (if any) committed;
+        re-raise any background failure."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise CheckpointSaveError(
+                "asynchronous checkpoint save failed: %r" % (err,)
+            ) from err
+        return self._last_no

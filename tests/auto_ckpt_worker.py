@@ -1,0 +1,84 @@
+"""auto_checkpoint drill worker: deterministic static-graph training
+under `incubate.checkpoint.train_epoch_range`, with optional SIGKILL
+mid-epoch (the preemption).  Env knobs:
+
+  ACP_WORKSPACE    checkpoint root (TrainEpochRange keys a subdir by
+                   program hash)
+  ACP_EPOCHS       total epochs the JOB must complete
+  ACP_KILL_EPOCH   epoch at which to SIGKILL ourselves mid-epoch (-1 off)
+  ACP_RESULT       path for the result JSON (written only on completion)
+  ACP_SYNC_SAVE    "1" forces synchronous saves (default async)
+"""
+
+import json
+import os
+import re
+import signal
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
+os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=1"
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.incubate.checkpoint import TrainEpochRange
+
+    ws = os.environ["ACP_WORKSPACE"]
+    epochs = int(os.getenv("ACP_EPOCHS", "6"))
+    kill_epoch = int(os.getenv("ACP_KILL_EPOCH", "-1"))
+    sync_save = os.getenv("ACP_SYNC_SAVE") == "1"
+    steps_per_epoch = 4
+
+    rng = np.random.RandomState(7)
+    G = 16
+    w_true = rng.randn(6, 1).astype(np.float32)
+    data = []
+    for _e in range(epochs):
+        xs = rng.randn(steps_per_epoch, G, 6).astype(np.float32)
+        data.append((xs, xs @ w_true))
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main_p, startup):
+        x = layers.data("x", shape=[-1, 6], append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        pred = layers.fc(layers.fc(x, 16, act="relu"), 1,
+                         param_attr="acp.w2", bias_attr="acp.b2")
+        loss = layers.reduce_mean(layers.square(pred - y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        tr = TrainEpochRange(
+            epochs, checkpoint_dir=ws, main_program=main_p,
+            async_save=not sync_save, verbose=True)
+        for e in tr:
+            for t in range(steps_per_epoch):
+                if e == kill_epoch and t == 2:
+                    os.kill(os.getpid(), signal.SIGKILL)  # preemption
+                xs, ys = data[e]
+                (lv,) = exe.run(main_p, feed={"x": xs[t], "y": ys[t]},
+                                fetch_list=[loss])
+                losses.append(float(np.mean(lv)))
+        final_w = np.asarray(scope.find_var("acp.w2")).tolist()
+
+    with open(os.environ["ACP_RESULT"], "w") as f:
+        json.dump({
+            "losses": losses,
+            "start_epoch": tr.start_epoch,
+            "restored_from": tr.restored_from,
+            "final_w": final_w,
+            "final_loss": losses[-1],
+        }, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,507 @@
+"""incubate.checkpoint subsystem: atomic commits, CRC integrity,
+async saves off the train step, auto-resume, multi-rank discipline.
+
+Reference capability: `python/paddle/fluid/incubate/checkpoint/`
+(auto_checkpoint.py, checkpoint_saver.py) + the crash-safety guarantees
+of Orbax-style async checkpointing (snapshot-then-persist, commit by
+rename)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.fs import LocalFS
+from paddle_tpu.incubate.checkpoint import (
+    AsyncCheckpointSaver,
+    CheckpointLoadError,
+    CheckpointSaveError,
+    CheckpointSaver,
+    HostEmbeddingCheckpoint,
+    StateSnapshot,
+    TrainEpochRange,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "auto_ckpt_worker.py")
+
+
+def _snap(**arrays):
+    return StateSnapshot({k: np.asarray(v) for k, v in arrays.items()})
+
+
+def _corrupt_payload(ckpt_dir):
+    """Truncate the first payload file named in the meta manifest (the
+    torn-write a preemption mid-flush leaves behind)."""
+    with open(os.path.join(ckpt_dir, "meta.json")) as f:
+        meta = json.load(f)
+    fname = sorted(meta["files"])[0]
+    path = os.path.join(ckpt_dir, fname)
+    data = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(data[: max(len(data) // 2, 1)])
+    return fname
+
+
+# ---------------------------------------------------------------------------
+# CheckpointSaver core
+# ---------------------------------------------------------------------------
+
+
+def test_atomic_commit_retention_and_meta(tmp_path):
+    root = str(tmp_path / "ckpts")
+    saver = CheckpointSaver(root=root, max_num_checkpoints=3)
+    for e in range(5):
+        n = saver.save_checkpoint(
+            [_snap(w=np.full((4,), float(e)))], epoch=e,
+            extra_meta={"program_hash": "h"})
+        assert n == e
+    dirs = sorted(os.listdir(root))
+    # retention kept exactly the newest 3; no tmp dirs survive a commit
+    assert dirs == ["checkpoint_2", "checkpoint_3", "checkpoint_4"]
+    meta = json.load(open(os.path.join(root, "checkpoint_4", "meta.json")))
+    assert meta["epoch"] == 4 and meta["program_hash"] == "h"
+    rec = meta["files"]["payload.npz"]
+    assert rec["size"] > 0 and 0 <= rec["crc32"] <= 0xFFFFFFFF
+    assert saver.get_checkpoint_no() == 4
+
+    out = StateSnapshot()
+    m = saver.load_checkpoint([out])
+    assert m["no"] == 4
+    np.testing.assert_allclose(out.arrays["w"], 4.0)
+
+
+def test_corrupt_checkpoint_skipped_and_all_corrupt_raises(tmp_path):
+    root = str(tmp_path / "ckpts")
+    saver = CheckpointSaver(root=root, max_num_checkpoints=5)
+    saver.save_checkpoint([_snap(w=np.arange(3.0))], epoch=0)
+    saver.save_checkpoint([_snap(w=np.arange(3.0) + 10)], epoch=1)
+    _corrupt_payload(os.path.join(root, "checkpoint_1"))
+
+    skips = []
+    out = StateSnapshot()
+    meta = saver.load_checkpoint(
+        [out], on_skip=lambda n, why: skips.append((n, why)))
+    # the torn newest was skipped, the previous COMMITTED one loads
+    assert [n for n, _ in skips] == [1]
+    assert meta["epoch"] == 0
+    np.testing.assert_allclose(out.arrays["w"], np.arange(3.0))
+
+    _corrupt_payload(os.path.join(root, "checkpoint_0"))
+    with pytest.raises(CheckpointLoadError):
+        saver.load_checkpoint([StateSnapshot()])
+
+
+def test_crash_mid_save_leaves_no_visible_checkpoint(tmp_path):
+    """A serialize() failure must not leave anything the load path (or
+    a numbering scan) could mistake for a checkpoint."""
+    root = str(tmp_path / "ckpts")
+    saver = CheckpointSaver(root=root, max_num_checkpoints=3)
+
+    class Boom(StateSnapshot):
+        def serialize(self, path):
+            super().serialize(path)
+            raise IOError("disk gone")
+
+    with pytest.raises(IOError):
+        saver.save_checkpoint([Boom({"w": np.ones(2)})], epoch=0)
+    assert saver.get_checkpoint_no() == -1
+    assert saver.load_checkpoint([StateSnapshot()]) is None
+    # stale tmp dirs from a hard crash are GC'd once old enough
+    stale = os.path.join(root, ".tmp_checkpoint_9.dead")
+    os.makedirs(stale)
+    os.utime(stale, (time.time() - 7200, time.time() - 7200))
+    saver.gc_stale_tmp()
+    assert not os.path.exists(stale)
+
+
+# ---------------------------------------------------------------------------
+# Async path
+# ---------------------------------------------------------------------------
+
+
+class SlowFS(LocalFS):
+    """LocalFS whose commit rename stalls — a slow remote mount."""
+
+    def __init__(self, delay):
+        self.delay = delay
+
+    def mv(self, src, dst):
+        time.sleep(self.delay)
+        super().mv(src, dst)
+
+
+class FailFS(LocalFS):
+    def mv(self, src, dst):
+        raise IOError("quota exceeded")
+
+
+def test_async_save_keeps_train_step_off_the_write_path(tmp_path):
+    """Acceptance: a step issued during an in-flight save must not block
+    on FS I/O.  The fake FS stalls the commit 1.5s; the step (and the
+    save_async call itself) complete orders of magnitude faster."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 4], append_batch_size=False)
+        y = layers.fc(x, 4, param_attr="as.w", bias_attr="as.b")
+        loss = layers.reduce_mean(layers.square(y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    feed = {"x": np.ones((2, 4), np.float32)}
+    delay = 1.5
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss])  # compile outside timing
+
+        saver = CheckpointSaver(root=str(tmp_path / "c"), fs=SlowFS(delay),
+                                max_num_checkpoints=2)
+        async_saver = AsyncCheckpointSaver(saver)
+        snap = StateSnapshot.from_program(main, scope)
+
+        t0 = time.perf_counter()
+        async_saver.save_async([snap], epoch=0)
+        t_issue = time.perf_counter() - t0
+        assert t_issue < delay / 3, t_issue  # snapshot only, no FS wait
+
+        t0 = time.perf_counter()
+        exe.run(main, feed=feed, fetch_list=[loss])
+        t_step = time.perf_counter() - t0
+        assert t_step < delay / 3, t_step
+        assert async_saver.in_flight  # the save really was concurrent
+
+        n = async_saver.wait()
+        assert n == 0 and saver.get_checkpoint_no() == 0
+
+
+def test_async_error_surfaces_on_next_save_or_wait(tmp_path):
+    saver = CheckpointSaver(root=str(tmp_path / "c"), fs=FailFS(),
+                            max_num_checkpoints=2)
+    a = AsyncCheckpointSaver(saver)
+    a.save_async([_snap(w=np.ones(2))], epoch=0)
+    with pytest.raises(CheckpointSaveError, match="quota"):
+        a.wait()
+    # error is consumed once, not sticky
+    a.wait()
+    a.save_async([_snap(w=np.ones(2))], epoch=1)
+    with pytest.raises(CheckpointSaveError):
+        a.save_async([_snap(w=np.ones(2))], epoch=2)
+
+
+def test_async_snapshot_isolated_from_mutation(tmp_path):
+    """The snapshot is taken at save_async time: mutating the source
+    arrays afterwards must not leak into the committed payload."""
+    w = np.zeros(4)
+    scope = fluid.Scope()
+    scope.set("w", w)
+    saver = CheckpointSaver(root=str(tmp_path / "c"), fs=SlowFS(0.3),
+                            max_num_checkpoints=2)
+    a = AsyncCheckpointSaver(saver)
+    a.save_async([StateSnapshot.from_scope(scope, ["w"])], epoch=0)
+    scope.set("w", np.full(4, 9.0))      # train step mutates state
+    a.wait()
+    out = StateSnapshot()
+    saver.load_checkpoint([out])
+    np.testing.assert_allclose(out.arrays["w"], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Multi-rank discipline & host-embedding shards
+# ---------------------------------------------------------------------------
+
+
+def test_rank0_commits_other_ranks_barrier(tmp_path):
+    from paddle_tpu.distributed.monitor import BarrierMonitor
+
+    root = str(tmp_path / "shared_ckpt")
+    bws = str(tmp_path / "barriers")
+    results = {}
+
+    def run_rank(rank):
+        barrier = BarrierMonitor(bws, worker_id=rank, worker_num=2,
+                                 timeout_s=30.0)
+        saver = CheckpointSaver(root=root, max_num_checkpoints=2,
+                                trainer_id=rank, num_trainers=2,
+                                barrier=barrier)
+        snap = StateSnapshot({"shard%d" % rank: np.full(3, float(rank))},
+                             filename="shard_rank%d.npz" % rank)
+        results[rank] = saver.save_checkpoint([snap], epoch=0)
+
+    threads = [threading.Thread(target=run_rank, args=(r,))
+               for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert results == {0: 0, 1: 0}
+    d = os.path.join(root, "checkpoint_0")
+    meta = json.load(open(os.path.join(d, "meta.json")))
+    # rank 0 merged BOTH ranks' manifests before the single commit
+    assert set(meta["files"]) == {"shard_rank0.npz", "shard_rank1.npz"}
+    assert os.path.exists(os.path.join(d, "shard_rank1.npz"))
+    # and the commit is valid end-to-end
+    out = StateSnapshot(filename="shard_rank1.npz")
+    CheckpointSaver(root=root, max_num_checkpoints=2).load_checkpoint([out])
+    np.testing.assert_allclose(out.arrays["shard1"], 1.0)
+
+
+def test_host_embedding_saves_sharded_per_rank(tmp_path):
+    from paddle_tpu.fluid.host_embedding import HostEmbedding
+
+    table = HostEmbedding("emb", num_rows=32, dim=4, seed=1)
+    before = table._rows.copy()
+    saver = CheckpointSaver(root=str(tmp_path / "c"), max_num_checkpoints=2)
+    saver.save_checkpoint([HostEmbeddingCheckpoint([table])], epoch=0)
+    d = os.path.join(str(tmp_path / "c"), "checkpoint_0")
+    assert os.path.exists(os.path.join(d, "hostemb_emb_rank0.npz"))
+
+    table._rows[:] = 0.0
+    saver.load_checkpoint([HostEmbeddingCheckpoint([table])])
+    np.testing.assert_allclose(table._rows, before)
+
+
+# ---------------------------------------------------------------------------
+# train_epoch_range / auto-resume
+# ---------------------------------------------------------------------------
+
+
+def _build_linreg(seed=5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 6], append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        pred = layers.fc(x, 1, param_attr="tr.w", bias_attr="tr.b")
+        loss = layers.reduce_mean(layers.square(pred - y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def test_train_epoch_range_without_dir_is_plain_range():
+    main, startup, _ = _build_linreg()
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    os.environ.pop("PADDLE_TPU_CHECKPOINT_DIR", None)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        from paddle_tpu.incubate.checkpoint import train_epoch_range
+
+        assert list(train_epoch_range(4, main_program=main)) == [0, 1, 2, 3]
+
+
+def test_train_epoch_range_resumes_and_keys_by_program_hash(tmp_path):
+    ws = str(tmp_path)
+    main, startup, loss = _build_linreg()
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 6).astype(np.float32)
+    ys = (xs @ rng.randn(6, 1)).astype(np.float32)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        tr = TrainEpochRange(3, checkpoint_dir=ws, main_program=main,
+                             async_save=False)
+        seen = []
+        for e in tr:
+            seen.append(e)
+            exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        assert seen == [0, 1, 2]
+        w_end = np.asarray(scope.find_var("tr.w")).copy()
+
+    # same program, fresh process state: silently fast-forwards past the
+    # completed epochs and restores the trained weights
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe.run(startup)
+        tr2 = TrainEpochRange(3, checkpoint_dir=ws, main_program=main,
+                              async_save=False)
+        assert tr2.restored_from == 2 and tr2.start_epoch == 3
+        assert list(tr2) == []
+        np.testing.assert_allclose(
+            np.asarray(scope2.find_var("tr.w")), w_end)
+
+    # a DIFFERENT program hashes to a different key: no false resume
+    main_b, startup_b, _ = _build_linreg(seed=6)
+    with fluid.program_guard(main_b, startup_b):
+        extra = layers.fc(layers.data("x2", shape=[-1, 2],
+                                      append_batch_size=False), 2)
+        del extra
+    scope3 = fluid.Scope()
+    with fluid.scope_guard(scope3):
+        exe.run(startup_b)
+        tr3 = TrainEpochRange(3, checkpoint_dir=ws, main_program=main_b,
+                              async_save=False)
+        assert tr3.restored_from == -1 and tr3.start_epoch == 0
+        assert tr3.name != tr2.name
+
+
+def _run_worker(ws, result, kill_epoch=-1, epochs=6):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ACP_WORKSPACE"] = ws
+    env["ACP_EPOCHS"] = str(epochs)
+    env["ACP_KILL_EPOCH"] = str(kill_epoch)
+    env["ACP_RESULT"] = result
+    return subprocess.run([sys.executable, WORKER], env=env, timeout=300,
+                          capture_output=True, text=True)
+
+
+def test_sigkill_and_restart_resumes_from_last_committed(tmp_path):
+    """Acceptance drill: SIGKILL a run mid-epoch, corrupt the newest
+    checkpoint on top (the partial the preemption could have left),
+    restart — the job resumes from the last COMMITTED checkpoint and
+    reaches the exact final loss of an uninterrupted control run."""
+    control_ws = str(tmp_path / "control")
+    control_res = str(tmp_path / "control.json")
+    p = _run_worker(control_ws, control_res)
+    assert p.returncode == 0, p.stderr
+    control = json.load(open(control_res))
+    assert control["restored_from"] == -1
+
+    ws = str(tmp_path / "faulted")
+    res = str(tmp_path / "faulted.json")
+    p = _run_worker(ws, res, kill_epoch=4)
+    assert p.returncode != 0          # SIGKILL'd itself mid-epoch 4
+    assert not os.path.exists(res)    # died before any result
+
+    # the committed checkpoints survived the kill; wound the newest one
+    # to stand in for a torn in-flight write
+    (key,) = os.listdir(ws)
+    root = os.path.join(ws, key)
+    ckpts = sorted((d for d in os.listdir(root)
+                    if d.startswith("checkpoint_")),
+                   key=lambda d: int(d.rsplit("_", 1)[1]))
+    assert ckpts, "no checkpoint committed before the kill"
+    corrupt_dir = os.path.join(root, ckpts[-1])
+    _corrupt_payload(corrupt_dir)
+
+    p = _run_worker(ws, res)
+    assert p.returncode == 0, p.stderr
+    out = json.load(open(res))
+    # resumed from a COMMITTED checkpoint (the corrupt one was skipped)
+    assert out["restored_from"] >= 0
+    assert out["start_epoch"] == out["restored_from"] + 1
+    assert "skipping" in p.stderr
+    # and the resumed trajectory is bit-for-bit the control's tail
+    np.testing.assert_allclose(out["final_loss"], control["final_loss"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(out["final_w"], control["final_w"],
+                               rtol=1e-6)
+    n = len(out["losses"])
+    np.testing.assert_allclose(out["losses"], control["losses"][-n:],
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# hapi ModelCheckpoint wiring
+# ---------------------------------------------------------------------------
+
+
+class _FakeModel:
+    def __init__(self, w):
+        self.w = {"w": np.asarray(w)}
+
+    def get_weights(self):
+        return {k: v.copy() for k, v in self.w.items()}
+
+    def set_weights(self, weights):
+        self.w = {k: np.asarray(v) for k, v in weights.items()}
+
+
+def test_hapi_model_checkpoint_async_and_load_latest(tmp_path):
+    from paddle_tpu.hapi.callbacks import ModelCheckpoint
+
+    m = _FakeModel(np.zeros(3))
+    mc = ModelCheckpoint(save_dir=str(tmp_path / "mc"),
+                         max_num_checkpoints=2, async_save=True)
+    mc.set_model(m)
+    for epoch in range(4):
+        m.w["w"] = m.w["w"] + 1.0
+        mc.on_epoch_end(epoch)
+    mc.on_train_end()
+    # retention held and commits are atomic checkpoint_<n> dirs
+    dirs = sorted(os.listdir(str(tmp_path / "mc")))
+    assert dirs == ["checkpoint_2", "checkpoint_3"]
+
+    m2 = _FakeModel(np.zeros(3))
+    meta = ModelCheckpoint(save_dir=str(tmp_path / "mc"),
+                           max_num_checkpoints=2).load_latest(m2)
+    assert meta["epoch"] == 3
+    np.testing.assert_allclose(m2.w["w"], 4.0)
+
+def test_refuses_to_overwrite_committed_checkpoint(tmp_path):
+    """shutil.move onto an existing dir would NEST the tmp inside it and
+    report success; the saver must refuse instead (review fix)."""
+    saver = CheckpointSaver(root=str(tmp_path / "c"), max_num_checkpoints=3)
+    saver.save_checkpoint([_snap(w=np.zeros(2))], epoch=0)
+    with pytest.raises(CheckpointSaveError, match="refusing"):
+        saver.save_checkpoint([_snap(w=np.ones(2))], epoch=9, no=0)
+    out = StateSnapshot()
+    assert saver.load_checkpoint([out])["epoch"] == 0  # intact
+    np.testing.assert_allclose(out.arrays["w"], 0.0)
+
+
+def test_multirank_save_retry_reuses_barrier_ids(tmp_path):
+    """A failed collective save leaves residue (barrier markers, the
+    attempt pointer, tmp payloads) for checkpoint number n; a retry
+    reusing n must neither wedge on 'barrier id already used' nor merge
+    the dead attempt's files (review fix: per-attempt tokens scoping
+    the tmp dir + barrier tags, withdrawn on failure)."""
+    from paddle_tpu.distributed.monitor import BarrierMonitor
+
+    root = str(tmp_path / "shared")
+    bws = str(tmp_path / "b")
+
+    class Boom(StateSnapshot):
+        def serialize(self, path):
+            raise IOError("rank 1 disk error")
+
+    def make(rank):
+        return CheckpointSaver(
+            root=root, max_num_checkpoints=2, trainer_id=rank,
+            num_trainers=2,
+            barrier=BarrierMonitor(bws, worker_id=rank, worker_num=2,
+                                   timeout_s=3.0))
+
+    def attempt(rank, slist, errs, results):
+        try:
+            results[rank] = make(rank).save_checkpoint(slist, epoch=0)
+        except BaseException as e:
+            errs[rank] = e
+
+    # attempt 1: rank 1 dies serializing; rank 0 times out on the barrier
+    errs, results = {}, {}
+    ts = [threading.Thread(target=attempt, args=(
+        r, [Boom({}) if r == 1 else StateSnapshot(
+            {"a": np.zeros(2)}, filename="shard_rank0.npz")],
+        errs, results)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert 0 in errs and 1 in errs          # both attempts failed loudly
+
+    # attempt 2: same checkpoint number, same barrier ids — must succeed
+    errs, results = {}, {}
+    ts = [threading.Thread(target=attempt, args=(
+        r, [StateSnapshot({"a%d" % r: np.full(2, float(r))},
+                          filename="shard_rank%d.npz" % r)],
+        errs, results)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert errs == {}, errs
+    assert results == {0: 0, 1: 0}
+    meta = json.load(open(os.path.join(root, "checkpoint_0", "meta.json")))
+    assert set(meta["files"]) == {"shard_rank0.npz", "shard_rank1.npz"}

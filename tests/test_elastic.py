@@ -72,6 +72,14 @@ def test_kill_detect_restart_converge(tmp_path):
     # fault
     n0 = get_last_checkpoint_no(os.path.join(ws, "ckpt"))
     assert n0 >= 0
+    # ... and it was committed through incubate.checkpoint: an
+    # atomically-renamed dir carrying a CRC manifest, so the restarted
+    # generation can never resume from a torn write
+    with open(os.path.join(ws, "ckpt", "checkpoint_%d" % n0,
+                           "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["files"] and all(
+        "crc32" in rec for rec in meta["files"].values())
 
     # generation 1 (the "replacement hardware"): resumes from the last
     # checkpoint_N and completes the job

@@ -526,3 +526,104 @@ def test_sensitivity_ratio_allocation():
         }
         ratios = get_ratios_by_sensitivity(sens, 0.25, main, scope)
     assert ratios["src2.w"] > ratios["src1.w"]
+
+def test_compressor_kill_and_resume_same_final_metric(tmp_path):
+    """cf. reference compressor.py:238 checkpoint flow: a compression
+    run killed mid-way resumes from the last per-epoch checkpoint (via
+    incubate.checkpoint) and lands on the SAME final metric/weights as
+    an uninterrupted run — including a prune that already rewrote the
+    program before the kill."""
+    from paddle_tpu.fluid.contrib.slim.core import Compressor
+    from paddle_tpu.fluid.contrib.slim.prune import UniformPruneStrategy
+
+    imgs, labels = _digits(192, seed=4)
+
+    def build():
+        # unique_name.guard: every (re)build names vars identically, as
+        # a fresh process would — resume matches the checkpointed names
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 31
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, startup):
+                img = layers.data("img", shape=[1, 28, 28])
+                label = layers.data("label", shape=[1], dtype="int64")
+                loss, acc, _ = _lenet(img, label, prefix="kr")
+                MomentumOptimizer(0.02, 0.9).minimize(loss)
+        return main, startup, loss, acc
+
+    def run(ckpt_path, die_at_epoch=None):
+        main, startup, loss, acc = build()
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        accs = []
+
+        def train_epoch(ctx):
+            if die_at_epoch is not None and ctx.epoch == die_at_epoch:
+                raise KeyboardInterrupt("simulated preemption")
+            accs.append(np.mean(_train(exe, ctx.train_program, imgs,
+                                       labels, loss, acc, epochs=1)))
+
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            strat = UniformPruneStrategy(
+                start_epoch=1, target_ratio=0.3,
+                pruned_params=["krc1.w", "krc2.w"])
+            c = Compressor(scope, main, startup_program=startup,
+                           train_epoch_fn=train_epoch, epochs=4,
+                           checkpoint_path=ckpt_path)
+            c.add_strategy(strat)
+            c.run()
+            w = np.asarray(scope.find_var("krc1.w")).copy()
+        return accs, w, strat
+
+    control_accs, control_w, _ = run(str(tmp_path / "control"))
+
+    ckpt = str(tmp_path / "faulted")
+    with pytest.raises(KeyboardInterrupt):
+        run(ckpt, die_at_epoch=2)          # epochs 0,1 checkpointed
+    # fresh process state, same pipeline: resumes at epoch 2 (the prune
+    # from epoch 1 comes back via the checkpointed program + state)
+    resumed_accs, resumed_w, strat2 = run(ckpt)
+    assert len(resumed_accs) == 2          # only epochs 2,3 re-ran
+    assert strat2.ratios is not None       # strategy state restored
+    assert resumed_w.shape == control_w.shape
+    np.testing.assert_allclose(resumed_w, control_w, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(resumed_accs[-1], control_accs[-1],
+                               rtol=1e-5)
+
+def test_compressor_refuses_wrong_program_checkpoint(tmp_path):
+    """Resuming a checkpoint dir written by a DIFFERENT model must fail
+    loudly (program-hash guard), never silently train the wrong
+    program."""
+    from paddle_tpu.fluid.contrib.slim.core import Compressor
+    from paddle_tpu.incubate.checkpoint import CheckpointLoadError
+
+    def build(width):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 41
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, startup):
+                x = layers.data("x", shape=[-1, 4],
+                                append_batch_size=False)
+                loss = layers.reduce_mean(
+                    layers.square(layers.fc(x, width)))
+        return main, startup
+
+    ckpt = str(tmp_path / "c")
+    main_a, startup_a = build(3)
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup_a)
+        Compressor(scope, main_a, startup_program=startup_a,
+                   train_epoch_fn=lambda ctx: None, epochs=1,
+                   checkpoint_path=ckpt).run()
+
+    main_b, startup_b = build(5)           # different model, same dir
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe.run(startup_b)
+        with pytest.raises(CheckpointLoadError):
+            Compressor(scope_b, main_b, startup_program=startup_b,
+                       train_epoch_fn=lambda ctx: None, epochs=1,
+                       checkpoint_path=ckpt).run()
