@@ -211,3 +211,102 @@ record_event = RecordEvent
 
 def cuda_profiler(*a, **kw):
     raise RuntimeError("cuda_profiler is CUDA-only; use fluid.profiler.profiler")
+
+
+# ---------------------------------------------------------------------------
+# Lightweight in-process metrics (serving observability)
+# ---------------------------------------------------------------------------
+#
+# The trace machinery above answers "where did one run spend its time";
+# production serving needs cheap always-on aggregates (reference
+# platform/profiler.cc kept per-event [calls,total,min,max] rows — the
+# same aggregation, kept live instead of post-hoc from a trace).  These
+# primitives back `InferenceServer.summary()` and its `/stats` endpoint.
+
+
+class Counter:
+    """Thread-safe monotonic counter."""
+
+    def __init__(self, name=""):
+        import threading
+
+        self.name = name
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._n += n
+
+    @property
+    def value(self):
+        return self._n
+
+    def summary(self):
+        return {"name": self.name, "value": self._n}
+
+
+class Histogram:
+    """Thread-safe histogram: exact count/sum/min/max plus percentiles
+    from a bounded reservoir (algorithm R, seeded — bounded memory under
+    unbounded traffic, deterministic in tests)."""
+
+    def __init__(self, name="", max_samples=4096):
+        import random
+        import threading
+
+        self.name = name
+        self._max = max(int(max_samples), 1)
+        self._rng = random.Random(0x5eed)
+        self._lock = threading.Lock()
+        self._samples = []
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            if len(self._samples) < self._max:
+                self._samples.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self._max:
+                    self._samples[j] = v
+
+    @staticmethod
+    def _rank(s, p):
+        k = min(len(s) - 1, max(0, int(round((p / 100.0) * (len(s) - 1)))))
+        return s[k]
+
+    def percentile(self, p):
+        """p in [0, 100]; nearest-rank over the reservoir; None if empty."""
+        with self._lock:
+            if not self._samples:
+                return None
+            s = sorted(self._samples)
+        return self._rank(s, p)
+
+    def summary(self):
+        with self._lock:  # one consistent snapshot, one sort
+            if self.count == 0:
+                return {"name": self.name, "count": 0}
+            count, total = self.count, self.sum
+            mn, mx = self.min, self.max
+            s = sorted(self._samples)
+        return {
+            "name": self.name,
+            "count": count,
+            "sum": total,
+            "mean": total / count,
+            "min": mn,
+            "max": mx,
+            "p50": self._rank(s, 50),
+            "p95": self._rank(s, 95),
+            "p99": self._rank(s, 99),
+        }

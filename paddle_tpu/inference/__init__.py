@@ -11,6 +11,13 @@ executable; per-request runs are cached-executable calls with device-
 resident weights (NaiveExecutor's no-scope-churn property).  Portable
 serialization uses jax.export (StableHLO) for serving stacks that load
 models without Python (`export_stablehlo`/`load_stablehlo`).
+
+Serving hot path (`InferenceServer`): shape-bucketed dynamic batching
+(pad coalesced batches to a bucket ladder so ragged traffic hits a
+fixed set of compiled executables), pipelined dispatch/completion over
+XLA's async dispatch queue, AOT `warmup()` plus jax's persistent
+compilation cache (`AnalysisConfig.enable_compilation_cache`), and
+live stats via `summary()` / `GET /stats`.
 """
 
 from .predictor import (  # noqa: F401

@@ -23,6 +23,7 @@ class AnalysisConfig:
         self._use_tpu = True
         self._memory_optim = True
         self._int8 = False
+        self._compile_cache_dir = None
 
     def enable_use_gpu(self, memory_pool_mb=100, device_id=0):
         pass  # device comes from the jax backend (TPU/CPU)
@@ -35,6 +36,20 @@ class AnalysisConfig:
 
     def enable_memory_optim(self):
         self._memory_optim = True
+
+    def enable_compilation_cache(self, cache_dir=None):
+        """Persist compiled executables across process restarts (jax's
+        persistent compilation cache): a server restart re-loads the
+        bucket-ladder executables from disk instead of recompiling them.
+        cf. the executor's in-process program cache — this is its
+        on-disk, cross-restart analogue for the serving path.
+
+        NOTE: jax's cache is process-global, so creating a Predictor
+        from this config enables on-disk caching for EVERY compile in
+        the process (with the size/compile-time thresholds zeroed).
+        Intended for dedicated serving processes."""
+        self._compile_cache_dir = cache_dir or os.path.join(
+            os.path.expanduser("~"), ".cache", "paddle_tpu_xla_cache")
 
     def enable_int8(self):
         """Weight-only int8 on load (cf. reference
@@ -55,6 +70,28 @@ class Predictor:
         from ..fluid.core.registry import LowerContext
 
         self._config = config
+        if config._compile_cache_dir:
+            os.makedirs(config._compile_cache_dir, exist_ok=True)
+            jax.config.update(
+                "jax_compilation_cache_dir", config._compile_cache_dir)
+            try:
+                # the cache latches its enabled/dir decision at the first
+                # compile; reset so enabling works even after earlier
+                # uncached compiles in this process
+                from jax.experimental.compilation_cache import (
+                    compilation_cache as _cc,
+                )
+
+                _cc.reset_cache()
+            except Exception:
+                pass
+            for knob, val in (
+                    ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                    ("jax_persistent_cache_min_entry_size_bytes", 0)):
+                try:
+                    jax.config.update(knob, val)
+                except Exception:
+                    pass  # older jax without the knob: cache still works
         from ..fluid.executor import Executor
         from ..fluid.core.scope import Scope
 
@@ -108,6 +145,7 @@ class Predictor:
             return [env[n] for n in self._fetch_names]
 
         self._jitted = jax.jit(run_pure)
+        self._signatures = set()
 
     # -- reference-style API -------------------------------------------
     def get_input_names(self):
@@ -116,17 +154,82 @@ class Predictor:
     def get_output_names(self):
         return list(self._fetch_names)
 
+    def _prepare_feed(self, inputs):
+        """Validate + normalize inputs into {name: np.ndarray}.  A list
+        must match feed order/length exactly; a dict must carry exactly
+        the declared feeds (zip used to drop extras silently)."""
+        if isinstance(inputs, dict):
+            unknown = sorted(set(inputs) - set(self._feed_names))
+            missing = sorted(set(self._feed_names) - set(inputs))
+            if unknown or missing:
+                raise ValueError(
+                    "feed dict mismatch: expects feeds %s%s%s"
+                    % (self._feed_names,
+                       ("; missing %s" % missing) if missing else "",
+                       ("; unknown %s" % unknown) if unknown else ""))
+            return {k: np.asarray(v) for k, v in inputs.items()}
+        inputs = list(inputs)
+        if len(inputs) != len(self._feed_names):
+            raise ValueError(
+                "feed list length mismatch: expects %d feeds %s, got %d"
+                % (len(self._feed_names), self._feed_names, len(inputs)))
+        return {n: np.asarray(v) for n, v in zip(self._feed_names, inputs)}
+
+    def _note_signature(self, feed_vals):
+        self._signatures.add(tuple(
+            (k, v.shape, str(v.dtype)) for k, v in sorted(feed_vals.items())))
+
+    @property
+    def compile_count(self):
+        """Number of XLA executables built by this predictor (one per
+        distinct feed signature) — the serving-path compile-storm gauge."""
+        try:
+            return int(self._jitted._cache_size())
+        except Exception:
+            return len(self._signatures)
+
     def run(self, inputs):
         """inputs: list of arrays (feed order) or {name: array}.
         Returns list of numpy arrays in fetch order."""
-        if isinstance(inputs, dict):
-            feed_vals = {k: np.asarray(v) for k, v in inputs.items()}
-        else:
-            feed_vals = {
-                n: np.asarray(v) for n, v in zip(self._feed_names, inputs)
-            }
+        feed_vals = self._prepare_feed(inputs)
+        self._note_signature(feed_vals)
         outs = self._jitted(self._weights, feed_vals)
         return [np.asarray(o) for o in outs]
+
+    def run_async(self, inputs):
+        """Like run() but returns the jitted call's device arrays without
+        materializing them: the call enqueues on XLA's async dispatch
+        stream and returns immediately, so the caller can overlap
+        host-side work (coalescing the next batch) with device execution.
+        Convert with np.asarray to block until the values are ready —
+        device errors also surface there."""
+        feed_vals = self._prepare_feed(inputs)
+        self._note_signature(feed_vals)
+        return self._jitted(self._weights, feed_vals)
+
+    def warmup(self, bucket_specs):
+        """AOT-compile the executables for a set of feed signatures before
+        traffic arrives (server-start warmup over the bucket ladder).
+
+        bucket_specs: iterable of {feed_name: spec} dicts where spec is a
+        shape tuple (float32 assumed), a (shape, dtype) pair, or a
+        ready-made array.  Blocks until every executable is built;
+        returns the resulting compile_count.
+        """
+        import jax
+
+        for spec in bucket_specs:
+            feed = {}
+            for name, s in spec.items():
+                if isinstance(s, np.ndarray):
+                    feed[name] = s
+                elif (isinstance(s, (tuple, list)) and len(s) == 2
+                        and not isinstance(s[1], (int, np.integer))):
+                    feed[name] = np.zeros(tuple(s[0]), np.dtype(s[1]))
+                else:
+                    feed[name] = np.zeros(tuple(s), np.float32)
+            jax.block_until_ready(self.run_async(feed))
+        return self.compile_count
 
 
 def create_predictor(config: AnalysisConfig) -> Predictor:

@@ -1,5 +1,5 @@
-"""Serving runner: concurrent request execution + dynamic batching + an
-HTTP JSON front end.
+"""Serving runner: shape-bucketed dynamic batching + pipelined dispatch
++ an HTTP JSON front end.
 
 Capability parity: reference serving surface = `AnalysisPredictor` cloned
 per request over a shared program (`analysis_predictor.cc`,
@@ -7,34 +7,77 @@ per request over a shared program (`analysis_predictor.cc`,
 (`inference/capi/`) and Go client (`go/paddle/`) for cross-language
 callers.  TPU-first redesign:
 
-* the Predictor is already compile-once/pure — requests need no scope
-  cloning, only a thread-safe queue in front of the single jitted
-  executable (XLA serializes device execution anyway);
+* the Predictor is compile-once/pure — requests need no scope cloning,
+  only a thread-safe queue in front of the jitted executable;
 * **dynamic batching** concatenates compatible waiting requests along
-  dim 0 and splits the results — the TPU answer to request throughput
-  (big batches feed the MXU) where the reference ran concurrent CPU
-  streams;
+  dim 0 — the TPU answer to request throughput (big batches feed the
+  MXU) where the reference ran concurrent CPU streams;
+* **shape bucketing**: a ragged traffic mix (any coalesced batch size,
+  variable declared feature dims like sequence length) would make
+  `jax.jit` compile one XLA executable per unique total shape — a
+  compile storm with multi-second tails.  Padding the batch dim to a
+  small bucket ladder (and declared ragged dims to their own ladders)
+  keeps a fixed set of executables hot; outputs are sliced back per
+  request, and an optional auto-generated validity mask feed tells the
+  model which rows/positions are real.  `warmup()` AOT-builds the
+  ladder at server start (TF-Serving/Clipper adaptive batching, redone
+  TPU-first);
+* **pipelined dispatch**: the jitted call returns device futures (XLA
+  async dispatch), so a dispatch thread coalesces/pads/enqueues batch
+  N+1 while a completion thread materializes batch N — the device
+  queue stays fed during all host-side work;
 * the cross-language story is the HTTP/JSON endpoint: any language
   (incl. C and Go) speaks it without binding glue, subsuming
   capi/go-client capability for this framework (documented non-goal:
   an in-process C ABI).
+
+Batch padding assumes the served program is row-independent along dim 0
+(true for `for_test` inference programs: BN uses running stats, every op
+maps rows to rows).  Pass ``batch_buckets=False`` to opt out for models
+that couple rows across the batch.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import queue
 import threading
+import time
+from collections import OrderedDict, deque
 
 import numpy as np
 
+from ..fluid.profiler import Counter, Histogram
+
 
 class _Request:
-    def __init__(self, inputs):
+    __slots__ = ("inputs", "event", "outputs", "error", "error_type",
+                 "seq", "t_enq", "abandoned")
+
+    def __init__(self, inputs, seq):
         self.inputs = inputs
         self.event = threading.Event()
         self.outputs = None
         self.error = None
+        self.error_type = None
+        self.seq = seq
+        self.t_enq = time.monotonic()
+        self.abandoned = False   # waiter timed out; don't serve/measure
+
+    @property
+    def rows(self):
+        return self.inputs[next(iter(self.inputs))].shape[0]
+
+
+def _default_ladder(max_batch):
+    """Powers of two up to max_batch, always ending at max_batch."""
+    ladder, b = [], 1
+    while b < max_batch:
+        ladder.append(b)
+        b *= 2
+    ladder.append(max_batch)
+    return ladder
 
 
 class InferenceServer:
@@ -43,121 +86,418 @@ class InferenceServer:
     Usage::
 
         server = InferenceServer(predictor, max_batch=32,
-                                 batch_timeout_ms=2)
+                                 batch_timeout_ms=2,
+                                 ragged_dims={"x": {1: [64, 128, 256]}})
         server.start()
+        server.warmup({"x": np.zeros((1, 64), np.float32)})
         outs = server.infer({"x": np.zeros((1, 8), np.float32)})
         server.serve_http(port=8080)   # blocking HTTP/JSON endpoint
+
+    * ``batch_buckets``: ladder of padded batch sizes.  None (default)
+      = powers of two up to ``max_batch``; a list pins an explicit
+      ladder; ``False``/``[]`` disables batch padding (every coalesced
+      size compiles its own executable — pre-bucketing behavior).
+    * ``ragged_dims``: ``{feed_name: {axis: [bucket, ...]}}`` declares
+      feature dims that vary per request (e.g. sequence length, axis
+      counted on the full array so 1 is the first feature dim).
+      Requests differing only on declared ragged axes share a batch;
+      each ragged axis pads up to the smallest bucket that fits the
+      group (zero fill).  Outputs are sliced along the batch dim only.
+    * ``mask_feed``: name of an extra feed the server synthesizes as a
+      float32 validity mask of shape (padded_batch, padded_extent) over
+      the FIRST declared ragged feed/axis: 1.0 where a row/position is
+      real, 0.0 where padding.  For models whose ops are not neutral to
+      zero padding.
+    * ``pipeline_depth``: max dispatched-but-unmaterialized batches in
+      flight (bounds device queue + host output backlog).
     """
 
-    def __init__(self, predictor, max_batch=32, batch_timeout_ms=2.0):
+    def __init__(self, predictor, max_batch=32, batch_timeout_ms=2.0,
+                 batch_buckets=None, ragged_dims=None, mask_feed=None,
+                 pipeline_depth=2):
         self._pred = predictor
         self._max_batch = max(int(max_batch), 1)
         self._timeout = max(batch_timeout_ms, 0.0) / 1e3
+        if batch_buckets is None:
+            self._batch_buckets = _default_ladder(self._max_batch)
+        elif not batch_buckets:          # False / [] -> no batch padding
+            self._batch_buckets = []
+        else:
+            self._batch_buckets = sorted(int(b) for b in batch_buckets)
+        self._ragged = {
+            name: {int(ax): sorted(int(b) for b in buckets)
+                   for ax, buckets in axes.items()}
+            for name, axes in (ragged_dims or {}).items()
+        }
+        for name, axes in self._ragged.items():
+            for ax in axes:
+                if ax < 1:
+                    raise ValueError(
+                        "ragged_dims[%r] axis %d: the batch dim (0) is "
+                        "padded by batch_buckets; ragged axes must be >= 1"
+                        % (name, ax))
+        self._mask_feed = mask_feed
+        if mask_feed is not None and not self._ragged:
+            raise ValueError("mask_feed requires ragged_dims")
         self._q: queue.Queue = queue.Queue()
-        self._worker = None
+        self._done_q: queue.Queue = queue.Queue(
+            maxsize=max(int(pipeline_depth), 1))
+        self._pending = OrderedDict()    # signature -> deque[_Request]
+        self._plock = threading.Lock()   # dispatcher mutates, stats read
+        self._seq = itertools.count()
+        self._dispatcher = None
+        self._completer = None
         self._stop = threading.Event()
+        # -- observability (fluid.profiler metric primitives) ----------
+        self._n_requests = Counter("requests")
+        self._n_batches = Counter("batches")
+        self._n_errors = Counter("errors")
+        self._n_abandoned = Counter("abandoned")
+        self._h_queue_depth = Histogram("queue_depth")
+        self._h_batch_size = Histogram("batch_size")
+        self._h_pad_waste = Histogram("padding_waste")
+        self._h_latency_ms = Histogram("latency_ms")
 
     # -- lifecycle -------------------------------------------------------
     def start(self):
-        if self._worker is not None:
+        if self._dispatcher is not None:
             return self
+        # fresh queues on (re)start: a prior stop() left sentinels behind
+        self._q = queue.Queue()
+        self._done_q = queue.Queue(maxsize=self._done_q.maxsize)
         self._stop.clear()
-        self._worker = threading.Thread(target=self._loop, daemon=True)
-        self._worker.start()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="infer-dispatch", daemon=True)
+        self._completer = threading.Thread(
+            target=self._completion_loop, name="infer-complete", daemon=True)
+        self._dispatcher.start()
+        self._completer.start()
         return self
 
     def stop(self):
+        if self._dispatcher is None and self._completer is None:
+            return  # never started / already stopped: nothing to signal
         self._stop.set()
         self._q.put(None)
-        if self._worker is not None:
-            self._worker.join(timeout=5)
-            self._worker = None
+        if self._dispatcher is not None:
+            self._dispatcher.join(timeout=5)
+            self._dispatcher = None
+        # sentinel AFTER the dispatcher exits: every dispatched batch is
+        # already in the done queue, FIFO drains them before the None
+        self._done_q.put(None)
+        if self._completer is not None:
+            self._completer.join(timeout=5)
+            self._completer = None
+
+    def warmup(self, example_inputs):
+        """AOT-compile the full bucket ladder before serving traffic.
+
+        example_inputs: {feed_name: array} with representative non-ragged
+        feature dims (any batch size / ragged extents — both are replaced
+        by bucket values).  Builds one zero feed per (batch bucket x
+        ragged bucket combination) and blocks until all executables
+        exist; returns the predictor's compile_count (None if the
+        predictor exposes no counter)."""
+        example = {k: np.asarray(v) for k, v in example_inputs.items()}
+        batch_ladder = self._batch_buckets or [self._max_batch]
+        ragged_axes = [(name, ax, buckets)
+                       for name, axes in sorted(self._ragged.items())
+                       for ax, buckets in sorted(axes.items())]
+        specs = []
+        for b in batch_ladder:
+            for combo in itertools.product(
+                    *[buckets for _, _, buckets in ragged_axes]):
+                feed = {}
+                for name, arr in example.items():
+                    shape = list(arr.shape)
+                    shape[0] = b
+                    for (rname, ax, _), ext in zip(ragged_axes, combo):
+                        if rname == name:
+                            shape[ax] = ext
+                    feed[name] = np.zeros(tuple(shape), arr.dtype)
+                if self._mask_feed is not None:
+                    feed[self._mask_feed] = self._mask_for(
+                        feed, rows_valid=b)
+                specs.append(feed)
+        if hasattr(self._pred, "warmup"):
+            return self._pred.warmup(specs)
+        for feed in specs:
+            self._pred.run(feed)
+        return getattr(self._pred, "compile_count", None)
 
     # -- client API ------------------------------------------------------
     def infer(self, inputs, timeout=30.0):
         """Blocking single request; inputs {name: array} with a leading
         batch dim.  Thread-safe; requests coalesce into device batches."""
-        if self._worker is None:
+        if self._dispatcher is None:
             raise RuntimeError("call start() first")
-        req = _Request({
-            k: np.asarray(v) for k, v in inputs.items()
-        })
+        arrs = {k: np.asarray(v) for k, v in inputs.items()}
+        if self._mask_feed is not None and self._mask_feed in arrs:
+            raise ValueError(
+                "feed %r is synthesized by the server (mask_feed); do not "
+                "send it" % self._mask_feed)
+        rows = {v.shape[0] if v.ndim else None for v in arrs.values()}
+        if len(rows) != 1 or None in rows:
+            raise ValueError(
+                "all feeds need the same leading batch dim; got %s"
+                % {k: v.shape for k, v in arrs.items()})
+        if hasattr(self._pred, "get_input_names"):
+            expected = set(self._pred.get_input_names())
+            if self._mask_feed is not None:
+                expected.discard(self._mask_feed)
+            if set(arrs) != expected:
+                raise ValueError(
+                    "feed names %s do not match the model's feeds %s"
+                    % (sorted(arrs), sorted(expected)))
+        req = _Request(arrs, next(self._seq))
+        self._n_requests.inc()
         self._q.put(req)
         if not req.event.wait(timeout):
+            req.abandoned = True   # still pending? dispatcher drops it
+            self._n_abandoned.inc()
             raise TimeoutError("inference request timed out")
         if req.error is not None:
-            raise RuntimeError("inference failed: %s" % req.error)
+            # keep the client/server distinction: a ValueError/TypeError
+            # from the predictor (bad shapes/dtypes in the request) stays
+            # that type so the HTTP layer can answer 400, not 500
+            exc_type = (req.error_type
+                        if req.error_type in (ValueError, TypeError)
+                        else RuntimeError)
+            raise exc_type("inference failed: %s" % req.error)
         return req.outputs
 
-    # -- batching loop ---------------------------------------------------
-    def _compatible(self, a, b):
-        """Two requests can share a batch: same keys, same non-batch dims,
-        same dtypes."""
-        if a.inputs.keys() != b.inputs.keys():
-            return False
-        for k in a.inputs:
-            x, y = a.inputs[k], b.inputs[k]
-            if x.shape[1:] != y.shape[1:] or x.dtype != y.dtype:
-                return False
-        return True
+    # -- observability ---------------------------------------------------
+    def summary(self):
+        """Live serving stats (also served by GET /stats)."""
+        with self._plock:
+            pending_rows = sum(
+                r.rows for dq in self._pending.values() for r in dq)
+        return {
+            "requests": self._n_requests.value,
+            "batches": self._n_batches.value,
+            "errors": self._n_errors.value,
+            "abandoned": self._n_abandoned.value,
+            "queue_depth": self._q.qsize() + pending_rows,
+            "inflight_batches": self._done_q.qsize(),
+            "batch_size": self._h_batch_size.summary(),
+            "padding_waste": self._h_pad_waste.summary(),
+            "latency_ms": self._h_latency_ms.summary(),
+            "queue_depth_hist": self._h_queue_depth.summary(),
+            "compile_count": getattr(self._pred, "compile_count", None),
+            "batch_buckets": list(self._batch_buckets),
+            "ragged_dims": {k: {str(ax): list(b) for ax, b in v.items()}
+                            for k, v in self._ragged.items()},
+        }
 
-    def _loop(self):
-        while not self._stop.is_set():
-            req = self._q.get()
-            if req is None:
-                continue
-            group = [req]
-            total = req.inputs[next(iter(req.inputs))].shape[0]
-            # coalesce compatible waiting requests up to max_batch
-            deadline_passed = False
-            while total < self._max_batch and not deadline_passed:
+    def stats(self):
+        """Alias of summary() (the /stats endpoint's payload)."""
+        return self.summary()
+
+    # -- batching: signatures + per-signature pending queues -------------
+    def _signature(self, req):
+        """Requests share a batch iff same feeds, dtypes, and non-batch
+        dims — except declared ragged axes, which are wildcarded (they
+        pad to a common bucket)."""
+        sig = []
+        for k in sorted(req.inputs):
+            v = req.inputs[k]
+            dims = list(v.shape[1:])
+            for ax in self._ragged.get(k, {}):
+                if 1 <= ax <= len(dims):
+                    dims[ax - 1] = None
+            sig.append((k, str(v.dtype), tuple(dims)))
+        return tuple(sig)
+
+    def _enqueue_pending(self, req):
+        with self._plock:
+            self._pending.setdefault(
+                self._signature(req), deque()).append(req)
+
+    def _head_sig(self):
+        """Signature owning the OLDEST pending request: every signature
+        makes progress in arrival order (no head-of-line starvation —
+        the old loop re-queued incompatible requests at the BACK, so a
+        steady compatible stream could starve them forever)."""
+        best_sig, best_seq = None, None
+        for sig, dq in self._pending.items():
+            if dq and (best_seq is None or dq[0].seq < best_seq):
+                best_sig, best_seq = sig, dq[0].seq
+        return best_sig
+
+    def _rows_pending(self, sig):
+        dq = self._pending.get(sig)
+        return sum(r.rows for r in dq) if dq else 0
+
+    def _take_group(self, sig):
+        with self._plock:
+            dq = self._pending.get(sig)
+            if not dq:
+                return []
+            group, total = [], 0
+            while dq and total < self._max_batch:
+                # never overshoot max_batch: an overshot total falls off
+                # the bucket ladder and compiles its own executable (a
+                # single oversized request still dispatches alone,
+                # padded exactly)
+                if group and total + dq[0].rows > self._max_batch:
+                    break
+                r = dq.popleft()
+                if r.abandoned:      # waiter already timed out: drop it
+                    continue         # instead of burning device work
+                group.append(r)
+                total += r.rows
+            if not dq:
+                del self._pending[sig]
+            return group
+
+    # -- stage 1: dispatch (coalesce -> pad -> async device call) --------
+    def _dispatch_loop(self):
+        while True:
+            if not self._pending:
+                if self._stop.is_set():
+                    return
                 try:
-                    nxt = self._q.get(timeout=self._timeout)
+                    req = self._q.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                if req is None:
+                    continue
+                self._enqueue_pending(req)
+            # soak the queue up to the batching timeout while the head
+            # group still has room
+            deadline = time.monotonic() + self._timeout
+            while not self._stop.is_set():
+                if self._rows_pending(self._head_sig()) >= self._max_batch:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
                 except queue.Empty:
                     break
                 if nxt is None:
-                    deadline_passed = True
                     break
-                if self._compatible(group[0], nxt):
-                    group.append(nxt)
-                    total += nxt.inputs[next(iter(nxt.inputs))].shape[0]
-                else:
-                    # different signature: run it in its own group later
-                    self._q.put(nxt)
-                    break
-            self._run_group(group)
+                self._enqueue_pending(nxt)
+            group = self._take_group(self._head_sig())
+            if group:
+                self._dispatch_group(group)
 
-    def _run_group(self, group):
+    def _bucket(self, n, ladder):
+        for b in ladder:
+            if b >= n:
+                return b
+        return n  # beyond the ladder: exact shape (rare oversize batch)
+
+    def _mask_for(self, feed, rows_valid, group=None):
+        """Validity mask over the first DECLARED ragged feed/axis
+        (insertion order): (padded_batch, padded_extent) float32, 1.0
+        where real."""
+        name = next(iter(self._ragged))
+        ax = next(iter(self._ragged[name]))
+        padded = feed[name]
+        mask = np.zeros((padded.shape[0], padded.shape[ax]), np.float32)
+        if group is None:
+            mask[:rows_valid, :] = 1.0
+        else:
+            off = 0
+            for r in group:
+                mask[off:off + r.rows, :r.inputs[name].shape[ax]] = 1.0
+                off += r.rows
+        return mask
+
+    def _dispatch_group(self, group):
         try:
-            if len(group) == 1:
-                feed = group[0].inputs
-            else:
-                feed = {
-                    k: np.concatenate([r.inputs[k] for r in group], axis=0)
-                    for k in group[0].inputs
+            total = sum(r.rows for r in group)
+            padded_rows = self._bucket(total, self._batch_buckets) \
+                if self._batch_buckets else total
+            feed, real_elems, padded_elems = {}, 0, 0
+            for k in group[0].inputs:
+                arrs = [r.inputs[k] for r in group]
+                real_elems += sum(a.size for a in arrs)
+                ragged = self._ragged.get(k, {})
+                targets = {
+                    ax: self._bucket(max(a.shape[ax] for a in arrs),
+                                     buckets)
+                    for ax, buckets in ragged.items()
                 }
-            outs = self._pred.run(feed)
-            if len(group) == 1:
-                group[0].outputs = outs
+                shape = list(arrs[0].shape)
+                shape[0] = padded_rows
+                for ax, ext in targets.items():
+                    shape[ax] = ext
+                if (len(group) == 1 and tuple(shape) == arrs[0].shape):
+                    feed[k] = arrs[0]          # no copy on the fast path
+                else:
+                    out = np.zeros(tuple(shape), arrs[0].dtype)
+                    off = 0
+                    for a in arrs:
+                        dst = (slice(off, off + a.shape[0]),) + tuple(
+                            slice(0, d) for d in a.shape[1:])
+                        out[dst] = a
+                        off += a.shape[0]
+                    feed[k] = out
+                padded_elems += feed[k].size
+            if self._mask_feed is not None:
+                feed[self._mask_feed] = self._mask_for(
+                    feed, rows_valid=total, group=group)
+            self._n_batches.inc()
+            self._h_batch_size.observe(total)
+            with self._plock:
+                backlog = sum(
+                    r.rows for dq in self._pending.values() for r in dq)
+            self._h_queue_depth.observe(self._q.qsize() + backlog)
+            if padded_elems:
+                self._h_pad_waste.observe(1.0 - real_elems / padded_elems)
+            if hasattr(self._pred, "run_async"):
+                outs = self._pred.run_async(feed)
             else:
+                outs = self._pred.run(feed)
+        except Exception as e:  # pad/validate/dispatch failed: fail group
+            self._fail_group(group, e)
+            return
+        # blocks when pipeline_depth batches are unmaterialized: natural
+        # backpressure so the host cannot run unboundedly ahead
+        self._done_q.put((group, outs))
+
+    # -- stage 2: completion (materialize -> slice -> signal waiters) ----
+    def _completion_loop(self):
+        while True:
+            item = self._done_q.get()
+            if item is None:
+                return
+            group, outs = item
+            try:
+                # np.asarray blocks until the device values are ready;
+                # async-dispatch device errors also surface here
+                host = [np.asarray(o) for o in outs]
                 off = 0
                 for r in group:
-                    n = r.inputs[next(iter(r.inputs))].shape[0]
-                    r.outputs = [o[off:off + n] for o in outs]
-                    off += n
-        except Exception as e:  # fail the whole group loudly
-            for r in group:
-                r.error = "%s: %s" % (type(e).__name__, e)
-        finally:
-            for r in group:
-                r.event.set()
+                    r.outputs = [o[off:off + r.rows] for o in host]
+                    off += r.rows
+                now = time.monotonic()
+                for r in group:
+                    if not r.abandoned:   # dead waiters don't skew p99
+                        self._h_latency_ms.observe((now - r.t_enq) * 1e3)
+                for r in group:
+                    r.event.set()
+            except Exception as e:
+                self._fail_group(group, e)
+
+    def _fail_group(self, group, exc):
+        self._n_errors.inc(len(group))
+        for r in group:
+            r.error = "%s: %s" % (type(exc).__name__, exc)
+            r.error_type = type(exc)
+            r.event.set()
 
     # -- HTTP endpoint ---------------------------------------------------
     def serve_http(self, host="127.0.0.1", port=8080, block=True):
         """JSON protocol (cross-language surface): POST /predict with
         {"inputs": {name: nested-list}, "dtypes": {name: "float32"}} ->
-        {"outputs": [nested-list, ...]}.  GET /health -> {"status":"ok"}.
-        Returns the HTTPServer (daemon-threaded when block=False)."""
+        {"outputs": [nested-list, ...]}.  GET /health -> {"status":"ok"};
+        GET /stats -> summary() JSON.  Malformed requests get 400;
+        internal inference failures get 500.  Returns the HTTPServer
+        (daemon-threaded when block=False)."""
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         server_self = self
@@ -177,6 +517,8 @@ class InferenceServer:
             def do_GET(self):
                 if self.path == "/health":
                     self._send(200, {"status": "ok"})
+                elif self.path == "/stats":
+                    self._send(200, server_self.summary())
                 else:
                     self._send(404, {"error": "unknown path"})
 
@@ -184,19 +526,32 @@ class InferenceServer:
                 if self.path != "/predict":
                     self._send(404, {"error": "unknown path"})
                     return
-                try:
+                try:  # client-side errors: malformed JSON / bad feeds
                     n = int(self.headers.get("Content-Length", 0))
                     msg = json.loads(self.rfile.read(n))
+                    if not isinstance(msg.get("inputs"), dict):
+                        raise ValueError('body needs an "inputs" object')
                     dtypes = msg.get("dtypes", {})
                     feed = {
                         k: np.asarray(v, dtype=dtypes.get(k, "float32"))
                         for k, v in msg["inputs"].items()
                     }
-                    outs = server_self.infer(feed)
-                    self._send(200, {"outputs": [o.tolist() for o in outs]})
                 except Exception as e:
                     self._send(400, {"error": "%s: %s"
                                      % (type(e).__name__, e)})
+                    return
+                try:
+                    outs = server_self.infer(feed)
+                except (ValueError, TypeError) as e:
+                    # infer() rejected the request itself (feed names /
+                    # batch dims): still the client's fault
+                    self._send(400, {"error": "%s: %s"
+                                     % (type(e).__name__, e)})
+                except Exception as e:
+                    self._send(500, {"error": "%s: %s"
+                                     % (type(e).__name__, e)})
+                else:
+                    self._send(200, {"outputs": [o.tolist() for o in outs]})
 
         httpd = ThreadingHTTPServer((host, port), Handler)
         if block:

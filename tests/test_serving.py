@@ -1,0 +1,479 @@
+"""Serving hot path: shape bucketing, pipelined dispatch, head-of-line
+fairness, feed validation, HTTP status codes, stats.
+
+Mirrors the reference's TF-Serving-style adaptive batching concerns,
+redone TPU-first: the compile-count tests prove the bucket ladder bounds
+XLA compiles under ragged traffic; the pipelining test proves host-side
+coalescing overlaps an in-flight device call (same slow-fake drill style
+as the async-checkpoint SlowFS tests)."""
+
+import json as _json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.inference import AnalysisConfig, create_predictor
+from paddle_tpu.inference.server import InferenceServer
+
+
+# ---------------------------------------------------------------------------
+# model builders
+# ---------------------------------------------------------------------------
+
+
+def _save_ragged_model(tmp_path, with_mask=False):
+    """x: (batch, ragged_len) -> per-row scalar; zero-padding-safe
+    (square(0)=0), so bucketed results must match unpadded exactly."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, -1], append_batch_size=False)
+        feeds = ["x"]
+        if with_mask:
+            mask = layers.data(
+                "mask", shape=[-1, -1], append_batch_size=False)
+            out = layers.reduce_sum(layers.elementwise_mul(x, mask), dim=1)
+            feeds.append("mask")
+        else:
+            out = layers.reduce_sum(layers.square(x), dim=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    path = str(tmp_path / "ragged.model")
+    fluid.io.save_inference_model(path, feeds, [out], exe, main)
+    return path
+
+
+def _save_fc_model(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 8], append_batch_size=False)
+        pred = layers.fc(layers.fc(x, 16, act="relu"), 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    path = str(tmp_path / "fc.model")
+    fluid.io.save_inference_model(path, ["x"], [pred], exe, main)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# tentpole: bucketing bounds the compile count under ragged traffic
+# ---------------------------------------------------------------------------
+
+
+def test_bucketing_bounds_compile_count_under_ragged_traffic(tmp_path):
+    """N ragged requests (variable batch AND length) must compile at most
+    |batch ladder| x |length ladder| executables — the compile-storm
+    elimination that motivates the whole subsystem."""
+    pred = create_predictor(
+        AnalysisConfig(_save_ragged_model(tmp_path)))
+    batch_buckets = [1, 2, 4, 8]
+    seq_buckets = [4, 8, 16]
+    server = InferenceServer(
+        pred, max_batch=8, batch_timeout_ms=5,
+        batch_buckets=batch_buckets,
+        ragged_dims={"x": {1: seq_buckets}}).start()
+    try:
+        rng = np.random.RandomState(7)
+        cases = [(int(rng.randint(1, 6)), int(rng.randint(3, 17)))
+                 for _ in range(40)]
+        xs = [rng.randn(n, l).astype(np.float32) for n, l in cases]
+        results = [None] * len(xs)
+        errors = []
+
+        def call(i):
+            try:
+                results[i] = server.infer({"x": xs[i]}, timeout=60)[0]
+            except Exception as e:  # surfaced below
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(xs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+        for x, got in zip(xs, results):
+            np.testing.assert_allclose(
+                got, (x * x).sum(axis=1), rtol=1e-5, atol=1e-5)
+        assert pred.compile_count <= len(batch_buckets) * len(seq_buckets), \
+            pred.compile_count
+        s = server.summary()
+        assert s["requests"] == len(xs)
+        assert s["errors"] == 0
+        assert s["compile_count"] == pred.compile_count
+        assert 0.0 < s["padding_waste"]["mean"] < 1.0
+        assert s["latency_ms"]["count"] == len(xs)
+    finally:
+        server.stop()
+
+
+def test_warmup_precompiles_the_full_ladder(tmp_path):
+    """After warmup over the bucket ladder, ragged traffic adds ZERO new
+    compiles (AOT warmup at server start)."""
+    pred = create_predictor(
+        AnalysisConfig(_save_ragged_model(tmp_path)))
+    server = InferenceServer(
+        pred, max_batch=4, batch_timeout_ms=1,
+        batch_buckets=[1, 2, 4], ragged_dims={"x": {1: [4, 8]}}).start()
+    try:
+        n0 = server.warmup({"x": np.zeros((1, 4), np.float32)})
+        assert n0 == pred.compile_count and n0 <= 3 * 2
+        rng = np.random.RandomState(1)
+        for n, l in [(1, 3), (2, 7), (3, 8), (4, 5), (1, 8)]:
+            x = rng.randn(n, l).astype(np.float32)
+            out, = server.infer({"x": x})
+            np.testing.assert_allclose(
+                out, (x * x).sum(axis=1), rtol=1e-5, atol=1e-5)
+        assert pred.compile_count == n0, \
+            (pred.compile_count, n0)
+    finally:
+        server.stop()
+
+
+def test_mask_feed_is_synthesized_for_padded_positions(tmp_path):
+    """Models not neutral to zero padding declare a mask feed: the server
+    builds the (padded_batch, padded_len) validity mask itself."""
+    pred = create_predictor(
+        AnalysisConfig(_save_ragged_model(tmp_path, with_mask=True)))
+    server = InferenceServer(
+        pred, max_batch=4, batch_timeout_ms=1,
+        batch_buckets=[2, 4], ragged_dims={"x": {1: [6, 12]}},
+        mask_feed="mask").start()
+    try:
+        rng = np.random.RandomState(2)
+        for n, l in [(1, 3), (2, 6), (3, 9), (1, 12)]:
+            x = rng.randn(n, l).astype(np.float32)
+            out, = server.infer({"x": x})
+            np.testing.assert_allclose(
+                out, x.sum(axis=1), rtol=1e-5, atol=1e-5)
+        # the synthesized feed must not be client-settable
+        with pytest.raises(ValueError, match="mask"):
+            server.infer({"x": np.zeros((1, 4), np.float32),
+                          "mask": np.ones((1, 4), np.float32)})
+    finally:
+        server.stop()
+    # axis 0 is the batch dim — batch_buckets' job, not ragged_dims'
+    with pytest.raises(ValueError, match="batch dim"):
+        InferenceServer(pred, ragged_dims={"x": {0: [2, 4]}})
+
+
+def test_persistent_compilation_cache_writes_entries(tmp_path):
+    """AnalysisConfig.enable_compilation_cache wires jax's persistent
+    cache: compiles leave on-disk entries a restarted server reloads."""
+    import os
+
+    import jax
+
+    model = _save_fc_model(tmp_path)
+    cache = str(tmp_path / "xla_cache")
+    cfg = AnalysisConfig(model)
+    cfg.enable_compilation_cache(cache)
+    try:
+        pred = create_predictor(cfg)
+        pred.run({"x": np.zeros((2, 8), np.float32)})
+        assert os.listdir(cache), "no persistent cache entries written"
+    finally:  # global knob: restore so other tests don't write here
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+        jax.config.update("jax_compilation_cache_dir", None)
+
+
+# ---------------------------------------------------------------------------
+# tentpole: pipelined dispatch (slow-fake-predictor drill)
+# ---------------------------------------------------------------------------
+
+
+class _LazyOut:
+    """Device-array stand-in: materialization blocks on a gate, like a
+    jax array whose computation is still in flight."""
+
+    def __init__(self, arr, gate):
+        self._arr = arr
+        self._gate = gate
+
+    def __array__(self, dtype=None, copy=None):
+        assert self._gate.wait(10), "gate never opened"
+        return np.asarray(self._arr, dtype=dtype)
+
+    def __getitem__(self, idx):
+        assert self._gate.wait(10), "gate never opened"
+        return self._arr[idx]
+
+
+class _FakeAsyncPredictor:
+    """run_async returns immediately (async dispatch); the output only
+    materializes once the per-call gate opens."""
+
+    def __init__(self, n_gates):
+        self.gates = [threading.Event() for _ in range(n_gates)]
+        self.calls = []
+        self._lock = threading.Lock()
+
+    def run_async(self, feed):
+        with self._lock:
+            i = len(self.calls)
+            self.calls.append(
+                {k: tuple(v.shape) for k, v in feed.items()})
+        rows = feed["x"].shape[0]
+        out = np.arange(rows, dtype=np.float32).reshape(rows, 1)
+        return [_LazyOut(out, self.gates[min(i, len(self.gates) - 1)])]
+
+
+def test_dispatch_overlaps_inflight_device_call():
+    """While batch N is dispatched but unmaterialized (gate closed), the
+    dispatch thread must accept, coalesce, and dispatch batch N+1 — the
+    host never blocks on device completion between batches."""
+    pred = _FakeAsyncPredictor(n_gates=2)
+    server = InferenceServer(
+        pred, max_batch=4, batch_timeout_ms=1, batch_buckets=False,
+        pipeline_depth=2).start()
+    try:
+        results = {}
+
+        def call(name, arr):
+            results[name] = server.infer({"x": arr}, timeout=30)
+
+        t1 = threading.Thread(
+            target=call, args=("a", np.zeros((2, 3), np.float32)))
+        t1.start()
+        deadline = time.monotonic() + 5
+        while len(pred.calls) < 1 and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert len(pred.calls) == 1, "first batch never dispatched"
+        # batch 1 is in flight (gate closed); submit batch 2
+        t2 = threading.Thread(
+            target=call, args=("b", np.zeros((3, 3), np.float32)))
+        t2.start()
+        deadline = time.monotonic() + 5
+        while len(pred.calls) < 2 and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert len(pred.calls) == 2, \
+            "dispatch stalled behind the in-flight device call"
+        assert not pred.gates[0].is_set()  # batch 1 STILL unmaterialized
+        pred.gates[0].set()
+        pred.gates[1].set()
+        t1.join(10)
+        t2.join(10)
+        assert results["a"][0].shape == (2, 1)
+        assert results["b"][0].shape == (3, 1)
+        assert server.summary()["batches"] == 2
+    finally:
+        for g in pred.gates:
+            g.set()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: head-of-line fairness across signatures
+# ---------------------------------------------------------------------------
+
+
+class _SlowPredictor:
+    def __init__(self, delay=0.005):
+        self.delay = delay
+
+    def run(self, feed):
+        time.sleep(self.delay)
+        rows = feed["x"].shape[0]
+        width = feed["x"].shape[1]
+        return [np.full((rows, 1), float(width), np.float32)]
+
+
+def test_minority_signature_is_not_starved_by_a_steady_stream():
+    """Regression: the old loop re-queued an incompatible request at the
+    BACK of the queue, so a steady compatible stream starved it forever.
+    Per-signature deques served in arrival order must let both shapes
+    make progress under load."""
+    server = InferenceServer(
+        _SlowPredictor(), max_batch=8, batch_timeout_ms=1,
+        batch_buckets=False).start()
+    try:
+        stop_flood = threading.Event()
+        flood_errors = []
+
+        def flood():
+            x = np.zeros((1, 4), np.float32)
+            while not stop_flood.is_set():
+                try:
+                    server.infer({"x": x}, timeout=30)
+                except Exception as e:
+                    flood_errors.append(e)
+                    return
+
+        floods = [threading.Thread(target=flood) for _ in range(3)]
+        for t in floods:
+            t.start()
+        time.sleep(0.05)  # flood is established
+        t0 = time.monotonic()
+        out, = server.infer({"x": np.zeros((1, 6), np.float32)}, timeout=5)
+        minority_latency = time.monotonic() - t0
+        stop_flood.set()
+        for t in floods:
+            t.join(10)
+        assert not flood_errors, flood_errors[:1]
+        assert out[0, 0] == 6.0          # the minority shape's own result
+        assert minority_latency < 2.0, minority_latency
+    finally:
+        stop_flood.set()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: Predictor feed validation
+# ---------------------------------------------------------------------------
+
+
+def test_predictor_rejects_mismatched_feeds(tmp_path):
+    pred = create_predictor(AnalysisConfig(_save_fc_model(tmp_path)))
+    x = np.zeros((2, 8), np.float32)
+    with pytest.raises(ValueError, match=r"expects 1 feeds.*'x'"):
+        pred.run([x, x])                     # silently zip-dropped before
+    with pytest.raises(ValueError, match=r"expects 1 feeds"):
+        pred.run([])
+    with pytest.raises(ValueError, match=r"unknown \['bogus'\]"):
+        pred.run({"x": x, "bogus": x})
+    with pytest.raises(ValueError, match=r"missing \['x'\]"):
+        pred.run({})
+    out, = pred.run({"x": x})                # valid feeds still fine
+    assert out.shape == (2, 2)
+
+
+# ---------------------------------------------------------------------------
+# satellite: HTTP status codes + /stats
+# ---------------------------------------------------------------------------
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, _json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, _json.loads(e.read())
+
+
+def test_http_distinguishes_client_errors_from_server_errors(tmp_path):
+    pred = create_predictor(AnalysisConfig(_save_fc_model(tmp_path)))
+    server = InferenceServer(pred, batch_timeout_ms=1).start()
+    httpd = server.serve_http(port=0, block=False)
+    try:
+        base = "http://127.0.0.1:%d" % httpd.server_address[1]
+        # malformed JSON -> 400
+        code, out = _post(base + "/predict", b"{not json")
+        assert code == 400 and "error" in out
+        # missing "inputs" -> 400
+        code, out = _post(base + "/predict", _json.dumps({"x": 1}).encode())
+        assert code == 400
+        # unknown feed name -> 400 (client's fault, not a 500)
+        code, out = _post(base + "/predict", _json.dumps(
+            {"inputs": {"bogus": [[1.0] * 8]}}).encode())
+        assert code == 400
+        # valid request -> 200
+        code, out = _post(base + "/predict", _json.dumps(
+            {"inputs": {"x": [[0.5] * 8] * 3}}).encode())
+        assert code == 200 and len(out["outputs"][0]) == 3
+        # /stats surfaces the serving counters
+        with urllib.request.urlopen(base + "/stats", timeout=10) as resp:
+            stats = _json.loads(resp.read())
+        assert stats["requests"] >= 1
+        assert stats["batches"] >= 1
+        assert "latency_ms" in stats and "padding_waste" in stats
+        assert stats["compile_count"] == pred.compile_count
+    finally:
+        httpd.shutdown()
+        server.stop()
+
+
+class _FailingPredictor:
+    def run(self, feed):
+        raise RuntimeError("device OOM")  # internal failure, not client's
+
+
+def test_http_internal_inference_failure_returns_500():
+    server = InferenceServer(
+        _FailingPredictor(), batch_timeout_ms=1,
+        batch_buckets=False).start()
+    httpd = server.serve_http(port=0, block=False)
+    try:
+        base = "http://127.0.0.1:%d" % httpd.server_address[1]
+        code, out = _post(base + "/predict", _json.dumps(
+            {"inputs": {"x": [[1.0, 2.0]]}}).encode())
+        assert code == 500, (code, out)   # was conflated with 400 before
+        assert "device OOM" in out["error"]
+        assert server.summary()["errors"] == 1
+    finally:
+        httpd.shutdown()
+        server.stop()
+
+
+def test_http_dispatch_time_shape_error_returns_400(tmp_path):
+    """Correct feed NAMES but wrong feature width: the error surfaces
+    inside the predictor during dispatch, yet it's the client's fault —
+    the ValueError type must survive to the HTTP layer as a 400."""
+    pred = create_predictor(AnalysisConfig(_save_fc_model(tmp_path)))
+    server = InferenceServer(pred, batch_timeout_ms=1).start()
+    httpd = server.serve_http(port=0, block=False)
+    try:
+        base = "http://127.0.0.1:%d" % httpd.server_address[1]
+        code, out = _post(base + "/predict", _json.dumps(
+            {"inputs": {"x": [[1.0, 2.0]]}}).encode())  # width 2, wants 8
+        assert code == 400, (code, out)
+    finally:
+        httpd.shutdown()
+        server.stop()
+
+
+def test_stop_start_cycle_and_stop_before_start_are_safe():
+    """Regression: stop() used to leave a sentinel in the bounded done
+    queue, wedging the completion thread spawned by the next start()."""
+    pred = _SlowPredictor(delay=0.001)
+    server = InferenceServer(
+        pred, max_batch=2, batch_timeout_ms=1,
+        batch_buckets=False, pipeline_depth=1)
+    server.stop()                      # stop before start: no-op
+    server.stop()
+    x = np.zeros((1, 4), np.float32)
+    for _ in range(2):                 # two full start/serve/stop cycles
+        server.start()
+        # pipeline_depth=1: more batches than depth proves the completer
+        # is draining (a wedged completer would block the dispatcher)
+        for _ in range(4):
+            out, = server.infer({"x": x}, timeout=10)
+            assert out.shape == (1, 1)
+        server.stop()
+        server.stop()                  # double stop: no-op
+
+
+def test_timed_out_request_is_dropped_not_dispatched():
+    """A waiter that times out while queued is abandoned: the dispatcher
+    drops it instead of burning device work, and it never skews the
+    latency histogram."""
+    pred = _SlowPredictor(delay=0.3)
+    server = InferenceServer(
+        pred, max_batch=1, batch_timeout_ms=1, batch_buckets=False,
+        pipeline_depth=1).start()
+    try:
+        x = np.zeros((1, 4), np.float32)
+        blocker = threading.Thread(
+            target=lambda: server.infer({"x": x}, timeout=10))
+        blocker.start()                # occupies the device 0.3s
+        time.sleep(0.05)
+        with pytest.raises(TimeoutError):
+            server.infer({"x": x}, timeout=0.05)   # dies in the queue
+        blocker.join(10)
+        out, = server.infer({"x": x}, timeout=10)  # server still healthy
+        assert out.shape == (1, 1)
+        s = server.summary()
+        assert s["abandoned"] == 1
+        # blocker + the healthy request served; the abandoned one wasn't
+        assert s["latency_ms"]["count"] == 2
+    finally:
+        server.stop()
