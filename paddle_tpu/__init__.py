@@ -15,7 +15,7 @@ Architecture (see SURVEY.md §7):
 __version__ = "0.1.0"
 
 from . import fluid  # noqa: F401
-from . import dataset, incubate, reader  # noqa: F401
+from . import dataset, incubate, io, reader  # noqa: F401
 from .reader import batch  # noqa: F401  (paddle.batch parity)
 # 2.0-style namespaces (reference python/paddle/{nn,tensor,metric})
 from . import metric, nn, tensor  # noqa: F401

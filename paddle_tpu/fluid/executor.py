@@ -358,12 +358,22 @@ class Executor:
         block = program.global_block
 
         # -- convert feeds -------------------------------------------------
+        # jax.Arrays (an io.DevicePrefetcher feed) stay device-resident:
+        # np.asarray on them would round-trip device->host->device and
+        # throw away exactly the overlap the prefetcher bought
         feed_vals = {}
         for name, value in feed.items():
             v = block._find_var_recursive(name)
-            arr = np.asarray(value)
-            if v is not None and dtypes_mod.to_jnp(v.dtype) != arr.dtype.type:
-                arr = arr.astype(dtypes_mod.to_str(v.dtype))
+            if isinstance(value, jax.Array):
+                arr = value
+                if v is not None and \
+                        dtypes_mod.to_jnp(v.dtype) != arr.dtype.type:
+                    arr = arr.astype(dtypes_mod.to_jnp(v.dtype))
+            else:
+                arr = np.asarray(value)
+                if v is not None and \
+                        dtypes_mod.to_jnp(v.dtype) != arr.dtype.type:
+                    arr = arr.astype(dtypes_mod.to_str(v.dtype))
             feed_vals[name] = arr
 
         feed_sig = tuple(
@@ -411,10 +421,20 @@ class Executor:
                 return v if getattr(v, "sharding", None) == tgt \
                     else jax.device_put(v, tgt)
 
+            def _to_global(a, sharding):
+                if getattr(a, "sharding", None) == sharding:
+                    return a
+                if isinstance(a, jax.Array):
+                    # device-resident with a different layout: reshard on
+                    # device (np.asarray would fail on a multi-host
+                    # global array, and would mislabel global shape as
+                    # process-local data)
+                    return jax.device_put(a, sharding)
+                return jax.make_array_from_process_local_data(
+                    sharding, np.asarray(a))
+
             feed_dev = {
-                n: jax.make_array_from_process_local_data(
-                    entry.feed_shardings[n], np.asarray(a)
-                )
+                n: _to_global(a, entry.feed_shardings[n])
                 for n, a in feed_vals.items()
             }
             donate_state = {n: _place(n, v) for n, v in donate_state.items()}
@@ -427,7 +447,12 @@ class Executor:
 
             def _stitch(a, sharding):
                 # per-process local data -> one global array (works single-
-                # process too, where local IS global)
+                # process too, where local IS global); already-placed
+                # device arrays pass through or reshard on device
+                if getattr(a, "sharding", None) == sharding:
+                    return a
+                if isinstance(a, jax.Array):
+                    return jax.device_put(a, sharding)
                 return jax.make_array_from_process_local_data(
                     sharding, np.asarray(a)
                 )

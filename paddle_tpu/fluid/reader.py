@@ -146,9 +146,30 @@ def _mp_worker_main(dataset, collate, task_q, res_q):
 
 
 def default_collate(items):
-    """list of tuples -> tuple of stacked arrays."""
-    transposed = list(zip(*items))
-    return tuple(np.stack([np.asarray(x) for x in col]) for col in transposed)
+    """Batch a list of samples: tuple/list samples -> tuple of stacked
+    arrays; dict samples -> dict of stacked arrays (keys must agree
+    across the batch).  Anything else raises — a clear error beats a
+    silent mis-zip."""
+    first = items[0]
+    if isinstance(first, dict):
+        keys = set(first)
+        for i, it in enumerate(items):
+            if not isinstance(it, dict) or set(it) != keys:
+                raise TypeError(
+                    "default_collate: dict samples must share one key set; "
+                    "sample 0 has %s, sample %d has %s"
+                    % (sorted(keys), i,
+                       sorted(it) if isinstance(it, dict) else type(it)))
+        return {
+            k: np.stack([np.asarray(it[k]) for it in items]) for k in first
+        }
+    if isinstance(first, (tuple, list)):
+        transposed = list(zip(*items))
+        return tuple(
+            np.stack([np.asarray(x) for x in col]) for col in transposed)
+    raise TypeError(
+        "default_collate supports tuple/list or dict samples, got %s; "
+        "pass collate_fn= for anything else" % type(first).__name__)
 
 
 class DataLoader:
@@ -182,7 +203,15 @@ class DataLoader:
                        iterable=True, return_list=False):
         return DataLoader(feed_list=feed_list, capacity=capacity)
 
-    def set_sample_generator(self, generator, batch_size, drop_last=True, places=None):
+    def set_sample_generator(self, generator, batch_size, drop_last=False,
+                             places=None):
+        """Feed from a per-sample generator, batching by `batch_size`.
+
+        `drop_last` defaults to False, ALIGNED with the constructor's
+        default (the reference defaulted this one method to True, so the
+        same DataLoader dropped the tail batch or not depending on which
+        entry point fed it — a silent data-loss footgun; pass
+        drop_last=True explicitly for fixed-shape feeding)."""
         from .reader import batch as _batch  # self-module import for clarity
 
         self._gen = lambda: (
@@ -358,15 +387,31 @@ class DataLoader:
         except Exception:
             pass
 
+    def _sampler_state(self):
+        """The sampler's cursor, or None when it has none (a plain
+        BatchSampler) or it is not meaningfully positional here."""
+        sampler = getattr(self, "batch_sampler", None)
+        if sampler is None or not hasattr(sampler, "state_dict"):
+            return None
+        try:
+            return sampler.state_dict()
+        except TypeError:
+            return None
+
     def __iter__(self):
         q = queue.Queue(maxsize=self.capacity)
         sentinel = object()
         err = []
+        # the background thread pulls the sampler up to capacity+1
+        # batches ahead of the consumer: pair each batch with the
+        # sampler cursor AS OF ITS PULL so state_dict() can report the
+        # position of the batch the trainer actually received
+        track = self.num_workers == 0 and self._gen is None
 
         def worker():
             try:
                 for b in self._batches():
-                    q.put(b)
+                    q.put((b, self._sampler_state() if track else None))
             except BaseException as e:  # propagate to consumer
                 err.append(e)
             finally:
@@ -380,6 +425,9 @@ class DataLoader:
                 if err:
                     raise err[0]
                 return
+            item, state = item
+            if state is not None:
+                self._last_sampler_state = state
             if self.feed_list is not None:
                 yield {
                     v.name if hasattr(v, "name") else v: arr
@@ -392,6 +440,39 @@ class DataLoader:
         if self._gen is not None:
             raise TypeError("generator-fed DataLoader has no length")
         return len(self.batch_sampler)
+
+    # -- checkpointable iteration (paddle_tpu.io contract) ------------------
+    def state_dict(self):
+        """Sampler state aligned to YIELDED batches (the internal
+        prefetch thread runs ahead; see __iter__) — exact for
+        num_workers=0 map-style iteration with an io.ShardedBatchSampler.
+        With num_workers>0 the batch list is drained upfront, so
+        positional resume needs io.ResumableDataLoader instead."""
+        state = getattr(self, "_last_sampler_state", None)
+        if state is not None:
+            return {"sampler": state}
+        state = self._sampler_state()
+        if state is None:
+            raise TypeError(
+                "this DataLoader's sampler has no state_dict(); use "
+                "io.ResumableDataLoader (or io.ShardedBatchSampler) for "
+                "checkpointable iteration")
+        return {"sampler": state}
+
+    def load_state_dict(self, state):
+        sampler = getattr(self, "batch_sampler", None)
+        if sampler is None or not hasattr(sampler, "load_state_dict"):
+            raise TypeError(
+                "this DataLoader's sampler has no load_state_dict(); use "
+                "io.ResumableDataLoader (or io.ShardedBatchSampler) for "
+                "checkpointable iteration")
+        sampler.load_state_dict(state["sampler"])
+        self._last_sampler_state = state["sampler"]
+
+    def set_epoch(self, epoch):
+        sampler = getattr(self, "batch_sampler", None)
+        if sampler is not None and hasattr(sampler, "set_epoch"):
+            sampler.set_epoch(epoch)
 
 
 class DistributedBatchSampler(BatchSampler):
@@ -418,21 +499,40 @@ class DistributedBatchSampler(BatchSampler):
         """Reshuffle deterministically per epoch (reference contract)."""
         self.epoch = int(epoch)
 
+    def state_dict(self):
+        """Epoch-granular state (the permutation is a pure function of
+        (seed, epoch)); `io.ShardedBatchSampler` extends this with the
+        exact batch offset for mid-epoch resume."""
+        return {"epoch": self.epoch, "seed": self._seed_base,
+                "nranks": self.nranks, "rank": self.rank}
+
+    def load_state_dict(self, state):
+        self.epoch = int(state["epoch"])
+
+    def _shard_batches(self, idx):
+        """Permuted global indices -> this rank's batch list: pad
+        (tiling if needed) to a multiple of nranks so every rank yields
+        equally many batches even when pad > dataset size, take the
+        rank-strided slice, split into batches.  Single-sourced: the
+        resumable io.ShardedBatchSampler's offsets index into exactly
+        this list."""
+        per = (self.n + self.nranks - 1) // self.nranks
+        padded = np.resize(idx, per * self.nranks)
+        local = padded[self.rank::self.nranks]
+        out = []
+        for i in range(0, len(local), self.batch_size):
+            b = local[i:i + self.batch_size]
+            if len(b) < self.batch_size and self.drop_last:
+                break
+            out.append([int(j) for j in b])
+        return out
+
     def __iter__(self):
         idx = np.arange(self.n)
         if self.shuffle:
             np.random.RandomState(
                 (self._seed_base or 0) + self.epoch).shuffle(idx)
-        # pad (tiling if needed) to a multiple of nranks so every rank
-        # yields equally many batches even when pad > dataset size
-        per = (self.n + self.nranks - 1) // self.nranks
-        padded = np.resize(idx, per * self.nranks)
-        local = padded[self.rank::self.nranks]
-        for i in range(0, len(local), self.batch_size):
-            b = local[i:i + self.batch_size]
-            if len(b) < self.batch_size and self.drop_last:
-                return
-            yield list(b)
+        yield from self._shard_batches(idx)
 
     def __len__(self):
         per = (self.n + self.nranks - 1) // self.nranks

@@ -51,6 +51,13 @@ def _to_batches(data, batch_size, shuffle=False, seed=None):
         yield xs[j], ys[j]
 
 
+def _as_array(a):
+    """Host lists -> numpy; anything already array-like (numpy OR a
+    device-resident jax.Array from io.DevicePrefetcher) passes through —
+    np.asarray on a device array would be a host round trip."""
+    return a if hasattr(a, "dtype") else np.asarray(a)
+
+
 class _DygraphAdapter:
     """Eager per-batch execution (reference DynamicGraphAdapter)."""
 
@@ -60,7 +67,7 @@ class _DygraphAdapter:
     def train_batch(self, inputs, labels):
         m = self.m
         xs = _wrap_vars(inputs)
-        y = to_variable(np.asarray(labels))
+        y = to_variable(_as_array(labels))
         m.network.train()
         pred = m.network(*xs)
         loss = m._loss(pred, y)
@@ -74,7 +81,7 @@ class _DygraphAdapter:
         m.network.eval()
         with dygraph.no_grad():
             pred = m.network(*_wrap_vars(inputs))
-            loss = m._loss(pred, to_variable(np.asarray(labels)))
+            loss = m._loss(pred, to_variable(_as_array(labels)))
         return float(loss.numpy()), pred.numpy()
 
     def predict_batch(self, inputs):
@@ -148,11 +155,11 @@ class _StaticGraphAdapter:
 
     def _feed(self, inputs, labels=None):
         ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        feed = {n: np.asarray(a) for n, a in zip(self._feed_names, ins)}
+        feed = {n: _as_array(a) for n, a in zip(self._feed_names, ins)}
         if labels is not None:
             labs = labels if isinstance(labels, (list, tuple)) else [labels]
             feed.update({
-                n: np.asarray(a) for n, a in zip(self._label_names, labs)
+                n: _as_array(a) for n, a in zip(self._label_names, labs)
             })
         return feed
 
@@ -200,8 +207,8 @@ class _StaticGraphAdapter:
 def _wrap_vars(inputs):
     """A network may take one array or a list of feature arrays."""
     if isinstance(inputs, (list, tuple)):
-        return [to_variable(np.asarray(a)) for a in inputs]
-    return [to_variable(np.asarray(inputs))]
+        return [to_variable(_as_array(a)) for a in inputs]
+    return [to_variable(_as_array(inputs))]
 
 
 class Model:
@@ -214,6 +221,7 @@ class Model:
         self._metrics = []
         self._adapter = None
         self.stop_training = False  # set by EarlyStopping
+        self.io_stats = None        # io.PipelineStats when device_prefetch
 
     @property
     def mode(self):
@@ -254,11 +262,33 @@ class Model:
     # -- loops ----------------------------------------------------------
     def fit(self, train_data, eval_data=None, batch_size=32, epochs=1,
             eval_freq=1, verbose=1, callbacks=None, shuffle=True,
-            log_freq=10):
+            log_freq=10, device_prefetch=False, prefetch_depth=2):
         """cf. reference Model.fit: epochs over train_data with eval every
         `eval_freq` epochs, callbacks driving logging/checkpoint/early
-        stop (reference model.py fit + callbacks.py)."""
+        stop (reference model.py fit + callbacks.py).
+
+        `device_prefetch=True` routes batches through
+        `io.DevicePrefetcher` (depth `prefetch_depth`): host collation
+        and the H2D copy of batch N+1 overlap the train step of batch N,
+        and pipeline wait/copy metrics accumulate in
+        `self.io_stats` (an `io.PipelineStats`).  Loaders exposing
+        `set_epoch` get it called once per epoch (sharded determinism
+        contract)."""
         self._ensure_prepared()
+        if device_prefetch:
+            from ..io import DevicePrefetcher, PipelineStats
+
+            if self.io_stats is None:
+                self.io_stats = PipelineStats(name="hapi.fit")
+            if isinstance(train_data, DevicePrefetcher):
+                self.io_stats = train_data.stats  # metrics live there
+            elif hasattr(train_data, "__iter__") and \
+                    not isinstance(train_data, (tuple, list)):
+                # wrap the LOADER itself (not the per-epoch generator) so
+                # a stateful loader keeps its delivered-batch alignment
+                # and early-break rewind guarantees
+                train_data = DevicePrefetcher(
+                    train_data, depth=prefetch_depth, stats=self.io_stats)
         cbs = list(callbacks or [])
         if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
             cbs.append(ProgBarLogger(log_freq=log_freq, verbose=verbose))
@@ -273,9 +303,18 @@ class Model:
             losses = []
             for m in self._metrics:
                 m.reset()
-            for step, (bx, by) in enumerate(
-                _to_batches(train_data, batch_size, shuffle, seed=epoch)
-            ):
+            if hasattr(train_data, "set_epoch"):
+                train_data.set_epoch(epoch)
+            batches = _to_batches(train_data, batch_size, shuffle, seed=epoch)
+            if device_prefetch:
+                from ..io import DevicePrefetcher
+
+                if not isinstance(train_data, DevicePrefetcher):
+                    # (x, y) array input: the per-epoch generator is
+                    # stateless, wrapping it loses nothing
+                    batches = DevicePrefetcher(
+                        batches, depth=prefetch_depth, stats=self.io_stats)
+            for step, (bx, by) in enumerate(batches):
                 for c in cbs:
                     c.on_train_batch_begin(step)
                 loss, pred = self.train_batch(bx, by)

@@ -51,7 +51,8 @@ class TrainEpochRange:
                  main_program=None, scope=None, fs=None,
                  save_checkpoint_inter=1, max_num_checkpoints=3,
                  async_save=True, trainer_id=None, num_trainers=None,
-                 barrier=None, extra_serializables=None, verbose=False):
+                 barrier=None, extra_serializables=None, data_loaders=None,
+                 verbose=False):
         from ...fluid import framework
         from ...fluid.core.scope import global_scope
 
@@ -71,6 +72,7 @@ class TrainEpochRange:
             self._async = None
             self._start_epoch = 0
             self.restored_from = -1
+            self.restored_step = None
             return
 
         trainer_id = int(os.getenv("PADDLE_TRAINER_ID", "0")
@@ -90,6 +92,24 @@ class TrainEpochRange:
         # rank-distinct filenames and save on every rank
         self._snap = StateSnapshot.from_program(self._program, self._scope)
         extras = list(extra_serializables or [])
+        # data loaders (paddle_tpu.io state_dict/load_state_dict contract)
+        # ride as per-rank extras: the iteration cursor commits atomically
+        # WITH the parameters, which is what makes mid-epoch resume exact.
+        # Multiple loaders must advance epochs in lockstep (same batch
+        # count): a shorter loader that already crossed into epoch e+1
+        # when a mid-epoch save lands would be rewound by the caller's
+        # set_epoch(e) on resume and replay its whole epoch
+        if data_loaders is not None:
+            from ...io.resumable import DataLoaderCheckpoint
+
+            if not isinstance(data_loaders, (list, tuple)):
+                data_loaders = [data_loaders]
+            for i, dl in enumerate(data_loaders):
+                if isinstance(dl, DataLoaderCheckpoint):
+                    extras.append(dl)
+                else:
+                    extras.append(DataLoaderCheckpoint(
+                        dl, name="dataloader%d" % i, trainer_id=trainer_id))
         self._serializables = [self._snap] + extras
         self._save_serializables = (
             self._serializables if trainer_id == 0 else extras)
@@ -114,13 +134,43 @@ class TrainEpochRange:
         if meta is None:
             self._start_epoch = 0
             self.restored_from = -1
+            self.restored_step = None
             return
         self._serializables[0].restore_to_scope(self._scope)
         self.restored_from = int(meta.get("epoch", -1))
-        self._start_epoch = self.restored_from + 1
+        self.restored_step = meta.get("step")
+        if self.restored_step is not None:
+            # mid-epoch checkpoint (saved via save_checkpoint(epoch, step)
+            # with a data loader attached): RE-ENTER the same epoch — the
+            # restored loader cursor positions iteration at the first
+            # unconsumed batch, so the epoch's remainder (and nothing
+            # else) gets trained.  Exception: a save landing exactly on
+            # the epoch's last batch restores a cursor already in the
+            # NEXT epoch; re-entering would retrain nothing but a
+            # set_epoch(e) call could rewind it — skip ahead instead.
+            loader_epochs = [
+                w.restored_epoch() for w in self._serializables
+                if hasattr(w, "restored_epoch")
+            ]
+            loader_epochs = [e for e in loader_epochs if e is not None]
+            if not loader_epochs:
+                # no loader cursor restored (none attached, or the
+                # checkpoint predates attachment): re-entering the epoch
+                # would retrain batches 0..step — skip to the next epoch
+                # instead (the pre-loader semantics)
+                self._start_epoch = self.restored_from + 1
+            elif min(loader_epochs) > self.restored_from:
+                self._start_epoch = self.restored_from + 1
+            else:
+                self._start_epoch = self.restored_from
+        else:
+            self._start_epoch = self.restored_from + 1
         if self._verbose:
-            print("auto_checkpoint[%s]: resumed after epoch %d"
-                  % (self.name, self.restored_from), file=sys.stderr)
+            print("auto_checkpoint[%s]: resumed after epoch %d%s"
+                  % (self.name, self.restored_from,
+                     "" if self.restored_step is None
+                     else " step %s (mid-epoch)" % self.restored_step),
+                  file=sys.stderr)
 
     @property
     def start_epoch(self):

@@ -1,0 +1,82 @@
+"""Optional sequence-packing stage for ragged text datasets.
+
+Bridges `fluid.packing.pack_sequences` (host-side first-fit-decreasing
+packing -> fixed-shape rows + segment ids, the TPU-first replacement for
+LoD batches) into the io pipeline: wrap a loader whose batches are lists
+of variable-length sequences and get fixed-shape dict batches XLA can
+compile ONCE, with the realized packing efficiency (real tokens / row
+capacity) recorded per batch in `PipelineStats`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..fluid.packing import pack_sequences
+from .stats import PipelineStats
+
+__all__ = ["PackingStage"]
+
+
+class PackingStage:
+    """Iterable stage: list-of-sequences batches -> packed dict batches.
+
+    source    iterable whose items are lists of 1-D/2-D variable-length
+              arrays (one buffer of sequences to pack together).
+    seq_len   packed row length; sequences longer than this raise
+              (pack_sequences never truncates).
+    max_rows  fixed row count per batch — REQUIRED for a static shape
+              across batches (XLA compiles one executable); None lets
+              the row count float (host-side use only).
+
+    Yields {"data", "segment_ids", "positions"} numpy batches, the exact
+    feed contract of `flash_attention(QSeg/KSeg)` / `segment_pool`.
+    Passes `state_dict/load_state_dict/set_epoch/__len__` through to the
+    source, so a packed pipeline is still resumable end to end.
+    """
+
+    def __init__(self, source, seq_len, pad_value=0, max_rows=None,
+                 stats=None):
+        self.source = source
+        self.seq_len = int(seq_len)
+        self.pad_value = pad_value
+        self.max_rows = max_rows
+        self.stats = stats or PipelineStats()
+
+    def __iter__(self):
+        for seqs in self.source:
+            packed = pack_sequences(
+                list(seqs), self.seq_len, pad_value=self.pad_value,
+                max_rows=self.max_rows)
+            rows = packed.data.shape[0]
+            if rows:
+                tokens = int(np.count_nonzero(packed.segment_ids))
+                self.stats.packing_efficiency.observe(
+                    tokens / float(rows * self.seq_len))
+            yield {
+                "data": packed.data,
+                "segment_ids": packed.segment_ids,
+                "positions": packed.positions,
+            }
+
+    def __len__(self):
+        return len(self.source)
+
+    def state_dict(self):
+        if not hasattr(self.source, "state_dict"):
+            raise TypeError(
+                "PackingStage source %r has no state_dict(); wrap a "
+                "ResumableDataLoader for checkpointable iteration"
+                % type(self.source).__name__)
+        return self.source.state_dict()
+
+    def load_state_dict(self, state):
+        if not hasattr(self.source, "load_state_dict"):
+            raise TypeError(
+                "PackingStage source %r has no load_state_dict()"
+                % type(self.source).__name__)
+        self.source.load_state_dict(state)
+
+    def set_epoch(self, epoch):
+        if hasattr(self.source, "set_epoch"):
+            self.source.set_epoch(epoch)

@@ -1,0 +1,145 @@
+"""Checkpointable iteration: a loader whose position survives SIGKILL.
+
+`ResumableDataLoader` collates synchronously on the caller's thread so
+its `state_dict()` is EXACT — a batch is counted if and only if the
+trainer received it.  Async overlap is not this class's job: wrap it in
+`io.DevicePrefetcher`, which keeps the state aligned to delivered (not
+merely prefetched) batches.
+
+`DataLoaderCheckpoint` adapts anything with `state_dict/load_state_dict`
+to the `incubate.checkpoint.SerializableBase` interface with a
+rank-distinct filename, so loader state rides inside the same atomic,
+CRC-manifested commit as the model parameters (one commit = params AND
+cursor, never one without the other).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from ..fluid.reader import default_collate
+from ..incubate.checkpoint.checkpoint_saver import SerializableBase
+from .sampler import ShardedBatchSampler
+
+__all__ = ["ResumableDataLoader", "DataLoaderCheckpoint"]
+
+
+class ResumableDataLoader:
+    """Map-style dataset -> deterministic, sharded, resumable batches.
+
+    Each rank sees a disjoint, epoch-seeded shard (ShardedBatchSampler);
+    `state_dict()` captures (epoch, batch offset) and restoring it makes
+    the next iteration consume exactly the unseen remainder of the epoch.
+    Epochs auto-advance on exhaustion; `set_epoch(e)` rewinds unless the
+    loader is already positioned inside epoch e (resume safety).
+    """
+
+    def __init__(self, dataset, batch_size=1, shuffle=True, drop_last=False,
+                 seed=0, num_replicas=None, rank=None, collate_fn=None,
+                 batch_sampler=None, stats=None):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate
+        self.batch_sampler = batch_sampler or ShardedBatchSampler(
+            dataset, batch_size, num_replicas=num_replicas, rank=rank,
+            shuffle=shuffle, drop_last=drop_last, seed=seed)
+        self.stats = stats
+
+    def __iter__(self):
+        for indices in self.batch_sampler:
+            batch = self.collate_fn([self.dataset[i] for i in indices])
+            if self.stats is not None:
+                # samples only: `batches` is the DELIVERY counter and is
+                # owned by the consuming DevicePrefetcher — one stats
+                # object rides the whole pipeline without double counts
+                self.stats.samples.inc(len(indices))
+            yield batch
+
+    def __len__(self):
+        return len(self.batch_sampler)
+
+    # -- epoch/position control ------------------------------------------
+    @property
+    def epoch(self):
+        return self.batch_sampler.epoch
+
+    def set_epoch(self, epoch):
+        self.batch_sampler.set_epoch(epoch)
+
+    def state_dict(self):
+        return {"sampler": self.batch_sampler.state_dict()}
+
+    def load_state_dict(self, state):
+        self.batch_sampler.load_state_dict(state["sampler"])
+
+
+class DataLoaderCheckpoint(SerializableBase):
+    """SerializableBase adapter: persist a loader's `state_dict()` as
+    `<name>_rank<r>.json` inside a checkpoint commit.
+
+    `snapshot()` copies the state on the caller's thread (async-save
+    safe: later batches cannot mutate what gets written); `deserialize`
+    pushes the restored state back into the live loader."""
+
+    def __init__(self, loader, name="dataloader", trainer_id=None):
+        if trainer_id is None:
+            trainer_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._loader = loader
+        self._rank = int(trainer_id)
+        self._name = name
+        self._state = None
+
+    @property
+    def filename(self):
+        return "%s_rank%d.json" % (self._name, self._rank)
+
+    def _stateful(self):
+        """The object whose cursor is exact: when the loader is being
+        consumed through a DevicePrefetcher, the prefetcher's state is
+        aligned to DELIVERED batches while the bare loader's cursor runs
+        up to depth+1 batches ahead — checkpointing the latter would
+        skip the in-queue batches on resume."""
+        ref = getattr(self._loader, "_device_prefetcher", None)
+        pf = ref() if ref is not None else None
+        return pf if pf is not None else self._loader
+
+    def snapshot(self):
+        self._state = json.loads(json.dumps(self._stateful().state_dict()))
+
+    def serialize(self, path):
+        if self._state is None:
+            self.snapshot()
+        with open(os.path.join(path, self.filename), "w") as f:
+            json.dump(self._state, f)
+        return [self.filename]
+
+    def deserialize(self, path):
+        fp = os.path.join(path, self.filename)
+        if not os.path.exists(fp):
+            # the checkpoint predates this loader's attachment (or was
+            # saved with different loader names): params still restore,
+            # the loader just starts fresh — degrade loudly, not fatally
+            print(
+                "DataLoaderCheckpoint[%s]: checkpoint has no %s; "
+                "iteration state starts fresh" % (self._name, self.filename),
+                file=sys.stderr)
+            self._restored = None
+            return None
+        with open(fp) as f:
+            state = json.load(f)
+        self._stateful().load_state_dict(state)
+        self._restored = state
+        return state
+
+    def restored_epoch(self):
+        """Epoch the restored cursor sits in (None before any restore or
+        for a loader whose state carries no epoch) — lets TrainEpochRange
+        tell 'mid-epoch e' from 'epoch e finished, e+1 not started'."""
+        state = getattr(self, "_restored", None)
+        if not isinstance(state, dict):
+            return None
+        inner = state.get("sampler", state)
+        if isinstance(inner, dict) and "epoch" in inner:
+            return int(inner["epoch"])
+        return None

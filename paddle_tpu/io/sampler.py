@@ -1,0 +1,136 @@
+"""Deterministic, resumable, per-host-sharded batch sampling.
+
+Layered over `fluid.reader.DistributedBatchSampler` (same env contract,
+same pad-to-equal-batch-count discipline) with the two properties the
+plain sampler lacks:
+
+  * the epoch permutation is derived from `SeedSequence([seed, epoch])`,
+    not `seed + epoch` — (seed=3, epoch=0) and (seed=2, epoch=1) no
+    longer collide, so every (seed, epoch) pair is an independent global
+    permutation shared by all ranks, and every rank's shard is a disjoint
+    strided slice of it regardless of when (or whether) the process was
+    restarted;
+  * iteration is POSITIONAL: the sampler remembers how many batches of
+    the current epoch it has handed out, `state_dict()/load_state_dict()`
+    round-trips that position, and a fresh process resumes exactly at the
+    first unconsumed batch — no replay, no skip.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..fluid.reader import DistributedBatchSampler
+
+__all__ = ["ShardedBatchSampler"]
+
+
+class ShardedBatchSampler(DistributedBatchSampler):
+    """Epoch-seeded global permutation, rank-disjoint, offset-resumable.
+
+    Semantics:
+      * `__iter__` yields the LOCAL batches of the current epoch starting
+        at the stored offset, advancing it per batch (a mid-epoch `break`
+        leaves the position where the consumer stopped);
+      * exhausting an epoch auto-advances to the next (epoch += 1,
+        offset = 0), so back-to-back `for b in sampler` loops walk
+        successive epochs without any `set_epoch` calls;
+      * `set_epoch(e)` rewinds to the start of epoch e — unless e is the
+        current epoch, in which case the (possibly restored mid-epoch)
+        position is KEPT, so the conventional `set_epoch(epoch)` at the
+        top of a resumed epoch loop cannot clobber a restore.
+    """
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=True, drop_last=False, seed=0):
+        super().__init__(dataset, batch_size, num_replicas=num_replicas,
+                         rank=rank, shuffle=shuffle, drop_last=drop_last,
+                         seed=seed)
+        self._offset = 0  # batches of the CURRENT epoch already yielded
+
+    # -- deterministic shard ---------------------------------------------
+    def _permutation(self):
+        idx = np.arange(self.n)
+        if self.shuffle:
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self._seed_base, self.epoch]))
+            rng.shuffle(idx)
+        return idx
+
+    def local_batches(self, epoch=None):
+        """The full list of this rank's batches for `epoch` (default: the
+        current one) — pure function of (seed, epoch, rank, nranks)."""
+        if epoch is not None and epoch != self.epoch:
+            saved, self.epoch = self.epoch, int(epoch)
+            try:
+                return self.local_batches()
+            finally:
+                self.epoch = saved
+        return self._shard_batches(self._permutation())
+
+    def _num_batches(self):
+        """Per-epoch local batch count without materializing the
+        permutation (state_dict runs per delivered batch) — the parent's
+        arithmetic, kept single-sourced."""
+        return DistributedBatchSampler.__len__(self)
+
+    # -- positional iteration --------------------------------------------
+    def __iter__(self):
+        batches = self.local_batches()
+        if batches and self._offset >= len(batches):
+            # position says "epoch complete" (a consumer stopped exactly
+            # on the last batch, skipping the generator's epilogue):
+            # start the next epoch instead of yielding an empty one
+            self.epoch += 1
+            self._offset = 0
+            batches = self.local_batches()
+        while self._offset < len(batches):
+            b = batches[self._offset]
+            self._offset += 1
+            yield b
+        self.epoch += 1
+        self._offset = 0
+
+    def __len__(self):
+        return self._num_batches()
+
+    def set_epoch(self, epoch):
+        """Rewind to the start of `epoch`; no-op if already positioned in
+        it (preserves a mid-epoch restore, see class docstring)."""
+        epoch = int(epoch)
+        if epoch != self.epoch:
+            self.epoch = epoch
+            self._offset = 0
+
+    # -- resume -----------------------------------------------------------
+    def state_dict(self):
+        # canonicalize "every batch of epoch e consumed" to "epoch e+1
+        # not started" — they are the same position, and emitting one
+        # form keeps a restore from replaying or shifting an epoch
+        epoch, offset = self.epoch, self._offset
+        n = self._num_batches()
+        if n and offset >= n:
+            epoch, offset = epoch + 1, 0
+        return {
+            "epoch": epoch,
+            "offset": offset,
+            "seed": self._seed_base,
+            "nranks": self.nranks,
+            "rank": self.rank,
+        }
+
+    def load_state_dict(self, state):
+        if int(state.get("nranks", self.nranks)) != self.nranks:
+            raise ValueError(
+                "ShardedBatchSampler state was saved with nranks=%s but "
+                "this run has nranks=%d — the shard layout would differ; "
+                "elastic resharding is not supported"
+                % (state.get("nranks"), self.nranks))
+        if int(state.get("seed", self._seed_base)) != self._seed_base:
+            raise ValueError(
+                "ShardedBatchSampler state was saved with seed=%s but "
+                "this sampler uses seed=%d — resuming would change the "
+                "permutation mid-epoch" % (state.get("seed"),
+                                           self._seed_base))
+        self.epoch = int(state["epoch"])
+        self._offset = int(state["offset"])

@@ -1,0 +1,48 @@
+"""Input-pipeline observability over `fluid.profiler` Counter/Histogram.
+
+The serving path (PR 2) answered "is the server batching well" with
+always-on aggregates; training needs the symmetric question answered —
+"is this run input-bound or compute-bound".  One `PipelineStats` instance
+rides through the io stages (loader -> packing -> prefetcher) and keeps
+the four numbers that decide it:
+
+  * step_wait_ms        how long the trainer blocked waiting for a batch
+                        (≈0 when compute-bound; the whole story when
+                        input-bound)
+  * h2d_copy_ms         dispatch+copy time of `jax.device_put` per batch
+  * prefetch_queue_depth  occupancy of the device-batch queue when the
+                        trainer takes a batch (pinned at 0 = producer is
+                        the bottleneck; pinned at depth = consumer is)
+  * packing_efficiency  real tokens / row capacity of the packing stage
+"""
+
+from __future__ import annotations
+
+from ..fluid.profiler import Counter, Histogram
+
+__all__ = ["PipelineStats"]
+
+
+class PipelineStats:
+    """Always-on aggregate metrics for one input pipeline."""
+
+    def __init__(self, name="io"):
+        self.name = name
+        self.batches = Counter("%s.batches" % name)
+        self.samples = Counter("%s.samples" % name)
+        self.step_wait_ms = Histogram("%s.step_wait_ms" % name)
+        self.h2d_copy_ms = Histogram("%s.h2d_copy_ms" % name)
+        self.queue_depth = Histogram("%s.prefetch_queue_depth" % name)
+        self.packing_efficiency = Histogram("%s.packing_efficiency" % name)
+
+    def summary(self):
+        """One dict a trainer can print/log to diagnose input-boundness."""
+        return {
+            "name": self.name,
+            "batches": self.batches.value,
+            "samples": self.samples.value,
+            "step_wait_ms": self.step_wait_ms.summary(),
+            "h2d_copy_ms": self.h2d_copy_ms.summary(),
+            "prefetch_queue_depth": self.queue_depth.summary(),
+            "packing_efficiency": self.packing_efficiency.summary(),
+        }

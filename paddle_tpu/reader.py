@@ -10,18 +10,25 @@ import random as _random
 import numpy as np
 
 
-def shuffle(reader, buf_size):
-    """cf. reference reader.shuffle: buffered shuffling of a reader."""
+def shuffle(reader, buf_size, seed=None):
+    """cf. reference reader.shuffle: buffered shuffling of a reader.
+
+    `seed=` (parity with `fluid.reader.shuffle`) makes the order
+    deterministic and stable across re-iterations.  Unseeded use is
+    EXPLICITLY nondeterministic: each call draws a fresh OS-entropy RNG
+    (never the process-global `random` module, whose hidden state made
+    "unseeded" runs silently couple to unrelated code)."""
 
     def _impl():
+        rs = _random.Random(seed) if seed is not None else _random.Random()
         buf = []
         for ex in reader():
             buf.append(ex)
             if len(buf) >= buf_size:
-                _random.shuffle(buf)
+                rs.shuffle(buf)
                 while buf:
                     yield buf.pop()
-        _random.shuffle(buf)
+        rs.shuffle(buf)
         while buf:
             yield buf.pop()
 

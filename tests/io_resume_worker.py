@@ -1,0 +1,107 @@
+"""io mid-epoch resume drill worker: deterministic training over a
+`io.ResumableDataLoader` under `TrainEpochRange(data_loaders=...)`, with
+per-step checkpointing and an optional SIGKILL mid-epoch.  Env knobs:
+
+  IOR_WORKSPACE     checkpoint root
+  IOR_EPOCHS        total epochs the JOB must complete
+  IOR_KILL_AT       "epoch:step" at which to SIGKILL ourselves AFTER the
+                    step trained but BEFORE any further checkpoint
+                    ("" = never)
+  IOR_SAVE_EVERY    checkpoint every k steps (mid-epoch, sync saves)
+  IOR_RESULT        path for the result JSON (written only on completion)
+
+The result records every (epoch, sample_ids) batch consumed by THIS
+process plus final weights, so the test can assert the resumed run
+consumed exactly the remainder the last committed checkpoint implies —
+no duplicated, no dropped samples.
+"""
+
+import json
+import os
+import re
+import signal
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
+os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=1"
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.io as io
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.incubate.checkpoint import TrainEpochRange
+
+    ws = os.environ["IOR_WORKSPACE"]
+    epochs = int(os.getenv("IOR_EPOCHS", "3"))
+    save_every = int(os.getenv("IOR_SAVE_EVERY", "2"))
+    kill_at = os.getenv("IOR_KILL_AT", "")
+    kill_epoch, kill_step = (
+        [int(v) for v in kill_at.split(":")] if kill_at else (-1, -1))
+
+    N, D, B = 24, 4, 3
+    rng = np.random.RandomState(11)
+    xs = rng.randn(N, D).astype(np.float32)
+    w_true = rng.randn(D, 1).astype(np.float32)
+    ys = (xs @ w_true).astype(np.float32)
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main_p, startup):
+        x = layers.data("x", shape=[-1, D], append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        pred = layers.fc(x, 1, param_attr="ior.w", bias_attr="ior.b")
+        loss = layers.reduce_mean(layers.square(pred - y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+
+    class Pairs(io.Dataset):
+        def __len__(self):
+            return N
+
+        def __getitem__(self, i):
+            # dict samples: exercises the dict default_collate
+            return {"x": xs[i], "y": ys[i], "idx": np.int64(i)}
+
+    loader = io.ResumableDataLoader(
+        Pairs(), batch_size=B, shuffle=True, seed=17,
+        num_replicas=1, rank=0)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    consumed = []           # (epoch, [sample ids]) per trained batch
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        tr = TrainEpochRange(
+            epochs, checkpoint_dir=ws, main_program=main_p,
+            async_save=False, data_loaders=loader, verbose=True)
+        for e in tr:
+            loader.set_epoch(e)   # must NOT clobber a mid-epoch restore
+            for t, batch in enumerate(loader):
+                (lv,) = exe.run(
+                    main_p, feed={"x": batch["x"], "y": batch["y"]},
+                    fetch_list=[loss])
+                losses.append(float(np.mean(lv)))
+                consumed.append([e, [int(i) for i in batch["idx"]]])
+                if e == kill_epoch and t == kill_step:
+                    os.kill(os.getpid(), signal.SIGKILL)  # preemption
+                if (t + 1) % save_every == 0:
+                    tr.save_checkpoint(e, step=t)
+        final_w = np.asarray(scope.find_var("ior.w")).tolist()
+
+    with open(os.environ["IOR_RESULT"], "w") as f:
+        json.dump({
+            "consumed": consumed,
+            "losses": losses,
+            "start_epoch": tr.start_epoch,
+            "restored_from": tr.restored_from,
+            "restored_step": tr.restored_step,
+            "final_w": final_w,
+        }, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,817 @@
+"""paddle_tpu.io subsystem: device prefetch, resumable iteration,
+sharded determinism, packing stage, checkpoint wiring (ISSUE 3).
+
+Reference capability: `paddle.io` loader surface + py_reader/double-
+buffer device feeding; the resume/determinism guarantees follow the
+tf.data-checkpoint / torchdata-StatefulDataLoader contract the reference
+never had."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.io as io
+from paddle_tpu.fluid.reader import default_collate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "io_resume_worker.py")
+
+
+def _ds(n=20, d=2):
+    return io.TensorDataset(
+        np.arange(n * d, dtype=np.float32).reshape(n, d),
+        np.arange(n, dtype=np.int64))
+
+
+def _ids(batches):
+    return [int(i) for b in batches for i in b]
+
+
+# ---------------------------------------------------------------------------
+# ShardedBatchSampler: disjoint, deterministic, resumable
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_sampler_disjoint_cover_and_deterministic():
+    ds = _ds(20)
+    samplers = [
+        io.ShardedBatchSampler(ds, 3, num_replicas=4, rank=r, seed=7)
+        for r in range(4)
+    ]
+    shards = [_ids(s.local_batches(0)) for s in samplers]
+    # pairwise disjoint (up to the pad tile), union covers the dataset
+    assert set().union(*map(set, shards)) == set(range(20))
+    for a in range(4):
+        for b in range(a + 1, 4):
+            assert not (set(shards[a]) & set(shards[b]))
+    # equal batch counts per rank (collective-step safety)
+    assert len({len(s.local_batches(0)) for s in samplers}) == 1
+    # same (seed, epoch) -> same permutation on a fresh process-like object
+    again = io.ShardedBatchSampler(ds, 3, num_replicas=4, rank=2, seed=7)
+    assert again.local_batches(0) == samplers[2].local_batches(0)
+    # different epochs -> different permutations
+    assert samplers[0].local_batches(1) != samplers[0].local_batches(0)
+
+
+def test_sharded_sampler_seed_epoch_mixing_no_collision():
+    """seed+epoch arithmetic collided ((3,0) == (2,1)); SeedSequence
+    mixing must not."""
+    ds = _ds(32)
+    a = io.ShardedBatchSampler(ds, 4, num_replicas=1, rank=0, seed=3)
+    b = io.ShardedBatchSampler(ds, 4, num_replicas=1, rank=0, seed=2)
+    assert a.local_batches(0) != b.local_batches(1)
+
+
+def test_sharded_sampler_resume_consumes_exact_remainder():
+    ds = _ds(22)
+    s = io.ShardedBatchSampler(ds, 4, num_replicas=2, rank=1, seed=5)
+    full = s.local_batches(0)
+    it = iter(s)
+    head = [next(it) for _ in range(2)]
+    state = s.state_dict()
+    assert state["epoch"] == 0 and state["offset"] == 2
+
+    fresh = io.ShardedBatchSampler(ds, 4, num_replicas=2, rank=1, seed=5)
+    fresh.load_state_dict(state)
+    rest = list(fresh)
+    assert head + rest == full          # no replay, no skip
+    # exhaustion auto-advanced the epoch
+    assert fresh.epoch == 1 and fresh.state_dict()["offset"] == 0
+
+
+def test_sharded_sampler_state_guards():
+    ds = _ds(12)
+    s = io.ShardedBatchSampler(ds, 3, num_replicas=2, rank=0, seed=1)
+    with pytest.raises(ValueError, match="nranks"):
+        s.load_state_dict({"epoch": 0, "offset": 0, "nranks": 4, "seed": 1})
+    with pytest.raises(ValueError, match="seed"):
+        s.load_state_dict({"epoch": 0, "offset": 0, "nranks": 2, "seed": 9})
+
+
+def test_sharded_sampler_set_epoch_keeps_midepoch_position():
+    ds = _ds(18)
+    s = io.ShardedBatchSampler(ds, 3, num_replicas=1, rank=0, seed=2)
+    it = iter(s)
+    next(it), next(it)
+    s.set_epoch(0)                      # same epoch: restore-safe no-op
+    assert s.state_dict()["offset"] == 2
+    s.set_epoch(3)                      # different epoch: rewinds
+    assert s.epoch == 3 and s.state_dict()["offset"] == 0
+
+
+def test_sampler_break_on_last_batch_next_epoch_not_empty():
+    """A steps-per-epoch loop that breaks exactly on the last batch
+    skips the generator epilogue; the next iteration must start the
+    next epoch, not yield an empty one (review fix)."""
+    ds = _ds(8)
+    s = io.ShardedBatchSampler(ds, 4, num_replicas=1, rank=0, seed=0)
+    for i, _b in enumerate(s):
+        if i == 1:
+            break                       # consumed both batches, no drain
+    nxt = list(s)
+    assert len(nxt) == 2                # a full epoch, not zero batches
+    assert s.epoch == 2                 # ... and it was epoch 1's data
+
+
+def test_prefetcher_break_rewinds_undelivered_prefetch():
+    """break mid-iteration: batches the producer pulled ahead but never
+    delivered must return to the source cursor (review fix)."""
+    ds = _ds(16)
+    ld = io.ResumableDataLoader(ds, batch_size=2, seed=6,
+                                num_replicas=1, rank=0)
+    expect = [by.tolist() for _, by in io.ResumableDataLoader(
+        ds, batch_size=2, seed=6, num_replicas=1, rank=0)]
+    pf = io.DevicePrefetcher(ld, depth=3)
+    got = []
+    for _, by in pf:
+        got.append(np.asarray(by).tolist())
+        if len(got) == 2:
+            break
+    time.sleep(0.2)                     # let teardown settle
+    assert ld.state_dict()["sampler"]["offset"] == 2
+    for _, by in pf:                    # remainder of the SAME epoch
+        got.append(np.asarray(by).tolist())
+    assert got == expect                # nothing dropped, nothing doubled
+
+
+def test_prefetcher_state_exact_before_first_delivery():
+    """After load_state_dict, state_dict() must report the restored
+    cursor even while the producer is already pulling ahead."""
+    ds = _ds(20)
+    ld = io.ResumableDataLoader(ds, batch_size=2, seed=2,
+                                num_replicas=1, rank=0)
+    pf = io.DevicePrefetcher(ld, depth=4)
+    restored = {"sampler": {"epoch": 0, "offset": 3, "seed": 2,
+                            "nranks": 1, "rank": 0}}
+    pf.load_state_dict(restored)
+    assert pf.state_dict() == restored
+    it = iter(pf)                       # producer starts running ahead
+    time.sleep(0.2)
+    assert pf.state_dict()["sampler"]["offset"] == 3  # still exact
+    next(it)
+    assert pf.state_dict()["sampler"]["offset"] == 4
+    it.close()
+
+
+def test_sampler_end_of_epoch_state_canonicalized():
+    """'all of epoch e consumed' must serialize as 'epoch e+1, offset 0'
+    so a restore + set_epoch(e+1) cannot replay or shift an epoch."""
+    ds = _ds(8)
+    s = io.ShardedBatchSampler(ds, 4, num_replicas=1, rank=0, seed=0)
+    it = iter(s)
+    next(it), next(it)                  # both batches, iterator NOT drained
+    st = s.state_dict()
+    assert st["epoch"] == 1 and st["offset"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ResumableDataLoader
+# ---------------------------------------------------------------------------
+
+
+def test_resumable_loader_midepoch_roundtrip():
+    ds = _ds(20)
+    mk = lambda: io.ResumableDataLoader(ds, batch_size=3, seed=9,
+                                        num_replicas=1, rank=0)
+    full = [bx[:, 0].tolist() for bx, _ in mk()]
+    ld = mk()
+    it = iter(ld)
+    head = [next(it)[0][:, 0].tolist() for _ in range(3)]
+    state = ld.state_dict()
+
+    ld2 = mk()
+    ld2.load_state_dict(state)
+    rest = [bx[:, 0].tolist() for bx, _ in ld2]
+    assert head + rest == full
+    assert ld2.epoch == 1               # auto-advanced after exhaustion
+
+
+def test_resumable_loader_epochs_auto_advance_and_differ():
+    ds = _ds(12)
+    ld = io.ResumableDataLoader(ds, batch_size=3, seed=4,
+                                num_replicas=1, rank=0)
+    e0 = [by.tolist() for _, by in ld]
+    e1 = [by.tolist() for _, by in ld]   # next for-loop = next epoch
+    assert sorted(sum(e0, [])) == sorted(sum(e1, [])) == list(range(12))
+    assert e0 != e1
+
+
+# ---------------------------------------------------------------------------
+# default_collate satellites (dict samples, clear errors)
+# ---------------------------------------------------------------------------
+
+
+def test_default_collate_dict_samples():
+    items = [{"a": np.ones(2) * i, "b": np.int64(i)} for i in range(3)]
+    out = default_collate(items)
+    assert set(out) == {"a", "b"}
+    assert out["a"].shape == (3, 2) and out["b"].tolist() == [0, 1, 2]
+
+
+def test_default_collate_clear_errors():
+    with pytest.raises(TypeError, match="share one key set"):
+        default_collate([{"a": 1}, {"b": 2}])
+    with pytest.raises(TypeError, match="collate_fn"):
+        default_collate(["a string sample"])
+
+
+def test_dataloader_state_aligned_to_yielded_batches():
+    """DataLoader's internal prefetch thread pulls the sampler ahead of
+    the consumer; state_dict() must report the YIELDED position, not the
+    pulled one (review fix)."""
+    ds = _ds(20)
+    dl = io.DataLoader(ds, batch_sampler=io.ShardedBatchSampler(
+        ds, 2, num_replicas=1, rank=0, seed=7), capacity=4)
+    it = iter(dl)
+    next(it), next(it)
+    time.sleep(0.2)                     # thread fills the queue
+    assert dl.batch_sampler.state_dict()["offset"] > 2  # raw cursor ahead
+    assert dl.state_dict()["sampler"]["offset"] == 2    # aligned
+    # and a fresh loader restored from it resumes at batch 3 exactly
+    state = dl.state_dict()
+    dl2 = io.DataLoader(ds, batch_sampler=io.ShardedBatchSampler(
+        ds, 2, num_replicas=1, rank=0, seed=7))
+    dl2.load_state_dict(state)
+    rest = [by.tolist() for _, by in dl2]
+    full = [by.tolist() for _, by in io.DataLoader(
+        ds, batch_sampler=io.ShardedBatchSampler(
+            ds, 2, num_replicas=1, rank=0, seed=7))]
+    assert rest == full[2:]
+
+
+def test_dataloader_dict_dataset_end_to_end():
+    class DictDS(io.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return {"x": np.full((2,), float(i), np.float32),
+                    "y": np.int64(i)}
+
+    batches = list(io.DataLoader(DictDS(), batch_size=4, shuffle=False))
+    assert isinstance(batches[0], dict)
+    assert batches[0]["x"].shape == (4, 2)
+    assert batches[1]["y"].tolist() == [4, 5, 6, 7]
+
+
+# ---------------------------------------------------------------------------
+# reader.shuffle seed satellite
+# ---------------------------------------------------------------------------
+
+
+def test_toplevel_reader_shuffle_seeded():
+    from paddle_tpu.reader import shuffle
+
+    r = shuffle(lambda: iter(range(20)), 8, seed=3)
+    a, b = list(r()), list(r())
+    assert a == b and sorted(a) == list(range(20))
+    # parity with the fluid decorator's seeded behavior
+    r2 = shuffle(lambda: iter(range(20)), 8, seed=4)
+    assert list(r2()) != a
+
+
+# ---------------------------------------------------------------------------
+# DevicePrefetcher
+# ---------------------------------------------------------------------------
+
+
+def test_prefetcher_preserves_content_and_lands_on_device():
+    import jax
+
+    ds = _ds(16)
+    ld = io.ResumableDataLoader(ds, batch_size=4, shuffle=False,
+                                num_replicas=1, rank=0)
+    host = [bx for bx, _ in ld]
+    ld.set_epoch(0)
+    dev = list(io.DevicePrefetcher(ld, depth=2))
+    assert len(dev) == len(host)
+    for (hb, db) in zip(host, dev):
+        assert isinstance(db[0], jax.Array)
+        np.testing.assert_array_equal(hb, np.asarray(db[0]))
+
+
+def test_prefetcher_shards_batch_dim_over_mesh():
+    from paddle_tpu import distributed as dist
+
+    mesh = dist.auto_mesh(8)
+    ds = _ds(32)
+    ld = io.ResumableDataLoader(ds, batch_size=16, shuffle=False,
+                                num_replicas=1, rank=0)
+    (first, *_rest) = list(io.DevicePrefetcher(ld, depth=2, mesh=mesh))
+    bx, by = first
+    assert len(bx.sharding.device_set) == 8   # split across all devices
+    # odd leading dims replicate instead of crashing
+    ragged = io.DevicePrefetcher([(np.ones((3, 2)),)], mesh=mesh)
+    (rb,) = list(ragged)
+    np.testing.assert_array_equal(np.asarray(rb[0]), np.ones((3, 2)))
+
+
+def test_prefetcher_state_aligned_to_delivered_not_prefetched():
+    """With depth 4 the producer runs ahead; state_dict() must reflect
+    what the trainer consumed, not what the queue holds."""
+    ds = _ds(20)
+    ld = io.ResumableDataLoader(ds, batch_size=2, seed=1,
+                                num_replicas=1, rank=0)
+    pf = io.DevicePrefetcher(ld, depth=4)
+    it = iter(pf)
+    next(it)
+    time.sleep(0.3)                     # let the producer fill the queue
+    next(it)
+    state = pf.state_dict()
+    assert state["sampler"]["offset"] == 2, state
+    it.close()
+
+    # resuming from that state yields batch 3 onward, exactly
+    ld2 = io.ResumableDataLoader(ds, batch_size=2, seed=1,
+                                 num_replicas=1, rank=0)
+    full = [by.tolist() for _, by in ld2]
+    ld3 = io.ResumableDataLoader(ds, batch_size=2, seed=1,
+                                 num_replicas=1, rank=0)
+    ld3.load_state_dict(state)
+    rest = [np.asarray(by).tolist() for _, by in io.DevicePrefetcher(ld3)]
+    assert rest == full[2:]
+
+
+def test_prefetcher_overlaps_producer_and_consumer():
+    import jax
+
+    jax.device_put(np.zeros(1))         # backend init outside timing
+
+    def slow_source():
+        for i in range(6):
+            time.sleep(0.05)
+            yield (np.full((2,), i, np.float32),)
+
+    # serial reference measured in-process so host load cancels out
+    t0 = time.perf_counter()
+    for _ in slow_source():
+        time.sleep(0.05)
+    serial = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in io.DevicePrefetcher(slow_source(), depth=2):
+        time.sleep(0.05)                # consumer work
+    wall = time.perf_counter() - t0
+    # the stalls (0.3s producer + 0.3s consumer) must overlap
+    assert wall < serial * 0.82, (wall, serial)
+
+
+def test_prefetcher_propagates_source_error():
+    def poisoned():
+        yield (np.zeros(2),)
+        raise ValueError("decode failed")
+
+    with pytest.raises(ValueError, match="decode failed"):
+        list(io.DevicePrefetcher(poisoned()))
+
+
+def test_prefetcher_metrics_populated():
+    ds = _ds(12)
+    ld = io.ResumableDataLoader(ds, batch_size=3, num_replicas=1, rank=0)
+    pf = io.DevicePrefetcher(ld, depth=2)
+    list(pf)
+    s = pf.stats.summary()
+    assert s["batches"] == 4
+    assert s["step_wait_ms"]["count"] >= 4
+    assert s["h2d_copy_ms"]["count"] == 4
+    assert s["prefetch_queue_depth"]["count"] == 4
+
+
+# ---------------------------------------------------------------------------
+# PackingStage
+# ---------------------------------------------------------------------------
+
+
+def test_packing_stage_fixed_shapes_and_efficiency():
+    rng = np.random.RandomState(0)
+
+    def seq_batches():
+        for _ in range(4):
+            yield [rng.randint(1, 9, size=int(rng.randint(2, 11)))
+                   .astype(np.int64) for _ in range(8)]
+
+    stage = io.PackingStage(seq_batches(), seq_len=12, max_rows=6)
+    toks_in, toks_packed = 0, 0
+    for b in stage:
+        assert b["data"].shape == (6, 12)       # static across batches
+        assert b["segment_ids"].shape == (6, 12)
+        toks_packed += int(np.count_nonzero(b["segment_ids"]))
+        # positions restart per segment
+        row = b["segment_ids"][0]
+        pos = b["positions"][0]
+        for seg in range(1, int(row.max()) + 1):
+            sel = pos[row == seg]
+            assert sel.tolist() == list(range(len(sel)))
+    eff = stage.stats.packing_efficiency.summary()
+    assert eff["count"] == 4 and 0.0 < eff["mean"] <= 1.0
+
+
+def test_packing_stage_passes_state_through():
+    ds = _ds(16)
+
+    class SeqLoader:
+        """Minimal stateful source yielding sequence lists."""
+
+        def __init__(self):
+            self.sampler = io.ShardedBatchSampler(
+                ds, 4, num_replicas=1, rank=0, seed=3)
+
+        def __iter__(self):
+            for idxs in self.sampler:
+                yield [np.arange(1 + (i % 5), dtype=np.int64) + 1
+                       for i in idxs]
+
+        def state_dict(self):
+            return self.sampler.state_dict()
+
+        def load_state_dict(self, s):
+            self.sampler.load_state_dict(s)
+
+    src = SeqLoader()
+    stage = io.PackingStage(src, seq_len=8, max_rows=4)
+    it = iter(stage)
+    next(it)
+    assert stage.state_dict()["offset"] == 1
+    stage.load_state_dict({"epoch": 2, "offset": 0, "nranks": 1,
+                           "rank": 0, "seed": 3})
+    assert src.sampler.epoch == 2
+
+
+# ---------------------------------------------------------------------------
+# hapi fit integration
+# ---------------------------------------------------------------------------
+
+
+def test_hapi_fit_device_prefetch_matches_plain_fit():
+    from paddle_tpu import hapi, nn
+    from paddle_tpu.fluid import dygraph
+    from paddle_tpu.fluid.optimizer import SGDOptimizer
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(24, 4).astype(np.float32)
+    y = rng.randint(0, 3, (24, 1)).astype(np.int64)
+
+    def run(prefetch):
+        with dygraph.guard():
+            net = nn.Linear(4, 3)
+            # deterministic init across the two runs
+            import jax.numpy as jnp
+
+            net.weight.data = jnp.asarray(
+                np.linspace(-1, 1, 12, dtype=np.float32).reshape(4, 3))
+            net.bias.data = jnp.zeros(3, jnp.float32)
+            m = hapi.Model(net)
+            m.prepare(
+                optimizer=SGDOptimizer(0.1),
+                loss_function=lambda p, t: nn.functional.cross_entropy(p, t))
+            h = m.fit((x, y), batch_size=8, epochs=2, verbose=0,
+                      shuffle=False, device_prefetch=prefetch)
+            return h["loss"], m
+
+    plain, _ = run(False)
+    pre, model = run(True)
+    np.testing.assert_allclose(plain, pre, rtol=1e-6)
+    assert model.io_stats.batches.value == 6  # 3 batches x 2 epochs
+
+
+def test_hapi_fit_device_prefetch_wraps_loader_statefully():
+    """fit(loader, device_prefetch=True) must wrap the LOADER (so the
+    delivered-batch alignment contract holds), not the per-epoch
+    generator (review fix)."""
+    from paddle_tpu import hapi, nn
+    from paddle_tpu.fluid import dygraph
+    from paddle_tpu.fluid.optimizer import SGDOptimizer
+
+    ds = io.TensorDataset(
+        np.random.RandomState(0).randn(16, 4).astype(np.float32),
+        np.random.RandomState(1).randint(0, 3, (16, 1)).astype(np.int64))
+    ld = io.ResumableDataLoader(ds, batch_size=4, seed=2,
+                                num_replicas=1, rank=0)
+    with dygraph.guard():
+        m = hapi.Model(nn.Linear(4, 3))
+        m.prepare(optimizer=SGDOptimizer(0.1),
+                  loss_function=nn.functional.cross_entropy)
+        m.fit(ld, epochs=2, verbose=0, device_prefetch=True)
+    assert getattr(ld, "_device_prefetcher", None) is not None
+    assert ld.epoch == 2                    # both epochs fully consumed
+    assert m.io_stats.batches.value == 8
+
+
+def test_midepoch_meta_without_loader_state_skips_to_next_epoch(tmp_path):
+    """A step!=None checkpoint restored WITHOUT any loader cursor must
+    not re-enter the epoch from batch 0 (double-training its head);
+    it falls back to epoch+1 (review fix)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.incubate.checkpoint import TrainEpochRange
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 2], append_batch_size=False)
+        y = layers.fc(x, 1, param_attr="ml.w", bias_attr="ml.b")
+        layers.reduce_mean(y)
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        tr = TrainEpochRange(5, checkpoint_dir=str(tmp_path),
+                             main_program=main, async_save=False)
+        tr.save_checkpoint(2, step=3)       # mid-epoch, no data_loaders
+        tr.wait()
+        tr2 = TrainEpochRange(5, checkpoint_dir=str(tmp_path),
+                              main_program=main, async_save=False)
+        assert tr2.restored_from == 2 and tr2.restored_step == 3
+        assert tr2.start_epoch == 3         # NOT 2
+
+
+# ---------------------------------------------------------------------------
+# static Executor loop integration
+# ---------------------------------------------------------------------------
+
+
+def test_executor_accepts_device_resident_feed():
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 4], append_batch_size=False)
+        out = layers.reduce_sum(layers.square(x), dim=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    a = np.random.RandomState(0).randn(6, 4).astype(np.float32)
+    (host_out,) = exe.run(main, feed={"x": a}, fetch_list=[out])
+    (dev_out,) = exe.run(main, feed={"x": jax.device_put(a)},
+                         fetch_list=[out])
+    np.testing.assert_allclose(host_out, dev_out, rtol=1e-6)
+
+
+def test_executor_loop_over_prefetcher():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 2], append_batch_size=False)
+        out = layers.reduce_sum(x, dim=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ds = _ds(12)
+    ld = io.ResumableDataLoader(
+        ds, batch_size=4, shuffle=False, num_replicas=1, rank=0,
+        collate_fn=lambda xs: {"x": np.stack([t[0] for t in xs])})
+    total = 0.0
+    for feed in io.DevicePrefetcher(ld, depth=2):
+        (o,) = exe.run(main, feed=feed, fetch_list=[out])
+        total += float(o.sum())
+    np.testing.assert_allclose(
+        total, np.arange(24, dtype=np.float32).sum(), rtol=1e-6)
+
+
+def test_prefetcher_over_stateless_dataloader_works():
+    """A plain DataLoader EXPOSES state_dict but raises TypeError (no
+    stateful sampler); the prefetcher must treat it as stateless, not
+    crash (review fix)."""
+    batches = list(io.DevicePrefetcher(
+        io.DataLoader(_ds(8), batch_size=4, shuffle=False)))
+    assert len(batches) == 2
+    gen_fed = io.DataLoader.from_generator(capacity=2)
+    gen_fed.set_batch_generator(
+        lambda: iter([(np.ones((2, 2), np.float32),)]))
+    assert len(list(io.DevicePrefetcher(gen_fed))) == 1
+    # PackingStage over a stateless source: same contract
+    stage = io.PackingStage(
+        [[np.arange(3, dtype=np.int64) + 1] * 4], seq_len=8, max_rows=2)
+    assert len(list(io.DevicePrefetcher(stage))) == 1
+
+
+def test_prefetcher_set_epoch_on_stateless_source_is_safe():
+    pf = io.DevicePrefetcher(io.DataLoader(_ds(8), batch_size=4))
+    pf.set_epoch(0)                      # must not raise (review fix)
+    assert len(list(pf)) == 2
+
+
+def test_prefetcher_namedtuple_batches():
+    import collections
+
+    Batch = collections.namedtuple("Batch", ["x", "y"])
+    src = [Batch(np.ones((2, 2), np.float32), np.zeros(2, np.int64))]
+    (got,) = list(io.DevicePrefetcher(src))
+    assert isinstance(got, Batch)
+    np.testing.assert_array_equal(np.asarray(got.x), src[0].x)
+
+
+def test_prefetcher_producer_error_rewinds_inflight_batch():
+    """A placement failure must not advance the cursor past the batch
+    that never reached the trainer (review fix)."""
+    ds = _ds(12)
+    ld = io.ResumableDataLoader(ds, batch_size=2, seed=5,
+                                num_replicas=1, rank=0)
+
+    calls = {"n": 0}
+
+    class Boom(Exception):
+        pass
+
+    def flaky_collate(items):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise Boom("transient decode failure")
+        return default_collate(items)
+
+    ld.collate_fn = flaky_collate
+    pf = io.DevicePrefetcher(ld, depth=2)
+    seen = []
+    with pytest.raises(Boom):
+        for _, by in pf:
+            seen.append(by.tolist())
+    # batches 1-2 delivered; batch 3 failed INSIDE the source pull, so
+    # the cursor must sit right after the delivered ones
+    assert pf.state_dict()["sampler"]["offset"] == len(seen)
+    rest = [np.asarray(by).tolist() for _, by in pf]
+    full = [by.tolist() for _, by in io.ResumableDataLoader(
+        ds, batch_size=2, seed=5, num_replicas=1, rank=0)]
+    assert seen + rest == full
+
+
+def test_prefetcher_second_iterator_stops_first_producer():
+    """Abandoning an iterator (no close) then starting a new one must
+    not leave two producers draining the source (review fix)."""
+    ds = _ds(20)
+    ld = io.ResumableDataLoader(ds, batch_size=2, seed=3,
+                                num_replicas=1, rank=0)
+    pf = io.DevicePrefetcher(ld, depth=2)
+    it1 = iter(pf)
+    first = np.asarray(next(it1)[1]).tolist()
+    # no it1.close(): simulate an abandoned reference
+    seen = [first] + [np.asarray(by).tolist() for _, by in pf]
+    expect = [by.tolist() for _, by in io.ResumableDataLoader(
+        ds, batch_size=2, seed=3, num_replicas=1, rank=0)]
+    assert seen == expect              # nothing split off into it1's queue
+
+
+def test_checkpoint_adapter_missing_file_degrades_gracefully(tmp_path):
+    """Restoring a checkpoint saved BEFORE a loader was attached must
+    not abort the whole restore (review fix)."""
+    from paddle_tpu.io.resumable import DataLoaderCheckpoint
+
+    ld = io.ResumableDataLoader(_ds(8), batch_size=2, seed=1,
+                                num_replicas=1, rank=0)
+    adapter = DataLoaderCheckpoint(ld, trainer_id=0)
+    assert adapter.deserialize(str(tmp_path)) is None
+    assert adapter.restored_epoch() is None
+    assert ld.state_dict()["sampler"]["offset"] == 0   # untouched
+
+
+def test_packing_over_prefetched_loader_keeps_alignment(tmp_path):
+    """DevicePrefetcher(PackingStage(loader)) must tag the loader
+    through the stage so DataLoaderCheckpoint(loader) still checkpoints
+    the delivered cursor (review fix)."""
+    from paddle_tpu.io.resumable import DataLoaderCheckpoint
+
+    ds = _ds(24)
+
+    class SeqLoader(io.ResumableDataLoader):
+        pass
+
+    ld = SeqLoader(ds, batch_size=2, seed=4, num_replicas=1, rank=0,
+                   collate_fn=lambda xs: [np.arange(2, dtype=np.int64) + 1
+                                          for _ in xs])
+    stage = io.PackingStage(ld, seq_len=4, max_rows=2)
+    pf = io.DevicePrefetcher(stage, depth=4)
+    it = iter(pf)
+    next(it)
+    time.sleep(0.3)                      # producer runs ahead
+    adapter = DataLoaderCheckpoint(ld, trainer_id=0)
+    adapter.snapshot()
+    adapter.serialize(str(tmp_path))
+    it.close()
+    import json
+
+    state = json.load(open(os.path.join(tmp_path, adapter.filename)))
+    assert state["sampler"]["offset"] == 1   # delivered, not ran-ahead
+
+
+def test_checkpoint_adapter_uses_prefetcher_aligned_state(tmp_path):
+    """Wiring TrainEpochRange(data_loaders=loader) while FEEDING through
+    a DevicePrefetcher must checkpoint the delivered-batch cursor, not
+    the loader's ran-ahead one (verified end to end in the drive: the
+    raw cursor loses depth+1 batches on resume)."""
+    from paddle_tpu.io.resumable import DataLoaderCheckpoint
+
+    ds = _ds(20)
+    ld = io.ResumableDataLoader(ds, batch_size=2, seed=8,
+                                num_replicas=1, rank=0)
+    pf = io.DevicePrefetcher(ld, depth=4)
+    adapter = DataLoaderCheckpoint(ld, trainer_id=0)
+    it = iter(pf)
+    next(it), next(it), next(it)
+    time.sleep(0.3)                     # producer runs ahead
+    assert ld.state_dict()["sampler"]["offset"] > 3   # raw cursor ahead
+    adapter.snapshot()
+    adapter.serialize(str(tmp_path))
+    it.close()
+
+    ld2 = io.ResumableDataLoader(ds, batch_size=2, seed=8,
+                                 num_replicas=1, rank=0)
+    DataLoaderCheckpoint(ld2, trainer_id=0).deserialize(str(tmp_path))
+    assert ld2.state_dict()["sampler"]["offset"] == 3
+
+
+# ---------------------------------------------------------------------------
+# multi-rank disjoint determinism across simulated restarts
+# ---------------------------------------------------------------------------
+
+
+def test_multirank_shards_disjoint_and_restart_invariant():
+    ds = _ds(30)
+    nranks = 3
+
+    def run_rank(rank, resume_after=None):
+        """Consume an epoch, optionally simulating a restart (fresh
+        objects + load_state_dict) after `resume_after` batches."""
+        ld = io.ResumableDataLoader(ds, batch_size=2, seed=13,
+                                    num_replicas=nranks, rank=rank)
+        seen = []
+        if resume_after is None:
+            for _, by in ld:
+                seen.extend(by.tolist())
+            return seen
+        it = iter(ld)
+        for _ in range(resume_after):
+            seen.extend(next(it)[1].tolist())
+        state = ld.state_dict()
+        ld2 = io.ResumableDataLoader(ds, batch_size=2, seed=13,
+                                     num_replicas=nranks, rank=rank)
+        ld2.load_state_dict(state)
+        for _, by in ld2:
+            seen.extend(by.tolist())
+        return seen
+
+    straight = [run_rank(r) for r in range(nranks)]
+    # disjoint cover across ranks
+    assert set().union(*map(set, straight)) == set(range(30))
+    for a in range(nranks):
+        for b in range(a + 1, nranks):
+            assert not (set(straight[a]) & set(straight[b]))
+    # every rank restarted at a DIFFERENT point sees the same stream
+    for r in range(nranks):
+        assert run_rank(r, resume_after=r + 1) == straight[r]
+
+
+# ---------------------------------------------------------------------------
+# kill-and-restart drill (mirrors test_auto_checkpoint)
+# ---------------------------------------------------------------------------
+
+
+def _run_worker(ws, result, kill_at="", epochs=3, save_every=2):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["IOR_WORKSPACE"] = ws
+    env["IOR_EPOCHS"] = str(epochs)
+    env["IOR_KILL_AT"] = kill_at
+    env["IOR_SAVE_EVERY"] = str(save_every)
+    env["IOR_RESULT"] = result
+    return subprocess.run([sys.executable, WORKER], env=env, timeout=300,
+                          capture_output=True, text=True)
+
+
+def test_sigkill_midepoch_resume_consumes_exact_remainder(tmp_path):
+    """Acceptance drill: SIGKILL mid-epoch, restart — the resumed run
+    consumes exactly the batches after the last committed checkpoint
+    (control-run suffix), with no duplicated and no dropped samples."""
+    control_res = str(tmp_path / "control.json")
+    p = _run_worker(str(tmp_path / "control"), control_res)
+    assert p.returncode == 0, p.stderr
+    control = json.load(open(control_res))
+    assert control["restored_from"] == -1
+
+    ws = str(tmp_path / "faulted")
+    res = str(tmp_path / "faulted.json")
+    p = _run_worker(ws, res, kill_at="1:4")
+    assert p.returncode != 0            # SIGKILL'd itself mid-epoch 1
+    assert not os.path.exists(res)
+
+    p = _run_worker(ws, res)
+    assert p.returncode == 0, p.stderr
+    out = json.load(open(res))
+    assert out["restored_from"] == 1 and out["restored_step"] is not None
+    assert out["start_epoch"] == 1      # re-entered the SAME epoch
+
+    # the resumed stream is exactly the control's tail: nothing replayed
+    # (batches before the commit), nothing skipped (batches after it)
+    n = len(out["consumed"])
+    assert 0 < n < len(control["consumed"])
+    assert out["consumed"] == control["consumed"][-n:]
+    # and the training trajectory converges to the control's weights
+    np.testing.assert_allclose(out["final_w"], control["final_w"],
+                               rtol=1e-5)
+    np.testing.assert_allclose(out["losses"], control["losses"][-n:],
+                               rtol=1e-5)
