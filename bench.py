@@ -97,6 +97,34 @@ def _flops_per_step(cfg, params, B, S, P):
     return total, trunk, head
 
 
+def _metrics_snapshot():
+    """Compact observability dump for the output line: compile counts
+    and device/host memory as the run ends — the before/after numbers a
+    perf investigation starts from."""
+    try:
+        from paddle_tpu import observability as obs
+
+        obs.SystemMetricsSampler().sample_once()
+        snap = obs.default_registry().snapshot()
+        out = {}
+        for name, key in (("xla_compilations_total", "value"),
+                          ("xla_compile_ms", "sum"),
+                          ("host_rss_bytes", "value"),
+                          ("jax_live_arrays", "value")):
+            fam = snap.get(name)
+            if fam and fam["series"]:
+                out[name] = fam["series"][0].get(key)
+        mem = snap.get("device_memory_bytes_in_use")
+        if mem and mem["series"]:
+            out["device_memory_bytes_in_use"] = {
+                s["labels"].get("device", "?"): s.get("value")
+                for s in mem["series"]
+            }
+        return out
+    except Exception as e:  # telemetry must never sink the bench
+        return {"error": repr(e)[:200]}
+
+
 def main():
     # The driver parses stdout: a down TPU tunnel (or any backend-init
     # failure) must yield ONE structured skip line and rc 0, never a raw
@@ -113,6 +141,12 @@ def main():
                       % (type(e).__name__, str(e)[:300]),
         }))
         return 0
+
+    # arm the compile-event hooks so the output line's metrics_snapshot
+    # carries compile count/time for THIS run
+    from paddle_tpu.observability import install_jax_compile_hooks
+
+    install_jax_compile_hooks()
 
     from paddle_tpu import distributed as dist
     from paddle_tpu import models
@@ -232,6 +266,7 @@ def main():
     }
     if resnet is not None:
         out["extra"] = resnet
+    out["metrics_snapshot"] = _metrics_snapshot()
     print(json.dumps(out))
 
 

@@ -14,6 +14,7 @@ Architecture (see SURVEY.md §7):
 
 __version__ = "0.1.0"
 
+from . import observability  # noqa: F401  (imported first: no deps)
 from . import fluid  # noqa: F401
 from . import dataset, incubate, io, reader  # noqa: F401
 from .reader import batch  # noqa: F401  (paddle.batch parity)

@@ -156,3 +156,112 @@ class BarrierMonitor:
                     % (barrier_id, self._timeout, missing)
                 )
             time.sleep(poll_s)
+
+
+class MetricsAggregator:
+    """Fleet-wide metric aggregation over the shared workspace.
+
+    The same medium the heartbeat/barrier monitors use (a local or
+    mounted distributed FS) carries per-rank metric snapshots: every
+    rank `publish()`es its `observability` registry snapshot to
+    `<workspace>/metrics/rank_<r>.json` (atomic tmp+rename, so a reader
+    never sees a torn file); any rank — typically rank 0, or an external
+    dashboard scraper — calls `fleet_snapshot()` to get per-series
+    min/max/mean across ranks plus each rank's raw snapshot.  There is
+    no collective on this path: a hung rank just goes stale (see
+    `age_s` in the output), it cannot block the fleet view.
+    """
+
+    def __init__(self, workspace, worker_id, worker_num, registry=None):
+        self._dir = os.path.join(workspace, "metrics")
+        os.makedirs(self._dir, exist_ok=True)
+        self._id = int(worker_id)
+        self._num = int(worker_num)
+        self._registry = registry
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from ..observability.metrics import default_registry
+
+        return default_registry()
+
+    def _path(self, rank):
+        return os.path.join(self._dir, "rank_%d.json" % rank)
+
+    # -- worker side ----------------------------------------------------
+    def publish(self):
+        """Write this rank's registry snapshot (atomic)."""
+        import json
+
+        payload = {
+            "rank": self._id,
+            "time": time.time(),
+            "metrics": self._reg().snapshot(),
+        }
+        tmp = self._path(self._id) + ".tmp%d" % os.getpid()
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self._path(self._id))
+        return payload
+
+    # -- reader side ----------------------------------------------------
+    def rank_snapshots(self):
+        """{rank: payload} for every rank that has published."""
+        import json
+
+        out = {}
+        for r in range(self._num):
+            p = self._path(r)
+            if not os.path.exists(p):
+                continue
+            try:
+                with open(p) as f:
+                    out[r] = json.load(f)
+            except (OSError, ValueError):
+                continue            # replaced mid-read: skip this round
+        return out
+
+    def fleet_snapshot(self, now=None):
+        """Cross-rank view: per (metric, labels) series, the min/max/
+        mean of each rank's value (counters/gauges: the value;
+        histograms: the mean, plus fleet-total count/sum).  Returns
+        {"ranks_reporting", "expected_ranks", "stale": {...}, "series":
+        {key: {...}}}."""
+        now = time.time() if now is None else now
+        snaps = self.rank_snapshots()
+        series = {}
+        for rank, payload in snaps.items():
+            for name, fam in (payload.get("metrics") or {}).items():
+                for s in fam.get("series", []):
+                    labels = s.get("labels") or {}
+                    key = name + "".join(
+                        "{%s=%s}" % (k, labels[k]) for k in sorted(labels))
+                    ent = series.setdefault(key, {
+                        "name": name, "labels": labels,
+                        "type": fam.get("type"), "values": {},
+                    })
+                    if fam.get("type") == "histogram":
+                        ent["values"][rank] = s.get("mean")
+                        ent.setdefault("total_count", 0)
+                        ent.setdefault("total_sum", 0.0)
+                        ent["total_count"] += int(s.get("count") or 0)
+                        ent["total_sum"] += float(s.get("sum") or 0.0)
+                    else:
+                        ent["values"][rank] = s.get("value")
+        for ent in series.values():
+            vals = [v for v in ent["values"].values() if v is not None]
+            if vals:
+                ent["min"] = min(vals)
+                ent["max"] = max(vals)
+                ent["mean"] = sum(vals) / len(vals)
+            ent["values"] = {str(r): v for r, v in ent["values"].items()}
+        return {
+            "ranks_reporting": sorted(snaps),
+            "expected_ranks": self._num,
+            "stale": {
+                str(r): round(now - p.get("time", 0), 3)
+                for r, p in snaps.items()
+            },
+            "series": series,
+        }

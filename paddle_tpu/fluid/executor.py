@@ -326,12 +326,51 @@ class Executor:
         self.mesh = mesh
         self._cache = {}
         self._rng_counter = 0
+        self._run_hist = None  # cached executor_run_ms child (hot path)
 
     def close(self):
         self._cache.clear()
 
     # ------------------------------------------------------------------
     def run(
+        self,
+        program: framework.Program = None,
+        feed: dict = None,
+        fetch_list=None,
+        scope: Scope = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,
+    ):
+        """Telemetry wrapper around `_run_impl`: the whole call's wall
+        time is split compile-vs-compute via the jax.monitoring compile
+        accumulator (`observability.step_timer`) and recorded into the
+        always-on registry histograms plus the active StepTimer record,
+        if a training loop armed one on this thread."""
+        import time
+
+        from ..observability import step_timer as _telemetry
+
+        _telemetry.install_jax_compile_hooks()
+        t0 = time.perf_counter()
+        comp0 = _telemetry.thread_compile_seconds()
+        try:
+            return self._run_impl(
+                program, feed, fetch_list, scope, return_numpy,
+                use_program_cache,
+            )
+        finally:
+            wall = time.perf_counter() - t0
+            dcomp = min(_telemetry.thread_compile_seconds() - comp0, wall)
+            _telemetry.record_component("compile", dcomp)
+            _telemetry.record_component("compute", max(wall - dcomp, 0.0))
+            if self._run_hist is None:
+                self._run_hist = _telemetry.default_registry().histogram(
+                    "executor_run_ms",
+                    "Executor.run wall time: placement + dispatch + "
+                    "device execution + fetch materialization (ms)")
+            self._run_hist.observe(wall * 1e3)
+
+    def _run_impl(
         self,
         program: framework.Program = None,
         feed: dict = None,
@@ -395,15 +434,33 @@ class Executor:
             bool(getattr(program, "_gspmd", False)),
         )
         from .core import monitor
+        from ..observability import step_timer as _telemetry
 
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
+            # cache miss: the lowering/trace below plus the XLA compile
+            # inside the first jitted call are "compile" time.  The
+            # jax.monitoring hooks catch the XLA side; the lowering wall
+            # time is pushed into the same thread accumulator (minus any
+            # compile events that already fired inside it) so the run
+            # wrapper attributes it to compile, not compute.
+            import time as _time
+
+            t_lower = _time.perf_counter()
+            c_lower = _telemetry.thread_compile_seconds()
             entry = _LoweredBlock(
                 program, block, list(feed_vals), fetch_names, scope,
                 dp_devices=dp_devices, mesh=self.mesh,
                 feed_shapes={n: a.shape for n, a in feed_vals.items()},
             )
+            lower_secs = _time.perf_counter() - t_lower
+            lower_evt = _telemetry.thread_compile_seconds() - c_lower
+            _telemetry.add_thread_compile_seconds(lower_secs - lower_evt)
             monitor.stat_add("STAT_executor_programs_compiled")
+            _telemetry.default_registry().histogram(
+                "executor_lowering_ms",
+                "Program lowering (trace + jit build) wall time (ms)"
+            ).observe(lower_secs * 1e3)
             if use_program_cache:
                 self._cache[key] = entry
             self._maybe_warn_unused_vars(block, fetch_names)

@@ -175,7 +175,26 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 
 
 def reset_profiler():
-    """cf. reference reset_profiler (traces are per-session under XLA)."""
+    """cf. reference reset_profiler — but note the trace-vs-metrics split:
+
+    * **traces** (start_profiler/stop_profiler above) are per-session
+      under XLA: each start opens a fresh jax trace session and stop
+      aggregates only that session's events, so there is no cross-run
+      trace state to reset;
+    * **metrics** (the always-on Counter/Gauge/Histogram aggregates in
+      `paddle_tpu.observability.default_registry()` — serving stats, io
+      pipeline stats, step telemetry, compile counts) DO accumulate
+      across runs, and this call zeroes them: every registered metric's
+      state (counts, sums, reservoirs, bucket rows) resets while the
+      families and their label children stay registered.
+
+    The reference's reset cleared the C++ profiler's accumulated event
+    table; the registry reset is this framework's equivalent for the
+    live-aggregate side.
+    """
+    from ..observability.metrics import default_registry
+
+    default_registry().reset()
 
 
 @contextlib.contextmanager
@@ -214,99 +233,20 @@ def cuda_profiler(*a, **kw):
 
 
 # ---------------------------------------------------------------------------
-# Lightweight in-process metrics (serving observability)
+# Lightweight in-process metrics (serving/io observability)
 # ---------------------------------------------------------------------------
 #
 # The trace machinery above answers "where did one run spend its time";
-# production serving needs cheap always-on aggregates (reference
-# platform/profiler.cc kept per-event [calls,total,min,max] rows — the
-# same aggregation, kept live instead of post-hoc from a trace).  These
-# primitives back `InferenceServer.summary()` and its `/stats` endpoint.
+# production needs cheap always-on aggregates.  Since the unified
+# telemetry subsystem landed these are THIN ALIASES of
+# `paddle_tpu.observability.metrics` — one implementation (thread-safe,
+# labeled, Prometheus-exportable).  Constructed bare (as the PR-2/PR-3
+# call sites do) they are standalone; constructed with `registry=...`
+# (or via a MetricsRegistry's get-or-create methods) they are scrapeable
+# at /metrics.  `Gauge` is re-exported for symmetry.
 
-
-class Counter:
-    """Thread-safe monotonic counter."""
-
-    def __init__(self, name=""):
-        import threading
-
-        self.name = name
-        self._n = 0
-        self._lock = threading.Lock()
-
-    def inc(self, n=1):
-        with self._lock:
-            self._n += n
-
-    @property
-    def value(self):
-        return self._n
-
-    def summary(self):
-        return {"name": self.name, "value": self._n}
-
-
-class Histogram:
-    """Thread-safe histogram: exact count/sum/min/max plus percentiles
-    from a bounded reservoir (algorithm R, seeded — bounded memory under
-    unbounded traffic, deterministic in tests)."""
-
-    def __init__(self, name="", max_samples=4096):
-        import random
-        import threading
-
-        self.name = name
-        self._max = max(int(max_samples), 1)
-        self._rng = random.Random(0x5eed)
-        self._lock = threading.Lock()
-        self._samples = []
-        self.count = 0
-        self.sum = 0.0
-        self.min = None
-        self.max = None
-
-    def observe(self, v):
-        v = float(v)
-        with self._lock:
-            self.count += 1
-            self.sum += v
-            self.min = v if self.min is None else min(self.min, v)
-            self.max = v if self.max is None else max(self.max, v)
-            if len(self._samples) < self._max:
-                self._samples.append(v)
-            else:
-                j = self._rng.randrange(self.count)
-                if j < self._max:
-                    self._samples[j] = v
-
-    @staticmethod
-    def _rank(s, p):
-        k = min(len(s) - 1, max(0, int(round((p / 100.0) * (len(s) - 1)))))
-        return s[k]
-
-    def percentile(self, p):
-        """p in [0, 100]; nearest-rank over the reservoir; None if empty."""
-        with self._lock:
-            if not self._samples:
-                return None
-            s = sorted(self._samples)
-        return self._rank(s, p)
-
-    def summary(self):
-        with self._lock:  # one consistent snapshot, one sort
-            if self.count == 0:
-                return {"name": self.name, "count": 0}
-            count, total = self.count, self.sum
-            mn, mx = self.min, self.max
-            s = sorted(self._samples)
-        return {
-            "name": self.name,
-            "count": count,
-            "sum": total,
-            "mean": total / count,
-            "min": mn,
-            "max": mx,
-            "p50": self._rank(s, 50),
-            "p95": self._rank(s, 95),
-            "p99": self._rank(s, 99),
-        }
+from ..observability.metrics import (  # noqa: E402,F401
+    Counter,
+    Gauge,
+    Histogram,
+)

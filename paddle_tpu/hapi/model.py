@@ -51,6 +51,16 @@ def _to_batches(data, batch_size, shuffle=False, seed=None):
         yield xs[j], ys[j]
 
 
+class _NullStepCtx:
+    """No-op stand-in for StepTimer.step() when telemetry is off."""
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
 def _as_array(a):
     """Host lists -> numpy; anything already array-like (numpy OR a
     device-resident jax.Array from io.DevicePrefetcher) passes through —
@@ -222,6 +232,7 @@ class Model:
         self._adapter = None
         self.stop_training = False  # set by EarlyStopping
         self.io_stats = None        # io.PipelineStats when device_prefetch
+        self.step_timer = None      # observability.StepTimer (set by fit)
 
     @property
     def mode(self):
@@ -262,7 +273,8 @@ class Model:
     # -- loops ----------------------------------------------------------
     def fit(self, train_data, eval_data=None, batch_size=32, epochs=1,
             eval_freq=1, verbose=1, callbacks=None, shuffle=True,
-            log_freq=10, device_prefetch=False, prefetch_depth=2):
+            log_freq=10, device_prefetch=False, prefetch_depth=2,
+            telemetry=True, scalar_log=None):
         """cf. reference Model.fit: epochs over train_data with eval every
         `eval_freq` epochs, callbacks driving logging/checkpoint/early
         stop (reference model.py fit + callbacks.py).
@@ -273,8 +285,26 @@ class Model:
         and pipeline wait/copy metrics accumulate in
         `self.io_stats` (an `io.PipelineStats`).  Loaders exposing
         `set_epoch` get it called once per epoch (sharded determinism
-        contract)."""
+        contract).
+
+        `telemetry=True` (default) arms an `observability.StepTimer` as
+        `self.step_timer`: every train step gets a component budget —
+        data_wait (blocked on next(batch)) + compile (XLA compilations,
+        detected via jax hooks + executor lowering) + compute (dispatch,
+        device execution, fetch) + host_overhead (residual) ≈ step_time
+        — recorded in always-on registry histograms
+        (`train_*_ms{loop="hapi.fit"}`) and kept in
+        `self.step_timer.history` / `.last_breakdown`.  `scalar_log`
+        (a path or `observability.ScalarWriter`) additionally streams
+        every step's scalars as JSONL."""
         self._ensure_prepared()
+        if telemetry:
+            from ..observability import StepTimer
+
+            self.step_timer = StepTimer(name="hapi.fit",
+                                        scalar_writer=scalar_log)
+        else:
+            self.step_timer = None
         if device_prefetch:
             from ..io import DevicePrefetcher, PipelineStats
 
@@ -297,45 +327,91 @@ class Model:
             c.on_train_begin()
         self.stop_training = False
         history = {"loss": []}
-        for epoch in range(epochs):
-            for c in cbs:
-                c.on_epoch_begin(epoch)
-            losses = []
-            for m in self._metrics:
-                m.reset()
-            if hasattr(train_data, "set_epoch"):
-                train_data.set_epoch(epoch)
-            batches = _to_batches(train_data, batch_size, shuffle, seed=epoch)
-            if device_prefetch:
-                from ..io import DevicePrefetcher
+        # in dygraph mode no Executor.run fills the compile/compute
+        # components; fit itself diffs the thread compile accumulator
+        # and the train_batch wall clock instead
+        eager = isinstance(self._adapter, _DygraphAdapter)
+        try:
+            for epoch in range(epochs):
+                for c in cbs:
+                    c.on_epoch_begin(epoch)
+                losses = []
+                for m in self._metrics:
+                    m.reset()
+                if hasattr(train_data, "set_epoch"):
+                    train_data.set_epoch(epoch)
+                batches = _to_batches(train_data, batch_size, shuffle,
+                                      seed=epoch)
+                if device_prefetch:
+                    from ..io import DevicePrefetcher
 
-                if not isinstance(train_data, DevicePrefetcher):
-                    # (x, y) array input: the per-epoch generator is
-                    # stateless, wrapping it loses nothing
-                    batches = DevicePrefetcher(
-                        batches, depth=prefetch_depth, stats=self.io_stats)
-            for step, (bx, by) in enumerate(batches):
+                    if not isinstance(train_data, DevicePrefetcher):
+                        # (x, y) array input: the per-epoch generator is
+                        # stateless, wrapping it loses nothing
+                        batches = DevicePrefetcher(
+                            batches, depth=prefetch_depth,
+                            stats=self.io_stats)
+                # explicit next() so the time blocked on the input
+                # pipeline is measured as the step's data_wait component
+                import time as _time
+
+                it = iter(batches)
+                step = 0
+                while True:
+                    ctx = self.step_timer.step() if self.step_timer \
+                        else _NullStepCtx()
+                    with ctx as rec:
+                        t_fetch = _time.perf_counter()
+                        try:
+                            bx, by = next(it)
+                        except StopIteration:
+                            if rec is not None:
+                                rec.cancel()
+                            break
+                        if rec is not None:
+                            rec.add("data_wait",
+                                    _time.perf_counter() - t_fetch)
+                        for c in cbs:
+                            c.on_train_batch_begin(step)
+                        if rec is not None and eager:
+                            from ..observability import step_timer as _st
+
+                            t_tb = _time.perf_counter()
+                            comp0 = _st.thread_compile_seconds()
+                            loss, pred = self.train_batch(bx, by)
+                            wall = _time.perf_counter() - t_tb
+                            dcomp = min(
+                                _st.thread_compile_seconds() - comp0, wall)
+                            rec.add("compile", dcomp)
+                            rec.add("compute", max(wall - dcomp, 0.0))
+                        else:
+                            loss, pred = self.train_batch(bx, by)
+                        losses.append(loss)
+                        self._update_metrics(pred, by)
+                        for c in cbs:
+                            c.on_train_batch_end(step, {"loss": loss})
+                    step += 1
+                logs = {"loss": float(np.mean(losses))}
+                logs.update(self._eval_metrics())
+                if eval_data is not None and (
+                        epoch % max(eval_freq, 1) == 0
+                        or epoch == epochs - 1):
+                    logs["eval_loss"] = self.evaluate(
+                        eval_data, batch_size=batch_size, verbose=0
+                    )["loss"]
+                history["loss"].append(logs["loss"])
                 for c in cbs:
-                    c.on_train_batch_begin(step)
-                loss, pred = self.train_batch(bx, by)
-                losses.append(loss)
-                self._update_metrics(pred, by)
-                for c in cbs:
-                    c.on_train_batch_end(step, {"loss": loss})
-            logs = {"loss": float(np.mean(losses))}
-            logs.update(self._eval_metrics())
-            if eval_data is not None and (
-                    epoch % max(eval_freq, 1) == 0 or epoch == epochs - 1):
-                logs["eval_loss"] = self.evaluate(
-                    eval_data, batch_size=batch_size, verbose=0
-                )["loss"]
-            history["loss"].append(logs["loss"])
+                    c.on_epoch_end(epoch, logs)
+                if self.stop_training:
+                    break
             for c in cbs:
-                c.on_epoch_end(epoch, logs)
-            if self.stop_training:
-                break
-        for c in cbs:
-            c.on_train_end()
+                c.on_train_end()
+        finally:
+            if self.step_timer is not None:
+                # flush/close the scalar log even on a mid-train crash:
+                # the steps leading up to a failure are the ones a
+                # post-mortem needs
+                self.step_timer.close()
         return history
 
     def evaluate(self, eval_data, batch_size=32, verbose=0):

@@ -176,6 +176,25 @@ class TrainEpochRange:
     def start_epoch(self):
         return self._start_epoch
 
+    @property
+    def step_timer(self):
+        """Lazily-created `observability.StepTimer` (loop="acp") for the
+        user's inner loop::
+
+            for epoch in r:
+                for batch in loader:
+                    with r.step_timer.step():
+                        exe.run(...)   # compile/compute split recorded
+
+        Epoch-level histograms (`train_epoch_ms{loop="acp"}`) are always
+        on; this adds the per-step breakdown when the inner loop opts
+        in."""
+        if getattr(self, "_step_timer", None) is None:
+            from ...observability import StepTimer
+
+            self._step_timer = StepTimer(name="acp")
+        return self._step_timer
+
     # -- save ------------------------------------------------------------
     def save_checkpoint(self, epoch, step=None):
         extra = {"program_hash": self._hash, "name": self.name}
@@ -194,11 +213,25 @@ class TrainEpochRange:
 
     # -- the loop --------------------------------------------------------
     def get(self):
+        import time
+
+        from ...observability.metrics import default_registry
+
+        reg = default_registry()
+        h_epoch = reg.histogram(
+            "train_epoch_ms", "Wall time of one training epoch (ms)",
+            labelnames=("loop",)).labels("acp")
+        g_epoch = reg.gauge(
+            "train_epoch", "Current epoch of the acp training loop",
+            labelnames=("loop",)).labels("acp")
         global _g_train_epoch_range
         _g_train_epoch_range = self
         try:
             for epoch in range(self._start_epoch, self._max_epoch_num):
+                g_epoch.set(epoch)
+                t0 = time.perf_counter()
                 yield epoch
+                h_epoch.observe((time.perf_counter() - t0) * 1e3)
                 if self._saver is not None and (
                         epoch % self._inter == self._inter - 1
                         or epoch == self._max_epoch_num - 1):

@@ -386,6 +386,8 @@ class CheckpointSaver:
         serialize, rank 0 merges manifests + commits, everyone barriers
         on both sides.
         """
+        t_save = time.perf_counter()
+        commit_secs = None
         slists = list(slists)
         if snapshot:
             for s in slists:
@@ -455,6 +457,7 @@ class CheckpointSaver:
                 json.dump(meta, f)
 
             final = self._ckpt_dir(n)
+            t_commit = time.perf_counter()
             # a committed checkpoint is immutable: shutil.move onto an
             # existing dir would NEST the tmp inside it and report
             # success while committing nothing
@@ -471,6 +474,7 @@ class CheckpointSaver:
                 self._fs.mv(remote_tmp, final)       # remote commit
                 LocalFS().delete(write_dir)
             committed = True
+            commit_secs = time.perf_counter() - t_commit
         except BaseException:
             # never leave a half-commit that a reader could mistake for
             # a checkpoint; tmp dirs are invisible to the load path by
@@ -499,6 +503,30 @@ class CheckpointSaver:
                         self._fs.delete(os.path.join(
                             self._root,
                             "%s%d.ptr" % (_ATTEMPT_PREFIX, n)))
+            # always-on checkpoint telemetry (observability registry):
+            # save = serialize + barriers + commit end to end; commit =
+            # the rename that makes the checkpoint durable (rank 0)
+            try:
+                from ...observability.metrics import default_registry
+
+                reg = default_registry()
+                reg.histogram(
+                    "checkpoint_save_ms",
+                    "CheckpointSaver.save_checkpoint wall time (ms)"
+                ).observe((time.perf_counter() - t_save) * 1e3)
+                if committed:
+                    reg.counter("checkpoint_saves_total",
+                                "Committed checkpoint saves").inc()
+                    if commit_secs is not None:
+                        reg.histogram(
+                            "checkpoint_commit_ms",
+                            "Atomic-rename commit wall time (ms)"
+                        ).observe(commit_secs * 1e3)
+                else:
+                    reg.counter("checkpoint_save_failures_total",
+                                "Failed checkpoint save attempts").inc()
+            except Exception:
+                pass  # telemetry must never break a save's error path
 
         if self._rank == 0:
             if self._nranks > 1:
@@ -640,10 +668,22 @@ class AsyncCheckpointSaver:
     def save_async(self, slists, epoch=None, step=None, extra_meta=None):
         """Snapshot now, write later; returns the checkpoint number the
         save WILL commit as."""
+        from ...observability.metrics import default_registry
+
+        reg = default_registry()
         self.wait()                      # one in flight; surfaces errors
         slists = list(slists)
+        t_snap = time.perf_counter()
         for s in slists:
             s.snapshot()
+        # the ONLY part of an async save the train step waits on: the
+        # device->host state snapshot
+        reg.histogram(
+            "checkpoint_snapshot_ms",
+            "Synchronous device->host snapshot time of an async save (ms)"
+        ).observe((time.perf_counter() - t_snap) * 1e3)
+        g_inflight = reg.gauge("checkpoint_save_in_flight",
+                               "Background checkpoint saves running")
         no = self.saver.last_checkpoint_dir_no() + 1
 
         def run():
@@ -654,7 +694,10 @@ class AsyncCheckpointSaver:
             except BaseException as e:   # surfaced on next save/wait
                 with self._lock:
                     self._error = e
+            finally:
+                g_inflight.dec()
 
+        g_inflight.inc()
         self._thread = threading.Thread(
             target=run, name="ckpt-save-%s" % no, daemon=True)
         self._thread.start()

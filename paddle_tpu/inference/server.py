@@ -48,7 +48,7 @@ from collections import OrderedDict, deque
 
 import numpy as np
 
-from ..fluid.profiler import Counter, Histogram
+from ..observability.metrics import default_registry, unique_instance_label
 
 
 class _Request:
@@ -110,11 +110,17 @@ class InferenceServer:
       zero padding.
     * ``pipeline_depth``: max dispatched-but-unmaterialized batches in
       flight (bounds device queue + host output backlog).
+    * ``name`` / ``metrics_registry``: serving metrics are children of
+      shared ``serving_*`` families in ``metrics_registry`` (default:
+      the process-wide ``observability.default_registry()``), labeled
+      ``server=<name>`` (made unique per instance).  GET /metrics on
+      `serve_http` exposes the whole registry as Prometheus text.
     """
 
     def __init__(self, predictor, max_batch=32, batch_timeout_ms=2.0,
                  batch_buckets=None, ragged_dims=None, mask_feed=None,
-                 pipeline_depth=2):
+                 pipeline_depth=2, name="serving",
+                 metrics_registry=None):
         self._pred = predictor
         self._max_batch = max(int(max_batch), 1)
         self._timeout = max(batch_timeout_ms, 0.0) / 1e3
@@ -148,15 +154,52 @@ class InferenceServer:
         self._dispatcher = None
         self._completer = None
         self._stop = threading.Event()
-        # -- observability (fluid.profiler metric primitives) ----------
-        self._n_requests = Counter("requests")
-        self._n_batches = Counter("batches")
-        self._n_errors = Counter("errors")
-        self._n_abandoned = Counter("abandoned")
-        self._h_queue_depth = Histogram("queue_depth")
-        self._h_batch_size = Histogram("batch_size")
-        self._h_pad_waste = Histogram("padding_waste")
-        self._h_latency_ms = Histogram("latency_ms")
+        # -- observability (shared registry; label = this server) -------
+        # children of shared families, one "server" label value per
+        # instance — /stats keeps its per-server view, while a registry
+        # scrape (/metrics here or serve_metrics_http) sees every server
+        reg = metrics_registry or default_registry()
+        self.metrics_registry = reg
+        self.name = name
+        self._mlabel = (unique_instance_label(name),)
+        lbl = ("server",)
+
+        def _c(mname, help):
+            return reg.counter(mname, help, labelnames=lbl).labels(
+                *self._mlabel)
+
+        def _h(mname, help, buckets=None):
+            return reg.histogram(mname, help, labelnames=lbl,
+                                 buckets=buckets).labels(*self._mlabel)
+
+        self._n_requests = _c("serving_requests_total", "Inference requests")
+        self._n_batches = _c("serving_batches_total", "Dispatched batches")
+        self._n_errors = _c("serving_errors_total", "Failed requests")
+        self._n_abandoned = _c("serving_abandoned_total",
+                               "Requests whose waiter timed out")
+        self._h_queue_depth = _h(
+            "serving_queue_depth", "Pending rows at dispatch",
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256))
+        self._h_batch_size = _h(
+            "serving_batch_size", "Coalesced rows per dispatched batch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+        self._h_pad_waste = _h(
+            "serving_padding_waste",
+            "Padded-but-dead fraction of dispatched elements",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9))
+        self._h_latency_ms = _h("serving_latency_ms",
+                                "Request latency enqueue->materialized (ms)")
+        # summary()//stats keeps the PR-2 metric names in nested dicts
+        for disp, child in (
+                ("requests", self._n_requests),
+                ("batches", self._n_batches),
+                ("errors", self._n_errors),
+                ("abandoned", self._n_abandoned),
+                ("queue_depth", self._h_queue_depth),
+                ("batch_size", self._h_batch_size),
+                ("padding_waste", self._h_pad_waste),
+                ("latency_ms", self._h_latency_ms)):
+            child.display_name = disp
 
     # -- lifecycle -------------------------------------------------------
     def start(self):
@@ -188,6 +231,22 @@ class InferenceServer:
         if self._completer is not None:
             self._completer.join(timeout=5)
             self._completer = None
+
+    def unregister_metrics(self):
+        """Drop this server's series from the shared registry and free
+        its label (call after a FINAL stop(); a server that may
+        restart should keep its series).  Keeps /metrics bounded in
+        processes that create/destroy servers per model reload."""
+        from ..observability.metrics import release_instance_label
+
+        for fam_name in ("serving_requests_total", "serving_batches_total",
+                         "serving_errors_total", "serving_abandoned_total",
+                         "serving_queue_depth", "serving_batch_size",
+                         "serving_padding_waste", "serving_latency_ms"):
+            fam = self.metrics_registry.get(fam_name)
+            if fam is not None:
+                fam.remove(*self._mlabel)
+        release_instance_label(self._mlabel[0])
 
     def warmup(self, example_inputs):
         """AOT-compile the full bucket ladder before serving traffic.
@@ -495,9 +554,11 @@ class InferenceServer:
         """JSON protocol (cross-language surface): POST /predict with
         {"inputs": {name: nested-list}, "dtypes": {name: "float32"}} ->
         {"outputs": [nested-list, ...]}.  GET /health -> {"status":"ok"};
-        GET /stats -> summary() JSON.  Malformed requests get 400;
-        internal inference failures get 500.  Returns the HTTPServer
-        (daemon-threaded when block=False)."""
+        GET /stats -> summary() JSON; GET /metrics -> Prometheus text
+        exposition of the server's metrics registry (every subsystem
+        reporting there, not just this server).  Malformed requests get
+        400; internal inference failures get 500.  Returns the
+        HTTPServer (daemon-threaded when block=False)."""
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         server_self = self
@@ -514,11 +575,26 @@ class InferenceServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_text(self, code, text, ctype):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 if self.path == "/health":
                     self._send(200, {"status": "ok"})
                 elif self.path == "/stats":
                     self._send(200, server_self.summary())
+                elif self.path == "/metrics":
+                    from ..observability.export import prometheus_text
+
+                    self._send_text(
+                        200,
+                        prometheus_text(server_self.metrics_registry),
+                        "text/plain; version=0.0.4; charset=utf-8")
                 else:
                     self._send(404, {"error": "unknown path"})
 

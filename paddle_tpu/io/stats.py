@@ -1,4 +1,4 @@
-"""Input-pipeline observability over `fluid.profiler` Counter/Histogram.
+"""Input-pipeline observability over the shared metrics registry.
 
 The serving path (PR 2) answered "is the server batching well" with
 always-on aggregates; training needs the symmetric question answered —
@@ -14,26 +14,84 @@ the four numbers that decide it:
                         trainer takes a batch (pinned at 0 = producer is
                         the bottleneck; pinned at depth = consumer is)
   * packing_efficiency  real tokens / row capacity of the packing stage
+
+Since the unified telemetry subsystem landed, these are label children
+(`pipeline=<instance>`) of shared registry families (`io_batches_total`,
+`io_step_wait_ms`, ...), so every pipeline is visible at /metrics while
+each `PipelineStats` instance keeps its own independent series (the
+instance label is made unique per construction).  `summary()` is
+unchanged — the dict a trainer printed before this PR still comes out
+byte-for-byte shaped the same.
 """
 
 from __future__ import annotations
 
-from ..fluid.profiler import Counter, Histogram
+from ..observability.metrics import default_registry, unique_instance_label
 
 __all__ = ["PipelineStats"]
+
+_LBL = ("pipeline",)
 
 
 class PipelineStats:
     """Always-on aggregate metrics for one input pipeline."""
 
-    def __init__(self, name="io"):
+    def __init__(self, name="io", registry=None):
+        reg = registry or default_registry()
         self.name = name
-        self.batches = Counter("%s.batches" % name)
-        self.samples = Counter("%s.samples" % name)
-        self.step_wait_ms = Histogram("%s.step_wait_ms" % name)
-        self.h2d_copy_ms = Histogram("%s.h2d_copy_ms" % name)
-        self.queue_depth = Histogram("%s.prefetch_queue_depth" % name)
-        self.packing_efficiency = Histogram("%s.packing_efficiency" % name)
+        self.registry = reg
+        # unique per instance: two pipelines never share series
+        self.instance_label = unique_instance_label(name)
+        lab = (self.instance_label,)
+        self.batches = reg.counter(
+            "io_batches_total", "Batches delivered by the input pipeline",
+            labelnames=_LBL).labels(*lab)
+        self.samples = reg.counter(
+            "io_samples_total", "Samples delivered by the input pipeline",
+            labelnames=_LBL).labels(*lab)
+        self.step_wait_ms = reg.histogram(
+            "io_step_wait_ms",
+            "Trainer wall time blocked waiting for the next batch (ms)",
+            labelnames=_LBL).labels(*lab)
+        self.h2d_copy_ms = reg.histogram(
+            "io_h2d_copy_ms",
+            "Host-to-device dispatch+copy time per batch (ms)",
+            labelnames=_LBL).labels(*lab)
+        self.queue_depth = reg.histogram(
+            "io_prefetch_queue_depth",
+            "Device-batch queue occupancy at batch take",
+            labelnames=_LBL,
+            buckets=(0, 1, 2, 4, 8, 16, 32)).labels(*lab)
+        self.packing_efficiency = reg.histogram(
+            "io_packing_efficiency",
+            "Real tokens / row capacity of the packing stage",
+            labelnames=_LBL,
+            buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 1.0)
+        ).labels(*lab)
+        # summary() keeps the pre-registry per-instance metric names
+        for suffix, child in (
+                ("batches", self.batches),
+                ("samples", self.samples),
+                ("step_wait_ms", self.step_wait_ms),
+                ("h2d_copy_ms", self.h2d_copy_ms),
+                ("prefetch_queue_depth", self.queue_depth),
+                ("packing_efficiency", self.packing_efficiency)):
+            child.display_name = "%s.%s" % (name, suffix)
+
+    def unregister(self):
+        """Drop this instance's series from the shared registry and free
+        its instance label (teardown for create/destroy-heavy callers:
+        the registry and /metrics output stop growing)."""
+        from ..observability.metrics import release_instance_label
+
+        for fam_name in ("io_batches_total", "io_samples_total",
+                         "io_step_wait_ms", "io_h2d_copy_ms",
+                         "io_prefetch_queue_depth",
+                         "io_packing_efficiency"):
+            fam = self.registry.get(fam_name)
+            if fam is not None:
+                fam.remove(self.instance_label)
+        release_instance_label(self.instance_label)
 
     def summary(self):
         """One dict a trainer can print/log to diagnose input-boundness."""

@@ -1,0 +1,70 @@
+"""paddle_tpu.observability — the unified telemetry subsystem.
+
+One always-on layer answering the production questions every perf PR
+needs answered before and after:
+
+* **MetricsRegistry** (`metrics.py`) — labeled `Counter` / `Gauge` /
+  `Histogram` families; `default_registry()` is where every built-in
+  subsystem (serving, io, executor, checkpointing) reports.  The PR-2
+  `fluid.profiler.Counter/Histogram` are thin aliases of these classes.
+* **Exporters** (`export.py`) — `prometheus_text()` (text exposition
+  0.0.4: escaping, cumulative buckets, `_sum`/`_count`),
+  `json_snapshot()`, and `serve_metrics_http()` (GET /metrics);
+  `InferenceServer.serve_http` answers /metrics too.
+* **StepTimer** (`step_timer.py`) — per-step budget `data_wait +
+  compile + compute + host_overhead ≈ step_time`, fed by thread-local
+  records the instrumented layers (`Executor.run`, `hapi.Model.fit`,
+  `io.DevicePrefetcher`) fill in; XLA compilations are counted and
+  timed via `jax.monitoring` hooks.  `ScalarWriter` streams per-step
+  scalars as JSONL.
+* **SystemMetricsSampler** (`system.py`) — background device-memory /
+  live-array / host-RSS gauges (graceful no-op on CPU jax).
+* **Fleet view** — `distributed.monitor.MetricsAggregator` publishes
+  each rank's snapshot over the shared workspace; rank 0 reads
+  min/max/mean across ranks.
+
+The trace-vs-metrics split: `fluid.profiler.profiler` answers "where
+did ONE run spend its time" (jax trace, per-op table, chrome export);
+this package answers "how is the system doing RIGHT NOW and over time"
+(cheap aggregates, always on).
+"""
+
+from .export import (  # noqa: F401
+    json_snapshot,
+    prometheus_text,
+    serve_metrics_http,
+)
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from .step_timer import (  # noqa: F401
+    ScalarWriter,
+    StepRecord,
+    StepTimer,
+    install_jax_compile_hooks,
+    record_compile,
+    record_component,
+)
+from .system import SystemMetricsSampler  # noqa: F401
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "prometheus_text",
+    "json_snapshot",
+    "serve_metrics_http",
+    "StepTimer",
+    "StepRecord",
+    "ScalarWriter",
+    "install_jax_compile_hooks",
+    "record_component",
+    "record_compile",
+    "SystemMetricsSampler",
+]

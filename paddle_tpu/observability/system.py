@@ -1,0 +1,151 @@
+"""System gauges: device memory, live arrays, host RSS — sampled on a
+background thread into the shared registry.
+
+Capability parity: the reference used StatRegistry counters for GPU
+memory high-water marks (`platform/monitor.h`, STAT_ADD in the CUDA
+allocator) and a separate monitor daemon.  TPU-first: the authoritative
+device numbers come from the runtime itself — `jax.Device.memory_stats()`
+(bytes_in_use / peak_bytes_in_use / num_allocs on TPU and GPU backends)
+— with `jax.live_arrays()` as the framework-level view.  On backends
+that expose no memory stats (CPU jax) the sampler degrades to the host
+metrics alone: every gauge it CAN read is still correct, and nothing
+raises.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from .metrics import default_registry
+
+__all__ = ["SystemMetricsSampler"]
+
+
+class SystemMetricsSampler:
+    """Background sampler: `start()` spawns a daemon thread calling
+    `sample_once()` every `interval_s`; `stop()` joins it.  `sample_once`
+    is also usable synchronously (tests, one-shot dumps) and returns the
+    dict of values it wrote."""
+
+    def __init__(self, registry=None, interval_s=10.0):
+        self.registry = registry or default_registry()
+        self.interval_s = float(interval_s)
+        self._thread = None
+        self._stop = threading.Event()
+        r = self.registry
+        dl = ("device",)
+        self._g_in_use = r.gauge(
+            "device_memory_bytes_in_use",
+            "Device allocator bytes currently in use "
+            "(jax.Device.memory_stats)", labelnames=dl)
+        self._g_peak = r.gauge(
+            "device_memory_peak_bytes",
+            "Device allocator peak bytes in use", labelnames=dl)
+        self._g_limit = r.gauge(
+            "device_memory_bytes_limit",
+            "Device allocator byte limit (0 when the backend reports "
+            "none)", labelnames=dl)
+        self._g_live = r.gauge(
+            "jax_live_arrays", "Live jax.Array count on this host")
+        self._g_rss = r.gauge(
+            "host_rss_bytes", "Current resident set size of this process")
+        self._g_peak_rss = r.gauge(
+            "host_peak_rss_bytes",
+            "Lifetime peak resident set size (getrusage high-water mark)")
+        self._c_samples = r.counter(
+            "system_metrics_samples_total", "sample_once() invocations")
+
+    # -- one sample ------------------------------------------------------
+    def sample_once(self):
+        out = {}
+        try:
+            import jax
+
+            for d in jax.local_devices():
+                label = "%s:%d" % (d.platform, d.id)
+                try:
+                    stats = d.memory_stats()
+                except Exception:
+                    stats = None
+                if not stats:       # CPU backend: None — graceful no-op
+                    continue
+                in_use = stats.get("bytes_in_use")
+                if in_use is not None:
+                    self._g_in_use.labels(label).set(in_use)
+                    out["device_memory_bytes_in_use{%s}" % label] = in_use
+                peak = stats.get("peak_bytes_in_use")
+                if peak is not None:
+                    self._g_peak.labels(label).set(peak)
+                limit = stats.get("bytes_limit")
+                if limit is not None:
+                    self._g_limit.labels(label).set(limit)
+            try:
+                n_live = len(jax.live_arrays())
+                self._g_live.set(n_live)
+                out["jax_live_arrays"] = n_live
+            except Exception:
+                pass
+        except Exception:
+            pass                     # no jax / backend init failed: host-only
+        rss = _host_rss_bytes()
+        if rss is not None:
+            self._g_rss.set(rss)
+            out["host_rss_bytes"] = rss
+        peak = _host_peak_rss_bytes()
+        if peak is not None:
+            self._g_peak_rss.set(peak)
+            out["host_peak_rss_bytes"] = peak
+        self._c_samples.inc()
+        return out
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                self.sample_once()
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="system-metrics")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def _host_rss_bytes():
+    """CURRENT resident set (linux /proc; ru_maxrss would be the
+    lifetime peak — see _host_peak_rss_bytes)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        return None
+
+
+def _host_peak_rss_bytes():
+    try:
+        import resource
+        import sys
+
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # linux reports KiB; darwin reports bytes
+        return rss if sys.platform == "darwin" else rss * 1024
+    except Exception:
+        return None

@@ -42,6 +42,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.fluid.contrib.slim.core",
     "paddle_tpu.incubate.checkpoint",
     "paddle_tpu.io",
+    "paddle_tpu.observability",
     "paddle_tpu.nn",
     "paddle_tpu.nn.functional",
     "paddle_tpu.tensor",
