@@ -25,3 +25,13 @@ from .tensor import to_tensor  # noqa: F401
 CPUPlace = fluid.CPUPlace
 TPUPlace = fluid.TPUPlace
 CUDAPlace = fluid.CUDAPlace
+
+
+def __getattr__(name):
+    # lazy submodules (PEP 562): analysis is a build/debug-time tool — it
+    # must not tax the import of every training/serving worker process
+    if name == "analysis":
+        import importlib
+
+        return importlib.import_module(".analysis", __name__)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

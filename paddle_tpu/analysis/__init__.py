@@ -1,0 +1,61 @@
+"""paddle_tpu.analysis — whole-program static verification and linting.
+
+The safety net behind aggressive pass-writing and program surgery
+(ROADMAP: "refactor freely"): a ProgramVerifier that re-checks global
+structural invariants + shape/dtype inference over a finished Program, a
+lint-rule engine producing structured diagnostics, and op-callsite
+provenance so findings point at the line of Python that built the op.
+
+Hot-path wiring:
+  * ``ir.apply_passes(..., verify=True)`` re-verifies after each pass and
+    names the offending pass on failure
+  * ``fluid.set_flags({"FLAGS_verify_program": True})`` makes Executor.run
+    verify each program on its first (cache-miss) run
+  * ``save_inference_model`` / the inference ``Predictor`` load path verify
+    before committing (``FLAGS_verify_io_programs``, on by default)
+  * ``fluid.set_flags({"FLAGS_op_callstack": True})`` or
+    ``analysis.provenance()`` records op build sites
+  * ``tools/program_lint.py`` lints a serialized program JSON from the CLI
+"""
+
+from .diagnostics import (  # noqa: F401
+    ERROR,
+    INFO,
+    WARNING,
+    Diagnostic,
+    Diagnostics,
+    ProgramVerificationError,
+)
+from .verifier import (  # noqa: F401
+    ProgramVerifier,
+    assert_program_valid,
+    find_orphan_vars,
+    verify_program,
+)
+from .lint import (  # noqa: F401
+    LintContext,
+    LintRule,
+    get_lint_rule,
+    lint_program,
+    lint_rules,
+    register_lint_rule,
+)
+from .provenance import (  # noqa: F401
+    disable_provenance,
+    enable_provenance,
+    op_callsite,
+    provenance,
+    provenance_enabled,
+)
+from . import opgraph  # noqa: F401
+
+
+def analyze_program(program, feed_names=None, fetch_names=None,
+                    check_shapes=True, rules=None):
+    """verify + lint in one call; returns a single Diagnostics."""
+    diags = verify_program(program, feed_names=feed_names,
+                           fetch_names=fetch_names,
+                           check_shapes=check_shapes)
+    diags.extend(lint_program(program, feed_names=feed_names,
+                              fetch_names=fetch_names, rules=rules))
+    return diags

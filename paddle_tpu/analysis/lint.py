@@ -1,0 +1,293 @@
+"""Lint-rule engine: registrable whole-program checks with structured
+diagnostics.
+
+Capability parity: the reference scatters program hygiene across
+`framework/unused_var_check.cc`, `ir/` sanity passes, and reviewer lore;
+here each check is a :class:`LintRule` over the Program IR, producing
+:class:`Diagnostic`s (severity, block/op coordinates, var names,
+provenance) that tests can assert on exactly.
+
+Built-in rules:
+  * ``dead-op``             — op whose outputs nothing consumes (any block,
+                              incl. serialized sub-ops), not side-effecting,
+                              not persistable-writing, not fetched
+  * ``unused-feed``         — is_data var no op ever reads
+  * ``unfetched-output``    — terminal non-persistable var missing from the
+                              provided fetch list (needs fetch_names)
+  * ``orphan-var``          — block.vars entry nothing references
+  * ``mixed-dtype-matmul``  — matmul/mul/conv2d with operands of different
+                              float dtypes (AMP hazard: silent upcast hides
+                              a missing cast, costs HBM bandwidth)
+  * ``collective-asymmetry``— c_* ops sharing a ring_id disagree on nranks
+                              (or carry malformed ring_ids) — the static
+                              form of a cross-rank deadlock
+  * ``side-effect-order``   — a side-effect op reads a var that a LATER op
+                              overwrites (the print/save observes the
+                              pre-update value)
+"""
+
+from __future__ import annotations
+
+from . import opgraph
+from .diagnostics import ERROR, INFO, WARNING, Diagnostics
+from .verifier import find_orphan_vars
+
+
+class LintContext:
+    """Shared caches for one lint run over one program."""
+
+    def __init__(self, program, feed_names=None, fetch_names=None):
+        self.program = program
+        self.feed_names = set(feed_names or ())
+        self.fetch_names = set(fetch_names or ())
+        self.read = opgraph.read_names(program)
+        self.referenced = opgraph.referenced_names(program)
+        # names bound through name-list attrs (sub-block aliases / branch
+        # output lists): consuming via an attr is consuming
+        self.attr_bound = set()
+        for _b, _i, op in opgraph.iter_all_ops_deep(program):
+            for _k, vals in opgraph.attr_name_lists(op):
+                self.attr_bound.update(vals)
+
+    def resolve(self, block_idx, name):
+        return self.program.blocks[block_idx]._find_var_recursive(name)
+
+
+class LintRule:
+    """One named check; subclass and register with @register_lint_rule."""
+
+    name = None
+    severity = WARNING
+
+    def check(self, ctx: LintContext) -> Diagnostics:
+        raise NotImplementedError
+
+
+_LINT_REGISTRY: dict = {}
+
+
+def register_lint_rule(cls):
+    if not getattr(cls, "name", None):
+        raise ValueError("a LintRule must define a class-level `name`")
+    _LINT_REGISTRY[cls.name] = cls
+    return cls
+
+
+def lint_rules():
+    """Registered rule names (extension surface, cf. ir.get_pass)."""
+    return sorted(_LINT_REGISTRY)
+
+
+def get_lint_rule(name):
+    if name not in _LINT_REGISTRY:
+        raise KeyError("no lint rule named %r (registered: %s)"
+                       % (name, ", ".join(lint_rules())))
+    return _LINT_REGISTRY[name]()
+
+
+_provenance = opgraph.op_provenance
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+@register_lint_rule
+class DeadOpRule(LintRule):
+    name = "dead-op"
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        consumed = ctx.read | ctx.attr_bound | ctx.fetch_names
+        for bidx, oidx, op in opgraph.iter_all_ops(ctx.program):
+            if opgraph.has_side_effects(op):
+                continue
+            if op.attrs.get("op_role") == "optimize":
+                continue
+            outs = op.all_output_names()
+            if not outs:
+                continue
+            live = False
+            for n in outs:
+                v = ctx.resolve(bidx, n)
+                if n in consumed or (v is not None and v.persistable):
+                    live = True
+                    break
+            if not live:
+                diags.add(self.severity, self.name,
+                          "op %r: no output (%s) is ever consumed, fetched, "
+                          "or persistable — dead code the executor still "
+                          "lowers" % (op.type, ", ".join(outs)),
+                          block_idx=bidx, op_idx=oidx, op_type=op.type,
+                          var_names=outs, provenance=_provenance(op))
+        return diags
+
+
+@register_lint_rule
+class UnusedFeedRule(LintRule):
+    name = "unused-feed"
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        for block in ctx.program.blocks:
+            for name, v in block.vars.items():
+                if not v.is_data:
+                    continue
+                if name in ctx.read or name in ctx.attr_bound:
+                    continue
+                diags.add(self.severity, self.name,
+                          "feed var %r is never read by any op" % name,
+                          block_idx=block.idx, var_names=[name])
+        return diags
+
+
+@register_lint_rule
+class UnfetchedOutputRule(LintRule):
+    name = "unfetched-output"
+    severity = INFO
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        if not ctx.fetch_names:
+            return diags  # needs a declared fetch list to judge against
+        for bidx, oidx, op in opgraph.iter_all_ops(ctx.program):
+            for n in op.all_output_names():
+                if n in ctx.read or n in ctx.attr_bound \
+                        or n in ctx.fetch_names:
+                    continue
+                v = ctx.resolve(bidx, n)
+                if v is not None and v.persistable:
+                    continue
+                diags.add(self.severity, self.name,
+                          "terminal output %r of op %r is not in the fetch "
+                          "list — computed then dropped" % (n, op.type),
+                          block_idx=bidx, op_idx=oidx, op_type=op.type,
+                          var_names=[n], provenance=_provenance(op))
+        return diags
+
+
+@register_lint_rule
+class OrphanVarRule(LintRule):
+    name = "orphan-var"
+
+    def check(self, ctx):
+        return find_orphan_vars(ctx.program)
+
+
+@register_lint_rule
+class MixedDtypeMatmulRule(LintRule):
+    name = "mixed-dtype-matmul"
+    _TYPES = ("matmul", "mul", "conv2d")
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        for bidx, oidx, op in opgraph.iter_all_ops(ctx.program):
+            if op.type not in self._TYPES:
+                continue
+            dts = {}
+            for n in op.all_input_names():
+                v = ctx.resolve(bidx, n)
+                if v is not None and "float" in v.dtype:
+                    dts[n] = v.dtype
+            if len(set(dts.values())) > 1:
+                diags.add(self.severity, self.name,
+                          "op %r mixes float dtypes %s — AMP hazard: the "
+                          "lowering silently promotes, hiding a missing "
+                          "cast" % (op.type, dts),
+                          block_idx=bidx, op_idx=oidx, op_type=op.type,
+                          var_names=sorted(dts), provenance=_provenance(op))
+        return diags
+
+
+@register_lint_rule
+class CollectiveSymmetryRule(LintRule):
+    name = "collective-asymmetry"
+    severity = ERROR
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        rings = {}  # ring_id -> {nranks_value: [(bidx, oidx, type)]}
+        for bidx, oidx, op in opgraph.iter_all_ops_deep(ctx.program):
+            t = opgraph.op_type(op)
+            if not t.startswith("c_"):
+                continue
+            attrs = opgraph.op_attrs(op)
+            ring = attrs.get("ring_id", 0)
+            if not isinstance(ring, int) or ring < 0:
+                diags.add(self.severity, self.name,
+                          "op %r has malformed ring_id %r" % (t, ring),
+                          block_idx=bidx, op_idx=oidx, op_type=t,
+                          provenance=_provenance(op))
+                continue
+            if "nranks" in attrs:
+                rings.setdefault(ring, {}).setdefault(
+                    attrs["nranks"], []).append((bidx, oidx, t, op))
+        for ring, by_n in rings.items():
+            if len(by_n) > 1:
+                detail = "; ".join(
+                    "nranks=%r at %s" % (
+                        n, ", ".join("block %d op %d (%s)" % loc[:3]
+                                     for loc in locs))
+                    for n, locs in sorted(by_n.items(), key=lambda kv: repr(kv[0])))
+                # anchor the diagnostic at the first op of the smallest
+                # (most likely outlier) group so sorted()/to_dict
+                # consumers can locate the offending op
+                obidx, ooidx, otype, oop = min(
+                    by_n.values(), key=len)[0]
+                diags.add(self.severity, self.name,
+                          "collectives on ring_id %d disagree on nranks: "
+                          "%s — ranks would hang or reduce across "
+                          "mismatched groups" % (ring, detail),
+                          block_idx=obidx, op_idx=ooidx, op_type=otype,
+                          provenance=_provenance(oop))
+        return diags
+
+
+@register_lint_rule
+class SideEffectOrderRule(LintRule):
+    name = "side-effect-order"
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        for block in ctx.program.blocks:
+            # last writer position per name within this block
+            last_write = {}
+            for oidx, op in enumerate(block.ops):
+                for n in op.all_output_names():
+                    last_write[n] = oidx
+            for oidx, op in enumerate(block.ops):
+                if not opgraph.has_side_effects(op):
+                    continue
+                stale = [
+                    n for n in op.all_input_names()
+                    if last_write.get(n, -1) > oidx
+                ]
+                if stale:
+                    diags.add(
+                        self.severity, self.name,
+                        "side-effect op %r reads %s which op %d later "
+                        "overwrites — it observes the pre-update value"
+                        % (op.type, stale,
+                           max(last_write[n] for n in stale)),
+                        block_idx=block.idx, op_idx=oidx, op_type=op.type,
+                        var_names=stale, provenance=_provenance(op))
+        return diags
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def lint_program(program, feed_names=None, fetch_names=None, rules=None):
+    """Run lint rules (all registered by default, or a list of names /
+    LintRule instances) over `program`; returns Diagnostics."""
+    ctx = LintContext(program, feed_names=feed_names,
+                      fetch_names=fetch_names)
+    diags = Diagnostics()
+    selected = rules if rules is not None else lint_rules()
+    for r in selected:
+        rule = r if isinstance(r, LintRule) else get_lint_rule(r)
+        diags.extend(rule.check(ctx))
+    return diags

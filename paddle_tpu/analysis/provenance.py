@@ -1,0 +1,58 @@
+"""Op-callsite provenance: record WHERE in user code each op was built.
+
+Capability parity: reference `op_callstack` attr — `framework.py` appends
+the Python traceback to every OpDesc so C++ enforce failures can print the
+build site.  Here capture lives in `framework.Block.append_op` (gated off
+by default: a stack walk per op is cheap but not free) and diagnostics /
+`_infer_op` errors render it, so a shape failure or lint finding points at
+the line of model code, not framework internals.
+
+Enable globally with ``fluid.set_flags({"FLAGS_op_callstack": True})`` or
+scoped with::
+
+    with analysis.provenance():
+        out = layers.fc(x, 10)   # op carries attrs["op_callstack"]
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from . import opgraph
+from ..fluid import flags, framework
+
+OP_CALLSTACK_ATTR = framework.OP_CALLSTACK_ATTR
+
+
+def enable_provenance():
+    """Start recording user callsites on every appended op.
+
+    Routed through ``set_flags`` so ``FLAGS_op_callstack`` and the
+    framework capture state stay in sync (both are documented sources of
+    truth; the flag handler toggles the framework)."""
+    flags.set_flags({"FLAGS_op_callstack": True})
+
+
+def disable_provenance():
+    flags.set_flags({"FLAGS_op_callstack": False})
+
+
+def provenance_enabled():
+    return framework.op_callstack_capture_enabled()
+
+
+@contextlib.contextmanager
+def provenance():
+    """Context manager: capture op callsites inside the block."""
+    old = flags.get_flags("FLAGS_op_callstack")["FLAGS_op_callstack"]
+    flags.set_flags({"FLAGS_op_callstack": True})
+    try:
+        yield
+    finally:
+        flags.set_flags({"FLAGS_op_callstack": old})
+
+
+def op_callsite(op):
+    """The recorded callsite frames of an Operator / serialized op dict
+    (innermost user frame first), or [] when capture was off."""
+    return opgraph.op_provenance(op)

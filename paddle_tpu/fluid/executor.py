@@ -327,6 +327,13 @@ class Executor:
         self._cache = {}
         self._rng_counter = 0
         self._run_hist = None  # cached executor_run_ms child (hot path)
+        # program -> versions FLAGS_verify_program already checked — weakly
+        # keyed (no id-reuse collisions) and independent of _cache so
+        # use_program_cache=False loops still verify each program version
+        # exactly once, not every step
+        import weakref
+
+        self._verified_programs = weakref.WeakKeyDictionary()
 
     def close(self):
         self._cache.clear()
@@ -438,6 +445,22 @@ class Executor:
 
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
+            # FLAGS_verify_program: opt-in static verification the first
+            # time each program version is run — a mutated or hand-built
+            # program fails here with a structured diagnostic naming the
+            # op/var instead of an XLA trace error below
+            seen = self._verified_programs.get(program)
+            if (seen is None or program._version not in seen) and \
+                    get_flags(["FLAGS_verify_program"])["FLAGS_verify_program"]:
+                from ..analysis import assert_program_valid
+
+                assert_program_valid(
+                    program, feed_names=list(feed_vals),
+                    fetch_names=fetch_names,
+                    what="program handed to Executor.run "
+                         "(FLAGS_verify_program)")
+                self._verified_programs.setdefault(
+                    program, set()).add(program._version)
             # cache miss: the lowering/trace below plus the XLA compile
             # inside the first jitted call are "compile" time.  The
             # jax.monitoring hooks catch the XLA side; the lowering wall

@@ -22,6 +22,10 @@ _VALUES = {
     "FLAGS_check_nan_inf": False,           # -> jax_debug_nans
     "FLAGS_enable_unused_var_check": False,
     "FLAGS_benchmark": False,
+    # static analysis (paddle_tpu.analysis)
+    "FLAGS_verify_program": False,   # Executor.run verifies on first run
+    "FLAGS_op_callstack": False,     # append_op records user callsites
+    "FLAGS_verify_io_programs": True,  # save/load_inference_model verify
     # memory knobs (XLA BFC owns memory; recorded, no-op)
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
     "FLAGS_allocator_strategy": "auto_growth",
@@ -44,6 +48,15 @@ def _set_debug_nans(value):
 
 
 _HANDLERS["FLAGS_check_nan_inf"] = _set_debug_nans
+
+
+def _set_op_callstack(value):
+    from . import framework
+
+    framework.set_op_callstack_capture(bool(value))
+
+
+_HANDLERS["FLAGS_op_callstack"] = _set_op_callstack
 
 
 def set_flags(flags: dict):

@@ -36,6 +36,71 @@ _DYN_SENTINEL = 1031
 
 GRAD_SUFFIX = "@GRAD"
 
+# Op callsite provenance (cf. reference OpDesc "op_callstack" attr written
+# by append_op): when enabled — set_flags({"FLAGS_op_callstack": True}) or
+# analysis.provenance — every append_op records the user frames that built
+# the op, so verifier/lint diagnostics and _infer_op errors point at the
+# line of model code instead of framework internals.
+_capture_op_callstack = False
+OP_CALLSTACK_ATTR = "op_callstack"
+_PKG_ROOT = None
+
+
+def set_op_callstack_capture(enabled):
+    """Toggle op provenance capture; returns the previous setting."""
+    global _capture_op_callstack
+    old = _capture_op_callstack
+    _capture_op_callstack = bool(enabled)
+    return old
+
+
+def op_callstack_capture_enabled():
+    return _capture_op_callstack
+
+
+def _user_callsite(limit=3):
+    """First `limit` stack frames OUTSIDE paddle_tpu, innermost first —
+    the Python line(s) of user code that built the current op."""
+    import os
+    import sys
+
+    global _PKG_ROOT
+    if _PKG_ROOT is None:
+        # .../paddle_tpu — every frame under it is framework internals
+        _PKG_ROOT = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))) + os.sep
+    frames = []
+    f = sys._getframe(2)
+    while f is not None and len(frames) < limit:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_ROOT):
+            frames.append(
+                "%s:%d (%s)" % (fn, f.f_lineno, f.f_code.co_name))
+        f = f.f_back
+    return frames
+
+
+def _format_callsite(op):
+    stack = op.attrs.get(OP_CALLSTACK_ATTR)
+    if not stack:
+        return ""
+    return "\n  op built at: " + " <- ".join(stack)
+
+
+def _format_op_input_structs(block, op):
+    """'slot=[name(shape, dtype), ...]' summary for inference errors."""
+    parts = []
+    for slot, names in op.inputs.items():
+        descs = []
+        for n in names:
+            v = block._find_var_recursive(n)
+            if v is None:
+                descs.append("%s(<undefined>)" % n)
+            else:
+                descs.append("%s(%s, %s)" % (n, v.shape, v.dtype))
+        parts.append("%s=[%s]" % (slot, ", ".join(descs)))
+    return "; ".join(parts) if parts else "<no inputs>"
+
 
 class Variable:
     """A named tensor in a Block (cf. reference framework.py:835 / VarDesc)."""
@@ -222,6 +287,11 @@ class Parameter(Variable):
         )
 
 
+class OpInputResolutionError(RuntimeError):
+    """An op input name resolves to no Variable (raised during shape
+    inference so callers can tell it apart from lowering failures)."""
+
+
 class Operator:
     """One op invocation (cf. reference framework.py:1822 / OpDesc).
 
@@ -318,6 +388,8 @@ class Block:
         op = Operator(self, type, inputs, outputs, attrs)
         if _current_device is not None and "op_device" not in op.attrs:
             op.attrs["op_device"] = _current_device
+        if _capture_op_callstack and OP_CALLSTACK_ATTR not in op.attrs:
+            op.attrs[OP_CALLSTACK_ATTR] = _user_callsite()
         self.ops.append(op)
         if infer:
             self._infer_op(op)
@@ -331,15 +403,27 @@ class Block:
         self.program._bump()
         return op
 
-    def _infer_op(self, op):
-        """Graph-time shape/dtype inference via jax.eval_shape on the lowering."""
+    def _eval_op_structs(self, op):
+        """jax.eval_shape over the op's lowering: {out_slot: [SDS, ...]}.
+
+        Shared by build-time `_infer_op` and the analysis verifier's
+        whole-program shape re-inference (paddle_tpu.analysis.verifier) —
+        one inference implementation, replayable over mutated programs."""
         import jax
 
         opdef = get_op_def(op.type)
-        in_structs = {
-            slot: [self.var(n)._sds() for n in names]
-            for slot, names in op.inputs.items()
-        }
+        in_structs = {}
+        for slot, names in op.inputs.items():
+            structs = []
+            for n in names:
+                v = self._find_var_recursive(n)
+                if v is None:
+                    raise OpInputResolutionError(
+                        "op '%s' reads var '%s' (slot %s) which is not "
+                        "defined in block %d or its ancestors%s"
+                        % (op.type, n, slot, self.idx, _format_callsite(op)))
+                structs.append(v._sds())
+            in_structs[slot] = structs
 
         def f(ins):
             ctx = LowerContext(base_key=None, is_test=True)
@@ -348,11 +432,19 @@ class Block:
                 ctx._base_key = jax.random.PRNGKey(0)
             return opdef.lower(ctx, ins, op.attrs)
 
+        return jax.eval_shape(f, in_structs)
+
+    def _infer_op(self, op):
+        """Graph-time shape/dtype inference via jax.eval_shape on the lowering."""
         try:
-            out_structs = jax.eval_shape(f, in_structs)
+            out_structs = self._eval_op_structs(op)
+        except OpInputResolutionError:
+            raise  # already carries the op/var/callsite context
         except Exception as e:
             raise RuntimeError(
-                "shape inference failed for op %r: %s" % (op, e)
+                "shape inference failed for op %r: %s\n  with inputs: %s%s"
+                % (op, e, _format_op_input_structs(self, op),
+                   _format_callsite(op))
             ) from e
 
         for slot, names in op.outputs.items():
@@ -458,6 +550,14 @@ class Program:
                     no.attrs["is_test"] = True
                 nb.ops.append(no)
             p.blocks.append(nb)
+        if for_test:
+            # pruning backward/optimizer ops strands their grad vars; drop
+            # entries no kept op references so eval clones stay
+            # orphan-clean (shared sweep matching the verifier's
+            # orphan-var exemptions)
+            from ..analysis import opgraph
+
+            opgraph.drop_orphan_vars(p)
         p._bump()
         return p
 

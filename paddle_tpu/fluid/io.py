@@ -128,16 +128,40 @@ def _prune_program(program, feed_names, target_names):
     return pruned
 
 
+def _verify_io_program(program, feed_names, fetch_names, what):
+    """Static verification gate on the export/load paths
+    (FLAGS_verify_io_programs, default on): a pruned-wrong or corrupted
+    serialized program fails HERE with structured diagnostics instead of
+    surfacing as an opaque trace error at serving time.  Structural
+    invariants only — cheap enough for in-loop saves; full shape
+    re-inference stays available via analysis.verify_program /
+    FLAGS_verify_program / tools/program_lint.py."""
+    from .flags import get_flags
+
+    if not get_flags(["FLAGS_verify_io_programs"])["FLAGS_verify_io_programs"]:
+        return
+    from ..analysis import assert_program_valid
+
+    assert_program_valid(program, feed_names=feed_names,
+                         fetch_names=fetch_names, check_shapes=False,
+                         what=what)
+
+
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None):
     """cf. reference io.py:1093 — prune to the inference subgraph, serialize
-    the program + parameters."""
+    the program + parameters.  The pruned program is statically verified
+    before anything is written (FLAGS_verify_io_programs)."""
     program = main_program or framework.default_main_program()
     target_names = [
         t.name if isinstance(t, framework.Variable) else t for t in target_vars
     ]
     pruned = _prune_program(program, list(feeded_var_names), target_names)
+    _verify_io_program(
+        pruned, list(feeded_var_names), target_names,
+        "pruned inference program (save_inference_model would export a "
+        "broken model)")
     os.makedirs(dirname, exist_ok=True)
     model_path = os.path.join(dirname, model_filename or "__model__.json")
     with open(model_path, "w") as f:
@@ -161,6 +185,10 @@ def load_inference_model(dirname, executor, model_filename=None,
         program = framework.Program.from_json(f.read())
     with open(os.path.join(dirname, "__meta__.pkl"), "rb") as f:
         meta = pickle.load(f)
+    _verify_io_program(
+        program, list(meta.get("feed_names", [])),
+        list(meta.get("fetch_names", [])),
+        "deserialized inference program %r" % model_path)
     load_vars(
         executor, dirname, program,
         predicate=lambda v: v.persistable and not v.is_data,

@@ -60,11 +60,37 @@ def get_pass(name):
     return _PASS_REGISTRY[name]()
 
 
-def apply_passes(program, names):
-    """Run a pass pipeline (cf. PassBuilder) over the program."""
+def apply_passes(program, names, verify=False):
+    """Run a pass pipeline (cf. PassBuilder) over the program.
+
+    verify=True re-runs the whole-program static verifier (structural
+    invariants + shape re-inference + orphan-var check, see
+    `paddle_tpu.analysis`) AFTER EACH pass and raises a
+    ProgramVerificationError NAMING the offending pass — so a broken
+    rewrite fails at the pass boundary, not as an XLA trace error deep
+    inside Executor.run."""
+    if verify:
+        from ..analysis import assert_program_valid
+
+        assert_program_valid(
+            program, check_orphans=True,
+            what="program handed to apply_passes (before any pass ran)")
     for n in names:
         p = n if isinstance(n, Pass) else get_pass(n)
         program = p.apply(program)
+        if verify:
+            from ..analysis import (
+                ProgramVerificationError, assert_program_valid,
+            )
+
+            pass_name = getattr(p, "name", None) or type(p).__name__
+            try:
+                assert_program_valid(
+                    program, check_orphans=True,
+                    what="program after pass %r" % pass_name)
+            except ProgramVerificationError as e:
+                e.pass_name = pass_name
+                raise
     return program
 
 
@@ -123,35 +149,51 @@ class DeadOpEliminationPass(Pass):
     persistable (cf. the reference's eager-deletion/memory passes — at
     the program level the equivalent hygiene is deleting dead ops so the
     executor never lowers them).  Set("keep", [names]) protects extra
-    vars (e.g. a fetch list known ahead of time)."""
+    vars (e.g. a fetch list known ahead of time).
+
+    Liveness spans EVERY block plus the sub-block ops control flow and
+    recompute serialize into attrs: a var consumed only inside a
+    cond/while/static_rnn body (or referenced through a name-list attr
+    like ``cap_names``) keeps its parent-block producer alive, and an op
+    whose sub-block contains a side effect (e.g. a cond that prints) is
+    never deleted.  Vars stranded by op removal are dropped from their
+    block's var table so the pass leaves no orphans behind."""
 
     name = "dead_op_elimination"
 
-    _SIDE_EFFECT_OPS = {"print", "assert", "py_func", "save", "load",
-                        "c_broadcast", "c_allreduce_sum", "send", "recv"}
-
     def apply(self, program):
+        from ..analysis import opgraph
+
         keep = set(self.get("keep", []))
-        block = program.current_block()
         changed = True
         while changed:
             changed = False
             live = set(keep)
-            for op in block.ops:
-                live.update(op.all_input_names())
-            for v in block.vars.values():
-                if getattr(v, "persistable", False):
-                    live.add(v.name)
-            kept_ops = []
-            for op in block.ops:
-                outs = op.all_output_names()
-                if (op.type in self._SIDE_EFFECT_OPS or not outs
-                        or any(o in live for o in outs)
-                        or op.attrs.get("op_role") == "optimize"):
-                    kept_ops.append(op)
-                else:
-                    changed = True
-            block.ops[:] = kept_ops
+            # reads from every real op in every block, every serialized
+            # sub-op, and every name-list attr (sub-block alias bindings)
+            for _b, _i, op in opgraph.iter_all_ops_deep(program):
+                live.update(opgraph.input_names(op))
+                for _k, vals in opgraph.attr_name_lists(op):
+                    live.update(vals)
+            for block in program.blocks:
+                for v in block.vars.values():
+                    if getattr(v, "persistable", False):
+                        live.add(v.name)
+            for block in program.blocks:
+                kept_ops = []
+                for op in block.ops:
+                    outs = op.all_output_names()
+                    if (opgraph.has_side_effects(op) or not outs
+                            or any(o in live for o in outs)
+                            or op.attrs.get("op_role") == "optimize"):
+                        kept_ops.append(op)
+                    else:
+                        changed = True
+                block.ops[:] = kept_ops
+        # drop vars the removed ops stranded (orphan hygiene: the verifier
+        # flags unreferenced entries, and a later pass must not trip over
+        # stale shape metadata)
+        opgraph.drop_orphan_vars(program, keep=keep)
         program._bump()
         return program
 
@@ -169,7 +211,10 @@ class BatchNormActFusePass(Pass):
     _ACTS = ("relu", "sigmoid", "tanh")
 
     def apply(self, program):
+        from ..analysis import opgraph
+
         block = program.current_block()
+        rewired = []
         for act in self._ACTS:
             while True:
                 matches = match_chain(block, ["batch_norm", act])
@@ -180,7 +225,16 @@ class BatchNormActFusePass(Pass):
                 bn.attrs["act_type"] = act
                 # the fused op's Y takes the activation's output name
                 act_out = act_op.all_output_names()[0]
+                old_y = bn.outputs["Y"][0]
                 bn.outputs["Y"] = [act_out]
                 block.ops.remove(act_op)
+                if old_y != act_out:
+                    rewired.append(old_y)
+        # the rewiring strands the original batch_norm Y name: drop it
+        # from the var table (it held stale shape metadata and tripped
+        # the orphan-var verifier rule) unless something else still
+        # references it
+        if rewired:
+            opgraph.drop_orphan_vars(program, candidates=rewired)
         program._bump()
         return program

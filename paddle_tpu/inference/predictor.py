@@ -122,6 +122,14 @@ class Predictor:
                 scope=self._scope, batch_generator=None,
                 quantize_activations=False,  # weight-only without calib data
             ).quantize()
+            # the int8 rewrite is a program mutation like any IR pass:
+            # re-verify before compiling against it (the load-time check
+            # above only saw the fp32 program)
+            from ..fluid.io import _verify_io_program
+
+            _verify_io_program(
+                program, list(feeds), list(self._fetch_names),
+                "int8-quantized inference program")
             self._program = program
         block = program.global_block
         ops = block.ops

@@ -1,0 +1,608 @@
+"""paddle_tpu.analysis — mutation suite for the whole-program verifier and
+lint engine, pass-pipeline safety net, and the model-zoo self-check.
+
+Method (cf. reference per-op InferShape unit tests, generalized): for every
+verifier invariant and lint rule, take a known-good program, seed exactly
+one defect (drop a producer, typo an op type, skew a shape, desync a
+ring_id, ...) and assert exactly that diagnostic fires — then assert the
+UNCORRUPTED program is clean, so the rules can't pass by firing on
+everything.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import analysis, models
+from paddle_tpu.fluid import ir, layers
+
+
+def _simple_program():
+    """data -> fc(relu) -> reduce_sum; returns (main, startup, out)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 4], append_batch_size=False)
+        h = layers.fc(x, 8, act="relu", param_attr="tsa.w")
+        out = layers.reduce_sum(h)
+    return main, startup, out
+
+
+def _error_codes(program, **kw):
+    return {d.code for d in analysis.verify_program(program, **kw)
+            if d.severity == analysis.ERROR}
+
+
+def _lint_codes(program, **kw):
+    return {d.code for d in analysis.lint_program(program, **kw)}
+
+
+# ---------------------------------------------------------------------------
+# verifier invariants: seed one defect each, assert the exact diagnostic
+# ---------------------------------------------------------------------------
+
+
+def test_clean_program_verifies_clean():
+    main, _s, out = _simple_program()
+    diags = analysis.verify_program(
+        main, feed_names=["x"], fetch_names=[out.name])
+    assert not diags.has_errors, diags.format()
+
+
+def test_dropped_producer_fires_def_before_use():
+    main, _s, out = _simple_program()
+    b = main.global_block
+    relu_idx = [i for i, o in enumerate(b.ops) if o.type == "relu"][0]
+    del b.ops[relu_idx]  # var entry survives: read of a never-produced var
+    codes = _error_codes(main, feed_names=["x"])
+    assert "def-before-use" in codes
+
+
+def test_reordered_consumer_fires_def_before_use():
+    main, _s, out = _simple_program()
+    b = main.global_block
+    b.ops.append(b.ops.pop(0))  # producer now AFTER its consumer
+    assert "def-before-use" in _error_codes(main, feed_names=["x"])
+
+
+def test_typoed_op_type_fires_unknown_op():
+    main, _s, out = _simple_program()
+    main.global_block.ops[0].type = "mull"
+    diags = analysis.verify_program(main, feed_names=["x"])
+    bad = diags.by_code("unknown-op")
+    assert bad and bad[0].op_type == "mull"
+
+
+def test_deleted_var_entry_fires_dangling():
+    main, _s, out = _simple_program()
+    name = main.global_block.ops[-1].all_input_names()[0]
+    del main.global_block.vars[name]
+    codes = _error_codes(main, feed_names=["x"])
+    assert "dangling-input" in codes and "dangling-output" in codes
+
+
+def test_skewed_shape_fires_shape_mismatch():
+    main, _s, out = _simple_program()
+    v = main.global_block.vars[main.global_block.ops[-1].all_input_names()[0]]
+    v.shape = (v.shape[0], 999)
+    diags = analysis.verify_program(main, feed_names=["x"])
+    bad = diags.by_code("shape-mismatch")
+    assert bad and "999" in bad[0].message
+
+
+def test_skewed_dtype_fires_dtype_mismatch():
+    main, _s, out = _simple_program()
+    v = main.global_block.vars[main.global_block.ops[-1].all_input_names()[0]]
+    v.dtype = "float16"
+    assert "dtype-mismatch" in _error_codes(main, feed_names=["x"])
+
+
+def test_mistyped_fetch_target_fires_missing_fetch():
+    main, _s, out = _simple_program()
+    assert "missing-fetch" in _error_codes(
+        main, feed_names=["x"], fetch_names=["n0pe"])
+
+
+def test_pruned_producer_fetch_fires_missing_fetch():
+    # the fetch var's entry survives but its producer is gone — the
+    # broken-export case the save_inference_model gate exists to stop
+    main, _s, out = _simple_program()
+    main.global_block.ops.pop()  # drop the reduce_sum producing `out`
+    assert "missing-fetch" in _error_codes(
+        main, feed_names=["x"], fetch_names=[out.name])
+
+
+def test_extra_output_name_fires_out_arity_mismatch():
+    # a broken pass appends an extra name to an output slot AND gives it a
+    # var-table entry: dangling-output stays quiet (the var exists), so the
+    # arity check is the only thing standing between this and a lowering
+    # failure inside Executor.run
+    main, _s, out = _simple_program()
+    b = main.global_block
+    op = b.ops[-1]
+    slot = next(iter(op.outputs))
+    b.create_var("tsa.phantom", shape=(3, 3), dtype="float32")
+    op.outputs[slot] = list(op.outputs[slot]) + ["tsa.phantom"]
+    diags = analysis.verify_program(main, feed_names=["x"])
+    bad = diags.by_code("out-arity-mismatch")
+    assert bad and "tsa.phantom" in bad[0].var_names
+    assert "dangling-output" not in {d.code for d in diags}
+
+
+def test_duplicate_definition_fires():
+    main, _s, out = _simple_program()
+    b = main.global_block
+    src = b.ops[1]
+    b.ops.append(
+        fluid.Operator(b, src.type, src.inputs, src.outputs, src.attrs))
+    assert "duplicate-definition" in _error_codes(main, feed_names=["x"])
+
+
+def test_corrupt_parent_link_fires_bad_block_link():
+    main, _s, out = _simple_program()
+    main.blocks[0].parent_idx = 0
+    assert "bad-block-link" in _error_codes(main, feed_names=["x"])
+
+
+def test_corrupt_sub_block_attr_fires_bad_sub_block():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2, 4], append_batch_size=False)
+        pred = layers.reduce_sum(x) > 0.0
+        layers.cond(pred, lambda: x + 1.0, lambda: x * 2.0)
+    assert not analysis.verify_program(main, feed_names=["x"]).has_errors
+    cond_op = [o for o in main.global_block.ops if o.type == "cond"][0]
+    cond_op.attrs["sub_block_true"] = 99
+    assert "bad-sub-block" in _error_codes(main, feed_names=["x"])
+
+
+def test_control_flow_and_roundtrip_verify_clean():
+    """cond/while/static_rnn programs — and their JSON round trips —
+    satisfy every invariant (sub-block aliases must not false-positive)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2, 4], append_batch_size=False)
+        w = layers.fc(x, 4, param_attr="cfrt.w")
+        pred = layers.reduce_sum(x) > 0.0
+        out = layers.cond(pred, lambda: w + 1.0, lambda: w * 2.0)
+        i = layers.fill_constant([1], "int64", 0)
+        wl = layers.while_loop(lambda i: i < 3, lambda i: i + 1, [i])
+        seq = layers.data("seq", shape=[3, 2, 4], append_batch_size=False)
+        h0 = layers.fill_constant([2, 4], "float32", 0.0)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(seq)
+            hp = rnn.memory(init=h0)
+            h = layers.elementwise_add(xt, hp)
+            rnn.update_memory(hp, h)
+            rnn.step_output(h)
+        final = layers.reduce_sum(out) + layers.reduce_sum(rnn())
+    fetch = [final.name, wl[0].name]
+    for prog in (main, fluid.Program.from_json(main.to_json())):
+        diags = analysis.verify_program(
+            prog, feed_names=["x", "seq"], fetch_names=fetch)
+        assert not diags.has_errors, diags.format()
+
+
+# ---------------------------------------------------------------------------
+# lint rules: each fires on its seeded defect, stays quiet otherwise
+# ---------------------------------------------------------------------------
+
+
+def test_lint_dead_op_fires_and_respects_subblock_reads():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2, 4], append_batch_size=False)
+        dead = layers.fc(x, 7, param_attr="dead.w")  # nothing consumes
+        pred = layers.reduce_sum(x) > 0.0
+        w = layers.fc(x, 4, param_attr="live.w")  # consumed ONLY in branch
+        kept = layers.cond(pred, lambda: w + 1.0, lambda: w * 2.0)
+        out = layers.reduce_sum(kept)
+    diags = analysis.lint_program(
+        main, feed_names=["x"], fetch_names=[out.name], rules=["dead-op"])
+    flagged = {n for d in diags.by_code("dead-op") for n in d.var_names}
+    assert dead.name in flagged
+    # the branch-only consumer keeps w's producer chain off the dead list
+    assert w.name not in flagged
+
+
+def test_lint_unused_feed_fires():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2, 4], append_batch_size=False)
+        layers.data("never_read", shape=[2, 4], append_batch_size=False)
+        layers.reduce_sum(x)
+    diags = analysis.lint_program(main, rules=["unused-feed"])
+    assert {"never_read"} == {
+        n for d in diags.by_code("unused-feed") for n in d.var_names}
+
+
+def test_lint_unfetched_output_fires_only_with_fetch_list():
+    main, _s, out = _simple_program()
+    with fluid.program_guard(main):
+        extra = layers.reduce_mean(main.global_block.var("tsa.w"))
+    diags = analysis.lint_program(
+        main, fetch_names=[out.name], rules=["unfetched-output"])
+    names = {n for d in diags.by_code("unfetched-output")
+             for n in d.var_names}
+    assert extra.name in names and out.name not in names
+    assert not analysis.lint_program(main, rules=["unfetched-output"])
+
+
+def test_lint_orphan_var_fires():
+    main, _s, out = _simple_program()
+    main.global_block.create_var(name="stray", shape=(3,), dtype="float32")
+    diags = analysis.lint_program(main, rules=["orphan-var"])
+    assert {"stray"} == {
+        n for d in diags.by_code("orphan-var") for n in d.var_names}
+
+
+def test_lint_mixed_dtype_matmul_fires():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[2, 4], append_batch_size=False)
+        b = layers.data("b", shape=[4, 3], append_batch_size=False)
+        bh = layers.cast(b, "float16")  # half-cast operand: AMP hazard
+        layers.matmul(a, bh)
+    diags = analysis.lint_program(main, rules=["mixed-dtype-matmul"])
+    assert diags.by_code("mixed-dtype-matmul")
+    # a fully-fp32 matmul is quiet
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        a = layers.data("a", shape=[2, 4], append_batch_size=False)
+        b = layers.data("b", shape=[4, 3], append_batch_size=False)
+        layers.matmul(a, b)
+    assert not analysis.lint_program(main2, rules=["mixed-dtype-matmul"])
+
+
+def test_lint_collective_asymmetry_fires_on_desynced_nranks():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2, 4], append_batch_size=False)
+        y = x + 1.0
+    b = main.global_block
+    for i, nranks in enumerate((2, 2)):
+        b.append_op(
+            "c_allreduce_sum", {"X": [y.name]},
+            {"Out": [b.create_var(name="ar%d" % i, shape=(2, 4)).name]},
+            {"ring_id": 0, "nranks": nranks})
+    assert not analysis.lint_program(
+        main, rules=["collective-asymmetry"]).has_errors
+    b.ops[-1].attrs["nranks"] = 4  # desync one participant
+    diags = analysis.lint_program(main, rules=["collective-asymmetry"])
+    bad = diags.by_code("collective-asymmetry")
+    assert bad and bad[0].severity == analysis.ERROR
+
+
+def test_lint_side_effect_order_fires():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2, 4], append_batch_size=False)
+        s = layers.reduce_sum(x)
+    b = main.global_block
+    b.append_op("print", {"In": [s.name]},
+                {"Out": [b.create_var(name="p_out", shape=(1,)).name]},
+                {"message": "s="})
+    assert not analysis.lint_program(main, rules=["side-effect-order"])
+    # a later op overwrites what the print already read
+    b.append_op("scale", {"X": [x.name]}, {"Out": [s.name]}, {"scale": 2.0})
+    diags = analysis.lint_program(main, rules=["side-effect-order"])
+    bad = diags.by_code("side-effect-order")
+    assert bad and s.name in bad[0].var_names
+
+
+# ---------------------------------------------------------------------------
+# pass-pipeline safety net
+# ---------------------------------------------------------------------------
+
+
+def test_dead_op_pass_keeps_producers_consumed_in_subblocks():
+    """Regression: liveness must span all blocks — a var consumed only by
+    an op living in a control-flow-style sub-block kept its parent-block
+    producer; the old single-block scan deleted it."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2, 4], append_batch_size=False)
+        w = layers.fc(x, 4, param_attr="dop.w")  # consumed ONLY in block 1
+        out = layers.reduce_sum(x)
+        sub = main._create_block()
+        sub.append_op(
+            "scale", {"X": [w.name]},
+            {"Out": [sub.create_var(name="sub_out", shape=(2, 4)).name]},
+            {"scale": 2.0})
+        main._rollback()
+    ir.apply_passes(main, [ir.get_pass("dead_op_elimination")
+                           .set("keep", [out.name, "sub_out"])])
+    kept = [o.type for o in main.global_block.ops]
+    assert "mul" in kept and "elementwise_add" in kept, kept
+    assert [o.type for o in main.blocks[1].ops] == ["scale"]
+
+
+def test_dead_op_pass_still_removes_dead_chains_and_their_vars():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 4], append_batch_size=False)
+        kept = layers.fc(x, 3, param_attr="dk.w")
+        dead = layers.relu(layers.fc(x, 7))
+        out = layers.reduce_sum(kept)
+    ir.apply_passes(main, [ir.get_pass("dead_op_elimination")
+                           .set("keep", [out.name])])
+    types = [o.type for o in main.global_block.ops]
+    assert "relu" not in types
+    assert dead.name not in main.global_block.vars  # no orphan left behind
+    assert not analysis.find_orphan_vars(main)
+
+
+def test_dead_op_pass_protects_side_effects_inside_subblocks():
+    """A cond whose branch prints has dead outputs but a live effect."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2, 4], append_batch_size=False)
+        pred = layers.reduce_sum(x) > 0.0
+
+        def noisy():
+            layers.Print(x, message="branch")
+            return x + 1.0
+
+        layers.cond(pred, noisy, lambda: x * 2.0)  # outputs unused
+        out = layers.reduce_sum(x)
+    ir.apply_passes(main, [ir.get_pass("dead_op_elimination")
+                           .set("keep", [out.name])])
+    assert "cond" in [o.type for o in main.global_block.ops]
+
+
+def test_batch_norm_act_fuse_cleans_up_orphaned_y(
+):
+    """Regression: the fuse rewires bn.outputs['Y'] to the act's output —
+    the original Y name must leave block.vars (it held stale shape
+    metadata), and the orphan-var rule guards the invariant."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 6], append_batch_size=False)
+        h = layers.batch_norm(layers.fc(x, 6, param_attr="bnfz.w"),
+                              act="relu")
+        out = layers.reduce_sum(h)
+    bn = [o for o in main.global_block.ops if o.type == "batch_norm"][0]
+    old_y = bn.outputs["Y"][0]
+    # verify=True makes the orphan check part of the pass contract: a
+    # regression to the old leave-it-behind behavior fails HERE
+    ir.apply_passes(main, ["batch_norm_act_fuse"], verify=True)
+    assert old_y not in main.global_block.vars
+    assert not analysis.find_orphan_vars(main)
+    assert "fused_batch_norm_act" in [
+        o.type for o in main.global_block.ops]
+
+
+def test_apply_passes_verify_catches_and_names_broken_pass():
+    @ir.register_pass
+    class _ProducerDroppingPass(ir.Pass):
+        name = "test_producer_dropping_pass"
+
+        def apply(self, program):
+            del program.global_block.ops[0]
+            program._bump()
+            return program
+
+    main, _s, out = _simple_program()
+    with pytest.raises(analysis.ProgramVerificationError) as ei:
+        ir.apply_passes(
+            main, ["batch_norm_act_fuse", "test_producer_dropping_pass"],
+            verify=True)
+    assert ei.value.pass_name == "test_producer_dropping_pass"
+    assert "test_producer_dropping_pass" in str(ei.value)
+    assert ei.value.diagnostics.has_errors
+    # the healthy pass before it was NOT blamed
+    assert "batch_norm_act_fuse" not in str(ei.value.pass_name)
+
+
+def test_apply_passes_verify_passes_on_clean_pipeline():
+    main, _s, out = _simple_program()
+    got = ir.apply_passes(
+        main, [ir.get_pass("dead_op_elimination").set("keep", [out.name])],
+        verify=True)
+    assert got is main
+
+
+# ---------------------------------------------------------------------------
+# hot-path wiring: executor flag, io gate, provenance
+# ---------------------------------------------------------------------------
+
+
+def test_executor_flag_verifies_on_first_run():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 4], append_batch_size=False)
+        out = layers.reduce_sum(layers.fc(x, 3, param_attr="exf.w"))
+    del main.global_block.ops[0]  # corrupt after build
+    fluid.set_flags({"FLAGS_verify_program": True})
+    try:
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            with pytest.raises(analysis.ProgramVerificationError):
+                exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                        fetch_list=[out])
+    finally:
+        fluid.set_flags({"FLAGS_verify_program": False})
+
+
+def test_save_and_load_inference_model_verify(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 4], append_batch_size=False)
+        out = layers.reduce_sum(layers.fc(x, 3, param_attr="iog.w"), dim=-1)
+    exe = fluid.Executor()
+    d = str(tmp_path / "model")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                      main_program=main)
+        # corrupt the serialized program: load must refuse it
+        mp = os.path.join(d, "__model__.json")
+        with open(mp) as f:
+            prog = json.load(f)
+        prog["blocks"][0]["ops"][0]["type"] = "mull"
+        with open(mp, "w") as f:
+            json.dump(prog, f)
+        with pytest.raises(analysis.ProgramVerificationError):
+            fluid.io.load_inference_model(d, exe)
+
+
+def test_save_inference_model_refuses_corrupted_program(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[-1, 4], append_batch_size=False)
+        out = layers.reduce_sum(layers.fc(x, 3, param_attr="iog2.w"))
+    # drop the producer of the fetch target's input: the pruned program
+    # reads a var nothing produces — the export gate must refuse it
+    del main.global_block.ops[1]  # elementwise_add (fc bias add)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with pytest.raises(analysis.ProgramVerificationError):
+            fluid.io.save_inference_model(
+                str(tmp_path / "m2"), ["x"], [out], exe, main_program=main)
+
+
+def test_provenance_capture_and_infer_error_context():
+    with analysis.provenance():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("provx", shape=[2, 4], append_batch_size=False)
+            layers.fc(x, 8, param_attr="prov.w")
+    op = main.global_block.ops[0]
+    stack = analysis.op_callsite(op)
+    assert stack and __file__.split(os.sep)[-1] in stack[0]
+    assert not analysis.provenance_enabled()  # scope restored
+
+    # shape-inference failure names input shapes/dtypes + the callsite
+    with analysis.provenance():
+        main2, startup2 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main2, startup2):
+            a = layers.data("a", shape=[2, 3], append_batch_size=False)
+            b = layers.data("b", shape=[5, 7], append_batch_size=False)
+            with pytest.raises(RuntimeError) as ei:
+                layers.matmul(a, b)
+    msg = str(ei.value)
+    assert "(2, 3)" in msg and "(5, 7)" in msg
+    assert __file__.split(os.sep)[-1] in msg
+
+
+def test_diagnostics_carry_provenance():
+    with analysis.provenance():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[2, 4], append_batch_size=False)
+            h = layers.fc(x, 8, param_attr="dprov.w")
+            layers.reduce_sum(h)
+    main.global_block.ops[0].type = "mull"
+    diags = analysis.verify_program(main, feed_names=["x"])
+    bad = diags.by_code("unknown-op")
+    assert bad and bad[0].provenance
+    assert __file__.split(os.sep)[-1] in bad[0].provenance[0]
+    assert "built at" in bad[0].format()
+
+
+def test_program_lint_cli(tmp_path):
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "program_lint", os.path.join(repo, "tools", "program_lint.py"))
+    pl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pl)
+
+    main, _s, out = _simple_program()
+    path = str(tmp_path / "prog.json")
+    with open(path, "w") as f:
+        f.write(main.to_json())
+    assert pl.main([path, "--feed", "x", "--fetch", out.name]) == 0
+
+    with open(path) as f:
+        prog = json.load(f)
+    prog["blocks"][0]["ops"][1]["type"] = "zzz"
+    with open(path, "w") as f:
+        json.dump(prog, f)
+    assert pl.main([path, "--feed", "x", "--fetch", out.name,
+                    "--json"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# model-zoo self-check: the analyzer is a standing regression gate over the
+# whole layer library — every built-in model program verifies + lints with
+# ZERO errors
+# ---------------------------------------------------------------------------
+
+
+def _build_lenet():
+    x = layers.data("img", shape=[-1, 1, 28, 28], append_batch_size=False)
+    return [models.LeNet5()(x)]
+
+
+def _build_resnet():
+    x = layers.data("img", shape=[-1, 3, 32, 32], append_batch_size=False)
+    return [models.resnet18(num_classes=7)(x)]
+
+
+def _build_vgg():
+    x = layers.data("img", shape=[-1, 3, 32, 32], append_batch_size=False)
+    return [models.VGG(depth=16, num_classes=5, in_channels=3)(x)]
+
+
+def _build_mobilenet():
+    x = layers.data("img", shape=[-1, 3, 32, 32], append_batch_size=False)
+    return [models.mobilenet_v1(num_classes=5)(x)]
+
+
+def _build_bert():
+    cfg = models.BertConfig.tiny()
+    B, S = 2, 16
+    mk = lambda n: layers.data(  # noqa: E731
+        n, shape=[B, S], append_batch_size=False, dtype="int64")
+    logits, nsp = models.BertForPretraining(cfg)(
+        mk("ids"), mk("seg"), mk("pos"), mk("mask"))
+    return [logits, nsp]
+
+
+def _build_transformer():
+    cfg = models.TransformerConfig.tiny()
+    B, S = 2, 8
+    mk = lambda n: layers.data(  # noqa: E731
+        n, shape=[B, S], append_batch_size=False, dtype="int64")
+    return [models.Transformer(cfg)(
+        mk("src"), mk("srcp"), mk("tgt"), mk("tgtp"))]
+
+
+def _build_moe():
+    x = layers.data("x", shape=[2, 4, 16], append_batch_size=False)
+    out = models.MoEFFN(16, 32, num_experts=4)(x)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+_MODEL_BUILDERS = [
+    ("lenet", _build_lenet),
+    ("resnet", _build_resnet),
+    ("vgg", _build_vgg),
+    ("mobilenet", _build_mobilenet),
+    ("bert", _build_bert),
+    ("transformer", _build_transformer),
+    ("moe", _build_moe),
+]
+
+
+@pytest.mark.parametrize("name,builder", _MODEL_BUILDERS,
+                         ids=[n for n, _ in _MODEL_BUILDERS])
+def test_model_zoo_verifies_and_lints_clean(name, builder):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = builder()
+    fetch_names = [f.name for f in fetches]
+    for prog, what in ((main, "main"), (startup, "startup")):
+        diags = analysis.analyze_program(
+            prog, fetch_names=fetch_names if prog is main else None)
+        errors = diags.errors()
+        assert not errors, "%s %s program: %s" % (
+            name, what, "\n".join(d.format() for d in errors))

@@ -1,0 +1,97 @@
+"""Lint/verify a serialized program JSON from the command line.
+
+Usage::
+
+    python tools/program_lint.py path/to/__model__.json \
+        [--feed x,y] [--fetch out] [--no-shapes] [--json] [--strict]
+
+Runs the `paddle_tpu.analysis` ProgramVerifier (structural invariants +
+whole-program shape re-inference) and every registered lint rule over the
+program, printing structured diagnostics.  Exit code 1 when any
+error-severity finding exists (or any finding at all with --strict), 0
+otherwise — wire it into CI against exported `__model__.json` artifacts.
+
+Also accepts an inference-model DIRECTORY (as written by
+save_inference_model): the program and feed/fetch lists are taken from
+`__model__.json` + `__meta__.pkl`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load(path):
+    """(program, feed_names, fetch_names) from a JSON file or model dir."""
+    feed_names, fetch_names = [], []
+    if os.path.isdir(path):
+        meta_path = os.path.join(path, "__meta__.pkl")
+        if os.path.exists(meta_path):
+            with open(meta_path, "rb") as f:
+                meta = pickle.load(f)
+            feed_names = list(meta.get("feed_names", []))
+            fetch_names = list(meta.get("fetch_names", []))
+        candidates = [p for p in os.listdir(path) if p.endswith(".json")]
+        preferred = "__model__.json" if "__model__.json" in candidates \
+            else (candidates[0] if candidates else None)
+        if preferred is None:
+            raise SystemExit("no program JSON found in directory %r" % path)
+        path = os.path.join(path, preferred)
+    from paddle_tpu.fluid.framework import Program
+
+    with open(path) as f:
+        program = Program.from_json(f.read())
+    return program, feed_names, fetch_names
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="program_lint",
+        description="statically verify + lint a serialized program")
+    ap.add_argument("model", help="program JSON file or inference model dir")
+    ap.add_argument("--feed", default="",
+                    help="comma-separated feed var names (overrides meta)")
+    ap.add_argument("--fetch", default="",
+                    help="comma-separated fetch var names (overrides meta)")
+    ap.add_argument("--no-shapes", action="store_true",
+                    help="skip whole-program shape re-inference (faster)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated lint rule subset (default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit diagnostics as a JSON array")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on ANY finding, not just errors")
+    args = ap.parse_args(argv)
+
+    import paddle_tpu.analysis as analysis
+
+    program, feed_names, fetch_names = _load(args.model)
+    if args.feed:
+        feed_names = [s for s in args.feed.split(",") if s]
+    if args.fetch:
+        fetch_names = [s for s in args.fetch.split(",") if s]
+    rules = [s for s in args.rules.split(",") if s] or None
+
+    diags = analysis.analyze_program(
+        program, feed_names=feed_names, fetch_names=fetch_names,
+        check_shapes=not args.no_shapes, rules=rules)
+
+    if args.as_json:
+        print(json.dumps([d.to_dict() for d in diags.sorted()], indent=2))
+    else:
+        print(diags.format())
+
+    if diags.has_errors or (args.strict and len(diags)):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
