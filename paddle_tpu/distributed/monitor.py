@@ -28,6 +28,21 @@ COMPLETED = "COMPLETED"
 LOST = "LOST"
 
 
+def _atomic_json_dump(path, obj):
+    """tmp + rename JSON write: readers polling the shared workspace
+    never see a torn file, only the previous or the new version."""
+    import json
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = "%s.tmp%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+    return path
+
+
 class HeartBeatMonitor:
     """File-based worker liveness (cf. `heart_beat_monitor.h:54`)."""
 
@@ -172,12 +187,21 @@ class MetricsAggregator:
     `age_s` in the output), it cannot block the fleet view.
     """
 
-    def __init__(self, workspace, worker_id, worker_num, registry=None):
+    def __init__(self, workspace, worker_id, worker_num, registry=None,
+                 straggler_factor=2.0):
         self._dir = os.path.join(workspace, "metrics")
+        self._trace_dir = os.path.join(workspace, "traces")
         os.makedirs(self._dir, exist_ok=True)
         self._id = int(worker_id)
         self._num = int(worker_num)
         self._registry = registry
+        # a rank whose mean step time exceeds straggler_factor x the
+        # fleet median is flagged (ROADMAP item 4: straggler forensics)
+        self._straggler_factor = float(straggler_factor)
+        # straggler windowing state (reader side): last seen histogram
+        # (count, sum) and the last windowed mean, per (series, rank)
+        self._prev_hist = {}
+        self._win_means = {}
 
     def _reg(self):
         if self._registry is not None:
@@ -192,17 +216,12 @@ class MetricsAggregator:
     # -- worker side ----------------------------------------------------
     def publish(self):
         """Write this rank's registry snapshot (atomic)."""
-        import json
-
         payload = {
             "rank": self._id,
             "time": time.time(),
             "metrics": self._reg().snapshot(),
         }
-        tmp = self._path(self._id) + ".tmp%d" % os.getpid()
-        with open(tmp, "w") as f:
-            json.dump(payload, f)
-        os.replace(tmp, self._path(self._id))
+        _atomic_json_dump(self._path(self._id), payload)
         return payload
 
     # -- reader side ----------------------------------------------------
@@ -243,6 +262,10 @@ class MetricsAggregator:
                     })
                     if fam.get("type") == "histogram":
                         ent["values"][rank] = s.get("mean")
+                        ent.setdefault("counts", {})[rank] = \
+                            int(s.get("count") or 0)
+                        ent.setdefault("sums", {})[rank] = \
+                            float(s.get("sum") or 0.0)
                         ent.setdefault("total_count", 0)
                         ent.setdefault("total_sum", 0.0)
                         ent["total_count"] += int(s.get("count") or 0)
@@ -256,7 +279,10 @@ class MetricsAggregator:
                 ent["max"] = max(vals)
                 ent["mean"] = sum(vals) / len(vals)
             ent["values"] = {str(r): v for r, v in ent["values"].items()}
-        return {
+            for k in ("counts", "sums"):
+                if k in ent:
+                    ent[k] = {str(r): v for r, v in ent[k].items()}
+        out = {
             "ranks_reporting": sorted(snaps),
             "expected_ranks": self._num,
             "stale": {
@@ -265,3 +291,158 @@ class MetricsAggregator:
             },
             "series": series,
         }
+        out["stragglers"] = self._detect_stragglers(series)
+        return out
+
+    # -- straggler detection (ROADMAP item 4 slice) ---------------------
+    def _detect_stragglers(self, series):
+        """Flag ranks whose mean train-step time exceeds
+        `straggler_factor` x the median of the OTHER ranks' means, from
+        the per-rank `train_step_ms` histogram series in the fleet view
+        (leave-one-out, so a slow rank cannot drag the baseline it is
+        judged against — on a 2-rank fleet the comparison is simply
+        against the other rank).
+
+        The mean is WINDOWED: each snapshot diffs the histogram's
+        (count, sum) against the previous snapshot, so a rank that
+        degrades after 10k healthy steps is flagged at the next look,
+        not after its lifetime mean finally drifts across the
+        threshold (and a rank slow only during warm-up is cleared as
+        soon as a healthy window lands).  First sight of a series — or
+        a publisher restart (count went backwards / rewrote in place) —
+        falls back to the lifetime mean; a window with no new steps
+        keeps the last windowed estimate, so a rank making NO progress
+        stays visible at its last known pace.
+
+        Publishes the result as a `straggler_ranks{rank=}` gauge (value:
+        ratio of the rank's mean step time to the fleet median; series
+        for ranks that recovered are removed, so the gauge always shows
+        the CURRENT straggler set).  Returns {"ranks": [...],
+        "ratios": {rank: ratio}, "median_step_ms": float}.
+        """
+        per_rank = {}
+        for key, ent in series.items():
+            if ent.get("name") != "train_step_ms":
+                continue
+            counts = ent.get("counts") or {}
+            sums = ent.get("sums") or {}
+            for r, v in ent["values"].items():
+                if v is None:
+                    continue
+                n, s = counts.get(r, 0), sums.get(r, 0.0)
+                prev = self._prev_hist.get((key, r))
+                self._prev_hist[(key, r)] = (n, s)
+                # restart detection: count OR sum went backwards (a
+                # restarted publisher whose new count overtakes the old
+                # within one poll window would otherwise difference the
+                # sums of two different processes into a negative mean)
+                if prev is None or n < prev[0] or s < prev[1] or (
+                        n == prev[0] and s != prev[1]):
+                    m = float(v)                 # fresh / restarted
+                elif n > prev[0]:
+                    m = (s - prev[1]) / (n - prev[0])
+                else:                            # no new steps
+                    m = self._win_means.get((key, r), float(v))
+                self._win_means[(key, r)] = m
+                per_rank.setdefault(int(r), []).append(m)
+        def _median(vals):
+            vals = sorted(vals)
+            n = len(vals)
+            return (vals[n // 2] if n % 2
+                    else 0.5 * (vals[n // 2 - 1] + vals[n // 2]))
+
+        result = {"ranks": [], "ratios": {}, "median_step_ms": None}
+        if len(per_rank) >= 2:
+            means = {r: sum(vs) / len(vs) for r, vs in per_rank.items()}
+            result["median_step_ms"] = _median(means.values())
+            # each rank is compared against the median of the OTHERS:
+            # including the candidate's own mean caps the reachable
+            # ratio at 2 on a 2-rank fleet (2m/(m+fast) < 2 for any
+            # slowdown), making the default factor unreachable there
+            for r, m in sorted(means.items()):
+                baseline = _median([v for q, v in means.items() if q != r])
+                if baseline <= 0:
+                    continue
+                ratio = m / baseline
+                if ratio >= self._straggler_factor:
+                    result["ranks"].append(r)
+                    result["ratios"][str(r)] = round(ratio, 3)
+        try:
+            fam = self._reg().gauge(
+                "straggler_ranks",
+                "Ranks whose windowed mean step time exceeds "
+                "straggler_factor x the median of the other ranks "
+                "(value: that ratio)",
+                labelnames=("rank",))
+            current = set(result["ratios"])
+            for labelvalues, _child in fam._series():
+                if labelvalues and labelvalues[0] not in current:
+                    fam.remove(*labelvalues)
+            for r, ratio in result["ratios"].items():
+                fam.labels(r).set(ratio)
+        except Exception:
+            pass   # detection is telemetry; never sink the reader
+        return result
+
+    # -- fleet timeline (per-rank trace shards -> one Perfetto file) ----
+    def _trace_path(self, rank):
+        return os.path.join(self._trace_dir, "rank_%d.trace.json" % rank)
+
+    def publish_trace(self, tracer=None):
+        """Write this rank's span-tracer ring as a trace shard in the
+        shared workspace (atomic via Tracer.save's tmp+rename); returns
+        the shard path.  Call on a cadence or at loop end — the merge
+        side tolerates ranks that never publish."""
+        from ..observability import trace as _trace
+
+        tr = tracer if tracer is not None else _trace.default_tracer()
+        os.makedirs(self._trace_dir, exist_ok=True)
+        return tr.save(self._trace_path(self._id),
+                       extra_metadata={"rank": self._id})
+
+    def merge_fleet_trace(self, out_path=None, align=True,
+                          fleet_snapshot=None):
+        """Merge every published rank shard into ONE timeline: rank
+        number becomes the Perfetto process id (a track per rank), the
+        wall-clock anchors align the shards' monotonic clocks, and the
+        current straggler set is stamped as global instant events on the
+        offending ranks' tracks.  Returns the chrome-trace dict (and
+        writes it to `out_path` when given).
+
+        `fleet_snapshot`: pass the loop's own fleet_snapshot() result to
+        reuse it — otherwise one is taken here, which re-reads every
+        rank file AND consumes a straggler-detection window (diffing
+        (count, sum) against an interval with almost no new steps)."""
+        from ..observability import trace as _trace
+
+        shards = []
+        for r in range(self._num):
+            p = self._trace_path(r)
+            if not os.path.exists(p):
+                continue
+            try:
+                evs, md = _trace.load_trace(p)
+            except (OSError, ValueError):
+                continue            # replaced mid-read: skip this round
+            shards.append((r, evs, md))
+        merged = _trace.merge_traces(shards, align=align)
+        for r, _evs, _md in shards:
+            merged["traceEvents"].insert(0, {
+                "ph": "M", "name": "process_name", "pid": r,
+                "args": {"name": "rank %d" % r}})
+        if fleet_snapshot is None:
+            fleet_snapshot = self.fleet_snapshot()
+        strag = fleet_snapshot["stragglers"]
+        t_end = max((e["ts"] for e in merged["traceEvents"]
+                     if "ts" in e), default=0)
+        for r in strag["ranks"]:
+            merged["traceEvents"].append({
+                "ph": "i", "name": "straggler", "cat": "fleet",
+                "ts": t_end, "pid": r, "tid": 0, "s": "p",
+                "args": {"ratio_to_median": strag["ratios"][str(r)],
+                         "median_step_ms": strag["median_step_ms"]}})
+        merged["metadata"]["stragglers"] = strag
+        merged["metadata"]["ranks"] = [r for r, _e, _m in shards]
+        if out_path:
+            _atomic_json_dump(out_path, merged)
+        return merged

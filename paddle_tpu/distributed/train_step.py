@@ -339,11 +339,35 @@ class ShardedTrainStep:
         sh = self._batch_sharding(batch)
         return {k: jax.device_put(v, sh[k]) for k, v in batch.items()}
 
+    @staticmethod
+    def _batch_sig(batch):
+        """The executable-cache key for one batch signature — writer
+        (__call__) and reader (cost_analysis) share the one canonical
+        builder in observability.xla_cost."""
+        from ..observability.xla_cost import feed_signature
+
+        return feed_signature(batch)
+
+    def cost_analysis(self, train_state, batch):
+        """XLA `cost_analysis()` of the compiled step executable for this
+        batch signature (flops / bytes_accessed per step as the fused HLO
+        reports them — the measured-MFU numerator).  `lower().compile()`
+        reuses the already-built executable after the first real step and
+        only reads avals, so donated/deleted buffers are fine.  Returns
+        None when nothing was compiled for this signature yet or the
+        backend reports no costs (attribution is telemetry, never an
+        error source)."""
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        step_fn = self._step_fns.get(self._batch_sig(batch))
+        if step_fn is None:
+            return None
+        from ..observability.xla_cost import cost_of_jitted
+
+        return cost_of_jitted(step_fn, train_state, batch)
+
     def __call__(self, train_state, batch):
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
-        sig = tuple(sorted(
-            (k, tuple(v.shape), str(v.dtype)) for k, v in batch.items()
-        ))
+        sig = self._batch_sig(batch)
         step_fn = self._step_fns.get(sig)
         if step_fn is None:
             if self._shardings is None:

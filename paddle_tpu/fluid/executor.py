@@ -366,10 +366,20 @@ class Executor:
                 use_program_cache,
             )
         finally:
-            wall = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            wall = t1 - t0
             dcomp = min(_telemetry.thread_compile_seconds() - comp0, wall)
             _telemetry.record_component("compile", dcomp)
             _telemetry.record_component("compute", max(wall - dcomp, 0.0))
+            from ..observability import trace as _trace
+
+            tracer = _trace.default_tracer()
+            if tracer.enabled:
+                tracer.complete(
+                    "executor.run", t0, t1, cat="executor",
+                    args={"compile_ms": round(dcomp * 1e3, 3),
+                          "compute_ms": round((wall - dcomp) * 1e3, 3),
+                          "fetches": len(fetch_list or [])})
             if self._run_hist is None:
                 self._run_hist = _telemetry.default_registry().histogram(
                     "executor_run_ms",
@@ -476,9 +486,18 @@ class Executor:
                 dp_devices=dp_devices, mesh=self.mesh,
                 feed_shapes={n: a.shape for n, a in feed_vals.items()},
             )
-            lower_secs = _time.perf_counter() - t_lower
+            t_lower1 = _time.perf_counter()
+            lower_secs = t_lower1 - t_lower
             lower_evt = _telemetry.thread_compile_seconds() - c_lower
             _telemetry.add_thread_compile_seconds(lower_secs - lower_evt)
+            from ..observability import trace as _trace
+
+            _tracer = _trace.default_tracer()
+            if _tracer.enabled:
+                _tracer.complete(
+                    "executor.lower", t_lower, t_lower1, cat="executor",
+                    args={"program_version": program._version,
+                          "feeds": sorted(feed_vals)})
             monitor.stat_add("STAT_executor_programs_compiled")
             _telemetry.default_registry().histogram(
                 "executor_lowering_ms",

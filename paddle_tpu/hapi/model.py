@@ -355,6 +355,9 @@ class Model:
                 # pipeline is measured as the step's data_wait component
                 import time as _time
 
+                from ..observability import trace as _trace
+
+                tracer = _trace.default_tracer()
                 it = iter(batches)
                 step = 0
                 while True:
@@ -368,9 +371,12 @@ class Model:
                             if rec is not None:
                                 rec.cancel()
                             break
+                        t_got = _time.perf_counter()
                         if rec is not None:
-                            rec.add("data_wait",
-                                    _time.perf_counter() - t_fetch)
+                            rec.add("data_wait", t_got - t_fetch)
+                        if tracer.enabled:
+                            tracer.complete("data_wait", t_fetch, t_got,
+                                            cat="train")
                         for c in cbs:
                             c.on_train_batch_begin(step)
                         if rec is not None and eager:
@@ -379,11 +385,19 @@ class Model:
                             t_tb = _time.perf_counter()
                             comp0 = _st.thread_compile_seconds()
                             loss, pred = self.train_batch(bx, by)
-                            wall = _time.perf_counter() - t_tb
+                            t_tb1 = _time.perf_counter()
+                            wall = t_tb1 - t_tb
                             dcomp = min(
                                 _st.thread_compile_seconds() - comp0, wall)
                             rec.add("compile", dcomp)
                             rec.add("compute", max(wall - dcomp, 0.0))
+                            if tracer.enabled:
+                                # dygraph has no Executor.run span: the
+                                # eager train_batch is the compute leg
+                                tracer.complete(
+                                    "train_batch", t_tb, t_tb1, cat="train",
+                                    args={"compile_ms":
+                                          round(dcomp * 1e3, 3)})
                         else:
                             loss, pred = self.train_batch(bx, by)
                         losses.append(loss)

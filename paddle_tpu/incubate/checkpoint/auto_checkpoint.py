@@ -224,6 +224,9 @@ class TrainEpochRange:
         g_epoch = reg.gauge(
             "train_epoch", "Current epoch of the acp training loop",
             labelnames=("loop",)).labels("acp")
+        from ...observability import trace as _trace
+
+        tracer = _trace.default_tracer()
         global _g_train_epoch_range
         _g_train_epoch_range = self
         try:
@@ -231,11 +234,19 @@ class TrainEpochRange:
                 g_epoch.set(epoch)
                 t0 = time.perf_counter()
                 yield epoch
-                h_epoch.observe((time.perf_counter() - t0) * 1e3)
+                t1 = time.perf_counter()
+                h_epoch.observe((t1 - t0) * 1e3)
+                if tracer.enabled:
+                    tracer.complete("epoch", t0, t1, cat="train",
+                                    args={"loop": "acp", "epoch": epoch})
                 if self._saver is not None and (
                         epoch % self._inter == self._inter - 1
                         or epoch == self._max_epoch_num - 1):
                     self.save_checkpoint(epoch)
+                    if tracer.enabled:
+                        tracer.instant(
+                            "checkpoint_saved", cat="checkpoint",
+                            args={"epoch": epoch, "loop": "acp"})
         finally:
             _g_train_epoch_range = None
             # drain the in-flight save on EVERY exit (normal end, break,

@@ -154,6 +154,7 @@ class Predictor:
 
         self._jitted = jax.jit(run_pure)
         self._signatures = set()
+        self._costs = {}     # feed signature -> cost_analysis dict
 
     # -- reference-style API -------------------------------------------
     def get_input_names(self):
@@ -214,6 +215,25 @@ class Predictor:
         feed_vals = self._prepare_feed(inputs)
         self._note_signature(feed_vals)
         return self._jitted(self._weights, feed_vals)
+
+    def cost_analysis(self, inputs):
+        """XLA `cost_analysis()` for the executable serving this feed
+        signature (flops / bytes_accessed per execution, as the compiled
+        HLO reports them — after fusion, after int8 rewrite).  Cached
+        per signature; `lower().compile()` reuses the already-built
+        executable after warmup.  Returns None when the backend reports
+        nothing (attribution is telemetry, never an error source)."""
+        feed_vals = self._prepare_feed(inputs)
+        from ..observability.xla_cost import cost_of_jitted, feed_signature
+
+        sig = feed_signature(feed_vals)
+        if sig in self._costs:
+            return self._costs[sig]
+
+        cost = cost_of_jitted(self._jitted, self._weights, feed_vals)
+        if cost is not None:      # don't let one transient failure
+            self._costs[sig] = cost   # disable attribution forever
+        return cost
 
     def warmup(self, bucket_specs):
         """AOT-compile the executables for a set of feed signatures before

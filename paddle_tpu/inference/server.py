@@ -48,12 +48,14 @@ from collections import OrderedDict, deque
 
 import numpy as np
 
+from ..observability import trace as _trace
 from ..observability.metrics import default_registry, unique_instance_label
 
 
 class _Request:
     __slots__ = ("inputs", "event", "outputs", "error", "error_type",
-                 "seq", "t_enq", "abandoned")
+                 "seq", "t_enq", "abandoned", "trace_id",
+                 "t_enq_pc", "t_taken", "t_disp", "t_mat", "t_done")
 
     def __init__(self, inputs, seq):
         self.inputs = inputs
@@ -64,6 +66,17 @@ class _Request:
         self.seq = seq
         self.t_enq = time.monotonic()
         self.abandoned = False   # waiter timed out; don't serve/measure
+        # per-request trace: the id is always allocated (returned in the
+        # HTTP response so a slow request can be found later); the phase
+        # stamps are perf_counter seconds on the tracer's clock, filled
+        # in as the request crosses the dispatch/completion threads and
+        # emitted as one nested async timeline at completion
+        self.trace_id = _trace.new_trace_id("req")
+        self.t_enq_pc = time.perf_counter()
+        self.t_taken = None
+        self.t_disp = None
+        self.t_mat = None
+        self.t_done = None
 
     @property
     def rows(self):
@@ -148,6 +161,10 @@ class InferenceServer:
         self._q: queue.Queue = queue.Queue()
         self._done_q: queue.Queue = queue.Queue(
             maxsize=max(int(pipeline_depth), 1))
+        # completed-request ring for /stats: a slow p99 request's trace
+        # id is findable after the fact (open it in Perfetto via /trace)
+        self._recent = deque(maxlen=64)
+        self._sig_costs = {}     # feed signature -> cost_analysis dict
         self._pending = OrderedDict()    # signature -> deque[_Request]
         self._plock = threading.Lock()   # dispatcher mutates, stats read
         self._seq = itertools.count()
@@ -246,6 +263,12 @@ class InferenceServer:
             fam = self.metrics_registry.get(fam_name)
             if fam is not None:
                 fam.remove(*self._mlabel)
+        for fam_name in ("xla_executable_flops",
+                         "xla_executable_bytes_accessed", "mfu"):
+            fam = self.metrics_registry.get(fam_name)
+            if fam is not None:
+                for sig in self._sig_costs:
+                    fam.remove("%s:%s" % (self.name, self._sig_label(sig)))
         release_instance_label(self._mlabel[0])
 
     def warmup(self, example_inputs):
@@ -279,15 +302,60 @@ class InferenceServer:
                         feed, rows_valid=b)
                 specs.append(feed)
         if hasattr(self._pred, "warmup"):
-            return self._pred.warmup(specs)
+            out = self._pred.warmup(specs)
+        else:
+            for feed in specs:
+                self._pred.run(feed)
+            out = getattr(self._pred, "compile_count", None)
+        self._sample_costs(specs)
+        return out
+
+    # -- XLA cost attribution -------------------------------------------
+    @staticmethod
+    def _feed_sig(feed):
+        from ..observability.xla_cost import feed_signature
+
+        return feed_signature(feed)
+
+    @staticmethod
+    def _sig_label(sig):
+        return ";".join("%s[%s]" % (k, "x".join(map(str, shp)))
+                        for k, shp, _dt in sig)
+
+    def _sample_costs(self, specs):
+        """Sample `cost_analysis()` for every warmed executable into
+        gauges + the per-signature table the dispatch spans and /stats
+        read.  Attribution is telemetry: any failure is swallowed."""
+        if not hasattr(self._pred, "cost_analysis"):
+            return
+        from ..observability.xla_cost import record_executable_cost
+
         for feed in specs:
-            self._pred.run(feed)
-        return getattr(self._pred, "compile_count", None)
+            try:
+                cost = self._pred.cost_analysis(feed)
+                if cost:
+                    sig = self._feed_sig(feed)
+                    self._sig_costs[sig] = cost
+                    record_executable_cost(
+                        "%s:%s" % (self.name, self._sig_label(sig)),
+                        cost, registry=self.metrics_registry)
+            except Exception:
+                continue   # e.g. a registry name collision must not
+                           # turn warmup into a crash
 
     # -- client API ------------------------------------------------------
     def infer(self, inputs, timeout=30.0):
         """Blocking single request; inputs {name: array} with a leading
         batch dim.  Thread-safe; requests coalesce into device batches."""
+        outs, _trace_id = self.infer_with_trace(inputs, timeout=timeout)
+        return outs
+
+    def infer_with_trace(self, inputs, timeout=30.0):
+        """Like `infer` but returns (outputs, trace_id).  The trace id
+        names this request's timeline in the span tracer (enable with
+        `observability.enable_tracing()`; export via GET /trace or
+        `default_tracer().save(path)`) — it is allocated even with
+        tracing disabled so responses are always correlatable."""
         if self._dispatcher is None:
             raise RuntimeError("call start() first")
         arrs = {k: np.asarray(v) for k, v in inputs.items()}
@@ -323,7 +391,7 @@ class InferenceServer:
                         if req.error_type in (ValueError, TypeError)
                         else RuntimeError)
             raise exc_type("inference failed: %s" % req.error)
-        return req.outputs
+        return req.outputs, req.trace_id
 
     # -- observability ---------------------------------------------------
     def summary(self):
@@ -346,6 +414,16 @@ class InferenceServer:
             "batch_buckets": list(self._batch_buckets),
             "ragged_dims": {k: {str(ax): list(b) for ax, b in v.items()}
                             for k, v in self._ragged.items()},
+            "tracing_enabled": _trace.default_tracer().enabled,
+            # the forensics handles: recent completions (trace_id +
+            # latency) and the worst of them — open via GET /trace
+            "recent_requests": list(self._recent)[-8:],
+            "slowest_recent": sorted(
+                self._recent, key=lambda r: -r["latency_ms"])[:5],
+            "executable_costs": {
+                self._sig_label(sig): cost
+                for sig, cost in self._sig_costs.items()
+            },
         }
 
     def stats(self):
@@ -403,6 +481,7 @@ class InferenceServer:
                 r = dq.popleft()
                 if r.abandoned:      # waiter already timed out: drop it
                     continue         # instead of burning device work
+                r.t_taken = time.perf_counter()
                 group.append(r)
                 total += r.rows
             if not dq:
@@ -466,6 +545,8 @@ class InferenceServer:
         return mask
 
     def _dispatch_group(self, group):
+        tracer = _trace.default_tracer()
+        t_pad0 = time.perf_counter()
         try:
             total = sum(r.rows for r in group)
             padded_rows = self._bucket(total, self._batch_buckets) \
@@ -507,40 +588,122 @@ class InferenceServer:
             self._h_queue_depth.observe(self._q.qsize() + backlog)
             if padded_elems:
                 self._h_pad_waste.observe(1.0 - real_elems / padded_elems)
-            if hasattr(self._pred, "run_async"):
+            t_disp0 = time.perf_counter()
+            is_async = hasattr(self._pred, "run_async")
+            if is_async:
                 outs = self._pred.run_async(feed)
             else:
                 outs = self._pred.run(feed)
+            t_disp1 = time.perf_counter()
+            # the signature tuple is only consumed by cost attribution
+            # and span args — don't build it per batch on an untraced,
+            # never-warmed hot path
+            sig = (self._feed_sig(feed)
+                   if (self._sig_costs or tracer.enabled) else None)
+            cost = self._sig_costs.get(sig) if sig is not None else None
+            # where compute is billed from: an async dispatch returns
+            # immediately (compute runs until materialize), a sync run()
+            # does the compute INSIDE the call — starting the compute
+            # clock at t_disp1 there would credit ~0 device time and
+            # inflate the measured MFU by orders of magnitude
+            t_compute0 = t_disp1 if is_async else t_disp0
+            for r in group:
+                r.t_disp = t_compute0
+            if tracer.enabled:
+                if sig is None:     # tracing flipped on mid-dispatch
+                    sig = self._feed_sig(feed)
+                args = {"rows": total, "padded_rows": padded_rows,
+                        "signature": self._sig_label(sig),
+                        "trace_ids": [r.trace_id for r in group]}
+                if cost and "flops" in cost:
+                    args["flops"] = cost["flops"]
+                tracer.complete("batch.pad", t_pad0, t_disp0,
+                                cat="serving", args=args)
+                tracer.complete("batch.dispatch", t_disp0, t_disp1,
+                                cat="serving", args=args)
         except Exception as e:  # pad/validate/dispatch failed: fail group
             self._fail_group(group, e)
             return
         # blocks when pipeline_depth batches are unmaterialized: natural
         # backpressure so the host cannot run unboundedly ahead
-        self._done_q.put((group, outs))
+        self._done_q.put((group, outs, sig, cost))
 
     # -- stage 2: completion (materialize -> slice -> signal waiters) ----
     def _completion_loop(self):
+        tracer = _trace.default_tracer()
         while True:
             item = self._done_q.get()
             if item is None:
                 return
-            group, outs = item
+            group, outs, sig, cost = item
             try:
                 # np.asarray blocks until the device values are ready;
                 # async-dispatch device errors also surface here
+                t_mat0 = time.perf_counter()
                 host = [np.asarray(o) for o in outs]
+                t_mat1 = time.perf_counter()
                 off = 0
                 for r in group:
                     r.outputs = [o[off:off + r.rows] for o in host]
                     off += r.rows
                 now = time.monotonic()
+                t_done = time.perf_counter()
                 for r in group:
+                    r.t_mat, r.t_done = t_mat1, t_done
                     if not r.abandoned:   # dead waiters don't skew p99
-                        self._h_latency_ms.observe((now - r.t_enq) * 1e3)
+                        lat_ms = (now - r.t_enq) * 1e3
+                        self._h_latency_ms.observe(lat_ms)
+                        self._recent.append(
+                            {"trace_id": r.trace_id,
+                             "latency_ms": round(lat_ms, 3),
+                             "rows": r.rows})
+                self._record_batch_cost(sig, cost, group,
+                                        t_mat1 - group[0].t_disp)
+                if tracer.enabled:
+                    tracer.complete(
+                        "batch.materialize", t_mat0, t_mat1, cat="serving",
+                        args={"trace_ids": [r.trace_id for r in group]})
+                    tracer.complete("batch.slice", t_mat1, t_done,
+                                    cat="serving")
+                    for r in group:
+                        self._emit_request_trace(tracer, r)
                 for r in group:
                     r.event.set()
             except Exception as e:
                 self._fail_group(group, e)
+
+    def _record_batch_cost(self, sig, cost, group, device_seconds):
+        """Measured serving MFU per executable: cost_analysis flops over
+        the dispatch->materialized wall (an upper bound on device time,
+        honest under async dispatch).  No-op when cost/peak unknown."""
+        if not cost or "flops" not in cost or device_seconds <= 0:
+            return
+        try:
+            from ..observability.xla_cost import record_mfu
+
+            record_mfu("%s:%s" % (self.name, self._sig_label(sig)),
+                       cost["flops"], device_seconds,
+                       registry=self.metrics_registry)
+        except Exception:
+            pass
+
+    def _emit_request_trace(self, tracer, r):
+        """One request's nested async timeline (id = trace_id): phase
+        begin/ends with the explicit stamps recorded as the request
+        crossed the client/dispatcher/completer threads."""
+        tid = r.trace_id
+        args = {"rows": r.rows}
+        tracer.async_begin("request", tid, cat="serving",
+                           args=args, ts=r.t_enq_pc)
+        phases = (("queue", r.t_enq_pc, r.t_taken),
+                  ("pad+dispatch", r.t_taken, r.t_disp),
+                  ("xla_compute", r.t_disp, r.t_mat),
+                  ("slice", r.t_mat, r.t_done))
+        for name, a, b in phases:
+            if a is not None and b is not None:
+                tracer.async_begin(name, tid, cat="serving", ts=a)
+                tracer.async_end(name, tid, cat="serving", ts=b)
+        tracer.async_end("request", tid, cat="serving", ts=r.t_done)
 
     def _fail_group(self, group, exc):
         self._n_errors.inc(len(group))
@@ -553,12 +716,17 @@ class InferenceServer:
     def serve_http(self, host="127.0.0.1", port=8080, block=True):
         """JSON protocol (cross-language surface): POST /predict with
         {"inputs": {name: nested-list}, "dtypes": {name: "float32"}} ->
-        {"outputs": [nested-list, ...]}.  GET /health -> {"status":"ok"};
-        GET /stats -> summary() JSON; GET /metrics -> Prometheus text
+        {"outputs": [nested-list, ...], "trace_id": "req-..."} — the
+        trace id names the request's span timeline (GET /trace, open in
+        Perfetto) when tracing is enabled.  GET /health ->
+        {"status":"ok"}; GET /stats -> summary() JSON (incl.
+        recent/slowest trace ids); GET /metrics -> Prometheus text
         exposition of the server's metrics registry (every subsystem
-        reporting there, not just this server).  Malformed requests get
-        400; internal inference failures get 500.  Returns the
-        HTTPServer (daemon-threaded when block=False)."""
+        reporting there, not just this server); GET /trace -> the
+        tracer ring as a loadable chrome trace (409 while tracing is
+        disabled).  Malformed requests get 400; internal inference
+        failures get 500.  Returns the HTTPServer (daemon-threaded when
+        block=False)."""
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         server_self = self
@@ -595,6 +763,18 @@ class InferenceServer:
                         200,
                         prometheus_text(server_self.metrics_registry),
                         "text/plain; version=0.0.4; charset=utf-8")
+                elif self.path == "/trace":
+                    # the tracer ring as a loadable chrome trace: save
+                    # the body to a file and open it in Perfetto to see
+                    # the request timelines named by response trace_ids
+                    tracer = _trace.default_tracer()
+                    if not tracer.enabled:
+                        self._send(409, {
+                            "error": "tracing disabled; call "
+                                     "observability.enable_tracing() or "
+                                     "set PADDLE_TPU_TRACE=1"})
+                    else:
+                        self._send(200, tracer.chrome_trace())
                 else:
                     self._send(404, {"error": "unknown path"})
 
@@ -617,7 +797,7 @@ class InferenceServer:
                                      % (type(e).__name__, e)})
                     return
                 try:
-                    outs = server_self.infer(feed)
+                    outs, trace_id = server_self.infer_with_trace(feed)
                 except (ValueError, TypeError) as e:
                     # infer() rejected the request itself (feed names /
                     # batch dims): still the client's fault
@@ -627,7 +807,8 @@ class InferenceServer:
                     self._send(500, {"error": "%s: %s"
                                      % (type(e).__name__, e)})
                 else:
-                    self._send(200, {"outputs": [o.tolist() for o in outs]})
+                    self._send(200, {"outputs": [o.tolist() for o in outs],
+                                     "trace_id": trace_id})
 
         httpd = ThreadingHTTPServer((host, port), Handler)
         if block:

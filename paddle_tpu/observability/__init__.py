@@ -51,6 +51,45 @@ from .step_timer import (  # noqa: F401
 )
 from .system import SystemMetricsSampler  # noqa: F401
 
+# trace / flight_recorder / xla_cost are PEP 562 lazy (like
+# paddle_tpu.analysis): importing paddle_tpu.observability alone (the
+# metrics/StepTimer surface every worker pays for) never loads them.
+# The instrumented hot paths load the (stdlib-only) modules once at
+# first use — first timed step / Executor.run / served request — not
+# at package import.
+_LAZY_MODULES = ("trace", "flight_recorder", "xla_cost")
+_LAZY_NAMES = {
+    # name -> submodule it lives in
+    "Tracer": "trace",
+    "default_tracer": "trace",
+    "enable_tracing": "trace",
+    "disable_tracing": "trace",
+    "tracing_enabled": "trace",
+    "trace_span": "trace",
+    "merge_traces": "trace",
+    "load_trace": "trace",
+    "FlightRecorder": "flight_recorder",
+    "install_flight_recorder": "flight_recorder",
+    "cost_of_jitted": "xla_cost",
+    "record_executable_cost": "xla_cost",
+    "record_mfu": "xla_cost",
+    "peak_flops": "xla_cost",
+}
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _LAZY_MODULES:
+        return importlib.import_module("." + name, __name__)
+    sub = _LAZY_NAMES.get(name)
+    if sub is not None:
+        mod = importlib.import_module("." + sub, __name__)
+        # trace_span is the module-level `span` under a collision-free name
+        return getattr(mod, "span" if name == "trace_span" else name)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
+
+
 __all__ = [
     "Counter",
     "Gauge",
@@ -67,4 +106,20 @@ __all__ = [
     "record_component",
     "record_compile",
     "SystemMetricsSampler",
+    # lazy (PEP 562): the tracing / crash-forensics / cost-attribution
+    # surface — see trace.py, flight_recorder.py, xla_cost.py
+    "Tracer",
+    "default_tracer",
+    "enable_tracing",
+    "disable_tracing",
+    "tracing_enabled",
+    "trace_span",
+    "merge_traces",
+    "load_trace",
+    "FlightRecorder",
+    "install_flight_recorder",
+    "cost_of_jitted",
+    "record_executable_cost",
+    "record_mfu",
+    "peak_flops",
 ]

@@ -1,0 +1,250 @@
+"""Flight recorder: always-on bounded trace ring + crash-time dump.
+
+Post-mortem debugging on a preemptible TPU fleet cannot be "re-run with
+profiling": the interesting step is the one that just died.  The flight
+recorder keeps a bounded ring of recent spans (the `trace.Tracer` ring
+IS the flight ring — `install()` arms span recording with a modest
+capacity if the user hasn't enabled tracing themselves) plus a bounded
+ring of recent per-step scalar breakdowns (fed by `StepTimer` finish
+hooks), and dumps ONE loadable chrome-trace file when the process dies:
+
+* **SIGTERM / SIGINT** — the preemption path.  The previous handler is
+  chained afterwards (default disposition re-raised), so the recorder
+  never changes exit semantics, it only leaves a dump behind;
+* **unhandled exception** — `sys.excepthook` chain;
+* **first failed step** — a `StepTimer.step()` region exiting with an
+  exception (the NaN guard, an XLA error, a data-pipeline crash)
+  triggers a dump immediately, while the spans leading up to it are
+  still in the ring.
+
+The three triggers share ONE guard: the first to fire dumps, the rest
+are suppressed (a dying run can fail every step, a Ctrl-C unwinds
+through signal handler, failed step AND excepthook — one dump is the
+signal, three copies are noise).  `dump()` called explicitly is never
+guarded.
+
+The dump contains the span ring, the scalar ring re-emitted as chrome
+counter events (`step_time`/`data_wait`/... per step — a visible
+timeline of the run's last N steps even when no spans were recorded),
+a registry snapshot, and the dump reason.  Load it in Perfetto or feed
+it to `tools/trace_summary.py`.
+
+Dump location: `dump_dir` argument, else `$PADDLE_TPU_FLIGHT_DIR`,
+else `./flight_recorder/`.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+
+from . import step_timer as _step_timer
+from . import trace as _trace
+
+__all__ = ["FlightRecorder", "install_flight_recorder"]
+
+DUMP_DIR_ENV = "PADDLE_TPU_FLIGHT_DIR"
+
+_install_lock = threading.Lock()
+_installed = None  # the process-wide recorder, if armed
+
+
+class FlightRecorder:
+    """Bounded black box; `install()` arms the crash hooks.
+
+    Parameters
+    ----------
+    tracer: the span source (default: the process tracer).  If tracing
+        is off at install time it is enabled — resized to
+        `span_capacity` only when the ring is empty (the "always-on
+        bounded ring" contract).  A tracer the user already enabled —
+        or froze with recorded events — keeps its capacity and ring.
+    scalar_capacity: recent per-step breakdowns kept (per loop name).
+    dump_dir: where dumps land (see module docstring for the default).
+    """
+
+    def __init__(self, tracer=None, span_capacity=4096,
+                 scalar_capacity=512, dump_dir=None, registry=None):
+        self._tracer_arg = tracer
+        self._span_capacity = int(span_capacity)
+        self._scalars = deque(maxlen=max(int(scalar_capacity), 1))
+        self._dump_dir = dump_dir
+        self._registry = registry
+        # RLock: a signal arriving MID-DUMP on the main thread re-enters
+        # dump() from the handler; a plain Lock would deadlock the
+        # handler against the interrupted frame and the process would
+        # ignore its own SIGTERM
+        self._lock = threading.RLock()
+        self._dumped_reasons = []
+        self._auto_dumped = False
+        self._prev_handlers = {}
+        self._prev_excepthook = None
+        self._installed = False
+
+    # -- wiring ----------------------------------------------------------
+    @property
+    def tracer(self):
+        return self._tracer_arg or _trace.default_tracer()
+
+    def install(self, signals=(signal.SIGTERM, signal.SIGINT),
+                catch_unhandled=True, on_failed_step=True):
+        """Arm the ring + hooks; idempotent.  Returns self."""
+        if self._installed:
+            return self
+        tr = self.tracer
+        if not tr.enabled:
+            if self._tracer_arg is None and len(tr) == 0:
+                # virgin default tracer: arm it at the flight capacity.
+                # A ring that already holds events (enabled earlier,
+                # then frozen with disable_tracing()) is re-enabled
+                # as-is — resizing would wipe the user's capture
+                _trace.enable_tracing(capacity=self._span_capacity)
+            else:
+                tr.enable()
+        _step_timer.add_step_finish_hook(self._on_step_finish)
+        if on_failed_step:
+            _step_timer.add_step_failure_hook(self._on_step_failure)
+        for sig in signals:
+            try:
+                self._prev_handlers[sig] = signal.signal(
+                    sig, self._on_signal)
+            except (ValueError, OSError):
+                pass  # non-main thread / unsupported signal: skip it
+        if catch_unhandled:
+            self._prev_excepthook = sys.excepthook
+            sys.excepthook = self._on_unhandled
+        self._installed = True
+        global _installed
+        _installed = self
+        return self
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        _step_timer.remove_step_finish_hook(self._on_step_finish)
+        _step_timer.remove_step_failure_hook(self._on_step_failure)
+        for sig, prev in self._prev_handlers.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev_handlers.clear()
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+            self._prev_excepthook = None
+        self._installed = False
+        global _installed
+        if _installed is self:
+            _installed = None
+
+    # -- feeds -----------------------------------------------------------
+    def _on_step_finish(self, loop_name, breakdown):
+        # breakdown: the StepTimer ms dict (data_wait/compile/compute/
+        # host_overhead/step_time/compiles + the step index)
+        self._scalars.append(
+            (time.time(), _trace._now(), loop_name, breakdown))
+
+    def _on_step_failure(self, loop_name, step, exc_type):
+        self._auto_dump("failed step %s (loop=%s, %s)"
+                        % (step, loop_name, exc_type.__name__))
+
+    def _auto_dump(self, reason):
+        """The crash-trigger path: first trigger wins, the rest are
+        suppressed (one process death must leave ONE dump, not one per
+        hook the unwind passes through).  Guard check/set runs under
+        the (reentrant) dump lock so two concurrent triggers — e.g. a
+        signal on the main thread while a training thread is dumping a
+        failed step — can't both pass it."""
+        with self._lock:
+            if self._auto_dumped:
+                return None
+            path = self.dump(reason=reason)
+            if path is not None:    # a FAILED dump (unwritable dir)
+                self._auto_dumped = True   # must not consume the slot:
+            return path                    # the next trigger retries
+
+    # -- crash hooks -----------------------------------------------------
+    def _on_signal(self, signum, frame):
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        self._auto_dump("signal %s" % name)
+        prev = self._prev_handlers.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_IGN:
+            pass
+        else:
+            # default disposition: restore it and re-raise so the exit
+            # status stays "killed by signal", not a clean return
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+    def _on_unhandled(self, exc_type, exc, tb):
+        self._auto_dump("unhandled %s: %s" % (exc_type.__name__,
+                                              str(exc)[:200]))
+        hook = self._prev_excepthook or sys.__excepthook__
+        hook(exc_type, exc, tb)
+
+    # -- the dump --------------------------------------------------------
+    def dump_path(self, reason="manual"):
+        d = self._dump_dir or os.getenv(DUMP_DIR_ENV) or "flight_recorder"
+        slug = "".join(c if c.isalnum() else "_" for c in reason)[:40]
+        return os.path.join(
+            d, "flight_%d_%s.trace.json" % (os.getpid(), slug))
+
+    def dump(self, path=None, reason="manual"):
+        """Write the black box as ONE loadable chrome trace; returns the
+        path (None if the dump itself failed — a recorder must never
+        turn a dying process's exit into a different crash)."""
+        try:
+            with self._lock:
+                return self._dump_locked(path, reason)
+        except Exception:
+            return None
+
+    def _dump_locked(self, path, reason):
+        tr = self.tracer
+        path = path or self.dump_path(reason)
+        extra = {"flight_recorder": True, "reason": reason,
+                 "unix_time": time.time()}
+        extra_events = [{
+            "ph": "i", "name": "flight_recorder.dump",
+            "cat": "flight", "ts": int(_trace._now() * 1e6),
+            "pid": tr._pid, "tid": threading.get_ident(), "s": "g",
+            "args": {"reason": reason},
+        }]
+        # scalar ring -> counter events: the last N steps' budget as a
+        # timeline even if nothing else was traced
+        for _wall, mono, loop, bd in list(self._scalars):
+            extra_events.append({
+                "ph": "C", "name": "step_budget_ms[%s]" % loop,
+                "cat": "flight", "ts": int(mono * 1e6), "pid": tr._pid,
+                "tid": 0,
+                "args": {k: float(v) for k, v in bd.items()
+                         if k != "step"},
+            })
+        try:
+            from .metrics import default_registry
+
+            reg = self._registry or default_registry()
+            extra["metrics_snapshot"] = reg.snapshot()
+        except Exception:
+            pass
+        tr.save(path, extra_metadata=extra, extra_events=extra_events)
+        self._dumped_reasons.append(reason)
+        return path
+
+
+def install_flight_recorder(**kw):
+    """Arm the process-wide flight recorder (idempotent); returns it."""
+    with _install_lock:
+        global _installed
+        if _installed is not None:
+            return _installed
+        return FlightRecorder(**kw).install()

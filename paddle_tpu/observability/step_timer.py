@@ -40,9 +40,42 @@ from .metrics import default_registry
 __all__ = ["StepTimer", "StepRecord", "ScalarWriter",
            "install_jax_compile_hooks", "record_component",
            "record_compile", "thread_compile_seconds",
-           "add_thread_compile_seconds"]
+           "add_thread_compile_seconds", "add_step_finish_hook",
+           "remove_step_finish_hook", "add_step_failure_hook",
+           "remove_step_failure_hook"]
 
 _tls = threading.local()
+
+# -- step lifecycle hooks ----------------------------------------------------
+#
+# The flight recorder (and anything else that wants the per-step budget
+# stream without subclassing StepTimer) registers here.  Empty-list
+# checks keep the step path at one `if` when nothing is listening.
+
+_finish_hooks = []    # fn(loop_name, breakdown_ms_dict)
+_failure_hooks = []   # fn(loop_name, step, exc_type)
+
+
+def add_step_finish_hook(fn):
+    if fn not in _finish_hooks:
+        _finish_hooks.append(fn)
+    return fn
+
+
+def remove_step_finish_hook(fn):
+    if fn in _finish_hooks:
+        _finish_hooks.remove(fn)
+
+
+def add_step_failure_hook(fn):
+    if fn not in _failure_hooks:
+        _failure_hooks.append(fn)
+    return fn
+
+
+def remove_step_failure_hook(fn):
+    if fn in _failure_hooks:
+        _failure_hooks.remove(fn)
 
 # -- jax compile detection ---------------------------------------------------
 #
@@ -248,7 +281,14 @@ class StepTimer:
             self._h_comp[c].observe(rec.components[c] * 1e3)
         self._c_steps.inc()
         bd = rec.breakdown_ms()
+        bd["step"] = rec.step
         self.history.append(bd)
+        if _finish_hooks:
+            for h in list(_finish_hooks):
+                try:
+                    h(self.name, bd)
+                except Exception:
+                    pass  # a consumer bug must not sink the train loop
         if self.scalar_writer is not None:
             items = [("%s/%s_ms" % (self.name, c), bd[c], rec.step)
                      for c in self.COMPONENTS + ("step_time",)]
@@ -266,12 +306,25 @@ class _StepCtx:
     def __init__(self, timer, step):
         self.timer = timer
         self.rec = StepRecord(step)
+        self._span = None
 
     def __enter__(self):
         stack = getattr(_tls, "records", None)
         if stack is None:
             stack = _tls.records = []
         stack.append(self.rec)
+        from . import trace as _trace  # deferred: importing
+        # observability alone never pulls the tracer; the (stdlib-only)
+        # module loads once at the first timed step
+
+        tracer = _trace.default_tracer()
+        if tracer.enabled:
+            # the per-step timeline span; Executor.run / data_wait spans
+            # nest inside it by time containment on the same thread
+            self._span = tracer.span(
+                "step", cat="train",
+                args={"loop": self.timer.name, "step": self.rec.step})
+            self._span.__enter__()
         return self.rec
 
     def __exit__(self, exc_type, exc, tb):
@@ -280,6 +333,32 @@ class _StepCtx:
             stack.pop()
         if exc_type is None:
             self.timer._finish(self.rec)
+            if self._span is not None:
+                if self.rec.cancelled:
+                    self._span.abandon()   # no event for a cancelled step
+                else:
+                    if self.rec.step_time is not None:
+                        self._span.add_args(**self.rec.breakdown_ms())
+                    self._span.__exit__(None, None, None)
+        else:
+            # close the span BEFORE the failure hooks: the flight
+            # recorder dumps inside them, and the dump that exists to
+            # explain this crash must contain the crashing step's own
+            # span (error-annotated), not just its lead-up
+            if self._span is not None:
+                if self.rec.cancelled:
+                    self._span.abandon()
+                else:
+                    self._span.__exit__(exc_type, exc, tb)
+            if not self.rec.cancelled and _failure_hooks:
+                # the step DIED (XLA error, NaN guard, loader crash):
+                # tell the flight recorder while the ring holds the
+                # lead-up AND the failed step
+                for h in list(_failure_hooks):
+                    try:
+                        h(self.timer.name, self.rec.step, exc_type)
+                    except Exception:
+                        pass
         return False
 
 

@@ -1,0 +1,152 @@
+"""XLA cost attribution: measured FLOPs/bytes per executable -> MFU.
+
+Every perf number in this repo so far derived FLOPs by hand (bench.py's
+matmul-parameter model).  XLA already knows: a compiled executable's
+`cost_analysis()` reports the flops and bytes the HLO actually contains
+— after fusion, after the AMP casts, after whatever a pass pipeline did
+to the program.  This module samples that into the shared registry and
+into span metadata, so bench.py and the serving tier report MEASURED
+utilization per executable:
+
+* `cost_of_jitted(fn, *args)` — lower+compile a jitted callable for one
+  argument signature (hits jax's compilation caches when the signature
+  was already built, e.g. after warmup) and normalize `cost_analysis()`
+  across jax versions (dict vs [dict]);
+* `record_executable_cost(name, cost)` — gauges
+  `xla_executable_flops{executable=}` /
+  `xla_executable_bytes_accessed{executable=}`;
+* `record_mfu(name, flops, seconds)` — the headline `mfu{executable=}`
+  gauge: flops / seconds / peak.  Peak FLOP/s comes from
+  `$PADDLE_TPU_PEAK_FLOPS`, an explicit argument, or the built-in
+  per-platform table (one v5e chip: 197 bf16 TFLOP/s — the same
+  constant bench.py always used).
+
+Sampling is warmup/once-per-signature work — nothing here runs on the
+step path.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .metrics import default_registry
+
+__all__ = [
+    "cost_analysis_of",
+    "cost_of_jitted",
+    "feed_signature",
+    "record_executable_cost",
+    "record_mfu",
+    "peak_flops",
+]
+
+
+def feed_signature(feed):
+    """Canonical (name, shape, dtype) cache key for one feed/batch
+    dict.  The executable-cache writer and the cost-attribution reader
+    must agree on this key byte-for-byte or attribution silently
+    returns None — so every site (Predictor, InferenceServer,
+    ShardedTrainStep) shares this one builder."""
+    return tuple(sorted(
+        (k, tuple(v.shape), str(v.dtype)) for k, v in feed.items()))
+
+PEAK_FLOPS_ENV = "PADDLE_TPU_PEAK_FLOPS"
+
+# bf16 peak per chip for platforms we know; MFU needs a denominator and
+# an unknown platform yields None (callers then skip the gauge)
+_PLATFORM_PEAK = {
+    "tpu": 197e12,   # v5e public spec (bench.py's constant of record)
+}
+
+
+def peak_flops(explicit=None, platform=None):
+    """Resolve the MFU denominator: explicit arg > env > platform table
+    (platform defaults to the live jax backend).  None when unknown."""
+    if explicit:
+        return float(explicit)
+    env = os.getenv(PEAK_FLOPS_ENV)
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    if platform is None:
+        try:
+            import jax
+
+            platform = jax.default_backend()
+        except Exception:
+            return None
+    return _PLATFORM_PEAK.get(platform)
+
+
+def cost_analysis_of(compiled):
+    """Normalize `Compiled.cost_analysis()` -> {"flops": float,
+    "bytes_accessed": float, ...} (keys snake_cased); None when the
+    backend reports nothing."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):      # older jax: one dict per device
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict) or not ca:
+        return None
+    out = {}
+    for k, v in ca.items():
+        k = str(k)
+        # skip per-operand detail rows ("bytes accessed0{}", ...): the
+        # headline numbers are what gauges/spans/stats want
+        if "{" in k or not isinstance(v, (int, float)):
+            continue
+        out[k.replace(" ", "_")] = float(v)
+    return out or None
+
+
+def cost_of_jitted(fn, *args, **kwargs):
+    """Cost analysis of the executable a jitted callable would run for
+    these arguments.  `fn.lower(...)` only traces (nothing executes, no
+    buffer is donated); `.compile()` reuses jax's executable caches when
+    this signature was already built.  Returns None instead of raising —
+    attribution is telemetry, never a failure source."""
+    try:
+        return cost_analysis_of(fn.lower(*args, **kwargs).compile())
+    except Exception:
+        return None
+
+
+def record_executable_cost(name, cost, registry=None):
+    """Publish one executable's cost into the registry; returns `cost`
+    for chaining into span args."""
+    if not cost:
+        return cost
+    reg = registry or default_registry()
+    lbl = ("executable",)
+    if "flops" in cost:
+        reg.gauge("xla_executable_flops",
+                  "HLO cost_analysis flops per execution",
+                  labelnames=lbl).labels(name).set(cost["flops"])
+    if "bytes_accessed" in cost:
+        reg.gauge("xla_executable_bytes_accessed",
+                  "HLO cost_analysis bytes accessed per execution",
+                  labelnames=lbl).labels(name).set(cost["bytes_accessed"])
+    return cost
+
+
+def record_mfu(name, flops, seconds, peak=None, registry=None,
+               platform=None):
+    """Set `mfu{executable=name}` = flops/seconds/peak; returns the MFU
+    (None when peak is unknown or inputs are degenerate)."""
+    if not flops or not seconds or seconds <= 0:
+        return None
+    peak = peak_flops(explicit=peak, platform=platform)
+    if not peak:
+        return None
+    mfu = float(flops) / float(seconds) / peak
+    reg = registry or default_registry()
+    reg.gauge(
+        "mfu",
+        "Measured model FLOP utilization: cost_analysis flops / "
+        "step time / peak", labelnames=("executable",),
+    ).labels(name).set(mfu)
+    return mfu

@@ -1,0 +1,69 @@
+"""Flight-recorder SIGTERM drill worker: arm the recorder, train an
+endless loop of real `Executor.run` steps under a `StepTimer`, and tell
+the parent when enough steps are in the ring.  The parent then SIGTERMs
+us mid-train; the recorder must leave ONE loadable chrome-trace dump
+behind while the process still dies by signal.
+
+Env knobs:
+
+  FLT_DUMP_DIR   where the recorder dumps (required)
+  FLT_READY      file touched once >=3 steps have trained ("" = never)
+  FLT_FAIL_AT    step index at which the train step raises (first-
+                 failed-step dump path; "" = never fail, loop forever)
+"""
+
+import os
+import re
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
+os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=1"
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.observability import StepTimer
+    from paddle_tpu.observability.flight_recorder import (
+        install_flight_recorder,
+    )
+
+    install_flight_recorder(dump_dir=os.environ["FLT_DUMP_DIR"],
+                            span_capacity=512)
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = layers.data("x", shape=[-1, 4], append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        h = layers.fc(x, 8, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    ready = os.environ.get("FLT_READY", "")
+    fail_at = int(os.environ.get("FLT_FAIL_AT", "-1") or "-1")
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 4).astype(np.float32),
+            "y": rng.randn(8, 1).astype(np.float32)}
+    timer = StepTimer(name="flight.drill")
+    step = 0
+    while True:
+        with timer.step():
+            if step == fail_at:
+                raise RuntimeError("injected step failure at %d" % step)
+            exe.run(main_p, feed=feed, fetch_list=[loss])
+        step += 1
+        if step == 3 and ready:
+            tmp = ready + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(step))
+            os.replace(tmp, ready)
+
+
+if __name__ == "__main__":
+    main()

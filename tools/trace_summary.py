@@ -1,0 +1,226 @@
+"""Summarize a chrome trace file from the command line.
+
+Usage::
+
+    python tools/trace_summary.py path/to/trace.json[.gz] \
+        [--top 15] [--json]
+
+Works on anything the `paddle_tpu.observability.trace` layer writes —
+a `Tracer.save()` capture, a GET /trace response body, a flight-recorder
+dump, or a `merge_fleet_trace` fleet timeline — and on any other
+chrome-trace-event file (object or bare-array format).
+
+Reports:
+
+* **top spans by self-time** — per span name: count, total wall,
+  self-time (total minus nested child spans on the same pid/tid track),
+  mean/max duration.  Self-time is what makes "where did the time go"
+  answerable when `step` contains `executor.run` contains nothing;
+* **per-signature serving latency breakdown** — reassembles the
+  per-request async timelines (`ph:"b"/"e"`, cat `serving`) the
+  InferenceServer emits, joins them to the batch signature via the
+  `batch.pad` span's `trace_ids` arg, and prints per signature: request
+  count, mean/p50/p99 end-to-end latency, and the mean per-phase split
+  (queue / pad+dispatch / xla_compute / slice);
+* the dump reason + straggler verdict when the file is a flight-recorder
+  dump or a merged fleet trace.
+
+Exit code: 1 when the file is missing or not a loadable chrome trace,
+0 otherwise.  `--json` prints one machine-readable object instead of
+the tables.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def span_stats(events):
+    """Per-name span statistics from ph:"X" events, with self-time.
+
+    Self-time: a span's duration minus the durations of spans nested
+    strictly inside it on the same (pid, tid) track — computed with a
+    sweep stack per track (events sorted by start, ties broken longest
+    first so parents enter before their children).
+    """
+    by_track = defaultdict(list)
+    for ev in events:
+        if ev.get("ph") == "X" and "ts" in ev:
+            by_track[(ev.get("pid"), ev.get("tid"))].append(ev)
+    stats = {}
+
+    def acct(name):
+        return stats.setdefault(name, {
+            "count": 0, "total_us": 0, "self_us": 0, "max_us": 0})
+
+    for track in by_track.values():
+        track.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+        stack = []   # (name, end_ts, child_us accumulator index)
+        for ev in track:
+            name, ts = ev.get("name", "?"), ev["ts"]
+            dur = int(ev.get("dur", 0))
+            while stack and ts >= stack[-1][1]:
+                stack.pop()
+            if stack:
+                stack[-1][2]["child_us"] = \
+                    stack[-1][2].get("child_us", 0) + dur
+            s = acct(name)
+            s["count"] += 1
+            s["total_us"] += dur
+            s["max_us"] = max(s["max_us"], dur)
+            holder = {}
+            stack.append((name, ts + dur, holder))
+            # defer self-time: subtract children once the span closes —
+            # but the sweep pops lazily, so bill at push via holder
+            s.setdefault("_holders", []).append((holder, dur))
+    for s in stats.values():
+        self_us = 0
+        for holder, dur in s.pop("_holders", []):
+            self_us += max(dur - holder.get("child_us", 0), 0)
+        s["self_us"] = self_us
+        s["mean_us"] = s["total_us"] / s["count"] if s["count"] else 0
+    return stats
+
+
+def serving_breakdown(events):
+    """Per-signature request latency from the serving async timelines."""
+    # request phases: {trace_id: {phase: us}}; overall span from the
+    # "request" b/e pair
+    begins = {}
+    phases = defaultdict(dict)
+    for ev in events:
+        if ev.get("cat") != "serving" or ev.get("ph") not in ("b", "e"):
+            continue
+        key = (ev.get("id"), ev.get("name"))
+        if ev["ph"] == "b":
+            begins[key] = ev.get("ts", 0)
+        else:
+            t0 = begins.pop(key, None)
+            if t0 is not None:
+                phases[ev.get("id")][ev.get("name")] = \
+                    ev.get("ts", 0) - t0
+    # trace_id -> signature from batch.pad / batch.dispatch span args
+    sig_of = {}
+    for ev in events:
+        if ev.get("ph") == "X" and ev.get("name", "").startswith("batch."):
+            args = ev.get("args") or {}
+            sig = args.get("signature")
+            for tid in args.get("trace_ids") or ():
+                if sig:
+                    sig_of[tid] = sig
+    groups = defaultdict(list)
+    for tid, ph in phases.items():
+        if "request" in ph:
+            groups[sig_of.get(tid, "(unknown)")].append(ph)
+    out = {}
+    for sig, reqs in sorted(groups.items()):
+        lats = sorted(r["request"] for r in reqs)
+        ent = {
+            "requests": len(reqs),
+            "mean_ms": round(sum(lats) / len(lats) / 1e3, 3),
+            "p50_ms": round(_pct(lats, 0.50) / 1e3, 3),
+            "p99_ms": round(_pct(lats, 0.99) / 1e3, 3),
+            "phases_mean_ms": {},
+        }
+        for phase in ("queue", "pad+dispatch", "xla_compute", "slice"):
+            vals = [r[phase] for r in reqs if phase in r]
+            if vals:
+                ent["phases_mean_ms"][phase] = \
+                    round(sum(vals) / len(vals) / 1e3, 3)
+        out[sig] = ent
+    return out
+
+
+def summarize(path, top=15):
+    from paddle_tpu.observability.trace import load_trace
+
+    events, metadata = load_trace(path)
+    stats = span_stats(events)
+    ranked = sorted(stats.items(), key=lambda kv: -kv[1]["self_us"])[:top]
+    return {
+        "path": os.fspath(path),
+        "events": len(events),
+        "metadata": {k: metadata[k] for k in
+                     ("reason", "stragglers", "ranks", "merged_shards",
+                      "pid") if k in metadata},
+        "top_spans_by_self_time": [
+            dict(name=name, count=s["count"],
+                 total_ms=round(s["total_us"] / 1e3, 3),
+                 self_ms=round(s["self_us"] / 1e3, 3),
+                 mean_ms=round(s["mean_us"] / 1e3, 3),
+                 max_ms=round(s["max_us"] / 1e3, 3))
+            for name, s in ranked],
+        "serving": serving_breakdown(events),
+    }
+
+
+def _print_tables(summary):
+    print("%s: %d events" % (summary["path"], summary["events"]))
+    md = summary["metadata"]
+    if md.get("reason"):
+        print("flight-recorder dump; reason: %s" % md["reason"])
+    strag = (md.get("stragglers") or {}).get("ranks")
+    if strag:
+        print("stragglers: ranks %s (ratios %s)"
+              % (strag, md["stragglers"]["ratios"]))
+    rows = summary["top_spans_by_self_time"]
+    if rows:
+        print("\ntop spans by self-time:")
+        print("  %-28s %8s %12s %12s %10s %10s"
+              % ("name", "count", "self ms", "total ms",
+                 "mean ms", "max ms"))
+        for r in rows:
+            print("  %-28s %8d %12.3f %12.3f %10.3f %10.3f"
+                  % (r["name"][:28], r["count"], r["self_ms"],
+                     r["total_ms"], r["mean_ms"], r["max_ms"]))
+    if summary["serving"]:
+        print("\nserving latency by signature:")
+        for sig, ent in summary["serving"].items():
+            print("  %s: n=%d mean=%.3fms p50=%.3fms p99=%.3fms"
+                  % (sig, ent["requests"], ent["mean_ms"],
+                     ent["p50_ms"], ent["p99_ms"]))
+            if ent["phases_mean_ms"]:
+                print("    phases (mean ms): %s" % " ".join(
+                    "%s=%.3f" % kv
+                    for kv in ent["phases_mean_ms"].items()))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="trace_summary",
+        description="summarize a chrome trace / flight-recorder dump")
+    ap.add_argument("trace", help="trace file (.json or .json.gz)")
+    ap.add_argument("--top", type=int, default=15,
+                    help="span rows to show (default 15)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print one machine-readable JSON object")
+    args = ap.parse_args(argv)
+    try:
+        summary = summarize(args.trace, top=args.top)
+    except (OSError, ValueError) as e:
+        print("trace_summary: cannot read %r: %s" % (args.trace, e),
+              file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        _print_tables(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
