@@ -342,6 +342,10 @@ def _run(on_tpu):
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.35, 4),
         "mfu_model": round(mfu, 4),
+        # a CPU capture is the tiny smoke config, not a number of record
+        # — consumers must be able to tell without guessing from scale
+        "platform": jax.default_backend(),
+        "smoke_config": not on_tpu,
     }
     if mfu_measured is not None:
         out["mfu_measured"] = round(mfu_measured, 4)

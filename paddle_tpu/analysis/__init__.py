@@ -1,10 +1,15 @@
-"""paddle_tpu.analysis — whole-program static verification and linting.
+"""paddle_tpu.analysis — whole-program static verification, linting,
+and performance estimation.
 
 The safety net behind aggressive pass-writing and program surgery
 (ROADMAP: "refactor freely"): a ProgramVerifier that re-checks global
 structural invariants + shape/dtype inference over a finished Program, a
-lint-rule engine producing structured diagnostics, and op-callsite
-provenance so findings point at the line of Python that built the op.
+lint-rule engine producing structured diagnostics, op-callsite
+provenance so findings point at the line of Python that built the op,
+and the performance half (`perf` / `perf_rules`): a static cost model
+(FLOPs / bytes / roofline time per op, validated against XLA's own cost
+analysis), perf lint rules, and `rank_pass_pipelines` — the
+estimate-and-rank front-end for compile-and-time autotuning.
 
 Hot-path wiring:
   * ``ir.apply_passes(..., verify=True)`` re-verifies after each pass and
@@ -48,14 +53,31 @@ from .provenance import (  # noqa: F401
     provenance_enabled,
 )
 from . import opgraph  # noqa: F401
+from .perf import (  # noqa: F401
+    ChipSpec,
+    CostReport,
+    OpCost,
+    PipelineRanking,
+    op_cost_types,
+    program_cost,
+    rank_pass_pipelines,
+    register_op_cost,
+    validate_cost_model,
+    xla_cost_of_program,
+)
+from . import perf_rules  # noqa: F401  (registers the perf lint rules)
 
 
 def analyze_program(program, feed_names=None, fetch_names=None,
-                    check_shapes=True, rules=None):
-    """verify + lint in one call; returns a single Diagnostics."""
+                    check_shapes=True, rules=None,
+                    categories=("program",)):
+    """verify + lint in one call; returns a single Diagnostics.  Lint
+    defaults to the correctness catalog; add the advisory perf rules
+    with `categories=("program", "perf")`."""
     diags = verify_program(program, feed_names=feed_names,
                            fetch_names=fetch_names,
                            check_shapes=check_shapes)
     diags.extend(lint_program(program, feed_names=feed_names,
-                              fetch_names=fetch_names, rules=rules))
+                              fetch_names=fetch_names, rules=rules,
+                              categories=categories))
     return diags

@@ -24,6 +24,10 @@ Built-in rules:
   * ``side-effect-order``   — a side-effect op reads a var that a LATER op
                               overwrites (the print/save observes the
                               pre-update value)
+
+The performance-hazard rules (category "perf": layout-transpose-hazard,
+dtype-promotion, unfused-epilogue, tiny-matmul, pad-waste,
+missed-donation) live in perf_rules.py on the same registry.
 """
 
 from __future__ import annotations
@@ -54,10 +58,15 @@ class LintContext:
 
 
 class LintRule:
-    """One named check; subclass and register with @register_lint_rule."""
+    """One named check; subclass and register with @register_lint_rule.
+
+    `category` partitions the catalog: "program" rules find correctness
+    or hygiene defects; "perf" rules (perf_rules.py) find performance
+    hazards and are selected separately (program_lint.py --perf)."""
 
     name = None
     severity = WARNING
+    category = "program"
 
     def check(self, ctx: LintContext) -> Diagnostics:
         raise NotImplementedError
@@ -73,9 +82,11 @@ def register_lint_rule(cls):
     return cls
 
 
-def lint_rules():
-    """Registered rule names (extension surface, cf. ir.get_pass)."""
-    return sorted(_LINT_REGISTRY)
+def lint_rules(category=None):
+    """Registered rule names (extension surface, cf. ir.get_pass);
+    `category` filters ("program" / "perf")."""
+    return sorted(n for n, c in _LINT_REGISTRY.items()
+                  if category is None or c.category == category)
 
 
 def get_lint_rule(name):
@@ -179,6 +190,41 @@ class OrphanVarRule(LintRule):
 class MixedDtypeMatmulRule(LintRule):
     name = "mixed-dtype-matmul"
     _TYPES = ("matmul", "mul", "conv2d")
+    # dtype-preserving ops the producer walk may pass through: the op
+    # that INTRODUCED the promotion is upstream of these
+    _DTYPE_THROUGH = ("assign", "reshape2", "squeeze2", "unsqueeze2",
+                      "flatten2", "transpose2", "transpose", "scale",
+                      "dropout")
+    _WIDTH = {"float64": 3, "float32": 2, "float16": 1, "bfloat16": 1}
+
+    def _promoter(self, ctx, bidx, oidx, dts):
+        """(name, origin_text) for the WIDEST-dtype operand — the one
+        whose presence forces the silent upcast — walking its def-chain
+        through dtype-preserving ops to the op that introduced it.  A
+        chain ending at a producer-less var (parameter/feed) reports
+        THAT var's kind, never the dtype-preserving hop before it."""
+        name = max(dts, key=lambda n: (self._WIDTH.get(dts[n], 0), n))
+        block = ctx.program.blocks[bidx]
+        idx, cur = oidx, name
+        for _hop in range(32):
+            found = opgraph.producer_before(block, cur, idx)
+            if found is None:
+                break
+            pidx, pop = found
+            if opgraph.op_type(pop) not in self._DTYPE_THROUGH:
+                return name, "%s %r introduced by op %d (%r)" % (
+                    dts[name], name, pidx, opgraph.op_type(pop))
+            ins = opgraph.input_names(pop)
+            if not ins:
+                break
+            idx, cur = pidx, ins[0]
+        v = block._find_var_recursive(cur)
+        kind = ("parameter" if v is not None and v.persistable
+                else "feed" if v is not None and v.is_data
+                else "external input")
+        via = "" if cur == name else " reached through %r" % name
+        return name, "%s %r (%s — no producer op)%s" % (
+            dts[name], cur, kind, via)
 
     def check(self, ctx):
         diags = Diagnostics()
@@ -191,10 +237,12 @@ class MixedDtypeMatmulRule(LintRule):
                 if v is not None and "float" in v.dtype:
                     dts[n] = v.dtype
             if len(set(dts.values())) > 1:
+                _pname, origin = self._promoter(ctx, bidx, oidx, dts)
                 diags.add(self.severity, self.name,
                           "op %r mixes float dtypes %s — AMP hazard: the "
                           "lowering silently promotes, hiding a missing "
-                          "cast" % (op.type, dts),
+                          "cast; promotion driven by %s"
+                          % (op.type, dts, origin),
                           block_idx=bidx, op_idx=oidx, op_type=op.type,
                           var_names=sorted(dts), provenance=_provenance(op))
         return diags
@@ -280,13 +328,25 @@ class SideEffectOrderRule(LintRule):
 # ---------------------------------------------------------------------------
 
 
-def lint_program(program, feed_names=None, fetch_names=None, rules=None):
-    """Run lint rules (all registered by default, or a list of names /
-    LintRule instances) over `program`; returns Diagnostics."""
+def lint_program(program, feed_names=None, fetch_names=None, rules=None,
+                 categories=("program",)):
+    """Run lint rules over `program`; returns Diagnostics.
+
+    Defaults to the "program" (correctness/hygiene) category, so
+    callers that predate the perf catalog keep returning zero findings
+    on clean programs (the --strict idiom).  Opt into the advisory perf
+    rules with `categories=("program", "perf")` (or ("perf",) alone,
+    or `categories=None` for every registered rule), or pass explicit
+    `rules` (names / LintRule instances) which override `categories`."""
     ctx = LintContext(program, feed_names=feed_names,
                       fetch_names=fetch_names)
     diags = Diagnostics()
-    selected = rules if rules is not None else lint_rules()
+    if rules is not None:
+        selected = rules
+    elif categories is not None:
+        selected = [n for c in categories for n in lint_rules(category=c)]
+    else:
+        selected = lint_rules()
     for r in selected:
         rule = r if isinstance(r, LintRule) else get_lint_rule(r)
         diags.extend(rule.check(ctx))

@@ -136,6 +136,16 @@ def producers(program):
     return out
 
 
+def producer_before(block, name, before_idx):
+    """Latest real op in `block` producing `name` strictly before index
+    `before_idx`, as (op_idx, op); None when the var comes from outside
+    the block (feed, param, parent block)."""
+    for i in range(min(before_idx, len(block.ops)) - 1, -1, -1):
+        if name in output_names(block.ops[i]):
+            return i, block.ops[i]
+    return None
+
+
 def op_provenance(op):
     """The op_callstack frames recorded by append_op provenance capture
     (innermost user frame first), [] when capture was off.  Works on

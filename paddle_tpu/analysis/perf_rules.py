@@ -1,0 +1,401 @@
+"""Performance lint rules: static hazards the cost model can see.
+
+The perf half of the lint catalog (lint.py holds the correctness half).
+Every rule is registered in the same `register_lint_rule` registry with
+``category = "perf"`` so drivers can select them separately
+(`tools/program_lint.py --perf`); severities are WARNING/INFO — a perf
+hazard never fails verification, it names money left on the table.
+
+Rules:
+  * ``layout-transpose-hazard`` — a transpose whose inverse appears
+    downstream on a def-chain crossing matmul/attention ops: the
+    [B,S,H,D]->[B,H,S,D] attention pattern (ROADMAP item 2c).  Each
+    pair round-trips the tensor through HBM twice for pure relayout.
+  * ``dtype-promotion``        — an op mixing reduced-precision
+    (bf16/f16) and f32 float operands outside the matmul family: the
+    lowering silently upcasts, doubling HBM traffic inside what was
+    meant to be a bf16 region.
+  * ``unfused-epilogue``       — matmul -> bias-add -> activation chain
+    whose intermediates have single consumers: eligible for a fused
+    epilogue kernel (the pallas fused bias+GeLU path, ROADMAP item 2a);
+    unfused it round-trips the [M,N] intermediate through HBM twice.
+  * ``tiny-matmul``            — matmul whose [m,k]x[k,n] tile padded to
+    the MXU grain (8x128 operands, 128-deep contraction) is mostly
+    padding: launch/relayout overhead dominates the useful MACs.
+  * ``pad-waste``              — a declared ragged (-1) dim whose bucket
+    ladder can pad away more than `threshold` of the traffic in the
+    worst case (serving bucket ladders, io packing).
+  * ``missed-donation``        — a feed whose live range ends before a
+    same-shape/dtype output is produced, with no donation: the executor
+    allocates a fresh output buffer while a dead input buffer of the
+    exact layout sits in HBM.
+"""
+
+from __future__ import annotations
+
+from . import opgraph
+from .diagnostics import INFO, WARNING, Diagnostics
+from .lint import LintRule, register_lint_rule
+from .perf import DEFAULT_DYNAMIC_DIM, MXU_LANE, MXU_SUBLANE
+
+_provenance = opgraph.op_provenance
+
+_MATMUL_TYPES = ("matmul", "mul", "bmm", "conv2d", "flash_attention")
+
+_REDUCED_FLOATS = ("bfloat16", "float16")
+
+# ops a transpose-cancellation chain may pass through: compute that
+# operates on the transposed layout without consuming the permutation
+_HAZARD_THROUGH = frozenset({
+    "matmul", "bmm", "mul", "flash_attention", "softmax", "log_softmax",
+    "scale", "dropout", "cast", "relu", "gelu", "tanh", "sigmoid",
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "layer_norm", "stack", "concat",
+})
+
+
+def _axis_perm(op):
+    perm = opgraph.op_attrs(op).get("axis")
+    return list(perm) if isinstance(perm, (list, tuple)) else None
+
+
+def _composes_identity(p1, p2):
+    """True when transpose(p2) applied to transpose(p1)'s result is the
+    identity permutation: p1[p2[j]] == j for all j."""
+    if p1 is None or p2 is None or len(p1) != len(p2):
+        return False
+    n = len(p1)
+    return all(0 <= p2[j] < n and p1[p2[j]] == j for j in range(n))
+
+
+@register_lint_rule
+class LayoutTransposeHazardRule(LintRule):
+    name = "layout-transpose-hazard"
+    category = "perf"
+    severity = WARNING
+    max_visits = 64
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        for block in ctx.program.blocks:
+            for oidx, op in enumerate(block.ops):
+                if op.type not in ("transpose2", "transpose"):
+                    continue
+                p2 = _axis_perm(op)
+                if p2 is None:
+                    continue
+                hit = self._find_cancelling(block, op, oidx, p2)
+                if hit is None:
+                    continue
+                t1_idx, t1 = hit
+                diags.add(
+                    self.severity, self.name,
+                    "transpose at op %d cancels transpose at op %d "
+                    "(axis %s then %s) across a matmul/attention chain "
+                    "— the [B,S,H,D]<->[B,H,S,D] relayout pattern; each "
+                    "transpose round-trips the tensor through HBM.  Use "
+                    "a layout-preserving path (flash_attention "
+                    "layout=\"BSHD\") or fold the permutation into the "
+                    "matmul operand order"
+                    % (oidx, t1_idx, _axis_perm(t1), p2),
+                    block_idx=block.idx, op_idx=oidx, op_type=op.type,
+                    var_names=op.all_input_names(),
+                    provenance=_provenance(op))
+        return diags
+
+    def _find_cancelling(self, block, t2, t2_idx, p2):
+        """BFS the def-chain upstream of t2 through compute ops; a
+        transpose whose perm composes with p2 to identity — with at
+        least one matmul-family op crossed — is the hazard."""
+        frontier = [(t2_idx, n, False) for n in t2.all_input_names()]
+        # crossed is part of the state: a producer first reached on an
+        # un-crossed path must still be revisitable via a crossed one
+        # (diamond def-chains)
+        seen = set()
+        visits = 0
+        while frontier and visits < self.max_visits:
+            idx, name, crossed = frontier.pop(0)
+            if (idx, name, crossed) in seen:
+                continue
+            seen.add((idx, name, crossed))
+            found = opgraph.producer_before(block, name, idx)
+            if found is None:
+                continue
+            visits += 1
+            pidx, producer = found
+            if producer.type in ("transpose2", "transpose"):
+                if crossed and _composes_identity(_axis_perm(producer), p2):
+                    return pidx, producer
+                continue  # a different transpose ends this branch
+            if producer.type not in _HAZARD_THROUGH:
+                continue
+            crossed = crossed or producer.type in _MATMUL_TYPES
+            for n in producer.all_input_names():
+                frontier.append((pidx, n, crossed))
+        return None
+
+
+@register_lint_rule
+class DtypePromotionRule(LintRule):
+    name = "dtype-promotion"
+    category = "perf"
+    severity = WARNING
+    # matmul-family mixing is mixed-dtype-matmul's finding; cast is the
+    # explicit fix, not a hazard
+    _EXEMPT = set(_MATMUL_TYPES) | {"cast", "conv2d"}
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        for bidx, oidx, op in opgraph.iter_all_ops(ctx.program):
+            if op.type in self._EXEMPT:
+                continue
+            reduced, wide = [], []
+            for n in op.all_input_names():
+                v = ctx.resolve(bidx, n)
+                if v is None or "float" not in v.dtype:
+                    continue
+                if v.dtype in _REDUCED_FLOATS:
+                    reduced.append((n, v.dtype))
+                elif v.dtype == "float32":
+                    wide.append((n, v.dtype))
+            if reduced and wide:
+                diags.add(
+                    self.severity, self.name,
+                    "op %r mixes reduced-precision %s with float32 %s — "
+                    "the lowering upcasts to f32 inside an intended "
+                    "reduced-precision region, doubling HBM traffic; "
+                    "cast the f32 operand once outside the hot loop"
+                    % (op.type, [n for n, _ in reduced],
+                       [n for n, _ in wide]),
+                    block_idx=bidx, op_idx=oidx, op_type=op.type,
+                    var_names=[n for n, _ in reduced + wide],
+                    provenance=_provenance(op))
+        return diags
+
+
+@register_lint_rule
+class UnfusedEpilogueRule(LintRule):
+    name = "unfused-epilogue"
+    category = "perf"
+    severity = INFO
+    _ACTS = ("relu", "gelu", "tanh", "sigmoid", "swish", "relu6")
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        for block in ctx.program.blocks:
+            # count of consuming ops per name, within this block
+            n_consumers = {}
+            consumer_at = {}
+            for i, op in enumerate(block.ops):
+                for n in op.all_input_names():
+                    n_consumers[n] = n_consumers.get(n, 0) + 1
+                    consumer_at[n] = (i, op)
+            for oidx, op in enumerate(block.ops):
+                if op.type not in ("matmul", "mul"):
+                    continue
+                outs = op.all_output_names()
+                if not outs or n_consumers.get(outs[0], 0) != 1:
+                    continue
+                _bi, bias_op = consumer_at[outs[0]]
+                if bias_op.type != "elementwise_add":
+                    continue
+                bouts = bias_op.all_output_names()
+                if not bouts or n_consumers.get(bouts[0], 0) != 1:
+                    continue
+                ai, act_op = consumer_at[bouts[0]]
+                if act_op.type not in self._ACTS:
+                    continue
+                diags.add(
+                    self.severity, self.name,
+                    "%s (op %d) -> bias add (op %d) -> %s (op %d) is a "
+                    "fusable epilogue chain: unfused, the [M,N] "
+                    "intermediate round-trips HBM twice; a fused "
+                    "matmul+bias+%s kernel (pallas epilogue path) "
+                    "writes it once"
+                    % (op.type, oidx, _bi, act_op.type, ai, act_op.type),
+                    block_idx=block.idx, op_idx=oidx, op_type=op.type,
+                    var_names=[outs[0], bouts[0]],
+                    provenance=_provenance(op))
+        return diags
+
+
+def _pad_up(x, grain):
+    return ((int(x) + grain - 1) // grain) * grain
+
+
+@register_lint_rule
+class TinyMatmulRule(LintRule):
+    name = "tiny-matmul"
+    category = "perf"
+    severity = WARNING
+    # flag when useful MACs fill less than this fraction of the padded
+    # MXU tile volume
+    threshold = 0.25
+    dynamic_dim = DEFAULT_DYNAMIC_DIM
+
+    def _mkn(self, ctx, bidx, op):
+        def shape(name):
+            v = ctx.resolve(bidx, name)
+            if v is None or v.shape is None:
+                return None
+            return [self.dynamic_dim if s == -1 else int(s)
+                    for s in v.shape]
+
+        xs = shape(op.all_input_names()[0]) if op.all_input_names() else None
+        outs = op.all_output_names()
+        os_ = shape(outs[0]) if outs else None
+        if not xs or not os_:
+            return None
+        if op.type == "matmul":
+            if len(os_) < 2 or len(xs) < 2:
+                return None
+            tx = op.attrs.get("transpose_X",
+                              op.attrs.get("transpose_x", False))
+            k = xs[-2] if tx else xs[-1]
+            return os_[-2], k, os_[-1]
+        if op.type == "mul":
+            ncol = int(op.attrs.get("x_num_col_dims", 1))
+            m = 1
+            for s in xs[:ncol]:
+                m *= s
+            k = 1
+            for s in xs[ncol:]:
+                k *= s
+            return m, k, os_[-1]
+        return None
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        for bidx, oidx, op in opgraph.iter_all_ops(ctx.program):
+            if op.type not in ("matmul", "mul"):
+                continue
+            mkn = self._mkn(ctx, bidx, op)
+            if mkn is None:
+                continue
+            m, k, n = mkn
+            useful = m * k * n
+            padded = (_pad_up(m, MXU_SUBLANE) * _pad_up(k, MXU_LANE)
+                      * _pad_up(n, MXU_LANE))
+            if not padded:
+                continue
+            util = useful / padded
+            if util >= self.threshold:
+                continue
+            diags.add(
+                self.severity, self.name,
+                "op %r computes a [%d,%d]x[%d,%d] matmul that fills "
+                "only %.1f%% of the padded MXU tile ([%d,%d]x[%d,%d]) "
+                "— launch and relayout overhead dominates; batch these "
+                "rows or fold the op into a neighbor"
+                % (op.type, m, k, k, n, util * 100,
+                   _pad_up(m, MXU_SUBLANE), _pad_up(k, MXU_LANE),
+                   _pad_up(k, MXU_LANE), _pad_up(n, MXU_LANE)),
+                block_idx=bidx, op_idx=oidx, op_type=op.type,
+                var_names=op.all_output_names(),
+                provenance=_provenance(op))
+        return diags
+
+
+@register_lint_rule
+class PadWasteRule(LintRule):
+    """Worst-case padding fraction of a bucket ladder over declared
+    ragged (-1) dims.  `ladders` maps feed name -> {axis: [buckets]}
+    (the serving `ragged_dims` convention); dims without a configured
+    ladder assume the serving default powers-of-two ladder, whose
+    worst-case waste stays just under 0.5 — so the rule stays quiet at
+    the default threshold and wakes when a CI budget (--max-pad-waste)
+    or a coarse custom ladder is declared."""
+
+    name = "pad-waste"
+    category = "perf"
+    severity = WARNING
+    threshold = 0.5
+    default_ladder = tuple(2 ** i for i in range(11))  # 1..1024
+
+    def __init__(self, ladders=None, threshold=None):
+        self.ladders = ladders or {}
+        if threshold is not None:
+            self.threshold = threshold
+
+    @staticmethod
+    def worst_waste(ladder):
+        """Max padded fraction over ladder steps: a request one element
+        past bucket b_i pads to b_{i+1}."""
+        ladder = sorted(set(int(b) for b in ladder if b > 0))
+        if not ladder:
+            return 0.0
+        worst = 1.0 - 1.0 / ladder[0]
+        for lo, hi in zip(ladder, ladder[1:]):
+            worst = max(worst, 1.0 - (lo + 1.0) / hi)
+        return worst
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        for block in ctx.program.blocks:
+            for name, v in block.vars.items():
+                if not v.is_data or v.shape is None:
+                    continue
+                for axis, s in enumerate(v.shape):
+                    if s != -1:
+                        continue
+                    ladder = (self.ladders.get(name) or {}).get(
+                        axis, self.default_ladder)
+                    waste = self.worst_waste(ladder)
+                    if waste <= self.threshold:
+                        continue
+                    diags.add(
+                        self.severity, self.name,
+                        "ragged dim %d of feed %r pads to bucket ladder "
+                        "%s: worst-case %.0f%% of the padded tensor is "
+                        "padding (> %.0f%% budget) — add intermediate "
+                        "buckets or pack requests"
+                        % (axis, name, list(sorted(set(ladder))),
+                           waste * 100, self.threshold * 100),
+                        block_idx=block.idx, var_names=[name])
+        return diags
+
+
+@register_lint_rule
+class MissedDonationRule(LintRule):
+    name = "missed-donation"
+    category = "perf"
+    severity = INFO
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        if not ctx.fetch_names:
+            return diags  # outputs unknown: donation pairs undecidable
+        block = ctx.program.global_block
+        last_read = {}
+        produced_at = {}
+        for i, op in enumerate(block.ops):
+            for n in op.all_input_names():
+                last_read[n] = i
+            for n in op.all_output_names():
+                produced_at.setdefault(n, i)
+        taken = set()
+        for name, v in sorted(block.vars.items()):
+            if not v.is_data or v.shape is None or name not in last_read:
+                continue
+            for out in sorted(ctx.fetch_names - taken):
+                ov = block._find_var_recursive(out)
+                if (ov is None or ov.persistable or ov.shape is None
+                        or out not in produced_at):
+                    continue
+                if (tuple(ov.shape) == tuple(v.shape)
+                        and ov.dtype == v.dtype
+                        and produced_at[out] >= last_read[name]):
+                    taken.add(out)
+                    diags.add(
+                        self.severity, self.name,
+                        "feed %r (shape %s, %s) is dead after op %d but "
+                        "its buffer is not donated to output %r "
+                        "(produced at op %d, same shape/dtype) — "
+                        "donation would save one HBM allocation per "
+                        "step (cf. executor state donation; feeds are "
+                        "never donated today)"
+                        % (name, tuple(v.shape), v.dtype,
+                           last_read[name], out, produced_at[out]),
+                        block_idx=0, var_names=[name, out])
+                    break
+        return diags

@@ -94,6 +94,15 @@ def apply_passes(program, names, verify=False):
     return program
 
 
+def clone_and_apply(program, names, verify=True):
+    """Run a pass pipeline on a CLONE of `program` and return the clone
+    — the candidate-evaluation primitive behind
+    `analysis.perf.rank_pass_pipelines` (and the coming autotuner): the
+    original program is never mutated, so any number of pipeline
+    variants can be costed side by side."""
+    return apply_passes(program.clone(), list(names), verify=verify)
+
+
 # ---------------------------------------------------------------------------
 # pattern detection (cf. ir/graph_pattern_detector.h, reduced to the
 # op-chain patterns the JSON IR needs)

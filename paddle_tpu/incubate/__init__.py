@@ -2,3 +2,4 @@
 capabilities that are production-real but whose API may still move."""
 
 from . import checkpoint  # noqa: F401
+from . import complex  # noqa: F401

@@ -1,0 +1,108 @@
+"""Complex-tensor namespace on native JAX complex dtypes.
+
+Capability parity: reference `python/paddle/incubate/complex/`
+(`tensor/math.py` kron/matmul/elementwise ops, `tensor/manipulation.py`
+reshape/transpose, `helper.py`) — there a ComplexVariable pairs two real
+tensors because the framework has no complex dtype; here XLA has native
+complex64/complex128, so each wrapper is the plain jnp op with the
+reference's calling convention (transpose_x/transpose_y on matmul,
+perm-list transpose) and VarBase in/out so dygraph code composes.
+
+All functions accept dygraph VarBase, numpy, or jax arrays; the result
+is a VarBase when any input was one (eager idiom preserved), else a jax
+array.  Real inputs are accepted everywhere — mixing real and complex
+operands promotes like numpy.  complex128 keeps full precision only
+under ``JAX_ENABLE_X64`` (otherwise jax canonicalizes it to complex64,
+its standard dtype policy).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "elementwise_add",
+    "elementwise_div",
+    "elementwise_mul",
+    "elementwise_sub",
+    "is_complex",
+    "kron",
+    "matmul",
+    "reshape",
+    "transpose",
+]
+
+
+def _unwrap(x):
+    """(array, was_varbase) for VarBase / numpy / jax inputs."""
+    from ..fluid.dygraph.varbase import VarBase
+
+    if isinstance(x, VarBase):
+        return jnp.asarray(x.data), True
+    return jnp.asarray(x), False
+
+
+def _wrap(val, wrapped):
+    if not wrapped:
+        return val
+    from ..fluid.dygraph.varbase import VarBase
+
+    return VarBase(val)
+
+
+def is_complex(x):
+    """True when `x` holds a complex dtype (complex64/complex128)."""
+    arr, _ = _unwrap(x)
+    return jnp.issubdtype(arr.dtype, jnp.complexfloating)
+
+
+def _binary(x, y, fn):
+    ax, wx = _unwrap(x)
+    ay, wy = _unwrap(y)
+    return _wrap(fn(ax, ay), wx or wy)
+
+
+def elementwise_add(x, y):
+    """Complex elementwise add (cf. incubate/complex/tensor/math.py)."""
+    return _binary(x, y, jnp.add)
+
+
+def elementwise_sub(x, y):
+    return _binary(x, y, jnp.subtract)
+
+
+def elementwise_mul(x, y):
+    return _binary(x, y, jnp.multiply)
+
+
+def elementwise_div(x, y):
+    return _binary(x, y, jnp.divide)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    """Complex matmul with the reference's transpose flags: operands
+    with ndim > 1 transpose their last two axes first."""
+    ax, wx = _unwrap(x)
+    ay, wy = _unwrap(y)
+    if transpose_x and ax.ndim > 1:
+        ax = jnp.swapaxes(ax, -1, -2)
+    if transpose_y and ay.ndim > 1:
+        ay = jnp.swapaxes(ay, -1, -2)
+    return _wrap(jnp.matmul(ax, ay), wx or wy)
+
+
+def kron(x, y):
+    """Kronecker product (cf. incubate/complex/tensor/math.py kron)."""
+    return _binary(x, y, jnp.kron)
+
+
+def reshape(x, shape):
+    ax, wx = _unwrap(x)
+    return _wrap(jnp.reshape(ax, tuple(shape)), wx)
+
+
+def transpose(x, perm):
+    """Axis permutation (the reference's perm-list convention; complex
+    values move untouched — no conjugation)."""
+    ax, wx = _unwrap(x)
+    return _wrap(jnp.transpose(ax, tuple(perm)), wx)

@@ -35,6 +35,7 @@ __all__ = [
     "cost_analysis_of",
     "cost_of_jitted",
     "feed_signature",
+    "hbm_bandwidth",
     "record_executable_cost",
     "record_mfu",
     "peak_flops",
@@ -51,11 +52,18 @@ def feed_signature(feed):
         (k, tuple(v.shape), str(v.dtype)) for k, v in feed.items()))
 
 PEAK_FLOPS_ENV = "PADDLE_TPU_PEAK_FLOPS"
+HBM_BW_ENV = "PADDLE_TPU_HBM_BW"
 
 # bf16 peak per chip for platforms we know; MFU needs a denominator and
 # an unknown platform yields None (callers then skip the gauge)
 _PLATFORM_PEAK = {
     "tpu": 197e12,   # v5e public spec (bench.py's constant of record)
+}
+
+# HBM bytes/s per chip — the other roofline axis (analysis.perf's time
+# estimates divide bytes moved by this)
+_PLATFORM_HBM_BW = {
+    "tpu": 819e9,    # v5e public spec
 }
 
 
@@ -78,6 +86,28 @@ def peak_flops(explicit=None, platform=None):
         except Exception:
             return None
     return _PLATFORM_PEAK.get(platform)
+
+
+def hbm_bandwidth(explicit=None, platform=None):
+    """Resolve HBM bytes/s the same way peak_flops resolves FLOP/s:
+    explicit arg > $PADDLE_TPU_HBM_BW > platform table (platform
+    defaults to the live jax backend).  None when unknown."""
+    if explicit:
+        return float(explicit)
+    env = os.getenv(HBM_BW_ENV)
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    if platform is None:
+        try:
+            import jax
+
+            platform = jax.default_backend()
+        except Exception:
+            return None
+    return _PLATFORM_HBM_BW.get(platform)
 
 
 def cost_analysis_of(compiled):

@@ -28,6 +28,12 @@ import pytest
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy tests excluded from tier-1 (-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def fresh_state():
     """Each test gets fresh default programs / scope / name generator."""

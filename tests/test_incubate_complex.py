@@ -1,0 +1,96 @@
+"""paddle_tpu.incubate.complex — value oracles against numpy.
+
+The reference's `python/paddle/incubate/complex/` pairs two real tensors
+into a ComplexVariable; here JAX's native complex64/complex128 carry the
+values, so every wrapper is checked against the numpy result on the
+same operands (the cheapest possible oracle)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.incubate import complex as pc
+
+
+def _c(shape, seed, dtype=np.complex64):
+    r = np.random.RandomState(seed)
+    return (r.randn(*shape) + 1j * r.randn(*shape)).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", [np.complex64, np.complex128],
+                         ids=["c64", "c128"])
+def test_elementwise_values(dtype):
+    import jax
+
+    a, b = _c((3, 4), 0, dtype), _c((3, 4), 1, dtype)
+    # without JAX_ENABLE_X64, jax canonicalizes complex128 -> complex64
+    want = dtype if (dtype == np.complex64
+                     or jax.config.jax_enable_x64) else np.complex64
+    tol = 1e-5 if want == np.complex64 else 1e-12
+    for fn, ref in [(pc.elementwise_add, np.add),
+                    (pc.elementwise_sub, np.subtract),
+                    (pc.elementwise_mul, np.multiply),
+                    (pc.elementwise_div, np.divide)]:
+        got = np.asarray(fn(a, b))
+        assert got.dtype == want
+        np.testing.assert_allclose(got, ref(a, b), rtol=tol, atol=tol)
+
+
+def test_matmul_values_and_transpose_flags():
+    a, b = _c((3, 4), 0), _c((4, 5), 1)
+    np.testing.assert_allclose(
+        np.asarray(pc.matmul(a, b)), a @ b, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(pc.matmul(a.T, b, transpose_x=True)), a @ b,
+        rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(pc.matmul(a, b.T, transpose_y=True)), a @ b,
+        rtol=1e-5, atol=1e-5)
+    # batched
+    ba, bb = _c((2, 3, 4), 2), _c((2, 4, 5), 3)
+    np.testing.assert_allclose(
+        np.asarray(pc.matmul(ba, bb)), ba @ bb, rtol=1e-5, atol=1e-5)
+
+
+def test_kron_values():
+    a, b = _c((2, 3), 0), _c((3, 2), 1)
+    np.testing.assert_allclose(
+        np.asarray(pc.kron(a, b)), np.kron(a, b), rtol=1e-5, atol=1e-5)
+
+
+def test_reshape_and_transpose_move_values_untouched():
+    a = _c((2, 3, 4), 0)
+    np.testing.assert_array_equal(
+        np.asarray(pc.reshape(a, [4, 6])), a.reshape(4, 6))
+    # transpose permutes axes with NO conjugation
+    np.testing.assert_array_equal(
+        np.asarray(pc.transpose(a, [2, 0, 1])), np.transpose(a, (2, 0, 1)))
+
+
+def test_real_complex_promotion_matches_numpy():
+    a = _c((3, 3), 0)
+    r = np.random.RandomState(9).randn(3, 3).astype(np.float32)
+    got = np.asarray(pc.elementwise_mul(a, r))
+    assert got.dtype == np.complex64
+    np.testing.assert_allclose(got, a * r, rtol=1e-5, atol=1e-5)
+
+
+def test_is_complex():
+    assert pc.is_complex(_c((2,), 0))
+    assert not pc.is_complex(np.ones(3, np.float32))
+
+
+def test_varbase_in_varbase_out():
+    a, b = _c((3, 4), 0), _c((4, 5), 1)
+    with dygraph.guard():
+        va = dygraph.to_variable(a)
+        out = pc.matmul(va, b)
+        assert isinstance(out, dygraph.varbase.VarBase)
+        assert out.dtype == "complex64"
+        np.testing.assert_allclose(out.numpy(), a @ b,
+                                   rtol=1e-5, atol=1e-5)
+        t = pc.transpose(va, [1, 0])
+        np.testing.assert_array_equal(t.numpy(), a.T)
+    # raw arrays in -> raw array out (no tracer required)
+    assert not isinstance(pc.kron(a, b[:3, :2]),
+                          dygraph.varbase.VarBase)

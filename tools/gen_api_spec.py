@@ -41,6 +41,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.fluid.contrib.slim.nas",
     "paddle_tpu.fluid.contrib.slim.core",
     "paddle_tpu.incubate.checkpoint",
+    "paddle_tpu.incubate.complex",
     "paddle_tpu.io",
     "paddle_tpu.observability",
     "paddle_tpu.analysis",

@@ -1,0 +1,114 @@
+"""Static cost report for a serialized program from the command line.
+
+Usage::
+
+    python tools/program_cost.py path/to/__model__.json \
+        [--dynamic-dim 8] [--peak-flops 1.97e14] [--hbm-bw 8.19e11] \
+        [--top 10] [--json] [--no-ops] [--budget-ms 5.0]
+
+Runs the `paddle_tpu.analysis.perf` static cost model (FLOPs / bytes /
+roofline time per op on a parameterized chip) over the program and
+prints per-op-type rollups, or the full machine-readable report with
+--json.  Also accepts an inference-model DIRECTORY (as written by
+save_inference_model).
+
+Exit code: 1 when the model is unreadable or when --budget-ms is given
+and the estimated whole-program time exceeds it; 0 otherwise.
+
+JSON schema (``schema_version`` 1, pinned for CI consumers)::
+
+    {
+      "schema_version": 1,
+      "model": "<path>",
+      "chip": {"name": str, "peak_flops": float, "hbm_bw": float},
+      "dynamic_dim": int,
+      "totals": {"flops", "transcendentals", "bytes", "time_s",
+                 "arithmetic_intensity", "op_count"},
+      "by_op_type": [{"op_type", "count", "flops", "bytes", "time_s"}],
+      "ops": [{"block_idx", "op_idx", "op_type", "flops",
+               "transcendentals", "bytes", "time_s", "bound",
+               "provenance"}],          # omitted with --no-ops
+      "budget_ms": float | null,
+      "within_budget": bool | null
+    }
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(_HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(1, _HERE)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="program_cost",
+        description="static FLOPs/bytes/roofline-time report for a "
+                    "serialized program")
+    ap.add_argument("model", help="program JSON file or inference model dir")
+    ap.add_argument("--dynamic-dim", type=int, default=None,
+                    help="extent substituted for -1 dims (default 8)")
+    ap.add_argument("--peak-flops", type=float, default=None,
+                    help="chip peak FLOP/s (default: env/platform table, "
+                         "v5e fallback)")
+    ap.add_argument("--hbm-bw", type=float, default=None,
+                    help="chip HBM bytes/s (same resolution order)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows in the per-op-type table (text mode)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the full report as JSON")
+    ap.add_argument("--no-ops", action="store_true",
+                    help="omit the per-op array from --json output")
+    ap.add_argument("--budget-ms", type=float, default=None,
+                    help="exit 1 when the estimated program time "
+                         "exceeds this many milliseconds")
+    args = ap.parse_args(argv)
+
+    from program_lint import _load
+
+    from paddle_tpu.analysis import perf
+
+    try:
+        program, _feed, _fetch = _load(args.model)
+    except SystemExit:
+        raise
+    except Exception as e:
+        print("error: cannot load %r: %s" % (args.model, e),
+              file=sys.stderr)
+        return 1
+
+    chip = perf.ChipSpec.detect(peak_flops=args.peak_flops,
+                                hbm_bw=args.hbm_bw)
+    kw = {}
+    if args.dynamic_dim is not None:
+        kw["dynamic_dim"] = args.dynamic_dim
+    report = perf.program_cost(program, chip=chip, **kw)
+
+    over_budget = (args.budget_ms is not None
+                   and report.total_time_s * 1e3 > args.budget_ms)
+
+    if args.as_json:
+        d = report.to_dict(include_ops=not args.no_ops)
+        d["model"] = args.model
+        d["budget_ms"] = args.budget_ms
+        d["within_budget"] = (None if args.budget_ms is None
+                              else not over_budget)
+        print(json.dumps(d, indent=2))
+    else:
+        print(report.format(top=args.top))
+        if args.budget_ms is not None:
+            print("budget: est %.3f ms %s %.3f ms budget" % (
+                report.total_time_s * 1e3,
+                "EXCEEDS" if over_budget else "within", args.budget_ms))
+
+    return 1 if over_budget else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,17 +3,41 @@
 Usage::
 
     python tools/program_lint.py path/to/__model__.json \
-        [--feed x,y] [--fetch out] [--no-shapes] [--json] [--strict]
+        [--feed x,y] [--fetch out] [--no-shapes] [--json] [--strict] \
+        [--perf] [--budget-ms 5.0] [--max-pad-waste 0.4] \
+        [--dynamic-dim 8] [--peak-flops F] [--hbm-bw B]
 
 Runs the `paddle_tpu.analysis` ProgramVerifier (structural invariants +
-whole-program shape re-inference) and every registered lint rule over the
-program, printing structured diagnostics.  Exit code 1 when any
-error-severity finding exists (or any finding at all with --strict), 0
-otherwise — wire it into CI against exported `__model__.json` artifacts.
+whole-program shape re-inference) and the registered "program"-category
+lint rules over the program, printing structured diagnostics.  --perf
+additionally runs the performance rules (perf_rules.py:
+layout-transpose-hazard, dtype-promotion, unfused-epilogue,
+tiny-matmul, pad-waste, missed-donation).  --max-pad-waste N sets the
+pad-waste worst-case budget (implies --perf) and flips the exit code to
+1 when any pad-waste finding fires; --budget-ms M runs the static cost
+model (tools/program_cost.py's engine) and flips the exit code when the
+estimated program time exceeds the budget.
+
+Exit code 1 when any error-severity finding exists, any finding at all
+with --strict, a pad-waste finding under --max-pad-waste, or a blown
+--budget-ms; 0 otherwise — wire it into CI against exported
+`__model__.json` artifacts.
 
 Also accepts an inference-model DIRECTORY (as written by
 save_inference_model): the program and feed/fetch lists are taken from
 `__model__.json` + `__meta__.pkl`.
+
+JSON output (``--json``) is an object pinned by ``schema_version``
+(currently 1) so CI consumers can detect format changes::
+
+    {
+      "schema_version": 1,
+      "diagnostics": [{severity, code, message, block_idx, op_idx,
+                       op_type, var_names, provenance, pass_name}],
+      "summary": {"errors": int, "warnings": int, "total": int},
+      "budget": {"budget_ms": float, "estimated_ms": float,
+                 "within_budget": bool}          # only with --budget-ms
+    }
 """
 
 from __future__ import annotations
@@ -26,6 +50,8 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+SCHEMA_VERSION = 1
 
 
 def _load(path):
@@ -63,9 +89,26 @@ def main(argv=None):
     ap.add_argument("--no-shapes", action="store_true",
                     help="skip whole-program shape re-inference (faster)")
     ap.add_argument("--rules", default="",
-                    help="comma-separated lint rule subset (default: all)")
+                    help="comma-separated lint rule subset (default: all "
+                         "program-category rules; see --perf)")
+    ap.add_argument("--perf", action="store_true",
+                    help="also run the performance lint rules")
+    ap.add_argument("--budget-ms", type=float, default=None,
+                    help="run the static cost model; exit 1 when the "
+                         "estimated program time exceeds this")
+    ap.add_argument("--dynamic-dim", type=int, default=None,
+                    help="extent substituted for -1 dims in the budget "
+                         "cost model (default 8; mirrors program_cost)")
+    ap.add_argument("--peak-flops", type=float, default=None,
+                    help="chip peak FLOP/s for the budget cost model")
+    ap.add_argument("--hbm-bw", type=float, default=None,
+                    help="chip HBM bytes/s for the budget cost model")
+    ap.add_argument("--max-pad-waste", type=float, default=None,
+                    help="pad-waste worst-case budget in [0,1] (implies "
+                         "--perf); any pad-waste finding exits 1")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit diagnostics as a JSON array")
+                    help="emit diagnostics as a schema-versioned JSON "
+                         "object")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 on ANY finding, not just errors")
     args = ap.parse_args(argv)
@@ -77,20 +120,75 @@ def main(argv=None):
         feed_names = [s for s in args.feed.split(",") if s]
     if args.fetch:
         fetch_names = [s for s in args.fetch.split(",") if s]
-    rules = [s for s in args.rules.split(",") if s] or None
 
-    diags = analysis.analyze_program(
+    from paddle_tpu.analysis import lint_rules
+
+    run_perf = args.perf or args.max_pad_waste is not None
+    if args.rules:
+        rules = [s for s in args.rules.split(",") if s]
+        if run_perf:
+            # --perf composes with an explicit subset: the perf catalog
+            # still runs alongside the named rules
+            rules += [r for r in lint_rules(category="perf")
+                      if r not in rules]
+    else:
+        rules = lint_rules(category="program")
+        if run_perf:
+            rules += lint_rules(category="perf")
+    if args.max_pad_waste is not None:
+        from paddle_tpu.analysis.perf_rules import PadWasteRule
+
+        rules = [r for r in rules if r != "pad-waste"]
+        rules.append(PadWasteRule(threshold=args.max_pad_waste))
+
+    diags = analysis.verify_program(
         program, feed_names=feed_names, fetch_names=fetch_names,
-        check_shapes=not args.no_shapes, rules=rules)
+        check_shapes=not args.no_shapes)
+    diags.extend(analysis.lint_program(
+        program, feed_names=feed_names, fetch_names=fetch_names,
+        rules=rules))
+
+    budget = None
+    if args.budget_ms is not None:
+        from paddle_tpu.analysis import perf
+
+        chip = perf.ChipSpec.detect(peak_flops=args.peak_flops,
+                                    hbm_bw=args.hbm_bw)
+        kw = {}
+        if args.dynamic_dim is not None:
+            kw["dynamic_dim"] = args.dynamic_dim
+        est_ms = perf.program_cost(
+            program, chip=chip, **kw).total_time_s * 1e3
+        budget = {"budget_ms": args.budget_ms, "estimated_ms": est_ms,
+                  "within_budget": est_ms <= args.budget_ms}
 
     if args.as_json:
-        print(json.dumps([d.to_dict() for d in diags.sorted()], indent=2))
+        out = {
+            "schema_version": SCHEMA_VERSION,
+            "diagnostics": [d.to_dict() for d in diags.sorted()],
+            "summary": {"errors": len(diags.errors()),
+                        "warnings": len(diags.warnings()),
+                        "total": len(diags)},
+        }
+        if budget is not None:
+            out["budget"] = budget
+        print(json.dumps(out, indent=2))
     else:
         print(diags.format())
+        if budget is not None:
+            print("budget: est %.3f ms %s %.3f ms budget" % (
+                budget["estimated_ms"],
+                "within" if budget["within_budget"] else "EXCEEDS",
+                budget["budget_ms"]))
 
+    rc = 0
     if diags.has_errors or (args.strict and len(diags)):
-        return 1
-    return 0
+        rc = 1
+    if args.max_pad_waste is not None and diags.by_code("pad-waste"):
+        rc = 1
+    if budget is not None and not budget["within_budget"]:
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":
