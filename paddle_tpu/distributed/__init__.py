@@ -47,3 +47,14 @@ from .topology import (  # noqa: F401
     mesh_guard,
 )
 from .train_step import ShardedTrainStep  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy submodule (PEP 562): the elastic controller/reshard machinery
+    # is a supervisor/recovery-time tool — training workers that never
+    # reshape must not pay its import
+    if name == "elastic":
+        import importlib
+
+        return importlib.import_module(".elastic", __name__)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

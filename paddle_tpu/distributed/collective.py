@@ -75,7 +75,6 @@ def broadcast(x, root=0, axis="dp"):
     """cf. c_broadcast_op.cc: all participants end with root's value."""
     if not _axis_bound(axis):
         return x
-    n = jax.lax.axis_size(axis)
     # select root's shard on every participant: gather then index is the
     # simple formulation; GSPMD lowers this to a broadcast-from-root
     gathered = jax.lax.all_gather(x, axis)

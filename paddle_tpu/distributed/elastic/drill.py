@@ -1,0 +1,446 @@
+"""The kill/reshape/restart drill: one scriptable entry point for CI,
+operators and tests.
+
+A drill trains a small deterministic model data-parallel across W OS
+processes (rank = process, so a rank can die by real SIGKILL), commits
+cursor-exact checkpoints through `incubate.checkpoint`, injects faults
+from a `paddle_tpu.incubate.fault.FaultPlan`, recovers through
+`ElasticController` (drain -> fence -> reshape -> relaunch at the next
+world size in the schedule), and then PROVES the recovery:
+
+  * trajectory — a control gang launched at the new topology from the
+    exact checkpoint the recovery resumed from must produce the same
+    post-resume loss sequence and final parameters;
+  * data accounting — per epoch, the ids consumed by the committed
+    prefix plus the resumed remainder cover every sample exactly once
+    (no duplicates, no drops), reconstructed from the sampler's
+    deterministic permutation.
+
+The invariant that makes cross-topology comparison possible at all: the
+GLOBAL batch (per-rank batch x world size) is held fixed, every global
+step consumes one contiguous G-slice of the epoch permutation
+regardless of how many ranks partition it, and gradients are averaged
+over the global batch — so the parameter trajectory is a function of
+the data order alone, not of the topology.
+
+Gradient traffic rides `elastic.transport.FileTransport` (the CPU
+oracle cannot run multiprocess XLA computations; see transport.py) —
+the checkpoint, recovery and resharding paths under test are the same
+ones a TPU pod run exercises.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+
+import numpy as np
+
+DRILL_CONFIG_ENV = "PADDLE_TPU_DRILL_CONFIG"
+
+DEFAULT_CONFIG = {
+    "n_samples": 96,       # must be divisible by global_batch
+    "dim": 12,             # momentum ZeRO-shards over dim 0
+    "global_batch": 12,    # fixed across topologies (see module doc)
+    "epochs": 4,
+    "seed": 7,
+    "lr": 0.05,
+    "momentum": 0.9,
+    "save_every": 3,       # mid-epoch checkpoint cadence (local batches)
+    "async_save": True,
+    # generous by default: on a small shared CPU host several drill
+    # ranks compete for cores and a live worker's ping thread can starve
+    # for seconds — rank DEATH is detected instantly via process exit,
+    # so only hung-rank detection pays this latency
+    "hb_interval_s": 0.2,
+    "hb_timeout_s": 6.0,
+    "transport_timeout_s": 60.0,
+    "drain_grace_s": 20.0,
+    "retry_attempts": 0,
+    "retry_backoff_s": 0.1,
+    # recovery must land the final loss below this fraction of the
+    # analytic starting loss (w=0 -> mean(y^2))
+    "converge_factor": 0.35,
+}
+
+
+# ---------------------------------------------------------------------------
+# Worker (one rank)
+# ---------------------------------------------------------------------------
+
+
+def _make_dataset(cfg):
+    rs = np.random.RandomState(cfg["seed"])
+    X = rs.randn(cfg["n_samples"], cfg["dim"]).astype(np.float32)
+    w_true = rs.randn(cfg["dim"], 1).astype(np.float32)
+    y = X @ w_true
+    return [{"x": X[i], "y": y[i], "idx": np.int64(i)}
+            for i in range(cfg["n_samples"])]
+
+
+def _build_program(cfg):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main_p, startup):
+        x = layers.data("x", shape=[-1, cfg["dim"]], append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        w = layers.create_parameter([cfg["dim"], 1], name="w")
+        pred = layers.matmul(x, w)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        (gw,) = fluid.gradients(loss, [w])
+    return main_p, startup, loss, gw
+
+
+def run_worker():
+    """One drill rank: train, heartbeat, checkpoint, obey the fault
+    plan, drain on SIGTERM.  Reads the standard elastic env contract."""
+    import re
+
+    # one CPU device per rank process, pinned BEFORE jax initializes
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.distributed.elastic.controller import (
+        GENERATION_ENV,
+        WORKSPACE_ENV,
+        GenerationFence,
+        PreemptionHandler,
+    )
+    from paddle_tpu.distributed.elastic.reshard import (
+        ZeROShardCheckpoint,
+        zero_shard_slice,
+    )
+    from paddle_tpu.distributed.elastic.transport import FileTransport
+    from paddle_tpu.distributed.monitor import HeartBeatMonitor
+    from paddle_tpu.incubate.fault import FaultPlan, HeartbeatStaller
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.incubate.checkpoint.auto_checkpoint import TrainEpochRange
+    from paddle_tpu.io.resumable import ResumableDataLoader
+
+    ws = os.environ[WORKSPACE_ENV]
+    gen = int(os.getenv(GENERATION_ENV, "0"))
+    rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+    W = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    cfg = dict(DEFAULT_CONFIG)
+    cfg.update(json.loads(os.getenv(DRILL_CONFIG_ENV, "{}")))
+    n, G, D = cfg["n_samples"], cfg["global_batch"], cfg["dim"]
+    if G % W or n % G:
+        raise SystemExit(
+            "drill config needs world %d | global_batch %d | n %d "
+            "divisibility" % (W, G, n))
+    B = G // W
+    steps_per_epoch = n // G
+
+    plan = FaultPlan.from_env(rank=rank)
+    preempt = PreemptionHandler().install()
+    fence = GenerationFence(ws, generation=gen)
+    hb = HeartBeatMonitor(ws, rank, W, interval_s=cfg["hb_interval_s"],
+                          timeout_s=cfg["hb_timeout_s"])
+    hb.start()
+    staller = HeartbeatStaller(hb, plan.heartbeat_stall_step())
+    transport = FileTransport(ws, rank, W, generation=gen, fence=fence,
+                              timeout_s=cfg["transport_timeout_s"],
+                              hb_timeout_s=cfg["hb_timeout_s"])
+
+    dataset = _make_dataset(cfg)
+    loader = ResumableDataLoader(dataset, batch_size=B, shuffle=True,
+                                 seed=cfg["seed"] + 1, num_replicas=W,
+                                 rank=rank)
+    main_p, startup, loss, gw = _build_program(cfg)
+
+    sl = zero_shard_slice((D, 1), rank, W)
+    m0 = np.zeros((D, 1) if sl is None
+                  else (D // W, 1), np.float32)
+    zero_ckpt = ZeROShardCheckpoint({"momentum_w": m0},
+                                    {"momentum_w": (D, 1)},
+                                    trainer_id=rank, num_trainers=W)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses, consumed, resume_info = [], {}, {}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # deterministic start regardless of initializer defaults; a
+        # restore (below) overwrites this with the committed params
+        scope.set("w", np.zeros((D, 1), np.float32))
+        r = TrainEpochRange(
+            cfg["epochs"], checkpoint_dir=os.path.join(ws, "ckpt"),
+            main_program=main_p, scope=scope, fs=plan.wrap_fs(),
+            max_num_checkpoints=0, async_save=cfg["async_save"],
+            trainer_id=rank, num_trainers=W,
+            extra_serializables=[zero_ckpt], data_loaders=[loader],
+            retry_attempts=cfg["retry_attempts"],
+            retry_backoff_s=cfg["retry_backoff_s"], fence=fence)
+        resume_info = {
+            "resumed_from": r.restored_from,
+            "resumed_step": r.restored_step,
+            "resumed_no": getattr(r, "restored_no", None),
+            "start_epoch": r.start_epoch,
+            "restored_sampler": loader.state_dict()["sampler"],
+        }
+        drained = False
+        for epoch in r:
+            if preempt.should_stop:
+                # DRAINING at an epoch boundary: the generator already
+                # committed the epoch-end checkpoint — nothing to lose
+                drained = True
+                break
+            loader.set_epoch(epoch)
+            st0 = loader.state_dict()["sampler"]
+            consumed_before = (0 if st0["epoch"] != epoch
+                               else st0["start"] + st0["offset"] * B * W)
+            epoch_batches = (n - consumed_before) // (B * W)
+            bi = 0
+            for batch in loader:
+                gstep = (epoch * steps_per_epoch
+                         + consumed_before // G + bi)
+                plan.maybe_kill(gstep)
+                plan.maybe_hang(gstep, monitor=hb)
+                staller.step(gstep)
+                consumed.setdefault(str(epoch), []).extend(
+                    int(i) for i in batch["idx"])
+                g_local, l_local = exe.run(
+                    main_p, feed={"x": batch["x"], "y": batch["y"]},
+                    fetch_list=[gw, loss])
+                red = transport.allreduce_mean({
+                    "g": np.asarray(g_local),
+                    "loss": np.asarray(l_local, np.float32).reshape(1)})
+                g = red["g"]
+                losses.append(float(red["loss"][0]))
+                w_cur = np.asarray(scope.find_var("w"))
+                m = zero_ckpt.states["momentum_w"]
+                if sl is None:
+                    m = cfg["momentum"] * m + g
+                    w_new = w_cur - cfg["lr"] * m
+                else:
+                    # ZeRO-1: update only the owned momentum block and
+                    # its param slice, allgather the param blocks
+                    m = cfg["momentum"] * m + g[sl]
+                    w_blk = w_cur[sl] - cfg["lr"] * m
+                    blocks = transport.allgather({"w": w_blk})["w"]
+                    w_new = np.concatenate(blocks, axis=0)
+                zero_ckpt.states["momentum_w"] = m
+                scope.set("w", w_new)
+                bi += 1
+                saved_here = (cfg["save_every"] and bi < epoch_batches
+                              and bi % cfg["save_every"] == 0)
+                if saved_here:
+                    r.save_checkpoint(epoch, step=gstep)
+                if preempt.should_stop and saved_here:
+                    # DRAINING mid-epoch: every rank got SIGTERM and
+                    # every rank drains at the SAME cadence boundary, so
+                    # the collective commit just issued is consistent —
+                    # wait it out (force the final commit) and leave
+                    r.wait()
+                    drained = True
+                    break
+            if drained:
+                break
+    hb.complete()
+    hb.stop()
+    out = {
+        "rank": rank, "world_size": W, "generation": gen,
+        "losses": losses, "consumed": consumed, "drained": drained,
+        "final_w": np.asarray(scope.find_var("w")).reshape(-1).tolist(),
+        **resume_info,
+    }
+    with open(os.path.join(ws, "result_g%d_r%d.json" % (gen, rank)),
+              "w") as f:
+        json.dump(out, f)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Supervisor (the drill itself)
+# ---------------------------------------------------------------------------
+
+
+def _epoch_permutation(cfg, epoch):
+    """The sampler's global permutation for `epoch` — reconstructed so
+    the supervisor can audit consumption without trusting the workers."""
+    rng = np.random.default_rng(
+        np.random.SeedSequence([cfg["seed"] + 1, int(epoch)]))
+    idx = np.arange(cfg["n_samples"])
+    rng.shuffle(idx)
+    return idx
+
+
+def _read_results(ws, generation, world):
+    out = []
+    for r in range(world):
+        p = os.path.join(ws, "result_g%d_r%d.json" % (generation, r))
+        with open(p) as f:
+            out.append(json.load(f))
+    return out
+
+
+def _launch_gang(workspace, world_sizes, cfg, plan_events, log_dir,
+                 max_restarts=None):
+    """Run an ElasticController'd gang over the world-size schedule;
+    returns (report, controller)."""
+    from paddle_tpu.incubate.fault import FaultPlan
+    from .controller import ElasticController
+
+    schedule = [int(w) for w in world_sizes]
+    env = FaultPlan(plan_events).to_env({
+        DRILL_CONFIG_ENV: json.dumps(cfg),
+        "JAX_PLATFORMS": "cpu",
+    })
+
+    def worker_argv(rank, world, generation):
+        return [sys.executable, "-m", "paddle_tpu.distributed.elastic.drill"]
+
+    def policy(generation, prev_world, event):
+        return schedule[min(generation, len(schedule) - 1)]
+
+    ctrl = ElasticController(
+        workspace, worker_argv, schedule[0], world_size_policy=policy,
+        max_restarts=(len(schedule) + 1 if max_restarts is None
+                      else max_restarts),
+        backoff_s=0.2, max_backoff_s=2.0,
+        heartbeat_interval_s=cfg["hb_interval_s"],
+        heartbeat_timeout_s=cfg["hb_timeout_s"],
+        drain_grace_s=cfg["drain_grace_s"], env=env, log_dir=log_dir)
+    report = ctrl.run()
+    return report, ctrl
+
+
+def run_drill(workspace, world_sizes=(3, 2), kill_rank=1, kill_step=12,
+              config=None, fault_events=None, control=True):
+    """The full drill: faulted run over `world_sizes`, then the control
+    run and the data-accounting audit.  Returns a report dict with
+    `passed` (CI gates on it); raises nothing on drill failure — the
+    report carries the reasons."""
+    cfg = dict(DEFAULT_CONFIG)
+    cfg.update(config or {})
+    ws = os.path.abspath(workspace)
+    os.makedirs(ws, exist_ok=True)
+    events = list(fault_events or [])
+    if kill_rank is not None:
+        events.append({"kind": "kill", "rank": int(kill_rank),
+                       "step": int(kill_step)})
+    report = {"workspace": ws, "world_sizes": list(world_sizes),
+              "config": cfg, "fault_events": events, "checks": {},
+              "passed": False}
+
+    run_report, _ctrl = _launch_gang(
+        ws, world_sizes, cfg, events, os.path.join(ws, "logs"))
+    report["controller"] = run_report
+    if run_report["state"] != "DONE":
+        report["checks"]["completed"] = False
+        return report
+    report["checks"]["completed"] = True
+    final_gen = run_report["generation"]
+    final_world = run_report["world_size"]
+    results = _read_results(ws, final_gen, final_world)
+    report["checks"]["recovered"] = final_gen > 0 if events else True
+    report["checks"]["resumed_from_checkpoint"] = all(
+        res["resumed_from"] >= 0 for res in results) if final_gen else True
+
+    # ---- data accounting: no sample duplicated, none dropped ----------
+    dup_drop_ok = True
+    detail = {}
+    perm_cache = {}
+    for res in results:
+        start_epoch = res["start_epoch"]
+        sampler = res["restored_sampler"]
+        for es, ids in sorted(res["consumed"].items(), key=lambda kv:
+                              int(kv[0])):
+            e = int(es)
+            perm = perm_cache.setdefault(
+                e, list(_epoch_permutation(cfg, e)))
+            start = 0
+            if e == start_epoch and sampler.get("epoch") == e:
+                # committed prefix = suffix cut + lockstep batches
+                start = (int(sampler.get("start", 0))
+                         + int(sampler.get("offset", 0))
+                         * int(sampler.get("batch_size") or 0)
+                         * int(sampler.get("nranks", 1)))
+            expected = set(int(i) for i in perm[start:])
+            got = detail.setdefault(e, {"expected": expected, "got": []})
+            got["got"].extend(ids)
+    for e, d in detail.items():
+        got = d["got"]
+        if len(got) != len(set(got)) or set(got) != d["expected"]:
+            dup_drop_ok = False
+            report["checks"].setdefault("epoch_errors", {})[e] = {
+                "dupes": len(got) - len(set(got)),
+                "missing": len(d["expected"] - set(got)),
+                "extra": len(set(got) - d["expected"]),
+            }
+    report["checks"]["no_dup_no_drop"] = dup_drop_ok
+
+    # ---- control run: same checkpoint, new topology, no faults --------
+    traj_ok = True
+    if control and final_gen > 0:
+        resumed_no = results[0].get("resumed_no")
+        cws = os.path.join(ws, "control")
+        shutil.rmtree(cws, ignore_errors=True)
+        os.makedirs(cws)
+        # copy EXACTLY the checkpoint the recovery resumed from
+        src_root = os.path.join(ws, "ckpt")
+        acp_dirs = [d for d in os.listdir(src_root)
+                    if d.startswith("acp_")] if os.path.isdir(src_root) \
+            else []
+        for acp in acp_dirs:
+            src = os.path.join(src_root, acp, "checkpoint_%s" % resumed_no)
+            if os.path.isdir(src):
+                dst = os.path.join(cws, "ckpt", acp,
+                                   "checkpoint_%s" % resumed_no)
+                shutil.copytree(src, dst)
+        ctl_report, _ = _launch_gang(
+            cws, (final_world,), cfg, [], os.path.join(cws, "logs"))
+        report["control"] = ctl_report
+        if ctl_report["state"] != "DONE":
+            traj_ok = False
+        else:
+            ctl_results = _read_results(cws, 0, final_world)
+            a = np.asarray(results[0]["losses"])
+            b = np.asarray(ctl_results[0]["losses"])
+            wa = np.asarray(results[0]["final_w"])
+            wb = np.asarray(ctl_results[0]["final_w"])
+            traj_ok = (a.shape == b.shape
+                       and np.allclose(a, b, atol=1e-5)
+                       and np.allclose(wa, wb, atol=1e-5))
+            report["checks"]["control_loss_maxdiff"] = (
+                float(np.abs(a - b).max()) if a.shape == b.shape else None)
+            report["checks"]["control_w_maxdiff"] = float(
+                np.abs(wa - wb).max()) if wa.shape == wb.shape else None
+    report["checks"]["trajectory_matches_control"] = traj_ok
+
+    # ---- converged ----------------------------------------------------
+    # baseline = the analytic starting loss (w=0 -> mean(y^2)); the
+    # recovered run's final loss must be well below it even though the
+    # faulted generation's own loss log died with its processes
+    base = float(np.mean(
+        np.asarray([d["y"] for d in _make_dataset(cfg)]) ** 2))
+    losses = results[0]["losses"]
+    converged = bool(losses) and losses[-1] < cfg["converge_factor"] * base
+    report["checks"]["converged"] = converged
+    report["checks"]["final_loss"] = losses[-1] if losses else None
+    report["checks"]["initial_loss"] = base
+
+    report["passed"] = all([
+        report["checks"]["completed"],
+        # a drill with faults that never fired proved nothing: recovery
+        # must actually have happened for the drill to pass
+        report["checks"]["recovered"],
+        report["checks"]["resumed_from_checkpoint"],
+        dup_drop_ok, traj_ok, converged,
+    ])
+    return report
+
+
+if __name__ == "__main__":
+    sys.exit(run_worker())

@@ -1,0 +1,115 @@
+"""The save-time topology record that makes resharding deterministic.
+
+Every rank-dependent layout in a checkpoint (ZeRO blocks, host-embedding
+row shards, sampler cursors) is a pure function of (global state, rank,
+world size) — so ONE number plus per-component layout fragments is
+enough for any future group to re-partition the state without guessing.
+The manifest rides inside the checkpoint's `meta.json` (atomic with the
+commit: a checkpoint either has its topology or does not exist).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+MANIFEST_KEY = "topology"
+SCHEMA_VERSION = 1
+
+
+class TopologyManifest:
+    """What the save-time group looked like.
+
+    Fields:
+      * world_size — ranks in the committing group
+      * generation — elastic generation that committed (fencing audit)
+      * zero       — {state_name: {full_shape, dim, nranks}} from
+                     `ZeROShardCheckpoint.layout()`
+      * host_embeddings — {table: {num_rows, dim, nranks}} from
+                     `HostEmbeddingCheckpoint.layout()`
+      * loaders    — {name: {nranks, batch_size}} for attached cursors
+      * global_batch — world-size-invariant global batch (per-rank
+                     batch * world_size); a resumed group can assert it
+                     kept the trajectory-preserving invariant
+    """
+
+    def __init__(self, world_size, generation=0, zero=None,
+                 host_embeddings=None, loaders=None, global_batch=None):
+        self.world_size = int(world_size)
+        self.generation = int(generation)
+        self.zero = dict(zero or {})
+        self.host_embeddings = dict(host_embeddings or {})
+        self.loaders = dict(loaders or {})
+        self.global_batch = global_batch
+
+    @classmethod
+    def from_serializables(cls, world_size, serializables, generation=0,
+                           global_batch=None):
+        """Collect the layout fragments of every serializable that can
+        describe one (ZeROShardCheckpoint / HostEmbeddingCheckpoint /
+        DataLoaderCheckpoint)."""
+        zero, hostemb, loaders = {}, {}, {}
+        for s in serializables:
+            layout = getattr(s, "layout", None)
+            frag = layout() if callable(layout) else None
+            if not isinstance(frag, dict):
+                if type(s).__name__ == "DataLoaderCheckpoint":
+                    sampler = getattr(
+                        getattr(s, "_loader", None), "batch_sampler", None)
+                    loaders[getattr(s, "_name", "dataloader")] = {
+                        "nranks": getattr(sampler, "nranks", world_size),
+                        "batch_size": getattr(sampler, "batch_size", None),
+                    }
+                continue
+            if type(s).__name__ == "ZeROShardCheckpoint":
+                zero.update(frag)
+            elif type(s).__name__ == "HostEmbeddingCheckpoint":
+                hostemb.update(frag)
+        return cls(world_size, generation=generation, zero=zero,
+                   host_embeddings=hostemb, loaders=loaders,
+                   global_batch=global_batch)
+
+    # -- (de)serialization ------------------------------------------------
+    def to_meta(self):
+        """The fragment merged into the checkpoint's extra_meta."""
+        return {MANIFEST_KEY: {
+            "schema_version": SCHEMA_VERSION,
+            "world_size": self.world_size,
+            "generation": self.generation,
+            "zero": self.zero,
+            "host_embeddings": self.host_embeddings,
+            "loaders": self.loaders,
+            "global_batch": self.global_batch,
+        }}
+
+    @classmethod
+    def from_meta(cls, meta):
+        """Manifest recorded in a checkpoint meta dict, or None (older
+        checkpoints carry no topology — resharding then relies on the
+        per-shard-file metadata alone)."""
+        frag = (meta or {}).get(MANIFEST_KEY)
+        if not isinstance(frag, dict):
+            return None
+        return cls(
+            frag.get("world_size", 1),
+            generation=frag.get("generation", 0),
+            zero=frag.get("zero"),
+            host_embeddings=frag.get("host_embeddings"),
+            loaders=frag.get("loaders"),
+            global_batch=frag.get("global_batch"),
+        )
+
+    @classmethod
+    def read(cls, checkpoint_dir):
+        """Manifest of a committed checkpoint_<n> directory."""
+        meta_path = os.path.join(checkpoint_dir, "meta.json")
+        if not os.path.exists(meta_path):
+            return None
+        with open(meta_path) as f:
+            return cls.from_meta(json.load(f))
+
+    def __repr__(self):
+        return ("TopologyManifest(world_size=%d, generation=%d, zero=%d "
+                "states, host_embeddings=%d tables, loaders=%d)"
+                % (self.world_size, self.generation, len(self.zero),
+                   len(self.host_embeddings), len(self.loaders)))

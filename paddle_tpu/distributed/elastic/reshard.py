@@ -1,0 +1,338 @@
+"""Checkpoint resharding on restore: save on N ranks, resume on M.
+
+Three state families carry rank-dependent layout in this repo's
+checkpoints, and each one's layout is a pure function of
+(global state, rank, nranks) — which is what makes deterministic
+re-partitioning possible at all:
+
+  * ZeRO optimizer shards (`distributed/sharding.py`): a state tensor is
+    block-sharded along its first nranks-divisible dim; rank r owns the
+    r-th contiguous block.
+  * Host-embedding tables (`fluid/host_embedding.py`): global row g
+    lives on rank g % nranks at compact position g // nranks.
+  * Sampler cursors (`paddle_tpu.io.ShardedBatchSampler`): the epoch
+    permutation depends only on (seed, epoch); rank r consumes the
+    strided slice perm[r::nranks], so after every rank consumed o
+    lockstep batches of size B the consumed set is EXACTLY the prefix
+    perm[:o*B*nranks].  A resharded resume therefore re-slices the
+    remaining suffix across the new group — no sample duplicated, none
+    dropped.
+
+Every function here is pure array/dict math so the recovery path is
+unit-testable without processes; `ZeROShardCheckpoint` adapts the ZeRO
+case to the `incubate.checkpoint` commit/restore protocol with
+reshard-on-restore built in.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+import numpy as np
+
+from ...incubate.checkpoint.checkpoint_saver import SerializableBase
+from ..sharding import _first_dp_divisible_dim
+
+__all__ = [
+    "reshard_zero_shards",
+    "zero_shard_slice",
+    "reshard_host_embedding_rows",
+    "reshard_sampler_states",
+    "ZeROShardCheckpoint",
+]
+
+
+class ReshardError(ValueError):
+    """The saved shards cannot be deterministically re-partitioned."""
+
+
+def rank_shard_paths(path, prefix, name):
+    """{old_rank: file path} for every `<prefix>_<name>_rank<r>.npz` in
+    a committed checkpoint dir — the one gather used by every
+    reshard-on-restore fallback (ZeRO states, host-embedding tables)."""
+    pat = re.compile(r"^%s_%s_rank(\d+)\.npz$"
+                     % (re.escape(prefix), re.escape(name)))
+    out = {}
+    for fp in glob.glob(os.path.join(
+            path, "%s_%s_rank*.npz" % (prefix, glob.escape(name)))):
+        m = pat.match(os.path.basename(fp))
+        if m:
+            out[int(m.group(1))] = fp
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ZeRO optimizer shards
+# ---------------------------------------------------------------------------
+
+
+def zero_shard_dim(shape, nranks):
+    """The dim ZeRO shards `shape` over for `nranks` (None: replicated)
+    — single-sourced with `sharding.zero_shard_state`'s placement."""
+    return _first_dp_divisible_dim(tuple(shape), int(nranks))
+
+
+def zero_shard_slice(shape, rank, nranks):
+    """The index slice of the full tensor that rank `rank` owns, or None
+    when the tensor is replicated at this world size."""
+    dim = zero_shard_dim(shape, nranks)
+    if dim is None:
+        return None
+    block = shape[dim] // int(nranks)
+    sl = [slice(None)] * len(shape)
+    sl[dim] = slice(rank * block, (rank + 1) * block)
+    return tuple(sl)
+
+
+def reshard_zero_shards(shards, full_shape, old_nranks, new_nranks):
+    """Re-slice one ZeRO-sharded tensor from N to M rank blocks.
+
+    `shards`: {old_rank: ndarray} — every old rank's block (a replicated
+    save passes {0: full_array} with old layout dim None).  Returns the
+    list of M new per-rank arrays (each the new rank's block, or the
+    full tensor for every rank when `full_shape` is not M-divisible —
+    the same fall-back-to-replicated rule `zero_shard_state` applies).
+    """
+    full_shape = tuple(int(s) for s in full_shape)
+    old_dim = zero_shard_dim(full_shape, old_nranks)
+    if old_dim is None:
+        if 0 not in shards:
+            raise ReshardError(
+                "replicated ZeRO state needs the rank-0 copy; have ranks %s"
+                % sorted(shards))
+        full = np.asarray(shards[0])
+    else:
+        missing = [r for r in range(old_nranks) if r not in shards]
+        if missing:
+            raise ReshardError(
+                "cannot reshard %s-sharded state: missing old-rank shards "
+                "%s of %d" % (full_shape, missing, old_nranks))
+        full = np.concatenate(
+            [np.asarray(shards[r]) for r in range(old_nranks)], axis=old_dim)
+    if full.shape != full_shape:
+        raise ReshardError(
+            "reassembled ZeRO state has shape %s, manifest says %s"
+            % (full.shape, full_shape))
+    new_dim = zero_shard_dim(full_shape, new_nranks)
+    if new_dim is None:
+        return [full.copy() for _ in range(new_nranks)]
+    return list(np.split(full, new_nranks, axis=new_dim))
+
+
+# ---------------------------------------------------------------------------
+# Host-embedding table shards
+# ---------------------------------------------------------------------------
+
+
+def reshard_host_embedding_rows(shards, new_rank, new_nranks,
+                                old_nranks=None):
+    """Rows (and optimizer accum) the NEW rank owns, assembled from the
+    old per-rank shards.
+
+    `shards`: {old_rank: (rows, accum)} covering ALL old ranks; the old
+    layout (row g at old rank g % N, position g // N) is re-indexed into
+    the new one (row g at new rank g % M, position g // M).  Returns
+    (rows, accum) for `new_rank` — accum is a zero-row array when no old
+    shard carried one.
+
+    Pass `old_nranks` whenever the save-time world size is recorded
+    (the per-shard npz meta carries it): inferring it from len(shards)
+    would let a shard set missing its HIGHEST-ranked files reshard
+    silently into interleave-scrambled rows instead of raising."""
+    old_n = len(shards) if old_nranks is None else int(old_nranks)
+    if sorted(shards) != list(range(old_n)):
+        raise ReshardError(
+            "host-embedding reshard needs every one of the old group's "
+            "%d shards; have ranks %s" % (old_n, sorted(shards)))
+    num_rows = sum(np.asarray(rows).shape[0] for rows, _ in shards.values())
+    rows0 = np.asarray(shards[0][0])
+    has_accum = all(np.asarray(a).size for _r, a in shards.values())
+    my_global = np.arange(int(new_rank), num_rows, int(new_nranks))
+    out_rows = np.empty((len(my_global),) + rows0.shape[1:], rows0.dtype)
+    out_accum = (np.empty((len(my_global),) + rows0.shape[1:], np.float32)
+                 if has_accum else np.zeros(0, np.float32))
+    # one fancy-indexed gather per OLD rank (tables are large by
+    # definition and the whole gang waits on this restore)
+    for r in range(old_n):
+        mask = my_global % old_n == r
+        src_idx = my_global[mask] // old_n
+        out_rows[mask] = np.asarray(shards[r][0])[src_idx]
+        if has_accum:
+            out_accum[mask] = np.asarray(shards[r][1])[src_idx]
+    return out_rows, out_accum
+
+
+# ---------------------------------------------------------------------------
+# Sampler cursors
+# ---------------------------------------------------------------------------
+
+
+def reshard_sampler_states(states, new_nranks):
+    """N old-rank `ShardedBatchSampler.state_dict()`s -> M new ones.
+
+    Correctness rests on the lockstep-prefix property (module
+    docstring): all old offsets must agree — they do for any state
+    committed through the atomic multi-rank checkpoint barrier; a
+    mismatch means the states come from different commits and resuming
+    from them could replay or drop samples, so it raises instead.
+
+    The new states position every new rank at the same GLOBAL sample
+    index via the `start` field (the suffix cut the sampler re-shards),
+    with offset 0 inside the re-sliced remainder."""
+    states = list(states)
+    if not states:
+        raise ReshardError("no sampler states to reshard")
+    old_n = int(states[0].get("nranks", 1))
+    if len(states) != old_n:
+        raise ReshardError(
+            "need all %d old-rank sampler states, got %d"
+            % (old_n, len(states)))
+    by_rank = {}
+    for s in states:
+        by_rank[int(s.get("rank", 0))] = s
+    if sorted(by_rank) != list(range(old_n)):
+        raise ReshardError(
+            "sampler states do not cover ranks 0..%d: %s"
+            % (old_n - 1, sorted(by_rank)))
+    ref = by_rank[0]
+    for key in ("seed", "epoch", "offset", "start", "batch_size"):
+        vals = {s.get(key) for s in by_rank.values()}
+        if len(vals) != 1:
+            raise ReshardError(
+                "old-rank sampler states disagree on %r (%s) — they are "
+                "not from one atomic commit; refusing to reshard (a guess "
+                "would replay or drop samples)" % (key, sorted(
+                    str(v) for v in vals)))
+    batch_size = ref.get("batch_size")
+    if batch_size is None:
+        raise ReshardError(
+            "sampler states carry no batch_size (saved before elastic "
+            "support); cannot compute the consumed prefix")
+    consumed = (int(ref.get("start", 0))
+                + int(ref["offset"]) * int(batch_size) * old_n)
+    return [
+        {
+            "epoch": int(ref["epoch"]),
+            "offset": 0,
+            "start": consumed,
+            "seed": int(ref["seed"]),
+            "nranks": int(new_nranks),
+            "rank": r,
+        }
+        for r in range(int(new_nranks))
+    ]
+
+
+# ---------------------------------------------------------------------------
+# ZeRO shard <-> checkpoint protocol adapter
+# ---------------------------------------------------------------------------
+
+
+class ZeROShardCheckpoint(SerializableBase):
+    """Per-rank ZeRO optimizer-state shards inside an atomic checkpoint
+    commit, resharded on restore when the world size changed.
+
+    `states`: {name: array} — THIS rank's block of each state tensor
+    (shape = the block, not the full tensor), with `full_shapes[name]`
+    recording the unsharded shape.  Serialization writes
+    `zero_<name>_rank<r>.npz` per state; `deserialize` loads this rank's
+    file when the saved world size matches, otherwise reads EVERY rank's
+    shard files and re-slices through `reshard_zero_shards` (the layout
+    metadata rides in each file, so no side channel is needed).
+
+    Set/read blocks through `.states`; `restored_nranks` reports the
+    world size the loaded checkpoint was saved at (None before any
+    restore)."""
+
+    def __init__(self, states, full_shapes, trainer_id=None,
+                 num_trainers=None):
+        if trainer_id is None:
+            trainer_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        if num_trainers is None:
+            num_trainers = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self.states = dict(states)
+        self.full_shapes = {n: tuple(int(x) for x in s)
+                            for n, s in full_shapes.items()}
+        self._rank = int(trainer_id)
+        self._nranks = int(num_trainers)
+        self.restored_nranks = None
+
+    def _fname(self, name, rank=None):
+        return "zero_%s_rank%d.npz" % (
+            name, self._rank if rank is None else rank)
+
+    def snapshot(self):
+        self._snap = {n: np.asarray(a).copy()
+                      for n, a in self.states.items()}
+
+    def serialize(self, path):
+        if not hasattr(self, "_snap"):
+            self.snapshot()
+        names = []
+        for n, a in self._snap.items():
+            fname = self._fname(n)
+            np.savez(os.path.join(path, fname), block=a,
+                     meta=np.asarray([self._rank, self._nranks]),
+                     full_shape=np.asarray(self.full_shapes[n]))
+            names.append(fname)
+        return names
+
+    def layout(self):
+        """Manifest fragment describing this save's ZeRO layout."""
+        return {
+            n: {"full_shape": list(self.full_shapes[n]),
+                "dim": zero_shard_dim(self.full_shapes[n], self._nranks),
+                "nranks": self._nranks}
+            for n in self.states
+        }
+
+    def deserialize(self, path):
+        for name in list(self.states):
+            own = os.path.join(path, self._fname(name))
+            saved_nranks = None
+            if os.path.exists(own):
+                with np.load(own) as d:
+                    saved_nranks = int(d["meta"][1])
+                    if saved_nranks == self._nranks:
+                        self.states[name] = d["block"]
+                        self.restored_nranks = saved_nranks
+                        continue
+            # world size changed (or this rank is new): gather every old
+            # rank's shard of this state and re-slice
+            shards = {}
+            full_shape = self.full_shapes[name]
+            for old_rank, fp in rank_shard_paths(path, "zero",
+                                                 name).items():
+                with np.load(fp) as d:
+                    shards[old_rank] = d["block"]
+                    saved_nranks = int(d["meta"][1])
+                    full_shape = tuple(int(x) for x in d["full_shape"])
+            if not shards:
+                raise ReshardError(
+                    "checkpoint carries no ZeRO shards for state %r" % name)
+            print(
+                "ZeROShardCheckpoint[%s]: resharding %d-rank shards for "
+                "world size %d" % (name, saved_nranks, self._nranks),
+                file=sys.stderr)
+            blocks = reshard_zero_shards(
+                shards, full_shape, saved_nranks, self._nranks)
+            self.states[name] = blocks[self._rank]
+            self.restored_nranks = saved_nranks
+        return self.states
+
+
+def read_sampler_states(path, name="dataloader0"):
+    """All `<name>_rank<r>.json` loader-cursor files inside a committed
+    checkpoint dir -> [sampler state dict] (the input of
+    `reshard_sampler_states`)."""
+    out = []
+    for fp in sorted(glob.glob(os.path.join(
+            path, "%s_rank*.json" % glob.escape(name)))):
+        with open(fp) as f:
+            state = json.load(f)
+        out.append(state.get("sampler", state))
+    return out

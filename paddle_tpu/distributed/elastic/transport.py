@@ -1,0 +1,135 @@
+"""File-based drill collectives (CPU-oracle fallback transport).
+
+The repo's real data path is XLA collectives over ICI/DCN — but the CPU
+oracle backend used by tier-1 tests cannot run multiprocess XLA
+computations at all ("Multiprocess computations aren't implemented on
+the CPU backend"), and elasticity drills are exactly the tests that
+need several OS processes so a rank can be SIGKILLed.  This transport
+carries the drill's tiny gradient traffic over the shared workspace —
+the same medium the heartbeat/barrier monitors and checkpoints already
+use — with deterministic numerics (fixed-order reduction) so
+kill/reshape/restart trajectories are bit-comparable.
+
+NOT a production transport: O(world²) reads per round and microsecond
+arrays only.  Production traffic rides XLA; this rides the drill.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+__all__ = ["FileTransport", "TransportTimeout"]
+
+
+class TransportTimeout(RuntimeError):
+    """A peer never produced its contribution — it is dead or hung; the
+    caller should exit nonzero and let the elastic controller recover."""
+
+
+class FileTransport:
+    """Rendezvous-free numpy collectives over a shared directory.
+
+    Rounds are identified by a monotonically increasing step counter
+    plus the elastic generation, so a stale rank from a superseded group
+    can never contribute into (or consume from) the new group's round —
+    the file-level twin of the checkpoint generation fence."""
+
+    def __init__(self, workspace, rank, nranks, generation=0,
+                 timeout_s=60.0, poll_s=0.01, fence=None,
+                 hb_timeout_s=None):
+        self._dir = os.path.join(workspace, "transport",
+                                 "gen_%d" % int(generation))
+        os.makedirs(self._dir, exist_ok=True)
+        self._hb_dir = os.path.join(workspace, "heartbeats")
+        self.rank = int(rank)
+        self.nranks = int(nranks)
+        self.generation = int(generation)
+        self._timeout = float(timeout_s)
+        self._poll = float(poll_s)
+        self._fence = fence
+        # optional fast path: a missing peer whose heartbeat file went
+        # stale is declared dead immediately instead of at full timeout
+        self._hb_timeout = hb_timeout_s and float(hb_timeout_s)
+        self._round = 0
+
+    def _path(self, tag, rank):
+        return os.path.join(self._dir, "%s_r%d.npz" % (tag, rank))
+
+    def _publish(self, tag, arrays):
+        # tmp must keep the .npz suffix (np.savez appends it otherwise)
+        tmp = self._path(tag, self.rank) + ".tmp.npz"
+        np.savez(tmp, **arrays)
+        os.replace(tmp, self._path(tag, self.rank))
+
+    def _collect(self, tag):
+        """Every rank's contribution for this round, rank order."""
+        deadline = time.time() + self._timeout
+        out = [None] * self.nranks
+        while True:
+            if self._fence is not None:
+                self._fence.check()   # stale group: stop contributing
+            for r in range(self.nranks):
+                if out[r] is not None:
+                    continue
+                p = self._path(tag, r)
+                if os.path.exists(p):
+                    try:
+                        with np.load(p) as d:
+                            out[r] = {k: d[k] for k in d.files}
+                    except (OSError, ValueError):
+                        continue     # replaced mid-read: next poll
+            if all(o is not None for o in out):
+                return out
+            missing = [r for r, o in enumerate(out) if o is None]
+            if self._hb_timeout:
+                now = time.time()
+                dead = []
+                for r in missing:
+                    hb = os.path.join(self._hb_dir, "hb_%d" % r)
+                    try:
+                        if now - os.path.getmtime(hb) > self._hb_timeout:
+                            dead.append(r)
+                    except OSError:
+                        pass   # never pinged yet: give it the timeout
+                if dead:
+                    raise TransportTimeout(
+                        "round %r: ranks %s stopped heartbeating — dead "
+                        "peer, aborting the collective" % (tag, dead))
+            if time.time() > deadline:
+                raise TransportTimeout(
+                    "round %r: ranks %s never contributed within %.0fs "
+                    "(dead or hung peer)" % (tag, missing, self._timeout))
+            time.sleep(self._poll)
+
+    # -- collectives ------------------------------------------------------
+    def allreduce_mean(self, arrays):
+        """{name: array} -> {name: mean across ranks} — fixed reduction
+        order (rank 0..n-1) so every rank computes bit-identical means
+        and the drill's trajectory is world-size-reproducible."""
+        self._round += 1
+        tag = "ar_%d" % self._round
+        self._publish(tag, arrays)
+        contribs = self._collect(tag)
+        out = {}
+        for name in arrays:
+            acc = np.zeros_like(np.asarray(contribs[0][name], np.float64))
+            for r in range(self.nranks):
+                acc = acc + np.asarray(contribs[r][name], np.float64)
+            out[name] = (acc / self.nranks).astype(
+                np.asarray(arrays[name]).dtype)
+        return out
+
+    def allgather(self, arrays):
+        """{name: array} -> {name: [every rank's array, rank order]}."""
+        self._round += 1
+        tag = "ag_%d" % self._round
+        self._publish(tag, arrays)
+        contribs = self._collect(tag)
+        return {
+            name: [np.asarray(contribs[r][name])
+                   for r in range(self.nranks)]
+            for name in arrays
+        }

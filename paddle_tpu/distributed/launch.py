@@ -29,6 +29,23 @@ def _parse_args(argv=None):
     p.add_argument("--started_port", type=int, default=6170)
     p.add_argument("--nproc_per_node", type=int, default=1)
     p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--elastic_restarts", type=int, default=0,
+                   help="> 0: supervise the gang with the elastic "
+                        "controller — on a rank loss, drain, bump the "
+                        "generation fence and relaunch (up to this many "
+                        "times) instead of failing the job")
+    p.add_argument("--elastic_workspace", type=str, default=None,
+                   help="shared dir for heartbeats/fence/checkpoints "
+                        "(required with --elastic_restarts)")
+    p.add_argument("--heartbeat_timeout", type=float, default=30.0,
+                   help="seconds of heartbeat silence before a rank "
+                        "counts as lost (elastic mode; only ranks that "
+                        "run a distributed.monitor.HeartBeatMonitor are "
+                        "watched this way — others by process exit)")
+    p.add_argument("--startup_timeout", type=float, default=300.0,
+                   help="elastic mode: seconds a rank may stay "
+                        "heartbeat-silent at startup when its peers DO "
+                        "heartbeat, before it counts as wedged")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -43,8 +60,58 @@ def get_cluster_endpoints(node_ips, started_port, nproc_per_node):
     return eps
 
 
+def launch_elastic(args):
+    """Supervised gang: the reference launcher's fail-fast loop becomes
+    the elastic controller's detect -> drain -> fence -> relaunch cycle
+    (single-node; world size stays `--nproc_per_node`).  Every worker
+    sees the usual PADDLE_* env contract plus PADDLE_ELASTIC_GENERATION
+    and PADDLE_ELASTIC_WORKSPACE for fencing and drain commits."""
+    from .elastic.controller import ElasticController
+
+    if not args.elastic_workspace:
+        raise SystemExit(
+            "--elastic_restarts needs --elastic_workspace (the shared "
+            "dir heartbeats and the generation fence live in)")
+    if len(args.cluster_node_ips.split(",")) > 1:
+        # two per-node controllers over one workspace would collide on
+        # rank ids, heartbeats and the generation fence — refuse instead
+        # of silently supervising half a cluster
+        raise SystemExit(
+            "--elastic_restarts is single-node for now "
+            "(--cluster_node_ips lists %s); run ONE elastic controller "
+            "per job" % args.cluster_node_ips)
+    nproc = args.nproc_per_node
+
+    def worker_argv(rank, world, generation):
+        return ([sys.executable, "-u", args.training_script]
+                + args.training_script_args)
+
+    def worker_env(rank, world, generation):
+        # fresh ports per generation: the old gang's sockets may still
+        # be in TIME_WAIT when the replacement comes up
+        port = args.started_port + generation * world
+        endpoints = get_cluster_endpoints([args.node_ip], port, world)
+        return {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        }
+
+    ctrl = ElasticController(
+        args.elastic_workspace, worker_argv, nproc,
+        max_restarts=args.elastic_restarts,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        startup_timeout_s=args.startup_timeout,
+        env=worker_env, log_dir=args.log_dir)
+    report = ctrl.run()
+    return 0 if report["state"] == "DONE" else 1
+
+
 def launch(args=None):
     args = args or _parse_args()
+    if args.elastic_restarts > 0:
+        return launch_elastic(args)
     node_ips = args.cluster_node_ips.split(",")
     endpoints = get_cluster_endpoints(
         node_ips, args.started_port, args.nproc_per_node

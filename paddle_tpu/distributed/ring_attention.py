@@ -60,7 +60,9 @@ def ring_attention(q, k, v, axis_name="sp", scale=None, causal=False):
     """
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
-    n = jax.lax.axis_size(axis_name)
+    from ..fluid.core.jax_compat import axis_size
+
+    n = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     s_loc = q.shape[2]
 
@@ -68,7 +70,9 @@ def ring_attention(q, k, v, axis_name="sp", scale=None, causal=False):
     # mark the accumulators as device-varying on the ring axis (shard_map
     # tracks varying-vs-replicated; a constant init would type-clash with
     # the per-shard scan carry)
-    _vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    from ..fluid.core.jax_compat import pvary
+
+    _vary = lambda x: pvary(x, axis_name)
     acc = _vary(jnp.zeros((b, h, s_loc, d), jnp.float32))
     m = _vary(jnp.full((b, h, s_loc), NEG_INF / 2, jnp.float32))
     l = _vary(jnp.zeros((b, h, s_loc), jnp.float32))
@@ -116,6 +120,8 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", scale=None,
     fn = functools.partial(
         ring_attention, axis_name=axis_name, scale=scale, causal=causal
     )
-    return jax.shard_map(
+    from ..fluid.core.jax_compat import shard_map as _shard_map
+
+    return _shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)

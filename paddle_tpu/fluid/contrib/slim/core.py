@@ -94,6 +94,78 @@ class Compressor:
         self.strategies.extend(strategies)
         return self
 
+    # -- yaml config (cf. reference compressor.py config/
+    # config_factory) ----------------------------------------------------
+    _STRATEGY_REGISTRY = None
+
+    @classmethod
+    def _strategy_classes(cls):
+        """Name -> class for every built-in Compressor strategy (slim
+        prune/quantization); a yaml `class:` may also be a dotted path
+        to anything else."""
+        if cls._STRATEGY_REGISTRY is None:
+            from .prune import (
+                PruneStrategy,
+                SensitivePruneStrategy,
+                UniformPruneStrategy,
+            )
+            from .quantization import QuantizationStrategy
+
+            cls._STRATEGY_REGISTRY = {
+                c.__name__: c for c in (
+                    PruneStrategy, UniformPruneStrategy,
+                    SensitivePruneStrategy, QuantizationStrategy)
+            }
+        return cls._STRATEGY_REGISTRY
+
+    def config(self, config):
+        """Configure strategies (and compressor knobs) from a yaml file
+        — the reference `Compressor.config(config_path)` API::
+
+            version: 1.0
+            strategies:
+              qat:
+                class: QuantizationStrategy
+                start_epoch: 0
+            compressor:
+              epoch: 5
+              checkpoint_path: ./ckpt
+
+        `config` may also be an already-parsed dict.  Strategy sections
+        instantiate by `class:` name (built-in registry or a dotted
+        import path) with the remaining keys as constructor kwargs;
+        strategies append in file order.  Returns self (chainable)."""
+        if not isinstance(config, dict):
+            import yaml
+
+            with open(config) as f:
+                config = yaml.safe_load(f) or {}
+        registry = self._strategy_classes()
+        for name, spec in (config.get("strategies") or {}).items():
+            if not isinstance(spec, dict) or "class" not in spec:
+                raise ValueError(
+                    "strategy %r needs a mapping with a 'class' key"
+                    % name)
+            spec = dict(spec)
+            cls_name = spec.pop("class")
+            klass = registry.get(cls_name)
+            if klass is None and "." in cls_name:
+                import importlib
+
+                mod, _, attr = cls_name.rpartition(".")
+                klass = getattr(importlib.import_module(mod), attr, None)
+            if klass is None:
+                raise ValueError(
+                    "unknown strategy class %r (built-ins: %s)"
+                    % (cls_name, sorted(registry)))
+            self.add_strategy(klass(**spec))
+        comp = config.get("compressor") or {}
+        if "epoch" in comp:
+            self._epochs = int(comp["epoch"])
+        if "checkpoint_path" in comp:
+            self._checkpoint_path = comp["checkpoint_path"]
+        return self
+
     # -- checkpoint/resume (cf. reference compressor.py:238 _save_/
     # _load_checkpoint + init_model flow) --------------------------------
     def _ckpt_saver(self):

@@ -171,6 +171,84 @@ class QuantizationFreezePass:
         return program
 
 
+class QuantizationStrategy:
+    """QAT as a Compressor strategy (reference
+    `slim/quantization/quantization_strategy.py`): at `start_epoch` the
+    training program is rewritten with fake quant-dequant ops
+    (QuantizationTransformPass) and the new moving-average scale states
+    are zero-initialized in the live scope; at compression end the
+    trained scales freeze into real int8 weights
+    (QuantizationFreezePass).
+
+    Resumable through the Compressor's per-epoch checkpoint: the
+    checkpoint carries the REWRITTEN program, the scale states (scope
+    arrays) and this strategy's `applied` flag, so a killed QAT run
+    resumes mid-schedule without re-applying the rewrite."""
+
+    def __init__(self, start_epoch=0, end_epoch=0, weight_bits=8,
+                 activation_bits=8, moving_rate=0.9,
+                 quantizable_op_type=None, freeze_on_end=True):
+        self.start_epoch = int(start_epoch)
+        self.end_epoch = int(end_epoch)
+        self.weight_bits = int(weight_bits)
+        self.activation_bits = int(activation_bits)
+        self.moving_rate = float(moving_rate)
+        self.quantizable_op_type = (list(quantizable_op_type)
+                                    if quantizable_op_type else None)
+        self.freeze_on_end = bool(freeze_on_end)
+        self.applied = False
+        self.frozen = False
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        if self.applied or context.epoch < self.start_epoch:
+            return
+        if context.startup_program is None:
+            raise ValueError(
+                "QuantizationStrategy needs the Compressor's "
+                "startup_program (it declares the fake-quant scale "
+                "state there); pass startup_program= to Compressor")
+        import numpy as np
+
+        import jax
+
+        QuantizationTransformPass(
+            weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits,
+            moving_rate=self.moving_rate,
+            quantizable_op_type=self.quantizable_op_type,
+        ).apply(context.train_program, context.startup_program)
+        # the startup program already ran: initialize the new scale
+        # states directly in the live scope instead of re-running it
+        # (which would clobber the partially-trained parameters)
+        block = context.train_program.global_block
+        for name in list(block.vars):
+            if name.endswith("@QUANT_SCALE_STATE") and not \
+                    context.scope.has(name):
+                context.scope.set(
+                    name, jax.device_put(np.zeros((1,), np.float32)))
+        self.applied = True
+
+    def _freeze(self, context):
+        if self.applied and self.freeze_on_end and not self.frozen:
+            QuantizationFreezePass().apply(context.train_program,
+                                           context.scope)
+            self.frozen = True
+
+    def on_epoch_end(self, context):
+        # end_epoch > start_epoch bounds the QAT window [start, end):
+        # freeze as soon as the last scheduled epoch finishes, so later
+        # epochs train the real int8-dequant weights
+        if (self.end_epoch > self.start_epoch
+                and context.epoch >= self.end_epoch - 1):
+            self._freeze(context)
+
+    def on_compression_end(self, context):
+        self._freeze(context)
+
+
 class PostTrainingQuantization:
     """PTQ (reference post_training_quantization.py): calibrate activation
     scales by running sample batches, then emit a program with int8

@@ -1,0 +1,148 @@
+"""Version-portable access to jax APIs that moved between releases.
+
+`shard_map` graduated from `jax.experimental.shard_map` (kwarg:
+`check_rep`) to `jax.shard_map` (kwarg: `check_vma`), and the
+varying-cast / axis-size helpers changed shape along the way
+(`jax.lax.pvary` / `jax.lax.pcast(..., to="varying")` /
+`jax.lax.axis_size`).  Every caller in this repo goes through this
+module so one jax install difference cannot fan out into
+AttributeErrors across the executor, the static pipeline, and ring
+attention (the long-standing "21 env failures" class).
+
+Fallback semantics (experimental API):
+
+  * `check=False` maps to `check_rep=False`.  With the checker off the
+    old API cannot accept replicated (partially-unmapped) out_specs, so
+    the wrapper auto-maps them: each such output gains a leading dim
+    mapped over the missing mesh axes inside the body, and the
+    caller-facing wrapper slices shard 0 back off.  For genuinely
+    replicated outputs (which is what an unmapped out_spec asserts)
+    this is value-identical.
+  * `check=True`/None maps to `check_rep=True`: the old checker proves
+    replicated out_specs itself (no rewrite needed), but demands
+    matching replication types across `cond`/`switch` branches — code
+    mixing per-shard values with replicated constants must `pvary` the
+    constants (the compat `pvary` below types as varying on BOTH APIs).
+  * `fallback_check` overrides `check` for the fallback only: a caller
+    tuned for the new API's `check_vma=False` whose body trips the old
+    checker-off limitations (e.g. rank-0 residuals under autodiff) can
+    keep its native setting and run the old API with the checker on.
+
+Either checker is a static analysis, never a runtime transform, so
+numerics do not change.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["has_shard_map", "shard_map", "pvary", "axis_size"]
+
+
+def has_shard_map():
+    """True when SOME shard_map implementation is importable."""
+    if hasattr(jax, "shard_map"):
+        return True
+    try:
+        from jax.experimental.shard_map import shard_map as _  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _spec_axes(spec):
+    """Mesh axis names referenced by a PartitionSpec."""
+    used = set()
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        if isinstance(entry, tuple):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return used
+
+
+def shard_map(f, mesh, in_specs, out_specs, check=None,
+              fallback_check=None):
+    """`jax.shard_map` when this jax has it, else the experimental one.
+
+    `check`: tri-state — None keeps the implementation default on the
+    native API; False/True map to `check_vma` there.  On the fallback,
+    `fallback_check` (when given) overrides `check`; see the module
+    docstring for the two fallback modes."""
+    if hasattr(jax, "shard_map"):
+        kw = {} if check is None else {"check_vma": bool(check)}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+    from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.sharding import PartitionSpec as P
+
+    check = check if fallback_check is None else fallback_check
+    if check is None or check:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=True)
+
+    axis_names = tuple(getattr(mesh, "axis_names", ()))
+    is_p = lambda x: isinstance(x, P)
+    specs_flat, treedef = jtu.tree_flatten(out_specs, is_leaf=is_p)
+    missing = [tuple(a for a in axis_names if a not in _spec_axes(s))
+               for s in specs_flat]
+    if not any(missing):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+
+    # out_specs leave some mesh axis unmapped: map those axes over a new
+    # size-1-per-shard leading dim so check_rep=False accepts them
+    new_specs = treedef.unflatten([
+        P(m, *s) if m else s for s, m in zip(specs_flat, missing)])
+
+    # out_specs may be a PREFIX tree (one P() standing for a whole dict
+    # of outputs), so each matched position is transformed as a subtree.
+    # jtu.tree_map, not jax.tree.map: the latter postdates some of the
+    # jax versions this fallback exists for
+    def body(*args):
+        outs_flat = treedef.flatten_up_to(f(*args))
+        return treedef.unflatten([
+            jtu.tree_map(lambda a: jnp.expand_dims(a, 0), o) if m else o
+            for o, m in zip(outs_flat, missing)])
+
+    mapped = _shard_map(body, mesh=mesh, in_specs=in_specs,
+                        out_specs=new_specs, check_rep=False)
+
+    def call(*args):
+        outs_flat = treedef.flatten_up_to(mapped(*args))
+        return treedef.unflatten([
+            jtu.tree_map(lambda a: a[0], o) if m else o
+            for o, m in zip(outs_flat, missing)])
+
+    return call
+
+
+def axis_size(axis_name):
+    """Size of a mapped axis from inside shard_map: `jax.lax.axis_size`
+    where it exists, else the classic `psum(1, axis)` identity."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pvary(x, axis_name):
+    """Mark `x` device-varying over `axis_name`.  Where no cast API
+    exists, route the value through a data dependence on
+    `axis_index(axis_name)` — `where(idx < 0, x, x)` is value- and
+    gradient-identity but the old replication checker types it as
+    varying on `axis_name`, which is all the cast is for."""
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis_name)
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to="varying")
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+
+    flag = jax.lax.axis_index(axis_name) < 0   # False, typed varying
+    return jtu.tree_map(lambda a: jnp.where(flag, a, a), x)

@@ -293,7 +293,9 @@ class _LoweredBlock:
                 new_state = {n: env[n] for n in self.state_out}
                 return fetches, new_state
 
-            sharded = jax.shard_map(
+            from .core.jax_compat import shard_map as _shard_map
+
+            sharded = _shard_map(
                 run_block_sharded,
                 mesh=jmesh,
                 in_specs=(
@@ -303,7 +305,7 @@ class _LoweredBlock:
                     P(),
                 ),
                 out_specs=([P(rank_axis)] * len(fetch_names), P()),
-                check_vma=False,
+                check=False,
             )
             self._jitted = jax.jit(sharded, donate_argnums=(1,))
 

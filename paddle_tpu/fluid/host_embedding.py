@@ -187,9 +187,48 @@ class HostEmbedding:
 
     def load(self, path):
         d = np.load(path if str(path).endswith(".npz") else str(path) + ".npz")
+        meta = d["meta"] if "meta" in d.files else None
+        if meta is not None and int(meta[3]) != self.nproc:
+            raise ValueError(
+                "host-embedding shard %r was saved with nproc=%d but this "
+                "table runs with nproc=%d — the row layout differs; load "
+                "all old shards through load_resharded (or the elastic "
+                "restore path in HostEmbeddingCheckpoint)"
+                % (str(path), int(meta[3]), self.nproc))
         self._rows = d["rows"]
         if self.optimizer == "adagrad" and d["accum"].size:
             self._accum = d["accum"]
+
+    def load_resharded(self, shard_paths):
+        """Elastic restore: rebuild THIS rank's rows from the complete
+        set of shards saved by an old group of any size.  `shard_paths`:
+        {old_rank: path} covering every old rank."""
+        from ..distributed.elastic.reshard import reshard_host_embedding_rows
+
+        shards = {}
+        old_nranks = None
+        for old_rank, p in shard_paths.items():
+            d = np.load(p if str(p).endswith(".npz") else str(p) + ".npz")
+            shards[int(old_rank)] = (d["rows"], d["accum"])
+            if "meta" in d.files:
+                saved = int(d["meta"][3])
+                if old_nranks not in (None, saved):
+                    raise ValueError(
+                        "host-embedding shards disagree on the save-time "
+                        "nproc (%d vs %d) — they are not from one commit"
+                        % (old_nranks, saved))
+                old_nranks = saved
+        rows, accum = reshard_host_embedding_rows(
+            shards, self.rank, self.nproc, old_nranks=old_nranks)
+        if rows.shape[0] != self._rows.shape[0]:
+            raise ValueError(
+                "resharded row count %d does not match this table's owned "
+                "rows %d (num_rows=%d nproc=%d rank=%d)"
+                % (rows.shape[0], self._rows.shape[0], self.num_rows,
+                   self.nproc, self.rank))
+        self._rows = rows.astype(self.dtype, copy=False)
+        if self.optimizer == "adagrad" and accum.size:
+            self._accum = accum.astype(np.float32, copy=False)
 
 
 class HostEmbeddingSession:

@@ -262,6 +262,8 @@ def build_pipeline_jit(program, block, ops, feed_names, feed_shapes,
     # an in-body psum per shard and psum'ing grads again double-counts
     # by the pp size).
     def pp_forward(train_params, const_params, mb_feeds, rng_key):
+        from .core.jax_compat import pvary
+
         s = jax.lax.axis_index("pp")
         env_base = dict(const_params)
         env_base.update(train_params)
@@ -293,14 +295,21 @@ def build_pipeline_jit(program, block, ops, feed_names, feed_shapes,
                             jax.random.fold_in(rng_key, mb), si),
                         is_test=is_test)
                     run_ops(stage_ops[si], env, ctx)
-                    out = {n: env.get(n, bnd_in[n]) for n in boundary}
+                    # every switch branch must produce the same
+                    # replication type: mark all branch outputs varying
+                    # on pp (they are — each shard ran its own stage)
+                    out = {n: pvary(env.get(n, bnd_in[n]), "pp")
+                           for n in boundary}
                     lv = (env[loss_name].astype(jnp.float32)
                           if si == loss_stage else jnp.float32(0))
                     new_stats = {
-                        n: jax.lax.stop_gradient(env.get(n, stats_in[n]))
+                        n: pvary(jax.lax.stop_gradient(
+                            env.get(n, stats_in[n])), "pp")
                         for n in stat_names
                     }
-                    return (out, jnp.asarray(lv, jnp.float32).reshape(()),
+                    return (out,
+                            pvary(jnp.asarray(lv, jnp.float32).reshape(()),
+                                  "pp"),
                             new_stats)
                 return f
 
@@ -333,12 +342,14 @@ def build_pipeline_jit(program, block, ops, feed_names, feed_shapes,
         loss = total / n_micro if loss_reduction == "mean" else total
         return loss, stats_final
 
-    sharded_loss = jax.shard_map(
+    from .core.jax_compat import shard_map as _shard_map
+
+    sharded_loss = _shard_map(
         pp_forward,
         mesh=jmesh,
         in_specs=(P(), P(), P(), P()),
         out_specs=(P(), {n: P() for n in stat_names}),
-        check_vma=False,
+        check=False,
     )
 
     def step(feed_vals, donate_state, ro_state, rng_key):

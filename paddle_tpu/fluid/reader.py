@@ -515,8 +515,11 @@ class DistributedBatchSampler(BatchSampler):
         equally many batches even when pad > dataset size, take the
         rank-strided slice, split into batches.  Single-sourced: the
         resumable io.ShardedBatchSampler's offsets index into exactly
-        this list."""
-        per = (self.n + self.nranks - 1) // self.nranks
+        this list.  Sized off `idx` (not self.n): an elastic resume
+        hands in the epoch's unconsumed SUFFIX and only it may be
+        sharded — tiling it back up to the dataset size would replay
+        consumed samples."""
+        per = (len(idx) + self.nranks - 1) // self.nranks
         padded = np.resize(idx, per * self.nranks)
         local = padded[self.rank::self.nranks]
         out = []

@@ -3,3 +3,4 @@ capabilities that are production-real but whose API may still move."""
 
 from . import checkpoint  # noqa: F401
 from . import complex  # noqa: F401
+from . import fault  # noqa: F401

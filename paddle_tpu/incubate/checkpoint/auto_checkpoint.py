@@ -52,7 +52,8 @@ class TrainEpochRange:
                  save_checkpoint_inter=1, max_num_checkpoints=3,
                  async_save=True, trainer_id=None, num_trainers=None,
                  barrier=None, extra_serializables=None, data_loaders=None,
-                 verbose=False):
+                 verbose=False, retry_attempts=0, retry_backoff_s=0.5,
+                 fence=None):
         from ...fluid import framework
         from ...fluid.core.scope import global_scope
 
@@ -73,6 +74,7 @@ class TrainEpochRange:
             self._start_epoch = 0
             self.restored_from = -1
             self.restored_step = None
+            self.restored_no = None
             return
 
         trainer_id = int(os.getenv("PADDLE_TRAINER_ID", "0")
@@ -113,11 +115,13 @@ class TrainEpochRange:
         self._serializables = [self._snap] + extras
         self._save_serializables = (
             self._serializables if trainer_id == 0 else extras)
+        self._nranks = num_trainers
         self._saver = CheckpointSaver(
             root=os.path.join(root, self.name), fs=fs,
             max_num_checkpoints=max_num_checkpoints,
             trainer_id=trainer_id, num_trainers=num_trainers,
-            barrier=barrier)
+            barrier=barrier, retry_attempts=retry_attempts,
+            retry_backoff_s=retry_backoff_s, fence=fence)
         self._async = AsyncCheckpointSaver(self._saver) if async_save \
             else None
         self._restore()
@@ -135,10 +139,12 @@ class TrainEpochRange:
             self._start_epoch = 0
             self.restored_from = -1
             self.restored_step = None
+            self.restored_no = None
             return
         self._serializables[0].restore_to_scope(self._scope)
         self.restored_from = int(meta.get("epoch", -1))
         self.restored_step = meta.get("step")
+        self.restored_no = meta.get("no")
         if self.restored_step is not None:
             # mid-epoch checkpoint (saved via save_checkpoint(epoch, step)
             # with a data loader attached): RE-ENTER the same epoch — the
@@ -198,6 +204,18 @@ class TrainEpochRange:
     # -- save ------------------------------------------------------------
     def save_checkpoint(self, epoch, step=None):
         extra = {"program_hash": self._hash, "name": self.name}
+        # the topology manifest makes elastic resharding deterministic:
+        # record how this group partitioned every rank-dependent layout
+        try:
+            from ...distributed.elastic.manifest import TopologyManifest
+
+            extra.update(TopologyManifest.from_serializables(
+                getattr(self, "_nranks", 1) or 1,
+                self._serializables,
+                generation=int(os.getenv("PADDLE_ELASTIC_GENERATION", "0")),
+            ).to_meta())
+        except Exception:
+            pass   # manifest is advisory; a save must never fail on it
         if self._async is not None:
             return self._async.save_async(
                 self._save_serializables, epoch=epoch, step=step,

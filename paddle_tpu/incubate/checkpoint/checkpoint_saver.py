@@ -27,6 +27,7 @@ rank can observe (or GC) a half-written checkpoint.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import threading
@@ -48,8 +49,39 @@ class CheckpointSaveError(RuntimeError):
     """A (possibly asynchronous) checkpoint save failed."""
 
 
+class StaleGenerationError(CheckpointSaveError):
+    """A rank from a superseded elastic generation tried to commit.
+
+    Raised by a generation fence (distributed.elastic.GenerationFence)
+    wired into the saver: once the controller bumps the generation, a
+    straggler from the old group can serialize all it wants but can
+    never make a checkpoint visible to the new group."""
+
+
 class CheckpointLoadError(RuntimeError):
     """No loadable checkpoint: every candidate was corrupt/partial."""
+
+
+_TRANSIENT_ERRNOS = frozenset(
+    getattr(errno, name)
+    for name in ("EIO", "EAGAIN", "EINTR", "EBUSY", "ESTALE", "ETIMEDOUT",
+                 "ECONNRESET", "ECONNABORTED", "ENETDOWN", "ENETUNREACH",
+                 "EREMOTEIO", "ENOBUFS")
+    if hasattr(errno, name)
+)
+
+
+def default_is_transient(exc):
+    """The retry policy's default verdict: I/O flakes a shared or
+    network filesystem recovers from (EIO, timeouts, dropped
+    connections) retry; everything else — including logic errors like
+    FileExistsError/PermissionError and any StaleGenerationError —
+    raises immediately."""
+    if isinstance(exc, StaleGenerationError):
+        return False
+    if isinstance(exc, (TimeoutError, InterruptedError, ConnectionError)):
+        return True
+    return isinstance(exc, OSError) and exc.errno in _TRANSIENT_ERRNOS
 
 
 def program_hash(program):
@@ -218,9 +250,41 @@ class HostEmbeddingCheckpoint(SerializableBase):
             names.append(fname)
         return names
 
+    def layout(self):
+        """Manifest fragment describing this save's table layout."""
+        return {
+            t.name: {"num_rows": t.num_rows, "dim": t.dim,
+                     "nranks": t.nproc}
+            for t in self._tables
+        }
+
     def deserialize(self, path):
+        import sys as _sys
+
+        from ...distributed.elastic.reshard import rank_shard_paths
+
         for t in self._tables:
-            t.load(os.path.join(path, self._fname(t)))
+            own = os.path.join(path, self._fname(t))
+            saved_nproc = None
+            if os.path.exists(own):
+                with np.load(own) as d:
+                    if "meta" in d.files:
+                        saved_nproc = int(d["meta"][3])
+            if saved_nproc in (None, t.nproc) and os.path.exists(own):
+                t.load(own)
+                continue
+            # world size changed (or this rank is new): gather the old
+            # group's complete shard set and re-slice the row layout
+            shard_paths = rank_shard_paths(path, "hostemb", t.name)
+            if not shard_paths:
+                raise CheckpointLoadError(
+                    "checkpoint carries no shards for host-embedding "
+                    "table %r" % t.name)
+            print(
+                "HostEmbeddingCheckpoint[%s]: resharding %d-rank shards "
+                "for nproc=%d" % (t.name, len(shard_paths), t.nproc),
+                file=_sys.stderr)
+            t.load_resharded(shard_paths)
 
 
 # ---------------------------------------------------------------------------
@@ -247,7 +311,21 @@ class CheckpointSaver:
 
     def __init__(self, root, fs=None, max_num_checkpoints=3,
                  trainer_id=0, num_trainers=1, barrier=None,
-                 local_cache_path=None):
+                 local_cache_path=None, retry_attempts=0,
+                 retry_backoff_s=0.5, retry_max_backoff_s=8.0,
+                 is_transient=None, fence=None):
+        """`retry_attempts`: extra single-rank save attempts on TRANSIENT
+        I/O failures (`is_transient`, default `default_is_transient`),
+        with exponential backoff from `retry_backoff_s` capped at
+        `retry_max_backoff_s`.  Each attempt starts a fresh tmp dir, so a
+        commit stays all-or-nothing across retries.  Multi-rank saves
+        are never retried here — re-issuing the collective save is the
+        elastic controller's job (the barrier tokens scope each attempt).
+
+        `fence`: an object whose `check()` raises StaleGenerationError
+        when this process belongs to a superseded elastic generation;
+        consulted at save start and again immediately before the commit
+        rename, so a stale rank cannot publish into the new group."""
         self._fs = fs or LocalFS()
         self._root = root
         self._max_num = (int(max_num_checkpoints)
@@ -255,6 +333,11 @@ class CheckpointSaver:
         self._rank = int(trainer_id)
         self._nranks = int(num_trainers)
         self._barrier = barrier
+        self._retry_attempts = max(int(retry_attempts), 0)
+        self._retry_backoff_s = float(retry_backoff_s)
+        self._retry_max_backoff_s = float(retry_max_backoff_s)
+        self._is_transient = is_transient or default_is_transient
+        self._fence = fence
         self._cache = local_cache_path or os.path.join(
             root if self._is_local else ".", ".checkpoint_cache")
         if self._nranks > 1 and barrier is None:
@@ -376,6 +459,10 @@ class CheckpointSaver:
             "rank %d: rank 0 never published an attempt token for "
             "checkpoint_%d (pointer %r)" % (self._rank, n, pointer))
 
+    def _check_fence(self):
+        if self._fence is not None:
+            self._fence.check()
+
     # -- save ------------------------------------------------------------
     def save_checkpoint(self, slists, epoch=None, step=None,
                         extra_meta=None, no=None, snapshot=True):
@@ -384,14 +471,46 @@ class CheckpointSaver:
         Atomicity: everything lands in a tmp dir; the rename to
         checkpoint_<n> is the commit point.  Multi-trainer: all ranks
         serialize, rank 0 merges manifests + commits, everyone barriers
-        on both sides.
-        """
-        t_save = time.perf_counter()
-        commit_secs = None
+        on both sides.  Single-rank transient I/O failures retry with
+        backoff when `retry_attempts` is configured (each retry restarts
+        from a fresh tmp dir — the snapshot is reused, so the retried
+        commit is the SAME state, all-or-nothing)."""
         slists = list(slists)
         if snapshot:
             for s in slists:
                 s.snapshot()
+        attempts = self._retry_attempts if self._nranks == 1 else 0
+        backoff = self._retry_backoff_s
+        for attempt in range(attempts + 1):
+            try:
+                return self._save_attempt(slists, epoch=epoch, step=step,
+                                          extra_meta=extra_meta, no=no)
+            except BaseException as e:
+                if attempt >= attempts or not self._is_transient(e):
+                    raise
+                try:
+                    from ...observability.metrics import default_registry
+
+                    default_registry().counter(
+                        "checkpoint_save_retries_total",
+                        "Checkpoint save attempts retried after a "
+                        "transient I/O failure").inc()
+                except Exception:
+                    pass
+                import sys as _sys
+
+                print("CheckpointSaver: transient save failure (%r), "
+                      "retry %d/%d in %.2fs"
+                      % (e, attempt + 1, attempts, backoff),
+                      file=_sys.stderr)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, self._retry_max_backoff_s)
+
+    def _save_attempt(self, slists, epoch=None, step=None, extra_meta=None,
+                      no=None):
+        t_save = time.perf_counter()
+        commit_secs = None
+        self._check_fence()
         n = (self.last_checkpoint_dir_no() + 1) if no is None else int(no)
 
         if self._nranks > 1:
@@ -458,6 +577,9 @@ class CheckpointSaver:
 
             final = self._ckpt_dir(n)
             t_commit = time.perf_counter()
+            # last exit before the commit becomes visible: a rank from a
+            # superseded elastic generation must not publish
+            self._check_fence()
             # a committed checkpoint is immutable: shutil.move onto an
             # existing dir would NEST the tmp inside it and report
             # success while committing nothing
@@ -467,13 +589,20 @@ class CheckpointSaver:
                     "overwrite a committed checkpoint" % (n, self._root))
             if self._is_local:
                 self._fs.mv(write_dir, final)        # THE commit
+                committed = True
             else:
                 remote_tmp = os.path.join(self._root, tmp_name)
                 self._fs.mkdirs(self._root)
                 self._fs.upload(write_dir, remote_tmp)
                 self._fs.mv(remote_tmp, final)       # remote commit
-                LocalFS().delete(write_dir)
-            committed = True
+                committed = True
+                # cache cleanup AFTER the commit flag: a flaky delete
+                # must not report (or retry-and-duplicate) a save whose
+                # checkpoint is already durable
+                try:
+                    LocalFS().delete(write_dir)
+                except OSError:
+                    pass
             commit_secs = time.perf_counter() - t_commit
         except BaseException:
             # never leave a half-commit that a reader could mistake for
@@ -528,14 +657,26 @@ class CheckpointSaver:
             except Exception:
                 pass  # telemetry must never break a save's error path
 
+        # post-commit housekeeping is BEST-EFFORT: the checkpoint is
+        # already durable, so a flaky delete must neither fail the save
+        # nor (via the transient-retry loop above) re-run the attempt
+        # and commit a duplicate checkpoint_<n+1>
         if self._rank == 0:
-            if self._nranks > 1:
-                # every rank is past the commit barrier; the attempt
-                # pointer has served its purpose
-                self._fs.delete(os.path.join(
-                    self._root, "%s%d.ptr" % (_ATTEMPT_PREFIX, n)))
-            self.clean_redundant_checkpoints()
-            self.gc_stale_tmp()
+            try:
+                if self._nranks > 1:
+                    # every rank is past the commit barrier; the attempt
+                    # pointer has served its purpose
+                    self._fs.delete(os.path.join(
+                        self._root, "%s%d.ptr" % (_ATTEMPT_PREFIX, n)))
+                self.clean_redundant_checkpoints()
+                self.gc_stale_tmp()
+            except OSError as e:
+                import sys as _sys
+
+                print("CheckpointSaver: post-commit cleanup failed (%r); "
+                      "checkpoint_%d is committed, cleanup will be "
+                      "retried on the next save" % (e, n),
+                      file=_sys.stderr)
         return n
 
     # -- load ------------------------------------------------------------

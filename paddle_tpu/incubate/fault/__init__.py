@@ -1,0 +1,17 @@
+"""Deterministic fault injection for elasticity drills.
+
+Every recovery path in `distributed.elastic` is exercised by a test
+that INJECTS the fault rather than asserting the behavior in prose:
+rank kills, slow/failing filesystems, stale heartbeats, and mid-commit
+crashes, all driven by one declarative `FaultPlan` that serializes
+through an environment variable so subprocess drill workers replay the
+exact same schedule every run.
+"""
+
+from .injection import (  # noqa: F401
+    FAULT_PLAN_ENV,
+    FaultPlan,
+    FaultyFS,
+    HeartbeatStaller,
+    transient_os_error,
+)

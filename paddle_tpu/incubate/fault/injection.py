@@ -1,0 +1,240 @@
+"""The fault-injection primitives behind `paddle_tpu.incubate.fault`.
+
+Design rules:
+
+  * deterministic — every fault fires at a declared (rank, step) or a
+    declared occurrence count, never at random, so a failing drill
+    reproduces byte-for-byte;
+  * side-channel free — the plan serializes to JSON and rides the
+    $PADDLE_TPU_FAULT_PLAN environment variable into drill workers;
+  * injection points are the REAL seams: the `fluid.fs` FS object the
+    CheckpointSaver writes through (transient errors, mid-commit
+    crashes), the heartbeat update loop (stale heartbeats), and the
+    training step (rank kills via real SIGKILL).
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import signal
+import time
+
+from ...fluid.fs import LocalFS
+
+FAULT_PLAN_ENV = "PADDLE_TPU_FAULT_PLAN"
+
+__all__ = ["FaultPlan", "FaultyFS", "HeartbeatStaller",
+           "transient_os_error", "FAULT_PLAN_ENV"]
+
+
+def transient_os_error(op=""):
+    """The canonical injectable transient failure: EIO, the error a
+    flaky NFS/FUSE mount surfaces."""
+    return OSError(errno.EIO, "injected transient I/O failure", op)
+
+
+class FaultPlan:
+    """A declarative schedule of faults for one drill.
+
+    Event kinds (all fields integers unless noted):
+
+      {"kind": "kill", "rank": r, "step": s}
+          rank r SIGKILLs itself before running global step s.
+      {"kind": "stall_heartbeat", "rank": r, "step": s}
+          rank r stops pinging its heartbeat from step s on (process
+          stays alive and keeps training — the silent-rank case).
+      {"kind": "hang", "rank": r, "step": s}
+          rank r stops heartbeating AND stops making progress at step s
+          (process alive, sleeping, shrugging off SIGTERM — the
+          hung-rank case only the watchdog can see and only SIGKILL
+          can clear).
+      {"kind": "fs_error", "rank": r, "op": "mv", "times": k}
+          the first k calls of FS op (serialize/commit seam) on rank r
+          raise a transient OSError(EIO).
+      {"kind": "fs_error", ..., "fatal": true}
+          same, but a NON-transient error (PermissionError) — must NOT
+          be retried.
+      {"kind": "fs_slow", "rank": r, "seconds": 0.5}
+          every intercepted FS op on rank r stalls `seconds` (float) —
+          the slow-NFS case async saves must ride out off the train
+          step.
+      {"kind": "crash", "rank": r, "op": "mv", "nth": i}
+          rank r dies by SIGKILL inside the i-th call of FS op — with
+          op "mv" that is the mid-commit crash (tmp dir fully written,
+          rename never happens).
+
+    Every event also takes `"gen": g` (default 0): it fires only in
+    that elastic generation, so a drill's fault does not re-fire in
+    every recovered group.
+    """
+
+    def __init__(self, events=None, rank=None, generation=None):
+        self.events = [dict(e) for e in (events or [])]
+        if rank is None:
+            rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        if generation is None:
+            generation = int(os.getenv("PADDLE_ELASTIC_GENERATION", "0"))
+        self.rank = int(rank)
+        self.generation = int(generation)
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def from_env(cls, rank=None, generation=None):
+        raw = os.getenv(FAULT_PLAN_ENV, "")
+        if not raw:
+            return cls([], rank=rank, generation=generation)
+        return cls(json.loads(raw), rank=rank, generation=generation)
+
+    def to_env(self, env=None):
+        """Serialize into an env dict for a drill worker subprocess."""
+        env = dict(env if env is not None else {})
+        env[FAULT_PLAN_ENV] = json.dumps(self.events)
+        return env
+
+    def add(self, kind, **fields):
+        self.events.append({"kind": kind, **fields})
+        return self
+
+    def _mine(self, kind):
+        """Events of `kind` addressed to this rank AND this elastic
+        generation (default generation 0: a drill's fault fires in the
+        faulted generation, not again in every recovered one)."""
+        return [
+            e for e in self.events
+            if e.get("kind") == kind
+            and int(e.get("rank", -1)) == self.rank
+            and int(e.get("gen", 0)) == self.generation
+        ]
+
+    # -- step-seam faults -------------------------------------------------
+    def maybe_kill(self, step):
+        """Call at the top of every training step: dies by REAL SIGKILL
+        (no atexit, no finally — the preemption model) when the plan
+        says this (rank, step)."""
+        for e in self._mine("kill"):
+            if int(e.get("step", -1)) == int(step):
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    def heartbeat_stall_step(self):
+        """The step this rank's heartbeat goes silent at (None: never)."""
+        ev = self._mine("stall_heartbeat")
+        return int(ev[0]["step"]) if ev else None
+
+    def maybe_hang(self, step, monitor=None):
+        """Call per step: when the plan hangs this (rank, step), stop
+        the heartbeat and sleep forever (only SIGKILL ends it)."""
+        for e in self._mine("hang"):
+            if int(step) >= int(e.get("step", -1)):
+                if monitor is not None:
+                    monitor.stop()
+                while True:         # PEP 475: SIGTERM handlers that
+                    time.sleep(3600)   # return do not break the sleep
+
+    # -- FS-seam faults ---------------------------------------------------
+    def wrap_fs(self, fs=None):
+        """An FS object with this plan's fs_error/crash/fs_slow events
+        armed (passthrough when the plan has none for this rank)."""
+        fs_events = self._mine("fs_error") + self._mine("crash")
+        slow = max((float(e.get("seconds", 0.0))
+                    for e in self._mine("fs_slow")), default=0.0)
+        base = fs or LocalFS()
+        if not fs_events and not slow:
+            return base
+        return FaultyFS(base, fs_events, slow_s=slow)
+
+
+class FaultyFS(LocalFS):
+    """A LocalFS whose declared operations fail or crash on schedule.
+
+    Subclasses LocalFS (not FS) on purpose: CheckpointSaver's
+    `_is_local` check must keep routing through the local atomic-rename
+    commit path — the faults land INSIDE that path, which is the code
+    under test."""
+
+    def __init__(self, base=None, events=(), slow_s=0.0):
+        self._base = base or LocalFS()
+        self._events = [dict(e) for e in events]
+        self._counts = {}
+        self._slow_s = float(slow_s)
+
+    def _intercept(self, op):
+        self._counts[op] = n = self._counts.get(op, 0) + 1
+        if self._slow_s:
+            time.sleep(self._slow_s)
+        for e in self._events:
+            if e.get("op") != op:
+                continue
+            if e.get("kind") == "crash":
+                if n == int(e.get("nth", 1)):
+                    os.kill(os.getpid(), signal.SIGKILL)
+            elif e.get("kind") == "fs_error":
+                if n <= int(e.get("times", 1)):
+                    if e.get("fatal"):
+                        raise PermissionError(
+                            errno.EACCES, "injected non-transient failure",
+                            op)
+                    raise transient_os_error(op)
+
+    def calls(self, op):
+        """How many times `op` was attempted (retry assertions)."""
+        return self._counts.get(op, 0)
+
+    # intercepted ops: the serialize/commit seams CheckpointSaver uses
+    def mkdirs(self, path):
+        self._intercept("mkdirs")
+        return self._base.mkdirs(path)
+
+    def mv(self, src, dst):
+        self._intercept("mv")
+        return self._base.mv(src, dst)
+
+    def delete(self, path):
+        self._intercept("delete")
+        return self._base.delete(path)
+
+    def touch(self, path):
+        self._intercept("touch")
+        return self._base.touch(path)
+
+    # passthrough reads
+    def ls_dir(self, path):
+        return self._base.ls_dir(path)
+
+    def is_dir(self, path):
+        return self._base.is_dir(path)
+
+    def is_file(self, path):
+        return self._base.is_file(path)
+
+    def is_exist(self, path):
+        return self._base.is_exist(path)
+
+    def upload(self, local_path, fs_path):
+        self._intercept("upload")
+        return self._base.upload(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        return self._base.download(fs_path, local_path)
+
+
+class HeartbeatStaller:
+    """Freeze a rank's heartbeat from a declared step on.
+
+    Wraps a HeartBeatMonitor: `step(global_step)` arms the stall when
+    the plan's step is reached — the monitor's background ping loop is
+    stopped, the file's mtime ages, and the watchdog sees LOST while the
+    process itself keeps computing (the hung-rank failure mode)."""
+
+    def __init__(self, monitor, stall_step):
+        self._monitor = monitor
+        self._stall_step = stall_step
+        self.stalled = False
+
+    def step(self, global_step):
+        if (not self.stalled and self._stall_step is not None
+                and int(global_step) >= int(self._stall_step)):
+            self._monitor.stop()
+            self.stalled = True
+        return self.stalled

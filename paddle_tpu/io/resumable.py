@@ -116,21 +116,76 @@ class DataLoaderCheckpoint(SerializableBase):
 
     def deserialize(self, path):
         fp = os.path.join(path, self.filename)
-        if not os.path.exists(fp):
-            # the checkpoint predates this loader's attachment (or was
-            # saved with different loader names): params still restore,
-            # the loader just starts fresh — degrade loudly, not fatally
-            print(
-                "DataLoaderCheckpoint[%s]: checkpoint has no %s; "
-                "iteration state starts fresh" % (self._name, self.filename),
-                file=sys.stderr)
-            self._restored = None
+        state = None
+        if os.path.exists(fp):
+            with open(fp) as f:
+                state = json.load(f)
+        live_nranks = self._live_nranks()
+        saved_nranks = None
+        if isinstance(state, dict):
+            inner = state.get("sampler", state)
+            if isinstance(inner, dict) and "nranks" in inner:
+                saved_nranks = int(inner["nranks"])
+        if state is not None and (live_nranks is None
+                                  or saved_nranks in (None, live_nranks)):
+            self._stateful().load_state_dict(state)
+            self._restored = state
+            return state
+        # own-rank file missing (this rank did not exist at save time) or
+        # saved at a different world size: elastic resume — gather EVERY
+        # old rank's cursor from the commit and re-partition the epoch's
+        # unconsumed suffix across the new group
+        resharded = self._try_reshard(path, live_nranks)
+        if resharded is not None:
+            self._stateful().load_state_dict(resharded)
+            self._restored = resharded
+            return resharded
+        # no cursor files AT ALL: the checkpoint predates this loader's
+        # attachment (or used different loader names) — params still
+        # restore, the loader starts fresh; degrade loudly, not fatally
+        print(
+            "DataLoaderCheckpoint[%s]: checkpoint has no %s cursors; "
+            "iteration state starts fresh" % (self._name, self._name),
+            file=sys.stderr)
+        self._restored = None
+        return None
+
+    def _live_nranks(self):
+        sampler = getattr(self._loader, "batch_sampler", None)
+        return getattr(sampler, "nranks", None)
+
+    def _try_reshard(self, path, live_nranks):
+        """All `<name>_rank*.json` cursors in the commit -> this rank's
+        resharded state (None only when the commit carries NO cursors
+        for this loader).  A present-but-unreshardable cursor set
+        raises (ReshardError) — silently starting the epoch over would
+        replay every sample the old group already trained on."""
+        from ..distributed.elastic.reshard import (
+            ReshardError,
+            read_sampler_states,
+            reshard_sampler_states,
+        )
+
+        old_states = read_sampler_states(path, self._name)
+        if not old_states:
             return None
-        with open(fp) as f:
-            state = json.load(f)
-        self._stateful().load_state_dict(state)
-        self._restored = state
-        return state
+        if live_nranks is None:
+            raise ReshardError(
+                "checkpoint carries %d-rank cursors for loader %r but "
+                "the live loader exposes no batch_sampler to reshard "
+                "them onto — attach a ShardedBatchSampler-backed loader "
+                "(silently starting fresh would replay consumed samples)"
+                % (len(old_states), self._name))
+        new_states = reshard_sampler_states(old_states, live_nranks)
+        # the LIVE sampler's rank is authoritative for the new group
+        sampler = getattr(self._loader, "batch_sampler", None)
+        rank = int(getattr(sampler, "rank", self._rank))
+        print(
+            "DataLoaderCheckpoint[%s]: resharded %d-rank cursor for world "
+            "size %d (rank %d)" % (self._name, len(old_states), live_nranks,
+                                   rank),
+            file=sys.stderr)
+        return {"sampler": new_states[rank]}
 
     def restored_epoch(self):
         """Epoch the restored cursor sits in (None before any restore or

@@ -47,6 +47,11 @@ class ShardedBatchSampler(DistributedBatchSampler):
                          rank=rank, shuffle=shuffle, drop_last=drop_last,
                          seed=seed)
         self._offset = 0  # batches of the CURRENT epoch already yielded
+        # global sample index the CURRENT epoch's iteration begins at:
+        # 0 normally; a resharded elastic resume (distributed.elastic.
+        # reshard_sampler_states) sets it to the old group's consumed
+        # prefix, and the remaining suffix is what gets rank-sliced
+        self._epoch_start = 0
 
     # -- deterministic shard ---------------------------------------------
     def _permutation(self):
@@ -59,20 +64,27 @@ class ShardedBatchSampler(DistributedBatchSampler):
 
     def local_batches(self, epoch=None):
         """The full list of this rank's batches for `epoch` (default: the
-        current one) — pure function of (seed, epoch, rank, nranks)."""
+        current one) — pure function of (seed, epoch, rank, nranks) plus,
+        for the current epoch only, the elastic start cut."""
         if epoch is not None and epoch != self.epoch:
             saved, self.epoch = self.epoch, int(epoch)
             try:
-                return self.local_batches()
+                return self._shard_batches(self._permutation())
             finally:
                 self.epoch = saved
-        return self._shard_batches(self._permutation())
+        return self._shard_batches(self._permutation()[self._epoch_start:])
 
     def _num_batches(self):
         """Per-epoch local batch count without materializing the
         permutation (state_dict runs per delivered batch) — the parent's
-        arithmetic, kept single-sourced."""
-        return DistributedBatchSampler.__len__(self)
+        arithmetic, shifted by the elastic start cut."""
+        if not self._epoch_start:
+            return DistributedBatchSampler.__len__(self)
+        remaining = max(self.n - self._epoch_start, 0)
+        per = (remaining + self.nranks - 1) // self.nranks
+        if self.drop_last:
+            return per // self.batch_size
+        return (per + self.batch_size - 1) // self.batch_size
 
     # -- positional iteration --------------------------------------------
     def __iter__(self):
@@ -83,6 +95,7 @@ class ShardedBatchSampler(DistributedBatchSampler):
             # start the next epoch instead of yielding an empty one
             self.epoch += 1
             self._offset = 0
+            self._epoch_start = 0
             batches = self.local_batches()
         while self._offset < len(batches):
             b = batches[self._offset]
@@ -90,6 +103,7 @@ class ShardedBatchSampler(DistributedBatchSampler):
             yield b
         self.epoch += 1
         self._offset = 0
+        self._epoch_start = 0
 
     def __len__(self):
         return self._num_batches()
@@ -101,22 +115,27 @@ class ShardedBatchSampler(DistributedBatchSampler):
         if epoch != self.epoch:
             self.epoch = epoch
             self._offset = 0
+            self._epoch_start = 0
 
     # -- resume -----------------------------------------------------------
     def state_dict(self):
         # canonicalize "every batch of epoch e consumed" to "epoch e+1
         # not started" — they are the same position, and emitting one
         # form keeps a restore from replaying or shifting an epoch
-        epoch, offset = self.epoch, self._offset
+        epoch, offset, start = self.epoch, self._offset, self._epoch_start
         n = self._num_batches()
         if n and offset >= n:
-            epoch, offset = epoch + 1, 0
+            epoch, offset, start = epoch + 1, 0, 0
         return {
             "epoch": epoch,
             "offset": offset,
+            "start": start,
             "seed": self._seed_base,
             "nranks": self.nranks,
             "rank": self.rank,
+            # self-describing for distributed.elastic.reshard: the
+            # consumed prefix is start + offset * batch_size * nranks
+            "batch_size": self.batch_size,
         }
 
     def load_state_dict(self, state):
@@ -124,7 +143,8 @@ class ShardedBatchSampler(DistributedBatchSampler):
             raise ValueError(
                 "ShardedBatchSampler state was saved with nranks=%s but "
                 "this run has nranks=%d — the shard layout would differ; "
-                "elastic resharding is not supported"
+                "re-partition the saved group's states first with "
+                "distributed.elastic.reshard_sampler_states"
                 % (state.get("nranks"), self.nranks))
         if int(state.get("seed", self._seed_base)) != self._seed_base:
             raise ValueError(
@@ -134,3 +154,10 @@ class ShardedBatchSampler(DistributedBatchSampler):
                                            self._seed_base))
         self.epoch = int(state["epoch"])
         self._offset = int(state["offset"])
+        self._epoch_start = int(state.get("start", 0))
+        if self._epoch_start >= self.n:
+            # the old group consumed the whole epoch (its tail batches
+            # were padding): canonicalize to the next epoch's start
+            self.epoch += 1
+            self._offset = 0
+            self._epoch_start = 0

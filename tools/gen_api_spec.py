@@ -42,6 +42,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.fluid.contrib.slim.core",
     "paddle_tpu.incubate.checkpoint",
     "paddle_tpu.incubate.complex",
+    "paddle_tpu.incubate.fault",
     "paddle_tpu.io",
     "paddle_tpu.observability",
     "paddle_tpu.analysis",
@@ -50,6 +51,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.tensor",
     "paddle_tpu.metric",
     "paddle_tpu.distributed",
+    "paddle_tpu.distributed.elastic",
     "paddle_tpu.fleet",
     "paddle_tpu.inference",
 ]
