@@ -1,0 +1,3 @@
+module paddle_tpu
+
+go 1.18

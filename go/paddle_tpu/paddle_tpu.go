@@ -1,0 +1,265 @@
+// Package paddle_tpu is the Go client for the in-process C ABI
+// (native/paddle_tpu_capi.h) — capability parity with the reference's
+// go/paddle predictor (go/paddle/predictor.go over paddle_c_api.h),
+// reduced to the pointer+shape contract a Go service needs to link
+// inference without a network hop.
+//
+// Build: the shared library is produced from native/infer_capi.cc (see
+// tests/test_native_infer_capi.py for the exact g++ line); point cgo at
+// it via the environment, no source edits needed:
+//
+//	CGO_CFLAGS="-I/path/to/paddle_tpu/native" \
+//	CGO_LDFLAGS="/path/to/libpaddle_tpu_capi.so -Wl,-rpath,/path/to" \
+//	go build ./...
+//
+// Thread-safety matches the C ABI: one Predictor serves one Run at a
+// time (output buffers are library-owned until the next Run); use one
+// Predictor per goroutine or serialize externally.  For fleet-level
+// concurrency, speak HTTP to paddle_tpu.serving instead — this client
+// is the zero-copy-adjacent in-process path.
+package paddle_tpu
+
+/*
+#include <stdlib.h>
+#include "paddle_tpu_capi.h"
+*/
+import "C"
+
+import (
+	"fmt"
+	"unsafe"
+)
+
+// DataType mirrors PD_DataType.
+type DataType int
+
+const (
+	Float32 DataType = iota
+	Int32
+	Int64
+	Uint8
+)
+
+func (d DataType) String() string {
+	switch d {
+	case Float32:
+		return "float32"
+	case Int32:
+		return "int32"
+	case Int64:
+		return "int64"
+	case Uint8:
+		return "uint8"
+	}
+	return fmt.Sprintf("DataType(%d)", int(d))
+}
+
+func (d DataType) itemSize() int {
+	switch d {
+	case Float32, Int32:
+		return 4
+	case Int64:
+		return 8
+	case Uint8:
+		return 1
+	}
+	return 0
+}
+
+// Tensor is a dense row-major array.  Exactly one of the typed data
+// fields (matching Dtype) is used.
+type Tensor struct {
+	Shape   []int64
+	Dtype   DataType
+	Float32 []float32
+	Int32   []int32
+	Int64   []int64
+	Uint8   []byte
+}
+
+// NewFloat32Tensor wraps data (length must equal the shape product).
+func NewFloat32Tensor(shape []int64, data []float32) *Tensor {
+	return &Tensor{Shape: shape, Dtype: Float32, Float32: data}
+}
+
+// Numel is the product of Shape.
+func (t *Tensor) Numel() int64 {
+	n := int64(1)
+	for _, d := range t.Shape {
+		n *= d
+	}
+	return n
+}
+
+func (t *Tensor) dataBytes() ([]byte, error) {
+	n := int(t.Numel())
+	switch t.Dtype {
+	case Float32:
+		if len(t.Float32) != n {
+			return nil, fmt.Errorf("float32 data length %d != numel %d",
+				len(t.Float32), n)
+		}
+		if n == 0 {
+			return nil, nil
+		}
+		return unsafe.Slice((*byte)(unsafe.Pointer(&t.Float32[0])), n*4), nil
+	case Int32:
+		if len(t.Int32) != n {
+			return nil, fmt.Errorf("int32 data length %d != numel %d",
+				len(t.Int32), n)
+		}
+		if n == 0 {
+			return nil, nil
+		}
+		return unsafe.Slice((*byte)(unsafe.Pointer(&t.Int32[0])), n*4), nil
+	case Int64:
+		if len(t.Int64) != n {
+			return nil, fmt.Errorf("int64 data length %d != numel %d",
+				len(t.Int64), n)
+		}
+		if n == 0 {
+			return nil, nil
+		}
+		return unsafe.Slice((*byte)(unsafe.Pointer(&t.Int64[0])), n*8), nil
+	case Uint8:
+		if len(t.Uint8) != n {
+			return nil, fmt.Errorf("uint8 data length %d != numel %d",
+				len(t.Uint8), n)
+		}
+		return t.Uint8, nil
+	}
+	return nil, fmt.Errorf("unsupported dtype %v", t.Dtype)
+}
+
+// Predictor wraps one loaded model (PD_CreatePredictor handle).
+type Predictor struct {
+	h C.int64_t
+}
+
+// NewPredictor loads a save_inference_model directory.  PD_Init runs
+// implicitly on the first predictor.
+func NewPredictor(modelDir string) (*Predictor, error) {
+	cdir := C.CString(modelDir)
+	defer C.free(unsafe.Pointer(cdir))
+	h := C.PD_CreatePredictor(cdir)
+	if h == 0 {
+		return nil, fmt.Errorf(
+			"paddle_tpu: PD_CreatePredictor failed for %q", modelDir)
+	}
+	return &Predictor{h: h}, nil
+}
+
+// InputNames returns the model's feed names in declared order.
+func (p *Predictor) InputNames() []string {
+	n := int(C.PD_GetInputNum(p.h))
+	out := make([]string, n)
+	for i := 0; i < n; i++ {
+		out[i] = C.GoString(C.PD_GetInputName(p.h, C.int(i)))
+	}
+	return out
+}
+
+// OutputNames returns the model's fetch names in declared order.
+func (p *Predictor) OutputNames() []string {
+	n := int(C.PD_GetOutputNum(p.h))
+	out := make([]string, n)
+	for i := 0; i < n; i++ {
+		out[i] = C.GoString(C.PD_GetOutputName(p.h, C.int(i)))
+	}
+	return out
+}
+
+const maxOutputs = 16
+const maxDims = 8
+
+// Run executes one inference.  Inputs follow the declared feed order;
+// outputs are fresh Go-owned copies (the C buffers are reused by the
+// next Run).
+func (p *Predictor) Run(inputs []*Tensor) ([]*Tensor, error) {
+	if len(inputs) == 0 {
+		return nil, fmt.Errorf("paddle_tpu: no inputs")
+	}
+	views := make([]C.PD_TensorView, len(inputs))
+	var cAllocs []unsafe.Pointer
+	defer func() {
+		for _, ptr := range cAllocs {
+			C.free(ptr)
+		}
+	}()
+	for i, t := range inputs {
+		if len(t.Shape) > maxDims {
+			return nil, fmt.Errorf(
+				"paddle_tpu: input %d has %d dims (max %d)",
+				i, len(t.Shape), maxDims)
+		}
+		buf, err := t.dataBytes()
+		if err != nil {
+			return nil, fmt.Errorf("paddle_tpu: input %d: %w", i, err)
+		}
+		// copy into C memory: the view struct must not point into Go
+		// memory when it crosses the cgo boundary
+		ptr := C.CBytes(buf)
+		cAllocs = append(cAllocs, ptr)
+		views[i].data = ptr
+		views[i].ndim = C.int(len(t.Shape))
+		views[i].dtype = C.PD_DataType(t.Dtype)
+		for j, d := range t.Shape {
+			views[i].shape[j] = C.int64_t(d)
+		}
+	}
+	outs := make([]C.PD_TensorView, maxOutputs)
+	var nOut C.int
+	rc := C.PD_Run(p.h, &views[0], C.int(len(inputs)),
+		&outs[0], &nOut, C.int(maxOutputs))
+	if rc != 0 {
+		return nil, fmt.Errorf("paddle_tpu: PD_Run failed (rc=%d)", int(rc))
+	}
+	result := make([]*Tensor, int(nOut))
+	for i := 0; i < int(nOut); i++ {
+		v := outs[i]
+		shape := make([]int64, int(v.ndim))
+		numel := 1
+		for j := range shape {
+			shape[j] = int64(v.shape[j])
+			numel *= int(shape[j])
+		}
+		t := &Tensor{Shape: shape, Dtype: DataType(v.dtype)}
+		switch t.Dtype {
+		case Float32:
+			t.Float32 = make([]float32, numel)
+			if numel > 0 {
+				copy(t.Float32,
+					unsafe.Slice((*float32)(v.data), numel))
+			}
+		case Int32:
+			t.Int32 = make([]int32, numel)
+			if numel > 0 {
+				copy(t.Int32, unsafe.Slice((*int32)(v.data), numel))
+			}
+		case Int64:
+			t.Int64 = make([]int64, numel)
+			if numel > 0 {
+				copy(t.Int64, unsafe.Slice((*int64)(v.data), numel))
+			}
+		case Uint8:
+			t.Uint8 = make([]byte, numel)
+			if numel > 0 {
+				copy(t.Uint8, unsafe.Slice((*byte)(v.data), numel))
+			}
+		default:
+			return nil, fmt.Errorf(
+				"paddle_tpu: output %d has unsupported dtype %d",
+				i, int(v.dtype))
+		}
+		result[i] = t
+	}
+	return result, nil
+}
+
+// Close releases the predictor.  The Predictor must not be used after.
+func (p *Predictor) Close() {
+	if p.h != 0 {
+		C.PD_DeletePredictor(p.h)
+		p.h = 0
+	}
+}

@@ -1,0 +1,108 @@
+// Smoke test driven by tests/test_native_go_client.py: the Python
+// harness builds libpaddle_tpu_capi.so, saves a model, writes an input
+// and the Python Predictor's expected output as flat binaries, and
+// points this test at them through the environment.  Standalone
+// `go test` without that environment skips with a reason.
+package paddle_tpu
+
+import (
+	"encoding/binary"
+	"math"
+	"os"
+	"testing"
+)
+
+// readBin reads the harness format: int64 ndim, int64 dims..., then
+// float32 data (little-endian) — the same layout native/infer_demo.c
+// consumes.
+func readBin(t *testing.T, path string) ([]int64, []float32) {
+	t.Helper()
+	raw, err := os.ReadFile(path)
+	if err != nil {
+		t.Fatalf("read %s: %v", path, err)
+	}
+	if len(raw) < 8 {
+		t.Fatalf("%s: truncated header", path)
+	}
+	ndim := int64(binary.LittleEndian.Uint64(raw[:8]))
+	off := 8
+	shape := make([]int64, ndim)
+	numel := int64(1)
+	for i := range shape {
+		shape[i] = int64(binary.LittleEndian.Uint64(raw[off : off+8]))
+		numel *= shape[i]
+		off += 8
+	}
+	data := make([]float32, numel)
+	for i := range data {
+		data[i] = math.Float32frombits(
+			binary.LittleEndian.Uint32(raw[off : off+4]))
+		off += 4
+	}
+	return shape, data
+}
+
+func TestPredictorMatchesPython(t *testing.T) {
+	modelDir := os.Getenv("PADDLE_TPU_TEST_MODEL_DIR")
+	inputBin := os.Getenv("PADDLE_TPU_TEST_INPUT")
+	expectedBin := os.Getenv("PADDLE_TPU_TEST_EXPECTED")
+	if modelDir == "" || inputBin == "" || expectedBin == "" {
+		t.Skip("PADDLE_TPU_TEST_MODEL_DIR/_INPUT/_EXPECTED unset; " +
+			"run via tests/test_native_go_client.py")
+	}
+
+	pred, err := NewPredictor(modelDir)
+	if err != nil {
+		t.Fatalf("NewPredictor: %v", err)
+	}
+	defer pred.Close()
+
+	inNames := pred.InputNames()
+	if len(inNames) != 1 {
+		t.Fatalf("expected 1 input, got %v", inNames)
+	}
+	if len(pred.OutputNames()) < 1 {
+		t.Fatalf("expected >=1 output, got %v", pred.OutputNames())
+	}
+
+	shape, data := readBin(t, inputBin)
+	wantShape, want := readBin(t, expectedBin)
+
+	outs, err := pred.Run([]*Tensor{NewFloat32Tensor(shape, data)})
+	if err != nil {
+		t.Fatalf("Run: %v", err)
+	}
+	if len(outs) < 1 {
+		t.Fatalf("no outputs")
+	}
+	got := outs[0]
+	if got.Dtype != Float32 {
+		t.Fatalf("output dtype %v, want float32", got.Dtype)
+	}
+	if len(got.Shape) != len(wantShape) {
+		t.Fatalf("output rank %v, want %v", got.Shape, wantShape)
+	}
+	for i := range wantShape {
+		if got.Shape[i] != wantShape[i] {
+			t.Fatalf("output shape %v, want %v", got.Shape, wantShape)
+		}
+	}
+	for i, w := range want {
+		g := got.Float32[i]
+		if diff := math.Abs(float64(g - w)); diff > 1e-4+1e-4*math.Abs(float64(w)) {
+			t.Fatalf("output[%d] = %g, want %g (diff %g)", i, g, w, diff)
+		}
+	}
+
+	// second Run on the same predictor: buffers are reused correctly
+	outs2, err := pred.Run([]*Tensor{NewFloat32Tensor(shape, data)})
+	if err != nil {
+		t.Fatalf("second Run: %v", err)
+	}
+	for i, w := range outs[0].Float32 {
+		if outs2[0].Float32[i] != w {
+			t.Fatalf("second run differs at %d: %g vs %g",
+				i, outs2[0].Float32[i], w)
+		}
+	}
+}

@@ -28,10 +28,11 @@ CUDAPlace = fluid.CUDAPlace
 
 
 def __getattr__(name):
-    # lazy submodules (PEP 562): analysis is a build/debug-time tool — it
-    # must not tax the import of every training/serving worker process
-    if name == "analysis":
+    # lazy submodules (PEP 562): analysis is a build/debug-time tool and
+    # serving is a dedicated-process front tier — neither may tax the
+    # import of every training/serving worker process
+    if name in ("analysis", "serving"):
         import importlib
 
-        return importlib.import_module(".analysis", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError("module %r has no attribute %r" % (__name__, name))

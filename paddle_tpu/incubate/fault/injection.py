@@ -64,6 +64,15 @@ class FaultPlan:
           rank r dies by SIGKILL inside the i-th call of FS op — with
           op "mv" that is the mid-commit crash (tmp dir fully written,
           rename never happens).
+      {"kind": "kill_replica", "replica": i, "request": n}
+          serving drill: replica i of a `paddle_tpu.serving` fleet dies
+          while serving its n-th request (1-based).  Process-level
+          workers die by REAL SIGKILL mid-request
+          (`maybe_kill_replica`); in-process replicas surface the same
+          schedule as a `ReplicaDeadError` (`replica_kill_request`) —
+          either way the router must detect the death and re-queue the
+          in-flight group exactly once.  Replica events are addressed
+          by replica INDEX, independent of this process's rank.
 
     Every event also takes `"gen": g` (default 0): it fires only in
     that elastic generation, so a drill's fault does not re-fire in
@@ -131,6 +140,26 @@ class FaultPlan:
                     monitor.stop()
                 while True:         # PEP 475: SIGTERM handlers that
                     time.sleep(3600)   # return do not break the sleep
+
+    # -- serving-replica faults -------------------------------------------
+    def replica_kill_request(self, replica_index):
+        """The 1-based request count at which serving replica
+        `replica_index` dies (None: never).  Addressed by replica index,
+        NOT rank — one serving process hosts many replicas."""
+        for e in self.events:
+            if (e.get("kind") == "kill_replica"
+                    and int(e.get("replica", -1)) == int(replica_index)
+                    and int(e.get("gen", 0)) == self.generation):
+                return int(e.get("request", 1))
+        return None
+
+    def maybe_kill_replica(self, replica_index, request_count):
+        """Call per served request in a process-level serving worker:
+        dies by REAL SIGKILL mid-request when the plan says so (the
+        router sees a dead pipe, never a reply)."""
+        n = self.replica_kill_request(replica_index)
+        if n is not None and int(request_count) >= n:
+            os.kill(os.getpid(), signal.SIGKILL)
 
     # -- FS-seam faults ---------------------------------------------------
     def wrap_fs(self, fs=None):

@@ -1,0 +1,212 @@
+"""Shape-bucketed batching math, shared by the single-process
+`InferenceServer` and the multi-replica `paddle_tpu.serving` router.
+
+The serving invariant both front ends enforce: a ragged traffic mix
+(any coalesced batch size, variable declared feature dims) must hit a
+FIXED set of XLA executables.  That is a pure function of the batching
+config — (batch bucket ladder, per-feed ragged-axis ladders, optional
+synthesized validity mask) — so the config and every shape decision
+made from it live here, once:
+
+* `signature(inputs, ragged)` — which requests may share a batch
+  (same feeds/dtypes/fixed dims; declared ragged axes wildcarded);
+* `coalesce(group, ...)` — concatenate a group of request feeds along
+  dim 0 and pad every dim to its ladder (zero fill), returning the
+  padding-waste accounting the metrics report;
+* `ladder_specs(example, ...)` — the full cross product of bucket
+  shapes, for AOT warmup;
+* `mask_for(...)` — the synthesized (padded_batch, padded_extent)
+  validity mask for models not neutral to zero padding.
+
+Both front ends slicing outputs back per request along dim 0 is what
+makes padding invisible to clients; the helpers never see outputs.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+__all__ = [
+    "BatchingConfig",
+    "default_ladder",
+    "pick_bucket",
+]
+
+
+def default_ladder(max_batch):
+    """Powers of two up to max_batch, always ending at max_batch."""
+    ladder, b = [], 1
+    while b < max_batch:
+        ladder.append(b)
+        b *= 2
+    ladder.append(max_batch)
+    return ladder
+
+
+def pick_bucket(n, ladder):
+    """Smallest ladder entry >= n; beyond the ladder, n itself (a rare
+    oversize batch dispatches alone, padded exactly)."""
+    for b in ladder:
+        if b >= n:
+            return b
+    return n
+
+
+class BatchingConfig:
+    """The (batch ladder, ragged ladders, mask feed) triple plus every
+    shape computation derived from it.
+
+    * ``batch_buckets``: None = powers of two up to ``max_batch``;
+      a list pins an explicit ladder; ``False``/``[]`` disables batch
+      padding (every coalesced size compiles its own executable).
+    * ``ragged_dims``: ``{feed_name: {axis: [bucket, ...]}}`` — feature
+      dims that vary per request (axis counted on the full array, so 1
+      is the first feature dim; the batch dim is batch_buckets' job).
+    * ``mask_feed``: name of an extra feed synthesized as a float32
+      validity mask over the FIRST declared ragged feed/axis.
+    """
+
+    def __init__(self, max_batch=32, batch_buckets=None, ragged_dims=None,
+                 mask_feed=None):
+        self.max_batch = max(int(max_batch), 1)
+        if batch_buckets is None:
+            self.batch_buckets = default_ladder(self.max_batch)
+        elif not batch_buckets:          # False / [] -> no batch padding
+            self.batch_buckets = []
+        else:
+            self.batch_buckets = sorted(int(b) for b in batch_buckets)
+        self.ragged = {
+            name: {int(ax): sorted(int(b) for b in buckets)
+                   for ax, buckets in axes.items()}
+            for name, axes in (ragged_dims or {}).items()
+        }
+        for name, axes in self.ragged.items():
+            for ax in axes:
+                if ax < 1:
+                    raise ValueError(
+                        "ragged_dims[%r] axis %d: the batch dim (0) is "
+                        "padded by batch_buckets; ragged axes must be >= 1"
+                        % (name, ax))
+        self.mask_feed = mask_feed
+        if mask_feed is not None and not self.ragged:
+            raise ValueError("mask_feed requires ragged_dims")
+
+    # -- grouping --------------------------------------------------------
+    def signature(self, inputs):
+        """Requests share a batch iff same feeds, dtypes, and non-batch
+        dims — except declared ragged axes, which are wildcarded (they
+        pad to a common bucket)."""
+        sig = []
+        for k in sorted(inputs):
+            v = inputs[k]
+            dims = list(v.shape[1:])
+            for ax in self.ragged.get(k, {}):
+                if 1 <= ax <= len(dims):
+                    dims[ax - 1] = None
+            sig.append((k, str(v.dtype), tuple(dims)))
+        return tuple(sig)
+
+    def validate_request(self, arrs):
+        """Front-door request checks shared by both front ends: the
+        synthesized mask must not be client-supplied, and every feed
+        needs the same leading batch dim."""
+        if self.mask_feed is not None and self.mask_feed in arrs:
+            raise ValueError(
+                "feed %r is synthesized by the server (mask_feed); do not "
+                "send it" % self.mask_feed)
+        rows = {v.shape[0] if v.ndim else None for v in arrs.values()}
+        if len(rows) != 1 or None in rows:
+            raise ValueError(
+                "all feeds need the same leading batch dim; got %s"
+                % {k: v.shape for k, v in arrs.items()})
+
+    # -- padding ---------------------------------------------------------
+    def mask_for(self, feed, rows_valid, group_inputs=None):
+        """Validity mask over the first DECLARED ragged feed/axis
+        (insertion order): (padded_batch, padded_extent) float32, 1.0
+        where real."""
+        name = next(iter(self.ragged))
+        ax = next(iter(self.ragged[name]))
+        padded = feed[name]
+        mask = np.zeros((padded.shape[0], padded.shape[ax]), np.float32)
+        if group_inputs is None:
+            mask[:rows_valid, :] = 1.0
+        else:
+            off = 0
+            for inputs in group_inputs:
+                n = inputs[name].shape[0]
+                mask[off:off + n, :inputs[name].shape[ax]] = 1.0
+                off += n
+        return mask
+
+    def coalesce(self, group_inputs):
+        """Concatenate a group of request feeds ({name: array} dicts
+        sharing a signature) along dim 0 and pad to the ladders.
+
+        Returns ``(feed, total_rows, real_elems, padded_elems)`` — feed
+        includes the synthesized mask when configured; the elem counts
+        feed the padding-waste metric.  Single already-bucket-shaped
+        requests pass through uncopied (fast path)."""
+        total = sum(inputs[next(iter(inputs))].shape[0]
+                    for inputs in group_inputs)
+        padded_rows = (pick_bucket(total, self.batch_buckets)
+                       if self.batch_buckets else total)
+        feed, real_elems, padded_elems = {}, 0, 0
+        for k in group_inputs[0]:
+            arrs = [inputs[k] for inputs in group_inputs]
+            real_elems += sum(a.size for a in arrs)
+            ragged = self.ragged.get(k, {})
+            targets = {
+                ax: pick_bucket(max(a.shape[ax] for a in arrs), buckets)
+                for ax, buckets in ragged.items()
+            }
+            shape = list(arrs[0].shape)
+            shape[0] = padded_rows
+            for ax, ext in targets.items():
+                shape[ax] = ext
+            if len(group_inputs) == 1 and tuple(shape) == arrs[0].shape:
+                feed[k] = arrs[0]          # no copy on the fast path
+            else:
+                out = np.zeros(tuple(shape), arrs[0].dtype)
+                off = 0
+                for a in arrs:
+                    dst = (slice(off, off + a.shape[0]),) + tuple(
+                        slice(0, d) for d in a.shape[1:])
+                    out[dst] = a
+                    off += a.shape[0]
+                feed[k] = out
+            padded_elems += feed[k].size
+        if self.mask_feed is not None:
+            feed[self.mask_feed] = self.mask_for(
+                feed, rows_valid=total, group_inputs=group_inputs)
+        return feed, total, real_elems, padded_elems
+
+    # -- warmup ----------------------------------------------------------
+    def ladder_specs(self, example_inputs):
+        """One zero feed per (batch bucket x ragged bucket combination):
+        the full executable set AOT warmup must build.  example_inputs
+        supplies dtypes and the non-ragged feature dims."""
+        example = {k: np.asarray(v) for k, v in example_inputs.items()}
+        batch_ladder = self.batch_buckets or [self.max_batch]
+        ragged_axes = [(name, ax, buckets)
+                       for name, axes in sorted(self.ragged.items())
+                       for ax, buckets in sorted(axes.items())]
+        specs = []
+        for b in batch_ladder:
+            for combo in itertools.product(
+                    *[buckets for _, _, buckets in ragged_axes]):
+                feed = {}
+                for name, arr in example.items():
+                    shape = list(arr.shape)
+                    shape[0] = b
+                    for (rname, ax, _), ext in zip(ragged_axes, combo):
+                        if rname == name:
+                            shape[ax] = ext
+                    feed[name] = np.zeros(tuple(shape), arr.dtype)
+                if self.mask_feed is not None:
+                    feed[self.mask_feed] = self.mask_for(
+                        feed, rows_valid=b)
+                specs.append(feed)
+        return specs

@@ -1,0 +1,73 @@
+"""Shared HTTP plumbing for the two serving front ends
+(`inference/server.py`'s single-process endpoint and
+`serving/http_front.py`'s fleet front): JSON response helpers and the
+drain-on-SIGTERM installer.  One copy, so a fix to the chain semantics
+cannot silently miss one of the two."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+__all__ = ["JsonHandlerMixin", "install_sigterm_drain"]
+
+
+class JsonHandlerMixin:
+    """Mix into a BaseHTTPRequestHandler: JSON send/parse helpers."""
+
+    def _send(self, code, payload, headers=()):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code, text, ctype):
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n) if n else b"{}"
+        msg = json.loads(raw or b"{}")
+        if not isinstance(msg, dict):
+            raise ValueError("body must be a JSON object")
+        return msg
+
+
+def install_sigterm_drain(httpd, drain_fn):
+    """Arm graceful shutdown on SIGTERM (main thread only; no-op with
+    False returned elsewhere): the handler runs `drain_fn()`
+    synchronously on the main thread (readiness flips inside it before
+    anything closes), closes the listener from a helper thread
+    (`shutdown()` from the serve_forever thread would deadlock), then
+    CHAINS the previously installed handler — the PR-6 flight-recorder
+    convention, so a crash dump still fires and the process still dies
+    by signal when that is what the previous handler does."""
+    import signal
+
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_sigterm(signum, frame):
+            drain_fn()
+            threading.Thread(target=httpd.shutdown, daemon=True).start()
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                import os
+
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+        return True
+    except ValueError:
+        return False   # not the main thread: drain_fn still callable

@@ -50,6 +50,12 @@ import numpy as np
 
 from ..observability import trace as _trace
 from ..observability.metrics import default_registry, unique_instance_label
+from .batching import BatchingConfig
+
+
+class ServerClosing(RuntimeError):
+    """Raised for requests arriving after graceful shutdown began (the
+    HTTP layer answers 503 + Retry-After instead of dropping sockets)."""
 
 
 class _Request:
@@ -81,16 +87,6 @@ class _Request:
     @property
     def rows(self):
         return self.inputs[next(iter(self.inputs))].shape[0]
-
-
-def _default_ladder(max_batch):
-    """Powers of two up to max_batch, always ending at max_batch."""
-    ladder, b = [], 1
-    while b < max_batch:
-        ladder.append(b)
-        b *= 2
-    ladder.append(max_batch)
-    return ladder
 
 
 class InferenceServer:
@@ -135,29 +131,17 @@ class InferenceServer:
                  pipeline_depth=2, name="serving",
                  metrics_registry=None):
         self._pred = predictor
-        self._max_batch = max(int(max_batch), 1)
         self._timeout = max(batch_timeout_ms, 0.0) / 1e3
-        if batch_buckets is None:
-            self._batch_buckets = _default_ladder(self._max_batch)
-        elif not batch_buckets:          # False / [] -> no batch padding
-            self._batch_buckets = []
-        else:
-            self._batch_buckets = sorted(int(b) for b in batch_buckets)
-        self._ragged = {
-            name: {int(ax): sorted(int(b) for b in buckets)
-                   for ax, buckets in axes.items()}
-            for name, axes in (ragged_dims or {}).items()
-        }
-        for name, axes in self._ragged.items():
-            for ax in axes:
-                if ax < 1:
-                    raise ValueError(
-                        "ragged_dims[%r] axis %d: the batch dim (0) is "
-                        "padded by batch_buckets; ragged axes must be >= 1"
-                        % (name, ax))
-        self._mask_feed = mask_feed
-        if mask_feed is not None and not self._ragged:
-            raise ValueError("mask_feed requires ragged_dims")
+        # all shape-bucketing math lives in BatchingConfig (shared with
+        # the multi-replica serving router)
+        self._cfg = BatchingConfig(
+            max_batch=max_batch, batch_buckets=batch_buckets,
+            ragged_dims=ragged_dims, mask_feed=mask_feed)
+        self._max_batch = self._cfg.max_batch
+        self._batch_buckets = self._cfg.batch_buckets
+        self._ragged = self._cfg.ragged
+        self._mask_feed = self._cfg.mask_feed
+        self._draining = threading.Event()   # graceful shutdown began
         self._q: queue.Queue = queue.Queue()
         self._done_q: queue.Queue = queue.Queue(
             maxsize=max(int(pipeline_depth), 1))
@@ -166,6 +150,7 @@ class InferenceServer:
         self._recent = deque(maxlen=64)
         self._sig_costs = {}     # feed signature -> cost_analysis dict
         self._pending = OrderedDict()    # signature -> deque[_Request]
+        self._inflight = 0       # requests taken off pending, not done
         self._plock = threading.Lock()   # dispatcher mutates, stats read
         self._seq = itertools.count()
         self._dispatcher = None
@@ -226,6 +211,7 @@ class InferenceServer:
         self._q = queue.Queue()
         self._done_q = queue.Queue(maxsize=self._done_q.maxsize)
         self._stop.clear()
+        self._draining.clear()
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="infer-dispatch", daemon=True)
         self._completer = threading.Thread(
@@ -248,6 +234,30 @@ class InferenceServer:
         if self._completer is not None:
             self._completer.join(timeout=5)
             self._completer = None
+
+    def ready(self):
+        """Readiness (the /readyz contract): started and not draining."""
+        return self._dispatcher is not None and not self._draining.is_set()
+
+    def begin_graceful_shutdown(self, drain_timeout=30.0):
+        """Zero-drop shutdown: flip /readyz to failing, refuse NEW
+        requests (`ServerClosing` -> HTTP 503 + Retry-After), let every
+        queued and in-flight batch finish, then stop the worker threads.
+        Safe to call from a SIGTERM handler (serve_http installs one
+        chaining any previous handler, PR-6 flight-recorder style).
+        Returns True when fully drained, False on drain timeout."""
+        self._draining.set()
+        deadline = time.monotonic() + max(float(drain_timeout), 0.0)
+        drained = False
+        while time.monotonic() < deadline:
+            with self._plock:
+                busy = bool(self._inflight) or bool(self._pending)
+            if not busy and self._q.empty() and self._done_q.empty():
+                drained = True
+                break
+            time.sleep(0.005)
+        self.stop()
+        return drained
 
     def unregister_metrics(self):
         """Drop this server's series from the shared registry and free
@@ -280,27 +290,7 @@ class InferenceServer:
         ragged bucket combination) and blocks until all executables
         exist; returns the predictor's compile_count (None if the
         predictor exposes no counter)."""
-        example = {k: np.asarray(v) for k, v in example_inputs.items()}
-        batch_ladder = self._batch_buckets or [self._max_batch]
-        ragged_axes = [(name, ax, buckets)
-                       for name, axes in sorted(self._ragged.items())
-                       for ax, buckets in sorted(axes.items())]
-        specs = []
-        for b in batch_ladder:
-            for combo in itertools.product(
-                    *[buckets for _, _, buckets in ragged_axes]):
-                feed = {}
-                for name, arr in example.items():
-                    shape = list(arr.shape)
-                    shape[0] = b
-                    for (rname, ax, _), ext in zip(ragged_axes, combo):
-                        if rname == name:
-                            shape[ax] = ext
-                    feed[name] = np.zeros(tuple(shape), arr.dtype)
-                if self._mask_feed is not None:
-                    feed[self._mask_feed] = self._mask_for(
-                        feed, rows_valid=b)
-                specs.append(feed)
+        specs = self._cfg.ladder_specs(example_inputs)
         if hasattr(self._pred, "warmup"):
             out = self._pred.warmup(specs)
         else:
@@ -358,16 +348,12 @@ class InferenceServer:
         tracing disabled so responses are always correlatable."""
         if self._dispatcher is None:
             raise RuntimeError("call start() first")
+        if self._draining.is_set():
+            raise ServerClosing(
+                "server is draining for shutdown; retry against another "
+                "replica")
         arrs = {k: np.asarray(v) for k, v in inputs.items()}
-        if self._mask_feed is not None and self._mask_feed in arrs:
-            raise ValueError(
-                "feed %r is synthesized by the server (mask_feed); do not "
-                "send it" % self._mask_feed)
-        rows = {v.shape[0] if v.ndim else None for v in arrs.values()}
-        if len(rows) != 1 or None in rows:
-            raise ValueError(
-                "all feeds need the same leading batch dim; got %s"
-                % {k: v.shape for k, v in arrs.items()})
+        self._cfg.validate_request(arrs)
         if hasattr(self._pred, "get_input_names"):
             expected = set(self._pred.get_input_names())
             if self._mask_feed is not None:
@@ -432,18 +418,7 @@ class InferenceServer:
 
     # -- batching: signatures + per-signature pending queues -------------
     def _signature(self, req):
-        """Requests share a batch iff same feeds, dtypes, and non-batch
-        dims — except declared ragged axes, which are wildcarded (they
-        pad to a common bucket)."""
-        sig = []
-        for k in sorted(req.inputs):
-            v = req.inputs[k]
-            dims = list(v.shape[1:])
-            for ax in self._ragged.get(k, {}):
-                if 1 <= ax <= len(dims):
-                    dims[ax - 1] = None
-            sig.append((k, str(v.dtype), tuple(dims)))
-        return tuple(sig)
+        return self._cfg.signature(req.inputs)
 
     def _enqueue_pending(self, req):
         with self._plock:
@@ -486,6 +461,7 @@ class InferenceServer:
                 total += r.rows
             if not dq:
                 del self._pending[sig]
+            self._inflight += len(group)
             return group
 
     # -- stage 1: dispatch (coalesce -> pad -> async device call) --------
@@ -521,65 +497,13 @@ class InferenceServer:
             if group:
                 self._dispatch_group(group)
 
-    def _bucket(self, n, ladder):
-        for b in ladder:
-            if b >= n:
-                return b
-        return n  # beyond the ladder: exact shape (rare oversize batch)
-
-    def _mask_for(self, feed, rows_valid, group=None):
-        """Validity mask over the first DECLARED ragged feed/axis
-        (insertion order): (padded_batch, padded_extent) float32, 1.0
-        where real."""
-        name = next(iter(self._ragged))
-        ax = next(iter(self._ragged[name]))
-        padded = feed[name]
-        mask = np.zeros((padded.shape[0], padded.shape[ax]), np.float32)
-        if group is None:
-            mask[:rows_valid, :] = 1.0
-        else:
-            off = 0
-            for r in group:
-                mask[off:off + r.rows, :r.inputs[name].shape[ax]] = 1.0
-                off += r.rows
-        return mask
-
     def _dispatch_group(self, group):
         tracer = _trace.default_tracer()
         t_pad0 = time.perf_counter()
         try:
-            total = sum(r.rows for r in group)
-            padded_rows = self._bucket(total, self._batch_buckets) \
-                if self._batch_buckets else total
-            feed, real_elems, padded_elems = {}, 0, 0
-            for k in group[0].inputs:
-                arrs = [r.inputs[k] for r in group]
-                real_elems += sum(a.size for a in arrs)
-                ragged = self._ragged.get(k, {})
-                targets = {
-                    ax: self._bucket(max(a.shape[ax] for a in arrs),
-                                     buckets)
-                    for ax, buckets in ragged.items()
-                }
-                shape = list(arrs[0].shape)
-                shape[0] = padded_rows
-                for ax, ext in targets.items():
-                    shape[ax] = ext
-                if (len(group) == 1 and tuple(shape) == arrs[0].shape):
-                    feed[k] = arrs[0]          # no copy on the fast path
-                else:
-                    out = np.zeros(tuple(shape), arrs[0].dtype)
-                    off = 0
-                    for a in arrs:
-                        dst = (slice(off, off + a.shape[0]),) + tuple(
-                            slice(0, d) for d in a.shape[1:])
-                        out[dst] = a
-                        off += a.shape[0]
-                    feed[k] = out
-                padded_elems += feed[k].size
-            if self._mask_feed is not None:
-                feed[self._mask_feed] = self._mask_for(
-                    feed, rows_valid=total, group=group)
+            feed, total, real_elems, padded_elems = self._cfg.coalesce(
+                [r.inputs for r in group])
+            padded_rows = feed[next(iter(feed))].shape[0]
             self._n_batches.inc()
             self._h_batch_size.observe(total)
             with self._plock:
@@ -669,6 +593,8 @@ class InferenceServer:
                         self._emit_request_trace(tracer, r)
                 for r in group:
                     r.event.set()
+                with self._plock:
+                    self._inflight -= len(group)
             except Exception as e:
                 self._fail_group(group, e)
 
@@ -711,49 +637,54 @@ class InferenceServer:
             r.error = "%s: %s" % (type(exc).__name__, exc)
             r.error_type = type(exc)
             r.event.set()
+        with self._plock:
+            self._inflight -= len(group)
 
     # -- HTTP endpoint ---------------------------------------------------
-    def serve_http(self, host="127.0.0.1", port=8080, block=True):
+    def serve_http(self, host="127.0.0.1", port=8080, block=True,
+                   install_sigterm=True, drain_timeout=30.0):
         """JSON protocol (cross-language surface): POST /predict with
         {"inputs": {name: nested-list}, "dtypes": {name: "float32"}} ->
         {"outputs": [nested-list, ...], "trace_id": "req-..."} — the
         trace id names the request's span timeline (GET /trace, open in
         Perfetto) when tracing is enabled.  GET /health ->
-        {"status":"ok"}; GET /stats -> summary() JSON (incl.
+        {"status":"ok"}; GET /readyz -> 200 while serving, 503 once a
+        graceful shutdown began (fleet routers stop sending here before
+        the listener closes); GET /stats -> summary() JSON (incl.
         recent/slowest trace ids); GET /metrics -> Prometheus text
         exposition of the server's metrics registry (every subsystem
         reporting there, not just this server); GET /trace -> the
         tracer ring as a loadable chrome trace (409 while tracing is
         disabled).  Malformed requests get 400; internal inference
-        failures get 500.  Returns the HTTPServer (daemon-threaded when
-        block=False)."""
+        failures get 500; requests during a drain get 503 +
+        Retry-After instead of a dropped socket.
+
+        ``install_sigterm`` (main thread only; silently skipped
+        elsewhere) arms graceful shutdown on SIGTERM: readiness flips,
+        in-flight batches drain (bounded by ``drain_timeout``), the
+        listener closes, and the PREVIOUS handler is chained (the PR-6
+        flight-recorder convention — exit semantics survive, e.g. the
+        crash dump still fires and the process still dies by signal).
+        Returns the HTTPServer (daemon-threaded when block=False)."""
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from .http_common import JsonHandlerMixin, install_sigterm_drain
 
         server_self = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
-
-            def _send(self, code, payload):
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def _send_text(self, code, text, ctype):
-                body = text.encode()
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
 
             def do_GET(self):
                 if self.path == "/health":
                     self._send(200, {"status": "ok"})
+                elif self.path == "/readyz":
+                    if server_self.ready():
+                        self._send(200, {"ready": True})
+                    else:
+                        self._send(503, {"ready": False,
+                                         "reason": "draining"})
                 elif self.path == "/stats":
                     self._send(200, server_self.summary())
                 elif self.path == "/metrics":
@@ -798,6 +729,12 @@ class InferenceServer:
                     return
                 try:
                     outs, trace_id = server_self.infer_with_trace(feed)
+                except ServerClosing as e:
+                    # shutting down is not an error on either side: 503
+                    # + Retry-After tells the client/router to go
+                    # elsewhere, instead of a socket dropped mid-response
+                    self._send(503, {"error": str(e)},
+                               headers=(("Retry-After", "1"),))
                 except (ValueError, TypeError) as e:
                     # infer() rejected the request itself (feed names /
                     # batch dims): still the client's fault
@@ -811,6 +748,10 @@ class InferenceServer:
                                      "trace_id": trace_id})
 
         httpd = ThreadingHTTPServer((host, port), Handler)
+        if install_sigterm:
+            install_sigterm_drain(
+                httpd,
+                lambda: server_self.begin_graceful_shutdown(drain_timeout))
         if block:
             httpd.serve_forever()
         else:

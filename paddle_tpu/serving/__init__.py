@@ -1,0 +1,44 @@
+"""paddle_tpu.serving — the multi-replica serving platform.
+
+The production tier above `inference/server.py`'s single-process
+`InferenceServer` (SURVEY §1 row 9: the reference's AnalysisPredictor +
+C/Go clients were ITS production tier; this is ours, TPU-first):
+
+* `Router` — N predictor replicas (in-process threads or subprocess
+  workers behind one `Replica` interface) fed from router-level
+  per-signature queues: continuous batching across replicas with the
+  oldest-first discipline of PR 2's batcher;
+* `ModelRegistry` — named versions with a gated lifecycle
+  (load -> `analysis` verify -> bucket-ladder warmup -> ready ->
+  atomic cutover -> drain -> retire/standby) giving zero-downtime
+  hot-swap, rollback-on-gate-failure, and operator `rollback()`;
+* canary (deterministic request-id hash split) and shadow traffic
+  (mirrored, compared, diffed into metrics, never returned);
+* `AdmissionController` — SLO-aware load shedding: 503 + Retry-After
+  from measured service rate and queue depth, per-version caps;
+* `serve_http` — the HTTP front: /predict, /healthz, /readyz, /stats,
+  /metrics, and the /admin plane `tools/serving_ctl.py` drives.
+
+Fault drills live in `incubate.fault` (``kill_replica`` events) and
+`tests/test_serving_platform.py`; `benchmarks/serving_fleet_bench.py`
+measures goodput/shed/p99 vs replica count under open-loop overload.
+"""
+
+from ..inference.batching import BatchingConfig  # noqa: F401
+from .admission import AdmissionController, ShedError  # noqa: F401
+from .canary import ShadowComparer, canary_fraction  # noqa: F401
+from .http_front import serve_http  # noqa: F401
+from .registry import (  # noqa: F401
+    DeployError,
+    ModelRegistry,
+    ModelVersion,
+    TransitionError,
+)
+from .replica import (  # noqa: F401
+    InProcessReplica,
+    ProcessReplica,
+    Replica,
+    ReplicaDeadError,
+    make_replicas,
+)
+from .router import Router  # noqa: F401

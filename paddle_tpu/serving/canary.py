@@ -1,0 +1,92 @@
+"""Canary and shadow traffic semantics.
+
+* **Canary**: a deterministic fraction of live traffic is ROUTED to the
+  candidate version — those clients get the canary's answers.  The
+  split hashes the request id (`registry.canary_fraction`), so a given
+  id always lands on the same side: retries are stable, sessions are
+  sticky, and two front-tier processes agree without coordination.
+
+* **Shadow**: ALL eligible primary traffic is MIRRORED to the candidate
+  after the primary answer is produced — the shadow's answers are
+  compared and folded into metrics, **never returned** to anyone.
+  Shadowing is how a version earns a canary: it sees production shapes
+  and values at production rate with zero client exposure.  Shadow work
+  is strictly best-effort: a bounded backlog drops mirrors (counted)
+  rather than ever slowing primaries.
+
+The comparison below is the shadow's scorecard: elementwise max
+absolute difference against the primary (all outputs), a mismatch flag
+at a configurable tolerance, and shape mismatches counted as their own
+failure mode (a new version that changes output shapes should fail
+loudly in metrics, not crash the comparer).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .registry import canary_fraction  # noqa: F401  (public here too)
+
+__all__ = ["ShadowComparer", "canary_fraction"]
+
+
+class ShadowComparer:
+    """Scores shadow outputs against primary outputs into metrics.
+
+    Families (labels ``front``, ``version`` = the shadow version):
+      * ``serving_fleet_shadow_compared_total``
+      * ``serving_fleet_shadow_mismatch_total``  (beyond tolerance or
+        shape/count mismatch)
+      * ``serving_fleet_shadow_absdiff`` histogram of per-request max
+        absolute difference (comparable outputs only)
+    """
+
+    def __init__(self, registry, front_label, atol=1e-5, rtol=1e-5):
+        self.atol = float(atol)
+        self.rtol = float(rtol)
+        lbl = ("front", "version")
+        self._compared = registry.counter(
+            "serving_fleet_shadow_compared_total",
+            "Shadow responses compared against primaries", labelnames=lbl)
+        self._mismatch = registry.counter(
+            "serving_fleet_shadow_mismatch_total",
+            "Shadow responses differing beyond tolerance", labelnames=lbl)
+        self._absdiff = registry.histogram(
+            "serving_fleet_shadow_absdiff",
+            "Max |shadow - primary| per compared request", labelnames=lbl,
+            buckets=(1e-7, 1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0))
+        self._front = front_label
+
+    def compare(self, shadow_version, primary_outputs, shadow_outputs):
+        """Returns {"max_abs_diff", "mismatch"} and records metrics."""
+        labels = (self._front, str(shadow_version))
+        self._compared.labels(*labels).inc()
+        mismatch = False
+        max_diff = 0.0
+        if len(primary_outputs) != len(shadow_outputs):
+            mismatch = True
+        else:
+            for p, s in zip(primary_outputs, shadow_outputs):
+                p = np.asarray(p)
+                s = np.asarray(s)
+                if p.shape != s.shape:
+                    mismatch = True
+                    continue
+                if p.size == 0:
+                    continue
+                try:
+                    diff = float(np.max(np.abs(
+                        p.astype(np.float64) - s.astype(np.float64))))
+                except TypeError:      # non-numeric dtype: exact match only
+                    if not np.array_equal(p, s):
+                        mismatch = True
+                    continue
+                max_diff = max(max_diff, diff)
+                tol = self.atol + self.rtol * float(
+                    np.max(np.abs(p.astype(np.float64))))
+                if diff > tol:
+                    mismatch = True
+        if mismatch:
+            self._mismatch.labels(*labels).inc()
+        self._absdiff.labels(*labels).observe(max_diff)
+        return {"max_abs_diff": max_diff, "mismatch": mismatch}

@@ -1,0 +1,206 @@
+"""HTTP front tier over the fleet `Router`: data plane + admin plane.
+
+Data plane (what clients and load balancers speak):
+
+  * ``POST /predict`` ``{"inputs": {name: nested-list},
+    "dtypes": {name: "float32"}, "request_id": "..."}`` ->
+    ``{"outputs": [...], "trace_id", "request_id", "version", "route"}``.
+    400 malformed / 500 internal / **503 + Retry-After** when shed or
+    draining (the load balancer's cue to try another front).
+  * ``GET /healthz`` — process liveness (200 while the listener runs).
+  * ``GET /readyz`` — routability: 200 iff a promoted stable version
+    has >= 1 alive replica and no drain is in progress; 503 otherwise
+    with the reason.  This is what a fleet LB health-checks.
+  * ``GET /stats`` — router.stats() JSON; ``GET /metrics`` — Prometheus
+    text of the whole registry.
+
+Admin plane (what `tools/serving_ctl.py` speaks; one JSON POST per
+lifecycle transition, GET for reads):
+
+  * ``GET  /admin/models``            — registry + version states
+  * ``POST /admin/deploy``   ``{"version", "model_dir", "replicas",
+                                "kind", "warmup_inputs"?, "dtypes"?}``
+  * ``POST /admin/promote``  ``{"version", "keep_old"?}``
+  * ``POST /admin/rollback`` ``{}``
+  * ``POST /admin/canary``   ``{"version", "percent"}`` (0 clears)
+  * ``POST /admin/shadow``   ``{"version"}`` (null clears)
+  * ``POST /admin/retire``   ``{"version"}``
+
+Refused transitions (`TransitionError`) and failed deploy gates
+(`DeployError`) answer **409** with the reason — serving_ctl turns any
+non-2xx into rc != 0.  SIGTERM gracefully drains the router (readyz
+flips first) and chains the previous handler, PR-6 style.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .admission import ShedError
+from .registry import DeployError, TransitionError
+
+__all__ = ["serve_http"]
+
+
+def serve_http(router, host="127.0.0.1", port=8080, block=True,
+               admin=True, install_sigterm=True, drain_timeout=30.0):
+    """Serve `router` over HTTP; returns the HTTPServer
+    (daemon-threaded when block=False).  ``admin=False`` disables the
+    mutating /admin endpoints (exposed data plane, private admin
+    plane)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from ..inference.http_common import (
+        JsonHandlerMixin,
+        install_sigterm_drain,
+    )
+
+    class Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
+        def log_message(self, *a):    # quiet
+            pass
+
+        # -- GET ---------------------------------------------------------
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {"status": "ok"})
+            elif self.path == "/readyz":
+                if router.ready():
+                    self._send(200, {"ready": True,
+                                     "stable": router.registry.stable})
+                else:
+                    reason = ("draining" if router._draining.is_set()
+                              else "no serving version with alive replicas")
+                    self._send(503, {"ready": False, "reason": reason})
+            elif self.path == "/stats":
+                self._send(200, router.stats())
+            elif self.path == "/metrics":
+                from ..observability.export import prometheus_text
+
+                self._send_text(
+                    200, prometheus_text(router.metrics_registry),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            elif self.path == "/admin/models":
+                self._send(200, router.registry.describe())
+            else:
+                self._send(404, {"error": "unknown path %r" % self.path})
+
+        # -- POST --------------------------------------------------------
+        def do_POST(self):
+            if self.path == "/predict":
+                return self._predict()
+            if not self.path.startswith("/admin/"):
+                self._send(404, {"error": "unknown path %r" % self.path})
+                return
+            if not admin:
+                self._send(403, {"error": "admin plane disabled"})
+                return
+            try:
+                msg = self._body()
+            except Exception as e:
+                self._send(400, {"error": "%s: %s" % (type(e).__name__, e)})
+                return
+            try:
+                out = self._admin(self.path[len("/admin/"):], msg)
+            except (TransitionError, DeployError) as e:
+                # a REFUSED transition: the operator's request was
+                # understood and denied — 409, serving_ctl exits rc=1
+                self._send(409, {"error": str(e),
+                                 "refused": True})
+            except (ValueError, TypeError, KeyError) as e:
+                self._send(400, {"error": "%s: %s" % (type(e).__name__, e)})
+            except Exception as e:
+                self._send(500, {"error": "%s: %s" % (type(e).__name__, e)})
+            else:
+                self._send(200, out)
+
+        def _admin(self, op, msg):
+            if op == "deploy":
+                warmup = None
+                if msg.get("warmup_inputs"):
+                    dtypes = msg.get("dtypes", {})
+                    warmup = {
+                        k: np.asarray(v, dtype=dtypes.get(k, "float32"))
+                        for k, v in msg["warmup_inputs"].items()
+                    }
+                mv = router.deploy(
+                    msg["version"], msg["model_dir"],
+                    replicas=int(msg.get("replicas", 1)),
+                    kind=msg.get("kind", "thread"),
+                    warmup_example=warmup)
+                return mv.describe()
+            if op == "promote":
+                mv = router.promote(
+                    msg["version"], keep_old=bool(msg.get("keep_old")),
+                    drain_timeout=float(msg.get("drain_timeout", 30.0)))
+                return mv.describe()
+            if op == "rollback":
+                mv = router.rollback(
+                    drain_timeout=float(msg.get("drain_timeout", 30.0)))
+                return mv.describe()
+            if op == "canary":
+                router.set_canary(msg["version"],
+                                  float(msg.get("percent", 0.0)))
+                return router.registry.describe()
+            if op == "shadow":
+                router.set_shadow(msg.get("version"))
+                return router.registry.describe()
+            if op == "retire":
+                mv = router.retire(
+                    msg["version"],
+                    drain_timeout=float(msg.get("drain_timeout", 30.0)))
+                return mv.describe()
+            raise ValueError("unknown admin op %r" % op)
+
+        def _predict(self):
+            try:
+                msg = self._body()
+                if not isinstance(msg.get("inputs"), dict):
+                    raise ValueError('body needs an "inputs" object')
+                dtypes = msg.get("dtypes", {})
+                feed = {
+                    k: np.asarray(v, dtype=dtypes.get(k, "float32"))
+                    for k, v in msg["inputs"].items()
+                }
+                request_id = msg.get("request_id")
+            except Exception as e:
+                self._send(400, {"error": "%s: %s" % (type(e).__name__, e)})
+                return
+            try:
+                outs, info = router.infer_with_details(
+                    feed, request_id=request_id,
+                    timeout=float(msg.get("timeout", 30.0)))
+            except ShedError as e:
+                self._send(
+                    503, {"error": str(e), "shed": True,
+                          "reason": e.reason},
+                    headers=(("Retry-After", str(e.retry_after_s)),))
+            except TransitionError as e:
+                # no promoted version yet: not routable, not a crash
+                self._send(503, {"error": str(e)},
+                           headers=(("Retry-After", "1"),))
+            except TimeoutError as e:
+                self._send(504, {"error": str(e)})
+            except (ValueError, TypeError) as e:
+                self._send(400, {"error": "%s: %s" % (type(e).__name__, e)})
+            except Exception as e:
+                self._send(500, {"error": "%s: %s" % (type(e).__name__, e)})
+            else:
+                payload = {"outputs": [o.tolist() for o in outs]}
+                payload.update(info)
+                self._send(200, payload)
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    if install_sigterm:
+        # readyz flips inside shutdown() before any replica closes; the
+        # previous handler is chained (flight-recorder dump +
+        # die-by-signal semantics survive)
+        install_sigterm_drain(
+            httpd, lambda: router.shutdown(drain_timeout=drain_timeout))
+    if block:
+        httpd.serve_forever()
+    else:
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+    return httpd

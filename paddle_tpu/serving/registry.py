@@ -1,0 +1,271 @@
+"""Model registry: named versions, their lifecycle, and the routing
+pointers (stable / canary / shadow) the fleet router consults.
+
+Version lifecycle (one way, except the serving<->ready cycle)::
+
+    loading -> verifying -> warming -> ready
+                  |             |
+                  +-- rejected--+        (gate failure: never serves)
+
+    ready -> serving (atomic cutover) -> draining -> ready   (standby)
+                                                  -> retired (replicas closed)
+
+The registry is bookkeeping only — it never touches replicas or queues.
+State transitions are validated HERE (`TransitionError` on refusal, the
+condition `tools/serving_ctl.py` turns into rc!=0) and driven by the
+router, which owns the mechanics (loading replicas, draining queues).
+Cutover atomicity = swapping `stable` under the registry lock: a request
+routed before the swap drains on the old version's replicas, a request
+routed after lands on the new — no request observes half a swap.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+
+__all__ = [
+    "DeployError",
+    "ModelRegistry",
+    "ModelVersion",
+    "TransitionError",
+    "canary_fraction",
+]
+
+# lifecycle states
+LOADING = "loading"
+VERIFYING = "verifying"
+WARMING = "warming"
+READY = "ready"          # loaded+verified+warm; no traffic unless canary/shadow
+SERVING = "serving"      # the stable version
+DRAINING = "draining"    # cut away from traffic; queues emptying
+RETIRED = "retired"      # drained and replicas closed
+REJECTED = "rejected"    # failed a deploy gate; never served
+
+_GATE_STATES = (LOADING, VERIFYING, WARMING)
+
+
+class DeployError(RuntimeError):
+    """A deploy gate (load / verify / warmup) failed; the version is
+    `rejected` and the previously serving version is untouched."""
+
+
+class TransitionError(RuntimeError):
+    """A refused lifecycle transition (promote a non-ready version,
+    retire the stable version, canary to a draining version, ...)."""
+
+
+def canary_fraction(request_id):
+    """Deterministic [0, 1) hash of a request id: the same id always
+    lands on the same side of a canary split (client retries included),
+    and the split needs no coordination between front-tier processes."""
+    return (zlib.crc32(str(request_id).encode()) & 0xFFFFFFFF) / 2.0 ** 32
+
+
+class ModelVersion:
+    """One deployed version: name, source dir, replicas, lifecycle."""
+
+    def __init__(self, version, model_dir):
+        self.version = str(version)
+        self.model_dir = model_dir
+        self.state = LOADING
+        self.error = None          # why rejected, when rejected
+        self.replicas = []         # Replica objects (router attaches)
+        self.feed_names = None
+        self.created_at = time.time()
+        self.requests = 0          # fulfilled primary requests
+        self.warmed = False        # bucket ladder AOT-built at deploy
+
+    @property
+    def alive_replicas(self):
+        return [r for r in self.replicas if r.alive]
+
+    def describe(self):
+        return {
+            "version": self.version,
+            "model_dir": self.model_dir,
+            "state": self.state,
+            "error": self.error,
+            "replicas": len(self.replicas),
+            "replicas_alive": len(self.alive_replicas),
+            "requests": self.requests,
+            "warmed": self.warmed,
+        }
+
+
+class ModelRegistry:
+    """Versions + routing pointers; every mutation validated and locked."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._versions = {}
+        self.stable = None           # version name serving default traffic
+        self.previous_stable = None  # rollback target (if kept on standby)
+        self.canary = None           # (version name, fraction 0..1)
+        self.shadow = None           # version name mirrored to, or None
+
+    # -- lookup -----------------------------------------------------------
+    def get(self, version, required=True):
+        with self._lock:
+            mv = self._versions.get(str(version))
+        if mv is None and required:
+            raise TransitionError("unknown version %r" % version)
+        return mv
+
+    def versions(self):
+        with self._lock:
+            return list(self._versions.values())
+
+    def describe(self):
+        with self._lock:
+            return {
+                "stable": self.stable,
+                "previous_stable": self.previous_stable,
+                "canary": ({"version": self.canary[0],
+                            "percent": self.canary[1] * 100.0}
+                           if self.canary else None),
+                "shadow": self.shadow,
+                "versions": [mv.describe()
+                             for mv in self._versions.values()],
+            }
+
+    # -- deploy gates -----------------------------------------------------
+    def begin_deploy(self, version, model_dir):
+        with self._lock:
+            v = str(version)
+            mv = self._versions.get(v)
+            if mv is not None and mv.state not in (RETIRED, REJECTED):
+                raise TransitionError(
+                    "version %r already exists in state %r" % (v, mv.state))
+            mv = ModelVersion(v, model_dir)
+            self._versions[v] = mv
+            return mv
+
+    def gate(self, mv, state):
+        """Advance a deploy through loading->verifying->warming->ready."""
+        with self._lock:
+            if mv.state not in _GATE_STATES:
+                raise TransitionError(
+                    "version %r is %r, not mid-deploy" % (mv.version, mv.state))
+            mv.state = state
+
+    def reject(self, mv, error):
+        with self._lock:
+            mv.state = REJECTED
+            mv.error = str(error)
+            # a rejected version can never be a routing target
+            if self.canary and self.canary[0] == mv.version:
+                self.canary = None
+            if self.shadow == mv.version:
+                self.shadow = None
+
+    # -- routing ----------------------------------------------------------
+    def route(self, request_id):
+        """(version_name, route_label) for a request id — deterministic
+        per id while the split is unchanged."""
+        with self._lock:
+            if self.canary is not None:
+                canary_version, frac = self.canary
+                if canary_fraction(request_id) < frac:
+                    return canary_version, "canary"
+            if self.stable is None:
+                raise TransitionError("no version has been promoted")
+            return self.stable, "stable"
+
+    # -- transitions (validation only; the router drives the mechanics) --
+    def promote(self, version):
+        """Atomic cutover: `version` becomes stable, the old stable (if
+        any) moves to draining.  Returns the old stable ModelVersion or
+        None."""
+        with self._lock:
+            mv = self.get(version)
+            if mv.state not in (READY,):
+                raise TransitionError(
+                    "cannot promote %r from state %r (need %r)"
+                    % (mv.version, mv.state, READY))
+            if not mv.alive_replicas:
+                raise TransitionError(
+                    "cannot promote %r: no alive replicas" % mv.version)
+            old = self._versions.get(self.stable) if self.stable else None
+            self.previous_stable = self.stable
+            self.stable = mv.version
+            mv.state = SERVING
+            if self.canary and self.canary[0] == mv.version:
+                self.canary = None        # the canary graduated
+            if self.shadow == mv.version:
+                self.shadow = None        # a shadow cannot also be stable
+            if old is not None:
+                old.state = DRAINING
+            return old
+
+    def set_canary(self, version, percent):
+        with self._lock:
+            pct = float(percent)
+            if not 0.0 <= pct <= 100.0:
+                raise TransitionError(
+                    "canary percent must be in [0, 100], got %r" % percent)
+            if pct == 0.0:
+                self.canary = None
+                return
+            mv = self.get(version)
+            if mv.state != READY:
+                raise TransitionError(
+                    "cannot canary %r from state %r (need %r)"
+                    % (mv.version, mv.state, READY))
+            if mv.version == self.stable:
+                raise TransitionError(
+                    "%r is already the stable version" % mv.version)
+            self.canary = (mv.version, pct / 100.0)
+
+    def set_shadow(self, version):
+        with self._lock:
+            if version is None:
+                self.shadow = None
+                return
+            mv = self.get(version)
+            if mv.state != READY:
+                raise TransitionError(
+                    "cannot shadow to %r in state %r (need %r)"
+                    % (mv.version, mv.state, READY))
+            if mv.version == self.stable:
+                raise TransitionError(
+                    "%r is the stable version; shadowing it to itself is "
+                    "meaningless" % mv.version)
+            self.shadow = mv.version
+
+    def rollback_target(self):
+        with self._lock:
+            if self.previous_stable is None:
+                raise TransitionError("no previous stable version to "
+                                      "roll back to")
+            mv = self.get(self.previous_stable)
+            if mv.state != READY or not mv.alive_replicas:
+                raise TransitionError(
+                    "previous stable %r is %r with %d alive replicas — "
+                    "not a standby (promote with keep_old=True to keep "
+                    "rollback targets warm)"
+                    % (mv.version, mv.state, len(mv.alive_replicas)))
+            return mv
+
+    def mark_drained(self, mv, retired):
+        with self._lock:
+            if mv.state == DRAINING:
+                mv.state = RETIRED if retired else READY
+
+    def begin_retire(self, version):
+        with self._lock:
+            mv = self.get(version)
+            if mv.version == self.stable:
+                raise TransitionError(
+                    "refusing to retire the stable version %r (promote a "
+                    "replacement first)" % mv.version)
+            if mv.state not in (READY, DRAINING):
+                raise TransitionError(
+                    "cannot retire %r from state %r" % (mv.version, mv.state))
+            if self.canary and self.canary[0] == mv.version:
+                self.canary = None
+            if self.shadow == mv.version:
+                self.shadow = None
+            mv.state = DRAINING
+            return mv

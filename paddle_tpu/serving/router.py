@@ -1,0 +1,765 @@
+"""The fleet router: N predictor replicas, one front door.
+
+Composition of everything the serving story needs (the single-process
+`InferenceServer` remains the one-replica special case; this is the
+layer above it):
+
+* **continuous batching across replicas** — one set of per-version,
+  per-signature pending queues at the ROUTER (same oldest-first
+  discipline as `inference/server.py`), with one worker thread per
+  replica pulling the next oldest group whenever its replica frees a
+  slot.  A fleet of R replicas therefore keeps R padded batches in
+  flight with zero static partitioning of traffic;
+* **versioned zero-downtime hot-swap** — `deploy()` drives
+  load -> analysis verify gate -> bucket-ladder warmup -> `ready`;
+  `promote()` is an atomic cutover under the registry lock followed by
+  drain-then-retire (or drain-to-standby for instant `rollback()`);
+  any gate failure rejects the candidate and leaves the old version
+  serving — a bad model never receives traffic;
+* **canary / shadow** — deterministic request-id hash split routes a
+  fraction to the canary; shadow mirrors primary traffic to a candidate
+  after the primary answer is produced, compares, and records diffs in
+  metrics (never returned);
+* **SLO-aware load shedding** — `AdmissionController` rejects at the
+  front door (`ShedError` -> HTTP 503 + Retry-After) using the measured
+  service rate and queue depth, so an overloaded fleet keeps bounded
+  p99 for admitted requests instead of collapsing;
+* **replica fault tolerance** — a dead replica (process SIGKILL, OOM,
+  injected drill death) fails only its in-flight group, which is
+  re-queued exactly ONCE at the head of the line; a request that
+  watches two replicas die fails loudly.  No request is lost, none is
+  served twice;
+* **observability** — per-version/per-replica labels on the PR-4
+  registry, per-request async span timelines on the PR-6 tracer, and
+  `/healthz` / `/readyz` wired to replica state via `ready()`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from ..inference.batching import BatchingConfig
+from ..observability import trace as _trace
+from ..observability.metrics import default_registry, unique_instance_label
+from .admission import AdmissionController, ShedError
+from .canary import ShadowComparer
+from .registry import (
+    READY,
+    VERIFYING,
+    WARMING,
+    DeployError,
+    ModelRegistry,
+)
+from .replica import InProcessReplica, ReplicaDeadError, make_replicas
+
+__all__ = ["Router"]
+
+
+class _FleetRequest:
+    __slots__ = ("request_id", "inputs", "rows", "seq", "event", "outputs",
+                 "error", "error_type", "version", "route", "requeued",
+                 "shadow_expect", "abandoned", "trace_id", "replica_id",
+                 "t_enq", "t_enq_pc", "t_taken", "t_disp", "t_mat", "t_done")
+
+    def __init__(self, request_id, inputs, seq, version, route,
+                 shadow_expect=None):
+        self.request_id = request_id
+        self.inputs = inputs
+        self.rows = inputs[next(iter(inputs))].shape[0]
+        self.seq = seq
+        self.event = None if shadow_expect is not None else threading.Event()
+        self.outputs = None
+        self.error = None
+        self.error_type = None
+        self.version = version
+        self.route = route
+        self.requeued = False
+        self.shadow_expect = shadow_expect   # primary outputs (shadow only)
+        self.abandoned = False
+        self.trace_id = _trace.new_trace_id("req")
+        self.replica_id = None
+        self.t_enq = time.monotonic()
+        self.t_enq_pc = time.perf_counter()
+        self.t_taken = None
+        self.t_disp = None
+        self.t_mat = None
+        self.t_done = None
+
+
+class _VersionRuntime:
+    """Router-side mutable state for one version (guarded by the
+    router's condition variable)."""
+
+    def __init__(self):
+        self.pending = OrderedDict()   # signature -> deque[_FleetRequest]
+        self.queued_rows = 0
+        self.inflight_rows = 0
+        self.rows_done = 0.0           # completed rows (service-rate est)
+        self.busy_seconds = 0.0        # replica-seconds spent on batches
+        self.stopped = False
+        self.workers = []
+
+
+class Router:
+    """Multi-replica serving front tier (see module docstring).
+
+    Batch shaping (``max_batch`` / ``batch_buckets`` / ``ragged_dims`` /
+    ``mask_feed``) has `InferenceServer` semantics and is uniform across
+    versions — a hot-swap changes weights, not the executable ladder.
+
+    ``predictor_factory(model_dir)`` overrides how "thread"-kind
+    replicas get their predictor (tests inject fakes).  ``name`` labels
+    every metric family child (``front=<name>``, made unique)."""
+
+    def __init__(self, max_batch=32, batch_timeout_ms=2.0,
+                 batch_buckets=None, ragged_dims=None, mask_feed=None,
+                 admission=None, name="fleet", metrics_registry=None,
+                 predictor_factory=None, shadow_atol=1e-5, shadow_rtol=1e-5,
+                 max_shadow_backlog_rows=256):
+        self._cfg = BatchingConfig(
+            max_batch=max_batch, batch_buckets=batch_buckets,
+            ragged_dims=ragged_dims, mask_feed=mask_feed)
+        self._timeout = max(batch_timeout_ms, 0.0) / 1e3
+        self._registry = ModelRegistry()
+        self._admission = admission or AdmissionController()
+        self._predictor_factory = predictor_factory
+        self._max_shadow_backlog = int(max_shadow_backlog_rows)
+        self._cond = threading.Condition()
+        self._rt = {}                   # version -> _VersionRuntime
+        self._seq = itertools.count()
+        self._stop_all = False
+        self._draining = threading.Event()
+        self._recent = deque(maxlen=64)
+
+        reg = metrics_registry or default_registry()
+        self.metrics_registry = reg
+        self.name = name
+        self._front = unique_instance_label(name)
+        fv = ("front", "version")
+        self._m_requests = reg.counter(
+            "serving_fleet_requests_total", "Admitted fleet requests",
+            labelnames=("front", "version", "route"))
+        self._m_errors = reg.counter(
+            "serving_fleet_errors_total", "Failed fleet requests",
+            labelnames=fv)
+        self._m_shed = reg.counter(
+            "serving_fleet_shed_total", "Requests refused at admission",
+            labelnames=("front", "reason"))
+        self._m_batches = reg.counter(
+            "serving_fleet_batches_total", "Dispatched fleet batches",
+            labelnames=("front", "version", "replica"))
+        self._m_requeued = reg.counter(
+            "serving_fleet_requeued_total",
+            "Requests re-queued after a replica death", labelnames=fv)
+        self._m_replica_deaths = reg.counter(
+            "serving_fleet_replica_deaths_total", "Replica deaths",
+            labelnames=fv)
+        self._m_shadow_dropped = reg.counter(
+            "serving_fleet_shadow_dropped_total",
+            "Shadow mirrors dropped by the backlog bound", labelnames=fv)
+        self._m_latency = reg.histogram(
+            "serving_fleet_latency_ms",
+            "Request latency enqueue->materialized (ms)", labelnames=fv)
+        self._m_batch_ms = reg.histogram(
+            "serving_fleet_batch_ms", "Per-batch replica wall time (ms)",
+            labelnames=fv)
+        self._m_batch_rows = reg.histogram(
+            "serving_fleet_batch_rows", "Coalesced rows per batch",
+            labelnames=fv,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+        self._m_pad_waste = reg.histogram(
+            "serving_fleet_padding_waste",
+            "Padded-but-dead fraction of dispatched elements",
+            labelnames=fv,
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9))
+        self._m_queue_rows = reg.gauge(
+            "serving_fleet_queue_rows", "Queued rows across all versions",
+            labelnames=("front",)).labels(self._front)
+        self._m_replicas_alive = reg.gauge(
+            "serving_fleet_replicas_alive", "Alive replicas", labelnames=fv)
+        self._shadow_cmp = ShadowComparer(
+            reg, self._front, atol=shadow_atol, rtol=shadow_rtol)
+
+    # -- registry passthrough ---------------------------------------------
+    @property
+    def registry(self):
+        return self._registry
+
+    @property
+    def batching(self):
+        return self._cfg
+
+    # -- lifecycle: deploy ------------------------------------------------
+    def deploy(self, version, model_dir, replicas=1, kind="thread",
+               warmup_example=None, env=None):
+        """The gated pipeline: load -> verify -> warmup -> ready.
+
+        Any failure rejects the version (replicas closed, state
+        `rejected`, `DeployError` raised) and the currently serving
+        version is untouched — rollback-on-gate-failure is the default
+        behavior, not an operation.
+
+        The warmup gate needs ``warmup_example`` ({feed: array} with
+        representative non-ragged feature dims) to know the model's
+        concrete shapes; WITHOUT it the gate is skipped and the version
+        reaches `ready` cold — promote() then pays XLA compilation on
+        the first request of every bucket shape.  `describe()['warmed']`
+        records which happened."""
+        mv = self._registry.begin_deploy(version, model_dir)
+        with self._cond:
+            self._rt[mv.version] = _VersionRuntime()
+        t0 = time.monotonic()
+        try:
+            reps = make_replicas(kind, model_dir, int(replicas), mv.version,
+                                 predictor_factory=self._predictor_factory,
+                                 env=env)
+            mv.replicas = reps
+            mv.feed_names = getattr(reps[0], "feed_names", None)
+            self._registry.gate(mv, VERIFYING)
+            for r in reps:
+                self._verify_replica(mv, r)
+            self._registry.gate(mv, WARMING)
+            if warmup_example is not None:
+                specs = self._cfg.ladder_specs(warmup_example)
+                for r in reps:
+                    r.warmup(specs)
+                mv.warmed = True
+            self._registry.gate(mv, READY)
+        except Exception as e:
+            failed_gate = mv.state
+            for r in mv.replicas:
+                try:
+                    r.close()
+                except Exception:
+                    pass
+            self._registry.reject(mv, e)
+            raise DeployError(
+                "deploy of %r rejected at gate %r: %s"
+                % (mv.version, failed_gate, e)) from e
+        rt = self._rt[mv.version]
+        for r in reps:
+            t = threading.Thread(target=self._worker_loop, args=(mv, r),
+                                 name="serve-%s" % r.replica_id, daemon=True)
+            rt.workers.append(t)
+            t.start()
+        self._m_replicas_alive.labels(self._front, mv.version).set(len(reps))
+        _trace.instant("serving.deployed", args={
+            "version": mv.version, "replicas": len(reps),
+            "seconds": round(time.monotonic() - t0, 3)}, cat="serving")
+        return mv
+
+    def _verify_replica(self, mv, replica):
+        """The analysis structural gate, run UNCONDITIONALLY at deploy
+        (the load path's FLAGS_verify_io_programs gate can be toggled
+        off; the fleet's cannot).  Process replicas verified the
+        program in-worker during load — a corrupt model never produced
+        a "ready" handshake."""
+        if not isinstance(replica, InProcessReplica):
+            return
+        pred = replica._pred
+        program = getattr(pred, "_program", None)
+        if program is None:
+            return   # fake predictors in tests have no program
+        from .. import analysis
+
+        analysis.assert_program_valid(
+            program,
+            feed_names=list(getattr(pred, "_feed_names", []) or []),
+            fetch_names=list(getattr(pred, "_fetch_names", []) or []),
+            check_shapes=False,
+            what="deploy gate for version %r" % mv.version)
+
+    # -- lifecycle: traffic transitions -----------------------------------
+    def promote(self, version, keep_old=False, drain_timeout=30.0):
+        """Atomic cutover to `version`; the old stable drains and is
+        then retired (default) or kept on warm standby
+        (``keep_old=True``) as the `rollback()` target."""
+        old = self._registry.promote(version)
+        _trace.instant("serving.cutover", args={
+            "to": str(version),
+            "from": old.version if old else None}, cat="serving")
+        if old is not None:
+            self._finish_drain(old, retire=not keep_old,
+                               drain_timeout=drain_timeout)
+        return self._registry.get(version)
+
+    def rollback(self, drain_timeout=30.0):
+        """Re-promote the previous stable (kept via keep_old=True)."""
+        target = self._registry.rollback_target()
+        return self.promote(target.version, keep_old=True,
+                            drain_timeout=drain_timeout)
+
+    def set_canary(self, version, percent):
+        self._registry.set_canary(version, percent)
+
+    def set_shadow(self, version):
+        self._registry.set_shadow(version)
+
+    def retire(self, version, drain_timeout=30.0):
+        """Drain and close a non-stable version's replicas."""
+        mv = self._registry.begin_retire(version)
+        self._finish_drain(mv, retire=True, drain_timeout=drain_timeout)
+        return mv
+
+    def _finish_drain(self, mv, retire, drain_timeout):
+        rt = self._rt[mv.version]
+        deadline = time.monotonic() + max(float(drain_timeout), 0.0)
+        with self._cond:
+            while time.monotonic() < deadline:
+                if not rt.pending and rt.inflight_rows == 0:
+                    break
+                self._cond.wait(0.05)
+            # stopped is set under the SAME cond acquisition as the
+            # final emptiness check: an infer() that raced the drain
+            # either enqueued before (the loop saw it) or observes
+            # stopped and is refused — never enqueued-then-stranded
+            if retire:
+                rt.stopped = True
+                self._cond.notify_all()
+        if retire:
+            for w in rt.workers:
+                w.join(timeout=5)
+            for r in mv.replicas:
+                try:
+                    r.close()
+                except Exception:
+                    pass
+            self._m_replicas_alive.labels(self._front, mv.version).set(0)
+            self._fail_leftover_pending(
+                mv, rt, "version %r retired before the request was "
+                "served (drain timed out)" % mv.version)
+        self._registry.mark_drained(mv, retired=retire)
+
+    def _fail_leftover_pending(self, mv, rt, why):
+        """On a drain TIMEOUT, requests still queued when the workers
+        stopped fail loudly instead of hanging until client timeout."""
+        leftover = []
+        with self._cond:
+            for dq in rt.pending.values():
+                leftover.extend(dq)
+            rt.pending.clear()
+            rt.queued_rows = 0
+            self._m_queue_rows.set(self._total_queued_locked())
+        primaries = [r for r in leftover if r.event is not None]
+        if primaries:
+            self._m_errors.labels(self._front, mv.version).inc(
+                len(primaries))
+        for r in primaries:
+            r.error = why
+            r.error_type = RuntimeError
+            r.event.set()
+
+    # -- health -----------------------------------------------------------
+    def ready(self):
+        """/readyz contract: a promoted stable version with at least one
+        alive replica, and no platform-wide drain in progress."""
+        if self._draining.is_set() or self._stop_all:
+            return False
+        stable = self._registry.stable
+        if stable is None:
+            return False
+        mv = self._registry.get(stable, required=False)
+        return bool(mv and mv.alive_replicas)
+
+    def shutdown(self, drain_timeout=10.0):
+        """Graceful platform shutdown: refuse new requests (shed reason
+        "draining"), drain every version, stop workers, close replicas."""
+        self._draining.set()
+        for mv in self._registry.versions():
+            rt = self._rt.get(mv.version)
+            if rt is None or rt.stopped:
+                continue
+            deadline = time.monotonic() + max(float(drain_timeout), 0.0)
+            with self._cond:
+                while time.monotonic() < deadline:
+                    if not rt.pending and rt.inflight_rows == 0:
+                        break
+                    self._cond.wait(0.05)
+        with self._cond:
+            self._stop_all = True
+            self._cond.notify_all()
+        for mv in self._registry.versions():
+            rt = self._rt.get(mv.version)
+            if rt is not None:
+                for w in rt.workers:
+                    w.join(timeout=5)
+            for r in mv.replicas:
+                try:
+                    r.close()
+                except Exception:
+                    pass
+            self._m_replicas_alive.labels(self._front, mv.version).set(0)
+            if rt is not None:
+                self._fail_leftover_pending(
+                    mv, rt, "front tier shut down before the request "
+                    "was served")
+
+    # -- client API -------------------------------------------------------
+    def infer(self, inputs, request_id=None, timeout=30.0):
+        outs, _info = self.infer_with_details(
+            inputs, request_id=request_id, timeout=timeout)
+        return outs
+
+    def infer_with_details(self, inputs, request_id=None, timeout=30.0):
+        """Returns (outputs, {"trace_id", "request_id", "version",
+        "route"}).  Raises ShedError (-> HTTP 503 + Retry-After) on
+        admission refusal or platform drain; ValueError/TypeError on bad
+        requests; TimeoutError when the deadline passes in-queue."""
+        if self._stop_all:
+            raise RuntimeError("router is shut down")
+        arrs = {k: np.asarray(v) for k, v in inputs.items()}
+        self._cfg.validate_request(arrs)
+        rows = arrs[next(iter(arrs))].shape[0]
+        if request_id is None:
+            request_id = _trace.new_trace_id("rid")
+        try:
+            if self._draining.is_set():
+                raise ShedError("draining", 1, "front tier shutting down")
+            version, route = self._registry.route(request_id)
+        except ShedError as e:
+            self._m_shed.labels(self._front, e.reason).inc()
+            raise
+        mv = self._registry.get(version)
+        if mv.feed_names:
+            expected = set(mv.feed_names)
+            if self._cfg.mask_feed is not None:
+                expected.discard(self._cfg.mask_feed)
+            if set(arrs) != expected:
+                raise ValueError(
+                    "feed names %s do not match version %r's feeds %s"
+                    % (sorted(arrs), version, sorted(expected)))
+        req = _FleetRequest(
+            request_id, arrs, next(self._seq), version, route)
+        try:
+            with self._cond:
+                rt = self._rt[version]
+                if rt.stopped:
+                    # raced a retire between route() and enqueue: refuse
+                    # rather than strand the request in a dead queue
+                    raise ShedError(
+                        "draining", 1, "version %r is retiring" % version)
+                if not mv.alive_replicas:
+                    # a fully dead version has no one to serve the
+                    # queue: 503 NOW, not a 30s client timeout later
+                    raise ShedError(
+                        "no_replicas", 1,
+                        "version %r has no alive replicas" % version)
+                self._admission.check(
+                    rows, self._total_queued_locked(),
+                    rt.queued_rows + rt.inflight_rows,
+                    self._service_rate_locked(mv))
+                rt.pending.setdefault(
+                    self._cfg.signature(arrs), deque()).append(req)
+                rt.queued_rows += rows
+                self._m_queue_rows.set(self._total_queued_locked())
+                self._cond.notify_all()
+        except ShedError as e:
+            self._m_shed.labels(self._front, e.reason).inc()
+            raise
+        self._m_requests.labels(self._front, version, route).inc()
+        if not req.event.wait(timeout):
+            req.abandoned = True
+            raise TimeoutError(
+                "request %s timed out in queue" % req.request_id)
+        if req.error is not None:
+            exc_type = (req.error_type
+                        if req.error_type in (ValueError, TypeError)
+                        else RuntimeError)
+            raise exc_type("inference failed: %s" % req.error)
+        return req.outputs, {"trace_id": req.trace_id,
+                             "request_id": req.request_id,
+                             "version": req.version, "route": req.route,
+                             "replica": req.replica_id}
+
+    # -- locked helpers ---------------------------------------------------
+    def _total_queued_locked(self):
+        return sum(rt.queued_rows for rt in self._rt.values())
+
+    def _service_rate_locked(self, mv):
+        rt = self._rt[mv.version]
+        if rt.busy_seconds <= 0 or rt.rows_done <= 0:
+            return 0.0
+        return (rt.rows_done / rt.busy_seconds) * max(
+            len(mv.alive_replicas), 0)
+
+    @staticmethod
+    def _head_sig_locked(rt):
+        best_sig, best_seq = None, None
+        for sig, dq in rt.pending.items():
+            if dq and (best_seq is None or dq[0].seq < best_seq):
+                best_sig, best_seq = sig, dq[0].seq
+        return best_sig
+
+    @staticmethod
+    def _rows_pending_locked(rt, sig):
+        dq = rt.pending.get(sig)
+        return sum(r.rows for r in dq) if dq else 0
+
+    # -- replica worker loop ----------------------------------------------
+    def _worker_loop(self, mv, replica):
+        rt = self._rt[mv.version]
+        while not (self._stop_all or rt.stopped) and replica.alive:
+            group = self._take_group(rt, replica)
+            if group:
+                self._run_group(mv, rt, replica, group)
+
+    def _take_group(self, rt, replica):
+        """Oldest-first group (InferenceServer's exact discipline) for
+        whichever replica calls first; soaks the queue up to the batch
+        timeout while the head group still has room."""
+        with self._cond:
+            while True:
+                if self._stop_all or rt.stopped or not replica.alive:
+                    return None
+                sig = self._head_sig_locked(rt)
+                if sig is not None:
+                    break
+                self._cond.wait(0.05)
+            while not (self._stop_all or rt.stopped):
+                sig = self._head_sig_locked(rt)
+                if sig is None:
+                    return None      # another worker took everything
+                if self._rows_pending_locked(
+                        rt, sig) >= self._cfg.max_batch:
+                    break
+                remaining = (rt.pending[sig][0].t_enq + self._timeout
+                             - time.monotonic())
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(remaining, 0.05))
+            sig = self._head_sig_locked(rt)
+            if sig is None:
+                return None
+            dq = rt.pending[sig]
+            group, total = [], 0
+            while dq and total < self._cfg.max_batch:
+                if group and total + dq[0].rows > self._cfg.max_batch:
+                    break
+                r = dq.popleft()
+                rt.queued_rows -= r.rows
+                if r.abandoned:
+                    continue
+                r.t_taken = time.perf_counter()
+                r.replica_id = replica.replica_id
+                group.append(r)
+                total += r.rows
+            if not dq:
+                del rt.pending[sig]
+            rt.inflight_rows += total
+            self._m_queue_rows.set(self._total_queued_locked())
+            return group
+
+    def _run_group(self, mv, rt, replica, group):
+        tracer = _trace.default_tracer()
+        try:
+            feed, total, real_elems, padded_elems = self._cfg.coalesce(
+                [r.inputs for r in group])
+        except Exception as e:
+            self._fail_group(mv, rt, group, e)
+            return
+        t0 = time.perf_counter()
+        for r in group:
+            r.t_disp = t0
+        try:
+            outs = replica.run(feed)
+        except ReplicaDeadError:
+            self._on_replica_death(mv, rt, replica, group)
+            return
+        except Exception as e:
+            if not replica.alive:
+                self._on_replica_death(mv, rt, replica, group)
+                return
+            self._fail_group(mv, rt, group, e)
+            return
+        t1 = time.perf_counter()
+        labels = (self._front, mv.version)
+        self._m_batches.labels(self._front, mv.version,
+                               replica.replica_id).inc()
+        self._m_batch_ms.labels(*labels).observe((t1 - t0) * 1e3)
+        self._m_batch_rows.labels(*labels).observe(total)
+        if padded_elems:
+            self._m_pad_waste.labels(*labels).observe(
+                1.0 - real_elems / padded_elems)
+        try:
+            host = [np.asarray(o) for o in outs]
+            now_mono = time.monotonic()
+            t_done = time.perf_counter()
+            off = 0
+            sliced = []
+            for r in group:
+                sliced.append([o[off:off + r.rows] for o in host])
+                off += r.rows
+        except Exception as e:
+            self._fail_group(mv, rt, group, e)
+            return
+        with self._cond:
+            rt.rows_done += total
+            rt.busy_seconds += (t1 - t0)
+            rt.inflight_rows -= total
+            self._cond.notify_all()
+        if tracer.enabled:
+            tracer.complete(
+                "fleet.batch", t0, t1, cat="serving",
+                args={"version": mv.version, "replica": replica.replica_id,
+                      "rows": total,
+                      "trace_ids": [r.trace_id for r in group]})
+        for r, outs_r in zip(group, sliced):
+            r.t_mat, r.t_done = t1, t_done
+            self._fulfill(mv, r, outs_r, tracer, now_mono)
+
+    def _fulfill(self, mv, req, outs, tracer, now_mono):
+        if req.shadow_expect is not None:
+            # shadow work: score it, never answer anyone
+            self._shadow_cmp.compare(mv.version, req.shadow_expect, outs)
+            return
+        req.outputs = outs
+        mv.requests += 1
+        if not req.abandoned:
+            lat_ms = (now_mono - req.t_enq) * 1e3
+            self._m_latency.labels(self._front, req.version).observe(lat_ms)
+            self._recent.append({
+                "trace_id": req.trace_id, "request_id": req.request_id,
+                "version": req.version, "route": req.route,
+                "replica": req.replica_id,
+                "latency_ms": round(lat_ms, 3), "rows": req.rows})
+        if tracer.enabled:
+            self._emit_request_trace(tracer, req)
+        req.event.set()
+        shadow = self._registry.shadow
+        if shadow is not None and shadow != req.version:
+            self._enqueue_shadow(shadow, req, outs)
+
+    def _enqueue_shadow(self, shadow_version, primary, outs):
+        rt = self._rt.get(shadow_version)
+        if rt is None or rt.stopped:
+            return
+        with self._cond:
+            if rt.queued_rows + primary.rows > self._max_shadow_backlog:
+                # shadow is best-effort: never let its backlog slow or
+                # block primaries — drop and count
+                self._m_shadow_dropped.labels(
+                    self._front, shadow_version).inc()
+                return
+            req = _FleetRequest(
+                primary.request_id + ":shadow", primary.inputs,
+                next(self._seq), shadow_version, "shadow",
+                shadow_expect=outs)
+            rt.pending.setdefault(
+                self._cfg.signature(primary.inputs), deque()).append(req)
+            rt.queued_rows += req.rows
+            self._cond.notify_all()
+        self._m_requests.labels(
+            self._front, shadow_version, "shadow").inc()
+
+    def _emit_request_trace(self, tracer, r):
+        tid = r.trace_id
+        args = {"rows": r.rows, "version": r.version, "route": r.route,
+                "replica": r.replica_id, "request_id": r.request_id}
+        tracer.async_begin("request", tid, cat="serving", args=args,
+                           ts=r.t_enq_pc)
+        phases = (("queue", r.t_enq_pc, r.t_taken),
+                  ("pad+dispatch", r.t_taken, r.t_disp),
+                  ("replica_run", r.t_disp, r.t_mat),
+                  ("slice", r.t_mat, r.t_done))
+        for name, a, b in phases:
+            if a is not None and b is not None:
+                tracer.async_begin(name, tid, cat="serving", ts=a)
+                tracer.async_end(name, tid, cat="serving", ts=b)
+        tracer.async_end("request", tid, cat="serving", ts=r.t_done)
+
+    # -- failure paths ----------------------------------------------------
+    def _fail_group(self, mv, rt, group, exc):
+        primaries = [r for r in group if r.shadow_expect is None]
+        self._m_errors.labels(self._front, mv.version).inc(len(primaries))
+        with self._cond:
+            rt.inflight_rows -= sum(r.rows for r in group)
+            self._cond.notify_all()
+        for r in group:
+            if r.event is None:
+                continue             # shadow work fails silently
+            r.error = "%s: %s" % (type(exc).__name__, exc)
+            r.error_type = type(exc)
+            r.event.set()
+
+    def _on_replica_death(self, mv, rt, replica, group):
+        """The requeue-once discipline: the dead replica's in-flight
+        group goes back to the HEAD of its signature queue (seq order
+        preserved, so oldest-first still holds) unless a request
+        already survived one death — that one fails loudly.  Shadow
+        mirrors are never retried."""
+        try:
+            replica.close()
+        except Exception:
+            pass
+        self._m_replica_deaths.labels(self._front, mv.version).inc()
+        alive = len(mv.alive_replicas)
+        self._m_replicas_alive.labels(self._front, mv.version).set(alive)
+        _trace.instant("serving.replica_death", args={
+            "replica": replica.replica_id, "version": mv.version,
+            "alive": alive}, cat="serving")
+        retry, dead = [], []
+        for r in group:
+            if r.shadow_expect is not None:
+                continue             # best-effort: drop silently
+            if r.requeued:
+                dead.append(r)
+            else:
+                r.requeued = True
+                retry.append(r)
+        with self._cond:
+            rt.inflight_rows -= sum(r.rows for r in group)
+            for r in reversed(retry):   # appendleft keeps seq order
+                rt.pending.setdefault(
+                    self._cfg.signature(r.inputs),
+                    deque()).appendleft(r)
+                rt.queued_rows += r.rows
+            self._m_queue_rows.set(self._total_queued_locked())
+            self._cond.notify_all()
+        if retry:
+            self._m_requeued.labels(self._front, mv.version).inc(len(retry))
+        for r in dead:
+            self._m_errors.labels(self._front, mv.version).inc()
+            r.error = ("replica %s died serving a request that already "
+                       "survived one replica death" % replica.replica_id)
+            r.error_type = RuntimeError
+            r.event.set()
+        if not mv.alive_replicas:
+            # no capacity left for this version: everything queued (incl.
+            # the group just re-queued) fails NOW, not at client timeout
+            self._fail_leftover_pending(
+                mv, rt, "all replicas of version %r are dead" % mv.version)
+
+    # -- observability ----------------------------------------------------
+    def stats(self):
+        with self._cond:
+            queued = {v: rt.queued_rows for v, rt in self._rt.items()}
+            inflight = {v: rt.inflight_rows for v, rt in self._rt.items()}
+            rates = {
+                mv.version: round(self._service_rate_locked(mv), 2)
+                for mv in self._registry.versions()
+                if mv.version in self._rt
+            }
+        desc = self._registry.describe()
+        desc.update({
+            "front": self._front,
+            "ready": self.ready(),
+            "draining": self._draining.is_set(),
+            "queued_rows": queued,
+            "inflight_rows": inflight,
+            "service_rate_rows_per_s": rates,
+            "admission": self._admission.describe(),
+            "batching": {
+                "max_batch": self._cfg.max_batch,
+                "batch_buckets": list(self._cfg.batch_buckets),
+                "ragged_dims": {k: {str(ax): list(b)
+                                    for ax, b in v.items()}
+                                for k, v in self._cfg.ragged.items()},
+            },
+            "recent_requests": list(self._recent)[-8:],
+        })
+        return desc

@@ -1,0 +1,103 @@
+"""Process-level serving worker: `python -m paddle_tpu.serving.worker
+<model_dir>`.
+
+One replica per process: loads the model (running the analysis verify
+gate the load path installs), handshakes ("ready", {...}) on its pipe,
+then serves pickled ("run", feed) frames until ("close",) or EOF.
+Protocol framing lives in `serving.replica` (the parent's side).
+
+The fault plan's ``kill_replica`` events fire HERE, by real SIGKILL
+mid-request — the parent router sees a dead pipe with an unanswered
+frame, which is exactly the crash shape a preempted host produces.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) != 1:
+        print("usage: python -m paddle_tpu.serving.worker <model_dir>",
+              file=sys.stderr)
+        return 2
+    model_dir = argv[0]
+
+    from paddle_tpu.serving.replica import (
+        REPLICA_INDEX_ENV,
+        WORKER_RFD_ENV,
+        WORKER_WFD_ENV,
+        read_frame,
+        write_frame,
+    )
+
+    rf = os.fdopen(int(os.environ[WORKER_RFD_ENV]), "rb")
+    wf = os.fdopen(int(os.environ[WORKER_WFD_ENV]), "wb")
+    replica_index = int(os.environ.get(REPLICA_INDEX_ENV, "0"))
+
+    try:
+        import numpy as np
+
+        from paddle_tpu.incubate.fault import FaultPlan
+        from paddle_tpu.inference import AnalysisConfig, create_predictor
+
+        plan = FaultPlan.from_env()
+        pred = create_predictor(AnalysisConfig(model_dir))
+        # the fleet's deploy gate is UNCONDITIONAL (the load path's
+        # FLAGS_verify_io_programs can be toggled off; this cannot) —
+        # mirror of Router._verify_replica for the thread kind
+        from paddle_tpu import analysis
+
+        analysis.assert_program_valid(
+            pred._program,
+            feed_names=pred.get_input_names(),
+            fetch_names=pred.get_output_names(),
+            check_shapes=False,
+            what="deploy gate (process worker) for %r" % model_dir)
+    except Exception as e:
+        try:
+            write_frame(wf, ("err", "%s: %s" % (type(e).__name__, e)))
+        except Exception:
+            pass
+        return 1
+
+    write_frame(wf, ("ready", {
+        "feed_names": pred.get_input_names(),
+        "fetch_names": pred.get_output_names(),
+        "pid": os.getpid(),
+    }))
+
+    served = 0
+    while True:
+        msg = read_frame(rf)
+        if msg is None or msg[0] == "close":
+            return 0
+        try:
+            if msg[0] == "run":
+                served += 1
+                # the SIGKILL drill seam: dies mid-request, frame
+                # unanswered, parent pipe EOFs
+                plan.maybe_kill_replica(replica_index, served)
+                outs = [np.asarray(o) for o in pred.run(msg[1])]
+                write_frame(wf, ("ok", outs))
+            elif msg[0] == "warmup":
+                n = pred.warmup(msg[1])
+                write_frame(wf, ("ok", n))
+            elif msg[0] == "ping":
+                write_frame(wf, ("ok", {"served": served}))
+            else:
+                write_frame(wf, ("err", "ValueError",
+                                 "unknown message %r" % (msg[0],)))
+        except BrokenPipeError:
+            return 0
+        except Exception as e:
+            try:
+                write_frame(wf, ("err", type(e).__name__, str(e)))
+            except Exception:
+                return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -452,6 +452,93 @@ def test_stop_start_cycle_and_stop_before_start_are_safe():
         server.stop()                  # double stop: no-op
 
 
+def test_graceful_shutdown_drains_inflight_and_rejects_new_with_503():
+    """SIGTERM semantics: /readyz flips to 503 first, queued+in-flight
+    batches finish (zero drop), NEW requests get 503 + Retry-After
+    instead of a dead socket."""
+    from paddle_tpu.inference.server import ServerClosing
+
+    pred = _SlowPredictor(delay=0.08)
+    server = InferenceServer(pred, max_batch=2, batch_timeout_ms=1,
+                             batch_buckets=False).start()
+    httpd = server.serve_http(port=0, block=False, install_sigterm=False)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        with urllib.request.urlopen(base + "/readyz", timeout=10) as resp:
+            assert resp.status == 200
+
+        inflight = {}
+
+        def slow_call():
+            inflight["result"] = _post(base + "/predict", _json.dumps(
+                {"inputs": {"x": [[1.0] * 4]}}).encode())
+
+        t = threading.Thread(target=slow_call)
+        t.start()
+        time.sleep(0.02)                 # the request is being served
+        shut = threading.Thread(
+            target=server.begin_graceful_shutdown, kwargs={
+                "drain_timeout": 10})
+        shut.start()
+        time.sleep(0.02)
+        try:
+            with urllib.request.urlopen(base + "/readyz",
+                                        timeout=10) as resp:
+                code = resp.status
+        except urllib.error.HTTPError as e:
+            code, payload = e.code, _json.loads(e.read())
+            assert payload["reason"] == "draining"
+        assert code == 503
+        # a NEW request during the drain: 503 + Retry-After
+        code, out = _post(base + "/predict", _json.dumps(
+            {"inputs": {"x": [[1.0] * 4]}}).encode())
+        assert code == 503, (code, out)
+        with pytest.raises(ServerClosing):
+            server.infer({"x": np.zeros((1, 4), np.float32)})
+        shut.join(20)
+        t.join(20)
+        # the in-flight request was drained, not dropped
+        code, out = inflight["result"]
+        assert code == 200, (code, out)
+        assert not server.ready()
+    finally:
+        httpd.shutdown()
+        server.stop()
+
+
+def test_sigterm_handler_drains_then_chains_previous_handler():
+    """serve_http(install_sigterm=True) arms graceful shutdown on
+    SIGTERM and chains whatever handler was installed before it (the
+    PR-6 flight-recorder convention: exit semantics survive)."""
+    import signal
+
+    chained = []
+    original = signal.getsignal(signal.SIGTERM)
+    signal.signal(signal.SIGTERM, lambda s, f: chained.append(s))
+    try:
+        pred = _SlowPredictor(delay=0.001)
+        server = InferenceServer(pred, max_batch=2, batch_timeout_ms=1,
+                                 batch_buckets=False).start()
+        httpd = server.serve_http(port=0, block=False,
+                                  install_sigterm=True, drain_timeout=5)
+        base = "http://127.0.0.1:%d" % httpd.server_address[1]
+        code, out = _post(base + "/predict", _json.dumps(
+            {"inputs": {"x": [[1.0] * 4]}}).encode())
+        assert code == 200
+        handler = signal.getsignal(signal.SIGTERM)
+        assert callable(handler)
+        # deliver the signal semantics synchronously (the handler runs
+        # on the main thread exactly as a real SIGTERM would)
+        handler(signal.SIGTERM, None)
+        assert chained == [signal.SIGTERM]     # previous handler ran
+        assert not server.ready()              # drained + stopped
+        # the listener closed: a fresh connection must fail
+        with pytest.raises(Exception):
+            urllib.request.urlopen(base + "/health", timeout=2)
+    finally:
+        signal.signal(signal.SIGTERM, original)
+
+
 def test_timed_out_request_is_dropped_not_dispatched():
     """A waiter that times out while queued is abandoned: the dispatcher
     drops it instead of burning device work, and it never skews the

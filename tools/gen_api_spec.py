@@ -54,6 +54,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.distributed.elastic",
     "paddle_tpu.fleet",
     "paddle_tpu.inference",
+    "paddle_tpu.serving",
 ]
 
 

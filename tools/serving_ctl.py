@@ -1,0 +1,219 @@
+"""Operator CLI for a running `paddle_tpu.serving` front tier.
+
+Speaks the /admin plane of `serving.serve_http`::
+
+    python tools/serving_ctl.py --endpoint http://host:port COMMAND ...
+
+    list                                  # versions, states, pointers
+    stats                                 # router stats()
+    deploy  -v V --model-dir DIR [--replicas N] [--kind thread|process]
+            [--warmup-inputs '{"x": [[0.0, ...]]}']
+    promote -v V [--keep-old]             # atomic cutover (+standby)
+    rollback                              # back to the kept previous
+    canary  -v V --percent P              # deterministic split (0 clears)
+    shadow  [-v V | --off]                # mirror traffic (never returned)
+    retire  -v V                          # drain + close replicas
+    drain   -v V                          # alias of retire
+
+Exit codes: 0 on success; **1 on a refused transition** (HTTP 409 —
+promote a non-ready version, retire the stable one, rollback with no
+standby, a deploy whose verify gate rejected the model) or any other
+HTTP/connection error.  ``--json`` prints the raw response object for
+scripting; the default output is a short human line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def _call(endpoint, path, body=None, timeout=120.0):
+    """(status_code, parsed_json).  Connection failures -> (None, err)."""
+    url = endpoint.rstrip("/") + path
+    if body is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read())
+        except Exception:
+            payload = {"error": str(e)}
+        return e.code, payload
+    except Exception as e:
+        return None, {"error": "%s: %s" % (type(e).__name__, e)}
+
+
+def _emit(args, code, payload):
+    ok = code is not None and 200 <= code < 300
+    if args.json:
+        print(json.dumps({"status": code, "ok": ok, "response": payload},
+                         indent=2, sort_keys=True))
+    elif not ok:
+        refused = isinstance(payload, dict) and payload.get("refused")
+        print("%s (HTTP %s): %s"
+              % ("refused" if refused else "error", code,
+                 payload.get("error", payload)
+                 if isinstance(payload, dict) else payload),
+              file=sys.stderr)
+    return 0 if ok else 1
+
+
+def cmd_list(args):
+    code, payload = _call(args.endpoint, "/admin/models")
+    rc = _emit(args, code, payload)
+    if rc == 0 and not args.json:
+        print("stable:   %s" % payload.get("stable"))
+        if payload.get("canary"):
+            print("canary:   %s @ %.1f%%" % (
+                payload["canary"]["version"], payload["canary"]["percent"]))
+        if payload.get("shadow"):
+            print("shadow:   %s" % payload["shadow"])
+        if payload.get("previous_stable"):
+            print("previous: %s" % payload["previous_stable"])
+        for mv in payload.get("versions", []):
+            print("  %-16s %-9s replicas %d/%d  requests %d%s" % (
+                mv["version"], mv["state"], mv["replicas_alive"],
+                mv["replicas"], mv["requests"],
+                ("  [%s]" % mv["error"]) if mv.get("error") else ""))
+    return rc
+
+
+def cmd_stats(args):
+    code, payload = _call(args.endpoint, "/stats")
+    if not args.json and code == 200:
+        print(json.dumps(payload, indent=2, sort_keys=True))
+        return 0
+    return _emit(args, code, payload)
+
+
+def cmd_deploy(args):
+    body = {"version": args.version, "model_dir": args.model_dir,
+            "replicas": args.replicas, "kind": args.kind}
+    if args.warmup_inputs:
+        body["warmup_inputs"] = json.loads(args.warmup_inputs)
+    code, payload = _call(args.endpoint, "/admin/deploy", body)
+    rc = _emit(args, code, payload)
+    if rc == 0 and not args.json:
+        print("deployed %s: state %s, %d replica(s)"
+              % (payload["version"], payload["state"], payload["replicas"]))
+    return rc
+
+
+def cmd_promote(args):
+    code, payload = _call(args.endpoint, "/admin/promote",
+                          {"version": args.version,
+                           "keep_old": args.keep_old})
+    rc = _emit(args, code, payload)
+    if rc == 0 and not args.json:
+        print("promoted %s (state %s)" % (payload["version"],
+                                          payload["state"]))
+    return rc
+
+
+def cmd_rollback(args):
+    code, payload = _call(args.endpoint, "/admin/rollback", {})
+    rc = _emit(args, code, payload)
+    if rc == 0 and not args.json:
+        print("rolled back to %s" % payload["version"])
+    return rc
+
+
+def cmd_canary(args):
+    code, payload = _call(args.endpoint, "/admin/canary",
+                          {"version": args.version,
+                           "percent": args.percent})
+    rc = _emit(args, code, payload)
+    if rc == 0 and not args.json:
+        print("canary: %s" % (payload.get("canary") or "off"))
+    return rc
+
+
+def cmd_shadow(args):
+    version = None if args.off else args.version
+    if version is None and not args.off:
+        print("shadow needs -v VERSION or --off", file=sys.stderr)
+        return 2
+    code, payload = _call(args.endpoint, "/admin/shadow",
+                          {"version": version})
+    rc = _emit(args, code, payload)
+    if rc == 0 and not args.json:
+        print("shadow: %s" % (payload.get("shadow") or "off"))
+    return rc
+
+
+def cmd_retire(args):
+    code, payload = _call(args.endpoint, "/admin/retire",
+                          {"version": args.version})
+    rc = _emit(args, code, payload)
+    if rc == 0 and not args.json:
+        print("retired %s" % payload["version"])
+    return rc
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="serving_ctl",
+        description="Operate a running paddle_tpu.serving front tier.")
+    p.add_argument("--endpoint", default="http://127.0.0.1:8080",
+                   help="front tier base URL (default %(default)s)")
+    p.add_argument("--json", action="store_true",
+                   help="print raw JSON responses (scripting)")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("list").set_defaults(fn=cmd_list)
+    sub.add_parser("stats").set_defaults(fn=cmd_stats)
+
+    d = sub.add_parser("deploy")
+    d.add_argument("-v", "--version", required=True)
+    d.add_argument("--model-dir", required=True)
+    d.add_argument("--replicas", type=int, default=1)
+    d.add_argument("--kind", choices=("thread", "process"),
+                   default="thread")
+    d.add_argument("--warmup-inputs", default=None,
+                   help='JSON example inputs, e.g. \'{"x": [[0.0, 0.0]]}\''
+                        " — warms the full bucket ladder")
+    d.set_defaults(fn=cmd_deploy)
+
+    pr = sub.add_parser("promote")
+    pr.add_argument("-v", "--version", required=True)
+    pr.add_argument("--keep-old", action="store_true",
+                    help="keep the old stable on warm standby (rollback "
+                         "target) instead of retiring it")
+    pr.set_defaults(fn=cmd_promote)
+
+    sub.add_parser("rollback").set_defaults(fn=cmd_rollback)
+
+    c = sub.add_parser("canary")
+    c.add_argument("-v", "--version", required=True)
+    c.add_argument("--percent", type=float, required=True)
+    c.set_defaults(fn=cmd_canary)
+
+    s = sub.add_parser("shadow")
+    s.add_argument("-v", "--version", default=None)
+    s.add_argument("--off", action="store_true")
+    s.set_defaults(fn=cmd_shadow)
+
+    for alias in ("retire", "drain"):   # drain = retire (drain-then-close)
+        r = sub.add_parser(alias)
+        r.add_argument("-v", "--version", required=True)
+        r.set_defaults(fn=cmd_retire)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
