@@ -28,10 +28,11 @@ CUDAPlace = fluid.CUDAPlace
 
 
 def __getattr__(name):
-    # lazy submodules (PEP 562): analysis is a build/debug-time tool and
-    # serving is a dedicated-process front tier — neither may tax the
-    # import of every training/serving worker process
-    if name in ("analysis", "serving"):
+    # lazy submodules (PEP 562): analysis is a build/debug-time tool,
+    # serving is a dedicated-process front tier, and tune is an offline
+    # search harness — none may tax the import of every training/serving
+    # worker process
+    if name in ("analysis", "serving", "tune"):
         import importlib
 
         return importlib.import_module("." + name, __name__)

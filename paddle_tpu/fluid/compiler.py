@@ -95,6 +95,16 @@ class CompiledProgram:
         self._is_data_parallel = False
         self._places = None
         self._share_vars_from = None
+        self._autotune = None          # with_autotune() config dict
+        # (program version, fetch tuple, feed signature) -> tuned clone.
+        # A tuned pipeline is only valid for the fetch set it was
+        # searched with (DCE "keep" protects exactly those fetches) AND
+        # the feed shapes it was timed at; a dict (not a single slot)
+        # so loops alternating fetch sets reuse stable clone objects —
+        # the executor's jit cache keys on id(program), so a fresh
+        # clone per run would retrace every step
+        self._tuned_programs = {}
+        self._tune_report = None       # last SearchReport, for operators
 
     # -- configuration --------------------------------------------------
     def with_data_parallel(
@@ -115,6 +125,67 @@ class CompiledProgram:
         self._share_vars_from = share_vars_from
         self._places = places
         return self
+
+    def with_autotune(self, cache_dir=None, budget_s=None, space=None,
+                      k=3, warmup=1, use_cache=True):
+        """Opt-in measured autotuning (``paddle_tpu.tune``): the FIRST
+        Executor.run of this program searches pass pipelines (pruned by
+        the static cost model, verified per pass, compiled-and-timed)
+        and every later run executes the winning rewrite.  Winners
+        persist in the tuning cache (keyed by program hash + mesh + chip
+        + jax version), so a second process skips the search entirely.
+
+        The search runs synchronously inside that first run —
+        ``budget_s`` bounds it.  Donation/sharding are fixed to the
+        executor's own conventions; the searched axis here is the
+        pipeline."""
+        self._autotune = {
+            "cache_dir": cache_dir, "budget_s": budget_s, "space": space,
+            "k": k, "warmup": warmup, "use_cache": use_cache,
+        }
+        return self
+
+    def _ensure_tuned(self, feed_vals, fetch_names, mesh=None):
+        """Run (or load) the search once per program version; returns
+        the tuned clone the executor should run.  Called by
+        Executor._run_impl on the autotune-enabled facade."""
+        prog = self._program
+        memo_key = (
+            prog._version, tuple(fetch_names),
+            tuple(sorted((n, tuple(a.shape), str(a.dtype))
+                         for n, a in feed_vals.items())))
+        cached = self._tuned_programs.get(memo_key)
+        if cached is not None:
+            return cached
+        from ..tune import SearchSpace, search, tuned_program
+
+        cfg = self._autotune
+        space = cfg["space"] or SearchSpace(
+            donate=(True,),    # the executor always donates state
+            sharding=False)    # sharding comes from dist_attr/mesh setup
+        report = search(
+            prog, list(fetch_names),
+            feed_specs={n: (a.shape, a.dtype)
+                        for n, a in feed_vals.items()},
+            mesh=mesh, space=space, k=cfg["k"], warmup=cfg["warmup"],
+            budget_s=cfg["budget_s"], use_cache=cfg["use_cache"],
+            cache_dir=cfg["cache_dir"])
+        self._tune_report = report
+        tuned = (tuned_program(prog, report, fetch_list=fetch_names)
+                 if report.winner is not None else prog)
+        if len(self._tuned_programs) >= 32:
+            # evict stale program versions first, then oldest-inserted —
+            # never a wholesale clear: live entries must keep their
+            # object identity or the executor's id-keyed jit cache
+            # retraces every alternating-shape step
+            for k in [k for k in self._tuned_programs
+                      if k[0] != prog._version]:
+                del self._tuned_programs[k]
+            while len(self._tuned_programs) >= 32:
+                self._tuned_programs.pop(
+                    next(iter(self._tuned_programs)))
+        self._tuned_programs[memo_key] = tuned
+        return tuned
 
     # -- executor protocol ----------------------------------------------
     def _unwrap_for_executor(self):

@@ -403,7 +403,9 @@ class Executor:
         program = program or framework.default_main_program()
         # CompiledProgram facade (compiler.py) unwraps to its program + config
         dp_devices = None
+        facade = None
         if hasattr(program, "_unwrap_for_executor"):
+            facade = program
             if hasattr(program, "_dp_devices"):
                 dp_devices = program._dp_devices()
             program = program._unwrap_for_executor()
@@ -433,6 +435,17 @@ class Executor:
                         dtypes_mod.to_jnp(v.dtype) != arr.dtype.type:
                     arr = arr.astype(dtypes_mod.to_str(v.dtype))
             feed_vals[name] = arr
+
+        # CompiledProgram.with_autotune: first run searches (or loads
+        # from the tuning cache) the winning pass pipeline for THIS
+        # program version at the live feed shapes; later runs execute
+        # the cached tuned clone (same var names, so scope state and
+        # feeds carry over unchanged)
+        if (facade is not None
+                and getattr(facade, "_autotune", None) and fetch_names):
+            program = facade._ensure_tuned(
+                feed_vals, fetch_names, mesh=self.mesh)
+            block = program.global_block
 
         feed_sig = tuple(
             (n, feed_vals[n].shape, str(feed_vals[n].dtype)) for n in sorted(feed_vals)

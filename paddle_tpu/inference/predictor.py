@@ -243,6 +243,13 @@ class Predictor:
         shape tuple (float32 assumed), a (shape, dtype) pair, or a
         ready-made array.  Blocks until every executable is built;
         returns the resulting compile_count.
+
+        To warm a MEASURED-tuned ladder instead of the default one,
+        feed this the specs of a tuned `BatchingConfig`
+        (`cfg.ladder_specs(example)` with
+        `batch_buckets=tune.search_bucket_ladder(...)` winner buckets)
+        — or use `InferenceServer.autotune`, which searches, adopts,
+        and warms in one call.
         """
         import jax
 

@@ -300,6 +300,39 @@ class InferenceServer:
         self._sample_costs(specs)
         return out
 
+    def autotune(self, example_inputs, traffic, ladders=None, **kw):
+        """Measured batch-bucket-ladder search (`paddle_tpu.tune`):
+        compile-and-time the candidate ladders against a sample of
+        observed request batch sizes, adopt the winner as this server's
+        ladder, and AOT-warm it.  Call before serving traffic (the
+        ladder swap is not synchronized against in-flight batches).
+
+        ``traffic``: iterable of request batch sizes (e.g. yesterday's
+        access log).  ``ladders`` pins explicit candidates.  Winners
+        persist in the tuning cache keyed by the predictor's program
+        hash + the traffic histogram, so a server restart re-adopts the
+        tuned ladder without re-searching.  Returns the SearchReport."""
+        from .. import tune
+
+        report = tune.search_bucket_ladder(
+            self._pred, example_inputs, traffic,
+            max_batch=self._max_batch,
+            ragged_dims=self._ragged or None,
+            mask_feed=self._mask_feed, ladders=ladders,
+            # the incumbent ladder always competes: "tuned" may only
+            # keep or beat what this server is already configured with
+            extra_ladders=([self._batch_buckets]
+                           if self._batch_buckets else None), **kw)
+        if report.winner is not None:
+            self._cfg = BatchingConfig(
+                max_batch=self._max_batch,
+                batch_buckets=report.winner.params["batch_buckets"],
+                ragged_dims=self._ragged or None,
+                mask_feed=self._mask_feed)
+            self._batch_buckets = self._cfg.batch_buckets
+            self.warmup(example_inputs)
+        return report
+
     # -- XLA cost attribution -------------------------------------------
     @staticmethod
     def _feed_sig(feed):

@@ -65,7 +65,33 @@ def _pick_block(s):
     return None
 
 
-def _block_sizes(sq, sk):
+def _block_sizes(sq, sk, block_q=None, block_k=None):
+    # explicit arguments (the autotuner / callers who measured their
+    # shape) are a hard contract: they win over the env override and the
+    # heuristic, and an invalid choice raises instead of silently
+    # falling back — a tuner must never time a different grid than the
+    # one it thinks it requested.  A side NOT given explicitly keeps the
+    # normal precedence (env override when it divides, else heuristic).
+    if block_q is not None or block_k is not None:
+        env_q = env_k = None
+        ov = os.getenv("PADDLE_TPU_FLASH_BLOCKS")
+        if ov:
+            try:
+                env_q, env_k = (int(t) for t in ov.split(","))
+            except ValueError:
+                raise ValueError(
+                    "PADDLE_TPU_FLASH_BLOCKS must be 'bq,bk' (two "
+                    "ints), got %r" % ov) from None
+        bq = int(block_q) if block_q is not None else (
+            env_q if env_q and sq % env_q == 0 else _pick_block(sq))
+        bk = int(block_k) if block_k is not None else (
+            env_k if env_k and sk % env_k == 0 else _pick_block(sk))
+        if not bq or not bk or sq % bq or sk % bk:
+            raise ValueError(
+                "explicit flash-attention block sizes (block_q=%r, "
+                "block_k=%r) must divide the padded sequence lengths "
+                "(Sq=%d, Sk=%d)" % (block_q, block_k, sq, sk))
+        return bq, bk
     ov = os.getenv("PADDLE_TPU_FLASH_BLOCKS")  # "bq,bk" tuning override
     if ov:
         import warnings
@@ -236,7 +262,7 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, nk, has_bias, has_seg,
 
 
 def _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret,
-         coff=0, layout="BHSD"):
+         coff=0, layout="BHSD", block_q=None, block_k=None):
     """Returns (out, lse); out is [bh,sq,d] (BHSD) or [b,sq,h,d] (BSHD);
     lse is the [bh,sq,128] row-broadcast residual, EXCEPT on the
     single-block schedule (nq==nk==1) where it is a (bh,8,128) zero
@@ -255,7 +281,7 @@ def _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret,
         sk = k.shape[1]
         bh = b * h_
         out_sds = jax.ShapeDtypeStruct((b, sq, h_, d), q.dtype)
-    bq, bk = _block_sizes(sq, sk)
+    bq, bk = _block_sizes(sq, sk, block_q, block_k)
     nq, nk = sq // bq, sk // bk
     has_bias, has_seg = bias is not None, qseg is not None
     h = n_head
@@ -575,13 +601,22 @@ def _bwd_fused(q, k, v, bias, qseg, kseg, out, g, h, scale, causal,
 
 
 def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
-                    causal=False, interpret=None, layout="BHSD"):
+                    causal=False, interpret=None, layout="BHSD",
+                    block_q=None, block_k=None):
     """q/k/v: [B, H, S, D] (layout="BHSD") or [B, S, H, D] ("BSHD" — no
     head transpose anywhere).  bias: None or broadcastable
     [B, 1/H, 1, Sk].
     segment_ids: None, a [B, S] int array (self-attention packing), or a
     (q_seg [B, Sq], kv_seg [B, Sk]) pair — attention is confined to equal
     segment ids.
+
+    ``block_q``/``block_k`` pin the kernel's q/k block sizes explicitly
+    (the knob ``paddle_tpu.tune.search_flash_blocks`` searches); they
+    must divide the PADDED sequence lengths (multiples of 128) or a
+    ValueError is raised.  Default None keeps the built-in heuristic
+    (largest of 512/256/128 that divides), and the
+    ``PADDLE_TPU_FLASH_BLOCKS=bq,bk`` env override still applies when no
+    explicit argument is given.
 
     Sequences not divisible by the 128-lane block are PADDED up to it
     (padded keys masked by bias / a sentinel segment id, padded query
@@ -602,7 +637,8 @@ def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
         out = flash_attention(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3), bias=bias, segment_ids=segment_ids,
-            scale=scale, causal=causal, interpret=interpret, layout="BHSD")
+            scale=scale, causal=causal, interpret=interpret, layout="BHSD",
+            block_q=block_q, block_k=block_k)
         return out.transpose(0, 2, 1, 3)
     if layout == "BHSD":
         b, h, sq, d = q.shape
@@ -653,7 +689,7 @@ def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
             )
         sq, sk = sq + pq, sk + pk
 
-    bq, bk = _block_sizes(sq, sk)
+    bq, bk = _block_sizes(sq, sk, block_q, block_k)
     if bq is None or bk is None:
         import warnings
 
@@ -702,29 +738,32 @@ def flash_attention(q, k, v, bias=None, segment_ids=None, scale=None,
 
     coff = sk_orig - sq_orig  # bottom-right causal alignment (original S)
     out = _flash_core(qf, kf, vf, bf, qsegf, ksegf, h, scale, causal,
-                      interpret, coff, layout)
+                      interpret, coff, layout, block_q, block_k)
     if layout == "BHSD":
         out = out.reshape(b, h, sq, d)
         return out[:, :, :sq_orig] if sq != sq_orig else out
     return out[:, :sq_orig] if sq != sq_orig else out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13))
 def _flash_core(q, k, v, bias, qseg, kseg, n_head, scale, causal, interpret,
-                coff, layout="BHSD"):
+                coff, layout="BHSD", block_q=None, block_k=None):
     out, _ = _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal,
-                  interpret, coff, layout)
+                  interpret, coff, layout, block_q, block_k)
     return out
 
 
 def _flash_core_fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal,
-                    interpret, coff, layout="BHSD"):
+                    interpret, coff, layout="BHSD", block_q=None,
+                    block_k=None):
     out, lse = _fwd(q, k, v, bias, qseg, kseg, n_head, scale, causal,
-                    interpret, coff, layout)
+                    interpret, coff, layout, block_q, block_k)
     return out, (q, k, v, bias, qseg, kseg, out, lse)
 
 
-def _flash_core_bwd(n_head, scale, causal, interpret, coff, layout, res, g):
+def _flash_core_bwd(n_head, scale, causal, interpret, coff, layout,
+                    block_q, block_k, res, g):
     q, k, v, bias, qseg, kseg, out, lse2d = res
     h = n_head
     if layout == "BHSD":
@@ -734,7 +773,7 @@ def _flash_core_bwd(n_head, scale, causal, interpret, coff, layout, res, g):
         b_, sq, h_, d = q.shape
         sk = k.shape[1]
         bh = b_ * h_
-    bq, bk = _block_sizes(sq, sk)
+    bq, bk = _block_sizes(sq, sk, block_q, block_k)
     nq, nk = sq // bq, sk // bk
     has_bias, has_seg = bias is not None, qseg is not None
     fast = nq == 1 and nk == 1      # lse recomputed in-kernel (see _fwd)

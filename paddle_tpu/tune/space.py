@@ -1,0 +1,279 @@
+"""Candidate spaces: what the measured autotuner is allowed to try.
+
+Every knob here already exists somewhere in the stack — the tuner adds
+no new mechanism, it SEARCHES the mechanisms the repo shipped one at a
+time:
+
+  * pass pipelines    — selection/order over the ``fluid.ir`` registry
+                        (the reference's ir/pass tier, PR 5's safety net);
+  * flash block sizes — the ``_block_sizes`` heuristic in
+                        ``ops/pallas/attention.py`` becomes one point in
+                        an explicit (block_q, block_k) grid;
+  * bucket ladders    — ``inference.batching.BatchingConfig`` batch
+                        ladders (PR 2's serving invariant);
+  * donation          — jit buffer donation of the program's inputs;
+  * sharding          — GSPMD column-sharding of large matmul weights
+                        over an ambient mesh axis (dist_attr annotation,
+                        the static_sharding convention).
+
+A ``Candidate`` is pure data (kind + params) so reports and the tuning
+cache serialize it verbatim; applying/timing lives in ``search.py``.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "Candidate",
+    "SearchSpace",
+    "default_pass_pipelines",
+    "flash_block_candidates",
+    "ladder_candidates",
+    "sharding_candidates",
+]
+
+# block sizes the pallas kernels accept (attention._pick_block's ladder)
+FLASH_BLOCKS = (512, 256, 128)
+
+# passes that are safe to enumerate by default: program-level rewrites
+# registered in fluid.ir that need no per-pass configuration.  An
+# explicit SearchSpace(pipelines=...) can add anything, including Pass
+# INSTANCES with .set() attributes.
+_DEFAULT_TUNABLE_PASSES = ("batch_norm_act_fuse", "dead_op_elimination")
+
+
+class Candidate:
+    """One point in the space: ``kind`` names the knob family, ``params``
+    is a JSON-serializable dict fully describing the choice.  ``extra``
+    carries non-serializable payloads (Pass instances) that apply-time
+    code needs; it never reaches the cache."""
+
+    __slots__ = ("kind", "params", "label", "extra")
+
+    def __init__(self, kind, params, label=None, extra=None):
+        self.kind = kind
+        self.params = dict(params)
+        self.label = label or self._default_label()
+        self.extra = extra or {}
+
+    def _default_label(self):
+        if self.kind == "program":
+            pipe = "+".join(self.params.get("pipeline", ())) or "baseline"
+            bits = [pipe]
+            if not self.params.get("donate", True):
+                bits.append("nodonate")
+            if self.params.get("sharding"):
+                bits.append("shard[%s]" % self.params["sharding"]["axis"])
+            return "|".join(bits)
+        if self.kind == "flash_blocks":
+            return "bq%d.bk%d" % (self.params["block_q"],
+                                  self.params["block_k"])
+        if self.kind == "ladder":
+            b = self.params.get("batch_buckets")
+            return "ladder[%s]" % ",".join(str(x) for x in (b or []))
+        return "%s:%s" % (self.kind, sorted(self.params.items()))
+
+    def to_dict(self):
+        return {"kind": self.kind, "params": self.params,
+                "label": self.label}
+
+    def __repr__(self):
+        return "Candidate(%s)" % self.label
+
+
+def _pass_name(p):
+    return p if isinstance(p, str) else (getattr(p, "name", None)
+                                         or type(p).__name__)
+
+
+def default_pass_pipelines():
+    """Deterministic pipeline set: the identity baseline, each pass of
+    the KNOWN-TUNABLE allowlist (`_DEFAULT_TUNABLE_PASSES` — config-free
+    program rewrites, intersected with what is actually registered)
+    alone, and the all-passes pipeline in fuse-then-clean order.  A new
+    pass enters the default space by being added to the allowlist; ad
+    hoc passes (including unregistered instances) are searched by
+    passing ``SearchSpace(pipelines=[...])`` explicitly."""
+    from ..fluid import ir
+
+    registered = [n for n in _DEFAULT_TUNABLE_PASSES
+                  if n in ir._PASS_REGISTRY]
+    pipelines = [[]]
+    for n in registered:
+        pipelines.append([n])
+    if len(registered) > 1:
+        pipelines.append(list(registered))
+    return pipelines
+
+
+def flash_block_candidates(sq, sk, grid=None):
+    """All (block_q, block_k) pairs that divide the (padded) sequence
+    lengths, heuristic default first so reports read naturally."""
+    from ..ops.pallas.attention import _pick_block
+
+    blocks = tuple(grid) if grid else FLASH_BLOCKS
+    default = (_pick_block(sq), _pick_block(sk))
+    out = []
+    seen = set()
+    for bq in blocks:
+        if sq % bq:
+            continue
+        for bk in blocks:
+            if sk % bk:
+                continue
+            key = (bq, bk)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Candidate(
+                "flash_blocks", {"block_q": bq, "block_k": bk}))
+    # stable order, heuristic default first
+    out.sort(key=lambda c: (
+        (c.params["block_q"], c.params["block_k"]) != default,
+        -c.params["block_q"], -c.params["block_k"]))
+    return out
+
+
+def ladder_candidates(max_batch, traffic=None, ladders=None,
+                      extra=None):
+    """Batch-bucket ladder candidates for a traffic sample (request
+    batch sizes).  Always contains the powers-of-two default; a traffic
+    sample adds an exact-sizes ladder (the observed sizes, capped at 8
+    distinct entries via even quantiles) and a linear ladder — the
+    shapes a ladder search can actually distinguish.  ``ladders`` pins
+    an explicit candidate list instead.  ``extra`` appends ladders in
+    either mode (the server's INCUMBENT ladder goes here, so "tuned"
+    can only keep or beat what is already serving)."""
+    from ..inference.batching import default_ladder
+
+    max_batch = max(int(max_batch), 1)
+    cands = []
+    seen = set()
+
+    def add(buckets, tag):
+        ladder = sorted({min(int(b), max_batch) for b in buckets if b})
+        if not ladder:
+            return
+        if ladder[-1] != max_batch:
+            ladder.append(max_batch)
+        key = tuple(ladder)
+        if key in seen:
+            return
+        seen.add(key)
+        cands.append(Candidate(
+            "ladder", {"batch_buckets": ladder},
+            label="ladder-%s[%s]" % (tag, ",".join(map(str, ladder)))))
+
+    if ladders is not None:
+        for i, l in enumerate(ladders):
+            add(l, "user%d" % i)
+        for i, l in enumerate(extra or ()):
+            add(l, "extra%d" % i)
+        return cands
+
+    add(default_ladder(max_batch), "pow2")
+    if traffic:
+        sizes = sorted({int(n) for n in traffic if int(n) > 0})
+        if len(sizes) > 8:     # quantile-cap, never silently drop tails
+            step = (len(sizes) - 1) / 7.0
+            sizes = sorted({sizes[round(i * step)] for i in range(8)})
+        add(sizes, "exact")
+    quarter = max(max_batch // 4, 1)
+    add(range(quarter, max_batch + 1, quarter), "linear")
+    for i, l in enumerate(extra or ()):
+        add(l, "extra%d" % i)
+    return cands
+
+
+def sharding_candidates(program, mesh, min_bytes=1 << 20):
+    """GSPMD candidates: column-shard every matmul/mul weight parameter
+    at least ``min_bytes`` big over one mesh axis (the static_sharding
+    ``dist_attr`` convention; XLA inserts the collectives).  Empty when
+    there is no mesh, no axis with >1 devices, or no big-enough weight
+    — a 1-chip box searches nothing here by construction."""
+    if mesh is None:
+        return []
+    axes = [a for a in getattr(mesh, "axis_names", ())
+            if a != "pp" and mesh.axis_size(a) > 1]
+    if not axes:
+        return []
+    from ..analysis.perf import _itemsize
+
+    block = program.global_block
+    big = []
+    for op in block.ops:
+        if op.type not in ("matmul", "mul"):
+            continue
+        for name in op.all_input_names():
+            v = block._find_var_recursive(name)
+            if v is None or not getattr(v, "persistable", False):
+                continue
+            shape = v.shape or ()
+            if len(shape) < 2 or any(s <= 0 for s in shape):
+                continue
+            n = 1
+            for s in shape:
+                n *= int(s)
+            if n * _itemsize(v.dtype) >= min_bytes and name not in big:
+                big.append(name)
+    if not big:
+        return []
+    out = []
+    for ax in axes:
+        # column-parallel: last dim over the axis; the activations stay
+        # replicated and XLA all-gathers at the boundary it picks
+        out.append(Candidate(
+            "program",
+            {"pipeline": [], "donate": True,
+             "sharding": {"axis": ax, "vars": list(big), "dim": -1}},
+            label="shard[%s]x%d" % (ax, mesh.axis_size(ax))))
+    return out
+
+
+class SearchSpace:
+    """The program-level candidate space: ``pipelines`` x ``donate``
+    (+ sharding variants when a mesh is ambient).
+
+    * ``pipelines``: list of pass pipelines; each entry is a list of
+      pass names and/or ``ir.Pass`` instances.  Default: enumerated
+      from the registry (`default_pass_pipelines`).
+    * ``donate``: tuple of booleans for the buffer-donation knob.
+    * ``sharding``: True (default) enumerates mesh sharding candidates,
+      False suppresses them.
+    * ``min_shard_bytes``: threshold for "large matmul".
+    """
+
+    def __init__(self, pipelines=None, donate=(True, False),
+                 sharding=True, min_shard_bytes=1 << 20):
+        self.pipelines = ([list(p) for p in pipelines]
+                          if pipelines is not None else None)
+        self.donate = tuple(bool(d) for d in donate) or (True,)
+        self.sharding = sharding
+        self.min_shard_bytes = min_shard_bytes
+
+    def program_candidates(self, program, mesh=None):
+        pipelines = (self.pipelines if self.pipelines is not None
+                     else default_pass_pipelines())
+        cands = []
+        have_baseline = False
+        for pipe in pipelines:
+            names = [_pass_name(p) for p in pipe]
+            passes = list(pipe)
+            for d in self.donate:
+                c = Candidate(
+                    "program",
+                    {"pipeline": names, "donate": d, "sharding": None},
+                    extra={"passes": passes})
+                cands.append(c)
+                if not names and d:
+                    have_baseline = True
+        if not have_baseline:
+            # the identity baseline is never optional: "tuned" is only a
+            # claim relative to a measured default
+            cands.insert(0, Candidate(
+                "program",
+                {"pipeline": [], "donate": True, "sharding": None},
+                extra={"passes": []}))
+        if self.sharding:
+            cands.extend(sharding_candidates(
+                program, mesh, min_bytes=self.min_shard_bytes))
+        return cands

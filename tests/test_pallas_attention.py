@@ -330,3 +330,89 @@ def test_fused_single_block_backward_matches_two_kernel(monkeypatch):
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
                 err_msg="fused-bwd grad mismatch for %s (seg=%s)"
                         % (name, with_seg))
+
+
+def test_explicit_block_override_changes_lowered_grid(monkeypatch):
+    """block_q/block_k are a hard contract: an explicit override must
+    actually change the pallas grid (the knob the autotuner searches),
+    not silently fall back to the heuristic."""
+    from paddle_tpu.ops.pallas import attention as A
+
+    B, H, S, D = 1, 2, 512, 64
+    q = _rand((B, H, S, D), 11)
+    grids = []
+    orig = A.pl.pallas_call
+
+    def spy(*args, **kw):
+        grids.append(kw.get("grid"))
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(A.pl, "pallas_call", spy)
+    A.flash_attention(q, q, q, interpret=True)
+    default_grid = grids[-1]
+    grids.clear()
+    A.flash_attention(q, q, q, interpret=True, block_q=128, block_k=256)
+    override_grid = grids[-1]
+    assert default_grid == (B * H, 1, 1)          # heuristic: one 512 block
+    assert override_grid == (B * H, 512 // 128, 512 // 256)
+    assert override_grid != default_grid
+
+
+def test_explicit_block_override_matches_naive_fwd_bwd():
+    B, H, S, D = 1, 2, 256, 64
+    q, k, v = _rand((B, H, S, D), 12), _rand((B, H, S, D), 13), \
+        _rand((B, H, S, D), 14)
+    scale = D ** -0.5
+
+    def f(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, scale=scale, causal=True, interpret=True,
+            block_q=128, block_k=128) * 0.01)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_naive_attention(q, k, v, None, scale, True) * 0.01)
+
+    out = flash_attention(q, k, v, scale=scale, causal=True,
+                          interpret=True, block_q=128, block_k=128)
+    ref = _naive_attention(q, k, v, None, scale, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3,
+            err_msg="block-override grad mismatch for %s" % name)
+
+
+def test_explicit_block_invalid_raises_and_wins_over_env(monkeypatch):
+    from paddle_tpu.ops.pallas.attention import _block_sizes
+
+    B, H, S, D = 1, 1, 256, 64
+    q = _rand((B, H, S, D), 15)
+    # non-divisor: hard error, never a silent fallback
+    with pytest.raises(ValueError, match="must divide"):
+        flash_attention(q, q, q, interpret=True, block_q=100)
+    # explicit argument beats the env override
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BLOCKS", "256,256")
+    assert _block_sizes(256, 256, 128, 128) == (128, 128)
+    # env still applies when no explicit argument is given
+    assert _block_sizes(256, 256) == (256, 256)
+
+
+def test_partial_explicit_block_keeps_env_for_other_side(monkeypatch):
+    """Precedence holds per side: an explicit block_q plus a fleet-wide
+    env pin means the env still governs block_k (heuristic only when
+    the env side does not divide)."""
+    from paddle_tpu.ops.pallas.attention import _block_sizes
+
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BLOCKS", "256,256")
+    assert _block_sizes(512, 512, 128, None) == (128, 256)
+    assert _block_sizes(512, 512, None, 128) == (256, 128)
+    # env side that does not divide falls to the heuristic
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BLOCKS", "256,384")
+    assert _block_sizes(512, 512, 128, None) == (128, 512)
+    # malformed env still raises, even on the explicit branch
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BLOCKS", "nope")
+    with pytest.raises(ValueError, match="two ints"):
+        _block_sizes(512, 512, 128, None)

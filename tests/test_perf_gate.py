@@ -1,0 +1,113 @@
+"""CI perf-regression gate: static roofline budgets for zoo models.
+
+`tools/program_cost.py --budget-ms` prices each model on an EXPLICIT
+chip (--peak-flops/--hbm-bw), so the gate is platform-independent: it
+fails when a future pass or lowering change inflates a model's static
+FLOPs/bytes past its pinned budget — the cheap, deterministic tier-1
+cousin of the measured autotuner (the cost model was anchored to XLA's
+own cost_analysis within ~1%% on these models in PERF.md round 8).
+
+Budgets are ~2.5x the estimates at pin time (see table below), so
+normal estimator recalibration never trips them but an accidental
+op-count/shape blowup (a fusion pass gone wrong, a transpose storm, a
+de-optimized lowering) does.  If a budget fires after an INTENTIONAL
+model/estimator change, re-pin it in this file with the new measured
+estimate — that is the review moment the gate exists to create.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import models
+from paddle_tpu.fluid import layers
+
+# the gate's fixed pricing chip — NOT a real platform on purpose
+PEAK_FLOPS = "1e14"
+HBM_BW = "1e12"
+
+# model -> (builder, budget_ms).  Estimates at pin time (2026-08-04):
+# lenet 0.0050 ms, resnet18 0.0565 ms, bert-small 0.0281 ms.
+_GATE = {}
+
+
+def _gate(name, budget_ms):
+    def deco(fn):
+        _GATE[name] = (fn, budget_ms)
+        return fn
+    return deco
+
+
+@_gate("lenet", 0.015)
+def _build_lenet():
+    x = layers.data("img", shape=[-1, 1, 28, 28], append_batch_size=False)
+    return models.LeNet5()(x)
+
+
+@_gate("resnet18", 0.15)
+def _build_resnet18():
+    x = layers.data("img", shape=[-1, 3, 32, 32], append_batch_size=False)
+    return models.resnet18(num_classes=7)(x)
+
+
+@_gate("bert_small", 0.08)
+def _build_bert_small():
+    cfg = models.BertConfig(
+        vocab_size=512, hidden_size=128, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=512,
+        max_position_embeddings=128, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    mk = lambda n: layers.data(  # noqa: E731
+        n, shape=[4, 64], append_batch_size=False, dtype="int64")
+    logits, _nsp = models.BertForPretraining(cfg)(
+        mk("ids"), mk("seg"), mk("pos"), mk("mask"))
+    return logits
+
+
+def _program_cost_tool():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "program_cost", os.path.join(repo, "tools", "program_cost.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _dump(name, tmp_path):
+    builder, budget = _GATE[name]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        builder()
+    path = str(tmp_path / ("%s.json" % name))
+    with open(path, "w") as f:
+        f.write(main.to_json())
+    return path, budget
+
+
+@pytest.mark.parametrize("name", sorted(_GATE), ids=sorted(_GATE))
+def test_zoo_model_within_static_roofline_budget(name, tmp_path, capsys):
+    pc = _program_cost_tool()
+    path, budget = _dump(name, tmp_path)
+    rc = pc.main([path, "--budget-ms", str(budget),
+                  "--peak-flops", PEAK_FLOPS, "--hbm-bw", HBM_BW])
+    out = capsys.readouterr().out
+    assert rc == 0, (
+        "%s blew its static roofline budget (%.3f ms): a pass or "
+        "lowering change inflated the program's estimated cost.\n%s"
+        % (name, budget, out))
+    assert "within" in out
+
+
+@pytest.mark.parametrize("name", sorted(_GATE), ids=sorted(_GATE))
+def test_gate_actually_binds(name, tmp_path, capsys):
+    """A vacuous gate is worse than none: the same model must FAIL a
+    near-zero budget, proving the estimate is non-trivial and the rc
+    contract holds."""
+    pc = _program_cost_tool()
+    path, _budget = _dump(name, tmp_path)
+    rc = pc.main([path, "--budget-ms", "1e-9",
+                  "--peak-flops", PEAK_FLOPS, "--hbm-bw", HBM_BW])
+    capsys.readouterr()
+    assert rc == 1

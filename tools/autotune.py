@@ -1,0 +1,200 @@
+"""Operator CLI for the measured autotuner (`paddle_tpu.tune`).
+
+Usage::
+
+    # tune a serialized program's pass pipeline (search report to stdout)
+    python tools/autotune.py path/to/__model__.json --fetch out.tmp_0 \
+        [--json] [--budget-s 120] [--k 5] [--warmup 1] \
+        [--cache-dir DIR] [--no-cache] [--dynamic-dim 8]
+
+    # pre-tune a serving model's batch-bucket ladder from an observed
+    # traffic sample (request batch sizes), then deploy with the winner
+    python tools/autotune.py model_dir --ladder-traffic 1,1,3,7,1,2 \
+        [--max-batch 32] [--json]
+
+    # tune flash-attention block sizes for one shape
+    python tools/autotune.py --flash 8,12,512,64 [--causal] \
+        [--layout BHSD] [--flash-backward] [--json]
+
+The report lists every candidate with its terminal status — ``timed``
+(est + measured + attributed compile time), ``pruned`` (statically
+rejected, never compiled), ``excluded`` (broken by a pass, offender
+named), ``skipped_budget`` — plus the winner vs the measured default.
+``--json`` emits `SearchReport.to_dict()` (schema_version 1).
+
+Exit code: 1 when the model is unreadable or the search produced no
+winner; 0 otherwise.  A cache hit prints the stored winner and compiles
+nothing — delete the entry (path printed) to force a re-search.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(_HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(1, _HERE)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="autotune",
+        description="measured autotuner: search pass pipelines, serving "
+                    "bucket ladders, or flash-attention block sizes")
+    ap.add_argument("model", nargs="?", default=None,
+                    help="program JSON file or inference model dir "
+                         "(omit with --flash)")
+    ap.add_argument("--fetch", default="",
+                    help="comma-separated fetch var names (overrides the "
+                         "model dir's recorded fetches)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the full SearchReport as JSON")
+    ap.add_argument("--budget-s", type=float, default=None,
+                    help="bound the compile-and-time phase (baseline "
+                         "always runs; the rest becomes skipped_budget)")
+    ap.add_argument("--k", type=int, default=5,
+                    help="timed repetitions per candidate (median)")
+    ap.add_argument("--warmup", type=int, default=1,
+                    help="warmup calls per candidate (compile happens "
+                         "here and is attributed separately)")
+    ap.add_argument("--dynamic-dim", type=int, default=None,
+                    help="extent substituted for -1 dims (default 8)")
+    ap.add_argument("--pipelines", default=None,
+                    help="semicolon-separated candidate pipelines, each "
+                         "a comma-separated list of registered pass "
+                         "names (an empty entry is the baseline); "
+                         "replaces the default registry-enumerated "
+                         "space, e.g. ';batch_norm_act_fuse'")
+    ap.add_argument("--cache-dir", default=None,
+                    help="tuning cache directory (default: the "
+                         "persistent compile-cache dir)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="search even when a cached winner exists, and "
+                         "do not store the result")
+    # ladder mode
+    ap.add_argument("--ladder-traffic", default=None,
+                    help="comma-separated observed request batch sizes; "
+                         "switches to bucket-ladder tuning against the "
+                         "model dir's Predictor")
+    ap.add_argument("--max-batch", type=int, default=32,
+                    help="ladder mode: max coalesced batch")
+    # flash mode
+    ap.add_argument("--flash", default=None,
+                    help="B,H,S,D (BHSD) or B,S,H,D (BSHD) q shape; "
+                         "switches to flash block-size tuning")
+    ap.add_argument("--kv-len", type=int, default=None,
+                    help="flash mode: key/value length (default: S)")
+    ap.add_argument("--causal", action="store_true",
+                    help="flash mode: causal masking")
+    ap.add_argument("--layout", default="BHSD", choices=("BHSD", "BSHD"),
+                    help="flash mode: q/k/v layout")
+    ap.add_argument("--flash-backward", action="store_true",
+                    help="flash mode: time forward+backward")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu import tune
+
+    kw = dict(use_cache=not args.no_cache, cache_dir=args.cache_dir,
+              warmup=args.warmup, k=args.k)
+
+    if args.flash:
+        try:
+            shape = tuple(int(s) for s in args.flash.split(","))
+            if len(shape) != 4:
+                raise ValueError("need 4 dims")
+        except ValueError as e:
+            print("error: --flash expects B,H,S,D: %s" % e,
+                  file=sys.stderr)
+            return 1
+        report = tune.search_flash_blocks(
+            shape, kv_len=args.kv_len, causal=args.causal,
+            layout=args.layout, include_backward=args.flash_backward,
+            **kw)
+        return _emit(report, args)
+
+    if args.model is None:
+        print("error: a model path is required (or use --flash)",
+              file=sys.stderr)
+        return 1
+
+    if args.ladder_traffic is not None:
+        try:
+            traffic = [int(s) for s in args.ladder_traffic.split(",") if s]
+        except ValueError:
+            print("error: --ladder-traffic expects comma-separated ints",
+                  file=sys.stderr)
+            return 1
+        try:
+            from paddle_tpu.inference import AnalysisConfig, Predictor
+
+            pred = Predictor(AnalysisConfig(args.model))
+        except Exception as e:
+            print("error: cannot load predictor from %r: %s"
+                  % (args.model, e), file=sys.stderr)
+            return 1
+        example = _example_feed(pred)
+        report = tune.search_bucket_ladder(
+            pred, example, traffic, max_batch=args.max_batch, **kw)
+        return _emit(report, args)
+
+    from program_lint import _load
+
+    try:
+        program, _feeds, fetches = _load(args.model)
+    except SystemExit:
+        raise
+    except Exception as e:
+        print("error: cannot load %r: %s" % (args.model, e),
+              file=sys.stderr)
+        return 1
+    if args.fetch:
+        fetches = [s for s in args.fetch.split(",") if s]
+    if not fetches:
+        print("error: no fetch names (pass --fetch or use a model dir "
+              "with recorded fetches)", file=sys.stderr)
+        return 1
+    skw = dict(kw)
+    if args.dynamic_dim is not None:
+        skw["dynamic_dim"] = args.dynamic_dim
+    if args.pipelines is not None:
+        pipes = [[n for n in cand.split(",") if n]
+                 for cand in args.pipelines.split(";")]
+        skw["space"] = tune.SearchSpace(pipelines=pipes, donate=(True,),
+                                        sharding=False)
+    report = tune.search(program, fetches, budget_s=args.budget_s, **skw)
+    return _emit(report, args)
+
+
+def _example_feed(pred):
+    """Zero batch-1 example from the predictor's recorded feed shapes."""
+    import numpy as np
+
+    from paddle_tpu.analysis.perf import DEFAULT_DYNAMIC_DIM
+
+    block = pred._program.global_block
+    feed = {}
+    for n in pred.get_input_names():
+        v = block._find_var_recursive(n)
+        shape = [1] + [DEFAULT_DYNAMIC_DIM if s == -1 else int(s)
+                       for s in (v.shape or ())[1:]]
+        from paddle_tpu.fluid.core import dtypes as dtypes_mod
+
+        feed[n] = np.zeros(tuple(shape),
+                           np.dtype(dtypes_mod.to_jnp(v.dtype)))
+    return feed
+
+
+def _emit(report, args):
+    if args.as_json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        print(report.format())
+    return 0 if report.winner is not None else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,6 +55,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.fleet",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.tune",
 ]
 
 
