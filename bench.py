@@ -388,18 +388,40 @@ def _autotune_bert_step(cfg, mesh, loss_fn, np_batches, k_short, k_long,
     from paddle_tpu.fluid.optimizer import AdamWOptimizer
 
     variants = [
-        ("default", {"remat": False, "donate": True, "fused_bwd": True}),
-        ("remat", {"remat": True, "donate": True, "fused_bwd": True}),
+        ("default", {"remat": False, "donate": True, "fused_bwd": True,
+                     "fused_ffn": False, "head_layout": "BSHD"}),
+        ("remat", {"remat": True, "donate": True, "fused_bwd": True,
+                   "fused_ffn": False, "head_layout": "BSHD"}),
         ("no_fused_flash_bwd",
-         {"remat": False, "donate": True, "fused_bwd": False}),
+         {"remat": False, "donate": True, "fused_bwd": False,
+          "fused_ffn": False, "head_layout": "BSHD"}),
+        # fused-epilogue FFN (matmul_bias_act, the MatmulBiasActFusePass
+        # target) vs XLA's own fusion of the unfused chain
+        ("fused_ffn", {"remat": False, "donate": True, "fused_bwd": True,
+                       "fused_ffn": True, "head_layout": "BSHD"}),
+        # the head-major layout that MATERIALIZES the [B,S,H,D]<->
+        # [B,H,S,D] transposes — the negative control for the
+        # transpose-free default (what TransposeFoldPass restores)
+        ("bhsd_head_transposes",
+         {"remat": False, "donate": True, "fused_bwd": True,
+          "fused_ffn": False, "head_layout": "BHSD"}),
     ]
+
+    _ENV_KNOBS = (
+        ("PADDLE_TPU_FLASH_FUSED_BWD",
+         lambda p: "1" if p.get("fused_bwd", True) else "0"),
+        ("PADDLE_TPU_FUSED_FFN",
+         lambda p: "1" if p.get("fused_ffn") else "0"),
+        ("PADDLE_TPU_BERT_HEAD_LAYOUT",
+         lambda p: p.get("head_layout", "BSHD")),
+    )
 
     def build_and_time(params):
         if params == variants[0][1]:
             return default_dt          # measured by the headline harness
-        prev = os.environ.get("PADDLE_TPU_FLASH_FUSED_BWD")
-        os.environ["PADDLE_TPU_FLASH_FUSED_BWD"] = (
-            "1" if params.get("fused_bwd", True) else "0")
+        prev = {k: os.environ.get(k) for k, _v in _ENV_KNOBS}
+        for k, val in _ENV_KNOBS:
+            os.environ[k] = val(params)
         try:
             with dygraph.guard():
                 model = models.BertForPretraining(cfg)
@@ -418,10 +440,11 @@ def _autotune_bert_step(cfg, mesh, loss_fn, np_batches, k_short, k_long,
                     step, state, placed, k_short, k_long, reps)
             return v_dt
         finally:
-            if prev is None:
-                os.environ.pop("PADDLE_TPU_FLASH_FUSED_BWD", None)
-            else:
-                os.environ["PADDLE_TPU_FLASH_FUSED_BWD"] = prev
+            for k, old in prev.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
 
     workload = "bench.bert_step:B%d.S%d.L%d.h%d" % (
         B, S, cfg.num_hidden_layers, cfg.hidden_size)

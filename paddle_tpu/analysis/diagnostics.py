@@ -22,7 +22,8 @@ class Diagnostic:
     human-readable statement, coordinates locate the op."""
 
     def __init__(self, severity, code, message, block_idx=None, op_idx=None,
-                 op_type=None, var_names=(), provenance=None, pass_name=None):
+                 op_type=None, var_names=(), provenance=None, pass_name=None,
+                 fix=None):
         self.severity = severity
         self.code = code
         self.message = message
@@ -32,6 +33,11 @@ class Diagnostic:
         self.var_names = tuple(var_names)
         self.provenance = list(provenance or [])
         self.pass_name = pass_name
+        # the registered fluid.ir pass (by name) that mechanically fixes
+        # this finding, when one exists — the rule<->pass linkage the
+        # autotuner and `apply_passes` act on (e.g. the perf lints name
+        # "matmul_bias_act_fuse" / "transpose_fold")
+        self.fix = fix
 
     def to_dict(self):
         return {
@@ -44,6 +50,7 @@ class Diagnostic:
             "var_names": list(self.var_names),
             "provenance": list(self.provenance),
             "pass_name": self.pass_name,
+            "fix": self.fix,
         }
 
     def format(self):
@@ -58,8 +65,11 @@ class Diagnostic:
         prov = ""
         if self.provenance:
             prov = "\n    built at: " + " <- ".join(self.provenance)
-        return "[%s] %s: %s%s%s" % (
-            self.severity.upper(), self.code, self.message, loc, prov)
+        fix = ""
+        if self.fix:
+            fix = "\n    fix: apply_passes(program, [%r])" % self.fix
+        return "[%s] %s: %s%s%s%s" % (
+            self.severity.upper(), self.code, self.message, loc, prov, fix)
 
     def __repr__(self):
         return "Diagnostic(%s)" % self.format()

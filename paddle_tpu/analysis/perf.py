@@ -422,6 +422,46 @@ def _cost_gelu(ins, outs, attrs):
     return {"flops": 64.0 * n, "transcendentals": n}
 
 
+@register_op_cost("matmul_bias_act")
+def _cost_matmul_bias_act(ins, outs, attrs):
+    """Fused-epilogue GEMM: matmul FLOPs + one elementwise epilogue
+    pass — and, critically, ONE [M,N] traffic pass instead of the
+    unfused chain's three (matmul write + add read/write + act
+    read/write).  The default bytes accounting (operand+result of THIS
+    op only) models that exactly, which is what makes
+    `rank_pass_pipelines` statically rank `matmul_bias_act_fuse` above
+    the unfused baseline."""
+    x = _first(ins, "X")
+    out = _first(outs, "Out")
+    if x is None or out is None:
+        return {"flops": 0}
+    xs = x[0]
+    n_out = _elems(out[0])
+    xn = attrs.get("x_num_col_dims")
+    if xn is not None:
+        k = _elems(xs[int(xn):])
+    else:
+        tx = attrs.get("transpose_X", attrs.get("transpose_x", False))
+        k = xs[-2] if (tx and len(xs) > 1) else xs[-1]
+    flops = 2.0 * n_out * int(k)
+    act = attrs.get("act_type", "none")
+    trans = 0.0
+    if act == "gelu":
+        # same per-element accounting as the standalone gelu estimator
+        if attrs.get("approximate", False):
+            flops += 8.0 * n_out
+        else:
+            flops += 64.0 * n_out
+        trans += float(n_out)
+    elif act == "tanh":
+        trans += float(n_out)
+    elif act == "relu":
+        flops += float(n_out)
+    if _first(ins, "Bias") is not None:
+        flops += float(n_out)
+    return {"flops": flops, "transcendentals": trans}
+
+
 @register_op_cost("cond", "while_loop_op", "static_rnn",
                   "recompute_segment")
 def _cost_container(ins, outs, attrs):

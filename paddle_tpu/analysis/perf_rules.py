@@ -100,7 +100,8 @@ class LayoutTransposeHazardRule(LintRule):
                     % (oidx, t1_idx, _axis_perm(t1), p2),
                     block_idx=block.idx, op_idx=oidx, op_type=op.type,
                     var_names=op.all_input_names(),
-                    provenance=_provenance(op))
+                    provenance=_provenance(op),
+                    fix="transpose_fold")
         return diags
 
     def _find_cancelling(self, block, t2, t2_idx, p2):
@@ -179,6 +180,29 @@ class UnfusedEpilogueRule(LintRule):
     category = "perf"
     severity = INFO
     _ACTS = ("relu", "gelu", "tanh", "sigmoid", "swish", "relu6")
+    # pure data-movement ops the chain may pass through without hiding
+    # the fusion candidate (the BERT FFN emits a reshape between matmul
+    # and add; a cast shows up in AMP regions) — each hop must still be
+    # single-consumer for the epilogue to be privately fusable
+    _THROUGH = ("reshape2", "reshape", "cast")
+
+    def _follow(self, n_consumers, consumer_at, name):
+        """Next non-movement sole consumer of `name`: skips through
+        single-consumer reshape/cast hops.  Returns (idx, op, hop_types)
+        or None when a hop fans out or the chain dead-ends."""
+        hops = []
+        while True:
+            if n_consumers.get(name, 0) != 1:
+                return None
+            i, op = consumer_at[name]
+            if op.type in self._THROUGH:
+                outs = op.all_output_names()
+                if not outs:
+                    return None
+                name = outs[0]
+                hops.append(op.type)
+                continue
+            return i, op, hops
 
     def check(self, ctx):
         diags = Diagnostics()
@@ -194,28 +218,54 @@ class UnfusedEpilogueRule(LintRule):
                 if op.type not in ("matmul", "mul"):
                     continue
                 outs = op.all_output_names()
-                if not outs or n_consumers.get(outs[0], 0) != 1:
+                if not outs:
                     continue
-                _bi, bias_op = consumer_at[outs[0]]
+                hit = self._follow(n_consumers, consumer_at, outs[0])
+                if hit is None:
+                    continue
+                _bi, bias_op, pre_hops = hit
                 if bias_op.type != "elementwise_add":
                     continue
                 bouts = bias_op.all_output_names()
-                if not bouts or n_consumers.get(bouts[0], 0) != 1:
+                if not bouts:
                     continue
-                ai, act_op = consumer_at[bouts[0]]
+                hit = self._follow(n_consumers, consumer_at, bouts[0])
+                if hit is None:
+                    continue
+                ai, act_op, post_hops = hit
                 if act_op.type not in self._ACTS:
                     continue
+                via = ""
+                if pre_hops or post_hops:
+                    via = (" (through %d interposed reshape/cast hop(s) "
+                           "— pure data movement that only HIDES the "
+                           "fusion candidate)"
+                           % (len(pre_hops) + len(post_hops)))
+                # the fix hint is only attached when
+                # MatmulBiasActFusePass can actually rewrite THIS chain:
+                # direct add->act, reshape-only pre-hops, and an
+                # activation the fused kernel implements — a hint that
+                # names a pass which then declines the chain would send
+                # the user (and any lints-clean-after-fix gate) in
+                # circles
+                fixable = (
+                    not post_hops
+                    and all(t in ("reshape2", "reshape")
+                            for t in pre_hops)
+                    and act_op.type in ("relu", "tanh", "gelu"))
                 diags.add(
                     self.severity, self.name,
                     "%s (op %d) -> bias add (op %d) -> %s (op %d) is a "
-                    "fusable epilogue chain: unfused, the [M,N] "
+                    "fusable epilogue chain%s: unfused, the [M,N] "
                     "intermediate round-trips HBM twice; a fused "
                     "matmul+bias+%s kernel (pallas epilogue path) "
                     "writes it once"
-                    % (op.type, oidx, _bi, act_op.type, ai, act_op.type),
+                    % (op.type, oidx, _bi, act_op.type, ai, via,
+                       act_op.type),
                     block_idx=block.idx, op_idx=oidx, op_type=op.type,
                     var_names=[outs[0], bouts[0]],
-                    provenance=_provenance(op))
+                    provenance=_provenance(op),
+                    fix="matmul_bias_act_fuse" if fixable else None)
         return diags
 
 

@@ -202,6 +202,84 @@ def _dot(ctx, ins, attrs):
     return {"Out": [jnp.sum(x * y, axis=-1, keepdims=True)]}
 
 
+@register_op("matmul_bias_act", inputs=["X", "Y", "Bias"], outputs=["Out"])
+def _matmul_bias_act(ctx, ins, attrs):
+    """Fused-epilogue GEMM: matmul + bias add + activation in one op.
+
+    The target of `fluid.ir.MatmulBiasActFusePass` (which rewrites the
+    matmul/mul -> elementwise_add -> act chains the `unfused-epilogue`
+    lint flags) and of `nn.functional.fused_linear`.  On TPU, plain
+    untransposed 128-tileable shapes lower to the pallas fused-epilogue
+    kernel (`ops.pallas.matmul.matmul_bias_act`, custom-VJP fused
+    backward); everything else lowers to the jnp composition XLA fuses
+    itself — numerically the same contraction either way (f32
+    accumulation).
+
+    attrs: ``act_type`` in {none, relu, tanh, gelu} (+``approximate``
+    for the tanh gelu), and ONE of the two source-op attr conventions —
+    mul-style ``x_num_col_dims``/``y_num_col_dims`` flattening, or
+    matmul-style ``transpose_X``/``transpose_Y``/``alpha``."""
+    import jax as _jax
+
+    x, w = ins["X"][0], ins["Y"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    act = attrs.get("act_type", "none")
+    if act not in ("none", "relu", "tanh", "gelu"):
+        # validated on EVERY path: the batched/naive branches below
+        # would otherwise silently return un-activated output for an
+        # activation the pallas path raises on
+        raise ValueError(
+            "matmul_bias_act act_type must be one of "
+            "('none', 'relu', 'tanh', 'gelu'), got %r" % act)
+    approx = attrs.get("approximate", False)
+    xn = attrs.get("x_num_col_dims")
+    if xn is not None:                      # mul-style flatten
+        yn = attrs.get("y_num_col_dims", 1)
+        out_shape = x.shape[:xn] + w.shape[yn:]
+        x2 = x.reshape((-1, _prod(x.shape[xn:])))
+        w2 = w.reshape((int(_prod(w.shape[:yn])), -1))
+        alpha, tx, ty = 1.0, False, False
+    else:                                   # matmul-style
+        tx = attrs.get("transpose_X", attrs.get("transpose_x", False))
+        ty = attrs.get("transpose_Y", attrs.get("transpose_y", False))
+        alpha = attrs.get("alpha", 1.0)
+        x2 = jnp.swapaxes(x, -1, -2) if (tx and x.ndim > 1) else x
+        w2 = jnp.swapaxes(w, -1, -2) if (ty and w.ndim > 1) else w
+        out_shape = None                    # jnp.matmul shape as-is
+
+    from ...ops.pallas.matmul import matmul_bias_act, naive_matmul_bias_act
+
+    use_pallas = (
+        _jax.default_backend() == "tpu"
+        and x2.ndim == 2 and w2.ndim == 2
+        and not tx and not ty and alpha == 1.0
+        and x2.shape[0] % 128 == 0 and x2.shape[1] % 128 == 0
+        and w2.shape[1] % 128 == 0
+    )
+    if use_pallas:
+        out = matmul_bias_act(x2, w2, bias, activation=act,
+                              approximate=approx)
+    else:
+        if x2.ndim == 2 and w2.ndim == 2 and alpha == 1.0:
+            out = naive_matmul_bias_act(x2, w2, bias, activation=act,
+                                        approximate=approx)
+        else:
+            out = jnp.matmul(x2, w2)
+            if alpha != 1.0:
+                out = out * alpha
+            if bias is not None:
+                out = out + bias
+            if act == "gelu":
+                out = _jax.nn.gelu(out, approximate=approx)
+            elif act == "relu":
+                out = _jax.nn.relu(out)
+            elif act == "tanh":
+                out = jnp.tanh(out)
+    if out_shape is not None:
+        out = out.reshape(out_shape)
+    return {"Out": [out]}
+
+
 @register_op("bmm", inputs=["X", "Y"], outputs=["Out"])
 def _bmm(ctx, ins, attrs):
     return {"Out": [jnp.matmul(ins["X"][0], ins["Y"][0])]}

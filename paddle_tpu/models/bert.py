@@ -12,11 +12,36 @@ Attention uses the fused `flash_attention` op (pallas kernel on TPU).
 from __future__ import annotations
 
 import math
+import os
 
 from ..fluid import dygraph, layers
 from ..fluid.initializer import NormalInitializer, ConstantInitializer
 from ..fluid.layer_helper import ParamAttr
 from ..fluid.layers.common import append_simple_op
+
+
+def _fused_ffn_enabled():
+    """``PADDLE_TPU_FUSED_FFN=1`` routes the FFN's fc1+gelu through the
+    fused-epilogue ``matmul_bias_act`` op instead of the
+    mul -> elementwise_add -> gelu chain — the knob `bench.py
+    --autotune` arbitrates (measure-keep-or-reject) and the eager-mode
+    twin of what `ir.MatmulBiasActFusePass` does to static programs."""
+    return os.getenv("PADDLE_TPU_FUSED_FFN") == "1"
+
+
+def _head_layout():
+    """``PADDLE_TPU_BERT_HEAD_LAYOUT=BHSD`` rebuilds attention in the
+    head-major layout, MATERIALIZING the [B,S,H,D]<->[B,H,S,D]
+    transposes the default transpose-free BSHD path avoids — the
+    negative control `bench.py --autotune` times against the default,
+    and (in static mode) the exact hazard `ir.TransposeFoldPass`
+    cancels."""
+    v = os.getenv("PADDLE_TPU_BERT_HEAD_LAYOUT", "BSHD").upper()
+    if v not in ("BSHD", "BHSD"):
+        raise ValueError(
+            "PADDLE_TPU_BERT_HEAD_LAYOUT must be BSHD or BHSD, got %r"
+            % v)
+    return v
 
 
 class BertConfig:
@@ -145,6 +170,11 @@ class MultiHeadAttention(dygraph.Layer):
             q = self._split(self.q_proj(query), q_len)
             k = self._split(self.k_proj(key), kv_len)
             v = self._split(self.v_proj(value), kv_len)
+        layout = _head_layout()
+        if layout == "BHSD":
+            q = layers.transpose(q, [0, 2, 1, 3])
+            k = layers.transpose(k, [0, 2, 1, 3])
+            v = layers.transpose(v, [0, 2, 1, 3])
         ins = {"Q": q, "K": k, "V": v}
         if attn_bias is not None:
             ins["Bias"] = attn_bias
@@ -167,8 +197,10 @@ class MultiHeadAttention(dygraph.Layer):
             "flash_attention",
             ins,
             {"scale": self.d_head ** -0.5, "causal": causal,
-             "layout": "BSHD"},
+             "layout": layout},
         )
+        if layout == "BHSD":
+            ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
         ctxv = layers.reshape(ctxv, [0, q_len, self.n_head * self.d_head])
         return self.dropout(self.out_proj(ctxv))
 
@@ -192,7 +224,13 @@ class TransformerEncoderLayer(dygraph.Layer):
         h = self.ln1(
             x + self.attn(x, attn_bias=attn_bias, segment_ids=segment_ids)
         )
-        f = self.fc2(layers.gelu(self.fc1(h)))
+        if _fused_ffn_enabled():
+            from ..nn import functional as F
+
+            f = self.fc2(F.fused_linear(h, self.fc1.weight, self.fc1.bias,
+                                        activation="gelu"))
+        else:
+            f = self.fc2(layers.gelu(self.fc1(h)))
         return self.ln2(h + self.dropout(f))
 
 

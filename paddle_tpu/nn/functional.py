@@ -58,6 +58,32 @@ def linear(x, weight, bias=None):
     return out
 
 
+def fused_linear(x, weight, bias=None, activation="none",
+                 approximate=False):
+    """Linear + bias + activation as ONE ``matmul_bias_act`` op — the
+    fused-epilogue GEMM (`ops.pallas.matmul`): on TPU the bias add and
+    activation run on the f32 accumulator tile before the HBM
+    writeback, and the custom-VJP backward fuses dact into the dX/dW
+    GEMMs.  ``activation`` in {"none", "relu", "tanh", "gelu"}
+    (``approximate`` picks the tanh gelu).
+
+    The composed spelling (`linear` + `gelu`, or `fluid.dygraph.Linear`
+    with an act) emits the matmul -> elementwise_add -> act chain that
+    `fluid.ir.MatmulBiasActFusePass` rewrites to this same op — use
+    ``fused_linear`` to get the fused op directly (dygraph mode
+    included, where no program rewrite ever runs)."""
+    from ..fluid.layers.common import append_simple_op
+
+    ins = {"X": x, "Y": weight}
+    if bias is not None:
+        ins["Bias"] = bias
+    return append_simple_op(
+        "matmul_bias_act", ins,
+        {"act_type": activation, "approximate": bool(approximate),
+         "x_num_col_dims": len(x.shape) - 1, "y_num_col_dims": 1},
+    )
+
+
 def embedding(x, weight, padding_idx=None):
     from ..fluid.layers.common import append_simple_op
 

@@ -2,5 +2,10 @@
 
 Capability parity: reference `operators/fused/` CUDA kernels +
 `ir/fusion_group` NVRTC codegen — here only where XLA fusion genuinely
-can't help (online-softmax attention streaming K/V through VMEM).
+can't help (online-softmax attention streaming K/V through VMEM; the
+fused-epilogue GEMM family keeping bias+activation on the f32
+accumulator tile instead of round-tripping the [M, N] intermediate
+through HBM).
 """
+
+from .matmul import matmul_bias_act, naive_matmul_bias_act  # noqa: F401

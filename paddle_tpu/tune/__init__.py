@@ -20,6 +20,8 @@ Front ends:
   (+ GSPMD sharding of large matmuls on an ambient mesh);
 * ``search_flash_blocks(shape, ...)`` — the pallas attention
   (block_q, block_k) grid;
+* ``search_gemm_blocks(m, k, n, ...)`` — the pallas fused-epilogue
+  GEMM (block_m, block_n, block_k) tile grid;
 * ``search_bucket_ladder(predictor, example, traffic, ...)`` — serving
   batch-bucket ladders (`InferenceServer.autotune` wires it in);
 * ``search_step(build_and_time, variants, ...)`` — opaque jitted-step
@@ -44,6 +46,7 @@ from .search import (  # noqa: F401
     search,
     search_bucket_ladder,
     search_flash_blocks,
+    search_gemm_blocks,
     search_step,
     tuned_program,
 )
@@ -52,6 +55,7 @@ from .space import (  # noqa: F401
     SearchSpace,
     default_pass_pipelines,
     flash_block_candidates,
+    gemm_block_candidates,
     ladder_candidates,
     sharding_candidates,
 )
@@ -67,10 +71,12 @@ __all__ = [
     "default_cache_dir",
     "default_pass_pipelines",
     "flash_block_candidates",
+    "gemm_block_candidates",
     "ladder_candidates",
     "search",
     "search_bucket_ladder",
     "search_flash_blocks",
+    "search_gemm_blocks",
     "search_step",
     "sharding_candidates",
     "tuned_program",

@@ -48,6 +48,7 @@ __all__ = [
     "search",
     "search_bucket_ladder",
     "search_flash_blocks",
+    "search_gemm_blocks",
     "search_step",
     "tuned_program",
 ]
@@ -820,6 +821,118 @@ def search_flash_blocks(shape, *, kv_len=None, causal=False,
         cache_stored = True
     return SearchReport(
         "flash_blocks", workload, parts, False, results, winner,
+        default_s=default_s, searched_s=time.perf_counter() - t_start,
+        cache_path=cache_path, cache_stored=bool(cache_stored))
+
+
+# ---------------------------------------------------------------------------
+# fused-GEMM block search
+# ---------------------------------------------------------------------------
+
+
+def search_gemm_blocks(m, k, n, *, activation="gelu", bias=True,
+                       dtype="float32", grid=None, include_backward=False,
+                       interpret=None, warmup=1, k_times=3, use_cache=True,
+                       cache_dir=None, platform=None, jax_version=None):
+    """Measured (block_m, block_n, block_k) search for one fused-GEMM
+    shape — `search_flash_blocks` extended to the MXU tile grid of
+    `ops.pallas.matmul.matmul_bias_act` ([M, K] x [K, N] with the
+    bias+activation epilogue).  Returns a SearchReport whose winner
+    params are ``{"block_m", "block_n", "block_k"}`` — pass them to
+    ``matmul_bias_act(..., block_m=, block_n=, block_k=)`` (or set
+    ``PADDLE_TPU_GEMM_BLOCKS=bm,bn,bk`` for code you don't own)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.pallas.matmul import _pick_block, matmul_bias_act
+
+    t_start = time.perf_counter()
+    m, k, n = int(m), int(k), int(n)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    workload = ("gemm:m%d.k%d.n%d.%s.%s.bias%d.bwd%d.grid%s.interp%d" % (
+        m, k, n, activation, dtype, int(bool(bias)),
+        int(include_backward),
+        "x".join(str(int(g)) for g in grid) if grid else "dflt",
+        int(bool(interpret))))
+    from ..analysis.perf import ChipSpec
+
+    parts = cache_key_parts(workload, chip=ChipSpec.detect(),
+                            platform=platform, jax_version=jax_version)
+    cache = _resolve_cache(use_cache, cache_dir)
+    if cache is not None:
+        entry = cache.get(parts)
+        if entry is not None:
+            _note_status(CACHED)
+            return SearchReport(
+                "gemm_blocks", workload, parts, True, [],
+                _winner_from_entry("gemm_blocks", entry),
+                default_s=entry.get("default_s"), searched_s=0.0,
+                cache_path=cache.path_for(parts))
+
+    cands = space_mod.gemm_block_candidates(m, k, n, grid=grid)
+    rng = np.random.RandomState(0)
+
+    def mk(*s):
+        return jnp.asarray(rng.randn(*s).astype(dtype) * 0.1)
+
+    x, w = mk(m, k), mk(k, n)
+    b = mk(n) if bias else None
+
+    tracer = _tracer()
+    results = []
+    for c in cands:
+        bm, bn, bk = (c.params["block_m"], c.params["block_n"],
+                      c.params["block_k"])
+
+        def fwd(x, w, _bm=bm, _bn=bn, _bk=bk):
+            return matmul_bias_act(
+                x, w, b, activation=activation, interpret=interpret,
+                block_m=_bm, block_n=_bn, block_k=_bk)
+
+        if include_backward:
+            def run(x, w, _f=fwd):
+                def loss(x, w):
+                    return jnp.sum(_f(x, w) * 0.01)
+                return jax.value_and_grad(loss, argnums=(0, 1))(x, w)
+        else:
+            run = fwd
+        fn = jax.jit(run)
+        t0 = time.perf_counter()
+        try:
+            mres = measure_callable(fn, lambda: (x, w),
+                                    warmup=warmup, k=k_times)
+        except Exception as e:
+            _note_status(EXCLUDED)
+            results.append(CandidateResult(
+                c, EXCLUDED, error="%s: %s" % (type(e).__name__, e)))
+            continue
+        if tracer.enabled:
+            tracer.complete(
+                "tune.candidate", t0, time.perf_counter(), cat="tune",
+                args={"label": c.label,
+                      "measured_ms": round(mres["median_s"] * 1e3, 3)})
+        _note_status(TIMED)
+        results.append(CandidateResult(
+            c, TIMED, measured_s=mres["median_s"], times=mres["times"],
+            compile_s=mres["compile_s"], compiles=mres["compiles"]))
+
+    timed = [r for r in results if r.status == TIMED]
+    winner = min(timed, key=lambda r: r.measured_s) if timed else None
+    default_triple = (_pick_block(m), _pick_block(n), _pick_block(k))
+    default_cand = next(
+        (c for c in cands
+         if (c.params["block_m"], c.params["block_n"],
+             c.params["block_k"]) == default_triple), None)
+    default_s = (_default_measured(results, default_cand)
+                 if default_cand is not None else None)
+    cache_path = cache_stored = None
+    if cache is not None and winner is not None:
+        cache_path = cache.put(parts, _cache_winner_dict(winner),
+                               extra={"default_s": default_s})
+        cache_stored = True
+    return SearchReport(
+        "gemm_blocks", workload, parts, False, results, winner,
         default_s=default_s, searched_s=time.perf_counter() - t_start,
         cache_path=cache_path, cache_stored=bool(cache_stored))
 

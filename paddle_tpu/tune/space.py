@@ -27,6 +27,7 @@ __all__ = [
     "SearchSpace",
     "default_pass_pipelines",
     "flash_block_candidates",
+    "gemm_block_candidates",
     "ladder_candidates",
     "sharding_candidates",
 ]
@@ -35,10 +36,12 @@ __all__ = [
 FLASH_BLOCKS = (512, 256, 128)
 
 # passes that are safe to enumerate by default: program-level rewrites
-# registered in fluid.ir that need no per-pass configuration.  An
-# explicit SearchSpace(pipelines=...) can add anything, including Pass
-# INSTANCES with .set() attributes.
-_DEFAULT_TUNABLE_PASSES = ("batch_norm_act_fuse", "dead_op_elimination")
+# registered in fluid.ir that need no per-pass configuration, listed in
+# fuse-then-clean order (the all-passes pipeline runs them in this
+# order).  An explicit SearchSpace(pipelines=...) can add anything,
+# including Pass INSTANCES with .set() attributes.
+_DEFAULT_TUNABLE_PASSES = ("batch_norm_act_fuse", "matmul_bias_act_fuse",
+                           "transpose_fold", "dead_op_elimination")
 
 
 class Candidate:
@@ -130,6 +133,43 @@ def flash_block_candidates(sq, sk, grid=None):
     out.sort(key=lambda c: (
         (c.params["block_q"], c.params["block_k"]) != default,
         -c.params["block_q"], -c.params["block_k"]))
+    return out
+
+
+def gemm_block_candidates(m, k, n, grid=None):
+    """All (block_m, block_n, block_k) triples dividing the fused-GEMM
+    operand dims, in the [M, K] x [K, N] order `search_gemm_blocks`
+    and `matmul_bias_act` use — the pallas tile knob, same contract as
+    `flash_block_candidates` (heuristic default first so reports read
+    naturally)."""
+    from ..ops.pallas.matmul import _pick_block
+
+    blocks = tuple(grid) if grid else FLASH_BLOCKS
+    default = (_pick_block(m), _pick_block(n), _pick_block(k))
+    out = []
+    seen = set()
+    for bm in blocks:
+        if m % bm:
+            continue
+        for bn in blocks:
+            if n % bn:
+                continue
+            for bk in blocks:
+                if k % bk:
+                    continue
+                key = (bm, bn, bk)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(Candidate(
+                    "gemm_blocks",
+                    {"block_m": bm, "block_n": bn, "block_k": bk},
+                    label="bm%d.bn%d.bk%d" % key))
+    out.sort(key=lambda c: (
+        (c.params["block_m"], c.params["block_n"],
+         c.params["block_k"]) != default,
+        -c.params["block_m"], -c.params["block_n"],
+        -c.params["block_k"]))
     return out
 
 

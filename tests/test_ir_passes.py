@@ -72,3 +72,236 @@ def test_batch_norm_act_fuse_matches_unfused():
     assert "fused_batch_norm_act" in types and "relu" not in types
     got = run(m1, s1, o1)
     assert got == pytest.approx(ref, rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# MatmulBiasActFusePass: matmul/mul -> add -> act => matmul_bias_act
+# ---------------------------------------------------------------------------
+
+
+def _run_clone_parity(main, startup, fetch, feed, pipeline):
+    """Apply `pipeline` to a verified CLONE and run original + clone on
+    ONE scope (params initialized once, shared by name) — the parity
+    harness every pass test shares."""
+    clone = ir.clone_and_apply(main, pipeline, verify=True)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (ref,) = exe.run(main, feed=feed, fetch_list=[fetch])
+        (got,) = exe.run(clone, feed=feed, fetch_list=[fetch.name])
+    return clone, np.asarray(ref), np.asarray(got)
+
+
+@pytest.mark.parametrize("act", ["gelu", "tanh", "relu"])
+def test_matmul_bias_act_fuse_matches_unfused(act):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 6, 16], append_batch_size=False)
+        w = layers.create_parameter([16, 32], name="mbf.%s.w" % act)
+        b = layers.create_parameter([32], name="mbf.%s.b" % act)
+        h = layers.elementwise_add(
+            layers.mul(x, w, x_num_col_dims=2), b, axis=2)
+        out = getattr(layers, act)(h)
+    xv = np.random.RandomState(0).randn(4, 6, 16).astype(np.float32)
+    clone, ref, got = _run_clone_parity(
+        main, startup, out, {"x": xv}, ["matmul_bias_act_fuse"])
+    types = [op.type for op in clone.global_block.ops]
+    assert "matmul_bias_act" in types
+    assert "elementwise_add" not in types and act not in types
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_matmul_bias_act_fuse_matmul_variant_with_transpose():
+    # matmul-style source op: transpose_Y attr must survive the rewrite
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8, 16], append_batch_size=False)
+        w = layers.create_parameter([32, 16], name="mbm.w")
+        b = layers.create_parameter([32], name="mbm.b")
+        out = layers.gelu(layers.matmul(x, w, transpose_y=True) + b)
+    xv = np.random.RandomState(1).randn(8, 16).astype(np.float32)
+    clone, ref, got = _run_clone_parity(
+        main, startup, out, {"x": xv}, ["matmul_bias_act_fuse"])
+    fused = [op for op in clone.global_block.ops
+             if op.type == "matmul_bias_act"]
+    assert fused and fused[0].attrs.get(
+        "transpose_Y", fused[0].attrs.get("transpose_y"))
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_matmul_bias_act_fuse_through_reshape():
+    """The reshape-interposed chain the BERT FFN can emit: the epilogue
+    commutes with a last-dim-preserving reshape, so the act moves into
+    the matmul and the reshape slides after it."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 6, 16], append_batch_size=False)
+        w = layers.create_parameter([16, 32], name="mbr.w")
+        b = layers.create_parameter([32], name="mbr.b")
+        mm = layers.mul(x, w, x_num_col_dims=2)        # [4, 6, 32]
+        r = layers.reshape(mm, [24, 32])               # keeps last dim
+        out = layers.gelu(layers.elementwise_add(r, b, axis=1))
+    xv = np.random.RandomState(2).randn(4, 6, 16).astype(np.float32)
+    clone, ref, got = _run_clone_parity(
+        main, startup, out, {"x": xv}, ["matmul_bias_act_fuse"])
+    types = [op.type for op in clone.global_block.ops]
+    assert "matmul_bias_act" in types and "reshape2" in types
+    assert "elementwise_add" not in types and "gelu" not in types
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_matmul_bias_act_fuse_skips_reused_intermediate():
+    # bias-add output consumed twice: fusing would change/recompute it
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8, 16], append_batch_size=False)
+        w = layers.create_parameter([16, 32], name="mbs.w")
+        b = layers.create_parameter([32], name="mbs.b")
+        h = layers.elementwise_add(layers.mul(x, w), b, axis=1)
+        layers.gelu(h)
+        layers.reduce_sum(h)
+    clone = ir.clone_and_apply(main, ["matmul_bias_act_fuse"],
+                               verify=True)
+    assert "matmul_bias_act" not in [op.type
+                                     for op in clone.global_block.ops]
+
+
+def test_matmul_bias_act_fuse_skips_non_vector_bias():
+    # a full-tensor add is not a bias epilogue: left alone
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8, 16], append_batch_size=False)
+        y2 = layers.data("y2", shape=[8, 32], append_batch_size=False)
+        w = layers.create_parameter([16, 32], name="mbv.w")
+        layers.gelu(layers.elementwise_add(layers.mul(x, w), y2))
+    clone = ir.clone_and_apply(main, ["matmul_bias_act_fuse"],
+                               verify=True)
+    assert "matmul_bias_act" not in [op.type
+                                     for op in clone.global_block.ops]
+
+
+# ---------------------------------------------------------------------------
+# TransposeFoldPass
+# ---------------------------------------------------------------------------
+
+
+def test_transpose_fold_adjacent_inverse_pair():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[4, 8, 16], append_batch_size=False)
+        t2 = layers.transpose(layers.transpose(a, [0, 2, 1]), [0, 2, 1])
+        out = layers.reduce_sum(t2 * 2.0)
+    av = np.random.RandomState(3).randn(4, 8, 16).astype(np.float32)
+    clone, ref, got = _run_clone_parity(
+        main, startup, out, {"a": av}, ["transpose_fold"])
+    types = [op.type for op in clone.global_block.ops]
+    assert "transpose2" not in types          # pair cancelled
+    assert "assign" in types                  # downstream name kept
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_transpose_fold_keeps_non_inverse_pair():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[4, 8, 16], append_batch_size=False)
+        t = layers.transpose(layers.transpose(a, [1, 0, 2]), [0, 2, 1])
+        layers.reduce_sum(t)
+    clone = ir.clone_and_apply(main, ["transpose_fold"], verify=True)
+    assert [op.type for op in clone.global_block.ops].count(
+        "transpose2") == 2
+
+
+def test_transpose_fold_flash_attention_layout():
+    """transpose([0,2,1,3]) x3 -> flash_attention(BHSD) ->
+    transpose([0,2,1,3]) folds to ONE flash_attention(BSHD) op — the
+    model never materializes [B,S,H,D]<->[B,H,S,D]."""
+    from paddle_tpu.fluid.layers.common import append_simple_op
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = layers.data("q", shape=[2, 256, 2, 64], append_batch_size=False)
+        k = layers.data("k", shape=[2, 256, 2, 64], append_batch_size=False)
+        v = layers.data("v", shape=[2, 256, 2, 64], append_batch_size=False)
+        ctx = append_simple_op(
+            "flash_attention",
+            {"Q": layers.transpose(q, [0, 2, 1, 3]),
+             "K": layers.transpose(k, [0, 2, 1, 3]),
+             "V": layers.transpose(v, [0, 2, 1, 3])},
+            {"scale": 64 ** -0.5, "causal": False, "layout": "BHSD"})
+        out = layers.reduce_sum(layers.transpose(ctx, [0, 2, 1, 3]))
+    rng = np.random.RandomState(4)
+    feed = {n: rng.randn(2, 256, 2, 64).astype(np.float32) * 0.1
+            for n in "qkv"}
+    clone, ref, got = _run_clone_parity(
+        main, startup, out, feed, ["transpose_fold"])
+    types = [op.type for op in clone.global_block.ops]
+    assert "transpose2" not in types
+    flash = [op for op in clone.global_block.ops
+             if op.type == "flash_attention"][0]
+    assert flash.attrs["layout"] == "BSHD"
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_transpose_fold_into_matmul_flag():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[8, 16], append_batch_size=False)
+        c = layers.data("c", shape=[8, 32], append_batch_size=False)
+        out = layers.reduce_sum(
+            layers.matmul(layers.transpose(a, [1, 0]), c))
+    feed = {"a": np.random.RandomState(5).randn(8, 16).astype(np.float32),
+            "c": np.random.RandomState(6).randn(8, 32).astype(np.float32)}
+    clone, ref, got = _run_clone_parity(
+        main, startup, out, feed, ["transpose_fold"])
+    types = [op.type for op in clone.global_block.ops]
+    assert "transpose2" not in types
+    mm = [op for op in clone.global_block.ops if op.type == "matmul"][0]
+    assert mm.attrs.get("transpose_X") is True
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_transpose_fold_keeps_fetched_intermediate_produced():
+    """The cancelled pair's OUTPUT name may be a fetch target: the
+    assign rewrite must keep it produced (missing-fetch stays green)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[4, 8], append_batch_size=False)
+        t2 = layers.transpose(layers.transpose(a, [1, 0]), [1, 0])
+        layers.reduce_sum(t2)
+    clone = ir.clone_and_apply(main, ["transpose_fold"], verify=True)
+    exe = fluid.Executor()
+    av = np.random.RandomState(7).randn(4, 8).astype(np.float32)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        (got,) = exe.run(clone, feed={"a": av}, fetch_list=[t2.name])
+    np.testing.assert_allclose(got, av, rtol=0, atol=0)
+
+
+def test_transpose_fold_flash_layout_shared_kv_transpose():
+    """K and V fed from ONE transposed tensor (shared-KV attention):
+    every read of the shared transpose's output is a Q/K/V slot of the
+    same flash op, so the fold still fires."""
+    from paddle_tpu.fluid.layers.common import append_simple_op
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = layers.data("q", shape=[2, 256, 2, 64], append_batch_size=False)
+        kv = layers.data("kv", shape=[2, 256, 2, 64],
+                         append_batch_size=False)
+        kvt = layers.transpose(kv, [0, 2, 1, 3])
+        ctx = append_simple_op(
+            "flash_attention",
+            {"Q": layers.transpose(q, [0, 2, 1, 3]), "K": kvt, "V": kvt},
+            {"scale": 64 ** -0.5, "causal": False, "layout": "BHSD"})
+        out = layers.reduce_sum(layers.transpose(ctx, [0, 2, 1, 3]))
+    rng = np.random.RandomState(11)
+    feed = {n: rng.randn(2, 256, 2, 64).astype(np.float32) * 0.1
+            for n in ("q", "kv")}
+    clone, ref, got = _run_clone_parity(
+        main, startup, out, feed, ["transpose_fold"])
+    types = [op.type for op in clone.global_block.ops]
+    assert "transpose2" not in types
+    flash = [op for op in clone.global_block.ops
+             if op.type == "flash_attention"][0]
+    assert flash.attrs["layout"] == "BSHD"
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)

@@ -673,3 +673,116 @@ def test_program_lint_cli_max_pad_waste(tmp_path, capsys):
     assert pl.main([path, "--feed", "seq", "--fetch", out.name,
                     "--max-pad-waste", "0.6"]) == 0
     capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# PR 11: rule<->pass linkage (fix hints), reshape/cast see-through, and
+# the fused-GEMM cost estimator
+# ---------------------------------------------------------------------------
+
+
+def test_unfused_epilogue_sees_through_reshape_and_carries_fix():
+    """The BERT FFN can emit a reshape between matmul and add — pure
+    data movement must not hide the fusion candidate, and the finding
+    names the pass that fixes it."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[8, 16, 32], append_batch_size=False)
+        w = main.global_block.create_parameter("rsh.w", shape=[32, 64])
+        b = main.global_block.create_parameter("rsh.b", shape=[64])
+        mm = layers.mul(a, w, x_num_col_dims=2)
+        r = layers.reshape(mm, [128, 64])
+        layers.gelu(layers.elementwise_add(r, b, axis=1))
+    hits = _lint(main, ["unfused-epilogue"]).by_code("unfused-epilogue")
+    assert hits, "reshape hid the epilogue chain"
+    assert hits[0].fix == "matmul_bias_act_fuse"
+    assert "interposed" in hits[0].message
+
+
+def test_unfused_epilogue_sees_through_cast():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[8, 32], append_batch_size=False)
+        w = main.global_block.create_parameter("cst.w", shape=[32, 64])
+        b = main.global_block.create_parameter("cst.b", shape=[64],
+                                               dtype="float32")
+        mm = layers.matmul(a, w)
+        c = layers.cast(mm, "float32")
+        layers.relu(layers.elementwise_add(c, b, axis=1))
+    hits = _lint(main, ["unfused-epilogue"]).by_code("unfused-epilogue")
+    # flagged — but the fuse pass declines cast hops (a cast changes
+    # numerics inside the chain), so no fix hint is attached
+    assert hits and hits[0].fix is None
+
+
+def test_unfused_epilogue_reshape_with_fanout_stays_quiet():
+    # the interposed reshape's output is consumed twice: not privately
+    # fusable, no finding
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[8, 16, 32], append_batch_size=False)
+        w = main.global_block.create_parameter("rsf.w", shape=[32, 64])
+        b = main.global_block.create_parameter("rsf.b", shape=[64])
+        r = layers.reshape(layers.mul(a, w, x_num_col_dims=2), [128, 64])
+        layers.gelu(layers.elementwise_add(r, b, axis=1))
+        layers.reduce_sum(r)
+    assert not _lint(main, ["unfused-epilogue"])
+
+
+def test_layout_transpose_hazard_carries_fix():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("hx", shape=[2, 8, 16], append_batch_size=False)
+        w = main.global_block.create_parameter("hz.w", shape=[16, 16])
+        t1 = layers.transpose(x, [0, 2, 1])
+        t1b = layers.transpose(t1, [0, 2, 1])
+        layers.transpose(layers.matmul(t1b, w), [0, 2, 1])
+    hits = _lint(main, ["layout-transpose-hazard"]).by_code(
+        "layout-transpose-hazard")
+    assert hits and hits[0].fix == "transpose_fold"
+    assert hits[0].to_dict()["fix"] == "transpose_fold"
+
+
+def test_matmul_bias_act_cost_is_one_pass_of_epilogue_bytes():
+    """The fused op bills matmul FLOPs + one epilogue pass — NOT the
+    unfused three-op [M,N] traffic — so the static ranker prefers the
+    fusion (the estimator registered like batch_norm_act_fuse's)."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[64, 128], append_batch_size=False)
+            w = main.global_block.create_parameter("fcost.w",
+                                                   shape=[128, 256])
+            b = main.global_block.create_parameter("fcost.b", shape=[256])
+            layers.gelu(layers.elementwise_add(
+                layers.mul(x, w), b, axis=1))
+        return main
+
+    main = build()
+    from paddle_tpu.fluid import ir
+
+    fused = ir.clone_and_apply(main, ["matmul_bias_act_fuse"],
+                               verify=True)
+    rep_unfused = perf.program_cost(main, chip=CHIP)
+    rep_fused = perf.program_cost(fused, chip=CHIP)
+    # matmul FLOPs identical; epilogue flops preserved within the op
+    assert rep_fused.total_flops == pytest.approx(
+        rep_unfused.total_flops, rel=1e-6)
+    # but the [M,N] intermediate no longer round-trips: strictly fewer
+    # bytes moved, strictly less estimated time
+    assert rep_fused.total_bytes < rep_unfused.total_bytes
+    assert rep_fused.total_time_s < rep_unfused.total_time_s
+
+
+def test_rank_pass_pipelines_prefers_matmul_bias_act_fuse():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[64, 128], append_batch_size=False)
+        w = main.global_block.create_parameter("frank.w",
+                                               shape=[128, 256])
+        b = main.global_block.create_parameter("frank.b", shape=[256])
+        layers.gelu(layers.elementwise_add(layers.mul(x, w), b, axis=1))
+    ranked = perf.rank_pass_pipelines(
+        main, [[], ["matmul_bias_act_fuse"]], chip=CHIP)
+    assert ranked[0].pipeline == ("matmul_bias_act_fuse",)
+    assert ranked[0].time_s < ranked[1].time_s

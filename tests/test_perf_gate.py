@@ -111,3 +111,63 @@ def test_gate_actually_binds(name, tmp_path, capsys):
                   "--peak-flops", PEAK_FLOPS, "--hbm-bw", HBM_BW])
     capsys.readouterr()
     assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# lint-cleanliness gate: the perf hazards the PR-11 passes eliminate
+# must STAY eliminated — re-introducing an unfused FFN epilogue or a
+# head-transpose pair fails tier-1
+# ---------------------------------------------------------------------------
+
+
+def _perf_findings(program, codes):
+    from paddle_tpu import analysis
+
+    diags = analysis.lint_program(program, categories=("perf",))
+    return [d for d in diags if d.code in codes]
+
+
+def test_zoo_bert_lints_clean_after_fusion_passes():
+    """Zoo BERT carries fusable FFN epilogues (the gate binds), and
+    after MatmulBiasActFusePass + TransposeFoldPass — verified after
+    each pass — it emits ZERO unfused-epilogue / layout-transpose-
+    hazard findings."""
+    from paddle_tpu.fluid import ir
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        _GATE["bert_small"][0]()
+    codes = ("unfused-epilogue", "layout-transpose-hazard")
+    before = _perf_findings(main, codes)
+    assert any(d.code == "unfused-epilogue" for d in before), (
+        "gate is vacuous: the unfused BERT FFN no longer emits the "
+        "epilogue chain the fusion pass exists for")
+    for d in before:
+        assert d.fix in ("matmul_bias_act_fuse", "transpose_fold")
+    fused = ir.clone_and_apply(
+        main, ["matmul_bias_act_fuse", "transpose_fold"], verify=True)
+    after = _perf_findings(fused, codes)
+    assert not after, (
+        "zoo BERT still lints dirty after the fusion passes:\n"
+        + "\n".join(d.format() for d in after))
+
+
+def test_zoo_bert_bhsd_layout_folds_clean(monkeypatch):
+    """The head-major (BHSD) BERT build materializes the exact
+    [B,S,H,D]<->[B,H,S,D] transpose pairs the hazard rule flags;
+    TransposeFoldPass must cancel every one (flash layout attr flip)
+    and survive verification."""
+    from paddle_tpu.fluid import ir
+
+    monkeypatch.setenv("PADDLE_TPU_BERT_HEAD_LAYOUT", "BHSD")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        _GATE["bert_small"][0]()
+    hazards = _perf_findings(main, ("layout-transpose-hazard",))
+    assert hazards, "BHSD build emitted no transpose hazard: gate vacuous"
+    folded = ir.clone_and_apply(
+        main, ["transpose_fold", "matmul_bias_act_fuse"], verify=True)
+    assert not _perf_findings(
+        folded, ("layout-transpose-hazard", "unfused-epilogue"))
+    types = [op.type for op in folded.global_block.ops]
+    assert "transpose2" not in types

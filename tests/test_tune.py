@@ -926,3 +926,73 @@ def test_ladder_cache_hits_on_proportional_traffic(tmp_path):
     # a genuinely shifted mix re-opens the search
     assert not tune.search_bucket_ladder(
         runner, example, [1, 2, 2], **kw).cache_hit
+
+
+# ---------------------------------------------------------------------------
+# PR 11: fused-GEMM block search + the new passes in the default space
+# ---------------------------------------------------------------------------
+
+
+def test_default_pipelines_include_fusion_passes():
+    pipes = tune.default_pass_pipelines()
+    assert ["matmul_bias_act_fuse"] in pipes
+    assert ["transpose_fold"] in pipes
+    # the all-passes pipeline keeps fuse-then-clean order
+    full = max(pipes, key=len)
+    assert full.index("matmul_bias_act_fuse") < full.index(
+        "dead_op_elimination")
+    assert full.index("transpose_fold") < full.index(
+        "dead_op_elimination")
+
+
+def test_gemm_block_candidates_divisors_default_first():
+    cands = tune.gemm_block_candidates(512, 512, 512)
+    triples = [(c.params["block_m"], c.params["block_n"],
+                c.params["block_k"]) for c in cands]
+    assert triples[0] == (512, 512, 512)    # heuristic default leads
+    assert set(triples) == {(a, b, c) for a in (512, 256, 128)
+                            for b in (512, 256, 128)
+                            for c in (512, 256, 128)}
+    # a non-512-divisible dim restricts its axis of the grid — args are
+    # (m, k, n), the same order as search_gemm_blocks/matmul_bias_act
+    assert all(c.params["block_k"] != 512
+               for c in tune.gemm_block_candidates(512, 256, 512))
+    assert all(c.params["block_n"] != 512
+               for c in tune.gemm_block_candidates(512, 512, 256))
+
+
+def test_search_gemm_blocks_winner_and_cache(tmp_path):
+    kw = dict(activation="gelu", grid=(256, 128), interpret=True,
+              k_times=1, warmup=1, cache_dir=str(tmp_path))
+    rep = tune.search_gemm_blocks(256, 256, 256, **kw)
+    assert not rep.cache_hit
+    timed = [r for r in rep.results if r.status == "timed"]
+    assert timed and rep.winner is not None
+    assert set(rep.winner.params) == {"block_m", "block_n", "block_k"}
+    # same shape+grid hits the cache; a different activation re-opens it
+    rep2 = tune.search_gemm_blocks(256, 256, 256, **kw)
+    assert rep2.cache_hit
+    assert rep2.winner.params == rep.winner.params
+    kw3 = dict(kw)
+    kw3["activation"] = "relu"
+    assert not tune.search_gemm_blocks(256, 256, 256, **kw3).cache_hit
+
+
+def test_search_gemm_blocks_winner_params_drive_the_kernel(tmp_path):
+    """The winner's params slot straight into matmul_bias_act — and an
+    invalid triple for the shape would raise, so a winner that runs IS
+    the grid that was timed."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.matmul import matmul_bias_act
+
+    rep = tune.search_gemm_blocks(
+        256, 256, 256, activation="relu", grid=(128,), interpret=True,
+        k_times=1, warmup=1, cache_dir=str(tmp_path))
+    p = rep.winner.params
+    x = jnp.zeros((256, 256), jnp.float32)
+    w = jnp.zeros((256, 256), jnp.float32)
+    out = matmul_bias_act(x, w, activation="relu", interpret=True,
+                          block_m=p["block_m"], block_n=p["block_n"],
+                          block_k=p["block_k"])
+    assert out.shape == (256, 256)
