@@ -171,6 +171,26 @@ def _metrics_snapshot():
 
 
 def main():
+    multichip = "--multichip" in sys.argv
+    if multichip:
+        n = 8
+        idx = sys.argv.index("--multichip")
+        if idx + 1 < len(sys.argv) and sys.argv[idx + 1].isdigit():
+            n = int(sys.argv[idx + 1])
+        # when real accelerator hardware is plausibly present — an
+        # explicit non-cpu JAX_PLATFORMS (the axon site), a libtpu
+        # install, or /dev/accel* device nodes — leave the platform
+        # alone: that IS the reserved on-hardware capture.  Otherwise
+        # simulate n chips on the CPU backend; the env must be set
+        # BEFORE any jax import initializes a platform (same
+        # discipline as __graft_entry__.dryrun_multichip)
+        if (os.environ.get("JAX_PLATFORMS", "cpu") in ("", "cpu")
+                and not _accelerator_plausible()):
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            flag = "--xla_force_host_platform_device_count"
+            if flag not in os.environ.get("XLA_FLAGS", ""):
+                os.environ["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "") + " %s=%d" % (flag, n))
     try:
         if os.getenv("BENCH_FORCE_BACKEND_FAIL") == "init":
             raise RuntimeError(
@@ -184,6 +204,8 @@ def main():
         return _skip("backend init failed: %s: %s"
                      % (type(e).__name__, str(e)[:300]))
     try:
+        if multichip:
+            return _run_multichip(n)
         return _run(on_tpu)
     except Exception as e:
         # BENCH_r05 regression: init succeeded but the tunnel died at
@@ -366,6 +388,112 @@ def _run(on_tpu):
     if resnet is not None:
         out["extra"] = resnet
     out["metrics_snapshot"] = _metrics_snapshot()
+    print(json.dumps(out))
+    return 0
+
+
+def _accelerator_plausible():
+    """Cheap pre-jax-import probe for real TPU hardware: /dev/accel*
+    (or vfio-bound) device NODES — an installed libtpu wheel is not a
+    signal, the toolchain image bakes it in on TPU-less boxes.
+    Deciding for sure needs jax, which would lock the platform before
+    --multichip can pin the CPU simulator, so device nodes are the
+    best available heuristic."""
+    import glob as _glob
+
+    return bool(_glob.glob("/dev/accel*") or _glob.glob("/dev/vfio/*"))
+
+
+def _run_multichip(n):
+    """--multichip N: time the n-device dryrun train step per ZeRO
+    stage and report the per-collective op counts + bytes extracted
+    from the COMPILED HLO — so the multichip capture carries real
+    collective traffic, not just an rc.  One JSON line, same
+    skip/platform/smoke_config conventions as the headline bench."""
+    import jax
+
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed import _zero_harness as zh
+
+    on_cpu = jax.default_backend() == "cpu"
+    devices = jax.devices("cpu") if on_cpu else jax.devices()
+    if len(devices) < n:
+        return _skip("multichip wants %d %s devices, have %d%s"
+                     % (n, jax.default_backend(), len(devices),
+                        " (stale XLA_FLAGS in this process)"
+                        if on_cpu else ""))
+    devices = devices[:n]
+    mesh = dist.auto_mesh(n, devices=devices)
+
+    # same workload/contract as the dryrun's ZeRO parity section (one
+    # shared harness — the bench measures what the dryrun validates);
+    # local batch 4 so accumulate_steps=4 divides
+    B, S = 4 * n, 32
+    batches = zh.bert_batches(zh.tiny_bert_config(), B, S, 2, seed=0)
+
+    def build_and_time(params, want_stats=False):
+        def body(step, state):
+            loss = None
+            for i in range(2):
+                state, loss = step(state, batches[i % 2])
+            float(loss)
+            placed = [step.place_batch(b) for b in batches]
+            dt, _w, state2 = _marginal_step_time(
+                step, state, placed, 1, 3, 1)
+            stats = (step.collective_stats(state2, batches[0])
+                     if want_stats else None)
+            est = step.comm_estimate() if want_stats else None
+            return dt, stats, est
+
+        return zh.run_deterministic(mesh, body, lr=1e-4, **params)
+
+    stages = {}
+    for label, params in (
+            ("zero1", {"zero_stage": 1}),
+            ("zero2", {"zero_stage": 2}),
+            ("zero3", {"zero_stage": 3}),
+            ("zero2_acc4", {"zero_stage": 2, "accumulate_steps": 4})):
+        dt, stats, est = build_and_time(params, want_stats=True)
+        entry = {"step_ms": round(dt * 1e3, 3)}
+        if stats:
+            entry["collectives"] = {
+                k: {kk: (round(vv, 1) if isinstance(vv, float) else vv)
+                    for kk, vv in v.items()}
+                for k, v in stats.items() if isinstance(v, dict)}
+            entry["hlo_wire_bytes"] = round(stats.get(
+                "wire_bytes_total", 0.0), 1)
+        if est:
+            entry["est_wire_bytes"] = round(est["wire_bytes_total"], 1)
+        stages[label] = entry
+
+    autotune = None
+    if "--autotune" in sys.argv:
+        from paddle_tpu import tune
+
+        report = tune.search_train_step(
+            lambda p: build_and_time(p)[0], mesh=mesh,
+            workload="bench.multichip:n%d.B%d.S%d" % (n, B, S))
+        print("multichip autotune:\n%s" % report.format(),
+              file=sys.stderr)
+        w = report.winner
+        autotune = {
+            "cache_hit": report.cache_hit,
+            "winner": w.to_dict() if w else None,
+            "default_s": report.default_s,
+            "counts": report.counts(),
+        }
+
+    out = {
+        "metric": "multichip_dryrun_bert_step_ms",
+        "value": stages["zero2"]["step_ms"],
+        "unit": "ms",
+        "n_devices": n,
+        "platform": jax.default_backend(),
+        "smoke_config": jax.default_backend() != "tpu",
+        "stages": stages,
+    }
+    if autotune is not None:
+        out["autotune"] = autotune
     print(json.dumps(out))
     return 0
 

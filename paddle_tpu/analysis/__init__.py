@@ -65,6 +65,7 @@ from .perf import (  # noqa: F401
     validate_cost_model,
     xla_cost_of_program,
 )
+from . import comm  # noqa: F401  (collective cost model + HLO extraction)
 from . import perf_rules  # noqa: F401  (registers the perf lint rules)
 
 

@@ -54,41 +54,47 @@ MXU_LANE = 128
 
 
 class ChipSpec:
-    """Roofline parameters for one chip: peak FLOP/s + HBM bytes/s.
+    """Roofline parameters for one chip: peak FLOP/s + HBM bytes/s +
+    ICI bytes/s (the collective-traffic axis, `analysis.comm`).
 
     Defaults resolve through `observability.xla_cost` (env overrides >
     live-platform table) and fall back to the v5e constants of record so
     static analysis works on machines with no accelerator attached."""
 
-    def __init__(self, name, peak_flops, hbm_bw):
+    def __init__(self, name, peak_flops, hbm_bw, ici_bw=None):
         self.name = name
         self.peak_flops = float(peak_flops)
         self.hbm_bw = float(hbm_bw)
+        self.ici_bw = float(ici_bw) if ici_bw else None
 
     @classmethod
-    def detect(cls, peak_flops=None, hbm_bw=None, platform=None):
+    def detect(cls, peak_flops=None, hbm_bw=None, platform=None,
+               ici_bw=None):
         from ..observability import xla_cost
 
         peak = xla_cost.peak_flops(explicit=peak_flops, platform=platform)
         bw = xla_cost.hbm_bandwidth(explicit=hbm_bw, platform=platform)
+        ici = xla_cost.ici_bandwidth(explicit=ici_bw, platform=platform)
         if peak and bw:
-            return cls(platform or "detected", peak, bw)
+            return cls(platform or "detected", peak, bw,
+                       ici or V5E.ici_bw)
         return cls(
             V5E.name if (peak is None and bw is None) else "partial",
-            peak or V5E.peak_flops, bw or V5E.hbm_bw)
+            peak or V5E.peak_flops, bw or V5E.hbm_bw, ici or V5E.ici_bw)
 
     def to_dict(self):
         return {"name": self.name, "peak_flops": self.peak_flops,
-                "hbm_bw": self.hbm_bw}
+                "hbm_bw": self.hbm_bw, "ici_bw": self.ici_bw}
 
     def __repr__(self):
-        return "ChipSpec(%s, %.0f GFLOP/s, %.0f GB/s)" % (
-            self.name, self.peak_flops / 1e9, self.hbm_bw / 1e9)
+        return "ChipSpec(%s, %.0f GFLOP/s, %.0f GB/s, ICI %s)" % (
+            self.name, self.peak_flops / 1e9, self.hbm_bw / 1e9,
+            "%.0f GB/s" % (self.ici_bw / 1e9) if self.ici_bw else "n/a")
 
 
 # one v5e chip: 197 bf16 TFLOP/s (the constant bench.py always used),
-# 819 GB/s HBM (public spec)
-V5E = ChipSpec("tpu-v5e", 197e12, 819e9)
+# 819 GB/s HBM, 45 GB/s one-way ICI per link (public specs)
+V5E = ChipSpec("tpu-v5e", 197e12, 819e9, 4.5e10)
 
 
 # ---------------------------------------------------------------------------
@@ -128,24 +134,35 @@ _TRANSCENDENTAL_OPS = {
 
 
 class OpCost:
-    """One op's estimated cost (flops/bytes/time) + location."""
+    """One op's estimated cost (flops/bytes/comm/time) + location.
+
+    ``comm_bytes`` is per-chip WIRE traffic of a collective op (ring
+    factors, `analysis.comm`); the roofline becomes the three-way
+    max(flops/peak, hbm/bw, wire/ici) and a collective-dominated op is
+    labeled ``bound="comm"``."""
 
     __slots__ = ("block_idx", "op_idx", "op_type", "flops",
-                 "transcendentals", "bytes", "time_s", "bound",
-                 "provenance")
+                 "transcendentals", "bytes", "comm_bytes", "time_s",
+                 "bound", "provenance")
 
     def __init__(self, block_idx, op_idx, op_type, flops, transcendentals,
-                 nbytes, chip, provenance=()):
+                 nbytes, chip, provenance=(), comm_bytes=0.0):
         self.block_idx = block_idx
         self.op_idx = op_idx
         self.op_type = op_type
         self.flops = float(flops)
         self.transcendentals = float(transcendentals)
         self.bytes = float(nbytes)
+        self.comm_bytes = float(comm_bytes or 0.0)
         t_compute = self.flops / chip.peak_flops
         t_memory = self.bytes / chip.hbm_bw
-        self.time_s = max(t_compute, t_memory)
-        self.bound = "compute" if t_compute >= t_memory else "memory"
+        t_comm = (self.comm_bytes / chip.ici_bw
+                  if self.comm_bytes and chip.ici_bw else 0.0)
+        self.time_s = max(t_compute, t_memory, t_comm)
+        if t_comm and t_comm >= t_compute and t_comm >= t_memory:
+            self.bound = "comm"
+        else:
+            self.bound = "compute" if t_compute >= t_memory else "memory"
         self.provenance = list(provenance or ())
 
     def to_dict(self):
@@ -153,6 +170,7 @@ class OpCost:
             "block_idx": self.block_idx, "op_idx": self.op_idx,
             "op_type": self.op_type, "flops": self.flops,
             "transcendentals": self.transcendentals, "bytes": self.bytes,
+            "comm_bytes": self.comm_bytes,
             "time_s": self.time_s, "bound": self.bound,
             "provenance": list(self.provenance),
         }
@@ -521,6 +539,43 @@ def _default_cost(op_type, ins, outs, attrs):
     return {"flops": float(n)}
 
 
+# explicit collective ops (the c_* transpiler surface) -> comm kind.
+# Priced per chip with the ring factors from `analysis.comm`; the group
+# size comes from the op's ``nranks`` attr, falling back to the
+# ``mesh_size`` a caller (tools/program_cost --mesh) provides.
+_COLLECTIVE_OP_KINDS = {
+    "c_allreduce_sum": "all-reduce",
+    "c_allreduce_max": "all-reduce",
+    "c_allreduce_min": "all-reduce",
+    "c_allreduce_prod": "all-reduce",
+    "c_broadcast": "broadcast",
+    "c_allgather": "all-gather",
+    "c_reducescatter": "reduce-scatter",
+}
+
+
+def _collective_comm_bytes(op_type, ins, outs, attrs, mesh_size):
+    """Per-chip wire bytes of one c_* op (0 when the group is 1)."""
+    from . import comm as comm_mod
+
+    kind = _COLLECTIVE_OP_KINDS[op_type]
+    n = int(attrs.get("nranks") or mesh_size or 1)
+    if n <= 1:
+        return 0.0
+    # the billed buffer: input for reduce-style ops; OUTPUT for
+    # all-gather (Out = nranks x X, the full payload) and for
+    # reduce-scatter (Out is the shard, scaled by the "shard" factor)
+    if kind == "all-gather":
+        src, payload = outs, "full"
+    elif kind == "reduce-scatter":
+        src, payload = outs, "shard"
+    else:
+        src, payload = ins, "full"
+    nbytes = sum(_elems(shape) * _itemsize(dtype)
+                 for vals in src.values() for shape, dtype in vals)
+    return comm_mod.collective_wire_bytes(kind, nbytes, n, payload=payload)
+
+
 # ---------------------------------------------------------------------------
 # program walk
 # ---------------------------------------------------------------------------
@@ -554,8 +609,10 @@ def _resolve_shapes(program, bidx, op, dynamic_dim):
 
 
 def estimate_op_cost(program, bidx, oidx, op, chip,
-                     dynamic_dim=DEFAULT_DYNAMIC_DIM):
-    """OpCost for one op (real Operator or serialized sub-op dict)."""
+                     dynamic_dim=DEFAULT_DYNAMIC_DIM, mesh_size=None):
+    """OpCost for one op (real Operator or serialized sub-op dict).
+    ``mesh_size`` is the collective group size used for c_* ops that
+    carry no ``nranks`` attr (tools/program_cost --mesh)."""
     ins, outs, _missing = _resolve_shapes(program, bidx, op, dynamic_dim)
     op_type = opgraph.op_type(op)
     attrs = opgraph.op_attrs(op)
@@ -583,9 +640,14 @@ def estimate_op_cost(program, bidx, oidx, op, chip,
             for vals in slots.values():
                 for shape, dtype in vals:
                     nbytes += _elems(shape) * _itemsize(dtype)
+    comm_bytes = c.get("comm_bytes", 0.0)
+    if op_type in _COLLECTIVE_OP_KINDS:
+        comm_bytes = _collective_comm_bytes(
+            op_type, ins, outs, attrs, mesh_size)
     return OpCost(bidx, oidx, op_type, c.get("flops", 0.0),
                   c.get("transcendentals", 0.0), nbytes, chip,
-                  provenance=opgraph.op_provenance(op))
+                  provenance=opgraph.op_provenance(op),
+                  comm_bytes=comm_bytes)
 
 
 class CostReport:
@@ -612,6 +674,11 @@ class CostReport:
         return sum(e.bytes for e in self.entries)
 
     @property
+    def total_comm_bytes(self):
+        """Per-chip collective wire bytes (ring factors applied)."""
+        return sum(e.comm_bytes for e in self.entries)
+
+    @property
     def total_time_s(self):
         return sum(e.time_s for e in self.entries)
 
@@ -625,15 +692,17 @@ class CostReport:
 
     # -- groupings -----------------------------------------------------
     def by_op_type(self):
-        """[{op_type, count, flops, bytes, time_s}] sorted by time desc."""
+        """[{op_type, count, flops, bytes, comm_bytes, time_s}] sorted
+        by time desc."""
         groups = {}
         for e in self.entries:
             g = groups.setdefault(e.op_type, dict(
                 op_type=e.op_type, count=0, flops=0.0, bytes=0.0,
-                time_s=0.0))
+                comm_bytes=0.0, time_s=0.0))
             g["count"] += 1
             g["flops"] += e.flops
             g["bytes"] += e.bytes
+            g["comm_bytes"] += e.comm_bytes
             g["time_s"] += e.time_s
         return sorted(groups.values(), key=lambda g: -g["time_s"])
 
@@ -666,6 +735,7 @@ class CostReport:
                 "flops": self.total_flops,
                 "transcendentals": self.total_transcendentals,
                 "bytes": self.total_bytes,
+                "comm_bytes": self.total_comm_bytes,
                 "time_s": self.total_time_s,
                 "arithmetic_intensity": self.arithmetic_intensity,
                 "op_count": len(self.entries),
@@ -677,25 +747,30 @@ class CostReport:
         return d
 
     def format(self, top=10):
+        comm = self.total_comm_bytes
         lines = [
-            "program cost on %r: %.2f GFLOP, %.1f MB moved, "
+            "program cost on %r: %.2f GFLOP, %.1f MB moved%s, "
             "est %.3f ms (%s-leaning, intensity %.1f FLOP/B)" % (
                 self.chip.name, self.total_flops / 1e9,
-                self.total_bytes / 1e6, self.total_time_s * 1e3,
+                self.total_bytes / 1e6,
+                ", %.2f MB collective wire" % (comm / 1e6) if comm else "",
+                self.total_time_s * 1e3,
                 "compute" if self.arithmetic_intensity
                 >= self.chip.peak_flops / self.chip.hbm_bw else "memory",
                 self.arithmetic_intensity),
         ]
         for g in self.by_op_type()[:top]:
             lines.append(
-                "  %-28s x%-4d %10.2f MFLOP %10.2f MB %8.1f us" % (
+                "  %-28s x%-4d %10.2f MFLOP %10.2f MB %8.1f us%s" % (
                     g["op_type"], g["count"], g["flops"] / 1e6,
-                    g["bytes"] / 1e6, g["time_s"] * 1e6))
+                    g["bytes"] / 1e6, g["time_s"] * 1e6,
+                    "  %.2f MB wire" % (g["comm_bytes"] / 1e6)
+                    if g.get("comm_bytes") else ""))
         return "\n".join(lines)
 
 
 def program_cost(program, chip=None, dynamic_dim=DEFAULT_DYNAMIC_DIM,
-                 include_sub_ops=True):
+                 include_sub_ops=True, mesh_size=None):
     """Static CostReport over every real op in every block — so a cond
     bills BOTH branches (the static model cannot know which is taken)
     and a while bills ONE iteration of its body.  Containers (cond /
@@ -705,18 +780,24 @@ def program_cost(program, chip=None, dynamic_dim=DEFAULT_DYNAMIC_DIM,
     the container also anchors real sub-blocks (``sub_block*`` attrs —
     the dicts mirror ops already walked above); with `include_sub_ops`
     (default) attr-only sub-ops — recompute segments, whose ops exist
-    NOWHERE else — are billed from the parent block's var metadata."""
+    NOWHERE else — are billed from the parent block's var metadata.
+
+    ``mesh_size`` prices explicit c_* collective ops that carry no
+    ``nranks`` attr (their wire bytes ride the ring factors against
+    ``chip.ici_bw``); without it such ops cost no comm."""
     chip = chip or ChipSpec.detect()
     entries = []
     for bidx, oidx, op in opgraph.iter_all_ops(program):
         entries.append(
-            estimate_op_cost(program, bidx, oidx, op, chip, dynamic_dim))
+            estimate_op_cost(program, bidx, oidx, op, chip, dynamic_dim,
+                             mesh_size=mesh_size))
         if include_sub_ops and not any(
                 k.startswith("sub_block")
                 for k in opgraph.op_attrs(op)):
             for sop in opgraph.iter_sub_ops(op):
                 entries.append(estimate_op_cost(
-                    program, bidx, oidx, sop, chip, dynamic_dim))
+                    program, bidx, oidx, sop, chip, dynamic_dim,
+                    mesh_size=mesh_size))
     return CostReport(entries, chip, dynamic_dim)
 
 

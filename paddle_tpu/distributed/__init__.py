@@ -18,7 +18,10 @@ package provides instead of a transport layer:
     parameter server capability, SURVEY §2.3).
   * train_step.py — builds ONE jitted SPMD training step from a dygraph
     Layer: dp/tp/sp sharded forward+backward+update with XLA-inserted
-    collectives (replaces ParallelExecutor + transpilers).
+    collectives (replaces ParallelExecutor + transpilers);
+    ``zero_stage=2|3`` switches the dp axis to explicit communication —
+    bucketed reduce-scatter gradient sync, sharded optimizer update,
+    overlap-ready chunked all-gathers (zero.py holds the layout math).
   * launch.py — `python -m paddle_tpu.distributed.launch` process-per-host
     launcher with the reference env contract (launch.py:193).
 """
@@ -46,6 +49,7 @@ from .topology import (  # noqa: F401
     get_mesh,
     mesh_guard,
 )
+from . import zero  # noqa: F401
 from .train_step import ShardedTrainStep  # noqa: F401
 
 

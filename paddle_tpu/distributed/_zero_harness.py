@@ -1,0 +1,81 @@
+"""Shared tiny-BERT harness for the multichip ZeRO drills.
+
+The 8-device dryrun (`__graft_entry__.dryrun_multichip`) and the bench
+capture (`bench.py --multichip`) exercise the SAME workload — a tiny
+deterministic BERT pretraining step per ZeRO stage — and must stay in
+lockstep: if the batch contract or the deterministic-build convention
+drifts between them, the parity dryrun stops validating what the bench
+measures.  One copy of the config, the batch synthesis, the loss, and
+the fresh-name + pinned-tracer-key build wrapper lives here.
+
+Deliberately underscore-private: a drill harness, not API surface.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def tiny_bert_config():
+    """The multichip drill model: 2-layer hidden-64 BERT, dropout OFF —
+    ZeRO-vs-GSPMD parity demands it (the oracle draws one global mask,
+    the shard_map body draws per-rank masks)."""
+    from .. import models
+
+    return models.BertConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+
+
+def bert_loss_fn(m, batch):
+    logits, nsp_logits = m(
+        batch["input_ids"], batch["token_type_ids"],
+        batch["position_ids"])
+    return m.loss(logits, nsp_logits, batch["mlm_labels"],
+                  batch["mlm_weights"], batch["nsp_labels"])
+
+
+def bert_batches(cfg, B, S, n, seed=0):
+    """n synthetic pretraining batches (the 6-key feed contract)."""
+    rng = np.random.RandomState(seed)
+    return [{
+        "input_ids": rng.randint(
+            0, cfg.vocab_size, (B, S)).astype(np.int32),
+        "token_type_ids": np.zeros((B, S), np.int32),
+        "position_ids": np.tile(np.arange(S, dtype=np.int32), (B, 1)),
+        "mlm_labels": rng.randint(
+            0, cfg.vocab_size, (B, S)).astype(np.int32),
+        "mlm_weights": np.ones((B, S), np.float32),
+        "nsp_labels": rng.randint(0, 2, (B, 1)).astype(np.int32),
+    } for _ in range(n)]
+
+
+def run_deterministic(mesh, body, cfg=None, lr=1e-4, **step_kw):
+    """Build tiny BERT + a `ShardedTrainStep(**step_kw)` with
+    bit-identical initial params on every call (fresh unique-name
+    scope, tracer key pinned to PRNGKey(7) — the convention every
+    parity drill in this repo uses) and run ``body(step, state)``
+    inside the dygraph guard, returning its result."""
+    import jax
+
+    from .. import models
+    from ..fluid import dygraph
+    from ..fluid import framework as fw
+    from ..fluid import unique_name as un
+    from ..fluid.optimizer import AdamOptimizer
+    from .train_step import ShardedTrainStep
+
+    old = un.switch()
+    try:
+        with dygraph.guard():
+            fw._dygraph_tracer._base_key = jax.random.PRNGKey(7)
+            model = models.BertForPretraining(cfg or tiny_bert_config())
+            step = ShardedTrainStep(
+                model, AdamOptimizer(learning_rate=lr), bert_loss_fn,
+                mesh, **step_kw)
+            state = step.init()
+            return body(step, state)
+    finally:
+        un.switch(old)

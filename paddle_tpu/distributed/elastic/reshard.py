@@ -6,8 +6,8 @@ checkpoints, and each one's layout is a pure function of
 re-partitioning possible at all:
 
   * ZeRO optimizer shards (`distributed/sharding.py`): a state tensor is
-    block-sharded along its first nranks-divisible dim; rank r owns the
-    r-th contiguous block.
+    block-sharded along its largest nranks-divisible dim; rank r owns
+    the r-th contiguous block.
   * Host-embedding tables (`fluid/host_embedding.py`): global row g
     lives on rank g % nranks at compact position g // nranks.
   * Sampler cursors (`paddle_tpu.io.ShardedBatchSampler`): the epoch
@@ -35,7 +35,7 @@ import sys
 import numpy as np
 
 from ...incubate.checkpoint.checkpoint_saver import SerializableBase
-from ..sharding import _first_dp_divisible_dim
+from ..sharding import _dp_shard_dim
 
 __all__ = [
     "reshard_zero_shards",
@@ -72,8 +72,26 @@ def rank_shard_paths(path, prefix, name):
 
 def zero_shard_dim(shape, nranks):
     """The dim ZeRO shards `shape` over for `nranks` (None: replicated)
-    — single-sourced with `sharding.zero_shard_state`'s placement."""
-    return _first_dp_divisible_dim(tuple(shape), int(nranks))
+    — single-sourced with `sharding.zero_shard_state`'s placement
+    (largest nranks-divisible dim; ties break toward the earlier dim)."""
+    return _dp_shard_dim(tuple(shape), int(nranks))
+
+
+def _legacy_first_divisible_dim(shape, nranks):
+    """The pre-PR-13 placement rule (FIRST divisible dim).  Kept ONLY
+    to reassemble checkpoints written before `_dp_shard_dim` switched
+    to largest-dim: shard files that carry no recorded ``dim`` were
+    sliced by this rule, and reassembling them along the new rule's dim
+    would corrupt (or refuse) the restore."""
+    nranks = int(nranks)
+    if nranks <= 1:
+        return None
+    for i, s in enumerate(shape):
+        if s and s % nranks == 0 and s >= nranks:
+            return i
+    return None
+
+
 
 
 def zero_shard_slice(shape, rank, nranks):
@@ -88,7 +106,8 @@ def zero_shard_slice(shape, rank, nranks):
     return tuple(sl)
 
 
-def reshard_zero_shards(shards, full_shape, old_nranks, new_nranks):
+def reshard_zero_shards(shards, full_shape, old_nranks, new_nranks,
+                        old_dim="auto"):
     """Re-slice one ZeRO-sharded tensor from N to M rank blocks.
 
     `shards`: {old_rank: ndarray} — every old rank's block (a replicated
@@ -96,9 +115,14 @@ def reshard_zero_shards(shards, full_shape, old_nranks, new_nranks):
     list of M new per-rank arrays (each the new rank's block, or the
     full tensor for every rank when `full_shape` is not M-divisible —
     the same fall-back-to-replicated rule `zero_shard_state` applies).
-    """
+
+    ``old_dim`` overrides the dim the SAVED blocks were sliced along
+    (checkpoints record it; pass the recorded value so a placement-rule
+    change can never mis-concatenate old shards).  Default: the current
+    rule."""
     full_shape = tuple(int(s) for s in full_shape)
-    old_dim = zero_shard_dim(full_shape, old_nranks)
+    if isinstance(old_dim, str) and old_dim == "auto":
+        old_dim = zero_shard_dim(full_shape, old_nranks)
     if old_dim is None:
         if 0 not in shards:
             raise ReshardError(
@@ -275,9 +299,14 @@ class ZeROShardCheckpoint(SerializableBase):
         names = []
         for n, a in self._snap.items():
             fname = self._fname(n)
+            dim = zero_shard_dim(self.full_shapes[n], self._nranks)
             np.savez(os.path.join(path, fname), block=a,
                      meta=np.asarray([self._rank, self._nranks]),
-                     full_shape=np.asarray(self.full_shapes[n]))
+                     full_shape=np.asarray(self.full_shapes[n]),
+                     # the dim these blocks were sliced along (-1 =
+                     # replicated), so restore never re-derives it from
+                     # a placement rule that may have changed since
+                     dim=np.asarray(-1 if dim is None else dim))
             names.append(fname)
         return names
 
@@ -290,6 +319,16 @@ class ZeROShardCheckpoint(SerializableBase):
             for n in self.states
         }
 
+    @staticmethod
+    def _saved_dim(d, full_shape, saved_nranks):
+        """The dim a shard file's block was sliced along: the recorded
+        value when present (-1 = replicated), else the PRE-PR-13
+        first-divisible rule such legacy files were written under."""
+        if "dim" in getattr(d, "files", ()):
+            v = int(d["dim"])
+            return None if v < 0 else v
+        return _legacy_first_divisible_dim(full_shape, saved_nranks)
+
     def deserialize(self, path):
         for name in list(self.states):
             own = os.path.join(path, self._fname(name))
@@ -297,20 +336,30 @@ class ZeROShardCheckpoint(SerializableBase):
             if os.path.exists(own):
                 with np.load(own) as d:
                     saved_nranks = int(d["meta"][1])
-                    if saved_nranks == self._nranks:
+                    fshape = tuple(int(x) for x in d["full_shape"])
+                    saved_dim = self._saved_dim(d, fshape, saved_nranks)
+                    # fast path only when BOTH the world size and the
+                    # slicing dim match the current layout — a
+                    # placement-rule change must re-slice, not load a
+                    # wrong-shaped block
+                    if (saved_nranks == self._nranks and saved_dim
+                            == zero_shard_dim(fshape, self._nranks)):
                         self.states[name] = d["block"]
                         self.restored_nranks = saved_nranks
                         continue
-            # world size changed (or this rank is new): gather every old
-            # rank's shard of this state and re-slice
+            # world size (or slicing layout) changed, or this rank is
+            # new: gather every old rank's shard and re-slice
             shards = {}
             full_shape = self.full_shapes[name]
+            saved_dim = "auto"
             for old_rank, fp in rank_shard_paths(path, "zero",
                                                  name).items():
                 with np.load(fp) as d:
                     shards[old_rank] = d["block"]
                     saved_nranks = int(d["meta"][1])
                     full_shape = tuple(int(x) for x in d["full_shape"])
+                    saved_dim = self._saved_dim(d, full_shape,
+                                                saved_nranks)
             if not shards:
                 raise ReshardError(
                     "checkpoint carries no ZeRO shards for state %r" % name)
@@ -319,7 +368,8 @@ class ZeROShardCheckpoint(SerializableBase):
                 "world size %d" % (name, saved_nranks, self._nranks),
                 file=sys.stderr)
             blocks = reshard_zero_shards(
-                shards, full_shape, saved_nranks, self._nranks)
+                shards, full_shape, saved_nranks, self._nranks,
+                old_dim=saved_dim)
             self.states[name] = blocks[self._rank]
             self.restored_nranks = saved_nranks
         return self.states

@@ -69,14 +69,27 @@ def _validate_spec(spec, shape, mesh):
     return PartitionSpec(*fixed)
 
 
-def _first_dp_divisible_dim(shape, dp):
-    """Index of the first dim shardable over dp, or None (ZeRO placement)."""
+def _dp_shard_dim(shape, dp):
+    """Index of the LARGEST dp-divisible dim, or None (replicated).
+
+    Largest, not first: a [30522, 768] embedding table shards over its
+    30k rows (3.8 MB/rank at dp=8) rather than the hidden dim, and ties
+    break toward the earlier dim so existing row-major layouts win.
+    This is THE ZeRO placement function — `zero_shard_state`, the
+    stage-2/3 train-step layouts (`distributed.zero`) and the elastic
+    reshard math (`elastic.reshard.zero_shard_dim`) all single-source
+    it, so save/restore and runtime sharding can never disagree."""
     if dp <= 1:
         return None
+    best, best_size = None, 0
     for i, s in enumerate(shape):
-        if s and s % dp == 0 and s >= dp:
-            return i
-    return None
+        if s and s % dp == 0 and s >= dp and int(s) > best_size:
+            best, best_size = i, int(s)
+    return best
+
+
+# legacy alias (pre-PR-13 name; semantics upgraded to largest-dim)
+_first_dp_divisible_dim = _dp_shard_dim
 
 
 def megatron_rule():
@@ -133,7 +146,7 @@ def zero_shard_state(state_specs, params, mesh, zero_stage=1):
         for sname, shape in states.items():
             spec = ()
             if zero_stage >= 1:
-                i = _first_dp_divisible_dim(shape, dp)
+                i = _dp_shard_dim(shape, dp)
                 if i is not None:
                     spec = (None,) * i + ("dp",)
             out[pname][sname] = NamedSharding(mesh.mesh, PartitionSpec(*spec))

@@ -36,11 +36,11 @@ def _validate(spec, shape, mesh):
 
 
 def _zero_spec(shape, mesh):
-    """ZeRO-1: shard along dp over the first divisible dim."""
-    from .sharding import _first_dp_divisible_dim
+    """ZeRO-1: shard along dp over the largest divisible dim."""
+    from .sharding import _dp_shard_dim
 
     dp = mesh.axis_size("dp")
-    i = _first_dp_divisible_dim(shape or (), dp)
+    i = _dp_shard_dim(shape or (), dp)
     return None if i is None else (None,) * i + ("dp",)
 
 

@@ -1,0 +1,323 @@
+"""ZeRO-2/3 layout math: per-parameter dp shards, gather/scatter buckets.
+
+The ZeRO paper's observation (Rajbhandari et al., 2020 — PAPERS.md) is
+that data-parallel gradient sync does not need an all-reduce at all:
+reduce-scatter the gradients (each rank receives the reduced 1/N shard
+it will update), apply the optimizer on that shard, and all-gather the
+updated parameters — the same total wire bytes as one ring all-reduce
+(2·(N−1)/N vs (N−1)/N + (N−1)/N), but gradient + optimizer memory drop
+N× and the two halves can overlap with backward/forward compute.
+
+This module is the pure layout half of that story for
+`ShardedTrainStep(zero_stage=2|3)`:
+
+  * `ZeroLayout` — how ONE tensor shards over dp: block-sharded along
+    its largest dp-divisible dim (single-sourced with
+    `sharding._dp_shard_dim`, so elastic reshard-on-restore keeps
+    working), or — when no dim divides — flattened and zero-padded to a
+    multiple of dp ("flat" layout), so EVERY tensor has a 1/N shard and
+    no gradient ever needs a full all-reduce fallback.
+  * `plan_buckets` — groups tensors into gather/scatter buckets capped
+    at `chunk_bytes` of per-rank shard payload: one collective per
+    bucket instead of one monolithic gather, giving XLA's latency-hiding
+    scheduler independent collectives it can overlap with compute
+    (overlap-ready chunked gathers).  Oversize tensors ride alone;
+    tensors of different dtypes never share a bucket (the bucket wire
+    format is a flat concat).
+  * flat-space transforms (`full_to_rows` / `rows_to_full` /
+    `shard_to_flat` / `flat_to_shard` / `local_flat`) — jnp-only, usable
+    both inside a `shard_map` body and on replicated arrays outside it.
+    The wire format per bucket is ``[dp, flat]`` rows flattened row-major
+    to ``[dp*flat]``: segment r is rank r's shard, which is exactly what
+    ``psum_scatter(..., tiled=True)`` scatters and
+    ``all_gather(..., tiled=True)`` concatenates.
+  * `zero_comm_estimate` — the static collective-traffic model for one
+    step (counts + payload + ring wire bytes per collective kind), the
+    prediction `analysis.comm.hlo_collective_stats` validates against
+    the compiled HLO.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .sharding import _dp_shard_dim
+
+__all__ = [
+    "DEFAULT_CHUNK_BYTES",
+    "ZeroLayout",
+    "plan_layouts",
+    "plan_buckets",
+    "zero_comm_estimate",
+]
+
+# default gather/scatter bucket cap: 4 MB of per-rank shard payload per
+# collective — big enough to amortize collective launch latency, small
+# enough that a BERT-base-scale model still splits into several
+# independently schedulable gathers
+DEFAULT_CHUNK_BYTES = 4 << 20
+
+
+def _itemsize(dtype):
+    return np.dtype(str(dtype).replace("bfloat16", "float16")).itemsize
+
+
+class ZeroLayout:
+    """How one tensor shards over dp ranks.
+
+    ``dim`` is the block-shard dim (largest dp-divisible), or None for
+    the flat fallback (ravel + zero-pad to a dp multiple).  ``flat`` is
+    the per-rank shard element count — the tensor's footprint in every
+    bucket wire format.
+    """
+
+    __slots__ = ("name", "shape", "dtype", "dp", "dim", "pad", "flat")
+
+    def __init__(self, name, shape, dtype, dp):
+        self.name = name
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = str(dtype)
+        self.dp = int(dp)
+        self.dim = _dp_shard_dim(self.shape, self.dp)
+        size = int(np.prod(self.shape)) if self.shape else 1
+        if self.dim is None:
+            padded = ((size + self.dp - 1) // self.dp) * self.dp
+            self.pad = padded - size
+            self.flat = padded // self.dp
+        else:
+            self.pad = 0
+            self.flat = size // self.dp
+
+    @property
+    def size(self):
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    @property
+    def shard_bytes(self):
+        return self.flat * _itemsize(self.dtype)
+
+    @property
+    def full_bytes(self):
+        return self.size * _itemsize(self.dtype)
+
+    @property
+    def sharded(self):
+        """True when a real dim shards (False = flat zero-pad fallback,
+        which keeps a replicated at-rest copy in the train state)."""
+        return self.dim is not None
+
+    def spec(self):
+        """PartitionSpec of the at-rest sharded placement (replicated
+        for flat-fallback tensors)."""
+        from jax.sharding import PartitionSpec
+
+        if self.dim is None:
+            return PartitionSpec()
+        return PartitionSpec(*((None,) * self.dim + ("dp",)))
+
+    # -- flat-space transforms (jnp; work inside and outside shard_map) -
+    def _moved_shape(self):
+        s = list(self.shape)
+        d = s.pop(self.dim)
+        return (d,) + tuple(s)
+
+    def full_to_rows(self, x):
+        """Full tensor -> [dp, flat] rows; row r is rank r's shard."""
+        import jax.numpy as jnp
+
+        if self.dim is None:
+            f = jnp.ravel(x)
+            if self.pad:
+                f = jnp.pad(f, (0, self.pad))
+            return f.reshape(self.dp, self.flat)
+        return jnp.moveaxis(x, self.dim, 0).reshape(self.dp, self.flat)
+
+    def rows_to_full(self, rows):
+        """[dp, flat] rows -> full tensor (inverse of full_to_rows)."""
+        import jax.numpy as jnp
+
+        if self.dim is None:
+            f = rows.reshape(-1)
+            if self.pad:
+                f = f[: self.size]
+            return f.reshape(self.shape)
+        moved = self._moved_shape()
+        # [dp, flat] rows are rank blocks of the moved-axis layout;
+        # merging the leading (dp, block) pair row-major IS the block
+        # concatenation along the shard dim
+        merged = rows.reshape((self.shape[self.dim],) + moved[1:])
+        return jnp.moveaxis(merged, 0, self.dim)
+
+    def shard_to_flat(self, shard):
+        """The local block (as `shard_map` delivers it for the sharded
+        placement) -> [flat]."""
+        import jax.numpy as jnp
+
+        if self.dim is None:
+            # flat-fallback tensors are replicated at rest; callers use
+            # local_flat(full, idx) instead
+            raise ValueError("flat-layout tensor %r has no block shard"
+                             % self.name)
+        return jnp.moveaxis(shard, self.dim, 0).reshape(self.flat)
+
+    def flat_to_shard(self, flat):
+        """[flat] -> the local block in original orientation."""
+        import jax.numpy as jnp
+
+        if self.dim is None:
+            raise ValueError("flat-layout tensor %r has no block shard"
+                             % self.name)
+        moved = self._moved_shape()
+        blk = (moved[0] // self.dp,) + moved[1:]
+        return jnp.moveaxis(flat.reshape(blk), 0, self.dim)
+
+    def local_flat(self, full, idx):
+        """Rank ``idx``'s [flat] slice of a full (replicated) tensor —
+        a dynamic row slice, traceable with ``idx = axis_index(...)``."""
+        import jax
+
+        rows = self.full_to_rows(full)
+        return jax.lax.dynamic_slice_in_dim(rows, idx, 1, axis=0)[0]
+
+    def __repr__(self):
+        how = ("dim%d" % self.dim) if self.dim is not None else (
+            "flat+pad%d" % self.pad)
+        return "ZeroLayout(%s %s %s %s /dp%d)" % (
+            self.name, self.shape, self.dtype, how, self.dp)
+
+
+def plan_layouts(arrays, dp):
+    """{name: array-like with .shape/.dtype} -> {name: ZeroLayout}."""
+    return {
+        name: ZeroLayout(name, a.shape, a.dtype, dp)
+        for name, a in arrays.items()
+    }
+
+
+def plan_buckets(layouts, keys=None, chunk_bytes=DEFAULT_CHUNK_BYTES):
+    """Greedy bucketing of ``keys`` (default: every layout, in insertion
+    order) into collective chunks.
+
+    Each bucket's per-rank shard payload stays under ``chunk_bytes``
+    (an oversize tensor rides alone — never split across buckets), and
+    a bucket holds one dtype only (the wire format is a flat concat).
+    Returns a list of key lists, ordered like the input so gathers fire
+    in parameter order — the order the forward consumes them, which is
+    what lets XLA overlap bucket i+1's gather with bucket i's compute.
+    """
+    chunk_bytes = max(int(chunk_bytes), 1)
+    buckets = []
+    cur, cur_bytes, cur_dtype = [], 0, None
+    for key in (list(keys) if keys is not None else list(layouts)):
+        lay = layouts[key]
+        b = lay.shard_bytes
+        if cur and (cur_bytes + b > chunk_bytes or lay.dtype != cur_dtype):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(key)
+        cur_bytes += b
+        cur_dtype = lay.dtype
+        if cur_bytes >= chunk_bytes:
+            buckets.append(cur)
+            cur, cur_bytes, cur_dtype = [], 0, None
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucket_offsets(layouts, bucket):
+    """[(key, offset, flat)] + total flat length for one bucket."""
+    out, off = [], 0
+    for key in bucket:
+        lay = layouts[key]
+        out.append((key, off, lay.flat))
+        off += lay.flat
+    return out, off
+
+
+# ---------------------------------------------------------------------------
+# static collective-traffic estimate (validated against compiled HLO)
+# ---------------------------------------------------------------------------
+
+
+def zero_comm_estimate(param_layouts, zero_stage, dp,
+                       chunk_bytes=DEFAULT_CHUNK_BYTES,
+                       state_slots_per_param=0):
+    """Predicted per-step collective traffic of a stage-2/3 step.
+
+    ``param_layouts``: {name: ZeroLayout} of the trained parameters.
+    ``state_slots_per_param``: sharded-moment slots the optimizer keeps
+    (0 for SGD, 1 momentum, 2 adam) — flat-fallback tensors' moments
+    ride the reassembly gather, so they add traffic.
+
+    Returns ``{kind: {count, payload_bytes, wire_bytes}}`` plus
+    ``wire_bytes_total``, using the ring factors from `analysis.comm`
+    (reduce-scatter and all-gather each move (N−1)/N of the full
+    payload per chip).  Counts are per-BUCKET: one collective per chunk.
+    """
+    from ..analysis import comm as comm_mod
+
+    layouts = dict(param_layouts)
+    names = list(layouts)
+    grad_buckets = plan_buckets(layouts, names, chunk_bytes)
+    grad_full = sum(layouts[n].flat * dp * _itemsize(layouts[n].dtype)
+                    for n in names)
+
+    # all-gather traffic per step:
+    #   stage 2 — every updated param re-replicates after the update;
+    #   stage 3 — sharded params gather JUST-IN-TIME at forward entry
+    #             (same bytes, earlier in the step) and flat-fallback
+    #             params re-replicate after the update.
+    # Flat-fallback moments re-replicate at either stage.
+    fallback = [n for n in names if not layouts[n].sharded]
+    if zero_stage >= 3:
+        fwd_keys = [n for n in names if layouts[n].sharded]
+        reasm_keys = list(fallback)
+    else:
+        fwd_keys = []
+        reasm_keys = list(names)
+    gather_layouts = {n: layouts[n] for n in fwd_keys + reasm_keys}
+    extra = {}
+    for n in fallback:
+        for s in range(int(state_slots_per_param)):
+            k = "%s#moment%d" % (n, s)
+            extra[k] = layouts[n]
+    gather_layouts.update(extra)
+    gather_buckets = plan_buckets(
+        {n: layouts[n] for n in fwd_keys}, fwd_keys, chunk_bytes)
+    gather_buckets += plan_buckets(
+        gather_layouts, reasm_keys + list(extra), chunk_bytes)
+    order = fwd_keys + reasm_keys + list(extra)
+    gather_full = sum(gather_layouts[k].flat * dp
+                      * _itemsize(gather_layouts[k].dtype) for k in order)
+
+    # kind keys use the HYPHENATED HLO vocabulary so this estimate and
+    # `hlo_collective_stats` (the report it validates against) share
+    # one schema
+    out = {
+        "reduce-scatter": {
+            "count": len(grad_buckets),
+            "payload_bytes": float(grad_full),
+            "wire_bytes": comm_mod.collective_wire_bytes(
+                "reduce-scatter", grad_full, dp, payload="full"),
+        },
+        "all-gather": {
+            "count": len(gather_buckets),
+            "payload_bytes": float(gather_full),
+            "wire_bytes": comm_mod.collective_wire_bytes(
+                "all-gather", gather_full, dp, payload="full"),
+        },
+        # the loss mean (plus the compat shim's scalar replication) is
+        # the only all-reduce a stage>=2 step performs
+        "all-reduce": {
+            "count": 2,
+            "payload_bytes": 8.0,
+            "wire_bytes": comm_mod.collective_wire_bytes(
+                "all-reduce", 8.0, dp, payload="full"),
+        },
+    }
+    out["wire_bytes_total"] = sum(v["wire_bytes"] for v in out.values())
+    return out

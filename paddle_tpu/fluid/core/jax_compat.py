@@ -36,7 +36,8 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["has_shard_map", "shard_map", "pvary", "axis_size"]
+__all__ = ["has_shard_map", "has_native_shard_map", "shard_map", "pvary",
+           "axis_size"]
 
 
 def has_shard_map():
@@ -49,6 +50,16 @@ def has_shard_map():
         return True
     except ImportError:
         return False
+
+
+def has_native_shard_map():
+    """True only for the graduated `jax.shard_map` API.  Some programs
+    need it outright — the experimental fallback's replication checker
+    cannot type e.g. the static pipeline's autodiff partial-eval — so
+    capability gates (tests/conftest.py markers, the dryrun's
+    static-pipeline section) key on THIS, while code that tolerates
+    the fallback keys on `has_shard_map`."""
+    return hasattr(jax, "shard_map")
 
 
 def _spec_axes(spec):

@@ -36,6 +36,7 @@ __all__ = [
     "cost_of_jitted",
     "feed_signature",
     "hbm_bandwidth",
+    "ici_bandwidth",
     "record_executable_cost",
     "record_mfu",
     "peak_flops",
@@ -53,6 +54,7 @@ def feed_signature(feed):
 
 PEAK_FLOPS_ENV = "PADDLE_TPU_PEAK_FLOPS"
 HBM_BW_ENV = "PADDLE_TPU_HBM_BW"
+ICI_BW_ENV = "PADDLE_TPU_ICI_BW"
 
 # bf16 peak per chip for platforms we know; MFU needs a denominator and
 # an unknown platform yields None (callers then skip the gauge)
@@ -64,6 +66,14 @@ _PLATFORM_PEAK = {
 # estimates divide bytes moved by this)
 _PLATFORM_HBM_BW = {
     "tpu": 819e9,    # v5e public spec
+}
+
+# ICI bytes/s per chip, one link one direction — the ring-collective
+# bound the comm model divides wire bytes by (v5e: 4 links x 400 Gbps
+# bidirectional => 45 GB/s usable one-way per ring direction, the
+# scaling-book figure).  The third roofline axis (analysis.comm).
+_PLATFORM_ICI_BW = {
+    "tpu": 4.5e10,   # v5e, one-way per link
 }
 
 
@@ -108,6 +118,29 @@ def hbm_bandwidth(explicit=None, platform=None):
         except Exception:
             return None
     return _PLATFORM_HBM_BW.get(platform)
+
+
+def ici_bandwidth(explicit=None, platform=None):
+    """Resolve ICI bytes/s (one link, one direction) the same way
+    peak_flops resolves FLOP/s: explicit arg > $PADDLE_TPU_ICI_BW >
+    platform table (platform defaults to the live jax backend).  None
+    when unknown."""
+    if explicit:
+        return float(explicit)
+    env = os.getenv(ICI_BW_ENV)
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    if platform is None:
+        try:
+            import jax
+
+            platform = jax.default_backend()
+        except Exception:
+            return None
+    return _PLATFORM_ICI_BW.get(platform)
 
 
 def cost_analysis_of(compiled):

@@ -25,7 +25,10 @@ Front ends:
 * ``search_bucket_ladder(predictor, example, traffic, ...)`` — serving
   batch-bucket ladders (`InferenceServer.autotune` wires it in);
 * ``search_step(build_and_time, variants, ...)`` — opaque jitted-step
-  knobs (``bench.py --autotune``).
+  knobs (``bench.py --autotune``);
+* ``search_train_step(build_and_time, ...)`` — the distributed-step
+  knobs: ZeRO stage x accumulate_steps x gather-chunk-bytes
+  (``bench.py --multichip --autotune``).
 
 Entry points: ``CompiledProgram.with_autotune()`` (Executor applies the
 tuned pipeline on first run), ``InferenceServer.autotune()``,
@@ -48,6 +51,7 @@ from .search import (  # noqa: F401
     search_flash_blocks,
     search_gemm_blocks,
     search_step,
+    search_train_step,
     tuned_program,
 )
 from .space import (  # noqa: F401
@@ -58,6 +62,7 @@ from .space import (  # noqa: F401
     gemm_block_candidates,
     ladder_candidates,
     sharding_candidates,
+    train_step_candidates,
 )
 
 __all__ = [
@@ -78,6 +83,8 @@ __all__ = [
     "search_flash_blocks",
     "search_gemm_blocks",
     "search_step",
+    "search_train_step",
     "sharding_candidates",
+    "train_step_candidates",
     "tuned_program",
 ]

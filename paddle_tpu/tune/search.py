@@ -50,6 +50,7 @@ __all__ = [
     "search_flash_blocks",
     "search_gemm_blocks",
     "search_step",
+    "search_train_step",
     "tuned_program",
 ]
 
@@ -1095,6 +1096,33 @@ def search_bucket_ladder(runner, example_inputs, traffic, *, max_batch=32,
 # ---------------------------------------------------------------------------
 # jitted-step variant search (bench.py --autotune)
 # ---------------------------------------------------------------------------
+
+
+def search_train_step(build_and_time, *, workload, mesh=None,
+                      zero_stages=(1, 2, 3), accumulate_steps=(1, 4),
+                      chunk_bytes=(4 << 20,), use_cache=True,
+                      cache_dir=None, platform=None, jax_version=None):
+    """Measured search over the distributed-train-step knobs: ZeRO
+    stage x accumulate_steps x gather-chunk-bytes
+    (`space.train_step_candidates`; the zero/chunk axes collapse on a
+    1-chip mesh by construction).
+
+    ``build_and_time(params) -> seconds`` owns constructing a
+    ``ShardedTrainStep(**params)`` and timing one step (bench.py's
+    marginal harness, or any caller-defined one); the tuner owns
+    enumeration, ordering, reporting, and the cache — the winner's
+    params slot straight back into ``ShardedTrainStep``.  Same
+    default-first contract as `search_step`: the first candidate (the
+    first entry of ``zero_stages`` at accumulate_steps[0]) is the
+    measured baseline."""
+    dp = mesh.axis_size("dp") if mesh is not None else 1
+    cands = space_mod.train_step_candidates(
+        dp=dp, zero_stages=zero_stages,
+        accumulate_steps=accumulate_steps, chunk_bytes=chunk_bytes)
+    return search_step(
+        build_and_time, cands, workload=workload, mesh=mesh,
+        use_cache=use_cache, cache_dir=cache_dir, platform=platform,
+        jax_version=jax_version)
 
 
 def search_step(build_and_time, variants, *, workload, mesh=None,

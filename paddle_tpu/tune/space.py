@@ -30,6 +30,7 @@ __all__ = [
     "gemm_block_candidates",
     "ladder_candidates",
     "sharding_candidates",
+    "train_step_candidates",
 ]
 
 # block sizes the pallas kernels accept (attention._pick_block's ladder)
@@ -222,6 +223,47 @@ def ladder_candidates(max_batch, traffic=None, ladders=None,
     for i, l in enumerate(extra or ()):
         add(l, "extra%d" % i)
     return cands
+
+
+def train_step_candidates(dp=None, zero_stages=(1, 2, 3),
+                          accumulate_steps=(1, 4),
+                          chunk_bytes=(4 << 20,)):
+    """Distributed-train-step knobs as measured candidates: ZeRO stage
+    (gradient sync strategy), microbatch accumulation, and the
+    gather/scatter chunk size of the stage-2/3 bucketed collectives.
+
+    The default configuration (zero_stage=1, accumulate_steps=1, first
+    chunk size) comes FIRST — `search_train_step`'s baseline.  On a
+    1-chip box (``dp<=1``) the zero/chunk axes collapse by construction
+    (stage >= 2 changes nothing without a dp ring to scatter over), so
+    only accumulation variants remain."""
+    dp = int(dp) if dp else 1
+    if dp <= 1:
+        zero_stages = tuple(z for z in zero_stages if z <= 1) or (1,)
+        chunk_bytes = chunk_bytes[:1]
+    out = []
+    seen = set()
+
+    def add(z, acc, cb):
+        key = (z, acc, cb if z >= 2 else None)
+        if key in seen:
+            return
+        seen.add(key)
+        label = "zero%d.acc%d" % (z, acc)
+        params = {"zero_stage": int(z), "accumulate_steps": int(acc)}
+        if z >= 2:
+            params["gather_chunk_bytes"] = int(cb)
+            label += ".chunk%dk" % (int(cb) // 1024)
+        out.append(Candidate("train_step", params, label=label))
+
+    first_z = zero_stages[0] if zero_stages else 1
+    add(first_z, (accumulate_steps or (1,))[0],
+        (chunk_bytes or (4 << 20,))[0])
+    for z in zero_stages:
+        for acc in accumulate_steps or (1,):
+            for cb in (chunk_bytes or (4 << 20,)):
+                add(z, acc, cb)
+    return out
 
 
 def sharding_candidates(program, mesh, min_bytes=1 << 20):

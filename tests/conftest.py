@@ -37,9 +37,12 @@ jax.config.update("jax_default_matmul_precision", "highest")
 # automatically the moment the jax install provides it.
 # ---------------------------------------------------------------------------
 
-from paddle_tpu.fluid.core.jax_compat import has_shard_map  # noqa: E402
+from paddle_tpu.fluid.core.jax_compat import (  # noqa: E402
+    has_native_shard_map,
+    has_shard_map,
+)
 
-HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+HAS_NATIVE_SHARD_MAP = has_native_shard_map()
 HAS_ANY_SHARD_MAP = has_shard_map()
 # multiprocess XLA on CPU needs the cross-process collectives runtime
 # (gloo/mpi); jax grew the config knob with the capability — a non-CPU

@@ -152,6 +152,71 @@ def test_zoo_bert_lints_clean_after_fusion_passes():
         + "\n".join(d.format() for d in after))
 
 
+def _bert_small_params():
+    """Parameter name -> numpy-shaped zeros for the zoo BERT config —
+    the tensors a dp=8 training step communicates."""
+    import numpy as np
+
+    from paddle_tpu.fluid import dygraph
+
+    cfg = models.BertConfig(
+        vocab_size=512, hidden_size=128, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=512,
+        max_position_embeddings=128, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    with dygraph.guard():
+        model = models.BertForPretraining(cfg)
+        return {k: np.zeros(v.shape, np.float32)
+                for k, v in model.state_dict().items()}
+
+
+# collective-bytes budget for zoo BERT on a dp=8 mesh: the static comm
+# model's per-step wire bytes (reduce-scatter + all-gather + scalar
+# all-reduce at ZeRO-2).  Estimate at pin time (2026-08-04): 3.59 MB;
+# budget ~2.5x so recalibration never trips it but a replication
+# regression (a pass/lowering change that re-replicates gradients or
+# doubles the gather set) does.
+_COMM_BUDGET_BYTES = 9.0e6
+
+
+def test_zoo_bert_dp8_collective_bytes_within_budget():
+    from paddle_tpu.distributed import zero as zero_mod
+
+    layouts = zero_mod.plan_layouts(_bert_small_params(), 8)
+    est = zero_mod.zero_comm_estimate(layouts, 2, 8,
+                                      state_slots_per_param=2)
+    assert 0 < est["wire_bytes_total"] <= _COMM_BUDGET_BYTES, (
+        "zoo BERT dp=8 ZeRO-2 step wants %.2f MB on the wire "
+        "(budget %.2f MB): a layout or estimator change inflated "
+        "collective traffic — re-pin only if intentional"
+        % (est["wire_bytes_total"] / 1e6, _COMM_BUDGET_BYTES / 1e6))
+    # binds-check: a near-zero budget must fail
+    assert est["wire_bytes_total"] > 1e3
+
+
+def test_replicated_gradient_lint_gate():
+    """The replicated-gradient hazard gate: an optimizer program on a
+    dp=8 mesh with unsharded grads MUST lint dirty (the ZeRO-2 value
+    proposition stays visible), and the same program without a mesh
+    stays clean (no false alarms on single-chip CI)."""
+    from paddle_tpu import distributed as dist
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("gx", shape=[-1, 64], append_batch_size=False)
+        y = layers.data("gy", shape=[-1, 1], append_batch_size=False)
+        pred = layers.fc(x, size=1, param_attr="gate_fc.w")
+        loss = layers.reduce_mean(layers.square(pred - y))
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+    clean = _perf_findings(main, ("replicated-gradient",))
+    assert not clean, "rule fired without a mesh: false alarm"
+    mesh = dist.auto_mesh(8)
+    with dist.mesh_guard(mesh):
+        dirty = _perf_findings(main, ("replicated-gradient",))
+    assert len(dirty) == 1, "gate is vacuous: hazard not flagged"
+    assert dirty[0].fix == "zero_stage>=2"
+
+
 def test_zoo_bert_bhsd_layout_folds_clean(monkeypatch):
     """The head-major (BHSD) BERT build materializes the exact
     [B,S,H,D]<->[B,H,S,D] transpose pairs the hazard rule flags;

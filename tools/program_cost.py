@@ -4,13 +4,20 @@ Usage::
 
     python tools/program_cost.py path/to/__model__.json \
         [--dynamic-dim 8] [--peak-flops 1.97e14] [--hbm-bw 8.19e11] \
-        [--top 10] [--json] [--no-ops] [--budget-ms 5.0]
+        [--ici-bw 4.5e10] [--mesh dp=8] [--top 10] [--json] [--no-ops] \
+        [--budget-ms 5.0]
 
 Runs the `paddle_tpu.analysis.perf` static cost model (FLOPs / bytes /
 roofline time per op on a parameterized chip) over the program and
 prints per-op-type rollups, or the full machine-readable report with
 --json.  Also accepts an inference-model DIRECTORY (as written by
 save_inference_model).
+
+``--mesh`` (e.g. ``dp=8`` or ``dp=4,tp=2``) supplies the collective
+group size for explicit c_* collective ops that carry no ``nranks``
+attr, and ``--ici-bw`` the interconnect bytes/s they are priced
+against — communication enters the roofline exactly like FLOPs and HBM
+(`analysis.comm` ring factors; totals gain ``comm_bytes``).
 
 Exit code: 1 when the model is unreadable or when --budget-ms is given
 and the estimated whole-program time exceeds it; 0 otherwise.
@@ -20,14 +27,16 @@ JSON schema (``schema_version`` 1, pinned for CI consumers)::
     {
       "schema_version": 1,
       "model": "<path>",
-      "chip": {"name": str, "peak_flops": float, "hbm_bw": float},
+      "chip": {"name": str, "peak_flops": float, "hbm_bw": float,
+               "ici_bw": float | null},
       "dynamic_dim": int,
-      "totals": {"flops", "transcendentals", "bytes", "time_s",
-                 "arithmetic_intensity", "op_count"},
-      "by_op_type": [{"op_type", "count", "flops", "bytes", "time_s"}],
+      "totals": {"flops", "transcendentals", "bytes", "comm_bytes",
+                 "time_s", "arithmetic_intensity", "op_count"},
+      "by_op_type": [{"op_type", "count", "flops", "bytes",
+                      "comm_bytes", "time_s"}],
       "ops": [{"block_idx", "op_idx", "op_type", "flops",
-               "transcendentals", "bytes", "time_s", "bound",
-               "provenance"}],          # omitted with --no-ops
+               "transcendentals", "bytes", "comm_bytes", "time_s",
+               "bound", "provenance"}], # omitted with --no-ops
       "budget_ms": float | null,
       "within_budget": bool | null
     }
@@ -59,6 +68,13 @@ def main(argv=None):
                          "v5e fallback)")
     ap.add_argument("--hbm-bw", type=float, default=None,
                     help="chip HBM bytes/s (same resolution order)")
+    ap.add_argument("--ici-bw", type=float, default=None,
+                    help="chip ICI bytes/s for collective pricing "
+                         "(same resolution order, v5e fallback)")
+    ap.add_argument("--mesh", default=None,
+                    help="mesh axes 'dp=8' or 'dp=4,tp=2': the product "
+                         "is the collective group size for c_* ops "
+                         "without an nranks attr")
     ap.add_argument("--top", type=int, default=10,
                     help="rows in the per-op-type table (text mode)")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -84,11 +100,30 @@ def main(argv=None):
         return 1
 
     chip = perf.ChipSpec.detect(peak_flops=args.peak_flops,
-                                hbm_bw=args.hbm_bw)
+                                hbm_bw=args.hbm_bw, ici_bw=args.ici_bw)
+    mesh_size = None
+    if args.mesh:
+        try:
+            parts = [p for p in args.mesh.split(",") if p.strip()]
+            if not parts or any("=" not in p for p in parts):
+                raise ValueError(args.mesh)
+            mesh_size = 1
+            for p in parts:
+                size = int(p.split("=", 1)[1])
+                if size < 1:
+                    # dp=0 (an unset $N) must not silently price every
+                    # collective as free
+                    raise ValueError(p)
+                mesh_size *= size
+        except (ValueError, IndexError):
+            print("error: --mesh wants 'axis=N[,axis=N...]' with N >= 1, "
+                  "got %r" % args.mesh, file=sys.stderr)
+            return 1
     kw = {}
     if args.dynamic_dim is not None:
         kw["dynamic_dim"] = args.dynamic_dim
-    report = perf.program_cost(program, chip=chip, **kw)
+    report = perf.program_cost(program, chip=chip, mesh_size=mesh_size,
+                               **kw)
 
     over_budget = (args.budget_ms is not None
                    and report.total_time_s * 1e3 > args.budget_ms)
