@@ -171,6 +171,8 @@ def _metrics_snapshot():
 
 
 def main():
+    if "--recsys" in sys.argv:
+        return _run_recsys()
     multichip = "--multichip" in sys.argv
     if multichip:
         n = 8
@@ -390,6 +392,20 @@ def _run(on_tpu):
     out["metrics_snapshot"] = _metrics_snapshot()
     print(json.dumps(out))
     return 0
+
+
+def _run_recsys():
+    """--recsys: the online-learning capture — events/sec +
+    minutes-to-freshness, the pipelined-vs-sync embedding A/B and the
+    hot-row cache, via benchmarks/streaming_bench (one JSON line with
+    the same skip/platform/smoke_config conventions as the headline
+    bench; remaining flags pass through, e.g. --autotune)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import streaming_bench
+
+    return streaming_bench.main(
+        [a for a in sys.argv[1:] if a != "--recsys"])
 
 
 def _accelerator_plausible():

@@ -55,46 +55,55 @@ MXU_LANE = 128
 
 class ChipSpec:
     """Roofline parameters for one chip: peak FLOP/s + HBM bytes/s +
-    ICI bytes/s (the collective-traffic axis, `analysis.comm`).
+    ICI bytes/s (the collective-traffic axis, `analysis.comm`) + host
+    link bytes/s (the host-embedding exchange axis,
+    `fluid.host_embedding`).
 
     Defaults resolve through `observability.xla_cost` (env overrides >
     live-platform table) and fall back to the v5e constants of record so
     static analysis works on machines with no accelerator attached."""
 
-    def __init__(self, name, peak_flops, hbm_bw, ici_bw=None):
+    def __init__(self, name, peak_flops, hbm_bw, ici_bw=None,
+                 host_bw=None):
         self.name = name
         self.peak_flops = float(peak_flops)
         self.hbm_bw = float(hbm_bw)
         self.ici_bw = float(ici_bw) if ici_bw else None
+        self.host_bw = float(host_bw) if host_bw else None
 
     @classmethod
     def detect(cls, peak_flops=None, hbm_bw=None, platform=None,
-               ici_bw=None):
+               ici_bw=None, host_bw=None):
         from ..observability import xla_cost
 
         peak = xla_cost.peak_flops(explicit=peak_flops, platform=platform)
         bw = xla_cost.hbm_bandwidth(explicit=hbm_bw, platform=platform)
         ici = xla_cost.ici_bandwidth(explicit=ici_bw, platform=platform)
+        host = xla_cost.host_bandwidth(explicit=host_bw, platform=platform)
         if peak and bw:
             return cls(platform or "detected", peak, bw,
-                       ici or V5E.ici_bw)
+                       ici or V5E.ici_bw, host or V5E.host_bw)
         return cls(
             V5E.name if (peak is None and bw is None) else "partial",
-            peak or V5E.peak_flops, bw or V5E.hbm_bw, ici or V5E.ici_bw)
+            peak or V5E.peak_flops, bw or V5E.hbm_bw, ici or V5E.ici_bw,
+            host or V5E.host_bw)
 
     def to_dict(self):
         return {"name": self.name, "peak_flops": self.peak_flops,
-                "hbm_bw": self.hbm_bw, "ici_bw": self.ici_bw}
+                "hbm_bw": self.hbm_bw, "ici_bw": self.ici_bw,
+                "host_bw": self.host_bw}
 
     def __repr__(self):
-        return "ChipSpec(%s, %.0f GFLOP/s, %.0f GB/s, ICI %s)" % (
+        return "ChipSpec(%s, %.0f GFLOP/s, %.0f GB/s, ICI %s, host %s)" % (
             self.name, self.peak_flops / 1e9, self.hbm_bw / 1e9,
-            "%.0f GB/s" % (self.ici_bw / 1e9) if self.ici_bw else "n/a")
+            "%.0f GB/s" % (self.ici_bw / 1e9) if self.ici_bw else "n/a",
+            "%.0f GB/s" % (self.host_bw / 1e9) if self.host_bw else "n/a")
 
 
 # one v5e chip: 197 bf16 TFLOP/s (the constant bench.py always used),
-# 819 GB/s HBM, 45 GB/s one-way ICI per link (public specs)
-V5E = ChipSpec("tpu-v5e", 197e12, 819e9, 4.5e10)
+# 819 GB/s HBM, 45 GB/s one-way ICI per link (public specs), 16 GB/s
+# PCIe-class host link
+V5E = ChipSpec("tpu-v5e", 197e12, 819e9, 4.5e10, 1.6e10)
 
 
 # ---------------------------------------------------------------------------
@@ -134,19 +143,22 @@ _TRANSCENDENTAL_OPS = {
 
 
 class OpCost:
-    """One op's estimated cost (flops/bytes/comm/time) + location.
+    """One op's estimated cost (flops/bytes/comm/host/time) + location.
 
     ``comm_bytes`` is per-chip WIRE traffic of a collective op (ring
-    factors, `analysis.comm`); the roofline becomes the three-way
-    max(flops/peak, hbm/bw, wire/ici) and a collective-dominated op is
-    labeled ``bound="comm"``."""
+    factors, `analysis.comm`); ``host_bytes`` is host-link traffic of a
+    host-resident exchange (the distributed-embedding pull/push —
+    `fluid.host_embedding`).  The roofline is the four-way
+    max(flops/peak, hbm/bw, wire/ici, host/host_bw); a dominated op is
+    labeled ``bound="comm"`` / ``bound="host"`` accordingly."""
 
     __slots__ = ("block_idx", "op_idx", "op_type", "flops",
-                 "transcendentals", "bytes", "comm_bytes", "time_s",
-                 "bound", "provenance")
+                 "transcendentals", "bytes", "comm_bytes", "host_bytes",
+                 "time_s", "bound", "provenance")
 
     def __init__(self, block_idx, op_idx, op_type, flops, transcendentals,
-                 nbytes, chip, provenance=(), comm_bytes=0.0):
+                 nbytes, chip, provenance=(), comm_bytes=0.0,
+                 host_bytes=0.0):
         self.block_idx = block_idx
         self.op_idx = op_idx
         self.op_type = op_type
@@ -154,12 +166,17 @@ class OpCost:
         self.transcendentals = float(transcendentals)
         self.bytes = float(nbytes)
         self.comm_bytes = float(comm_bytes or 0.0)
+        self.host_bytes = float(host_bytes or 0.0)
         t_compute = self.flops / chip.peak_flops
         t_memory = self.bytes / chip.hbm_bw
         t_comm = (self.comm_bytes / chip.ici_bw
                   if self.comm_bytes and chip.ici_bw else 0.0)
-        self.time_s = max(t_compute, t_memory, t_comm)
-        if t_comm and t_comm >= t_compute and t_comm >= t_memory:
+        t_host = (self.host_bytes / chip.host_bw
+                  if self.host_bytes and chip.host_bw else 0.0)
+        self.time_s = max(t_compute, t_memory, t_comm, t_host)
+        if t_host and t_host >= max(t_compute, t_memory, t_comm):
+            self.bound = "host"
+        elif t_comm and t_comm >= t_compute and t_comm >= t_memory:
             self.bound = "comm"
         else:
             self.bound = "compute" if t_compute >= t_memory else "memory"
@@ -171,6 +188,7 @@ class OpCost:
             "op_type": self.op_type, "flops": self.flops,
             "transcendentals": self.transcendentals, "bytes": self.bytes,
             "comm_bytes": self.comm_bytes,
+            "host_bytes": self.host_bytes,
             "time_s": self.time_s, "bound": self.bound,
             "provenance": list(self.provenance),
         }
@@ -355,10 +373,26 @@ def _cost_xent(ins, outs, attrs):
     return {"flops": float(n), "transcendentals": float(n)}
 
 
-@register_op_cost("lookup_table")
+@register_op_cost("lookup_table", "lookup_table_v2")
 def _cost_lookup(ins, outs, attrs):
     # XLA bills the gather's address math ~1 FLOP per fetched element
-    return {"flops": float(_out_elems(outs))}
+    c = {"flops": float(_out_elems(outs))}
+    if attrs.get("is_distributed"):
+        # host-RAM table (fluid.host_embedding): every step the touched
+        # rows cross the host link twice (pull values + push gradients)
+        # with their ids.  The static bound bills one row per looked-up
+        # id (no np.unique dedup — the same upper-bound convention as
+        # the no-fusion byte model; the measured dedup lives in the
+        # hostemb_unique_ratio metric).
+        ids = _first(ins, "Ids")
+        w = _first(ins, "W")
+        if ids is not None and w is not None:
+            n_ids = float(_elems(ids[0]))
+            row_bytes = int(w[0][-1]) * _itemsize(w[1])
+            # pull row + push f32 grad row + 8-byte id each way
+            c["host_bytes"] = n_ids * (row_bytes + int(w[0][-1]) * 4
+                                       + 2 * 8)
+    return c
 
 
 @register_op_cost("flash_attention")
@@ -647,7 +681,8 @@ def estimate_op_cost(program, bidx, oidx, op, chip,
     return OpCost(bidx, oidx, op_type, c.get("flops", 0.0),
                   c.get("transcendentals", 0.0), nbytes, chip,
                   provenance=opgraph.op_provenance(op),
-                  comm_bytes=comm_bytes)
+                  comm_bytes=comm_bytes,
+                  host_bytes=c.get("host_bytes", 0.0))
 
 
 class CostReport:
@@ -679,6 +714,11 @@ class CostReport:
         return sum(e.comm_bytes for e in self.entries)
 
     @property
+    def total_host_bytes(self):
+        """Host-link exchange bytes (distributed-embedding pull/push)."""
+        return sum(e.host_bytes for e in self.entries)
+
+    @property
     def total_time_s(self):
         return sum(e.time_s for e in self.entries)
 
@@ -698,11 +738,12 @@ class CostReport:
         for e in self.entries:
             g = groups.setdefault(e.op_type, dict(
                 op_type=e.op_type, count=0, flops=0.0, bytes=0.0,
-                comm_bytes=0.0, time_s=0.0))
+                comm_bytes=0.0, host_bytes=0.0, time_s=0.0))
             g["count"] += 1
             g["flops"] += e.flops
             g["bytes"] += e.bytes
             g["comm_bytes"] += e.comm_bytes
+            g["host_bytes"] += e.host_bytes
             g["time_s"] += e.time_s
         return sorted(groups.values(), key=lambda g: -g["time_s"])
 
@@ -736,6 +777,7 @@ class CostReport:
                 "transcendentals": self.total_transcendentals,
                 "bytes": self.total_bytes,
                 "comm_bytes": self.total_comm_bytes,
+                "host_bytes": self.total_host_bytes,
                 "time_s": self.total_time_s,
                 "arithmetic_intensity": self.arithmetic_intensity,
                 "op_count": len(self.entries),
@@ -748,24 +790,29 @@ class CostReport:
 
     def format(self, top=10):
         comm = self.total_comm_bytes
+        host = self.total_host_bytes
         lines = [
-            "program cost on %r: %.2f GFLOP, %.1f MB moved%s, "
+            "program cost on %r: %.2f GFLOP, %.1f MB moved%s%s, "
             "est %.3f ms (%s-leaning, intensity %.1f FLOP/B)" % (
                 self.chip.name, self.total_flops / 1e9,
                 self.total_bytes / 1e6,
                 ", %.2f MB collective wire" % (comm / 1e6) if comm else "",
+                ", %.2f MB host exchange" % (host / 1e6) if host else "",
                 self.total_time_s * 1e3,
                 "compute" if self.arithmetic_intensity
                 >= self.chip.peak_flops / self.chip.hbm_bw else "memory",
                 self.arithmetic_intensity),
         ]
         for g in self.by_op_type()[:top]:
+            extra = ""
+            if g.get("comm_bytes"):
+                extra += "  %.2f MB wire" % (g["comm_bytes"] / 1e6)
+            if g.get("host_bytes"):
+                extra += "  %.2f MB host" % (g["host_bytes"] / 1e6)
             lines.append(
                 "  %-28s x%-4d %10.2f MFLOP %10.2f MB %8.1f us%s" % (
                     g["op_type"], g["count"], g["flops"] / 1e6,
-                    g["bytes"] / 1e6, g["time_s"] * 1e6,
-                    "  %.2f MB wire" % (g["comm_bytes"] / 1e6)
-                    if g.get("comm_bytes") else ""))
+                    g["bytes"] / 1e6, g["time_s"] * 1e6, extra))
         return "\n".join(lines)
 
 

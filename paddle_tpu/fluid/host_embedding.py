@@ -21,13 +21,50 @@ shard layout).  Per step:
 
 The device never sees more than the touched rows — the table can exceed
 HBM by orders of magnitude.  `layers.embedding(..., is_distributed=True)`
-builds this path automatically; drive steps through
-:class:`HostEmbeddingSession`.
+builds this path automatically.
+
+Three engines drive the cycle (recsys-scale online learning, SURVEY
+§2.1/§2.3 — the DownpourWorker FillSparseValue -> train -> push_sparse
+overlap):
+
+* `HostEmbeddingSession` — the synchronous reference path (blocking
+  pull -> device step -> blocking push), the parity oracle;
+* `PipelinedHostEmbeddingSession` — a background host worker prefetches
+  batch t+1's rows and applies batch t-1's push WHILE the device
+  computes batch t (double-buffered).  Exactness is preserved: FIFO
+  ordering means the prefetched pull can miss at most the immediately-
+  preceding push, so rows touched twice in flight (uniq(t) ∩
+  uniq(t-1)) are detected and re-gathered after that push lands — a
+  barrier for only the conflicting rows, bit-identical to the
+  synchronous path (``exact=False`` trades that patch for bounded
+  one-step staleness on the conflicting rows);
+* `HotRowCache` — an HBM-resident LFU cache of the hottest rows with
+  batch-local remap: cache hits skip the host exchange entirely,
+  evicted dirty rows write back to the host shard, `flush()` runs
+  before every checkpoint snapshot.
+
+Multi-process exchange is owner-partitioned request/response (traffic
+∝ unique pulled rows, not nproc²·P): round 1 all-gathers the id
+requests, round 2 each owner publishes one deduped response row per
+unique owned request; every rank derives each owner's response ordering
+locally from round 1, so no index traffic moves.  Duplicate gradients
+merge through one flattened `np.bincount` pass.
 """
 
 from __future__ import annotations
 
+import threading
+import time
+
 import numpy as np
+
+__all__ = [
+    "HostEmbedding",
+    "HostEmbeddingSession",
+    "PipelinedHostEmbeddingSession",
+    "HotRowCache",
+    "HostEmbeddingStats",
+]
 
 
 def _bucket(n):
@@ -54,12 +91,347 @@ def _global_bucket(n):
     return _bucket(int(counts.max()))
 
 
+def _npz_path(path):
+    """`np.savez` silently appends ``.npz`` when the path lacks it; every
+    save/load site routes through this one helper so the writer and the
+    reader always agree on the real filename."""
+    p = str(path)
+    return p if p.endswith(".npz") else p + ".npz"
+
+
+def _bincount_merge(pos, grads, n_rows, dim):
+    """Sum duplicate gradient rows: `pos` maps each grad row to its
+    merged row index; one flattened `np.bincount` pass does the whole
+    [N, D] scatter-add.  Accumulation is float64 inside bincount, cast
+    back to f32 — deterministic regardless of duplicate order."""
+    pos = np.asarray(pos, np.int64)
+    idx = (pos[:, None] * dim + np.arange(dim, dtype=np.int64)[None, :])
+    return np.bincount(
+        idx.ravel(), weights=np.asarray(grads, np.float64).ravel(),
+        minlength=int(n_rows) * dim).reshape(
+            int(n_rows), dim).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+_LBL = ("table",)
+
+
+class HostEmbeddingStats:
+    """Always-on labeled metrics for one host table: PR-4 registry
+    families with ``table=<instance>`` label children (the
+    io.stats.PipelineStats pattern — every table is visible at /metrics
+    while each instance keeps independent series)."""
+
+    def __init__(self, name, registry=None):
+        from ..observability.metrics import (default_registry,
+                                             unique_instance_label)
+
+        reg = registry or default_registry()
+        self.registry = reg
+        self.instance_label = unique_instance_label(name)
+        lab = (self.instance_label,)
+        self.pull_ms = reg.histogram(
+            "hostemb_pull_ms", "Host-embedding pull wall time (ms)",
+            labelnames=_LBL).labels(*lab)
+        self.push_ms = reg.histogram(
+            "hostemb_push_ms", "Host-embedding push wall time (ms)",
+            labelnames=_LBL).labels(*lab)
+        self.exchange_ms = reg.histogram(
+            "hostemb_exchange_ms",
+            "Host shard-exchange (gather/scatter) wall time (ms)",
+            labelnames=_LBL).labels(*lab)
+        self.exchange_bytes = reg.counter(
+            "hostemb_exchange_bytes_total",
+            "Bytes moved through the host row exchange (pull rows + "
+            "pushed gradient rows + id traffic)",
+            labelnames=_LBL).labels(*lab)
+        self.unique_ratio = reg.histogram(
+            "hostemb_unique_ratio",
+            "Unique ids / batch ids per pull (low = heavy reuse)",
+            labelnames=_LBL,
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+        ).labels(*lab)
+        self.cache_hits = reg.counter(
+            "hostemb_cache_hits_total",
+            "Pulled rows served by the hot-row device cache",
+            labelnames=_LBL).labels(*lab)
+        self.cache_misses = reg.counter(
+            "hostemb_cache_misses_total",
+            "Pulled rows that went through the host exchange",
+            labelnames=_LBL).labels(*lab)
+        self.cache_hit_rate = reg.gauge(
+            "hostemb_cache_hit_rate",
+            "Lifetime hit fraction of the hot-row cache",
+            labelnames=_LBL).labels(*lab)
+        self.cache_staleness = reg.histogram(
+            "hostemb_cache_staleness_steps",
+            "Steps since a hit row was last touched (refresh age)",
+            labelnames=_LBL,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, float("inf"))
+        ).labels(*lab)
+        self.pipeline_conflicts = reg.counter(
+            "hostemb_pipeline_conflicts_total",
+            "Pipelined steps that re-gathered conflicting rows (uniq "
+            "overlap with the in-flight push)",
+            labelnames=_LBL).labels(*lab)
+
+    def close(self):
+        from ..observability.metrics import release_instance_label
+
+        try:
+            release_instance_label(self.instance_label)
+        except Exception:
+            pass
+
+
+def _trace_span(name, **args):
+    """A hostemb trace span on the PR-6 tracer; the disabled path is the
+    tracer's shared no-op context (step_timer's lazy-import idiom)."""
+    from ..observability import trace as _trace
+
+    return _trace.default_tracer().span(name, cat="hostemb",
+                                        args=args or None)
+
+
+# ---------------------------------------------------------------------------
+# hot-row device cache
+# ---------------------------------------------------------------------------
+
+
+class HotRowCache:
+    """HBM-resident LFU cache of the hottest rows of one table.
+
+    Cached rows live authoritatively in the cache (the host shard is
+    STALE for them) — hits skip the host exchange entirely; the pulled
+    buffer is assembled ON DEVICE from the resident [C+1, D] cache
+    array plus a host buffer carrying only the miss rows, through one
+    shape-stable gather+where (compile count bounded by the pull-bucket
+    ladder, never by the hit pattern).  Updates land in the host mirror
+    and the device copy is refreshed lazily as one [C+1, D] upload on
+    the next assemble (TODO: scatter-refresh on TPU once pallas
+    dynamic-update-slice is wired — the full refresh is the CPU-smoke
+    trade).  Evicted rows write back to the host shard; `flush()`
+    writes everything back (checkpoint snapshots call it).
+
+    Single-process only: per-rank caches of peer-owned rows would need
+    a coherence protocol the exchange does not speak yet.
+
+    ``device_resident=None`` (default) keeps the [C+1, D] values array
+    in device memory only on a real accelerator; on the CPU backend
+    "device" and host are the same silicon, so hits are assembled from
+    the host mirror directly (identical values, none of the fake-
+    device dispatch overhead — the CPU-smoke measurement then isolates
+    the exchange savings, which is what the cache is for).
+    """
+
+    def __init__(self, table, capacity, device_resident=None):
+        if table.nproc > 1:
+            raise ValueError(
+                "HotRowCache requires a single-process table: per-rank "
+                "caches of peer-owned rows would serve stale values "
+                "without cross-rank invalidation")
+        if device_resident is None:
+            import jax
+
+            device_resident = jax.default_backend() != "cpu"
+        self.device_resident = bool(device_resident)
+        self.table = table
+        self.capacity = max(int(capacity), 1)
+        # cross-lane coherence: the pull lane owns index mutation
+        # (insert/evict, serial with itself), the push lane reads the
+        # index and writes values — the lock keeps index+value reads
+        # consistent and makes eviction write-back atomic vs peeks
+        self.lock = threading.RLock()
+        C, D = self.capacity, table.dim
+        self._ids = np.full(C, -1, np.int64)          # -1 = empty slot
+        self._freq = np.zeros(C, np.int64)
+        self._host = np.zeros((C + 1, D), table.dtype)  # [C]=zero sentinel
+        # sorted-id index (vectorized lookups: a per-id python dict walk
+        # costs more than the exchange it saves at recsys batch sizes)
+        self._sorted_ids = np.zeros(0, np.int64)
+        self._sorted_slots = np.zeros(0, np.int64)
+        self._last_touch = np.zeros(C, np.int64)
+        self._dev = None                              # lazy [C+1, D]
+        self._dirty_dev = True
+        self._step = 0
+        self.hits = 0
+        self.misses = 0
+
+    # -- device copy -----------------------------------------------------
+    def _device_values(self):
+        import jax.numpy as jnp
+
+        if self._dev is None or self._dirty_dev:
+            self._dev = jnp.asarray(self._host)
+            self._dirty_dev = False
+        return self._dev
+
+    @property
+    def hit_rate(self):
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def _reindex(self):
+        live = np.flatnonzero(self._ids >= 0)
+        order = np.argsort(self._ids[live], kind="stable")
+        self._sorted_ids = self._ids[live][order]
+        self._sorted_slots = live[order]
+
+    def _lookup(self, uniq):
+        uniq = np.asarray(uniq, np.int64)
+        pos = np.searchsorted(self._sorted_ids, uniq)
+        pos_c = np.minimum(pos, max(len(self._sorted_ids) - 1, 0))
+        hit = ((pos < len(self._sorted_ids))
+               & (self._sorted_ids[pos_c] == uniq)
+               if len(self._sorted_ids)
+               else np.zeros(len(uniq), bool))
+        slots = np.where(hit, self._sorted_slots[pos_c]
+                         if len(self._sorted_slots)
+                         else -1, -1)
+        return hit, slots
+
+    def _evict_for(self, need, protect):
+        """Free `need` slots, preferring empty then lowest-freq slots
+        not in `protect` (the current batch); evicted rows write back
+        to the host shard.  Returns the freed slot indices."""
+        empty = np.flatnonzero(self._ids < 0)
+        if len(empty) >= need:
+            return empty[:need]
+        eligible = np.flatnonzero(
+            (self._ids >= 0)
+            & ~np.isin(self._ids, protect, assume_unique=False))
+        order = eligible[np.argsort(self._freq[eligible], kind="stable")]
+        victims = order[: need - len(empty)]
+        if len(victims):
+            vids = self._ids[victims]
+            self.table._writeback_rows(vids, self._host[victims])
+            self._ids[victims] = -1
+            self._freq[victims] = 0
+        return np.concatenate([empty, victims])
+
+    def assemble(self, uniq, P, stats=None):
+        """Pulled [P, D] device buffer for sorted-unique `uniq`: hits
+        read the resident cache rows, misses go through the table's
+        host exchange and are inserted (LFU eviction).  Pull-lane
+        only (index mutation is single-threaded); the exchange runs
+        OUTSIDE the lock so a concurrent push never waits on wire
+        time."""
+        import jax.numpy as jnp
+
+        D = self.table.dim
+        with self.lock:
+            self._step += 1
+            hit, slots = self._lookup(uniq)
+            n_hit = int(hit.sum())
+            if stats is not None and n_hit:
+                stats.cache_hits.inc(n_hit)
+                ages = self._step - self._last_touch[slots[hit]]
+                stats.cache_staleness.observe(float(ages.mean()))
+        n_miss = len(uniq) - n_hit
+        if stats is not None and n_miss:
+            stats.cache_misses.inc(n_miss)
+        self.hits += n_hit
+        self.misses += n_miss
+        host_buf = np.zeros((P, D), self.table.dtype)
+        if n_miss:
+            miss_ids = uniq[~hit]
+            rows = self.table._fetch_rows(miss_ids)
+            host_buf[np.flatnonzero(~hit)] = rows
+        with self.lock:
+            if n_miss:
+                # insert the misses so the NEXT pull of these rows
+                # hits: at most `capacity` of them (a giant cold batch
+                # cannot thrash the whole cache through itself), and
+                # only as many as eviction could actually free (slots
+                # holding rows of THIS batch are protected)
+                freed = self._evict_for(min(n_miss, self.capacity),
+                                        uniq)
+                take = len(freed)
+                ins_ids = miss_ids[:take]
+                self._ids[freed] = ins_ids
+                self._freq[freed] = 0
+                # re-read the shard UNDER the lock: a concurrent push
+                # may have updated these rows after the fetch above,
+                # and the cache copy becomes authoritative on insert
+                self._host[freed] = self.table._rows[
+                    ins_ids // self.table.nproc]
+                self._last_touch[freed] = self._step
+                self._reindex()
+                self._dirty_dev = True
+                # the inserted rows now live in the cache; re-resolve
+                # so they are served like any other hit
+                hit, slots = self._lookup(uniq)
+            self._freq[slots[hit]] += 1
+            self._last_touch[slots[hit]] = self._step
+            # sel[j] = cache slot of uniq[j], or C (zero sentinel) for
+            # rows still outside the cache / padding
+            sel = np.full(P, self.capacity, np.int64)
+            sel[: len(uniq)][hit] = slots[hit]
+            if self.device_resident:
+                dev = self._device_values()
+                sel_d = jnp.asarray(sel)
+                pulled = jnp.where((sel_d < self.capacity)[:, None],
+                                   jnp.take(dev, sel_d, axis=0),
+                                   jnp.asarray(host_buf))
+            else:
+                # CPU-smoke assembly: hits read the host mirror in
+                # place (same values the device array would carry)
+                pulled = host_buf
+                cached_pos = np.flatnonzero(sel < self.capacity)
+                if cached_pos.size:
+                    pulled[cached_pos] = self._host[sel[cached_pos]]
+        if stats is not None:
+            stats.cache_hit_rate.set(self.hit_rate)
+        return pulled
+
+    # -- update/write-back ----------------------------------------------
+    def cached_mask(self, ids):
+        with self.lock:
+            mask, _ = self._lookup(np.asarray(ids, np.int64))
+        return mask
+
+    def read_rows(self, ids):
+        with self.lock:
+            _mask, slots = self._lookup(np.asarray(ids, np.int64))
+            return self._host[slots]    # caller guarantees all cached
+
+    def update_rows(self, ids, values):
+        with self.lock:
+            _mask, slots = self._lookup(np.asarray(ids, np.int64))
+            self._host[slots] = values
+            self._last_touch[slots] = self._step
+            self._dirty_dev = True
+
+    def flush(self):
+        """Write every cached row back to the host shard (rows stay
+        cached and become clean — `table._rows` equals the mirror)."""
+        with self.lock:
+            live = np.flatnonzero(self._ids >= 0)
+            if len(live):
+                self.table._writeback_rows(self._ids[live],
+                                           self._host[live])
+
+    def metrics(self):
+        return {"capacity": self.capacity, "hits": self.hits,
+                "misses": self.misses, "hit_rate": self.hit_rate,
+                "resident": int((self._ids >= 0).sum())}
+
+
+# ---------------------------------------------------------------------------
+# the table
+# ---------------------------------------------------------------------------
+
+
 class HostEmbedding:
     """One host-resident row-sharded table + its optimizer state."""
 
     def __init__(self, name, num_rows, dim, dtype="float32",
                  optimizer="adagrad", lr=0.05, init_scale=0.01, seed=0,
-                 epsilon=1e-6, padding_idx=None):
+                 epsilon=1e-6, padding_idx=None, transport_latency_s=0.0,
+                 transport_bw_bytes_s=None):
         import jax
 
         self.name = name
@@ -71,6 +443,14 @@ class HostEmbedding:
         self.epsilon = float(epsilon)
         self.nproc = jax.process_count()
         self.rank = jax.process_index()
+        # single-process drills/benches can model the DCN pull/push RPC
+        # of a real multi-host exchange: a flat per-exchange round-trip
+        # latency plus a bytes/bandwidth term (GIL-released sleep, so a
+        # pipelined worker genuinely overlaps it).  Cache hits never pay
+        # either — they never exchange.
+        self.transport_latency_s = float(transport_latency_s)
+        self.transport_bw_bytes_s = (float(transport_bw_bytes_s)
+                                     if transport_bw_bytes_s else None)
         # padding row: always reads zeros, never updates (reference
         # lookup_table padding_idx semantics carried into the host table)
         self.padding_idx = (None if padding_idx is None
@@ -84,109 +464,309 @@ class HostEmbedding:
             self._accum = np.zeros((n_owned, self.dim), np.float32)
         elif optimizer != "sgd":
             raise ValueError("host optimizer must be sgd or adagrad")
+        self.cache = None
+        self.stats = None
+        # global ids whose rows changed since the last delta checkpoint
+        # (streaming.DeltaCheckpointer drains this via collect_touched).
+        # Tracking is OPT-IN: without a consumer draining the set, a
+        # long trainer would accumulate one id array per push forever
+        self.track_touched = False
+        self._touched_chunks = []
+
+    # -- observability ---------------------------------------------------
+    def enable_stats(self, registry=None):
+        """Attach (or re-attach) the PR-4 metric families."""
+        if self.stats is not None:
+            self.stats.close()
+        self.stats = HostEmbeddingStats(self.name, registry=registry)
+        return self.stats
+
+    def attach_cache(self, capacity):
+        """Attach an LFU hot-row device cache (single-process only)."""
+        self.cache = HotRowCache(self, capacity)
+        return self.cache
+
+    def flush_cache(self):
+        """Write cached rows back to the host shard; checkpoint
+        snapshots call this so `_rows` is always the full truth."""
+        if self.cache is not None:
+            self.cache.flush()
+
+    def _note_touched(self, uniq):
+        if not self.track_touched:
+            return
+        self._touched_chunks.append(np.asarray(uniq, np.int64).copy())
+        if len(self._touched_chunks) > 64:
+            # compact: memory stays O(unique touched), not O(steps)
+            self._touched_chunks = [
+                np.unique(np.concatenate(self._touched_chunks))]
+
+    def collect_touched(self, reset=True):
+        """Sorted unique global row ids pushed since the last collect."""
+        if not self._touched_chunks:
+            return np.zeros(0, np.int64)
+        out = np.unique(np.concatenate(self._touched_chunks))
+        if reset:
+            self._touched_chunks = []
+        return out
+
+    def _simulate_transport(self, nbytes=0):
+        if self.nproc != 1:
+            return
+        wait = self.transport_latency_s
+        if self.transport_bw_bytes_s:
+            wait += nbytes / self.transport_bw_bytes_s
+        if wait > 0:
+            time.sleep(wait)
+
+    def _validate_ids(self, ids, what):
+        if ids.size and (ids.min() < 0 or ids.max() >= self.num_rows):
+            raise IndexError(
+                "embedding id out of range [0, %d) in %s of %s"
+                % (self.num_rows, what, self.name))
 
     # -- sharded row access ---------------------------------------------
-    def _gather_rows(self, uniq):
-        """uniq (sorted unique global row ids) -> [len(uniq), D].
+    @staticmethod
+    def _owner_requests(all_req, nproc):
+        """Each owner's deduped sorted response id list, derived
+        identically on every rank from the round-1 request gather."""
+        flat = all_req.reshape(-1)
+        valid = flat[flat >= 0]
+        return [np.unique(valid[valid % nproc == r]) for r in range(nproc)]
 
-        Multi-process: every process owns rows r % nproc == rank; the
-        exchange all-gathers each rank's request and each rank's owned
-        responses (traffic = total pulled rows — the pslib pull RPC
-        without a transport layer)."""
-        if self.nproc == 1:
-            return self._rows[uniq]
+    def _exchange_pull(self, uniq):
+        """Owner-partitioned pull (sorted unique ids -> [len, D]).
+
+        Round 1 all-gathers the (padded) id requests — bytes ∝
+        requested ids.  Round 2: each owner answers one row per unique
+        owned requested id, padded to the bucketed max owner load —
+        bytes ∝ unique pulled rows.  The old exchange all-gathered a
+        [nproc, nproc·P, D] answers-for-everyone matrix (O(nproc²·P·D));
+        this one derives every owner's response ordering locally, so
+        only the rows themselves move."""
         from jax.experimental import multihost_utils
 
-        # 1 round: gather every rank's (padded) request list
         P = _global_bucket(len(uniq))
         req = np.full((P,), -1, np.int64)
         req[: len(uniq)] = uniq
         all_req = np.asarray(multihost_utils.process_allgather(req))
-        # answer what we own, for all requests
-        flat = all_req.reshape(-1)
-        mine = (flat >= 0) & (flat % self.nproc == self.rank)
-        ans = np.zeros((flat.shape[0], self.dim), self.dtype)
-        ans[mine] = self._rows[flat[mine] // self.nproc]
-        all_ans = np.asarray(multihost_utils.process_allgather(ans))
-        # rows for MY request: sum over the responder axis (only the owner
-        # wrote non-zero), slice my block
-        summed = all_ans.sum(axis=0).reshape(all_req.shape + (self.dim,))
-        return summed[self.rank][: len(uniq)]
+        per_owner = self._owner_requests(all_req, self.nproc)
+        Q = _bucket(max(max((len(x) for x in per_owner), default=1), 1))
+        resp = np.zeros((Q, self.dim), self.dtype)
+        mine = per_owner[self.rank]
+        resp[: len(mine)] = self._rows[mine // self.nproc]
+        all_resp = np.asarray(multihost_utils.process_allgather(resp))
+        out = np.empty((len(uniq), self.dim), self.dtype)
+        owners = uniq % self.nproc
+        for r in range(self.nproc):
+            sel = owners == r
+            if sel.any():
+                pos = np.searchsorted(per_owner[r], uniq[sel])
+                out[sel] = all_resp[r][pos]
+        if self.stats is not None:
+            self.stats.exchange_bytes.inc(
+                self.nproc * (P * 8 + Q * self.dim * self.dtype.itemsize))
+        return out
+
+    def _fetch_rows(self, uniq):
+        """Current values of sorted-unique global ids from the host
+        shards (the exchange path — the part a cache hit skips).  Does
+        NOT consult the cache: callers route cached ids elsewhere."""
+        t0 = time.perf_counter()
+        self._simulate_transport(
+            int(uniq.size) * (8 + self.dim * self.dtype.itemsize))
+        if self.nproc == 1:
+            rows = self._rows[uniq]
+            if self.stats is not None:
+                self.stats.exchange_bytes.inc(
+                    int(uniq.size) * (8 + self.dim * self.dtype.itemsize))
+        else:
+            rows = self._exchange_pull(uniq)
+        if self.stats is not None:
+            self.stats.exchange_ms.observe(
+                (time.perf_counter() - t0) * 1e3)
+        return rows
+
+    def _peek_rows(self, uniq, simulate_transport=True):
+        """Current values honoring the cache: cached rows read the
+        mirror, the rest the shard.  The pipelined conflict re-gather
+        passes ``simulate_transport=False``: the rows it refetches are
+        exactly the ones THIS rank just pushed, and a real owner-
+        partitioned push RPC returns the updated values in its response
+        (push-and-refetch) — no extra round trip to model."""
+        uniq = np.asarray(uniq, np.int64)
+        if self.cache is None:
+            if self.nproc == 1 and not simulate_transport:
+                return self._rows[uniq]      # advanced indexing: a copy
+            return self._fetch_rows(uniq)
+        with self.cache.lock:
+            mask = self.cache.cached_mask(uniq)
+            out = np.empty((len(uniq), self.dim), self.dtype)
+            if mask.any():
+                out[mask] = self.cache.read_rows(uniq[mask])
+            if (~mask).any():
+                miss = uniq[~mask]
+                out[~mask] = (self._rows[miss]
+                              if self.nproc == 1
+                              and not simulate_transport
+                              else self._fetch_rows(miss))
+        return out
+
+    def _writeback_rows(self, ids, values):
+        """Scatter evicted/flushed cache rows into the owned shard."""
+        ids = np.asarray(ids, np.int64)
+        own = ids % self.nproc == self.rank
+        self._rows[ids[own] // self.nproc] = values[own]
 
     # -- step API --------------------------------------------------------
     def pull(self, ids):
         """ids: int array [...] -> (pulled [P, D], local_ids like ids,
-        uniq).  local_ids index into pulled."""
+        uniq).  local_ids index into pulled.  `pulled` is a numpy array
+        on the plain path and a device-resident jax array when a
+        HotRowCache is attached (Executor feeds both without copies)."""
+        t0 = time.perf_counter()
         ids = np.asarray(ids)
         uniq, inv = np.unique(ids, return_inverse=True)
-        if uniq.size and (uniq[0] < 0 or uniq[-1] >= self.num_rows):
-            raise IndexError(
-                "embedding id out of range [0, %d) in %s"
-                % (self.num_rows, self.name))
+        self._validate_ids(uniq, "pull")
         P = _bucket(max(len(uniq), 1))
-        pulled = np.zeros((P, self.dim), self.dtype)
-        if uniq.size or self.nproc > 1:
-            # nproc>1: join the exchange even with zero local ids — peers
-            # are blocked in the same collective and a rank that skipped
-            # it would hang them
-            rows = self._gather_rows(uniq)
-            if uniq.size:
-                pulled[: len(uniq)] = rows
-                if self.padding_idx is not None:
-                    pulled[: len(uniq)][uniq == self.padding_idx] = 0
+        with _trace_span("hostemb.pull", table=self.name,
+                         uniq=int(uniq.size), bucket=P):
+            if self.cache is not None:
+                pulled = self.cache.assemble(uniq, P, stats=self.stats)
+                if uniq.size and self.padding_idx is not None:
+                    pad = np.flatnonzero(uniq == self.padding_idx)
+                    if pad.size:
+                        if isinstance(pulled, np.ndarray):
+                            pulled[pad] = 0
+                        else:
+                            import jax.numpy as jnp
+
+                            pulled = pulled.at[pad].set(jnp.zeros(
+                                (pad.size, self.dim), pulled.dtype))
+            else:
+                pulled = np.zeros((P, self.dim), self.dtype)
+                if uniq.size or self.nproc > 1:
+                    # nproc>1: join the exchange even with zero local
+                    # ids — peers are blocked in the same collective and
+                    # a rank that skipped it would hang them
+                    rows = self._fetch_rows(uniq)
+                    if uniq.size:
+                        pulled[: len(uniq)] = rows
+                        if self.padding_idx is not None:
+                            pulled[: len(uniq)][uniq == self.padding_idx] = 0
+        if self.stats is not None:
+            self.stats.pull_ms.observe((time.perf_counter() - t0) * 1e3)
+            if ids.size:
+                self.stats.unique_ratio.observe(uniq.size / ids.size)
         return pulled, inv.reshape(ids.shape).astype(np.int64), uniq
+
+    def _exchange_push(self, uniq, g):
+        """Owner-partitioned gradient scatter: all-gather (id, grad)
+        rows — bytes ∝ pushed rows — then each owner keeps its own and
+        merges duplicates with one bincount pass."""
+        from jax.experimental import multihost_utils
+
+        P = _global_bucket(len(uniq))
+        req = np.full((P,), -1, np.int64)
+        req[: len(uniq)] = uniq
+        gpad = np.zeros((P, self.dim), np.float32)
+        gpad[: len(uniq)] = g
+        all_req = np.asarray(multihost_utils.process_allgather(req))
+        all_g = np.asarray(multihost_utils.process_allgather(gpad))
+        flat = all_req.reshape(-1)
+        flatg = all_g.reshape(-1, self.dim)
+        mine = (flat >= 0) & (flat % self.nproc == self.rank)
+        ids_mine, g_mine = flat[mine], flatg[mine]
+        merged_ids = np.unique(ids_mine)
+        pos = np.searchsorted(merged_ids, ids_mine)
+        merged = _bincount_merge(pos, g_mine, len(merged_ids), self.dim)
+        if self.stats is not None:
+            self.stats.exchange_bytes.inc(
+                self.nproc * P * (8 + self.dim * 4))
+        return merged_ids, merged
 
     def push(self, uniq, grad_rows, lr=None):
         """Apply the host-side optimizer to the touched rows.  grad_rows:
         [len(uniq), D] dense gradient for the pulled rows."""
+        t0 = time.perf_counter()
         lr = self.lr if lr is None else float(lr)
-        uniq = np.asarray(uniq)
+        uniq = np.asarray(uniq, np.int64)
+        self._validate_ids(uniq, "push")
         g = np.asarray(grad_rows, np.float32)[: len(uniq)]
-        own = uniq % self.nproc == self.rank
-        if self.nproc > 1:
-            # every rank computed the same grads for its batch only; sum
-            # contributions across ranks for shared rows
-            from jax.experimental import multihost_utils
+        with _trace_span("hostemb.push", table=self.name,
+                         uniq=int(uniq.size)):
+            self._push_impl(uniq, g, lr)
+        if self.stats is not None:
+            self.stats.push_ms.observe((time.perf_counter() - t0) * 1e3)
 
-            # exchange (uniq, grad) pairs via the same gather trick
-            P = _global_bucket(len(uniq))
-            req = np.full((P,), -1, np.int64)
-            req[: len(uniq)] = uniq
-            gpad = np.zeros((P, self.dim), np.float32)
-            gpad[: len(uniq)] = g
-            all_req = np.asarray(multihost_utils.process_allgather(req))
-            all_g = np.asarray(multihost_utils.process_allgather(gpad))
-            flat = all_req.reshape(-1)
-            flatg = all_g.reshape(-1, self.dim)
-            mine = (flat >= 0) & (flat % self.nproc == self.rank)
-            uniq, g = flat[mine], flatg[mine]
-            # merge duplicate global rows
-            uniq, inv = np.unique(uniq, return_inverse=True)
-            merged = np.zeros((len(uniq), self.dim), np.float32)
-            np.add.at(merged, inv, g)
-            g = merged
+    def _push_impl(self, uniq, g, lr):
+        own = uniq % self.nproc == self.rank
+        cache = self.cache
+        if self.nproc > 1:
+            t0 = time.perf_counter()
+            uniq, g = self._exchange_push(uniq, g)
+            if self.stats is not None:
+                self.stats.exchange_ms.observe(
+                    (time.perf_counter() - t0) * 1e3)
             own = np.ones(len(uniq), bool)
+        else:
+            # only UNCACHED rows cross the modeled link: cached rows
+            # are authoritative in the cache (write-back on eviction)
+            cached_all = (cache.cached_mask(uniq)
+                          if cache is not None else None)
+            n_wire = int(uniq.size if cached_all is None
+                         else (~cached_all).sum())
+            if n_wire:
+                self._simulate_transport(n_wire * (8 + self.dim * 4))
         if self.padding_idx is not None:
             own = own & (uniq != self.padding_idx)
-        local = uniq[own] // self.nproc
+        ids = uniq[own]
+        local = ids // self.nproc
         gl = g[own]
+        self._note_touched(ids)
+        if cache is not None:
+            # the authoritative mask is re-read INSIDE the lock and the
+            # whole read-modify-write holds it: a concurrent pull-lane
+            # insert/evict between mask and write would otherwise strand
+            # this update in a dead slot (the wire-billing mask above
+            # may legitimately be a step stale; this one may not be)
+            with cache.lock:
+                self._apply_update(ids, local, gl, lr,
+                                   cache.cached_mask(ids), cache)
+        else:
+            self._apply_update(ids, local, gl, lr,
+                               np.zeros(len(ids), bool), None)
+
+    def _apply_update(self, ids, local, gl, lr, cached, cache):
+        # current values: cached rows read the (authoritative) mirror,
+        # the rest the shard — the update math is identical either way,
+        # so cache on/off stays bit-identical
+        cur = np.empty((len(ids), self.dim), self.dtype)
+        if cached.any():
+            cur[cached] = cache.read_rows(ids[cached])
+        if (~cached).any():
+            cur[~cached] = self._rows[local[~cached]]
         if self.optimizer == "adagrad":
             self._accum[local] += gl * gl
-            self._rows[local] -= (
-                lr * gl / (np.sqrt(self._accum[local]) + self.epsilon)
-            ).astype(self.dtype)
+            new = cur - (lr * gl / (np.sqrt(self._accum[local])
+                                    + self.epsilon)).astype(self.dtype)
         else:  # sgd
-            self._rows[local] -= (lr * gl).astype(self.dtype)
+            new = cur - (lr * gl).astype(self.dtype)
+        if cached.any():
+            cache.update_rows(ids[cached], new[cached])
+        if (~cached).any():
+            self._rows[local[~cached]] = new[~cached]
 
     # -- persistence (fleet SaveModel capability) ------------------------
     def save(self, path):
-        np.savez(path, rows=self._rows,
+        self.flush_cache()
+        np.savez(_npz_path(path), rows=self._rows,
                  accum=getattr(self, "_accum", np.zeros(0)),
                  meta=np.asarray([self.num_rows, self.dim, self.rank,
                                   self.nproc]))
 
     def load(self, path):
-        d = np.load(path if str(path).endswith(".npz") else str(path) + ".npz")
+        d = np.load(_npz_path(path))
         meta = d["meta"] if "meta" in d.files else None
         if meta is not None and int(meta[3]) != self.nproc:
             raise ValueError(
@@ -198,6 +778,15 @@ class HostEmbedding:
         self._rows = d["rows"]
         if self.optimizer == "adagrad" and d["accum"].size:
             self._accum = d["accum"]
+        self._drop_cache_values()
+
+    def _drop_cache_values(self):
+        """After a load/restore the shard is the truth; a live cache
+        would serve pre-restore values, so re-seed it empty."""
+        if self.cache is not None:
+            self.cache = HotRowCache(
+                self, self.cache.capacity,
+                device_resident=self.cache.device_resident)
 
     def load_resharded(self, shard_paths):
         """Elastic restore: rebuild THIS rank's rows from the complete
@@ -208,7 +797,7 @@ class HostEmbedding:
         shards = {}
         old_nranks = None
         for old_rank, p in shard_paths.items():
-            d = np.load(p if str(p).endswith(".npz") else str(p) + ".npz")
+            d = np.load(_npz_path(p))
             shards[int(old_rank)] = (d["rows"], d["accum"])
             if "meta" in d.files:
                 saved = int(d["meta"][3])
@@ -229,12 +818,99 @@ class HostEmbedding:
         self._rows = rows.astype(self.dtype, copy=False)
         if self.optimizer == "adagrad" and accum.size:
             self._accum = accum.astype(np.float32, copy=False)
+        self._drop_cache_values()
+
+    def export_rows(self):
+        """The FULL [num_rows, D] table (all shards), for materializing
+        a dense serving copy of a small/test table or an export slice.
+        Production push-to-serving ships delta rows to an embedding
+        service instead — this is the drill/bench-scale path."""
+        self.flush_cache()
+        if self.nproc == 1:
+            return self._rows.copy()
+        from jax.experimental import multihost_utils
+
+        n_max = (self.num_rows + self.nproc - 1) // self.nproc
+        pad = np.zeros((n_max, self.dim), self.dtype)
+        pad[: self._rows.shape[0]] = self._rows
+        shards = np.asarray(multihost_utils.process_allgather(pad))
+        full = np.zeros((self.num_rows, self.dim), self.dtype)
+        for r in range(self.nproc):
+            n_r = (self.num_rows - r + self.nproc - 1) // self.nproc
+            full[r::self.nproc] = shards[r][:n_r]
+        return full
+
+    # -- delta persistence (streaming online learning) -------------------
+    def _read_owned_rows(self, own):
+        """Current values of OWNED ids, honoring the cache mirror — a
+        pure local read: no exchange, no simulated transport, no
+        exchange metrics (this is a checkpoint read, not a pull)."""
+        rows = self._rows[own // self.nproc]     # advanced indexing: copy
+        if self.cache is not None and own.size:
+            with self.cache.lock:
+                mask = self.cache.cached_mask(own)
+                if mask.any():
+                    rows[mask] = self.cache.read_rows(own[mask])
+        return rows
+
+    def delta_payload(self, touched=None):
+        """(own_ids, rows, accum, meta) for the touched rows — the one
+        delta format both `save_delta` and the streaming
+        DeltaCheckpointer serialize."""
+        ids = (np.asarray(touched, np.int64) if touched is not None
+               else self.collect_touched(reset=False))
+        own = ids[ids % self.nproc == self.rank]
+        vals = (self._read_owned_rows(own) if own.size
+                else np.zeros((0, self.dim), self.dtype))
+        accum = (self._accum[own // self.nproc].copy()
+                 if hasattr(self, "_accum") and own.size
+                 else np.zeros((0, self.dim), np.float32))
+        meta = np.asarray([self.num_rows, self.dim, self.rank,
+                           self.nproc])
+        return own, vals, accum, meta
+
+    def apply_delta_arrays(self, ids, rows, accum, saved_nproc=None):
+        """Replay one delta payload: scatter its rows into the shard.
+        Validates the save-time layout — deltas do not reshard."""
+        if saved_nproc is not None and int(saved_nproc) != self.nproc:
+            raise ValueError(
+                "delta for table %r was saved with nproc=%d but this "
+                "run has nproc=%d — deltas do not reshard; restart "
+                "from the chain's full snapshot on the old topology"
+                % (self.name, int(saved_nproc), self.nproc))
+        ids = np.asarray(ids, np.int64)
+        if ids.size:
+            self._writeback_rows(ids, rows)
+            if hasattr(self, "_accum") and accum.size:
+                self._accum[ids // self.nproc] = accum
+        self._drop_cache_values()
+        return int(ids.size)
+
+    def save_delta(self, path, touched=None):
+        """Persist only the touched rows (ids + values + accum) —
+        the streaming delta-checkpoint payload.  Returns the id count."""
+        own, vals, accum, meta = self.delta_payload(touched)
+        np.savez(_npz_path(path), ids=own, rows=vals, accum=accum,
+                 meta=meta)
+        return int(own.size)
+
+    def apply_delta(self, path):
+        """Replay one delta file saved by `save_delta`."""
+        d = np.load(_npz_path(path))
+        saved = d["meta"][3] if "meta" in d.files else None
+        return self.apply_delta_arrays(d["ids"], d["rows"], d["accum"],
+                                       saved_nproc=saved)
 
 
-class HostEmbeddingSession:
-    """Wraps Executor.run with the pull/compute/push cycle for every
-    HostEmbedding registered on the program (DownpourWorker parity:
-    `downpour_worker.cc` FillSparseValue -> train -> push_sparse)."""
+# ---------------------------------------------------------------------------
+# sessions
+# ---------------------------------------------------------------------------
+
+
+class _HostEmbeddingSessionBase:
+    """Shared wiring: locate the program's tables, materialize the
+    pulled-buffer gradients once (the param backward sweep does not
+    necessarily produce them: PULLED is a data var)."""
 
     def __init__(self, exe, program, loss=None):
         self._exe = exe
@@ -244,8 +920,6 @@ class HostEmbeddingSession:
             raise ValueError(
                 "program has no host embeddings; build one with "
                 "layers.embedding(..., is_distributed=True)")
-        # materialize grads of the pulled tables once (the param backward
-        # sweep does not necessarily produce them: PULLED is a data var)
         self._grad_names = []
         if loss is not None:
             from . import framework
@@ -266,8 +940,11 @@ class HostEmbeddingSession:
                 w + "@PULLED" + framework.GRAD_SUFFIX for w in self._tables
             ]
 
-    def run(self, feed, fetch_list=None, lr=None, **kw):
-        fetch_list = list(fetch_list or [])
+    def tables(self):
+        return [t for t, _slot in self._tables.values()]
+
+    def _pull_feed(self, feed):
+        """(extra_feed, recs): pull every table for one batch."""
         extra = {}
         recs = []
         for wname, (table, ids_slot) in self._tables.items():
@@ -275,10 +952,405 @@ class HostEmbeddingSession:
             extra[wname + "@PULLED"] = pulled
             extra[ids_slot + "@LOCAL"] = local
             recs.append((table, uniq))
+        return extra, recs
+
+    def _push_grads(self, recs, grads, lr):
+        for (table, uniq), g in zip(recs, grads):
+            table.push(uniq, g, lr=lr)
+
+
+class HostEmbeddingSession(_HostEmbeddingSessionBase):
+    """Wraps Executor.run with the SYNCHRONOUS pull/compute/push cycle
+    for every HostEmbedding registered on the program (DownpourWorker
+    parity: `downpour_worker.cc` FillSparseValue -> train ->
+    push_sparse).  The parity oracle for the pipelined engine."""
+
+    def run(self, feed, fetch_list=None, lr=None, **kw):
+        fetch_list = list(fetch_list or [])
+        extra, recs = self._pull_feed(feed)
         outs = self._exe.run(
             self._program, feed={**feed, **extra},
             fetch_list=fetch_list + self._grad_names, **kw)
         n = len(fetch_list)
-        for (table, uniq), g in zip(recs, outs[n:]):
-            table.push(uniq, g, lr=lr)
+        self._push_grads(recs, outs[n:], lr)
         return outs[:n]
+
+
+class _WorkerOp:
+    __slots__ = ("kind", "payload", "result", "error", "done",
+                 "early", "early_result")
+
+    def __init__(self, kind, payload):
+        self.kind = kind
+        self.payload = payload
+        self.result = None
+        self.error = None
+        self.done = threading.Event()
+        # push ops: set after the CONFLICT phase (the rows the next
+        # step needs) with their post-push values in early_result —
+        # the device step starts while the rest of the push drains
+        self.early = None
+        self.early_result = None
+
+    def wait(self):
+        self.done.wait()
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+    def wait_early(self):
+        self.early.wait()
+        if self.error is not None:
+            raise self.error
+        return self.early_result
+
+
+class _Lane:
+    """One background op lane: a FIFO + worker thread.  Ops execute
+    strictly in submission order WITHIN a lane; the two lanes (pull,
+    push) run concurrently, so a prefetch's wire time overlaps a
+    writeback's — the async pull/push worker pair of the reference's
+    DownpourWorker, with exactness restored by the epoch protocol in
+    `PipelinedHostEmbeddingSession`.
+
+    An op error lands on ``op.error`` for any waiter AND on
+    ``on_error`` — push ops usually have no waiter (only conflicting
+    steps ever wait one), and a silently lost gradient push would let
+    training sail on over a corrupt table."""
+
+    def __init__(self, name, handler, on_error=None):
+        self._handler = handler
+        self._on_error = on_error
+        self._ops = []
+        self._cv = threading.Condition()
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    def submit(self, op):
+        with self._cv:
+            self._ops.append(op)
+            self._cv.notify()
+        return op
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._ops:
+                    self._cv.wait()
+                op = self._ops.pop(0)
+            try:
+                if op.kind == "stop":
+                    op.result = True
+                    return
+                if op.kind == "drain":
+                    op.result = True
+                else:
+                    self._handler(op)
+            except BaseException as e:  # delivered to the waiter
+                op.error = e
+                if self._on_error is not None:
+                    self._on_error(e)
+            finally:
+                if op.early is not None:
+                    op.early.set()     # never leave a waiter hanging
+                op.done.set()
+
+
+class PipelinedHostEmbeddingSession(_HostEmbeddingSessionBase):
+    """Async pull-prefetch / push-writeback around the device step.
+
+    TWO background lanes (the reference DownpourWorker's async
+    pull/push pair): the PULL lane prefetches batch t+1's rows while
+    the device computes batch t, and the PUSH lane applies batch t-1's
+    gradients — pull wire time, push wire time and device compute all
+    overlap.
+
+    Exactness is an epoch protocol, not queue order: every pull op
+    records how many pushes were FULLY APPLIED when its gather started
+    (its epoch).  At step t, any push not provably applied before
+    pull(t)'s gather is *suspect*; rows in `uniq(t) ∩ uniq(suspect)`
+    are the only ones whose pulled values can be stale (or torn — a
+    gather racing an update), and exactly those rows are re-patched
+    before the device step:
+
+    * the newest push (t-1, deferred-enqueued at step t's start once
+      `uniq(t)` is known) runs CONFLICT-SPLIT — the push lane applies
+      the conflicting rows first (their wire bytes + row updates
+      only), hands their post-push values back through an early
+      event (the push-and-refetch RPC response of a real
+      owner-partitioned exchange), then drains the remainder while
+      the device computes;
+    * older suspect pushes (already enqueued, normally already done)
+      are waited and their conflict rows re-read in place.
+
+    With ``exact=True`` (default) the result is bit-identical to
+    `HostEmbeddingSession` — the parity drill in
+    tests/test_streaming.py proves it.  ``exact=False`` skips the
+    patches: conflicting rows are served one step stale (bounded
+    staleness, recsys-style).
+
+    Rows outside every in-flight push's uniq set are never written
+    concurrently, so their gathers are always clean; `HotRowCache`
+    coherence across the two lanes rides the cache's internal lock.
+
+    Single-process only: cross-host pipelining needs every rank to
+    take the same conflict decisions, which requires a coordination
+    the exchange does not carry yet.
+    """
+
+    def __init__(self, exe, program, loss=None, exact=True):
+        super().__init__(exe, program, loss=loss)
+        import jax
+
+        if jax.process_count() > 1:
+            raise ValueError(
+                "PipelinedHostEmbeddingSession is single-process: the "
+                "conflict barrier is a per-step local decision and "
+                "ranks would diverge on it; use HostEmbeddingSession "
+                "under multi-host launch")
+        self.exact = bool(exact)
+        self._next = None              # prefetched PULL op
+        self._pending_push = None      # created, not yet enqueued
+        self._push_log = []            # [(seq, {wname: uniq}, op)]
+        self._push_seq = 0
+        self._pushes_applied = 0       # advanced by the push lane
+        self._closed = False
+        self._async_error = None
+        self._pull_lane = _Lane("hostemb-pull", self._handle_pull,
+                                on_error=self._note_async_error)
+        self._push_lane = _Lane("hostemb-push", self._handle_push,
+                                on_error=self._note_async_error)
+
+    def _note_async_error(self, e):
+        self._async_error = e
+
+    def _check_async_error(self):
+        """Surface a background-lane failure at the NEXT session call:
+        a push op usually has no waiter, and training past a lost
+        gradient update would checkpoint a corrupt table."""
+        e = self._async_error
+        if e is not None:
+            self._async_error = None
+            raise RuntimeError(
+                "a background host-embedding pull/push failed; the "
+                "table state is not trustworthy past this step") from e
+
+    # -- lane handlers ---------------------------------------------------
+    def _handle_pull(self, op):
+        # the epoch is sampled BEFORE the gather touches any row: a
+        # push counted here is fully applied, anything later is the
+        # caller's suspect set
+        epoch = self._pushes_applied
+        extra, recs = self._pull_feed(op.payload)
+        op.result = (extra, recs, epoch)
+
+    def _handle_push(self, op):
+        """Apply one push, conflict subset first when the op carries
+        one: the conflicting rows' updates land and their new values
+        are handed back through ``early`` BEFORE the remainder's wire
+        time — so the in-flight step serializes on only the rows it
+        actually shares."""
+        recs, grads, lr, conflicts = op.payload
+        if conflicts:
+            sels = {}
+            early = {}
+            for (table, uniq), g, wname in zip(recs, grads,
+                                               self._tables):
+                ids = conflicts.get(wname)
+                if ids is None or not len(ids):
+                    continue
+                sel = np.isin(uniq, ids, assume_unique=True)
+                sels[wname] = sel
+                g_rows = np.asarray(g)[: len(uniq)]
+                table.push(uniq[sel], g_rows[sel], lr=lr)
+                early[wname] = table._peek_rows(
+                    ids, simulate_transport=False)
+            op.early_result = early
+            op.early.set()
+            for (table, uniq), g, wname in zip(recs, grads,
+                                               self._tables):
+                sel = sels.get(wname)
+                if sel is None:
+                    table.push(uniq, g, lr=lr)
+                else:
+                    g_rows = np.asarray(g)[: len(uniq)]
+                    table.push(uniq[~sel], g_rows[~sel], lr=lr)
+        else:
+            self._push_grads(recs, grads, lr)
+        self._pushes_applied += 1
+
+    # -- submission ------------------------------------------------------
+    def _submit_pull(self, feed):
+        if self._closed:
+            raise RuntimeError("session is closed")
+        return self._pull_lane.submit(_WorkerOp("pull", feed))
+
+    def _flush_pending(self, conflicts=None):
+        """Enqueue the deferred push (conflict-split when `conflicts`
+        — {wname: sorted ids} — is given)."""
+        op = self._pending_push
+        self._pending_push = None
+        if op is None:
+            return None
+        if conflicts:
+            op.payload = op.payload[:3] + (conflicts,)
+        if self._closed:
+            raise RuntimeError("session is closed")
+        self._push_lane.submit(op)
+        return op
+
+    # -- API -------------------------------------------------------------
+    def prefetch(self, feed):
+        """Enqueue the pull for the NEXT batch (run() does this itself
+        when given ``next_feed``/an iterator; explicit calls are for
+        custom loops)."""
+        if self._next is not None:
+            raise RuntimeError("a prefetched batch is already pending")
+        self._flush_pending()
+        self._next = self._submit_pull(feed)
+
+    def _patch_plan(self, recs, suspects):
+        """{wname: (table, older_ids, newest_ids)} — the rows of this
+        step's pull that a suspect push may have made stale.  Rows
+        conflicting with BOTH an older suspect and the pending push
+        land in newest_ids only: the pending push's early refetch
+        reads after the push lane applied everything older (lane
+        FIFO), so its values are already post-everything."""
+        plan = {}
+        for (table, uniq), wname in zip(recs, self._tables):
+            if not len(uniq):
+                continue
+            older_parts = []
+            newest = None
+            for _seq, umap, op in suspects:
+                pu = umap.get(wname)
+                if pu is None or not len(pu):
+                    continue
+                c = np.intersect1d(uniq, pu, assume_unique=True)
+                if not len(c):
+                    continue
+                if op is self._pending_push:
+                    newest = c
+                else:
+                    older_parts.append(c)
+            older = (np.unique(np.concatenate(older_parts))
+                     if older_parts else None)
+            if older is not None and newest is not None:
+                older = np.setdiff1d(older, newest, assume_unique=True)
+                if not len(older):
+                    older = None
+            if older is not None or newest is not None:
+                plan[wname] = (table, older, newest)
+        return plan
+
+    def run(self, feed, fetch_list=None, lr=None, next_feed=None, **kw):
+        """One pipelined step.  Pass ``next_feed`` (the t+1 batch) to
+        start its pull before the device computes batch t; without it
+        the step degrades to the synchronous order."""
+        fetch_list = list(fetch_list or [])
+        self._check_async_error()
+        cur = self._next
+        self._next = None
+        if cur is not None and cur.payload is not feed:
+            # stale prefetch: a caller loop that stopped early (e.g.
+            # StreamingTrainer.run(max_steps=...)) left batch t+1's
+            # pull queued, and this run() is for a DIFFERENT batch —
+            # training on the prefetched rows would pair them with
+            # this feed's labels.  Discard it (the gather had no side
+            # effects) and pull fresh.
+            try:
+                cur.wait()
+            except Exception:
+                pass            # its batch will never train anyway
+            cur = None
+        if cur is None:
+            self._flush_pending()
+            cur = self._submit_pull(feed)
+        extra, recs, epoch = cur.wait()
+        if next_feed is not None:
+            # overlaps everything below, including the conflict wait
+            self._next = self._submit_pull(next_feed)
+        self._push_log = [e for e in self._push_log if e[0] >= epoch]
+        plan = (self._patch_plan(recs, self._push_log)
+                if self.exact else {})
+        newest_map = {w: n for w, (_t, _o, n) in plan.items()
+                      if n is not None}
+        pending_op = self._flush_pending(conflicts=newest_map or None)
+        if plan:
+            early_vals = None
+            if pending_op is not None and newest_map:
+                # implies every older suspect applied: the push lane
+                # is FIFO and the pending op is its newest entry
+                early_vals = pending_op.wait_early()
+            else:
+                for _seq, _u, op in self._push_log:
+                    if op is not pending_op:
+                        op.wait()
+            uniq_by = {w: u for (t, u), w in zip(recs, self._tables)}
+            for wname, (table, older, newest) in plan.items():
+                # patch pulled buffers as host copies: an .at[].set
+                # with a per-step-varying index shape would recompile
+                # every step
+                buf = extra[wname + "@PULLED"]
+                if not isinstance(buf, np.ndarray):
+                    buf = np.array(buf)   # device -> writable copy
+                if older is not None:
+                    buf[np.searchsorted(uniq_by[wname], older)] = \
+                        table._peek_rows(older, simulate_transport=False)
+                if newest is not None and early_vals is not None:
+                    buf[np.searchsorted(uniq_by[wname], newest)] = \
+                        early_vals[wname]
+                extra[wname + "@PULLED"] = buf
+                if table.stats is not None:
+                    table.stats.pipeline_conflicts.inc()
+        outs = self._exe.run(
+            self._program, feed={**feed, **extra},
+            fetch_list=fetch_list + self._grad_names, **kw)
+        n = len(fetch_list)
+        op = _WorkerOp("push", (recs, outs[n:], lr, None))
+        op.early = threading.Event()
+        self._pending_push = op
+        self._push_log.append((self._push_seq, {
+            wname: uniq for (t, uniq), wname in zip(recs, self._tables)
+        }, op))
+        self._push_seq += 1
+        return outs[:n]
+
+    def run_stream(self, feeds, fetch_list=None, lr=None, **kw):
+        """Drive an iterable of feed dicts with automatic one-batch
+        lookahead; yields each step's fetches."""
+        it = iter(feeds)
+        try:
+            cur = next(it)
+        except StopIteration:
+            return
+        while cur is not None:
+            nxt = next(it, None)
+            yield self.run(cur, fetch_list=fetch_list, lr=lr,
+                           next_feed=nxt, **kw)
+            cur = nxt
+
+    def drain(self):
+        """Block until every queued pull/push has been applied (call
+        before reading table state — checkpoints, eval, parity)."""
+        self._flush_pending()
+        push_d = self._push_lane.submit(_WorkerOp("drain", None))
+        pull_d = self._pull_lane.submit(_WorkerOp("drain", None))
+        push_d.wait()
+        pull_d.wait()
+        self._check_async_error()
+
+    def close(self):
+        if self._closed:
+            return
+        self.drain()
+        self._push_lane.submit(_WorkerOp("stop", None)).wait()
+        self._pull_lane.submit(_WorkerOp("stop", None)).wait()
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

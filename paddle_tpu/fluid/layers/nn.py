@@ -92,7 +92,12 @@ def embedding(
         return append_simple_op(
             "lookup_table",
             {"W": pulled, "Ids": local},
-            {"padding_idx": -1, "is_sparse": False},
+            # is_distributed marks the host-RAM table for the analysis
+            # cost model: the touched rows cross the HOST link each
+            # step (pull + gradient push), priced against
+            # ChipSpec.host_bw instead of HBM
+            {"padding_idx": -1, "is_sparse": False,
+             "is_distributed": True},
             dtype=dtype,
         )
     w = helper.create_parameter(param_attr, list(size), dtype=dtype)

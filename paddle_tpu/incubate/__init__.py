@@ -3,4 +3,5 @@ capabilities that are production-real but whose API may still move."""
 
 from . import checkpoint  # noqa: F401
 from . import complex  # noqa: F401
+from . import data_generator  # noqa: F401
 from . import fault  # noqa: F401

@@ -227,6 +227,12 @@ class HostEmbeddingCheckpoint(SerializableBase):
         self._rank = int(trainer_id)
 
     def snapshot(self):
+        # a hot-row device cache holds the newest values for cached
+        # rows; flush so _rows is the full truth before copying
+        for t in self._tables:
+            flush = getattr(t, "flush_cache", None)
+            if flush is not None:
+                flush()
         # rows live on host already; copy so the optimizer's in-place
         # push during an async write can't tear the payload
         self._shards = [
@@ -376,6 +382,18 @@ class CheckpointSaver:
             if self._read_valid_meta(n) is not None:
                 return n
         return -1
+
+    def list_checkpoints(self):
+        """[(n, meta)] for every committed checkpoint with readable
+        meta, oldest first (payload CRCs are re-verified at load, not
+        here — this is the fast listing the streaming delta-chain
+        restore walks)."""
+        out = []
+        for n in self._numbers():
+            meta = self._read_valid_meta(n)
+            if meta is not None:
+                out.append((n, meta))
+        return out
 
     def last_checkpoint_dir_no(self):
         """Largest checkpoint_<n> dir present, valid or not (numbering
@@ -737,6 +755,12 @@ class CheckpointSaver:
         return True
 
     # -- retention & GC ---------------------------------------------------
+    def delete_checkpoint(self, n):
+        """Remove one committed checkpoint (the streaming delta-chain
+        retention deletes whole superseded chains; the numeric GC below
+        cannot know chain boundaries)."""
+        self._fs.delete(self._ckpt_dir(int(n)))
+
     def clean_redundant_checkpoints(self, reserved_num=None):
         """Keep the newest `reserved_num` (default max_num_checkpoints)
         VALID checkpoints; also delete any committed-but-corrupt dirs

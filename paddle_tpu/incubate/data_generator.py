@@ -1,0 +1,140 @@
+"""Dataset feed authoring (reference parity:
+`python/paddle/fluid/incubate/data_generator/__init__.py` —
+MultiSlotDataGenerator et al., VERDICT #4's last parity gap).
+
+A DataGenerator turns RAW log lines into the MultiSlot line protocol
+the native Dataset channel engine (`native/dataset.cpp` +
+`fluid.dataset`) parses: per sample line, for every declared slot,
+``<count> v1 .. v<count>`` — ints for id slots, floats for value
+slots.  Deployment modes:
+
+* ``run_from_stdin()`` — the classic pslib shape: the generator script
+  becomes the dataset's ``pipe_command`` ("python my_gen.py"), and the
+  engine pipes every raw file through it at load/stream time;
+* ``run_from_files(files, out_dir)`` — offline materialization: write
+  protocol files once, point ``set_filelist`` at them.
+
+Author by subclassing and implementing ``generate_sample(line)``,
+which returns an ITERATOR (usually a generator function) over samples;
+each sample is a list of ``(slot_name, values)`` pairs in the SLOT
+ORDER the dataset declares via ``set_use_var``.  ``generate_batch``
+may override cross-sample processing (negative sampling, shuffling a
+local buffer) — the default passes samples through one by one.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator"]
+
+
+class DataGenerator:
+    """Base authoring class: line in -> protocol line(s) out."""
+
+    def __init__(self):
+        self.batch_size_ = 1
+        self._line_str = None
+
+    # -- reference surface ----------------------------------------------
+    def set_batch(self, batch_size):
+        """Samples per `generate_batch` call (reference parity; the
+        TPU-native engine batches again on the consumer side, so this
+        only scopes cross-sample hooks like negative sampling)."""
+        self.batch_size_ = max(int(batch_size), 1)
+
+    def generate_sample(self, line):
+        """Return an iterator over samples for one raw line; each
+        sample is ``[(slot_name, values), ...]`` in declared slot
+        order.  Must be implemented by the author."""
+        raise NotImplementedError(
+            "implement generate_sample(line) -> iterator of "
+            "[(slot_name, values), ...]")
+
+    def generate_batch(self, samples):
+        """Cross-sample hook: receives `batch_size_` samples, yields
+        (possibly transformed) samples.  Default: passthrough."""
+        for s in samples:
+            yield s
+
+    # -- protocol --------------------------------------------------------
+    def _convert_to_line(self, sample):
+        raise NotImplementedError
+
+    def _iter_samples(self, lines):
+        buf = []
+        for line in lines:
+            it = self.generate_sample(line)
+            if it is None:
+                continue
+            for sample in it:
+                if sample is None:
+                    continue
+                buf.append(sample)
+                if len(buf) >= self.batch_size_:
+                    for out in self.generate_batch(buf):
+                        yield out
+                    buf = []
+        if buf:
+            for out in self.generate_batch(buf):
+                yield out
+
+    def process(self, lines):
+        """Protocol lines (with trailing newline) for raw `lines`."""
+        for sample in self._iter_samples(lines):
+            yield self._convert_to_line(sample)
+
+    # -- runners ---------------------------------------------------------
+    def run_from_stdin(self, stdin=None, stdout=None):
+        """The pipe_command entry point: raw lines on stdin, protocol
+        lines on stdout."""
+        stdin = stdin if stdin is not None else sys.stdin
+        stdout = stdout if stdout is not None else sys.stdout
+        for out in self.process(stdin):
+            stdout.write(out)
+
+    def run_from_files(self, filelist, out_dir, suffix=".slot"):
+        """Materialize protocol files; returns the written paths (feed
+        them to ``dataset.set_filelist``)."""
+        os.makedirs(out_dir, exist_ok=True)
+        written = []
+        for path in filelist:
+            out_path = os.path.join(
+                out_dir, os.path.basename(path) + suffix)
+            with open(path) as fin, open(out_path, "w") as fout:
+                for out in self.process(fin):
+                    fout.write(out)
+            written.append(out_path)
+        return written
+
+
+def _fmt(v):
+    """Ints stay ints (id slots are parsed as int64); floats use repr
+    (round-trips float32 text exactly enough for the engine's parse)."""
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """The MultiSlot text schema writer: per sample, for every slot,
+    ``<count> v...`` — exactly what `fluid.dataset`'s engine parses."""
+
+    def _convert_to_line(self, sample):
+        parts = []
+        for name, values in sample:
+            try:
+                vals = list(values)
+            except TypeError:
+                vals = [values]
+            if not vals:
+                raise ValueError(
+                    "slot %r produced zero values — the MultiSlot "
+                    "protocol needs at least one value per slot per "
+                    "sample" % (name,))
+            parts.append(str(len(vals)))
+            parts.extend(_fmt(v) for v in vals)
+        return " ".join(parts) + "\n"

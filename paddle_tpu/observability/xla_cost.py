@@ -55,6 +55,7 @@ def feed_signature(feed):
 PEAK_FLOPS_ENV = "PADDLE_TPU_PEAK_FLOPS"
 HBM_BW_ENV = "PADDLE_TPU_HBM_BW"
 ICI_BW_ENV = "PADDLE_TPU_ICI_BW"
+HOST_BW_ENV = "PADDLE_TPU_HOST_BW"
 
 # bf16 peak per chip for platforms we know; MFU needs a denominator and
 # an unknown platform yields None (callers then skip the gauge)
@@ -76,13 +77,21 @@ _PLATFORM_ICI_BW = {
     "tpu": 4.5e10,   # v5e, one-way per link
 }
 
+# host<->device link bytes/s — the fourth roofline axis: host-RAM
+# embedding pull/push traffic (fluid.host_embedding) rides this, not
+# HBM or ICI.  PCIe-gen3-x16-class figure for the v5e host attach.
+_PLATFORM_HOST_BW = {
+    "tpu": 1.6e10,
+}
 
-def peak_flops(explicit=None, platform=None):
-    """Resolve the MFU denominator: explicit arg > env > platform table
-    (platform defaults to the live jax backend).  None when unknown."""
+
+def _resolve_rate(explicit, env_name, table, platform):
+    """The shared resolution ladder for every chip-rate axis: explicit
+    arg > env var > platform table (platform defaults to the live jax
+    backend).  None when unknown."""
     if explicit:
         return float(explicit)
-    env = os.getenv(PEAK_FLOPS_ENV)
+    env = os.getenv(env_name)
     if env:
         try:
             return float(env)
@@ -95,52 +104,35 @@ def peak_flops(explicit=None, platform=None):
             platform = jax.default_backend()
         except Exception:
             return None
-    return _PLATFORM_PEAK.get(platform)
+    return table.get(platform)
+
+
+def peak_flops(explicit=None, platform=None):
+    """Resolve the MFU denominator: explicit arg > $PADDLE_TPU_PEAK_FLOPS
+    > platform table."""
+    return _resolve_rate(explicit, PEAK_FLOPS_ENV, _PLATFORM_PEAK,
+                         platform)
 
 
 def hbm_bandwidth(explicit=None, platform=None):
-    """Resolve HBM bytes/s the same way peak_flops resolves FLOP/s:
-    explicit arg > $PADDLE_TPU_HBM_BW > platform table (platform
-    defaults to the live jax backend).  None when unknown."""
-    if explicit:
-        return float(explicit)
-    env = os.getenv(HBM_BW_ENV)
-    if env:
-        try:
-            return float(env)
-        except ValueError:
-            pass
-    if platform is None:
-        try:
-            import jax
-
-            platform = jax.default_backend()
-        except Exception:
-            return None
-    return _PLATFORM_HBM_BW.get(platform)
+    """Resolve HBM bytes/s: explicit arg > $PADDLE_TPU_HBM_BW >
+    platform table."""
+    return _resolve_rate(explicit, HBM_BW_ENV, _PLATFORM_HBM_BW,
+                         platform)
 
 
 def ici_bandwidth(explicit=None, platform=None):
-    """Resolve ICI bytes/s (one link, one direction) the same way
-    peak_flops resolves FLOP/s: explicit arg > $PADDLE_TPU_ICI_BW >
-    platform table (platform defaults to the live jax backend).  None
-    when unknown."""
-    if explicit:
-        return float(explicit)
-    env = os.getenv(ICI_BW_ENV)
-    if env:
-        try:
-            return float(env)
-        except ValueError:
-            pass
-    if platform is None:
-        try:
-            import jax
+    """Resolve ICI bytes/s (one link, one direction): explicit arg >
+    $PADDLE_TPU_ICI_BW > platform table."""
+    return _resolve_rate(explicit, ICI_BW_ENV, _PLATFORM_ICI_BW,
+                         platform)
 
-            platform = jax.default_backend()
-        except Exception:
-            return None
-    return _PLATFORM_ICI_BW.get(platform)
+
+def host_bandwidth(explicit=None, platform=None):
+    """Resolve host-link bytes/s (host-embedding exchange pricing):
+    explicit arg > $PADDLE_TPU_HOST_BW > platform table."""
+    return _resolve_rate(explicit, HOST_BW_ENV, _PLATFORM_HOST_BW,
+                         platform)
 
 
 def cost_analysis_of(compiled):

@@ -1,0 +1,45 @@
+"""paddle_tpu.streaming — recsys-scale online learning (ROADMAP item 5).
+
+The circulatory system over the repo's recsys organs (SURVEY §2.1
+fleet pslib/box wrappers, §2.3 massive sparse embeddings — the
+reference's raison d'être at Baidu scale): continuous training from an
+unbounded event stream, host-embedding engines doing the heavy lifting
+(`fluid.host_embedding`), and the trained state flowing all the way to
+live traffic:
+
+* `StreamSource` / `dataset_stream` — unbounded feed-dict sources with
+  per-batch ingest timestamps (the freshness clock starts here);
+* `DeltaCheckpointer` — periodic delta checkpoints of TOUCHED embedding
+  rows + the (small) dense state, a full snapshot every K deltas, every
+  commit CRC-manifested through `incubate.checkpoint.CheckpointSaver`;
+  restore replays the newest full snapshot + its delta chain, so a
+  SIGKILL loses at most one checkpoint window;
+* `PushToServing` — export -> `analysis` verify gate -> bucket-ladder
+  warmup -> atomic hot-swap into a live `serving.Router` (the PR-9
+  zero-downtime lifecycle), with the event-ingested -> served-by-new-
+  version freshness measured per push;
+* `StreamingTrainer` — the loop: windowed eval, events/sec accounting,
+  checkpoint + push cadences, PR-4 metrics and PR-6 trace spans.
+
+`benchmarks/streaming_bench.py` measures events/sec and
+minutes-to-freshness end to end; `tests/test_streaming.py` holds the
+parity and zero-failed-requests hot-swap drills, and
+`tests/test_perf_gate.py` the SIGKILL-mid-stream loss bound.
+"""
+
+from .delta import DeltaCheckpointer  # noqa: F401
+from .source import StreamSource, dataset_stream  # noqa: F401
+from .trainer import (  # noqa: F401
+    PushToServing,
+    StreamingReport,
+    StreamingTrainer,
+)
+
+__all__ = [
+    "DeltaCheckpointer",
+    "PushToServing",
+    "StreamSource",
+    "StreamingReport",
+    "StreamingTrainer",
+    "dataset_stream",
+]

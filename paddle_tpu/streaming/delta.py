@@ -1,0 +1,214 @@
+"""Delta checkpoints of touched embedding rows (online-learning cadence).
+
+A recsys table is huge and each streaming window touches a sliver of
+it, so checkpointing the full table every window would turn the
+freshness loop into an I/O loop.  `DeltaCheckpointer` commits, through
+the PR-1 `incubate.checkpoint.CheckpointSaver` (atomic tmp+rename, CRC
+manifest):
+
+* **delta commits** — only the rows pushed since the previous commit
+  (`HostEmbedding.collect_touched`), plus the (small) dense state;
+* **full commits** — the complete sharded table
+  (`HostEmbeddingCheckpoint`), every `full_every`-th commit and always
+  first.
+
+Restore finds the newest commit, loads the newest full snapshot at or
+below it, replays the delta chain between them in order, then restores
+the newest commit's dense state — so a SIGKILL mid-stream loses at
+most the events since the last commit (one checkpoint window; the
+drill in tests/test_perf_gate.py proves it).  Retention keeps the last
+`keep_chains` full chains and deletes whole superseded chains (the
+numeric GC in CheckpointSaver cannot know chain boundaries, so it is
+disabled here).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from ..incubate.checkpoint.checkpoint_saver import (
+    CheckpointLoadError,
+    CheckpointSaver,
+    HostEmbeddingCheckpoint,
+    SerializableBase,
+)
+
+__all__ = ["DeltaCheckpointer"]
+
+KIND_FULL = "full"
+KIND_DELTA = "delta"
+
+
+class _TableDeltas(SerializableBase):
+    """Touched rows of every table: one npz per table per rank."""
+
+    def __init__(self, tables, touched, trainer_id=0):
+        self._tables = list(tables)
+        self._touched = touched          # {table name: sorted ids}
+        self._rank = int(trainer_id)
+        self._snap = None
+
+    def _fname(self, table):
+        return "hostemb_delta_%s_rank%d.npz" % (table.name, self._rank)
+
+    def snapshot(self):
+        # the payload copy is taken NOW (the saver may serialize in a
+        # background thread while the optimizer keeps pushing); the
+        # format itself is HostEmbedding.delta_payload — one source of
+        # truth with save_delta/apply_delta
+        self._snap = {
+            t.name: t.delta_payload(
+                self._touched.get(t.name, np.zeros(0, np.int64)))
+            for t in self._tables
+        }
+
+    def serialize(self, path):
+        if self._snap is None:
+            self.snapshot()
+        names = []
+        for t in self._tables:
+            own, rows, accum, meta = self._snap[t.name]
+            fname = self._fname(t)
+            np.savez(os.path.join(path, fname), ids=own, rows=rows,
+                     accum=accum, meta=meta)
+            names.append(fname)
+        return names
+
+    def deserialize(self, path):
+        """Replay: scatter each table's delta rows into its shard."""
+        applied = 0
+        for t in self._tables:
+            with np.load(os.path.join(path, self._fname(t))) as d:
+                try:
+                    applied += t.apply_delta_arrays(
+                        d["ids"], d["rows"], d["accum"],
+                        saved_nproc=d["meta"][3])
+                except ValueError as e:
+                    raise CheckpointLoadError(str(e)) from e
+        return applied
+
+
+class DeltaCheckpointer:
+    """Delta/full checkpoint cadence for streaming training.
+
+    ``tables``: HostEmbedding list (or a program's `_host_embeddings`
+    mapping).  ``dense``: a SerializableBase for the non-embedding
+    state — `incubate.checkpoint.PaddleModel(exe, program)` restores
+    straight into the scope; omit for embedding-only drills."""
+
+    def __init__(self, root, tables, dense=None, full_every=5,
+                 keep_chains=2, trainer_id=0, **saver_kw):
+        if isinstance(tables, dict):
+            tables = [t if not isinstance(t, tuple) else t[0]
+                      for t in tables.values()]
+        self.root = root
+        self.tables = list(tables)
+        for t in self.tables:
+            # touched-id tracking is opt-in (unbounded growth without a
+            # consumer); this checkpointer is the consumer
+            t.track_touched = True
+        self.dense = dense
+        self.full_every = max(int(full_every), 1)
+        self.keep_chains = max(int(keep_chains), 1)
+        self._rank = int(trainer_id)
+        saver_kw.setdefault("max_num_checkpoints", 0)  # chain-aware GC
+        self._saver = CheckpointSaver(root, trainer_id=trainer_id,
+                                      **saver_kw)
+        self.last_commit_time = None
+        self.last_commit_no = None
+
+    # -- save ------------------------------------------------------------
+    def _deltas_since_full(self):
+        metas = self._saver.list_checkpoints()
+        n = 0
+        for _no, meta in metas:
+            if meta.get("kind") == KIND_FULL:
+                n = 0
+            else:
+                n += 1
+        return n, len(metas)
+
+    def save(self, step=None, events_done=None, window=None,
+             extra_meta=None):
+        """One commit: full on the configured cadence, delta otherwise.
+        Drain any pipelined session BEFORE calling (table state must be
+        quiescent).  Returns (no, kind)."""
+        deltas, total = self._deltas_since_full()
+        kind = (KIND_FULL if total == 0 or deltas + 1 >= self.full_every
+                else KIND_DELTA)
+        touched = {t.name: t.collect_touched(reset=True)
+                   for t in self.tables}
+        payload = []
+        if kind == KIND_FULL:
+            payload.append(HostEmbeddingCheckpoint(
+                self.tables, trainer_id=self._rank))
+        else:
+            payload.append(_TableDeltas(self.tables, touched,
+                                        trainer_id=self._rank))
+        if self.dense is not None:
+            payload.append(self.dense)
+        meta = {"kind": kind, "events_done": events_done,
+                "window": window,
+                "touched_rows": {k: int(v.size)
+                                 for k, v in touched.items()}}
+        meta.update(extra_meta or {})
+        try:
+            no = self._saver.save_checkpoint(
+                payload, step=step, extra_meta=meta)
+        except BaseException:
+            # the touched set was drained optimistically; merge it back
+            # so the NEXT commit still covers these rows
+            for t in self.tables:
+                ids = touched.get(t.name)
+                if ids is not None and ids.size:
+                    t._note_touched(ids)
+            raise
+        self.last_commit_time = time.time()
+        self.last_commit_no = no
+        self._gc_chains()
+        return no, kind
+
+    def _gc_chains(self):
+        metas = self._saver.list_checkpoints()
+        fulls = [no for no, m in metas if m.get("kind") == KIND_FULL]
+        if len(fulls) <= self.keep_chains:
+            return
+        cut = fulls[-self.keep_chains]
+        for no, _m in metas:
+            if no < cut:
+                self._saver.delete_checkpoint(no)
+
+    # -- restore ---------------------------------------------------------
+    def restore(self):
+        """Rebuild table + dense state from the newest committed chain.
+        Returns the newest commit's meta, or None when the root is
+        empty."""
+        metas = self._saver.list_checkpoints()
+        if not metas:
+            return None
+        newest_no, newest_meta = metas[-1]
+        fulls = [no for no, m in metas if m.get("kind") == KIND_FULL
+                 and no <= newest_no]
+        if not fulls:
+            raise CheckpointLoadError(
+                "no full snapshot at or below checkpoint_%d under %r — "
+                "the delta chain has no base" % (newest_no, self.root))
+        base = fulls[-1]
+        self._saver.load_checkpoint(
+            [HostEmbeddingCheckpoint(self.tables, trainer_id=self._rank)],
+            no=base)
+        for no, m in metas:
+            if base < no <= newest_no and m.get("kind") == KIND_DELTA:
+                self._saver.load_checkpoint(
+                    [_TableDeltas(self.tables, {},
+                                  trainer_id=self._rank)], no=no)
+        if self.dense is not None:
+            self._saver.load_checkpoint([self.dense], no=newest_no)
+        for t in self.tables:
+            t._touched_chunks = []
+            t._drop_cache_values()
+        self.last_commit_no = newest_no
+        return newest_meta

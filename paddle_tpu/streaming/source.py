@@ -1,0 +1,81 @@
+"""Unbounded stream sources: feed dicts + event counts + ingest stamps.
+
+A streaming trainer consumes `(feed, n_events, ingested_at)` triples.
+`StreamSource` adapts anything iterable — a generator of feed dicts, a
+`paddle_tpu.io` loader, a replayed log — and `dataset_stream` adapts
+the native Dataset channel engine (`fluid.dataset.QueueDataset` /
+`InMemoryDataset`), whose reader threads parse files into a bounded
+channel while the trainer consumes (the reference's true-streaming
+InMemoryDataFeed architecture).
+
+The ingest timestamp is stamped when the batch LEAVES the source —
+that is the moment an event became visible to training, and the
+freshness clock (`event ingested -> served by the new model version`)
+starts there.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["StreamBatch", "StreamSource", "dataset_stream"]
+
+
+class StreamBatch:
+    """One unit of stream consumption."""
+
+    __slots__ = ("feed", "n_events", "ingested_at")
+
+    def __init__(self, feed, n_events, ingested_at=None):
+        self.feed = feed
+        self.n_events = int(n_events)
+        self.ingested_at = (time.time() if ingested_at is None
+                            else float(ingested_at))
+
+
+def _default_count(feed):
+    """Events per batch: leading dim of the first array-valued feed."""
+    for v in feed.values():
+        a = np.asarray(v)
+        if a.ndim:
+            return int(a.shape[0])
+    return 1
+
+
+class StreamSource:
+    """Wrap an iterable of feed dicts (or ready StreamBatches) as an
+    unbounded source.  ``count_fn(feed) -> events`` overrides the
+    default leading-dim event count; ``limit`` bounds an otherwise
+    infinite iterable (drills/benches)."""
+
+    def __init__(self, batches, count_fn=None, limit=None):
+        self._batches = batches
+        self._count = count_fn or _default_count
+        self._limit = limit
+
+    def __iter__(self):
+        n = 0
+        for b in self._batches:
+            if self._limit is not None and n >= self._limit:
+                return
+            n += 1
+            if isinstance(b, StreamBatch):
+                yield b
+            else:
+                yield StreamBatch(b, self._count(b))
+
+
+def dataset_stream(dataset, make_feed, count_fn=None):
+    """Adapt a `fluid.dataset` engine to a stream of feed dicts.
+
+    ``make_feed({slot: (values, lod)}) -> feed dict`` converts one
+    ragged channel batch (the engine's native form) into executor
+    feeds — `fluid.dataset.pad_batch` is the usual bridge.  Returns a
+    `StreamSource`; iterate it inside a `StreamingTrainer`."""
+    def gen():
+        for raw in dataset:
+            yield make_feed(raw)
+
+    return StreamSource(gen(), count_fn=count_fn)

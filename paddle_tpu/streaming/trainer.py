@@ -1,0 +1,322 @@
+"""The streaming train -> freshness loop.
+
+`StreamingTrainer` drives an unbounded `StreamSource` through a
+host-embedding session (pipelined or synchronous), with three cadences
+riding on the window clock:
+
+* **windowed eval** — every `window_events` ingested events close a
+  window: mean loss, events/sec, metrics, a trace span;
+* **delta checkpoints** — every `checkpoint_every_windows` windows the
+  `DeltaCheckpointer` commits the touched rows (full snapshot on its
+  own cadence); the pipelined session is drained first so the commit
+  is a consistent cut;
+* **push to serving** — every `push_every_windows` windows,
+  `PushToServing` exports the model, rides the PR-9 gated deploy
+  (load -> analysis verify -> warmup -> ready), atomically promotes it
+  on a live `serving.Router`, and measures freshness: the age of the
+  newest and oldest not-yet-served events at the moment the new
+  version answers its first (probe) request.
+
+Everything is measured: events/sec and minutes-to-freshness are the
+two numbers this subsystem exists to optimize (ROADMAP item 5).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .source import StreamSource
+
+__all__ = ["PushToServing", "StreamingReport", "StreamingTrainer"]
+
+
+class _StreamStats:
+    """PR-4 metric families for one streaming loop."""
+
+    _LBL = ("stream",)
+
+    def __init__(self, name, registry=None):
+        from ..observability.metrics import (default_registry,
+                                             unique_instance_label)
+
+        reg = registry or default_registry()
+        self.registry = reg
+        self.instance_label = unique_instance_label(name)
+        lab = (self.instance_label,)
+        L = self._LBL
+        self.events = reg.counter(
+            "streaming_events_total", "Events ingested by the loop",
+            labelnames=L).labels(*lab)
+        self.steps = reg.counter(
+            "streaming_steps_total", "Train steps taken", labelnames=L
+        ).labels(*lab)
+        self.windows = reg.counter(
+            "streaming_windows_total", "Eval windows closed", labelnames=L
+        ).labels(*lab)
+        self.window_loss = reg.gauge(
+            "streaming_window_loss", "Mean loss of the last closed window",
+            labelnames=L).labels(*lab)
+        self.events_per_s = reg.gauge(
+            "streaming_events_per_s",
+            "Ingest rate over the last closed window", labelnames=L
+        ).labels(*lab)
+        self.delta_lag_s = reg.gauge(
+            "streaming_delta_lag_s",
+            "Seconds since the last committed (delta) checkpoint",
+            labelnames=L).labels(*lab)
+        self.pushes = reg.counter(
+            "streaming_pushes_total", "Model versions pushed to serving",
+            labelnames=L).labels(*lab)
+        self.freshness_s = reg.gauge(
+            "streaming_freshness_s",
+            "Oldest-unserved-event age when the pushed version went live",
+            labelnames=L).labels(*lab)
+
+    def close(self):
+        from ..observability.metrics import release_instance_label
+
+        try:
+            release_instance_label(self.instance_label)
+        except Exception:
+            pass
+
+
+def _trace():
+    from ..observability import trace as trace_mod
+
+    return trace_mod.default_tracer()
+
+
+class PushToServing:
+    """Export -> verify -> warmup -> atomic hot-swap, measured.
+
+    ``export_fn(version_no) -> model_dir`` owns producing the
+    inference model (see `tests/test_streaming.py` for the dense-
+    materialization exporter); the gate chain is PR-9's `Router.deploy`
+    (which runs the PR-5 structural verify unconditionally) followed by
+    `Router.promote` (atomic cutover, old version drains).  A probe
+    request through the router confirms the new version ANSWERS before
+    freshness is stamped — promote-then-crash cannot report a fresh
+    model that never served."""
+
+    def __init__(self, router, export_fn, replicas=1,
+                 warmup_example=None, probe_example=None,
+                 version_prefix="stream-v", keep_old=False):
+        self.router = router
+        self.export_fn = export_fn
+        self.replicas = int(replicas)
+        self.warmup_example = warmup_example
+        self.probe_example = probe_example
+        self.version_prefix = version_prefix
+        self.keep_old = bool(keep_old)
+        self.pushed = []           # [{version, deploy_s, ...}]
+
+    def push(self, version_no):
+        t0 = time.time()
+        version = "%s%d" % (self.version_prefix, int(version_no))
+        with _trace().span("streaming.push", cat="streaming",
+                           args={"version": version}):
+            model_dir = self.export_fn(version_no)
+            t_export = time.time()
+            self.router.deploy(version, model_dir,
+                               replicas=self.replicas,
+                               warmup_example=self.warmup_example)
+            self.router.promote(version, keep_old=self.keep_old)
+            if self.probe_example is not None:
+                self.router.infer(self.probe_example,
+                                  request_id="probe-%s" % version)
+            t_live = time.time()
+        rec = {"version": version, "model_dir": model_dir,
+               "export_s": t_export - t0, "deploy_s": t_live - t_export,
+               "total_s": t_live - t0, "live_at": t_live}
+        self.pushed.append(rec)
+        return rec
+
+
+class StreamingReport:
+    """What one `StreamingTrainer.run` accomplished."""
+
+    def __init__(self):
+        self.events = 0
+        self.steps = 0
+        self.windows = []          # [{events, loss, events_per_s, dur_s}]
+        self.checkpoints = []      # [(no, kind)]
+        self.pushes = []           # push records + freshness fields
+        self.started_at = None
+        self.finished_at = None
+
+    @property
+    def events_per_s(self):
+        dur = (self.finished_at or 0) - (self.started_at or 0)
+        return self.events / dur if dur > 0 else 0.0
+
+    @property
+    def freshness_s(self):
+        """Worst-case event-ingested -> served-by-new-version age over
+        the run's pushes (the minutes-to-freshness headline)."""
+        ages = [p.get("freshness_oldest_s") for p in self.pushes
+                if p.get("freshness_oldest_s") is not None]
+        return max(ages) if ages else None
+
+    def to_dict(self):
+        return {
+            "events": self.events, "steps": self.steps,
+            "events_per_s": self.events_per_s,
+            "windows": self.windows,
+            "checkpoints": [{"no": n, "kind": k}
+                            for n, k in self.checkpoints],
+            "pushes": self.pushes,
+            "freshness_s": self.freshness_s,
+        }
+
+
+class StreamingTrainer:
+    """Continuous training with windowed eval + checkpoint/push cadence.
+
+    ``session`` is a `HostEmbeddingSession`, a
+    `PipelinedHostEmbeddingSession` (lookahead used automatically), or
+    any object with ``run(feed, fetch_list=, lr=) -> [loss, ...]``.
+    ``source`` is a `StreamSource` (or any iterable of feed dicts).
+    The first fetch (or ``eval_fn(outs)``) is the windowed metric."""
+
+    def __init__(self, session, source, fetch_list, *, lr=None,
+                 window_events=512, eval_fn=None,
+                 checkpoint=None, checkpoint_every_windows=1,
+                 push=None, push_every_windows=None,
+                 name="stream", metrics_registry=None):
+        self.session = session
+        self.source = (source if isinstance(source, StreamSource)
+                       else StreamSource(source))
+        self.fetch_list = list(fetch_list)
+        self.lr = lr
+        self.window_events = int(window_events)
+        self.eval_fn = eval_fn or (lambda outs: float(
+            np.asarray(outs[0]).mean()))
+        self.checkpoint = checkpoint
+        self.checkpoint_every_windows = int(checkpoint_every_windows)
+        self.push = push
+        self.push_every_windows = push_every_windows
+        self.stats = _StreamStats(name, registry=metrics_registry)
+        self._supports_lookahead = hasattr(session, "run_stream")
+
+    # -- internals -------------------------------------------------------
+    def _drain(self):
+        drain = getattr(self.session, "drain", None)
+        if drain is not None:
+            drain()
+
+    def _checkpoint(self, report, step, window_no):
+        self._drain()
+        no, kind = self.checkpoint.save(
+            step=step, events_done=report.events, window=window_no)
+        report.checkpoints.append((no, kind))
+        _trace().instant("streaming.checkpoint",
+                         args={"no": no, "kind": kind}, cat="streaming")
+
+    def _push(self, report, window_no, oldest_unserved, newest_event):
+        rec = self.push.push(window_no)
+        now = rec["live_at"]
+        rec["freshness_oldest_s"] = (
+            now - oldest_unserved if oldest_unserved is not None else None)
+        rec["freshness_newest_s"] = (
+            now - newest_event if newest_event is not None else None)
+        report.pushes.append(rec)
+        self.stats.pushes.inc()
+        if rec["freshness_oldest_s"] is not None:
+            self.stats.freshness_s.set(rec["freshness_oldest_s"])
+
+    # -- the loop --------------------------------------------------------
+    def run(self, max_events=None, max_steps=None, max_windows=None):
+        report = StreamingReport()
+        report.started_at = time.time()
+        stats = self.stats
+        win_events = 0
+        win_losses = []
+        win_no = 0
+        win_t0 = time.time()
+        # freshness bookkeeping: the ingest stamp of the oldest event
+        # no pushed version has trained on yet, and of the newest event
+        oldest_unserved = None
+        newest_event = None
+
+        it = iter(self.source)
+        cur = next(it, None)
+
+        def done():
+            return (
+                (max_events is not None and report.events >= max_events)
+                or (max_steps is not None and report.steps >= max_steps)
+                or (max_windows is not None and win_no >= max_windows))
+
+        while cur is not None and not done():
+            nxt = next(it, None)
+            if oldest_unserved is None:
+                oldest_unserved = cur.ingested_at
+            newest_event = cur.ingested_at
+            if self._supports_lookahead and nxt is not None:
+                outs = self.session.run(
+                    cur.feed, fetch_list=self.fetch_list, lr=self.lr,
+                    next_feed=nxt.feed)
+            else:
+                outs = self.session.run(
+                    cur.feed, fetch_list=self.fetch_list, lr=self.lr)
+            report.steps += 1
+            report.events += cur.n_events
+            stats.steps.inc()
+            stats.events.inc(cur.n_events)
+            win_events += cur.n_events
+            win_losses.append(self.eval_fn(outs))
+            if self.checkpoint is not None \
+                    and self.checkpoint.last_commit_time is not None:
+                stats.delta_lag_s.set(
+                    time.time() - self.checkpoint.last_commit_time)
+
+            if win_events >= self.window_events:
+                win_no += 1
+                dur = time.time() - win_t0
+                loss = float(np.mean(win_losses)) if win_losses else None
+                rate = win_events / dur if dur > 0 else 0.0
+                report.windows.append({
+                    "window": win_no, "events": win_events,
+                    "loss": loss, "events_per_s": rate, "dur_s": dur})
+                stats.windows.inc()
+                if loss is not None:
+                    stats.window_loss.set(loss)
+                stats.events_per_s.set(rate)
+                _trace().instant(
+                    "streaming.window",
+                    args={"window": win_no, "events": win_events,
+                          "loss": loss, "events_per_s": round(rate, 1)},
+                    cat="streaming")
+                if (self.checkpoint is not None
+                        and self.checkpoint_every_windows
+                        and win_no % self.checkpoint_every_windows == 0):
+                    self._checkpoint(report, report.steps, win_no)
+                if (self.push is not None
+                        and self.push_every_windows
+                        and win_no % self.push_every_windows == 0):
+                    self._drain()
+                    self._push(report, win_no, oldest_unserved,
+                               newest_event)
+                    oldest_unserved = None
+                win_events = 0
+                win_losses = []
+                win_t0 = time.time()
+            cur = nxt
+
+        self._drain()
+        report.finished_at = time.time()
+        return report
+
+    def restore(self):
+        """Delegate to the DeltaCheckpointer; returns its meta (with
+        ``events_done``/``window`` so the caller can reposition the
+        source) or None."""
+        if self.checkpoint is None:
+            return None
+        return self.checkpoint.restore()
+
+    def close(self):
+        self.stats.close()

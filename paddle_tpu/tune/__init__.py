@@ -28,7 +28,10 @@ Front ends:
   knobs (``bench.py --autotune``);
 * ``search_train_step(build_and_time, ...)`` — the distributed-step
   knobs: ZeRO stage x accumulate_steps x gather-chunk-bytes
-  (``bench.py --multichip --autotune``).
+  (``bench.py --multichip --autotune``);
+* ``search_hostemb_cache(build_and_time, ...)`` — the hot-row
+  device-cache capacity of a host-embedding workload
+  (``benchmarks/streaming_bench.py --autotune``).
 
 Entry points: ``CompiledProgram.with_autotune()`` (Executor applies the
 tuned pipeline on first run), ``InferenceServer.autotune()``,
@@ -50,6 +53,7 @@ from .search import (  # noqa: F401
     search_bucket_ladder,
     search_flash_blocks,
     search_gemm_blocks,
+    search_hostemb_cache,
     search_step,
     search_train_step,
     tuned_program,
@@ -57,6 +61,7 @@ from .search import (  # noqa: F401
 from .space import (  # noqa: F401
     Candidate,
     SearchSpace,
+    cache_capacity_candidates,
     default_pass_pipelines,
     flash_block_candidates,
     gemm_block_candidates,
@@ -72,6 +77,7 @@ __all__ = [
     "SearchSpace",
     "TUNE_SCHEMA_VERSION",
     "TuningCache",
+    "cache_capacity_candidates",
     "cache_key_parts",
     "default_cache_dir",
     "default_pass_pipelines",
@@ -82,6 +88,7 @@ __all__ = [
     "search_bucket_ladder",
     "search_flash_blocks",
     "search_gemm_blocks",
+    "search_hostemb_cache",
     "search_step",
     "search_train_step",
     "sharding_candidates",

@@ -1125,6 +1125,31 @@ def search_train_step(build_and_time, *, workload, mesh=None,
         jax_version=jax_version)
 
 
+def search_hostemb_cache(build_and_time, *, workload, capacities=None,
+                         table_rows=None, mesh=None, use_cache=True,
+                         cache_dir=None, platform=None,
+                         jax_version=None):
+    """Measured search over the hot-row device-cache capacity of a
+    host-embedding workload (`space.cache_capacity_candidates`; 0 = no
+    cache is the measured baseline, first).
+
+    ``build_and_time(params) -> seconds`` owns building the session —
+    attach ``HotRowCache(table, params["cache_capacity"])`` when the
+    capacity is non-zero — and timing a step (streaming_bench's
+    harness, or any caller-defined one); the tuner owns enumeration,
+    ordering, reporting, and the cache.  The winner's capacity slots
+    straight back into `HostEmbedding.attach_cache`."""
+    kw = {}
+    if capacities is not None:
+        kw["capacities"] = capacities
+    cands = space_mod.cache_capacity_candidates(table_rows=table_rows,
+                                                **kw)
+    return search_step(
+        build_and_time, cands, workload=workload, mesh=mesh,
+        use_cache=use_cache, cache_dir=cache_dir, platform=platform,
+        jax_version=jax_version)
+
+
 def search_step(build_and_time, variants, *, workload, mesh=None,
                 use_cache=True, cache_dir=None, platform=None,
                 jax_version=None):

@@ -25,6 +25,7 @@ from __future__ import annotations
 __all__ = [
     "Candidate",
     "SearchSpace",
+    "cache_capacity_candidates",
     "default_pass_pipelines",
     "flash_block_candidates",
     "gemm_block_candidates",
@@ -263,6 +264,33 @@ def train_step_candidates(dp=None, zero_stages=(1, 2, 3),
         for acc in accumulate_steps or (1,):
             for cb in (chunk_bytes or (4 << 20,)):
                 add(z, acc, cb)
+    return out
+
+
+def cache_capacity_candidates(capacities=(0, 256, 1024, 4096),
+                              table_rows=None):
+    """Hot-row device-cache capacities as measured candidates
+    (`fluid.host_embedding.HotRowCache`).  Capacity 0 = no cache — the
+    DEFAULT, first per the search_step baseline contract.  Capacities
+    at or above ``table_rows`` are dropped (the whole table fits in
+    HBM; host offload is the wrong tool) except that the no-cache
+    default always survives."""
+    out = []
+    seen = set()
+    caps = list(capacities)
+    if 0 not in caps:
+        caps.insert(0, 0)
+    caps.sort(key=lambda c: (c != 0, c))   # 0 first, then ascending
+    for c in caps:
+        c = int(c)
+        if c in seen:
+            continue
+        if c and table_rows is not None and c >= int(table_rows):
+            continue
+        seen.add(c)
+        out.append(Candidate(
+            "hostemb_cache", {"cache_capacity": c},
+            label=("nocache" if c == 0 else "cache%d" % c)))
     return out
 
 

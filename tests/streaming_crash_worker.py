@@ -1,0 +1,109 @@
+"""SIGKILL-mid-stream worker for the delta-checkpoint loss-bound drill.
+
+Driven by test_perf_gate.py: trains a streaming loop with per-window
+delta checkpoints, then SIGKILLs ITSELF (no cleanup, no atexit — the
+preemption case) after a given number of windows.  A second invocation
+with ``restore`` rebuilds the table from the committed chain and
+prints the restored ``events_done`` so the driver can assert the loss
+bound: at most ONE window of events between the last commit and the
+kill is gone.
+
+Deterministic data: windows are generated from a fixed seed, so the
+restored table must be BIT-identical to an uninterrupted run truncated
+at the restored event count — which the driver also verifies via the
+printed table digest.
+"""
+
+import hashlib
+import json
+import os
+import signal
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+V, D, T, B = 2000, 8, 4, 8
+STEPS_PER_WINDOW = 4
+
+
+def _build():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 17
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[-1, T], dtype="int64",
+                          append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        emb = layers.embedding(ids, size=[V, D], is_distributed=True,
+                               param_attr="cw.emb")
+        pred = layers.fc(layers.reduce_mean(emb, dim=1), size=1,
+                         param_attr="cw.fc.w", bias_attr="cw.fc.b")
+        loss = layers.reduce_mean(layers.square(pred - y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    table, _slot = main._host_embeddings["cw.emb"]
+    return main, startup, loss, table
+
+
+def _window_feeds(window_no):
+    rng = np.random.RandomState(1000 + window_no)
+    return [{"ids": rng.randint(0, V, (B, T)).astype(np.int64),
+             "y": rng.randn(B, 1).astype(np.float32)}
+            for _ in range(STEPS_PER_WINDOW)]
+
+
+def _digest(table):
+    return hashlib.sha256(
+        np.ascontiguousarray(table._rows).tobytes()).hexdigest()[:16]
+
+
+def main():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import streaming
+
+    mode = sys.argv[1]                  # train | restore
+    root = sys.argv[2]
+    windows = int(sys.argv[3])
+    kill_after = int(sys.argv[4]) if len(sys.argv) > 4 else -1
+
+    main_prog, startup, loss, table = _build()
+    ck = streaming.DeltaCheckpointer(root, [table], full_every=3)
+
+    if mode == "restore":
+        meta = ck.restore()
+        print(json.dumps({"events_done": meta["events_done"],
+                          "window": meta["window"],
+                          "digest": _digest(table)}))
+        return 0
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    from paddle_tpu.fluid.host_embedding import HostEmbeddingSession
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        sess = HostEmbeddingSession(exe, main_prog, loss=loss)
+        events = 0
+        for w in range(windows):
+            for f in _window_feeds(w):
+                sess.run(f, fetch_list=[loss], lr=0.1)
+                events += B
+            ck.save(step=(w + 1) * STEPS_PER_WINDOW, events_done=events,
+                    window=w + 1)
+            if kill_after >= 0 and w + 1 == kill_after:
+                # half a window of post-commit work, then die mid-stream
+                for f in _window_feeds(w + 1)[: STEPS_PER_WINDOW // 2]:
+                    sess.run(f, fetch_list=[loss], lr=0.1)
+                sys.stdout.flush()
+                os.kill(os.getpid(), signal.SIGKILL)
+    print(json.dumps({"events_done": events, "digest": _digest(table)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

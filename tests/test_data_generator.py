@@ -1,0 +1,110 @@
+"""incubate.data_generator (reference MultiSlotDataGenerator parity,
+VERDICT #4): raw log lines -> MultiSlot line protocol -> round trip
+through the native Dataset channel engine."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.dataset import DatasetFactory, pad_batch
+from paddle_tpu.incubate.data_generator import (
+    DataGenerator,
+    MultiSlotDataGenerator,
+)
+
+
+class CtrGen(MultiSlotDataGenerator):
+    """Raw line: "<click> <id> <id> ..." -> two slots (ids, label)."""
+
+    def generate_sample(self, line):
+        def gen():
+            parts = line.split()
+            if len(parts) < 2:
+                return                      # malformed line dropped
+            yield [("ids", [int(p) for p in parts[1:]]),
+                   ("label", float(parts[0]))]
+        return gen()
+
+
+def _raw_lines():
+    return ["1 4 7 9\n", "0 2\n", "bad\n", "1 11 3\n"]
+
+
+def test_protocol_lines():
+    gen = CtrGen()
+    lines = list(gen.process(_raw_lines()))
+    assert lines == ["3 4 7 9 1 1.0\n", "1 2 1 0.0\n", "2 11 3 1 1.0\n"]
+
+
+def test_run_from_stdin_is_the_pipe_command_shape():
+    gen = CtrGen()
+    out = io.StringIO()
+    gen.run_from_stdin(stdin=iter(_raw_lines()), stdout=out)
+    assert out.getvalue().count("\n") == 3
+
+
+def test_generate_batch_hook_sees_batches():
+    """set_batch scopes the cross-sample hook (negative sampling et
+    al.): generate_batch receives groups of batch_size samples."""
+    sizes = []
+
+    class BatchGen(CtrGen):
+        def generate_batch(self, samples):
+            sizes.append(len(samples))
+            for s in samples:
+                yield s
+
+    g = BatchGen()
+    g.set_batch(2)
+    assert len(list(g.process(_raw_lines()))) == 3
+    assert sizes == [2, 1]                   # 3 samples in groups of 2
+
+
+def test_empty_slot_rejected():
+    class BadGen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def gen():
+                yield [("ids", [])]
+            return gen()
+
+    with pytest.raises(ValueError, match="zero values"):
+        list(BadGen().process(["x\n"]))
+
+
+def test_round_trip_through_native_dataset_engine(tmp_path):
+    """Authoring -> protocol files -> native channel engine -> parsed
+    batches: ids and labels survive bit-exact, ragged lengths intact."""
+    raw = str(tmp_path / "raw.log")
+    rng = np.random.RandomState(4)
+    want = []
+    with open(raw, "w") as fh:
+        for _ in range(20):
+            n = rng.randint(1, 5)
+            ids = rng.randint(0, 100, n)
+            click = int(rng.rand() < 0.5)
+            fh.write("%d %s\n" % (click, " ".join(map(str, ids))))
+            want.append((list(ids), float(click)))
+
+    files = CtrGen().run_from_files([raw], str(tmp_path / "slots"))
+    assert files and files[0].endswith(".slot")
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        ids_v = fluid.data("ids", [-1, 1], "int64")
+        lab_v = fluid.data("label", [-1, 1], "float32")
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    ds.set_filelist(files)
+    ds.set_batch_size(6)
+    ds.set_thread(1)
+    ds.set_use_var([ids_v, lab_v])
+
+    got = []
+    for batch in ds:
+        vals, lod = batch["ids"]
+        labels = batch["label"][0]
+        dense, mask = pad_batch(vals, lod)
+        for r in range(dense.shape[0]):
+            got.append((list(dense[r][mask[r] > 0]), float(labels[r])))
+    assert sorted(got) == sorted(want)

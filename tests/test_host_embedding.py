@@ -161,3 +161,59 @@ def test_host_embedding_save_load(tmp_path):
     table._rows[:5] = 0
     table.load(p)
     assert np.all(table._rows[:5] == np.float32(1.25))
+
+
+def test_push_validates_id_range_like_pull():
+    """Out-of-range ids must raise on BOTH verbs — push used to index
+    the shard arrays unchecked (negative ids aliased via python
+    wraparound, overflow ids crashed deep in numpy)."""
+    import pytest
+
+    from paddle_tpu.fluid.host_embedding import HostEmbedding
+
+    t = HostEmbedding("rng_t", 100, 4, optimizer="sgd")
+    g = np.ones((1, 4), np.float32)
+    with pytest.raises(IndexError, match="push of rng_t"):
+        t.push(np.asarray([100]), g)
+    with pytest.raises(IndexError, match="push of rng_t"):
+        t.push(np.asarray([-1]), g)
+    with pytest.raises(IndexError, match="pull of rng_t"):
+        t.pull(np.asarray([250]))
+    t.push(np.asarray([99]), g)  # boundary id is fine
+
+
+def test_save_load_npz_suffix_consistent(tmp_path):
+    """np.savez silently appends .npz; save and load must agree on the
+    real filename whether or not the caller wrote the extension."""
+    from paddle_tpu.fluid.host_embedding import HostEmbedding, _npz_path
+
+    assert _npz_path("x") == "x.npz" and _npz_path("x.npz") == "x.npz"
+    t = HostEmbedding("sfx_t", 50, 4, optimizer="sgd")
+    t._rows[:3] = 2.5
+    t.save(str(tmp_path / "bare"))          # writes bare.npz
+    t.save(str(tmp_path / "ext.npz"))       # writes ext.npz, not .npz.npz
+    import os
+
+    assert sorted(os.listdir(tmp_path)) == ["bare.npz", "ext.npz"]
+    for name in ("bare", "bare.npz", "ext", "ext.npz"):
+        t2 = HostEmbedding("sfx_t2", 50, 4, optimizer="sgd")
+        t2.load(str(tmp_path / name))
+        assert np.all(t2._rows[:3] == np.float32(2.5))
+
+
+def test_save_delta_apply_delta_roundtrip(tmp_path):
+    """save_delta persists only touched rows; apply_delta replays them
+    into a fresh table (the streaming delta-checkpoint payload)."""
+    from paddle_tpu.fluid.host_embedding import HostEmbedding
+
+    t = HostEmbedding("dlt_t", 80, 4, seed=1)
+    t.track_touched = True       # opt-in (DeltaCheckpointer's job)
+    ids = np.asarray([3, 9, 41], np.int64)
+    t.push(ids, np.ones((3, 4), np.float32), lr=0.5)
+    n = t.save_delta(str(tmp_path / "d0"), touched=t.collect_touched())
+    assert n == 3
+    t2 = HostEmbedding("dlt_t2", 80, 4, seed=2)  # different init
+    assert not np.array_equal(t2._rows[ids], t._rows[ids])
+    assert t2.apply_delta(str(tmp_path / "d0")) == 3
+    np.testing.assert_array_equal(t2._rows[ids], t._rows[ids])
+    np.testing.assert_array_equal(t2._accum[ids], t._accum[ids])

@@ -236,3 +236,117 @@ def test_zoo_bert_bhsd_layout_folds_clean(monkeypatch):
         folded, ("layout-transpose-hazard", "unfused-epilogue"))
     types = [op.type for op in folded.global_block.ops]
     assert "transpose2" not in types
+
+
+# ---------------------------------------------------------------------------
+# host-exchange-bytes budget: the recsys path's fourth roofline axis
+# (fluid.host_embedding pull/push traffic priced via OpCost.host_bytes)
+# ---------------------------------------------------------------------------
+
+# zoo CTR model: batch 256 x 16 ids into a [200k, 32] host table.  The
+# static upper bound bills one row per looked-up id both ways (pull f32
+# row + push f32 grad row + ids): 256*16 * (32*4 + 32*4 + 16) = 1.11 MB
+# per step.  Budget ~2.5x so estimator recalibration never trips it but
+# an accidental double-exchange (a lowering that re-pulls, a layout
+# change that inflates the row payload) does.
+_HOSTEX_BUDGET_BYTES = 2.8e6
+
+
+def _build_ctr_recsys():
+    ids = layers.data("ids", shape=[256, 16], dtype="int64",
+                      append_batch_size=False)
+    emb = layers.embedding(ids, size=[200_000, 32], is_distributed=True,
+                           param_attr="gate_ctr.emb")
+    pooled = layers.reduce_mean(emb, dim=1)
+    h = layers.fc(pooled, size=64, act="relu", param_attr="gate_ctr.w")
+    return layers.fc(h, size=1, param_attr="gate_ctr.out")
+
+
+def test_zoo_recsys_host_exchange_bytes_within_budget():
+    from paddle_tpu.analysis import perf
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        _build_ctr_recsys()
+    chip = perf.ChipSpec(
+        "gate", float(PEAK_FLOPS), float(HBM_BW), host_bw=1.6e10)
+    rep = perf.program_cost(main, chip=chip)
+    host = rep.total_host_bytes
+    assert 0 < host <= _HOSTEX_BUDGET_BYTES, (
+        "zoo recsys step wants %.2f MB across the host link (budget "
+        "%.2f MB): an exchange or lowering change inflated the "
+        "distributed-embedding traffic — re-pin only if intentional"
+        % (host / 1e6, _HOSTEX_BUDGET_BYTES / 1e6))
+    # binds-check: the estimate is non-trivial (at least one full
+    # pull+push of every looked-up row) and prices against host_bw —
+    # the lookup op must be host-bound on this chip
+    assert host >= 256 * 16 * (32 * 4 + 32 * 4)
+    lookup = [e for e in rep.entries if e.op_type == "lookup_table"]
+    assert lookup and lookup[0].bound == "host"
+    # ... and the dimension reaches the CLI gate: totals + chip carry it
+    d = rep.to_dict()
+    assert d["totals"]["host_bytes"] == host
+    assert d["chip"]["host_bw"] == 1.6e10
+
+
+def test_host_exchange_dimension_off_for_dense_embedding():
+    """A plain in-HBM embedding must NOT be billed host traffic — the
+    dimension prices only the is_distributed host-table path."""
+    from paddle_tpu.analysis import perf
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("dids", shape=[8, 4], dtype="int64",
+                          append_batch_size=False)
+        layers.embedding(ids, size=[100, 8], param_attr="gate_dense.emb")
+    rep = perf.program_cost(main)
+    assert rep.total_host_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL-mid-stream drill: delta-checkpoint restore loses at most one
+# checkpoint window
+# ---------------------------------------------------------------------------
+
+STREAM_CRASH_WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "streaming_crash_worker.py")
+
+
+def test_sigkill_mid_stream_restores_within_one_window(tmp_path):
+    """Train 3 windows committing a delta checkpoint per window, then
+    SIGKILL mid-window-4 (post-commit work in flight, no cleanup).
+    Restore must land EXACTLY on the window-3 commit — at most one
+    window of events lost — and the restored table must be
+    bit-identical to an uninterrupted run truncated at that commit
+    (same digest), proving replay correctness, not just liveness."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    root = str(tmp_path / "ck")
+    p = subprocess.run(
+        [_sys.executable, STREAM_CRASH_WORKER, "train", root, "8", "3"],
+        capture_output=True, text=True)
+    assert p.returncode == -9, (p.returncode, p.stderr[-500:])
+
+    p = subprocess.run(
+        [_sys.executable, STREAM_CRASH_WORKER, "restore", root, "0"],
+        capture_output=True, text=True)
+    assert p.returncode == 0, p.stderr[-500:]
+    got = _json.loads(p.stdout.strip().splitlines()[-1])
+    # window 4 was half-trained when the kill landed; the committed
+    # chain ends at window 3 — exactly one window boundary behind
+    assert got["window"] == 3
+    assert got["events_done"] == 3 * 4 * 8     # windows x steps x batch
+
+    # ground truth: an uninterrupted 3-window run's table digest
+    p = subprocess.run(
+        [_sys.executable, STREAM_CRASH_WORKER, "train",
+         str(tmp_path / "ck2"), "3"],
+        capture_output=True, text=True)
+    assert p.returncode == 0, p.stderr[-500:]
+    want = _json.loads(p.stdout.strip().splitlines()[-1])
+    assert got["digest"] == want["digest"], (
+        "restored table diverges from the uninterrupted run: delta "
+        "replay is lossy or misordered")

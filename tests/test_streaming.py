@@ -1,0 +1,467 @@
+"""Recsys-scale online learning (paddle_tpu.streaming + the pipelined
+host-embedding engine): exact-parity drill (pipelined == synchronous,
+bit-identical, with and without the hot-row cache), bounded-staleness
+mode, delta-checkpoint chain save/replay, and the end-to-end
+train-from-stream -> delta ckpt -> export -> verify -> hot-swap drill
+against a live serving router under client load."""
+
+import threading
+import time
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.framework as fw
+from paddle_tpu import streaming
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.host_embedding import (
+    HostEmbeddingSession,
+    HotRowCache,
+    PipelinedHostEmbeddingSession,
+)
+from paddle_tpu.observability.metrics import MetricsRegistry
+
+V, D, T, B = 5000, 8, 4, 8
+
+
+def _build(seed=3, optimizer="adagrad"):
+    fw.reset_default_programs()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[-1, T], dtype="int64",
+                          append_batch_size=False)
+        y = layers.data("y", shape=[-1, 1], append_batch_size=False)
+        emb = layers.embedding(ids, size=[V, D], is_distributed=True,
+                               param_attr="st.emb")
+        pooled = layers.reduce_mean(emb, dim=1)
+        pred = layers.fc(pooled, size=1, param_attr="st.fc.w",
+                         bias_attr="st.fc.b")
+        loss = layers.reduce_mean(layers.square(pred - y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    table, _slot = main._host_embeddings["st.emb"]
+    table.optimizer = optimizer
+    return main, startup, loss, table
+
+
+def _batches(steps, hot=300, seed=0):
+    """Consecutive batches drawn from a small hot pool so uniq(t)
+    overlaps uniq(t-1) — the conflict path must actually fire."""
+    rng = np.random.RandomState(seed)
+    pool = rng.randint(0, V, size=hot)
+    return [{"ids": pool[rng.randint(0, hot, (B, T))].astype(np.int64),
+             "y": rng.randn(B, 1).astype(np.float32)}
+            for _ in range(steps)]
+
+
+def _run_to_final_rows(kind, feeds, cache=0, exact=True, registry=None):
+    """Final host-table rows (+accum) after training `feeds` with one
+    engine; fresh identically-seeded model each call."""
+    main, startup, loss, table = _build()
+    if cache:
+        table.attach_cache(cache)
+    if registry is not None:
+        table.enable_stats(registry=registry)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        if kind == "sync":
+            sess = HostEmbeddingSession(exe, main, loss=loss)
+            losses = [float(sess.run(f, fetch_list=[loss], lr=0.1)[0])
+                      for f in feeds]
+        else:
+            with PipelinedHostEmbeddingSession(
+                    exe, main, loss=loss, exact=exact) as sess:
+                losses = [float(o[0]) for o in sess.run_stream(
+                    feeds, fetch_list=[loss], lr=0.1)]
+    table.flush_cache()
+    return table._rows.copy(), table._accum.copy(), losses
+
+
+# ---------------------------------------------------------------------------
+# the exact-parity drill (acceptance: bit-identical final table)
+# ---------------------------------------------------------------------------
+
+
+def test_pipelined_exact_parity_bit_identical():
+    """Pipelined (conflict serialization ON) vs synchronous over hot
+    overlapping batches: the final table must be BIT-identical, and the
+    conflict path must actually have fired (else the drill proves
+    nothing)."""
+    feeds = _batches(16)
+    ref_rows, ref_accum, ref_losses = _run_to_final_rows("sync", feeds)
+    reg = MetricsRegistry()
+    rows, accum, losses = _run_to_final_rows("pipe", feeds, registry=reg)
+    assert np.array_equal(ref_rows, rows)
+    assert np.array_equal(ref_accum, accum)
+    np.testing.assert_allclose(ref_losses, losses, rtol=0, atol=0)
+    snap = reg.snapshot()["hostemb_pipeline_conflicts_total"]["series"]
+    assert snap and snap[0]["value"] > 0, "conflict path never exercised"
+
+
+def test_pipelined_exact_parity_with_hot_row_cache():
+    """Cache on: hits skip the exchange but the math must stay
+    bit-identical to the synchronous no-cache oracle."""
+    feeds = _batches(12, seed=5)
+    ref_rows, ref_accum, _ = _run_to_final_rows("sync", feeds)
+    rows, accum, _ = _run_to_final_rows("pipe", feeds, cache=256)
+    assert np.array_equal(ref_rows, rows)
+    assert np.array_equal(ref_accum, accum)
+
+
+def test_pipelined_discards_stale_prefetch_on_reentry():
+    """A caller loop that stops early (StreamingTrainer max_steps)
+    leaves batch t+1's pull queued; a later run() for a DIFFERENT
+    batch must not train on the stale prefetched rows — the session
+    discards it and stays bit-identical to the sync oracle."""
+    feeds = _batches(8, seed=41)
+    # the oracle never sees feeds[4]: the stream dropped it between
+    # the two loops
+    ref_rows, _a, _l = _run_to_final_rows("sync",
+                                          feeds[:4] + feeds[5:])
+
+    main, startup, loss, table = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with PipelinedHostEmbeddingSession(exe, main, loss=loss) as sess:
+            # first "trainer.run": stops after 4 steps with feeds[4]
+            # prefetched and never trained
+            for t in range(4):
+                sess.run(feeds[t], fetch_list=[loss], lr=0.1,
+                         next_feed=feeds[t + 1])
+            # re-entry resumes at feeds[5]: the stale feeds[4] pull
+            # must be discarded, not paired with feeds[5]'s labels
+            for t in range(5, len(feeds)):
+                sess.run(feeds[t], fetch_list=[loss], lr=0.1)
+            sess.drain()
+    assert np.array_equal(ref_rows, table._rows)
+
+
+def test_pipelined_inexact_mode_bounded_staleness_still_trains():
+    """exact=False trades the conflict patch for one-step staleness on
+    the conflicting rows only — training still converges."""
+    rng = np.random.RandomState(2)
+    pool = rng.randint(0, V, 64)
+    w = rng.randn(64)
+    lut = dict(zip(pool, w))
+    feeds = []
+    for _ in range(40):
+        ids = pool[rng.randint(0, 64, (B, T))]
+        ys = np.vectorize(lut.get)(ids).mean(1, keepdims=True)
+        feeds.append({"ids": ids.astype(np.int64),
+                      "y": ys.astype(np.float32)})
+    _rows, _accum, losses = _run_to_final_rows("pipe", feeds, exact=False)
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_pipelined_background_push_failure_surfaces():
+    """A push that fails in the background lane has no waiter unless a
+    later step conflicts — the session must still raise at the next
+    call instead of training past a lost gradient update."""
+    import pytest
+
+    feeds = _batches(6, seed=43)
+    main, startup, loss, table = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    orig = table._push_impl
+    calls = [0]
+
+    def flaky(uniq, g, lr):
+        calls[0] += 1
+        if calls[0] == 2:
+            raise OSError("parameter server gone")
+        return orig(uniq, g, lr)
+
+    table._push_impl = flaky
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        sess = PipelinedHostEmbeddingSession(exe, main, loss=loss)
+        # the error surfaces either as the original (a conflicting
+        # step waited the failed op) or wrapped by the async check
+        with pytest.raises((RuntimeError, OSError)):
+            for f in feeds:
+                sess.run(f, fetch_list=[loss], lr=0.1)
+            sess.drain()          # backstop if no later run noticed
+        table._push_impl = orig
+        try:
+            sess.close()
+        except RuntimeError:
+            pass                  # the close-time drain re-reports it
+
+
+# ---------------------------------------------------------------------------
+# hot-row cache mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_hot_row_cache_hits_evicts_and_flushes():
+    main, startup, loss, table = _build(seed=11)
+    cache = table.attach_cache(8)
+    ids = np.arange(6, dtype=np.int64) * 7
+    pulled1, _l, uniq = table.pull(ids)
+    assert cache.misses == 6 and cache.hits == 0
+    pulled2, _l, _u = table.pull(ids)          # all resident now
+    assert cache.hits == 6
+    np.testing.assert_array_equal(np.asarray(pulled1), np.asarray(pulled2))
+    # update through push lands in the cache mirror, not the shard
+    g = np.ones((len(uniq), D), np.float32)
+    table.push(uniq, g, lr=0.5)
+    stale_shard = table._rows[uniq // table.nproc].copy()
+    fresh = table._peek_rows(uniq)
+    assert not np.array_equal(stale_shard, fresh)
+    # eviction (capacity 8, insert 8 new rows) writes victims back
+    more = (np.arange(8, dtype=np.int64) * 11 + 2000)
+    table.pull(more)
+    table.flush_cache()
+    np.testing.assert_array_equal(table._rows[uniq // table.nproc], fresh)
+    assert 0.0 < cache.hit_rate < 1.0
+    assert cache.metrics()["resident"] <= 8
+
+
+def test_cache_requires_single_process_and_capacity_knob_exists():
+    from paddle_tpu.tune.space import cache_capacity_candidates
+
+    cands = cache_capacity_candidates(capacities=(0, 64, 9999),
+                                      table_rows=1000)
+    labels = [c.label for c in cands]
+    assert labels[0] == "nocache"              # measured baseline first
+    assert "cache64" in labels and "cache9999" not in labels
+    assert cands[0].params["cache_capacity"] == 0
+
+
+# ---------------------------------------------------------------------------
+# delta checkpoints
+# ---------------------------------------------------------------------------
+
+
+def test_delta_checkpoint_chain_save_and_replay(tmp_path):
+    """full -> delta -> delta ... restore replays the chain in order
+    and lands bit-identical to the live table."""
+    main, startup, loss, table = _build(seed=7)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    ck = streaming.DeltaCheckpointer(str(tmp_path / "ck"), [table],
+                                     full_every=4)
+    feeds = _batches(9, seed=9)
+    kinds = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        sess = HostEmbeddingSession(exe, main, loss=loss)
+        for i, f in enumerate(feeds):
+            sess.run(f, fetch_list=[loss], lr=0.1)
+            if i % 3 == 2:
+                _no, kind = ck.save(step=i, events_done=(i + 1) * B)
+                kinds.append(kind)
+    assert kinds[0] == "full" and "delta" in kinds
+    want_rows = table._rows.copy()
+    want_accum = table._accum.copy()
+
+    # a fresh table (different seed => different init) must restore to
+    # the exact committed state through full + delta replay
+    main2, _st, _l, table2 = _build(seed=99)
+    ck2 = streaming.DeltaCheckpointer(str(tmp_path / "ck"), [table2],
+                                      full_every=4)
+    meta = ck2.restore()
+    assert meta["kind"] == kinds[-1]
+    assert meta["events_done"] == 9 * B
+    np.testing.assert_array_equal(table2._rows, want_rows)
+    np.testing.assert_array_equal(table2._accum, want_accum)
+
+
+def test_delta_checkpoint_failed_commit_requeues_touched(tmp_path):
+    main, _st, _l, table = _build(seed=13)
+    ck = streaming.DeltaCheckpointer(str(tmp_path / "ck"), [table])
+    table.push(np.asarray([3, 5], np.int64), np.ones((2, D), np.float32))
+    ck.save()                                   # full, drains touched
+    table.push(np.asarray([7], np.int64), np.ones((1, D), np.float32))
+    saver = ck._saver
+
+    def boom(*a, **kw):
+        raise OSError("disk gone")
+
+    orig = saver.save_checkpoint
+    saver.save_checkpoint = boom
+    try:
+        try:
+            ck.save()
+        except OSError:
+            pass
+        else:
+            raise AssertionError("expected the injected failure")
+    finally:
+        saver.save_checkpoint = orig
+    # the touched row survived the failed commit and lands in the next
+    _no, kind = ck.save()
+    assert kind == "delta"
+    meta = ck._saver.list_checkpoints()[-1][1]
+    assert meta["touched_rows"]["st.emb"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end streaming drill (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_train_to_freshness_drill(tmp_path):
+    """Train-from-stream -> delta checkpoint -> export -> PR-5 verify
+    (inside Router.deploy) -> hot-swap into a live router, with client
+    load across the swap: ZERO failed requests, freshness measured,
+    and the served prediction matches the trained table."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import serving
+    from paddle_tpu.incubate.checkpoint.checkpoint_saver import PaddleModel
+
+    main, startup, loss, table = _build(seed=21)
+    table.attach_cache(128)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    reg = MetricsRegistry()
+    router = serving.Router(max_batch=4, batch_timeout_ms=1,
+                            metrics_registry=reg)
+    probe = {"ids": np.zeros((1, T), np.int64)}
+
+    def export_fn(no):
+        fw.reset_default_programs()
+        imain, istart = fluid.Program(), fluid.Program()
+        with fluid.program_guard(imain, istart):
+            ids = layers.data("ids", shape=[-1, T], dtype="int64",
+                              append_batch_size=False)
+            emb = layers.embedding(ids, size=[V, D],
+                                   param_attr="st.emb.dense")
+            pooled = layers.reduce_mean(emb, dim=1)
+            pred = layers.fc(pooled, size=1, param_attr="st.fc.w",
+                             bias_attr="st.fc.b")
+        s = fluid.Scope()
+        with fluid.scope_guard(s):
+            exe.run(istart)
+            s.set("st.emb.dense", jnp.asarray(table.export_rows()))
+            for nm in ("st.fc.w", "st.fc.b"):
+                s.set(nm, jnp.asarray(np.asarray(
+                    scope.find_var(nm)).copy()))
+            path = str(tmp_path / ("export_v%d" % no))
+            fluid.io.save_inference_model(path, ["ids"], [pred], exe,
+                                          imain)
+        return path
+
+    failures = []
+    n_ok = [0]
+    stop = threading.Event()
+
+    def client():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                router.infer(probe, request_id="cl-%d" % i, timeout=30)
+                n_ok[0] += 1
+            except serving.TransitionError:
+                time.sleep(0.01)       # nothing promoted yet: not a failure
+            except Exception as e:
+                failures.append(repr(e))
+                return
+            time.sleep(0.002)
+
+    feeds = _batches(24, seed=31)
+    cl = threading.Thread(target=client)
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            sess = PipelinedHostEmbeddingSession(exe, main, loss=loss)
+            ck = streaming.DeltaCheckpointer(
+                str(tmp_path / "ck"), [table],
+                dense=PaddleModel(exe, main, scope), full_every=3)
+            push = streaming.PushToServing(
+                router, export_fn, warmup_example=probe,
+                probe_example=probe)
+            trainer = streaming.StreamingTrainer(
+                sess, feeds, [loss], lr=0.1, window_events=4 * B,
+                checkpoint=ck, push=push, push_every_windows=2,
+                metrics_registry=reg)
+            cl.start()
+            report = trainer.run()
+            time.sleep(0.05)           # client traffic on the new version
+            sess.close()
+            trainer.close()
+            # served-prediction probe while the router is still live
+            ids_v = feeds[0]["ids"][:1]
+            served = np.asarray(
+                router.infer({"ids": ids_v}, timeout=30)[0])
+    finally:
+        stop.set()
+        cl.join(30)
+        router.shutdown(drain_timeout=5)
+
+    # zero failed requests across the hot swap(s)
+    assert not failures, failures[:3]
+    assert n_ok[0] > 0
+    snap = reg.snapshot()
+    errs = snap.get("serving_fleet_errors_total")
+    assert not errs or sum(s["value"] for s in errs["series"]) == 0
+
+    # the loop did everything it claims: windows, checkpoints, pushes
+    assert len(report.windows) >= 2
+    assert report.checkpoints and report.checkpoints[0][1] == "full"
+    assert len(report.pushes) >= 1
+    assert report.events == 24 * B
+    # freshness (event ingested -> served by new version) was measured
+    assert report.freshness_s is not None and report.freshness_s > 0
+    for p in report.pushes:
+        assert p["freshness_oldest_s"] > 0
+
+    # the promoted version serves the TRAINED table: prediction through
+    # the router equals a local forward with the exported weights
+    rows = table.export_rows()[ids_v[0]]
+    with fluid.scope_guard(scope):
+        w = np.asarray(scope.find_var("st.fc.w"))
+        b = np.asarray(scope.find_var("st.fc.b"))
+    want = rows.mean(0) @ w + b
+    np.testing.assert_allclose(served[0], want, atol=1e-4)
+
+    # streaming metrics landed on the registry
+    for fam in ("streaming_events_total", "streaming_windows_total",
+                "streaming_pushes_total", "streaming_freshness_s"):
+        series = snap[fam]["series"]
+        assert series and series[0]["value"] > 0, fam
+
+
+def test_stream_source_and_dataset_stream(tmp_path):
+    """StreamSource wraps iterables with event counts + ingest stamps;
+    dataset_stream bridges the native Dataset channel engine."""
+    src = streaming.StreamSource(
+        ({"x": np.zeros((5, 2))} for _ in range(3)))
+    got = list(src)
+    assert [b.n_events for b in got] == [5, 5, 5]
+    assert all(b.ingested_at > 0 for b in got)
+    src2 = streaming.StreamSource(iter(got), limit=2)
+    assert len(list(src2)) == 2
+
+    from paddle_tpu.fluid.dataset import DatasetFactory, pad_batch
+
+    path = str(tmp_path / "p.txt")
+    with open(path, "w") as fh:
+        for i in range(8):
+            fh.write("2 %d %d 1 0.5\n" % (i, i + 1))
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        ids = fluid.data("ids", [-1, 1], "int64")
+        lab = fluid.data("label", [-1, 1], "float32")
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    ds.set_filelist([path])
+    ds.set_batch_size(4)
+    ds.set_thread(1)
+    ds.set_use_var([ids, lab])
+
+    def make_feed(raw):
+        vals, lod = raw["ids"]
+        dense, _mask = pad_batch(vals, lod, pad_value=0)
+        return {"ids": dense, "label": raw["label"][0].reshape(-1, 1)}
+
+    stream = streaming.dataset_stream(ds, make_feed)
+    batches = list(stream)
+    assert sum(b.n_events for b in batches) == 8
+    assert all(isinstance(b.feed["ids"], np.ndarray) for b in batches)

@@ -630,3 +630,94 @@ def test_scalar_writer_roundtrip_and_append(tmp_path):
     with obs.ScalarWriter(p) as w:
         w.add_scalar("loss", 0.1, 5)
     assert len(obs.ScalarWriter.read(str(p))) == 8
+
+
+# ---------------------------------------------------------------------------
+# host-embedding + streaming observability (PR-14): labeled metric
+# families and trace spans for the online-learning hot path
+# ---------------------------------------------------------------------------
+
+
+def test_host_embedding_metrics_and_spans():
+    """pull/push/exchange ms, exchange bytes, unique-id ratio, cache
+    hit rate + staleness, pipeline conflicts: all land as labeled PR-4
+    families, and the pull/push spans hit the PR-6 tracer."""
+    from paddle_tpu.fluid.host_embedding import HostEmbedding
+    from paddle_tpu.observability import trace as trace_mod
+
+    reg = MetricsRegistry()
+    t = HostEmbedding("obs_t", 500, 4, optimizer="sgd")
+    t.enable_stats(registry=reg)
+    t.attach_cache(16)
+    tracer = trace_mod.enable_tracing()
+    try:
+        ids = np.asarray([[1, 2, 2, 7]], np.int64)
+        _p, _l, uniq = t.pull(ids)
+        t.push(uniq, np.ones((len(uniq), 4), np.float32))
+        t.pull(ids)                         # all cached now: hits
+    finally:
+        trace_mod.disable_tracing()
+
+    snap = reg.snapshot()
+
+    def one(name):
+        fam = snap[name]
+        series, = fam["series"]
+        assert series["labels"] == {"table": t.stats.instance_label}
+        return series
+
+    assert one("hostemb_pull_ms")["count"] == 2
+    assert one("hostemb_push_ms")["count"] == 1
+    assert one("hostemb_exchange_ms")["count"] >= 1
+    assert one("hostemb_exchange_bytes_total")["value"] > 0
+    # 4 ids, 3 unique, observed once per pull
+    ur = one("hostemb_unique_ratio")
+    assert ur["count"] == 2 and ur["sum"] == pytest.approx(1.5)
+    assert one("hostemb_cache_misses_total")["value"] == 3
+    assert one("hostemb_cache_hits_total")["value"] == 3
+    assert one("hostemb_cache_hit_rate")["value"] == pytest.approx(0.5)
+    assert one("hostemb_cache_staleness_steps")["count"] == 1
+    names = [e["name"] for e in tracer.events() if e.get("ph") == "X"]
+    assert "hostemb.pull" in names and "hostemb.push" in names
+    # label released on close so the next instance gets a fresh child
+    t.stats.close()
+
+
+def test_streaming_delta_lag_and_window_metrics(tmp_path):
+    """The streaming loop's delta-checkpoint lag gauge + window
+    families land on the registry (the freshness loop's dashboards)."""
+    from paddle_tpu import streaming
+    from paddle_tpu.fluid.host_embedding import HostEmbedding
+
+    reg = MetricsRegistry()
+    table = HostEmbedding("lag_t", 100, 4, optimizer="sgd")
+    ck = streaming.DeltaCheckpointer(str(tmp_path / "ck"), [table])
+
+    class _Sess:
+        def run(self, feed, fetch_list=None, lr=None):
+            table.push(np.unique(feed["ids"]),
+                       np.ones((len(np.unique(feed["ids"])), 4),
+                               np.float32))
+            return [np.float32(0.5)]
+
+    feeds = [{"ids": np.arange(i, i + 4, dtype=np.int64).reshape(1, 4)}
+             for i in range(6)]
+    tr = streaming.StreamingTrainer(
+        _Sess(), feeds, ["loss"], window_events=2,
+        checkpoint=ck, metrics_registry=reg)
+    report = tr.run()
+    tr.close()
+    assert len(report.windows) == 3
+    snap = reg.snapshot()
+
+    def val(name, key="value"):
+        return snap[name]["series"][0][key]
+
+    assert val("streaming_events_total") == 6
+    assert val("streaming_steps_total") == 6
+    assert val("streaming_windows_total") == 3
+    assert val("streaming_window_loss") == pytest.approx(0.5)
+    assert val("streaming_events_per_s") > 0
+    # the lag gauge ticked after the first commit
+    assert val("streaming_delta_lag_s") >= 0
+    assert report.checkpoints

@@ -42,6 +42,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.fluid.contrib.slim.core",
     "paddle_tpu.incubate.checkpoint",
     "paddle_tpu.incubate.complex",
+    "paddle_tpu.incubate.data_generator",
     "paddle_tpu.incubate.fault",
     "paddle_tpu.io",
     "paddle_tpu.observability",
@@ -55,6 +56,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.fleet",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.streaming",
     "paddle_tpu.tune",
 ]
 

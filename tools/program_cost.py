@@ -4,8 +4,8 @@ Usage::
 
     python tools/program_cost.py path/to/__model__.json \
         [--dynamic-dim 8] [--peak-flops 1.97e14] [--hbm-bw 8.19e11] \
-        [--ici-bw 4.5e10] [--mesh dp=8] [--top 10] [--json] [--no-ops] \
-        [--budget-ms 5.0]
+        [--ici-bw 4.5e10] [--host-bw 1.6e10] [--mesh dp=8] [--top 10] \
+        [--json] [--no-ops] [--budget-ms 5.0]
 
 Runs the `paddle_tpu.analysis.perf` static cost model (FLOPs / bytes /
 roofline time per op on a parameterized chip) over the program and
@@ -28,15 +28,16 @@ JSON schema (``schema_version`` 1, pinned for CI consumers)::
       "schema_version": 1,
       "model": "<path>",
       "chip": {"name": str, "peak_flops": float, "hbm_bw": float,
-               "ici_bw": float | null},
+               "ici_bw": float | null, "host_bw": float | null},
       "dynamic_dim": int,
       "totals": {"flops", "transcendentals", "bytes", "comm_bytes",
-                 "time_s", "arithmetic_intensity", "op_count"},
+                 "host_bytes", "time_s", "arithmetic_intensity",
+                 "op_count"},
       "by_op_type": [{"op_type", "count", "flops", "bytes",
-                      "comm_bytes", "time_s"}],
+                      "comm_bytes", "host_bytes", "time_s"}],
       "ops": [{"block_idx", "op_idx", "op_type", "flops",
-               "transcendentals", "bytes", "comm_bytes", "time_s",
-               "bound", "provenance"}], # omitted with --no-ops
+               "transcendentals", "bytes", "comm_bytes", "host_bytes",
+               "time_s", "bound", "provenance"}], # omitted with --no-ops
       "budget_ms": float | null,
       "within_budget": bool | null
     }
@@ -71,6 +72,10 @@ def main(argv=None):
     ap.add_argument("--ici-bw", type=float, default=None,
                     help="chip ICI bytes/s for collective pricing "
                          "(same resolution order, v5e fallback)")
+    ap.add_argument("--host-bw", type=float, default=None,
+                    help="host link bytes/s for distributed-embedding "
+                         "exchange pricing (same resolution order, "
+                         "v5e fallback)")
     ap.add_argument("--mesh", default=None,
                     help="mesh axes 'dp=8' or 'dp=4,tp=2': the product "
                          "is the collective group size for c_* ops "
@@ -100,7 +105,8 @@ def main(argv=None):
         return 1
 
     chip = perf.ChipSpec.detect(peak_flops=args.peak_flops,
-                                hbm_bw=args.hbm_bw, ici_bw=args.ici_bw)
+                                hbm_bw=args.hbm_bw, ici_bw=args.ici_bw,
+                                host_bw=args.host_bw)
     mesh_size = None
     if args.mesh:
         try:
