@@ -173,6 +173,8 @@ def _metrics_snapshot():
 def main():
     if "--recsys" in sys.argv:
         return _run_recsys()
+    if "--generate" in sys.argv:
+        return _run_generate()
     multichip = "--multichip" in sys.argv
     if multichip:
         n = 8
@@ -406,6 +408,20 @@ def _run_recsys():
 
     return streaming_bench.main(
         [a for a in sys.argv[1:] if a != "--recsys"])
+
+
+def _run_generate():
+    """--generate: the autoregressive-decoding capture — tokens/s,
+    TTFT, ITL, and the KV-cache-vs-recompute-prefix A/B, via
+    benchmarks/generation_bench (one JSON line with the same
+    skip/platform/smoke_config conventions as the headline bench;
+    remaining flags pass through, e.g. --autotune / --slots N)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    import generation_bench
+
+    return generation_bench.main(
+        [a for a in sys.argv[1:] if a != "--generate"])
 
 
 def _accelerator_plausible():

@@ -30,9 +30,10 @@ CUDAPlace = fluid.CUDAPlace
 def __getattr__(name):
     # lazy submodules (PEP 562): analysis is a build/debug-time tool,
     # serving is a dedicated-process front tier, tune is an offline
-    # search harness, and streaming is the online-learning loop — none
-    # may tax the import of every training/serving worker process
-    if name in ("analysis", "serving", "tune", "streaming"):
+    # search harness, streaming is the online-learning loop, and
+    # generation is the decoding engine — none may tax the import of
+    # every training/serving worker process
+    if name in ("analysis", "serving", "tune", "streaming", "generation"):
         import importlib
 
         return importlib.import_module("." + name, __name__)

@@ -34,8 +34,10 @@ from . import opgraph
 __all__ = [
     "ChipSpec",
     "CostReport",
+    "DecodeStepCost",
     "OpCost",
     "PipelineRanking",
+    "decode_step_cost",
     "program_cost",
     "op_cost_types",
     "register_op_cost",
@@ -999,3 +1001,80 @@ def rank_pass_pipelines(program, candidates, chip=None,
             names, program_cost(clone, chip=chip,
                                 dynamic_dim=dynamic_dim)))
     return sorted(ranked, key=lambda r: r.time_s)
+
+
+# ---------------------------------------------------------------------------
+# autoregressive decode-step cost (paddle_tpu.generation)
+# ---------------------------------------------------------------------------
+
+
+class DecodeStepCost:
+    """The decode step's roofline: one token per slot against a
+    ``[L, slots, cache_len, H, D]`` KV cache.
+
+    At batch 1-per-slot the MXU sees [slots, hidden] x [hidden, ...]
+    matmuls — every weight byte and every cache byte is read for O(1)
+    FLOPs per byte, so the step is **memory-bound** at any realistic
+    slot count; the ceiling is HBM bandwidth, and tokens/s scales with
+    how little you read per token.  That is the quantitative argument
+    for the KV cache (read ``2*L*len*hidden`` bytes per token instead
+    of recomputing ``O(len)`` positions) and for batching slots (the
+    weight read amortizes across slots; the KV read does not).
+
+    ``kv_read_bytes`` is per STEP (all slots); the per-token KV read is
+    ``kv_read_bytes / slots``.  `tests/test_perf_gate.py` budgets it
+    the way PR-13 gates collective bytes."""
+
+    __slots__ = ("slots", "cache_len", "flops", "kv_read_bytes",
+                 "param_read_bytes", "bytes", "time_s", "bound",
+                 "tokens_per_s", "chip")
+
+    def __init__(self, slots, cache_len, flops, kv_read_bytes,
+                 param_read_bytes, chip):
+        self.slots = int(slots)
+        self.cache_len = int(cache_len)
+        self.flops = float(flops)
+        self.kv_read_bytes = float(kv_read_bytes)
+        self.param_read_bytes = float(param_read_bytes)
+        self.bytes = self.kv_read_bytes + self.param_read_bytes
+        self.chip = chip
+        t_compute = self.flops / chip.peak_flops
+        t_memory = self.bytes / chip.hbm_bw
+        self.time_s = max(t_compute, t_memory)
+        self.bound = "compute" if t_compute >= t_memory else "memory"
+        self.tokens_per_s = (self.slots / self.time_s
+                             if self.time_s > 0 else float("inf"))
+
+    def to_dict(self):
+        return {
+            "schema_version": 1,
+            "slots": self.slots, "cache_len": self.cache_len,
+            "flops": self.flops,
+            "kv_read_bytes": self.kv_read_bytes,
+            "param_read_bytes": self.param_read_bytes,
+            "bytes": self.bytes, "time_s": self.time_s,
+            "bound": self.bound, "tokens_per_s": self.tokens_per_s,
+            "chip": self.chip.to_dict(),
+        }
+
+
+def decode_step_cost(*, num_layers, hidden_size, num_heads, vocab_size,
+                     intermediate_size=None, slots=8, cache_len=512,
+                     dtype_bytes=4, chip=None):
+    """Static decode-step estimate (see `DecodeStepCost`).
+
+    FLOPs per slot: the standard 2*N_params matmul work (QKV/out
+    projections, FFN, tied LM head) + 4*cache_len*hidden attention
+    work.  HBM bytes: every parameter once per STEP (amortized over
+    slots) + each slot's K and V cache rows once."""
+    if intermediate_size is None:
+        intermediate_size = 4 * hidden_size
+    h, L = float(hidden_size), int(num_layers)
+    per_layer_params = 4 * h * h + 2 * h * intermediate_size
+    params = L * per_layer_params + vocab_size * h
+    attn_flops = 4.0 * cache_len * h            # QK^T + PV per slot/layer
+    flops = slots * (2.0 * params + L * attn_flops)
+    kv_read = 2.0 * L * slots * cache_len * h * dtype_bytes
+    param_read = params * dtype_bytes
+    return DecodeStepCost(slots, cache_len, flops, kv_read, param_read,
+                          chip or ChipSpec.detect())

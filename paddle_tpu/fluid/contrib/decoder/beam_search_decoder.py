@@ -12,7 +12,15 @@ shape discipline every other sequence feature in this framework uses —
 driving the dense `beam_search` / `beam_search_decode` ops
 (`ops/rnn_ops.py`); finished beams carry their end token and frozen
 score exactly like the reference's pruning, without data-dependent
-shapes."""
+shapes.  Both ops are pinned against a numpy value oracle in
+`tests/test_contrib_extras.py`.
+
+.. note:: This decoder re-runs the cell on the FULL state every step
+   and recomputes what a cache would remember — it exists for
+   reference-parity of the static-graph API.  For autoregressive
+   serving use **`paddle_tpu.generation`**: KV-cached decode that
+   compiles once, continuous batching across requests, sampling
+   suites, and token streaming through the serving fleet."""
 
 from __future__ import annotations
 

@@ -1,0 +1,37 @@
+"""paddle_tpu.generation — the TPU-native autoregressive decoding
+engine (SURVEY §1 row 9's inference tier, grown from one-shot forward
+passes to token streams).
+
+* `KVCache` — fixed-shape ``[L, slots, T, H, D]`` per-layer cache,
+  donated across steps so the decode step compiles ONCE per engine
+  config;
+* prefill/decode split — prefill rides the bucketed flash-attention
+  path and writes its K/V into the cache; the decode step is a
+  single-token attention-over-cache kernel
+  (`ops.pallas.decode_attention`) with length masking;
+* `GenerationEngine` — slot-based continuous batching: requests claim
+  cache slots, finished sequences free slots mid-flight and queued
+  requests prefill into freed slots while other slots keep decoding —
+  token-for-token identical to serving one request at a time
+  (`sequential_oracle`);
+* `SamplingParams` / `sample_tokens` — greedy, temperature, top-k,
+  top-p with per-slot `jax.random` key streams;
+* serving: `paddle_tpu.serving.generation` puts engine replicas behind
+  the PR-9 front with chunked token streaming, slot-occupancy
+  admission, and requeue-once replica fault tolerance.
+
+The legacy static-graph `fluid.contrib.decoder.BeamSearchDecoder`
+recomputes the full prefix every step; this engine is the recommended
+path for autoregressive serving.
+"""
+
+from .engine import (  # noqa: F401
+    EngineDeadError,
+    GenerationEngine,
+    GenerationRequest,
+    RequestHandle,
+    default_prefill_buckets,
+    sequential_oracle,
+)
+from .kv_cache import KVCache  # noqa: F401
+from .sampling import SamplingParams, make_base_key, sample_tokens  # noqa: F401

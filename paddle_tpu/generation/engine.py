@@ -1,0 +1,694 @@
+"""`GenerationEngine`: slot-based continuous-batching autoregressive
+decoding (Orca-style iteration-level scheduling over a fixed-shape KV
+cache).
+
+The execution model, and why it compiles exactly twice per shape:
+
+* **prefill** — a new request claims a free cache slot, its prompt is
+  padded to a bucket from the prefill ladder (PR-2 discipline: a
+  bounded executable set, one per bucket length), and ONE jitted
+  ``prefill`` call runs the full causal forward on the flash-attention
+  path, writes every layer's K/V into the slot's cache rows, and
+  samples the first token from the last real position's logits.  The
+  first token is emitted immediately — that is the TTFT path.
+* **decode** — every scheduler iteration runs ONE jitted step over ALL
+  slots: one token per slot in, attention over the cache
+  (`ops.pallas.decode_attention`), one sampled token per slot out.
+  Cache arrays are donated, shapes never change, so the step compiles
+  once per (slot-count, max_len) engine config and is reused for every
+  token of every request — `_decode_cache_size()` and the PR-4 compile
+  accumulator both pin this.
+* **continuous batching** — requests finish (stop token / max tokens /
+  cache full) at different steps; their slots are freed mid-flight and
+  the next queued request prefills into the freed slot while the other
+  slots keep decoding.  Nothing ever drains the whole batch.
+
+Exactness: scheduling is invisible in the tokens.  Per-request PRNG
+streams (`sampling.py`) + row-independent slot math make the engine's
+output token-for-token identical to serving the same requests one at a
+time (`sequential_oracle`), greedy or sampled — the property
+`tests/test_generation.py` drills with slots freed and refilled
+mid-run.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fluid import framework
+from ..observability import trace as _trace
+from ..observability.metrics import default_registry, unique_instance_label
+from .kv_cache import KVCache
+from .sampling import SamplingParams, make_base_key, sample_tokens
+
+__all__ = [
+    "EngineDeadError",
+    "GenerationEngine",
+    "GenerationRequest",
+    "RequestHandle",
+    "default_prefill_buckets",
+    "sequential_oracle",
+]
+
+
+class EngineDeadError(RuntimeError):
+    """The engine died mid-generation (injected drill death or a loop
+    crash) — affected requests were NOT completed and are safe to
+    re-queue exactly once (`serving.generation.GenerationFleet`)."""
+
+
+# jit TRACING rebinds the (possibly shared) model's VarBase data and the
+# process-global dygraph tracer — two engine threads tracing at once
+# would corrupt each other.  One process-wide lock around every jitted
+# invocation serializes that window; compiled-cache hits pay only an
+# uncontended acquire (in-process replicas share a device anyway — real
+# parallel engines are separate processes/chips behind the fleet).
+_TRACE_LOCK = threading.Lock()
+
+
+def _shed_error(reason, retry_after_s, detail):
+    from ..serving.admission import ShedError
+
+    return ShedError(reason, retry_after_s, detail)
+
+
+def default_prefill_buckets(max_len):
+    """Power-of-two prompt-length ladder up to max_len (PR-2's default
+    batch-bucket shape discipline, applied to the sequence axis)."""
+    out = []
+    b = 8
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return out
+
+
+class GenerationRequest:
+    """One prompt in, one token stream out."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt_ids, max_new_tokens=16, sampling=None,
+                 stop_token_ids=(), request_id=None):
+        self.prompt_ids = [int(t) for t in np.asarray(prompt_ids).ravel()]
+        if not self.prompt_ids:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.sampling = sampling or SamplingParams.greedy()
+        self.stop_token_ids = frozenset(int(t) for t in stop_token_ids)
+        self.request_id = (request_id if request_id is not None
+                           else "genreq-%d" % next(self._ids))
+
+
+class RequestHandle:
+    """The caller's end of one request: a stream of ``(index, token)``
+    plus terminal events.  ``restart`` events reset the index stream to
+    0 (the fleet's requeue-after-replica-death path re-runs the request
+    from scratch; a consumer discards what it saw before)."""
+
+    def __init__(self, request):
+        self.request = request
+        self._q = queue.Queue()
+        self._done = threading.Event()
+        self._tokens = []
+        self.finish_reason = None
+        self.error = None
+        self.requeued = False          # fleet's requeue-once latch
+        self.t_submit = time.perf_counter()
+        self.t_first_token = None
+
+    # -- engine side ------------------------------------------------------
+    def _emit(self, index, token):
+        if index == 0:
+            self.t_first_token = time.perf_counter()
+        self._tokens.append(int(token))
+        self._q.put(("token", index, int(token)))
+
+    def _restart(self):
+        self._tokens = []
+        self._q.put(("restart", None, None))
+
+    def _finish(self, reason):
+        self.finish_reason = reason
+        self._q.put(("done", reason, None))
+        self._done.set()
+
+    def _fail(self, error):
+        self.error = str(error)
+        self._q.put(("error", str(error), None))
+        self._done.set()
+
+    # -- caller side ------------------------------------------------------
+    def events(self, timeout=30.0):
+        """Yield raw events: ("token", i, t) / ("restart",..) until the
+        terminal ("done", reason) / ("error", msg) which is yielded
+        last.  ``timeout`` bounds the wait for EACH event; exceeding it
+        raises TimeoutError (never a bare queue.Empty — the HTTP front
+        turns it into a terminal error record, see handle_generate)."""
+        while True:
+            try:
+                ev = self._q.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    "request %s produced no event within %.1fs"
+                    % (self.request.request_id, timeout)) from None
+            yield ev
+            if ev[0] in ("done", "error"):
+                return
+
+    def tokens(self, timeout=30.0):
+        """Yield ``(index, token)``; restart resets the stream."""
+        for ev in self.events(timeout=timeout):
+            if ev[0] == "token":
+                yield ev[1], ev[2]
+            elif ev[0] == "error":
+                raise RuntimeError(ev[1])
+
+    def result(self, timeout=30.0):
+        """Block until done; the complete generated token list."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                "request %s not finished" % self.request.request_id)
+        if self.error is not None:
+            raise RuntimeError(self.error)
+        return list(self._tokens)
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+
+class _Slot:
+    __slots__ = ("request", "handle", "generated")
+
+    def __init__(self, request, handle):
+        self.request = request
+        self.handle = handle
+        self.generated = 0
+
+
+class GenerationEngine:
+    """See module docstring.
+
+    ``model`` is a decode-capable dygraph Layer with the
+    `models.TransformerLM` forward contract (``use_cache`` prefill /
+    ``caches`` decode).  ``slots`` x ``max_len`` is the engine's
+    compiled identity; ``prefill_buckets`` bounds the prefill
+    executable set (default: pow2 ladder).  ``max_queue`` bounds the
+    pending queue — beyond it `submit` sheds with the slot-occupancy
+    signal (`ShedError` -> HTTP 503 + Retry-After upstream).
+    ``step_hook(step_no)`` runs before every decode step (the fault
+    drill's kill seam)."""
+
+    def __init__(self, model, *, slots=4, max_len=256,
+                 prefill_buckets=None, max_queue=64, name="gen",
+                 metrics_registry=None, step_hook=None, donate=None):
+        cfg = model.cfg
+        self.model = model
+        self.cfg = cfg
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        if self.max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                "max_len %d exceeds the model's max_position_embeddings %d"
+                % (self.max_len, cfg.max_position_embeddings))
+        self.prefill_buckets = sorted(
+            int(b) for b in (prefill_buckets
+                             or default_prefill_buckets(self.max_len)))
+        if self.prefill_buckets[-1] > self.max_len:
+            raise ValueError("prefill bucket %d exceeds max_len %d"
+                             % (self.prefill_buckets[-1], self.max_len))
+        self.max_queue = int(max_queue)
+        self._params = {k: jnp.asarray(v.data)
+                        for k, v in model.state_dict().items()}
+        self.cache = KVCache(cfg.num_layers, self.slots, self.max_len,
+                             cfg.num_heads, cfg.head_dim)
+        n = self.slots
+        # host mirrors of per-slot state (device state is ONLY the cache)
+        self._lengths = np.zeros(n, np.int32)
+        self._last_tokens = np.zeros(n, np.int32)
+        self._steps = np.zeros(n, np.int32)
+        self._keys = np.zeros((n, 2), np.uint32)
+        self._temp = np.zeros(n, np.float32)
+        self._top_k = np.zeros(n, np.int32)
+        self._top_p = np.ones(n, np.float32)
+        self._active = np.zeros(n, bool)
+        self._slot_state = [None] * n          # _Slot | None
+        self._free = list(range(n))
+        self._pending = []                     # [(request, handle)]
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self._dead = False
+        self._stop = False
+        self._thread = None
+        self._decode_steps = 0
+        self._step_hook = step_hook
+        self.on_death = None           # fleet requeue hook
+        self._t0 = time.perf_counter()
+        # donation only where the backend implements it (CPU warns)
+        if donate is None:
+            donate = jax.default_backend() in ("tpu", "gpu")
+        donate_kv = (1, 2) if donate else ()
+        self._decode_step_fn = jax.jit(self._decode_fn,
+                                       donate_argnums=donate_kv)
+        self._prefill_fns = {
+            b: jax.jit(self._make_prefill_fn(b), donate_argnums=donate_kv)
+            for b in self.prefill_buckets
+        }
+
+        reg = metrics_registry or default_registry()
+        self.metrics_registry = reg
+        self._engine = unique_instance_label(name)
+        lbl = ("engine",)
+        self._m_requests = reg.counter(
+            "generation_requests_total", "Submitted generation requests",
+            labelnames=lbl).labels(self._engine)
+        self._m_tokens = reg.counter(
+            "generation_tokens_total", "Generated tokens",
+            labelnames=lbl).labels(self._engine)
+        self._m_shed = reg.counter(
+            "generation_shed_total", "Requests refused at admission",
+            labelnames=("engine", "reason"))
+        self._m_ttft = reg.histogram(
+            "generation_ttft_ms", "Submit -> first token (ms)",
+            labelnames=lbl).labels(self._engine)
+        self._m_itl = reg.histogram(
+            "generation_itl_ms", "Inter-token latency per decode step (ms)",
+            labelnames=lbl).labels(self._engine)
+        self._m_prefill_ms = reg.histogram(
+            "generation_prefill_ms", "Prefill call wall time (ms)",
+            labelnames=lbl).labels(self._engine)
+        self._m_occupancy = reg.gauge(
+            "generation_slot_occupancy", "Occupied-slot fraction",
+            labelnames=lbl).labels(self._engine)
+        self._m_queue = reg.gauge(
+            "generation_queue_depth", "Pending (unslotted) requests",
+            labelnames=lbl).labels(self._engine)
+
+    # -- traced functions --------------------------------------------------
+    def _apply_model(self, params, fn):
+        """Run ``fn(model)`` with params rebound to traced arrays under
+        a fresh inference-mode tracer (ShardedTrainStep's rebinding
+        idiom, dropout off)."""
+        from ..fluid.dygraph.tracer import Tracer
+
+        model = self.model
+        old = framework._dygraph_tracer
+        tracer = Tracer()
+        tracer.train_mode = False
+        tracer._has_grad = False
+        framework._dygraph_tracer = tracer
+        try:
+            sd = model.state_dict()
+            for vb in sd.values():
+                tracer.register_var(vb)
+            saved = {}
+            for name, arr in params.items():
+                var = sd[name]
+                saved[name] = var.data
+                var.data = arr
+            try:
+                return fn(model)
+            finally:
+                for name, arr in saved.items():
+                    sd[name].data = arr
+        finally:
+            framework._dygraph_tracer = old
+
+    def _decode_fn(self, params, k_stack, v_stack, lengths, tokens, keys,
+                   steps, temp, top_k, top_p):
+        """ONE decode step over all slots (see module docstring)."""
+        from ..fluid.dygraph import to_variable
+
+        def run(model):
+            logits, caches = model(
+                to_variable(tokens[:, None].astype(jnp.int32)),
+                to_variable(lengths[:, None].astype(jnp.int32)),
+                caches=(k_stack, v_stack), cache_positions=lengths)
+            return logits.data, caches
+
+        logits, (k2, v2) = self._apply_model(params, run)
+        nxt = sample_tokens(logits[:, 0], keys, steps, temp, top_k, top_p)
+        return k2, v2, nxt
+
+    def _make_prefill_fn(self, bucket):
+        from ..fluid.dygraph import to_variable
+
+        def prefill(params, k_stack, v_stack, tokens, length, slot, key,
+                    temp, top_k, top_p):
+            """tokens [1, bucket]; length/slot scalars; writes the
+            slot's cache rows and samples generated token 0."""
+            def run(model):
+                pos = jnp.arange(bucket, dtype=jnp.int32)[None]
+                logits, kvs = model(to_variable(tokens),
+                                    to_variable(pos), use_cache=True)
+                return logits.data, kvs
+
+            logits, kvs = self._apply_model(params, run)
+            for li, (k, v) in enumerate(kvs):
+                idx = (li, slot, 0, 0, 0)
+                k_stack = jax.lax.dynamic_update_slice(
+                    k_stack, k.astype(k_stack.dtype)[None], idx)
+                v_stack = jax.lax.dynamic_update_slice(
+                    v_stack, v.astype(v_stack.dtype)[None], idx)
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], length - 1, axis=0)      # [1, V]
+            tok0 = sample_tokens(last, key[None],
+                                 jnp.zeros((1,), jnp.int32),
+                                 temp[None], top_k[None], top_p[None])[0]
+            return k_stack, v_stack, tok0
+
+        return prefill
+
+    # -- admission / submission -------------------------------------------
+    def submit(self, request, _handle=None):
+        """Queue a request; returns its `RequestHandle`.  Sheds
+        (`ShedError`, reason ``slots_full``) when the pending queue is
+        at ``max_queue`` — the slot-occupancy admission signal; the
+        Retry-After estimate prices the queue in measured decode
+        steps.  ``_handle`` re-attaches an existing handle (the fleet's
+        requeue-after-death path: the stream restarts, the handle
+        doesn't change hands)."""
+        if not isinstance(request, GenerationRequest):
+            request = GenerationRequest(request)
+        if len(request.prompt_ids) > self.prefill_buckets[-1]:
+            raise ValueError(
+                "prompt length %d exceeds the largest prefill bucket %d"
+                % (len(request.prompt_ids), self.prefill_buckets[-1]))
+        need = len(request.prompt_ids) + request.max_new_tokens
+        if need > self.max_len:
+            raise ValueError(
+                "prompt + max_new_tokens = %d exceeds max_len %d"
+                % (need, self.max_len))
+        with self._lock:
+            if self._dead:
+                raise EngineDeadError("engine %s is dead" % self._engine)
+            if len(self._pending) >= self.max_queue:
+                err = _shed_error(
+                    "slots_full", self._retry_after_locked(),
+                    "all %d slots busy and %d requests queued"
+                    % (self.slots, len(self._pending)))
+                self._m_shed.labels(self._engine, err.reason).inc()
+                raise err
+            handle = _handle if _handle is not None \
+                else RequestHandle(request)
+            self._pending.append((request, handle))
+            self._m_requests.inc()
+            self._m_queue.set(len(self._pending))
+            self._work.notify_all()
+        return handle
+
+    def _retry_after_locked(self):
+        """Queue depth priced in measured generation throughput."""
+        rate = self._tokens_per_s()
+        if rate <= 0:
+            return 1
+        backlog_tokens = sum(
+            r.max_new_tokens for r, _ in self._pending) or 1
+        return max(1.0, backlog_tokens / rate)
+
+    def _tokens_per_s(self):
+        try:
+            tot = self._m_tokens.value
+            elapsed = time.perf_counter() - self._t0
+        except AttributeError:
+            return 0.0
+        return tot / elapsed if elapsed > 0 else 0.0
+
+    # -- scheduler ---------------------------------------------------------
+    def step(self):
+        """One scheduler iteration: refill free slots (prefill), then
+        one decode step over the active batch.  Returns True when any
+        work happened."""
+        with self._lock:
+            if self._dead:
+                raise EngineDeadError("engine %s is dead" % self._engine)
+            progressed = False
+            while self._free and self._pending:
+                request, handle = self._pending.pop(0)
+                slot = self._free.pop(0)
+                self._m_queue.set(len(self._pending))
+                self._prefill_into(slot, request, handle)
+                progressed = True
+            if self._active.any():
+                self._decode_once()
+                progressed = True
+            self._m_occupancy.set(
+                float(self._active.sum()) / max(self.slots, 1))
+            return progressed
+
+    def run_until_idle(self, max_steps=100000):
+        """Drive `step()` until no pending and no active work is left."""
+        for _ in range(max_steps):
+            if not self.step():
+                return
+        raise RuntimeError("run_until_idle: still busy after %d steps"
+                           % max_steps)
+
+    def _bucket_for(self, n):
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError("prompt length %d exceeds bucket ladder" % n)
+
+    def _prefill_into(self, slot, request, handle):
+        sp = request.sampling
+        n_prompt = len(request.prompt_ids)
+        bucket = self._bucket_for(n_prompt)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n_prompt] = request.prompt_ids
+        key = make_base_key(sp.seed).astype(np.uint32)
+        t0 = time.perf_counter()
+        with _trace.span("generation.prefill",
+                         cat="generation",
+                         args={"bucket": bucket, "slot": slot,
+                               "request_id": request.request_id}):
+            with _TRACE_LOCK:
+                k2, v2, tok0 = self._prefill_fns[bucket](
+                    self._params, self.cache.k, self.cache.v, tokens,
+                    np.int32(n_prompt), np.int32(slot), key,
+                    np.float32(sp.temperature), np.int32(sp.top_k),
+                    np.float32(sp.top_p))
+        self.cache.update(k2, v2)
+        tok0 = int(tok0)
+        self._m_prefill_ms.observe((time.perf_counter() - t0) * 1e3)
+        st = _Slot(request, handle)
+        self._slot_state[slot] = st
+        self._lengths[slot] = n_prompt
+        self._last_tokens[slot] = tok0
+        self._steps[slot] = 1
+        self._keys[slot] = key
+        self._temp[slot] = sp.temperature
+        self._top_k[slot] = sp.top_k
+        self._top_p[slot] = sp.top_p
+        self._active[slot] = True
+        self._emit(slot, st, tok0)
+        self._m_ttft.observe(
+            (time.perf_counter() - handle.t_submit) * 1e3)
+
+    def _decode_once(self):
+        if self._step_hook is not None:
+            try:
+                self._step_hook(self._decode_steps)
+            except EngineDeadError:
+                self._die("injected death at decode step %d"
+                          % self._decode_steps)
+                raise
+        t0 = time.perf_counter()
+        with _TRACE_LOCK:
+            k2, v2, nxt = self._decode_step_fn(
+                self._params, self.cache.k, self.cache.v, self._lengths,
+                self._last_tokens, self._keys, self._steps, self._temp,
+                self._top_k, self._top_p)
+        self.cache.update(k2, v2)
+        nxt = np.asarray(nxt)
+        self._decode_steps += 1
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        # the cache write in the step put every ACTIVE slot's new token
+        # at lengths; advance those counters (inactive rows computed
+        # garbage nobody reads — their slot is re-prefilled on reuse)
+        for slot in np.nonzero(self._active)[0]:
+            self._lengths[slot] += 1
+            self._steps[slot] += 1
+            st = self._slot_state[slot]
+            st_tok = int(nxt[slot])
+            self._last_tokens[slot] = st_tok
+            self._emit(slot, st, st_tok)
+            self._m_itl.observe(dt_ms)
+
+    def _emit(self, slot, st, token):
+        """Deliver one generated token and apply stop conditions."""
+        st.handle._emit(st.generated, token)
+        st.generated += 1
+        self._m_tokens.inc()
+        reason = None
+        if token in st.request.stop_token_ids:
+            reason = "stop_token"
+        elif st.generated >= st.request.max_new_tokens:
+            reason = "max_new_tokens"
+        elif self._lengths[slot] + 1 >= self.max_len:
+            reason = "cache_full"
+        if reason is not None:
+            self._finish_slot(slot, reason)
+
+    def _finish_slot(self, slot, reason):
+        st = self._slot_state[slot]
+        st.handle._finish(reason)
+        self._slot_state[slot] = None
+        self._active[slot] = False
+        self._free.append(slot)
+        _trace.instant("generation.finish", cat="generation",
+                       args={"slot": int(slot), "reason": reason,
+                             "request_id": st.request.request_id})
+
+    # -- death (drills / fleet) -------------------------------------------
+    def _die(self, why):
+        self._dead = True
+        affected = []
+        for slot, st in enumerate(self._slot_state):
+            if st is not None:
+                affected.append(st.handle)
+                self._slot_state[slot] = None
+        self._active[:] = False
+        for _, handle in self._pending:
+            affected.append(handle)
+        self._pending = []
+        self._affected_on_death = affected
+        _trace.instant("generation.engine_death", cat="generation",
+                       args={"engine": self._engine, "why": why})
+        if self.on_death is not None:
+            self.on_death(self, affected)
+        else:
+            for h in affected:
+                h._fail("engine %s died: %s" % (self._engine, why))
+
+    def kill(self, why="killed"):
+        """Drill/operator kill: in-flight + queued handles become the
+        fleet's requeue set (`affected_on_death`)."""
+        with self._lock:
+            if not self._dead:
+                self._die(why)
+            self._work.notify_all()
+
+    @property
+    def dead(self):
+        return self._dead
+
+    @property
+    def affected_on_death(self):
+        """Handles that were in flight or queued when the engine died."""
+        return list(getattr(self, "_affected_on_death", ()))
+
+    # -- background loop ---------------------------------------------------
+    def start(self):
+        """Run the scheduler on a background thread (serving mode)."""
+        if self._thread is not None:
+            return self
+        self._t0 = time.perf_counter()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name="genloop-%s" % self._engine,
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                if self._stop or self._dead:
+                    return
+                busy = bool(self._pending) or bool(self._active.any())
+                if not busy:
+                    self._work.wait(0.05)
+                    continue
+            try:
+                self.step()
+            except EngineDeadError:
+                return
+            except Exception as e:     # pragma: no cover - defensive
+                with self._lock:
+                    self._die("engine loop crashed: %s: %s"
+                              % (type(e).__name__, e))
+                return
+
+    def stop(self):
+        with self._lock:
+            self._stop = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # -- introspection -----------------------------------------------------
+    def _decode_cache_size(self):
+        """Jit-cache entries of the decode step — the compile-once pin."""
+        try:
+            return int(self._decode_step_fn._cache_size())
+        except Exception:
+            return -1
+
+    def occupancy(self):
+        with self._lock:
+            return {
+                "slots": self.slots,
+                "active": int(self._active.sum()),
+                "free": len(self._free),
+                "pending": len(self._pending),
+            }
+
+    def stats(self):
+        occ = self.occupancy()
+        occ.update({
+            "engine": self._engine,
+            "dead": self._dead,
+            "decode_steps": self._decode_steps,
+            "max_len": self.max_len,
+            "prefill_buckets": list(self.prefill_buckets),
+            "cache": self.cache.describe(),
+            "decode_executables": self._decode_cache_size(),
+        })
+        return occ
+
+    # -- convenience -------------------------------------------------------
+    def generate(self, prompts, max_new_tokens=16, sampling=None,
+                 stop_token_ids=(), timeout=120.0):
+        """Synchronous batch helper: submit all, drive to idle, return
+        token lists in prompt order."""
+        handles = []
+        for i, p in enumerate(prompts):
+            sp = sampling[i] if isinstance(sampling, (list, tuple)) \
+                else sampling
+            handles.append(self.submit(GenerationRequest(
+                p, max_new_tokens=max_new_tokens, sampling=sp,
+                stop_token_ids=stop_token_ids)))
+        if self._thread is None:
+            self.run_until_idle()
+        return [h.result(timeout=timeout) for h in handles]
+
+
+def sequential_oracle(make_engine, requests, timeout=120.0):
+    """The exactness reference: a FRESH engine per request, one request
+    at a time — no continuous batching, no slot reuse, no shared state.
+    Returns the per-request token lists.  `make_engine()` must build an
+    engine with the same (slots, max_len, buckets) config as the engine
+    under test."""
+    out = []
+    for r in requests:
+        eng = make_engine()
+        h = eng.submit(GenerationRequest(
+            r.prompt_ids, max_new_tokens=r.max_new_tokens,
+            sampling=r.sampling, stop_token_ids=r.stop_token_ids,
+            request_id=r.request_id + ":oracle"))
+        eng.run_until_idle()
+        out.append(h.result(timeout=timeout))
+    return out

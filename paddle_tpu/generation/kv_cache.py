@@ -1,0 +1,63 @@
+"""Fixed-shape slot KV cache: the decode step's working set.
+
+Two stacked device arrays, ``k``/``v`` of shape
+``[layers, slots, max_len, heads, head_dim]`` (slot-major rows, BSHD
+within a slot so prefill's flash K/V copy straight in), plus per-slot
+length counters living HOST-side in the engine.  The shape never
+changes — slot count and max_len are the engine's compile-time
+identity — so the decode executable is built once and every step
+after that is a cache-donated re-invocation: XLA writes the updated
+cache into the same HBM buffers instead of allocating a second copy
+of what is by far the largest inference allocation
+(``2 * L * slots * T * H * D * itemsize`` bytes; see
+``analysis.perf.decode_step_cost`` for what streaming it costs per
+token).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["KVCache"]
+
+
+class KVCache:
+    """Host-side handle of the device cache arrays (see module doc)."""
+
+    def __init__(self, num_layers, slots, max_len, num_heads, head_dim,
+                 dtype=jnp.float32):
+        self.num_layers = int(num_layers)
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = jnp.dtype(dtype)
+        shape = (self.num_layers, self.slots, self.max_len,
+                 self.num_heads, self.head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+
+    @property
+    def shape(self):
+        return tuple(self.k.shape)
+
+    @property
+    def nbytes(self):
+        return int(2 * np.prod(self.shape) * self.dtype.itemsize)
+
+    def arrays(self):
+        return self.k, self.v
+
+    def update(self, k, v):
+        """Adopt the arrays a donated prefill/decode call returned (the
+        old handles are invalid once donated — never keep them)."""
+        self.k, self.v = k, v
+
+    def describe(self):
+        return {
+            "layers": self.num_layers, "slots": self.slots,
+            "max_len": self.max_len, "heads": self.num_heads,
+            "head_dim": self.head_dim, "dtype": str(self.dtype),
+            "bytes": self.nbytes,
+        }

@@ -1,0 +1,91 @@
+"""Token sampling: greedy, temperature, top-k, top-p — all per slot.
+
+One traced function covers every policy: the knobs are DATA ([N]
+arrays), not static config, so a continuous batch mixing greedy and
+nucleus-sampled requests still runs ONE decode executable.  Per-slot
+`jax.random` key streams make results independent of slot assignment
+and arrival order — the property the engine-vs-sequential-oracle
+exactness test pins: request seed -> base key; generated token g is
+sampled with ``fold_in(base_key, g)`` wherever and whenever that
+request happens to be scheduled.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SamplingParams", "sample_tokens", "make_base_key"]
+
+NEG_INF = -1e30
+
+
+class SamplingParams:
+    """Per-request sampling policy.
+
+    * ``temperature <= 0`` — greedy (argmax; top_k/top_p ignored).
+    * ``top_k > 0``  — keep only the k highest-logit tokens.
+    * ``top_p < 1``  — nucleus: keep the smallest prefix of the sorted
+      distribution whose mass reaches ``top_p`` (the argmax token is
+      always kept, so ``top_p=0`` degrades to greedy-with-noise, never
+      to an empty support).
+    * ``seed`` — the request's PRNG stream identity.
+    """
+
+    __slots__ = ("temperature", "top_k", "top_p", "seed")
+
+    def __init__(self, temperature=1.0, top_k=0, top_p=1.0, seed=0):
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+
+    @staticmethod
+    def greedy():
+        return SamplingParams(temperature=0.0)
+
+    def to_dict(self):
+        return {"temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "seed": self.seed}
+
+
+def make_base_key(seed):
+    """The request's base PRNG key as a host [2] uint32 row."""
+    return np.asarray(jax.random.PRNGKey(int(seed)))
+
+
+def sample_tokens(logits, keys, steps, temperature, top_k, top_p):
+    """Sample one token per row.
+
+    logits [N, V] (any float dtype); keys [N, 2] uint32 base keys;
+    steps [N] int32 (the per-request generated-token index, folded into
+    the key); temperature/top_p [N] float; top_k [N] int32.
+    Returns [N] int32."""
+    logits = logits.astype(jnp.float32)
+    n, v = logits.shape
+    greedy = temperature <= 0.0
+    safe_t = jnp.where(greedy, 1.0, temperature)
+    scaled = logits / safe_t[:, None]
+
+    # top-k: mask strictly below the kth-largest logit (k <= 0: off)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k_idx = jnp.clip(top_k - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    scaled = jnp.where((top_k > 0)[:, None] & (scaled < kth),
+                       NEG_INF, scaled)
+
+    # top-p over the (top-k-filtered) distribution
+    sorted2 = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted2, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]      # mass BEFORE the token
+    keep = keep.at[:, 0].set(True)             # argmax always survives
+    thresh = jnp.min(jnp.where(keep, sorted2, jnp.inf), axis=-1)
+    scaled = jnp.where((top_p < 1.0)[:, None] & (scaled < thresh[:, None]),
+                       NEG_INF, scaled)
+
+    step_keys = jax.vmap(jax.random.fold_in)(keys, steps)
+    sampled = jax.vmap(jax.random.categorical)(step_keys, scaled)
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                     sampled).astype(jnp.int32)

@@ -9,7 +9,8 @@ from __future__ import annotations
 import json
 import threading
 
-__all__ = ["JsonHandlerMixin", "install_sigterm_drain"]
+__all__ = ["JsonHandlerMixin", "install_sigterm_drain",
+           "standard_get_plane"]
 
 
 class JsonHandlerMixin:
@@ -40,6 +41,32 @@ class JsonHandlerMixin:
         if not isinstance(msg, dict):
             raise ValueError("body must be a JSON object")
         return msg
+
+
+def standard_get_plane(handler, path, *, ready_fn, stats_fn, registry,
+                       not_ready_reason="not ready"):
+    """Serve the shared GET plane (/healthz, /readyz, /stats, /metrics)
+    on a `JsonHandlerMixin` handler; returns True when ``path`` was
+    handled.  One copy of the endpoint semantics, same contract as the
+    mixin itself: fronts that add endpoints compose around it."""
+    if path == "/healthz":
+        handler._send(200, {"status": "ok"})
+    elif path == "/readyz":
+        if ready_fn():
+            handler._send(200, {"ready": True})
+        else:
+            handler._send(503, {"ready": False,
+                                "reason": not_ready_reason})
+    elif path == "/stats":
+        handler._send(200, stats_fn())
+    elif path == "/metrics":
+        from ..observability.export import prometheus_text
+
+        handler._send_text(200, prometheus_text(registry),
+                           "text/plain; version=0.0.4; charset=utf-8")
+    else:
+        return False
+    return True
 
 
 def install_sigterm_drain(httpd, drain_fn):

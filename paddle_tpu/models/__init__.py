@@ -15,4 +15,9 @@ from .lenet import LeNet5  # noqa: F401
 from .mobilenet import MobileNetV1, mobilenet_v1  # noqa: F401
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101  # noqa: F401
 from .transformer import Transformer, TransformerConfig  # noqa: F401
+from .transformer_lm import (  # noqa: F401
+    TransformerLM,
+    TransformerLMBlock,
+    TransformerLMConfig,
+)
 from .vgg import VGG, vgg16, vgg19  # noqa: F401

@@ -151,7 +151,21 @@ class MultiHeadAttention(dygraph.Layer):
         return layers.reshape(x, [0, seq_len, self.n_head, self.d_head])
 
     def forward(self, query, key=None, value=None, attn_bias=None,
-                causal=False, segment_ids=None):
+                causal=False, segment_ids=None, cache=None,
+                use_cache=False):
+        """``cache``/``use_cache`` are the decode-engine hooks
+        (`paddle_tpu.generation`):
+
+        * ``use_cache=True`` (prefill): the normal forward, but also
+          returns the projected ``(k, v)`` as raw ``[B, S, H, Dh]``
+          jax arrays — what the engine copies into its slot cache.
+        * ``cache=(k_cache, v_cache, pos)`` (decode): ``query`` is ONE
+          token per row; its K/V are written into the ``[B, T, H, Dh]``
+          cache arrays at index ``pos`` ([B] int) and attention runs
+          over the cache through `ops.pallas.decode_attention` with
+          positions ``<= pos`` live.  Returns
+          ``(out, (k_cache', v_cache'))``.
+        """
         key = key if key is not None else query
         value = value if value is not None else key
         q_len = int(query.shape[1])
@@ -170,6 +184,8 @@ class MultiHeadAttention(dygraph.Layer):
             q = self._split(self.q_proj(query), q_len)
             k = self._split(self.k_proj(key), kv_len)
             v = self._split(self.v_proj(value), kv_len)
+        if cache is not None:
+            return self._decode_with_cache(q, k, v, cache)
         layout = _head_layout()
         if layout == "BHSD":
             q = layers.transpose(q, [0, 2, 1, 3])
@@ -202,7 +218,43 @@ class MultiHeadAttention(dygraph.Layer):
         if layout == "BHSD":
             ctxv = layers.transpose(ctxv, [0, 2, 1, 3])
         ctxv = layers.reshape(ctxv, [0, q_len, self.n_head * self.d_head])
-        return self.dropout(self.out_proj(ctxv))
+        out = self.dropout(self.out_proj(ctxv))
+        if use_cache:
+            # BSHD is the cache-native layout; hand back arrays in it
+            # regardless of the (env-controlled) compute layout
+            if layout == "BHSD":
+                k = layers.transpose(k, [0, 2, 1, 3])
+                v = layers.transpose(v, [0, 2, 1, 3])
+            return out, (k.data, v.data)
+        return out
+
+    def _decode_with_cache(self, q, k, v, cache):
+        """Single-token decode: write this token's K/V at ``pos``, then
+        attend over the cache (fixed shapes — the decode step compiles
+        once and is reused for every token)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..fluid.dygraph import to_variable
+        from ..ops.pallas.decode_attention import decode_attention
+
+        k_cache, v_cache, pos = cache
+        pos = jnp.asarray(pos).astype(jnp.int32)
+
+        def write_row(c, new, p):
+            # c [T, H, Dh]; new [1, H, Dh]; p scalar
+            return jax.lax.dynamic_update_slice(c, new, (p, 0, 0))
+
+        k_cache = jax.vmap(write_row)(jnp.asarray(k_cache),
+                                      jnp.asarray(k.data), pos)
+        v_cache = jax.vmap(write_row)(jnp.asarray(v_cache),
+                                      jnp.asarray(v.data), pos)
+        ctx = decode_attention(
+            jnp.asarray(q.data)[:, 0], k_cache, v_cache, pos + 1,
+            scale=self.d_head ** -0.5)
+        ctxv = to_variable(ctx[:, None])            # [B, 1, H, Dh]
+        ctxv = layers.reshape(ctxv, [0, 1, self.n_head * self.d_head])
+        return self.dropout(self.out_proj(ctxv)), (k_cache, v_cache)
 
 
 class TransformerEncoderLayer(dygraph.Layer):

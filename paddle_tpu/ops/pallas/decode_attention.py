@@ -1,0 +1,183 @@
+"""Single-token attention over a fixed-shape KV cache (the decode step).
+
+Autoregressive decoding asks a shape the training flash kernel never
+sees: ONE query token per sequence against a [slots, max_len, H, D]
+cache of which only the first ``lengths[slot]`` positions are real.
+The arithmetic intensity is ~1 FLOP per cache byte — the step is HBM-
+bandwidth bound (see ``analysis.perf.decode_step_cost``), so the kernel
+exists to stream the cache through VMEM exactly once with an online
+softmax, never materializing the [slots, H, max_len] score tensor in
+HBM and never reading past what a block of the length mask kills.
+
+Layout: the cache is the engine's native [N, T, H, D] (slot-major,
+sequence, heads, head_dim — the BSHD discipline of PR 11, so prefill's
+flash output K/V slices copy straight in with no transpose).  The
+query is [N, H, D] (one token per slot).  Per slot the kernel computes
+
+    s[h, t] = scale * sum_d q[h, d] * k[t, h, d]      (t < lengths[n])
+    out[h, :] = softmax_t(s[h, :]) @ v[:, h, :]
+
+with a [H, bk] score tile per cache block — heads are the sublane axis,
+so a 12-head model still feeds the MXU 12 rows per block instead of
+one.  Free slots (lengths == 0) emit zeros, exactly like the flash
+kernel's dead-row handling.
+
+On CPU (or ``interpret=True``) the same kernel runs through the pallas
+interpreter; the jnp oracle below is the reference the tests pin both
+paths against.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+__all__ = ["decode_attention", "decode_attention_reference"]
+
+
+def decode_attention_reference(q, k_cache, v_cache, lengths, scale=None):
+    """jnp oracle: q [N, H, D], k/v_cache [N, T, H, D], lengths [N].
+
+    Attends positions ``t < lengths[n]``; a slot with length 0 emits
+    zeros (matches the kernel's dead-row handling)."""
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    s = jnp.einsum("nhd,nthd->nht", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    t = jnp.arange(k_cache.shape[1])
+    valid = t[None, :] < lengths[:, None]              # [N, T]
+    s = jnp.where(valid[:, None, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - safe_m))
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    p = p / jnp.maximum(l, 1e-30)
+    out = jnp.einsum("nht,nthd->nhd", p, v_cache.astype(jnp.float32))
+    dead = (m <= NEG_INF / 2)                          # [N, H, 1]
+    return jnp.where(dead, 0.0, out).astype(q.dtype)
+
+
+def _pick_block_k(t):
+    for b in (512, 256, 128):
+        if t % b == 0:
+            return b
+    return None
+
+
+def _kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_ref, l_ref, acc_ref,
+            *, scale, bk, nk):
+    """Grid (N, nk): per slot, stream cache blocks with running
+    (m, l, acc) statistics — the flash forward's online softmax with
+    the head axis as the score tile's sublane dimension."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)                   # [H, D]
+    k = k_ref[0].astype(jnp.float32)                   # [bk, H, D]
+    v = v_ref[0].astype(jnp.float32)                   # [bk, H, D]
+    # batched per-head dot: [H, D] x [H, bk, D] -> [H, bk]
+    s = jax.lax.dot_general(
+        q, k.transpose(1, 0, 2), (((1,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = s + bias_ref[0, 0, :].astype(jnp.float32)[None, :]
+
+    m_prev = m_ref[:, 0]                               # [H]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    p = jnp.exp(s - m_new[:, None])                    # [H, bk]
+    # a block the mask fully killed still has p = exp(s - m); with m
+    # stuck at NEG_INF the subtraction is 0 -> p = 1 garbage.  Kill it.
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
+    # [H, bk] x [H, bk, D] -> [H, D]
+    pv = jax.lax.dot_general(
+        p, v.transpose(1, 0, 2), (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    acc_ref[...] = acc_ref[...] * corr[:, None] + pv
+    m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        out = acc_ref[...] / safe_l[:, None]
+        dead = m_ref[:, 0] <= NEG_INF / 2              # empty slot
+        o_ref[0] = jnp.where(dead[:, None], 0.0, out).astype(o_ref.dtype)
+
+
+def _pallas_decode(q, k_cache, v_cache, lengths, scale, interpret,
+                   block_k=None):
+    n, t, h, d = k_cache.shape
+    # no standard divisor: run the whole cache as one block.  Fine in
+    # interpret mode (tests at any max_len); on real TPU the auto
+    # dispatch only takes this path when a 128-multiple block divides T
+    # (_use_pallas), so an explicit caller owns the tiling constraint.
+    bk = block_k or _pick_block_k(t) or t
+    if t % bk:
+        raise ValueError(
+            "block_k=%d does not divide cache length %d" % (bk, t))
+    nk = t // bk
+    # length mask as an additive [N, 1, T] bias (one f32 row per slot:
+    # O(T) HBM, vs the O(H*T) score tensor the kernel never emits)
+    pos = jnp.arange(t, dtype=jnp.int32)
+    bias = jnp.where(pos[None, :] < lengths[:, None], 0.0,
+                     NEG_INF).astype(jnp.float32)[:, None, :]
+    kernel = functools.partial(_kernel, scale=scale, bk=bk, nk=nk)
+    return pl.pallas_call(
+        kernel,
+        grid=(n, nk),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda g, j: (g, 0, 0)),
+            pl.BlockSpec((1, bk, h, d), lambda g, j: (g, j, 0, 0)),
+            pl.BlockSpec((1, bk, h, d), lambda g, j: (g, j, 0, 0)),
+            pl.BlockSpec((1, 1, bk), lambda g, j: (g, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda g, j: (g, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),   # running row max
+            pltpu.VMEM((h, 128), jnp.float32),   # running row sum
+            pltpu.VMEM((h, d), jnp.float32),     # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k_cache, v_cache, bias)
+
+
+def _use_pallas(k_cache):
+    if jax.default_backend() != "tpu":
+        return False
+    t, d = k_cache.shape[1], k_cache.shape[-1]
+    return d % 64 == 0 and _pick_block_k(t) is not None
+
+
+def decode_attention(q, k_cache, v_cache, lengths, scale=None,
+                     interpret=None, block_k=None):
+    """One decode step of attention over the cache.
+
+    q: [N, H, D] (the current token's projected queries, one per slot);
+    k_cache/v_cache: [N, T, H, D]; lengths: [N] int — positions
+    ``t < lengths[n]`` are attended (the engine writes the current
+    token's K/V at index ``lengths-1`` BEFORE calling, so the token
+    attends to itself).  Returns [N, H, D]."""
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    lengths = lengths.astype(jnp.int32)
+    if interpret is None and not _use_pallas(k_cache):
+        return decode_attention_reference(q, k_cache, v_cache, lengths,
+                                          scale)
+    return _pallas_decode(q, k_cache, v_cache, lengths, scale,
+                          bool(interpret), block_k=block_k)

@@ -17,7 +17,11 @@ C/Go clients were ITS production tier; this is ours, TPU-first):
 * `AdmissionController` — SLO-aware load shedding: 503 + Retry-After
   from measured service rate and queue depth, per-version caps;
 * `serve_http` — the HTTP front: /predict, /healthz, /readyz, /stats,
-  /metrics, and the /admin plane `tools/serving_ctl.py` drives.
+  /metrics, and the /admin plane `tools/serving_ctl.py` drives;
+* `GenerationFleet` / `serve_generation_http` — `paddle_tpu
+  .generation` engine replicas behind the front: chunked /generate
+  token streaming, slot-occupancy admission (503 + Retry-After), and
+  requeue-once replica fault tolerance (`tools/generation_ctl.py`).
 
 Fault drills live in `incubate.fault` (``kill_replica`` events) and
 `tests/test_serving_platform.py`; `benchmarks/serving_fleet_bench.py`
@@ -40,5 +44,10 @@ from .replica import (  # noqa: F401
     Replica,
     ReplicaDeadError,
     make_replicas,
+)
+from .generation import (  # noqa: F401
+    GenerationFleet,
+    GenerationReplica,
+    serve_generation_http,
 )
 from .router import Router  # noqa: F401

@@ -45,11 +45,14 @@ __all__ = ["serve_http"]
 
 
 def serve_http(router, host="127.0.0.1", port=8080, block=True,
-               admin=True, install_sigterm=True, drain_timeout=30.0):
+               admin=True, install_sigterm=True, drain_timeout=30.0,
+               generation_fleet=None):
     """Serve `router` over HTTP; returns the HTTPServer
     (daemon-threaded when block=False).  ``admin=False`` disables the
     mutating /admin endpoints (exposed data plane, private admin
-    plane)."""
+    plane).  ``generation_fleet`` (a `serving.generation
+    .GenerationFleet`) mounts ``POST /generate`` — chunked token
+    streaming — on the same front as /predict."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from ..inference.http_common import (
@@ -58,6 +61,12 @@ def serve_http(router, host="127.0.0.1", port=8080, block=True,
     )
 
     class Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
+        if generation_fleet is not None:
+            # chunked transfer encoding needs 1.1; every plain JSON
+            # response already carries Content-Length, so keep-alive
+            # semantics stay correct
+            protocol_version = "HTTP/1.1"
+
         def log_message(self, *a):    # quiet
             pass
 
@@ -74,7 +83,10 @@ def serve_http(router, host="127.0.0.1", port=8080, block=True,
                               else "no serving version with alive replicas")
                     self._send(503, {"ready": False, "reason": reason})
             elif self.path == "/stats":
-                self._send(200, router.stats())
+                stats = router.stats()
+                if generation_fleet is not None:
+                    stats["generation"] = generation_fleet.stats()
+                self._send(200, stats)
             elif self.path == "/metrics":
                 from ..observability.export import prometheus_text
 
@@ -90,6 +102,17 @@ def serve_http(router, host="127.0.0.1", port=8080, block=True,
         def do_POST(self):
             if self.path == "/predict":
                 return self._predict()
+            if self.path == "/generate" and generation_fleet is not None:
+                from .generation import handle_generate
+
+                try:
+                    msg = self._body()
+                except Exception as e:
+                    self._send(400, {"error": "%s: %s"
+                                     % (type(e).__name__, e)})
+                    return
+                handle_generate(self, generation_fleet, msg)
+                return
             if not self.path.startswith("/admin/"):
                 self._send(404, {"error": "unknown path %r" % self.path})
                 return

@@ -31,7 +31,10 @@ Front ends:
   (``bench.py --multichip --autotune``);
 * ``search_hostemb_cache(build_and_time, ...)`` — the hot-row
   device-cache capacity of a host-embedding workload
-  (``benchmarks/streaming_bench.py --autotune``).
+  (``benchmarks/streaming_bench.py --autotune``);
+* ``search_generation_config(build_and_time, ...)`` — the decode
+  engine's slot count (`paddle_tpu.generation`;
+  ``benchmarks/generation_bench.py --autotune``).
 
 Entry points: ``CompiledProgram.with_autotune()`` (Executor applies the
 tuned pipeline on first run), ``InferenceServer.autotune()``,
@@ -53,6 +56,7 @@ from .search import (  # noqa: F401
     search_bucket_ladder,
     search_flash_blocks,
     search_gemm_blocks,
+    search_generation_config,
     search_hostemb_cache,
     search_step,
     search_train_step,

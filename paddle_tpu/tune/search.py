@@ -1206,3 +1206,31 @@ def search_step(build_and_time, variants, *, workload, mesh=None,
         "step", workload, parts, False, results, winner,
         default_s=default_s, searched_s=time.perf_counter() - t_start,
         cache_path=cache_path, cache_stored=bool(cache_stored))
+
+
+def search_generation_config(build_and_time, *, workload,
+                             slot_counts=(1, 4, 8, 16), max_len=None,
+                             hbm_budget_bytes=None,
+                             cache_bytes_per_slot=None, mesh=None,
+                             use_cache=True, cache_dir=None,
+                             platform=None, jax_version=None):
+    """Measured search over the decode engine's slot count
+    (`space.generation_config_candidates`).
+
+    ``build_and_time(params) -> seconds-per-token`` owns building a
+    ``GenerationEngine(slots=params["slots"], ...)``, running a
+    representative request mix, and reporting time per generated token
+    (`benchmarks/generation_bench.py`'s harness); the tuner owns
+    enumeration, ordering, reporting, and the cache.  The first slot
+    count is the measured baseline; candidates whose KV cache would
+    blow the HBM budget are dropped before anything compiles."""
+    cands = space_mod.generation_config_candidates(
+        slot_counts=slot_counts, max_len=max_len,
+        hbm_budget_bytes=hbm_budget_bytes,
+        cache_bytes_per_slot=cache_bytes_per_slot)
+    if not cands:
+        raise ValueError("no feasible slot-count candidates")
+    return search_step(
+        build_and_time, cands, workload=workload, mesh=mesh,
+        use_cache=use_cache, cache_dir=cache_dir, platform=platform,
+        jax_version=jax_version)

@@ -387,3 +387,34 @@ class SearchSpace:
             cands.extend(sharding_candidates(
                 program, mesh, min_bytes=self.min_shard_bytes))
         return cands
+
+
+def generation_config_candidates(slot_counts=(1, 4, 8, 16),
+                                 max_len=None, hbm_budget_bytes=None,
+                                 cache_bytes_per_slot=None):
+    """Decode-engine slot-count candidates (`paddle_tpu.generation`).
+
+    More slots amortize the per-step weight read over more tokens
+    (the decode step is memory-bound — `analysis.perf
+    .decode_step_cost`) but grow the KV cache linearly and the
+    per-request ITL with it; the sweet spot is workload- and
+    HBM-budget-dependent, so it is MEASURED.  The first candidate is
+    the caller's default (search_step baseline contract).  Candidates
+    whose cache would exceed ``hbm_budget_bytes`` (when both budget
+    and ``cache_bytes_per_slot`` are given) are dropped up front —
+    never compiled, like the static prune in `search`."""
+    out, seen = [], set()
+    for s in slot_counts:
+        s = int(s)
+        if s <= 0 or s in seen:
+            continue
+        if (hbm_budget_bytes is not None
+                and cache_bytes_per_slot is not None
+                and s * cache_bytes_per_slot > hbm_budget_bytes):
+            continue
+        seen.add(s)
+        params = {"slots": s}
+        if max_len is not None:
+            params["max_len"] = int(max_len)
+        out.append(Candidate("generation", params, label="slots%d" % s))
+    return out

@@ -436,3 +436,105 @@ def test_tree_conv_matches_numpy_oracle():
             out = out + np.einsum("un,noc->uoc", reach, mixed)
         expect[b] = out
     np.testing.assert_allclose(got, expect, rtol=2e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# dense beam-op numpy value oracles (the pyramid_hash oracle discipline
+# applied to the legacy decoder's two ops; `paddle_tpu.generation` is
+# the recommended serving path — these pin the bridge it replaces)
+# ---------------------------------------------------------------------------
+
+
+def _np_beam_search_step(pre_ids, pre_scores, scores, beam, end_id):
+    """Numpy oracle of ONE dense beam_search step (beam_search_op.cc
+    semantics): finished beams contribute a single frozen end_id
+    candidate; top-k over the flattened [beam*V] accumulated scores."""
+    B, _, V = scores.shape
+    total = scores.copy()
+    for b in range(B):
+        for k in range(beam):
+            if pre_ids[b, k] == end_id:
+                total[b, k, :] = -1e9
+                total[b, k, end_id] = pre_scores[b, k]
+    sel_ids = np.zeros((B, beam), np.int64)
+    sel_scores = np.zeros((B, beam), np.float32)
+    parents = np.zeros((B, beam), np.int64)
+    for b in range(B):
+        flat = total[b].reshape(-1)
+        top = np.argsort(-flat, kind="stable")[:beam]
+        sel_scores[b] = flat[top]
+        parents[b] = top // V
+        sel_ids[b] = top % V
+    return sel_ids, sel_scores, parents
+
+
+def _np_beam_search_decode(ids, parents):
+    """Numpy oracle of the backtrack: [T, B, beam] -> [B, beam, T]."""
+    T, B, beam = ids.shape
+    out = np.zeros((B, beam, T), ids.dtype)
+    for b in range(B):
+        for k in range(beam):
+            cur = k
+            for t in range(T - 1, -1, -1):
+                out[b, k, t] = ids[t, b, cur]
+                cur = parents[t, b, cur]
+    return out
+
+
+def test_beam_search_ops_match_numpy_oracle():
+    rng = np.random.RandomState(3)
+    B, beam, Vv, end = 3, 4, 9, 1
+    pre_ids = rng.randint(0, Vv, (B, beam)).astype(np.int64)
+    pre_ids[0, 1] = end                      # one finished beam
+    pre_scores = rng.randn(B, beam).astype(np.float32)
+    scores = rng.randn(B, beam, Vv).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pi = layers.data("pi", shape=[-1, beam], dtype="int64",
+                         append_batch_size=False)
+        ps = layers.data("ps", shape=[-1, beam],
+                         append_batch_size=False)
+        sc = layers.data("sc", shape=[-1, beam, Vv],
+                         append_batch_size=False)
+        si, ss, pa = layers.beam_search(pi, ps, sc, beam_size=beam,
+                                        end_id=end)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got_i, got_s, got_p = exe.run(
+            main, feed={"pi": pre_ids, "ps": pre_scores, "sc": scores},
+            fetch_list=[si, ss, pa])
+    ref_i, ref_s, ref_p = _np_beam_search_step(
+        pre_ids, pre_scores, scores, beam, end)
+    np.testing.assert_allclose(np.asarray(got_s), ref_s, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_i), ref_i)
+    np.testing.assert_array_equal(np.asarray(got_p), ref_p)
+
+
+def test_beam_search_decode_matches_numpy_oracle():
+    rng = np.random.RandomState(5)
+    T, B, beam = 6, 2, 3
+    ids = rng.randint(0, 11, (T, B, beam)).astype(np.int64)
+    parents = rng.randint(0, beam, (T, B, beam)).astype(np.int64)
+    final_scores = rng.randn(B, beam).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        iv = layers.data("ids", shape=[T, B, beam], dtype="int64",
+                         append_batch_size=False)
+        pv = layers.data("par", shape=[T, B, beam], dtype="int64",
+                         append_batch_size=False)
+        fv = layers.data("fs", shape=[-1, beam],
+                         append_batch_size=False)
+        sent, sscore = layers.beam_search_decode(iv, pv, fv)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got_ids, got_scores = exe.run(
+            main, feed={"ids": ids, "par": parents, "fs": final_scores},
+            fetch_list=[sent, sscore])
+    np.testing.assert_array_equal(
+        np.asarray(got_ids), _np_beam_search_decode(ids, parents))
+    np.testing.assert_allclose(np.asarray(got_scores), final_scores,
+                               rtol=1e-6)

@@ -350,3 +350,36 @@ def test_sigkill_mid_stream_restores_within_one_window(tmp_path):
     assert got["digest"] == want["digest"], (
         "restored table diverges from the uninterrupted run: delta "
         "replay is lossy or misordered")
+
+
+# decode-step HBM-bytes budget for the generation engine on zoo
+# BERT-small shapes (L=4, h=256, V=8k) at slots=8, cache_len=512: KV
+# read 2*4*8*512*256*4 = 32 MB + params ~10.5 MB per step.  Estimate at
+# pin time (2026-08-04): 42.9 MB; budget ~2.5x so a cache-layout or
+# estimator regression (e.g. re-reading the cache per layer pass, or a
+# recompute-prefix fallback sneaking into the decode path) trips it.
+_DECODE_BUDGET_BYTES = 110e6
+
+
+def test_generation_decode_step_hbm_bytes_within_budget():
+    from paddle_tpu.analysis.perf import ChipSpec, decode_step_cost
+
+    chip = ChipSpec("pinned", 197e12, 819e9)   # platform-independent
+    cost = decode_step_cost(
+        num_layers=4, hidden_size=256, num_heads=4, vocab_size=8000,
+        intermediate_size=1024, slots=8, cache_len=512, chip=chip)
+    assert cost.bound == "memory", (
+        "decode step should be HBM-bound; got %r" % cost.bound)
+    assert 0 < cost.bytes <= _DECODE_BUDGET_BYTES, (
+        "decode step wants %.1f MB of HBM traffic (budget %.1f MB): a "
+        "cache-layout or estimator change inflated the per-token read "
+        "— re-pin only if intentional"
+        % (cost.bytes / 1e6, _DECODE_BUDGET_BYTES / 1e6))
+    # binds-check: a near-zero budget must fail
+    assert cost.bytes > 1e3
+    # the KV read must dominate growth in cache_len (the quantity the
+    # budget exists to guard)
+    longer = decode_step_cost(
+        num_layers=4, hidden_size=256, num_heads=4, vocab_size=8000,
+        intermediate_size=1024, slots=8, cache_len=1024, chip=chip)
+    assert longer.kv_read_bytes == 2 * cost.kv_read_bytes

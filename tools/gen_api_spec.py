@@ -58,6 +58,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.serving",
     "paddle_tpu.streaming",
     "paddle_tpu.tune",
+    "paddle_tpu.generation",
 ]
 
 

@@ -1,0 +1,228 @@
+"""Operator CLI + smoke driver for a `paddle_tpu.generation` engine
+behind an HTTP front (`serving.serve_generation_http`, or
+`serving.serve_http(generation_fleet=...)`)::
+
+    python tools/generation_ctl.py --endpoint http://host:port COMMAND
+
+    stats                                # fleet stats() (slot occupancy)
+    generate --prompt "1,2,3" [--max-new N] [--temperature T]
+             [--top-k K] [--top-p P] [--seed S] [--no-stream]
+    smoke    [--requests N] [--max-new M] [--concurrency C]
+             [--prompt-vocab V]
+
+``smoke`` is the CI/ops liveness drill: it streams N prompts through a
+LIVE engine (C at a time) and asserts every stream is COMPLETE and
+ORDERED — token indices 0..k-1 contiguous with no duplicate, no gap, a
+terminal done record, and the token count consistent with it.  A
+``restart`` record (replica died mid-generation; the fleet re-queued
+the request once) legally resets the expected index to 0.  Exit code 0
+only when every stream checks out; any dropped, duplicated, or
+out-of-order token (or transport error) is rc 1 with the offending
+request named — wire this against a canary front before promoting a
+new engine build.
+
+``--json`` prints machine-readable envelopes for scripting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import sys
+import threading
+import urllib.parse
+
+
+def _conn(endpoint, timeout):
+    u = urllib.parse.urlparse(endpoint)
+    return http.client.HTTPConnection(u.hostname, u.port or 80,
+                                      timeout=timeout)
+
+
+def _get_json(endpoint, path, timeout=30.0):
+    conn = _conn(endpoint, timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def stream_generate(endpoint, body, timeout=60.0):
+    """POST /generate with stream=true; yields parsed ndjson records."""
+    conn = _conn(endpoint, timeout)
+    try:
+        payload = dict(body)
+        payload["stream"] = True
+        conn.request("POST", "/generate", json.dumps(payload),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(
+                "HTTP %d: %s" % (resp.status, resp.read()[:300]))
+        while True:
+            line = resp.readline()
+            if not line:
+                return
+            line = line.strip()
+            if line:
+                yield json.loads(line)
+    finally:
+        conn.close()
+
+
+def check_stream(records):
+    """The smoke invariant: contiguous 0..k-1 indices (restart resets),
+    exactly one terminal done record, count consistent.  Returns
+    (ok, reason, tokens)."""
+    expected = 0
+    tokens = []
+    done = None
+    for rec in records:
+        if "error" in rec:
+            return False, "stream error: %s" % rec["error"], tokens
+        if rec.get("event") == "restart":
+            expected = 0
+            tokens = []
+            continue
+        if rec.get("done"):
+            if done is not None:
+                return False, "duplicate done record", tokens
+            done = rec
+            continue
+        if done is not None:
+            return False, "token after done record", tokens
+        idx = rec.get("index")
+        if idx != expected:
+            kind = "duplicated" if (idx is not None and idx < expected) \
+                else "dropped"
+            return False, ("%s token: expected index %d, got %r"
+                           % (kind, expected, idx)), tokens
+        tokens.append(rec["token"])
+        expected += 1
+    if done is None:
+        return False, "stream ended without a done record", tokens
+    if done.get("n_tokens") != len(tokens):
+        return False, ("done record says %r tokens, stream carried %d"
+                       % (done.get("n_tokens"), len(tokens))), tokens
+    return True, "ok", tokens
+
+
+def cmd_stats(args):
+    code, payload = _get_json(args.endpoint, "/stats")
+    print(json.dumps(payload) if args.json
+          else "stats (HTTP %s): %s" % (code, json.dumps(payload)))
+    return 0 if code == 200 else 1
+
+
+def cmd_generate(args):
+    body = {
+        "prompt": [int(t) for t in args.prompt.split(",")],
+        "max_new_tokens": args.max_new,
+        "temperature": args.temperature,
+        "top_k": args.top_k, "top_p": args.top_p, "seed": args.seed,
+    }
+    if args.no_stream:
+        conn = _conn(args.endpoint, args.timeout)
+        try:
+            body["stream"] = False
+            conn.request("POST", "/generate", json.dumps(body),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            payload = json.loads(resp.read())
+            print(json.dumps(payload) if args.json else
+                  "tokens: %s (%s)" % (payload.get("tokens"),
+                                       payload.get("reason")))
+            return 0 if resp.status == 200 else 1
+        finally:
+            conn.close()
+    records = list(stream_generate(args.endpoint, body,
+                                   timeout=args.timeout))
+    ok, reason, tokens = check_stream(records)
+    if args.json:
+        print(json.dumps({"ok": ok, "reason": reason,
+                          "tokens": tokens}))
+    else:
+        print("tokens: %s (%s)" % (tokens, reason))
+    return 0 if ok else 1
+
+
+def cmd_smoke(args):
+    """See module docstring."""
+    results = [None] * args.requests
+    sem = threading.Semaphore(args.concurrency)
+
+    def one(i):
+        with sem:
+            body = {
+                "prompt": [1 + (i + j) % args.prompt_vocab
+                           for j in range(2 + i % 6)],
+                "max_new_tokens": args.max_new,
+                "temperature": 0.0, "seed": i,
+                "request_id": "smoke-%d" % i,
+            }
+            try:
+                records = list(stream_generate(
+                    args.endpoint, body, timeout=args.timeout))
+                results[i] = check_stream(records)
+            except Exception as e:
+                results[i] = (False, "%s: %s" % (type(e).__name__, e), [])
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(args.requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    failures = [(i, "no result (worker died)" if r is None else r[1])
+                for i, r in enumerate(results)
+                if r is None or not r[0]]
+    n_tokens = sum(len(r[2]) for r in results if r)
+    out = {"requests": args.requests, "tokens": n_tokens,
+           "failures": [{"request": i, "reason": why}
+                        for i, why in failures],
+           "ok": not failures}
+    print(json.dumps(out) if args.json else
+          ("smoke: %d requests, %d tokens, %s"
+           % (args.requests, n_tokens,
+              "ALL STREAMS COMPLETE AND ORDERED" if not failures else
+              "%d FAILED: %s" % (len(failures), failures))))
+    return 0 if not failures else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--endpoint", default="http://127.0.0.1:8090")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--timeout", type=float, default=60.0)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("stats")
+    g = sub.add_parser("generate")
+    g.add_argument("--prompt", required=True,
+                   help="comma-separated token ids")
+    g.add_argument("--max-new", type=int, default=16)
+    g.add_argument("--temperature", type=float, default=0.0)
+    g.add_argument("--top-k", type=int, default=0)
+    g.add_argument("--top-p", type=float, default=1.0)
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--no-stream", action="store_true")
+    s = sub.add_parser("smoke")
+    s.add_argument("--requests", type=int, default=8)
+    s.add_argument("--max-new", type=int, default=8)
+    s.add_argument("--concurrency", type=int, default=4)
+    s.add_argument("--prompt-vocab", type=int, default=100)
+    args = ap.parse_args(argv)
+    try:
+        return {"stats": cmd_stats, "generate": cmd_generate,
+                "smoke": cmd_smoke}[args.cmd](args)
+    except Exception as e:
+        msg = {"error": "%s: %s" % (type(e).__name__, e)}
+        print(json.dumps(msg) if args.json else msg["error"],
+              file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
