@@ -30,10 +30,12 @@ CUDAPlace = fluid.CUDAPlace
 def __getattr__(name):
     # lazy submodules (PEP 562): analysis is a build/debug-time tool,
     # serving is a dedicated-process front tier, tune is an offline
-    # search harness, streaming is the online-learning loop, and
-    # generation is the decoding engine — none may tax the import of
-    # every training/serving worker process
-    if name in ("analysis", "serving", "tune", "streaming", "generation"):
+    # search harness, streaming is the online-learning loop, generation
+    # is the decoding engine, and rl is the feedback loop over all of
+    # them — none may tax the import of every training/serving worker
+    # process
+    if name in ("analysis", "serving", "tune", "streaming", "generation",
+                "rl"):
         import importlib
 
         return importlib.import_module("." + name, __name__)

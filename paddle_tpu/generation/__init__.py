@@ -34,4 +34,9 @@ from .engine import (  # noqa: F401
     sequential_oracle,
 )
 from .kv_cache import KVCache  # noqa: F401
-from .sampling import SamplingParams, make_base_key, sample_tokens  # noqa: F401
+from .sampling import (  # noqa: F401
+    SamplingParams,
+    make_base_key,
+    sample_tokens,
+    token_logprobs,
+)

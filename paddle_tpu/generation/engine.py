@@ -46,7 +46,12 @@ from ..fluid import framework
 from ..observability import trace as _trace
 from ..observability.metrics import default_registry, unique_instance_label
 from .kv_cache import KVCache
-from .sampling import SamplingParams, make_base_key, sample_tokens
+from .sampling import (
+    SamplingParams,
+    make_base_key,
+    sample_tokens,
+    token_logprobs,
+)
 
 __all__ = [
     "EngineDeadError",
@@ -121,6 +126,7 @@ class RequestHandle:
         self._q = queue.Queue()
         self._done = threading.Event()
         self._tokens = []
+        self._logprobs = []            # filled only on logprob engines
         self.finish_reason = None
         self.error = None
         self.requeued = False          # fleet's requeue-once latch
@@ -128,14 +134,21 @@ class RequestHandle:
         self.t_first_token = None
 
     # -- engine side ------------------------------------------------------
-    def _emit(self, index, token):
+    def _emit(self, index, token, logprob=None):
         if index == 0:
             self.t_first_token = time.perf_counter()
         self._tokens.append(int(token))
-        self._q.put(("token", index, int(token)))
+        if logprob is None:
+            # logprobs disabled: the event tuple (and hence the ndjson
+            # stream upstream) is byte-identical to a pre-logprob engine
+            self._q.put(("token", index, int(token)))
+        else:
+            self._logprobs.append(float(logprob))
+            self._q.put(("token", index, int(token), float(logprob)))
 
     def _restart(self):
         self._tokens = []
+        self._logprobs = []
         self._q.put(("restart", None, None))
 
     def _finish(self, reason):
@@ -183,6 +196,17 @@ class RequestHandle:
             raise RuntimeError(self.error)
         return list(self._tokens)
 
+    def logprobs(self, timeout=30.0):
+        """Block until done; per-token logprobs of the generated tokens
+        (`sampling.token_logprobs` semantics).  Empty unless the engine
+        was built with ``logprobs=True``."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                "request %s not finished" % self.request.request_id)
+        if self.error is not None:
+            raise RuntimeError(self.error)
+        return list(self._logprobs)
+
     @property
     def done(self):
         return self._done.is_set()
@@ -212,10 +236,12 @@ class GenerationEngine:
 
     def __init__(self, model, *, slots=4, max_len=256,
                  prefill_buckets=None, max_queue=64, name="gen",
-                 metrics_registry=None, step_hook=None, donate=None):
+                 metrics_registry=None, step_hook=None, donate=None,
+                 logprobs=False):
         cfg = model.cfg
         self.model = model
         self.cfg = cfg
+        self.return_logprobs = bool(logprobs)
         self.slots = int(slots)
         self.max_len = int(max_len)
         if self.max_len > cfg.max_position_embeddings:
@@ -339,6 +365,8 @@ class GenerationEngine:
 
         logits, (k2, v2) = self._apply_model(params, run)
         nxt = sample_tokens(logits[:, 0], keys, steps, temp, top_k, top_p)
+        if self.return_logprobs:
+            return k2, v2, nxt, token_logprobs(logits[:, 0], nxt)
         return k2, v2, nxt
 
     def _make_prefill_fn(self, bucket):
@@ -366,6 +394,9 @@ class GenerationEngine:
             tok0 = sample_tokens(last, key[None],
                                  jnp.zeros((1,), jnp.int32),
                                  temp[None], top_k[None], top_p[None])[0]
+            if self.return_logprobs:
+                return (k_stack, v_stack, tok0,
+                        token_logprobs(last, tok0[None])[0])
             return k_stack, v_stack, tok0
 
         return prefill
@@ -474,11 +505,13 @@ class GenerationEngine:
                          args={"bucket": bucket, "slot": slot,
                                "request_id": request.request_id}):
             with _TRACE_LOCK:
-                k2, v2, tok0 = self._prefill_fns[bucket](
+                out = self._prefill_fns[bucket](
                     self._params, self.cache.k, self.cache.v, tokens,
                     np.int32(n_prompt), np.int32(slot), key,
                     np.float32(sp.temperature), np.int32(sp.top_k),
                     np.float32(sp.top_p))
+        k2, v2, tok0 = out[:3]
+        lp0 = float(out[3]) if self.return_logprobs else None
         self.cache.update(k2, v2)
         tok0 = int(tok0)
         self._m_prefill_ms.observe((time.perf_counter() - t0) * 1e3)
@@ -492,7 +525,7 @@ class GenerationEngine:
         self._top_k[slot] = sp.top_k
         self._top_p[slot] = sp.top_p
         self._active[slot] = True
-        self._emit(slot, st, tok0)
+        self._emit(slot, st, tok0, lp0)
         self._m_ttft.observe(
             (time.perf_counter() - handle.t_submit) * 1e3)
 
@@ -506,10 +539,12 @@ class GenerationEngine:
                 raise
         t0 = time.perf_counter()
         with _TRACE_LOCK:
-            k2, v2, nxt = self._decode_step_fn(
+            out = self._decode_step_fn(
                 self._params, self.cache.k, self.cache.v, self._lengths,
                 self._last_tokens, self._keys, self._steps, self._temp,
                 self._top_k, self._top_p)
+        k2, v2, nxt = out[:3]
+        lps = np.asarray(out[3]) if self.return_logprobs else None
         self.cache.update(k2, v2)
         nxt = np.asarray(nxt)
         self._decode_steps += 1
@@ -523,12 +558,13 @@ class GenerationEngine:
             st = self._slot_state[slot]
             st_tok = int(nxt[slot])
             self._last_tokens[slot] = st_tok
-            self._emit(slot, st, st_tok)
+            self._emit(slot, st, st_tok,
+                       float(lps[slot]) if lps is not None else None)
             self._m_itl.observe(dt_ms)
 
-    def _emit(self, slot, st, token):
+    def _emit(self, slot, st, token, logprob=None):
         """Deliver one generated token and apply stop conditions."""
-        st.handle._emit(st.generated, token)
+        st.handle._emit(st.generated, token, logprob)
         st.generated += 1
         self._m_tokens.inc()
         reason = None
@@ -628,6 +664,41 @@ class GenerationEngine:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+
+    # -- weight hot-swap ---------------------------------------------------
+    def snapshot_params(self):
+        """Host copies of the serving weights — a rollback point for
+        `paddle_tpu.rl`'s gated promotion."""
+        with self._lock:
+            return {k: np.asarray(v) for k, v in self._params.items()}
+
+    def swap_params(self, params):
+        """Replace serving weights in place (policy hot-swap).
+
+        The new arrays must match the current parameter names, shapes
+        and dtypes exactly — same shapes means the already-compiled
+        prefill/decode executables keep serving, so in-flight requests
+        see at most one token drawn from the old policy and the swap
+        costs zero recompiles and zero failed requests."""
+        with self._lock:
+            if self._dead:
+                raise EngineDeadError("swap_params on dead engine")
+            cur = self._params
+            new_names = set(map(str, params.keys()))
+            if new_names != set(cur.keys()):
+                missing = sorted(set(cur.keys()) - new_names)
+                extra = sorted(new_names - set(cur.keys()))
+                raise ValueError("swap_params name mismatch: missing=%r "
+                                 "extra=%r" % (missing, extra))
+            staged = {}
+            for k, old in cur.items():
+                arr = jnp.asarray(params[k])
+                if arr.shape != old.shape or arr.dtype != old.dtype:
+                    raise ValueError(
+                        "swap_params %r: got %s %s, engine serves %s %s"
+                        % (k, arr.shape, arr.dtype, old.shape, old.dtype))
+                staged[k] = arr
+            self._params = staged
 
     # -- introspection -----------------------------------------------------
     def _decode_cache_size(self):

@@ -16,7 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["SamplingParams", "sample_tokens", "make_base_key"]
+__all__ = ["SamplingParams", "sample_tokens", "token_logprobs",
+           "make_base_key"]
 
 NEG_INF = -1e30
 
@@ -89,3 +90,19 @@ def sample_tokens(logits, keys, steps, temperature, top_k, top_p):
     sampled = jax.vmap(jax.random.categorical)(step_keys, scaled)
     return jnp.where(greedy, jnp.argmax(logits, axis=-1),
                      sampled).astype(jnp.int32)
+
+
+def token_logprobs(logits, tokens):
+    """Per-row log-probability of ``tokens`` under the RAW policy
+    distribution: ``log_softmax(logits)[token]``, temperature-1 and
+    unfiltered.  This is deliberately NOT the density of the sampling
+    distribution the knobs shaped — the trainer (`paddle_tpu.rl`)
+    optimizes the raw softmax and recomputes new-policy logprobs the
+    same way, so the PPO ratio ``exp(new - old)`` is consistent no
+    matter what temperature/top-k/top-p drew the rollout.
+
+    logits [N, V] (any float dtype); tokens [N] int.  Returns [N] f32.
+    """
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(
+        lp, tokens.astype(jnp.int32)[:, None], axis=-1)[:, 0]

@@ -166,3 +166,16 @@ class TransformerLM(dygraph.Layer):
         lab = layers.reshape(labels, [-1, 1])
         return layers.reduce_mean(
             layers.softmax_with_cross_entropy(flat, lab))
+
+    def token_logprob(self, logits, labels):
+        """Per-token log-probability of ``labels`` under the raw
+        softmax ([B, S, V] vs [B, S] -> [B, S]) — the dygraph mirror of
+        `generation.sampling.token_logprobs`.  `paddle_tpu.rl`
+        recomputes new-policy logprobs through this so train-time and
+        rollout-time densities agree token for token."""
+        vocab = int(logits.shape[-1])
+        flat = layers.reshape(logits, [-1, vocab])
+        lab = layers.reshape(labels, [-1, 1])
+        nll = layers.softmax_with_cross_entropy(flat, lab)
+        return layers.reshape(layers.scale(nll, scale=-1.0),
+                              [int(labels.shape[0]), int(labels.shape[1])])

@@ -1,0 +1,52 @@
+"""paddle_tpu.rl — the online RL/feedback loop (ROADMAP item 3).
+
+The circulatory system between the repo's organs: the generation
+fleet (PR 15) produces `(prompt, generation, per-token logprobs)`
+rollouts against its own latest weights, a `RewardSource` scores
+them, a policy-gradient `RLTrainStep` (REINFORCE-with-baseline or
+PPO clipped ratio, optional frozen-reference KL penalty) updates the
+policy through `distributed.ShardedTrainStep` (ZeRO-2, microbatch
+accumulation), and `FeedbackLoop` — a PR-14 `StreamingTrainer` run
+under the hood — delta-checkpoints the state and promotes the policy
+through PR-9's verify -> canary -> promote gates into the serving
+fleet by in-place weight hot-swap.  Freshness (minutes from a reward
+event to the policy that learned from it answering probes) comes out
+measured the PR-14 way, through the PR-4 metrics registry and the
+PR-6 tracer.
+
+Layers:
+
+* `rollout` — `RolloutEngine`: deterministic, exactly-accounted
+  sample production over the fleet;
+* `reward`  — `RewardSource` (callable / HTTP / the drill's
+  verifiable `TokenAffinityReward`) + reward-event time stamping;
+* `loss`    — `pg_loss_jnp` (the tested formula), `make_rl_loss_fn`
+  (its dygraph mirror), `RLTrainStep`, `ReferenceScorer`;
+* `loop`    — `FeedbackLoop`, `PolicyPublisher` (gated promotion),
+  `PolicyCheckpointer` (full/delta chains), `serve_rl_http`
+  (`tools/rl_ctl.py`'s control plane).
+"""
+
+from .loop import (  # noqa: F401
+    Baseline,
+    FeedbackLoop,
+    PolicyCheckpointer,
+    PolicyPublisher,
+    PublishError,
+    build_batch,
+    serve_rl_http,
+)
+from .loss import (  # noqa: F401
+    ReferenceScorer,
+    RLTrainStep,
+    make_rl_loss_fn,
+    pg_loss_jnp,
+)
+from .reward import (  # noqa: F401
+    CallableReward,
+    HTTPReward,
+    RewardSource,
+    TokenAffinityReward,
+    stamp_rewards,
+)
+from .rollout import RolloutEngine, RolloutSample  # noqa: F401

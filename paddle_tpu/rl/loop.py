@@ -1,0 +1,700 @@
+"""FeedbackLoop: rollout -> score -> train -> hot-swap, closed.
+
+The loop IS a PR-14 `StreamingTrainer` run: the stream source is a
+generator whose every batch is one rollout round (the fleet generating
+against its own latest policy), the session is one `RLTrainStep`
+update, the checkpointer delta-checkpoints the full train state, and
+the push seam is `PolicyPublisher` — PR-9's verify -> canary ->
+promote gate chain re-expressed over the generation fleet's
+`swap_params` hot-swap.  Freshness therefore comes out measured the
+PR-14 way with zero new mechanism: every batch's ``ingested_at`` is
+its oldest reward-event stamp, and `StreamingTrainer._push` computes
+``live_at - oldest_unserved`` — minutes from a reward event to the
+policy that learned from it answering probes in the serving fleet.
+
+Rollout batches are **lazy**: the generator yields `_LazyRolloutBatch`
+shells that materialize (sync weights -> rollout -> score -> build the
+feed) only when the trainer first touches them — AFTER the previous
+round's update committed.  That kills the lookahead skew a plain
+generator would have (rollout N+1 running against pre-update weights
+while round N trains), which is what makes the fixed-seed resume
+drill exact: round k's rollout always sees the params the checkpoint
+at window k-1 captured.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..observability import trace as _trace_mod
+from ..observability.metrics import default_registry, unique_instance_label
+from ..streaming.source import StreamBatch, StreamSource
+from .loss import RLTrainStep, ReferenceScorer
+from .reward import stamp_rewards
+from .rollout import RolloutEngine
+
+__all__ = ["Baseline", "FeedbackLoop", "PolicyCheckpointer",
+           "PolicyPublisher", "PublishError", "build_batch",
+           "serve_rl_http"]
+
+
+def _tracer():
+    return _trace_mod.default_tracer()
+
+
+class Baseline:
+    """Running-mean reward baseline (the variance reducer in
+    REINFORCE-with-baseline).  ``advantages`` subtracts the value
+    BEFORE folding the new rewards in, so a batch never sees itself in
+    its own baseline."""
+
+    def __init__(self, beta=0.9):
+        self.beta = float(beta)
+        self.value = None
+
+    def advantages(self, rewards):
+        base = 0.0 if self.value is None else self.value
+        adv = [float(r) - base for r in rewards]
+        mean = float(np.mean(rewards)) if len(rewards) else 0.0
+        self.value = (mean if self.value is None
+                      else self.beta * self.value + (1 - self.beta) * mean)
+        return adv
+
+    def state_dict(self):
+        return {"beta": self.beta, "value": self.value}
+
+    def load_state_dict(self, d):
+        self.beta = float(d["beta"])
+        self.value = None if d["value"] is None else float(d["value"])
+
+
+def build_batch(samples, advantages, ref_logps=None, *, seq_len):
+    """Samples -> the `make_rl_loss_fn` feed: fixed-shape [B, seq_len]
+    arrays (ONE train executable per config, the engine's
+    compile-once discipline applied to training).
+
+    For sample i with sequence ``s = prompt + tokens`` the model sees
+    ``input_ids = s[:-1]`` and predicts ``labels = s[1:]``; ``mask``
+    is 1.0 exactly on the generated-token positions, where
+    ``old_logp`` carries the rollout's sampled-token logprobs,
+    ``ref_logp`` the frozen reference's, and ``adv`` broadcasts the
+    sample's scalar advantage."""
+    n = len(samples)
+    ids = np.zeros((n, seq_len), np.int32)
+    pos = np.zeros((n, seq_len), np.int32)
+    labels = np.zeros((n, seq_len), np.int32)
+    mask = np.zeros((n, seq_len), np.float32)
+    adv = np.zeros((n, seq_len), np.float32)
+    old_lp = np.zeros((n, seq_len), np.float32)
+    ref_lp = np.zeros((n, seq_len), np.float32)
+    for i, s in enumerate(samples):
+        seq = np.asarray(s.sequence, np.int32)
+        t = len(seq) - 1
+        if t > seq_len:
+            raise ValueError(
+                "sample %d needs %d positions, batch is built for %d"
+                % (i, t, seq_len))
+        ids[i, :t] = seq[:-1]
+        labels[i, :t] = seq[1:]
+        pos[i, :t] = np.arange(t, dtype=np.int32)
+        g0 = len(s.prompt_ids) - 1       # first generated label position
+        g1 = g0 + len(s.tokens)
+        mask[i, g0:g1] = 1.0
+        adv[i, g0:g1] = float(advantages[i])
+        old_lp[i, g0:g1] = np.asarray(s.logprobs, np.float32)
+        if ref_logps is not None:
+            ref_lp[i, :t] = np.asarray(ref_logps[i], np.float32)[:t]
+    return {"input_ids": ids, "position_ids": pos, "labels": labels,
+            "mask": mask, "adv": adv, "old_logp": old_lp,
+            "ref_logp": ref_lp}
+
+
+# ---------------------------------------------------------------------------
+# checkpointing
+# ---------------------------------------------------------------------------
+
+
+class PolicyCheckpointer:
+    """Full/delta checkpoints of the loop's complete state, in the
+    PR-14 `DeltaCheckpointer` cadence interface (``save(step=,
+    events_done=, window=) -> (no, kind)``, ``last_commit_time``,
+    ``restore()``) so it drops straight into `StreamingTrainer`.
+
+    ``capture() -> {name: host array}`` and ``apply(arrays)`` are the
+    loop's serializer seam (train-state params + optimizer moments +
+    step + baseline + round counter — EVERYTHING the resume drill
+    needs).  A delta commit stores only arrays whose bytes changed
+    since the previous commit (with adapters/frozen layers that is the
+    small set; full fine-tuning degrades gracefully to full size);
+    restore loads the newest full snapshot and overlays the delta
+    chain above it, newest last."""
+
+    KIND_FULL = "full"
+    KIND_DELTA = "delta"
+
+    def __init__(self, root, capture, apply, *, full_every=5,
+                 keep_chains=2, **saver_kw):
+        from ..incubate.checkpoint import CheckpointSaver
+
+        self.capture = capture
+        self.apply = apply
+        self.full_every = max(int(full_every), 1)
+        self.keep_chains = max(int(keep_chains), 1)
+        saver_kw.setdefault("max_num_checkpoints", 0)
+        self._saver = CheckpointSaver(root, **saver_kw)
+        self._last = None              # {name: bytes-compared array}
+        self.last_commit_time = None
+        self.last_commit_no = None
+
+    def _deltas_since_full(self):
+        metas = self._saver.list_checkpoints()
+        n = 0
+        for _no, meta in metas:
+            if meta.get("kind") == self.KIND_FULL:
+                n = 0
+            else:
+                n += 1
+        return n, len(metas)
+
+    def save(self, step=None, events_done=None, window=None,
+             extra_meta=None):
+        from ..incubate.checkpoint import StateSnapshot
+
+        state = {k: np.asarray(v) for k, v in self.capture().items()}
+        deltas, total = self._deltas_since_full()
+        kind = (self.KIND_FULL
+                if total == 0 or self._last is None
+                or deltas + 1 >= self.full_every else self.KIND_DELTA)
+        if kind == self.KIND_DELTA:
+            payload = {k: v for k, v in state.items()
+                       if (k not in self._last
+                           or not np.array_equal(v, self._last[k]))}
+        else:
+            payload = state
+        meta = {"kind": kind, "events_done": events_done,
+                "window": window, "n_arrays": len(payload)}
+        meta.update(extra_meta or {})
+        no = self._saver.save_checkpoint(
+            [StateSnapshot(payload, filename="policy.npz")],
+            step=step, extra_meta=meta)
+        self._last = state
+        self.last_commit_time = time.time()
+        self.last_commit_no = no
+        self._gc_chains()
+        return no, kind
+
+    def _gc_chains(self):
+        metas = self._saver.list_checkpoints()
+        fulls = [no for no, m in metas if m.get("kind") == self.KIND_FULL]
+        if len(fulls) <= self.keep_chains:
+            return
+        cut = fulls[-self.keep_chains]
+        for no, _m in metas:
+            if no < cut:
+                self._saver.delete_checkpoint(no)
+
+    def restore(self):
+        from ..incubate.checkpoint import StateSnapshot
+        from ..incubate.checkpoint.checkpoint_saver import \
+            CheckpointLoadError
+
+        metas = self._saver.list_checkpoints()
+        if not metas:
+            return None
+        newest_no, newest_meta = metas[-1]
+        fulls = [no for no, m in metas
+                 if m.get("kind") == self.KIND_FULL and no <= newest_no]
+        if not fulls:
+            raise CheckpointLoadError(
+                "no full policy snapshot at or below checkpoint_%d — "
+                "the delta chain has no base" % newest_no)
+        base = fulls[-1]
+        snap = StateSnapshot(filename="policy.npz")
+        self._saver.load_checkpoint([snap], no=base)
+        state = dict(snap.arrays)
+        for no, m in metas:
+            if base < no <= newest_no and m.get("kind") == self.KIND_DELTA:
+                d = StateSnapshot(filename="policy.npz")
+                self._saver.load_checkpoint([d], no=no)
+                state.update(d.arrays)
+        self.apply(state)
+        self._last = state
+        return newest_meta
+
+
+# ---------------------------------------------------------------------------
+# gated promotion
+# ---------------------------------------------------------------------------
+
+
+class PublishError(RuntimeError):
+    """A promotion gate refused the candidate policy (the fleet keeps
+    serving the previous weights — rollback already happened)."""
+
+
+class PolicyPublisher:
+    """PR-9's deploy -> verify -> canary -> promote chain over the
+    serving fleet's in-place weight hot-swap.
+
+    Gates, in order, all under one trace span:
+
+    1. **verify** — structural: candidate names/shapes/dtypes must
+       match what the fleet serves, every array finite (the PR-5
+       verify discipline applied to a weight payload);
+    2. **canary** — swap into ``canary_replicas`` replicas only, then
+       answer pinned greedy probe prompts THROUGH those replicas; any
+       error fails the gate;
+    3. **promote** — swap the remaining alive replicas and answer one
+       fleet-routed probe; ``live_at`` stamps AFTER that probe answers
+       (promote-then-crash cannot report a policy that never served).
+
+    Any gate failure rolls the already-swapped replicas back to the
+    pre-push snapshot and raises `PublishError`.  The returned record
+    carries ``live_at``, so `StreamingTrainer._push` measures
+    freshness off it unchanged."""
+
+    def __init__(self, fleet, params_fn, *, probe_prompts=((1, 2, 3),),
+                 probe_new_tokens=4, canary_replicas=1,
+                 version_prefix="policy-v", timeout=60.0,
+                 metrics_registry=None, name="rlpub"):
+        from ..generation import GenerationRequest, SamplingParams
+
+        self.fleet = fleet
+        self.params_fn = params_fn
+        self.probe_prompts = [list(p) for p in probe_prompts]
+        self.probe_new_tokens = int(probe_new_tokens)
+        self.canary_replicas = int(canary_replicas)
+        self.version_prefix = version_prefix
+        self.timeout = float(timeout)
+        self._mk_probe = lambda p: GenerationRequest(
+            list(p), max_new_tokens=self.probe_new_tokens,
+            sampling=SamplingParams.greedy())
+        self.pushed = []
+        reg = metrics_registry or default_registry()
+        self._label = unique_instance_label(name)
+        lbl = ("publisher",)
+        self._m_promoted = reg.counter(
+            "rl_promotions_total", "Policies promoted to serving",
+            labelnames=lbl).labels(self._label)
+        self._m_rolled_back = reg.counter(
+            "rl_rollbacks_total", "Policy pushes rolled back at a gate",
+            labelnames=lbl).labels(self._label)
+
+    # -- gates -------------------------------------------------------------
+    def _verify(self, params, reference):
+        if set(map(str, params.keys())) != set(reference.keys()):
+            raise PublishError("verify: parameter name set mismatch")
+        for k, ref in reference.items():
+            arr = np.asarray(params[k])
+            if arr.shape != ref.shape or arr.dtype != ref.dtype:
+                raise PublishError(
+                    "verify: %r is %s %s, fleet serves %s %s"
+                    % (k, arr.shape, arr.dtype, ref.shape, ref.dtype))
+            if not np.all(np.isfinite(arr)):
+                raise PublishError("verify: %r has non-finite values" % k)
+
+    def _probe_engine(self, submit):
+        """Run every pinned probe through ``submit``; an erroring or
+        empty generation fails the gate."""
+        handles = [submit(self._mk_probe(p)) for p in self.probe_prompts]
+        for r in self.fleet.replicas:
+            if r.alive and r.engine._thread is None:
+                r.engine.run_until_idle()
+        for h in handles:
+            toks = h.result(timeout=self.timeout)
+            if not toks:
+                raise PublishError("probe generated no tokens")
+
+    # -- the chain ---------------------------------------------------------
+    def push(self, version_no):
+        version = "%s%d" % (self.version_prefix, int(version_no))
+        t0 = time.time()
+        snapshot = self.fleet.snapshot_params()
+        swapped = []
+        rec = {"version": version}
+        try:
+            with _tracer().span("rl.publish", cat="rl",
+                                args={"version": version}):
+                params = {k: np.asarray(v)
+                          for k, v in self.params_fn().items()}
+                t_export = time.time()
+                self._verify(params, snapshot)
+                t_verify = time.time()
+                alive = [r for r in self.fleet.replicas if r.alive]
+                canary = alive[:max(self.canary_replicas, 1)]
+                rest = alive[len(canary):]
+                for r in canary:
+                    r.engine.swap_params(params)
+                    swapped.append(r)
+                for r in canary:
+                    self._probe_engine(r.engine.submit)
+                t_canary = time.time()
+                for r in rest:
+                    r.engine.swap_params(params)
+                    swapped.append(r)
+                self._probe_engine(self.fleet.submit)
+                t_live = time.time()
+        except Exception as e:
+            for r in swapped:
+                try:
+                    r.engine.swap_params(snapshot)
+                except Exception:
+                    pass               # a replica died mid-rollback
+            self._m_rolled_back.inc()
+            _tracer().instant("rl.publish_rollback", cat="rl",
+                              args={"version": version,
+                                    "error": str(e)})
+            if isinstance(e, PublishError):
+                raise
+            raise PublishError("%s: %s" % (type(e).__name__, e))
+        rec.update({
+            "export_s": t_export - t0,
+            "verify_s": t_verify - t_export,
+            "canary_s": t_canary - t_verify,
+            "promote_s": t_live - t_canary,
+            "total_s": t_live - t0,
+            "canary": [r.replica_id for r in canary],
+            "replicas": [r.replica_id for r in swapped],
+            "live_at": t_live,
+        })
+        self.pushed.append(rec)
+        self._m_promoted.inc()
+        return rec
+
+
+# ---------------------------------------------------------------------------
+# the loop driver
+# ---------------------------------------------------------------------------
+
+
+class _LazyRolloutBatch(StreamBatch):
+    """A StreamBatch shell that materializes on first attribute touch —
+    inside the trainer's iteration, after the previous update (see
+    module docstring)."""
+
+    def __init__(self, make):                 # noqa: super not called
+        self._make = make
+        self._real = None
+
+    def _mat(self):
+        if self._real is None:
+            self._real = self._make()
+        return self._real
+
+    feed = property(lambda self: self._mat().feed)
+    n_events = property(lambda self: self._mat().n_events)
+    ingested_at = property(lambda self: self._mat().ingested_at)
+
+
+class _RLSession:
+    """`RLTrainStep` behind the ``run(feed, fetch_list=, lr=)`` session
+    contract `StreamingTrainer` drives."""
+
+    def __init__(self, step):
+        self.step = step
+        self.state = step.init()
+
+    def run(self, feed, fetch_list=None, lr=None):
+        self.state, loss = self.step(self.state, feed)
+        return [np.asarray(loss)]
+
+    def host_params(self):
+        return {k: np.asarray(v)
+                for k, v in self.state["params"].items()}
+
+
+class FeedbackLoop:
+    """See module docstring.  ``rollout_fleet`` generates the data
+    (per-round ungated weight sync — the actor); ``serving_fleet``
+    (default: the same fleet) receives policies only through the
+    publisher's gate chain."""
+
+    def __init__(self, model, optimizer, rollout_fleet, reward_source, *,
+                 prompts, mesh=None, serving_fleet=None,
+                 rollout_batch=4, max_new_tokens=8, temperature=1.0,
+                 top_k=0, top_p=1.0, kind="reinforce", clip_eps=0.2,
+                 kl_coef=0.0, zero_stage=1, accumulate_steps=1,
+                 seq_len=None, base_seed=0, sync_every=1,
+                 baseline_beta=0.9, checkpoint_root=None,
+                 checkpoint_every_windows=1, full_every=5,
+                 push_every_windows=None, probe_prompts=None,
+                 name="rl", metrics_registry=None, **step_kwargs):
+        if mesh is None:
+            from ..distributed import auto_mesh
+
+            mesh = auto_mesh(n_devices=1)
+        self.model = model
+        self.prompts = [list(p) for p in prompts]
+        if not self.prompts:
+            raise ValueError("prompts must be non-empty")
+        self.rollout_batch = int(rollout_batch)
+        self.base_seed = int(base_seed)
+        self.sync_every = max(int(sync_every), 1)
+        self.round = 0                     # rollouts materialized so far
+        self.reward_history = []           # [(round, mean reward)]
+        self._stop = threading.Event()
+        reg = metrics_registry or default_registry()
+        self.metrics_registry = reg
+        self._name = name
+
+        self.trainer_step = RLTrainStep(
+            model, optimizer, mesh, kind=kind, clip_eps=clip_eps,
+            kl_coef=kl_coef, zero_stage=zero_stage,
+            accumulate_steps=accumulate_steps, **step_kwargs)
+        if zero_stage >= 3:
+            raise NotImplementedError(
+                "FeedbackLoop weight sync needs replicated params at "
+                "rest (zero_stage <= 2); stage-3 gather is future work")
+        self.session = _RLSession(self.trainer_step)
+        self.rollout_engine = RolloutEngine(
+            rollout_fleet, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            name="%s-rollout" % name, metrics_registry=reg)
+        self.rollout_fleet = rollout_fleet
+        self.serving_fleet = serving_fleet or rollout_fleet
+        self.reward_source = reward_source
+        self.baseline = Baseline(baseline_beta)
+        self.reference = (ReferenceScorer(model) if kl_coef else None)
+        self.kl_coef = float(kl_coef)
+        max_prompt = max(len(p) for p in self.prompts)
+        self.seq_len = int(seq_len or (max_prompt + int(max_new_tokens)))
+
+        self.publisher = PolicyPublisher(
+            self.serving_fleet, self.session.host_params,
+            probe_prompts=probe_prompts or [self.prompts[0]],
+            name="%s-pub" % name, metrics_registry=reg)
+        self.push_every_windows = push_every_windows
+
+        self.checkpointer = None
+        if checkpoint_root is not None:
+            self.checkpointer = PolicyCheckpointer(
+                checkpoint_root, self._capture_state, self._apply_state,
+                full_every=full_every)
+        self.checkpoint_every_windows = int(checkpoint_every_windows)
+
+        lbl = ("loop",)
+        self._label = unique_instance_label(name)
+        self._m_reward = reg.gauge(
+            "rl_reward_mean", "Mean reward of the last rollout round",
+            labelnames=lbl).labels(self._label)
+        self._m_rounds = reg.counter(
+            "rl_rounds_total", "Rollout rounds materialized",
+            labelnames=lbl).labels(self._label)
+
+    # -- checkpoint seam ---------------------------------------------------
+    def _capture_state(self):
+        st = self.session.state
+        out = {"__round__": np.asarray(self.round, np.int64),
+               "__baseline__": np.asarray(
+                   [np.nan if self.baseline.value is None
+                    else self.baseline.value], np.float64),
+               "__step__": np.asarray(st["step"])}
+        for k, v in st["params"].items():
+            out["params/%s" % k] = np.asarray(v)
+        for k, slots in st["opt"].items():
+            for slot, v in slots.items():
+                out["opt/%s/%s" % (k, slot)] = np.asarray(v)
+        return out
+
+    def _apply_state(self, arrays):
+        import jax.numpy as jnp
+
+        st = dict(self.session.state)
+        params = dict(st["params"])
+        opt = {k: dict(v) for k, v in st["opt"].items()}
+        for name, arr in arrays.items():
+            if name == "__round__":
+                self.round = int(arr)
+            elif name == "__baseline__":
+                v = float(np.asarray(arr)[0])
+                self.baseline.value = None if np.isnan(v) else v
+            elif name == "__step__":
+                st["step"] = jnp.asarray(arr)
+            elif name.startswith("params/"):
+                params[name[len("params/"):]] = jnp.asarray(arr)
+            elif name.startswith("opt/"):
+                _, pname, slot = name.split("/", 2)
+                opt.setdefault(pname, {})[slot] = jnp.asarray(arr)
+        st["params"] = params
+        st["opt"] = opt
+        self.session.state = st
+
+    def restore(self):
+        """Load the newest checkpoint chain (params, optimizer, step,
+        baseline, round counter); returns its meta or None.  The next
+        materialized round continues exactly where the saved run's
+        round counter left off."""
+        if self.checkpointer is None:
+            return None
+        return self.checkpointer.restore()
+
+    # -- one round ---------------------------------------------------------
+    def _round_prompts_seeds(self, rnd):
+        b = self.rollout_batch
+        prompts = [self.prompts[(rnd * b + i) % len(self.prompts)]
+                   for i in range(b)]
+        seeds = [self.base_seed + rnd * 100003 + i for i in range(b)]
+        return prompts, seeds
+
+    def _materialize_round(self):
+        rnd = self.round
+        if rnd % self.sync_every == 0:
+            self.rollout_fleet.swap_params(self.session.host_params())
+        prompts, seeds = self._round_prompts_seeds(rnd)
+        samples, acct = self.rollout_engine.rollout(prompts, seeds)
+        if not samples:
+            raise RuntimeError(
+                "rollout round %d produced no samples (accounting: %r)"
+                % (rnd, acct))
+        with _tracer().span("rl.score", cat="rl",
+                            args={"round": rnd, "n": len(samples)}):
+            rewards = self.reward_source.score(samples)
+        stamp_rewards(samples, rewards)
+        mean_r = float(np.mean(rewards))
+        self.reward_history.append((rnd, mean_r))
+        self._m_reward.set(mean_r)
+        self._m_rounds.inc()
+        adv = self.baseline.advantages(rewards)
+        ref_lp = (self.reference.score([s.sequence for s in samples])
+                  if self.reference is not None else None)
+        feed = build_batch(samples, adv, ref_lp, seq_len=self.seq_len)
+        self.round = rnd + 1
+        return StreamBatch(feed, n_events=len(samples),
+                           ingested_at=min(s.reward_at for s in samples))
+
+    def _source(self):
+        def gen():
+            while not self._stop.is_set():
+                yield _LazyRolloutBatch(self._materialize_round)
+        return StreamSource(gen())
+
+    # -- the run -----------------------------------------------------------
+    def run(self, rounds=None, max_events=None):
+        """Drive the loop for ``rounds`` rollout rounds (or until
+        ``stop()``); returns the `StreamingReport` — windows are
+        rounds, pushes carry the gate-chain records and the measured
+        freshness fields."""
+        from ..streaming import StreamingTrainer
+
+        self._stop.clear()
+        trainer = StreamingTrainer(
+            self.session, self._source(), ["loss"],
+            window_events=self.rollout_batch,
+            checkpoint=self.checkpointer,
+            checkpoint_every_windows=self.checkpoint_every_windows,
+            push=self.publisher if self.push_every_windows else None,
+            push_every_windows=self.push_every_windows,
+            name="%s-stream" % self._name,
+            metrics_registry=self.metrics_registry)
+        try:
+            return trainer.run(max_events=max_events, max_windows=rounds)
+        finally:
+            trainer.close()
+
+    def stop(self):
+        self._stop.set()
+
+    def stats(self):
+        return {
+            "round": self.round,
+            "reward_history": self.reward_history[-50:],
+            "baseline": self.baseline.value,
+            "rollout": self.rollout_engine.stats(),
+            "pushes": len(self.publisher.pushed),
+            "last_push": (self.publisher.pushed[-1]
+                          if self.publisher.pushed else None),
+        }
+
+
+# ---------------------------------------------------------------------------
+# control plane
+# ---------------------------------------------------------------------------
+
+
+def serve_rl_http(loop, host="127.0.0.1", port=8093, block=True):
+    """The loop's operator plane (`tools/rl_ctl.py` speaks this):
+    GET /healthz /readyz /stats /metrics, POST /start {"rounds": N}
+    (409 while a run is active), POST /stop.  Returns the
+    HTTPServer."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from ..inference.http_common import JsonHandlerMixin, standard_get_plane
+
+    state = {"thread": None, "report": None, "error": None,
+             "started_at": None}
+    lock = threading.Lock()
+
+    def running():
+        t = state["thread"]
+        return t is not None and t.is_alive()
+
+    def stats():
+        out = loop.stats()
+        out["running"] = running()
+        out["started_at"] = state["started_at"]
+        out["error"] = state["error"]
+        rep = state["report"]
+        if rep is not None and not running():
+            out["last_report"] = rep.to_dict()
+        return out
+
+    class Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if not standard_get_plane(
+                    self, self.path, ready_fn=loop.serving_fleet.ready,
+                    stats_fn=stats, registry=loop.metrics_registry,
+                    not_ready_reason="no alive replicas"):
+                self._send(404, {"error": "no such endpoint"})
+
+        def do_POST(self):
+            try:
+                msg = self._body()
+            except Exception as e:
+                self._send(400, {"error": str(e)})
+                return
+            if self.path == "/start":
+                with lock:
+                    if running():
+                        self._send(409, {"error": "loop already running"})
+                        return
+                    rounds = msg.get("rounds")
+                    state["error"] = None
+                    state["report"] = None
+                    state["started_at"] = time.time()
+
+                    def body():
+                        try:
+                            state["report"] = loop.run(rounds=rounds)
+                        except Exception as e:   # surfaced via /stats
+                            state["error"] = "%s: %s" % (
+                                type(e).__name__, e)
+
+                    t = threading.Thread(target=body, name="rl-loop",
+                                         daemon=True)
+                    state["thread"] = t
+                    t.start()
+                self._send(200, {"started": True, "rounds": rounds})
+            elif self.path == "/stop":
+                was = running()
+                loop.stop()
+                self._send(200, {"stopping": was})
+            else:
+                self._send(404, {"error": "no such endpoint"})
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    if block:
+        try:
+            httpd.serve_forever()
+        finally:
+            httpd.server_close()
+    else:
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd

@@ -1,0 +1,241 @@
+"""Policy-gradient losses + the reference-model KL scorer.
+
+One formula, two implementations, tested against each other and a
+numpy gradient oracle:
+
+* `pg_loss_jnp` — the pure-jnp reference of the objective;
+* `make_rl_loss_fn` — the same math written in dygraph layers, in the
+  exact ``loss_fn(model, batch) -> scalar VarBase`` shape
+  `distributed.ShardedTrainStep` compiles (so the RL step inherits
+  ZeRO-2/3 sharding and microbatch accumulation for free).
+
+The objective over a batch of ``[B, T]`` per-token tensors (``mask``
+selects generated positions, ``Z = sum(mask)``):
+
+* REINFORCE-with-baseline:  ``L = -sum(adv * logp * mask) / Z``
+* PPO clipped ratio: ``r = exp(logp - old_logp)``,
+  ``L = -sum(min(r*adv, clip(r, 1-eps, 1+eps)*adv) * mask) / Z``
+* KL penalty (always additive, coef may be 0): the non-negative,
+  differentiable k3 estimator ``kl = exp(d) - d - 1`` with
+  ``d = ref_logp - logp`` (Schulman's low-variance form; zero iff the
+  policies agree on the sampled token), ``L += kl_coef*sum(kl*mask)/Z``.
+
+``logp`` is ALWAYS the raw-softmax log-probability of the sampled
+token (`models.TransformerLM.token_logprob` at train time,
+`generation.sampling.token_logprobs` at rollout time) — temperature-1
+and unfiltered on both sides, so the PPO ratio is consistent no matter
+what sampling knobs drew the rollout.
+
+`ReferenceScorer` produces ``ref_logp``: the FROZEN initial policy
+re-scored over (prompt + generation) sequences.  It shares the
+generation engine's prefill path — the same bucketed full-causal
+flash forward, the same params-rebinding idiom, the same
+`_TRACE_LOCK` tracing discipline — so a second engine's worth of
+weights is the only extra cost.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fluid import framework, layers
+from ..fluid.dygraph import to_variable
+from ..generation.engine import _TRACE_LOCK, default_prefill_buckets
+from ..generation.sampling import token_logprobs
+
+__all__ = ["RLTrainStep", "ReferenceScorer", "make_rl_loss_fn",
+           "pg_loss_jnp"]
+
+
+def pg_loss_jnp(logp, old_logp, ref_logp, adv, mask, *,
+                kind="reinforce", clip_eps=0.2, kl_coef=0.0):
+    """The objective in pure jnp (all args [B, T]); see module
+    docstring.  The numpy gradient oracle in tests differentiates
+    THIS via jax.grad."""
+    logp = jnp.asarray(logp, jnp.float32)
+    mask = jnp.asarray(mask, jnp.float32)
+    adv = jnp.asarray(adv, jnp.float32)
+    z = jnp.maximum(jnp.sum(mask), 1.0)
+    if kind == "reinforce":
+        pg = -jnp.sum(adv * logp * mask) / z
+    elif kind == "ppo":
+        ratio = jnp.exp(logp - jnp.asarray(old_logp, jnp.float32))
+        clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps)
+        pg = -jnp.sum(jnp.minimum(ratio * adv, clipped * adv) * mask) / z
+    else:
+        raise ValueError("kind must be 'reinforce' or 'ppo', got %r"
+                         % (kind,))
+    if kl_coef:
+        d = jnp.asarray(ref_logp, jnp.float32) - logp
+        kl = jnp.exp(d) - d - 1.0
+        pg = pg + kl_coef * jnp.sum(kl * mask) / z
+    return pg
+
+
+def make_rl_loss_fn(kind="reinforce", clip_eps=0.2, kl_coef=0.0):
+    """The dygraph mirror of `pg_loss_jnp` as a ShardedTrainStep
+    ``loss_fn``.  Batch keys (host-precomputed [B, T] arrays, T =
+    sequence length minus one): ``input_ids``/``position_ids``/
+    ``labels`` int32, ``mask``/``adv``/``old_logp``/``ref_logp``
+    float32.  Everything but ``input_ids -> logits -> logp`` is data,
+    so the whole gradient flows through `token_logprob`."""
+    if kind not in ("reinforce", "ppo"):
+        raise ValueError("kind must be 'reinforce' or 'ppo', got %r"
+                         % (kind,))
+    clip_eps = float(clip_eps)
+    kl_coef = float(kl_coef)
+
+    def loss_fn(model, batch):
+        logits = model(batch["input_ids"], batch["position_ids"])
+        logp = model.token_logprob(logits, batch["labels"])   # [B, T]
+        mask = batch["mask"]
+        adv = batch["adv"]
+        z = layers.clip(layers.reduce_sum(mask), 1.0, 3.4e38)
+        if kind == "reinforce":
+            num = layers.reduce_sum(
+                layers.elementwise_mul(
+                    layers.elementwise_mul(adv, logp), mask))
+            pg = layers.scale(layers.elementwise_div(num, z), scale=-1.0)
+        else:
+            ratio = layers.exp(
+                layers.elementwise_sub(logp, batch["old_logp"]))
+            clipped = layers.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps)
+            surr = layers.elementwise_min(
+                layers.elementwise_mul(ratio, adv),
+                layers.elementwise_mul(clipped, adv))
+            num = layers.reduce_sum(layers.elementwise_mul(surr, mask))
+            pg = layers.scale(layers.elementwise_div(num, z), scale=-1.0)
+        if kl_coef:
+            d = layers.elementwise_sub(batch["ref_logp"], logp)
+            kl = layers.scale(layers.elementwise_sub(layers.exp(d), d),
+                              bias=-1.0)
+            kl_sum = layers.reduce_sum(layers.elementwise_mul(kl, mask))
+            pg = layers.elementwise_add(
+                pg, layers.scale(layers.elementwise_div(kl_sum, z),
+                                 scale=kl_coef))
+        return pg
+
+    return loss_fn
+
+
+class RLTrainStep:
+    """`make_rl_loss_fn` compiled by `ShardedTrainStep` — one SPMD
+    program per batch signature, with the distributed layer's whole
+    feature set (``zero_stage >= 2`` reduce-scatter sync,
+    ``accumulate_steps`` microbatching) riding along unchanged."""
+
+    def __init__(self, model, optimizer, mesh, *, kind="reinforce",
+                 clip_eps=0.2, kl_coef=0.0, zero_stage=1,
+                 accumulate_steps=1, **step_kwargs):
+        from ..distributed.train_step import ShardedTrainStep
+
+        self.kind = kind
+        self.clip_eps = float(clip_eps)
+        self.kl_coef = float(kl_coef)
+        self.step = ShardedTrainStep(
+            model, optimizer,
+            make_rl_loss_fn(kind=kind, clip_eps=clip_eps,
+                            kl_coef=kl_coef),
+            mesh, zero_stage=zero_stage,
+            accumulate_steps=accumulate_steps, **step_kwargs)
+
+    def init(self):
+        return self.step.init()
+
+    def __call__(self, state, batch):
+        return self.step(state, batch)
+
+    def collective_stats(self, state, batch):
+        return self.step.collective_stats(state, batch)
+
+
+class ReferenceScorer:
+    """Frozen-policy per-token logprobs over full sequences.
+
+    ``score(sequences) -> list of [len(seq)-1] float32 arrays``: the
+    reference model's ``log p(seq[t+1] | seq[:t+1])`` for every
+    position.  Sequences are right-padded to a pow2 bucket ladder (the
+    prefill ladder's shape discipline: one executable per bucket,
+    compiled once); tracing is serialized under the generation
+    engine's `_TRACE_LOCK` so scorer compiles never interleave with an
+    engine's own tracing windows."""
+
+    def __init__(self, model, params=None, *, max_len=None, buckets=None):
+        self.model = model
+        cfg = model.cfg
+        self.max_len = int(max_len or cfg.max_position_embeddings)
+        self.buckets = sorted(
+            int(b) for b in (buckets
+                             or default_prefill_buckets(self.max_len)))
+        if params is None:
+            params = {k: np.asarray(v.data)
+                      for k, v in model.state_dict().items()}
+        self._params = {k: jnp.asarray(v) for k, v in params.items()}
+        self._fns = {b: jax.jit(self._make_fn(b)) for b in self.buckets}
+
+    def _apply_frozen(self, params, fn):
+        """The engine's params-rebinding idiom: run ``fn(model)`` with
+        the frozen arrays bound under a fresh inference tracer."""
+        from ..fluid.dygraph.tracer import Tracer
+
+        model = self.model
+        old = framework._dygraph_tracer
+        tracer = Tracer()
+        tracer.train_mode = False
+        tracer._has_grad = False
+        framework._dygraph_tracer = tracer
+        try:
+            sd = model.state_dict()
+            for vb in sd.values():
+                tracer.register_var(vb)
+            saved = {}
+            for name, arr in params.items():
+                var = sd[name]
+                saved[name] = var.data
+                var.data = arr
+            try:
+                return fn(model)
+            finally:
+                for name, arr in saved.items():
+                    sd[name].data = arr
+        finally:
+            framework._dygraph_tracer = old
+
+    def _make_fn(self, bucket):
+        def score(params, ids, labels):
+            """ids/labels [1, bucket] int32 -> [bucket] f32 logprobs."""
+            def run(model):
+                pos = jnp.arange(bucket, dtype=jnp.int32)[None]
+                logits = model(to_variable(ids), to_variable(pos))
+                return logits.data
+            logits = self._apply_frozen(params, run)       # [1, b, V]
+            return token_logprobs(logits[0], labels[0])
+
+        return score
+
+    def _bucket_for(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError("sequence length %d exceeds the largest "
+                         "reference bucket %d" % (n, self.buckets[-1]))
+
+    def score(self, sequences):
+        out = []
+        for seq in sequences:
+            seq = np.asarray(seq, np.int32)
+            n = len(seq) - 1
+            if n < 1:
+                out.append(np.zeros(0, np.float32))
+                continue
+            b = self._bucket_for(n)
+            ids = np.zeros((1, b), np.int32)
+            labels = np.zeros((1, b), np.int32)
+            ids[0, :n] = seq[:-1]
+            labels[0, :n] = seq[1:]
+            with _TRACE_LOCK:
+                lp = self._fns[b](self._params, ids, labels)
+            out.append(np.asarray(lp)[:n].astype(np.float32))
+        return out

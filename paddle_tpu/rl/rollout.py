@@ -1,0 +1,179 @@
+"""RolloutEngine: drive the generation fleet to produce training data.
+
+One rollout = submit a batch of prompts to a `GenerationFleet` (or a
+bare `GenerationEngine`) built with ``logprobs=True`` and collect
+`(prompt, generation, per-token logprobs)` samples.  Two properties the
+RL loop leans on:
+
+* **determinism** — every sample's PRNG stream is its request seed
+  (`sampling.make_base_key`), and the engine's exactness property makes
+  tokens independent of slot assignment, arrival order and replica
+  choice; a rollout with the same seeds against the same weights
+  reproduces byte-identically (the resume drill's foundation);
+* **exact accounting** — every submitted prompt is accounted for:
+  ``submitted == completed + failed`` per rollout, with requeues (the
+  fleet's once-after-replica-death discipline) counted separately.
+  A replica killed mid-rollout therefore shows up as requeued samples
+  and an intact ledger, never as silently missing events.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..generation import GenerationRequest, SamplingParams
+from ..observability import trace as _trace_mod
+from ..observability.metrics import default_registry, unique_instance_label
+
+__all__ = ["RolloutEngine", "RolloutSample"]
+
+
+def _tracer():
+    return _trace_mod.default_tracer()
+
+
+class RolloutSample:
+    """One (prompt, generation, logprobs) sample, later stamped with its
+    reward (`reward.stamp_rewards`) — the loop's event unit."""
+
+    __slots__ = ("prompt_ids", "tokens", "logprobs", "finish_reason",
+                 "seed", "requeued", "reward", "reward_at")
+
+    def __init__(self, prompt_ids, tokens, logprobs, finish_reason,
+                 seed, requeued=False):
+        self.prompt_ids = list(prompt_ids)
+        self.tokens = list(tokens)
+        self.logprobs = list(logprobs)
+        self.finish_reason = finish_reason
+        self.seed = int(seed)
+        self.requeued = bool(requeued)
+        self.reward = None
+        self.reward_at = None
+
+    @property
+    def sequence(self):
+        """prompt + generation, the trainer's token stream."""
+        return self.prompt_ids + self.tokens
+
+    def to_dict(self):
+        return {"prompt_ids": self.prompt_ids, "tokens": self.tokens,
+                "logprobs": self.logprobs, "reward": self.reward,
+                "finish_reason": self.finish_reason, "seed": self.seed}
+
+
+def _target_engines(target):
+    """The engines behind ``target`` (fleet or bare engine)."""
+    if hasattr(target, "replicas"):
+        return [r.engine for r in target.replicas]
+    return [target]
+
+
+class RolloutEngine:
+    """See module docstring.
+
+    ``target`` is a `serving.GenerationFleet` or a `GenerationEngine`;
+    its engines must have been built with ``logprobs=True`` (the
+    satellite seam) — rollouts without sampled-token logprobs cannot
+    feed a policy-gradient trainer, so that is validated up front.
+    """
+
+    def __init__(self, target, *, max_new_tokens=16, temperature=1.0,
+                 top_k=0, top_p=1.0, stop_token_ids=(), timeout=120.0,
+                 name="rollout", metrics_registry=None):
+        for eng in _target_engines(target):
+            if not getattr(eng, "return_logprobs", False):
+                raise ValueError(
+                    "RolloutEngine needs engines built with "
+                    "logprobs=True (engine %r has them disabled)"
+                    % getattr(eng, "_engine", eng))
+        self.target = target
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.stop_token_ids = tuple(stop_token_ids)
+        self.timeout = float(timeout)
+        # cumulative ledger across rollouts
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.requeued = 0
+        self.tokens = 0
+        reg = metrics_registry or default_registry()
+        self._label = unique_instance_label(name)
+        lbl = ("rollout",)
+        self._m_samples = reg.counter(
+            "rl_rollout_samples_total", "Completed rollout samples",
+            labelnames=lbl).labels(self._label)
+        self._m_failed = reg.counter(
+            "rl_rollout_failed_total", "Failed rollout samples",
+            labelnames=lbl).labels(self._label)
+        self._m_tokens = reg.counter(
+            "rl_rollout_tokens_total", "Generated rollout tokens",
+            labelnames=lbl).labels(self._label)
+
+    def _sampling(self, seed):
+        return SamplingParams(temperature=self.temperature,
+                              top_k=self.top_k, top_p=self.top_p,
+                              seed=seed)
+
+    def _drive(self):
+        """Bare un-threaded engines are driven synchronously; a started
+        fleet (or engine) decodes on its own scheduler threads."""
+        for eng in _target_engines(self.target):
+            if eng._thread is None and not eng.dead:
+                eng.run_until_idle()
+
+    def rollout(self, prompts, seeds):
+        """Generate one sample per prompt; ``seeds`` (same length) give
+        each sample its PRNG stream.  Returns (samples, accounting):
+        failed samples (a request that lost TWO replicas, or a dead
+        bare engine) are dropped from ``samples`` but counted, so
+        ``accounting["submitted"] == len(samples) + accounting["failed"]``
+        always holds."""
+        if len(prompts) != len(seeds):
+            raise ValueError("prompts and seeds must align")
+        t0 = time.perf_counter()
+        with _tracer().span("rl.rollout", cat="rl",
+                            args={"n": len(prompts)}):
+            handles = []
+            for p, seed in zip(prompts, seeds):
+                req = GenerationRequest(
+                    list(p), max_new_tokens=self.max_new_tokens,
+                    sampling=self._sampling(int(seed)),
+                    stop_token_ids=self.stop_token_ids)
+                handles.append((self.target.submit(req), seed))
+            self._drive()
+            samples, failed, requeued = [], 0, 0
+            for (h, seed) in handles:
+                try:
+                    toks = h.result(timeout=self.timeout)
+                    lps = h.logprobs(timeout=self.timeout)
+                except Exception:
+                    failed += 1
+                    continue
+                if getattr(h, "requeued", False):
+                    requeued += 1
+                samples.append(RolloutSample(
+                    h.request.prompt_ids, toks, lps, h.finish_reason,
+                    seed, requeued=getattr(h, "requeued", False)))
+        n_tokens = sum(len(s.tokens) for s in samples)
+        acct = {"submitted": len(handles), "completed": len(samples),
+                "failed": failed, "requeued": requeued,
+                "tokens": n_tokens,
+                "dur_s": time.perf_counter() - t0}
+        self.submitted += acct["submitted"]
+        self.completed += acct["completed"]
+        self.failed += failed
+        self.requeued += requeued
+        self.tokens += n_tokens
+        self._m_samples.inc(acct["completed"])
+        if failed:
+            self._m_failed.inc(failed)
+        self._m_tokens.inc(n_tokens)
+        return samples, acct
+
+    def stats(self):
+        return {"submitted": self.submitted, "completed": self.completed,
+                "failed": self.failed, "requeued": self.requeued,
+                "tokens": self.tokens}

@@ -206,6 +206,33 @@ class GenerationFleet:
                     "requeue after replica death failed: %s: %s"
                     % (type(e).__name__, e))
 
+    # -- weight hot-swap ---------------------------------------------------
+    def swap_params(self, params, replica_ids=None):
+        """Hot-swap serving weights on alive replicas (all of them, or
+        the subset named by ``replica_ids`` — the canary seam
+        `paddle_tpu.rl.PolicyPublisher` drives).  Returns the replica
+        ids actually swapped; raises if none were."""
+        swapped = []
+        for r in self._alive():
+            if replica_ids is not None and r.replica_id not in replica_ids:
+                continue
+            r.engine.swap_params(params)
+            swapped.append(r.replica_id)
+        if not swapped:
+            raise RuntimeError(
+                "generation fleet %s: no alive replica matched swap"
+                % self._fleet)
+        return swapped
+
+    def snapshot_params(self):
+        """Rollback point: host copies of the first alive replica's
+        weights (replicas only ever diverge mid-canary)."""
+        alive = self._alive()
+        if not alive:
+            raise RuntimeError(
+                "generation fleet %s has no alive replicas" % self._fleet)
+        return alive[0].engine.snapshot_params()
+
     # -- observability -----------------------------------------------------
     def ready(self):
         return bool(self._alive())
@@ -303,7 +330,10 @@ def handle_generate(handler, fleet, msg):
             for ev in h.events(timeout=timeout):
                 kind = ev[0]
                 if kind == "token":
-                    chunk({"index": ev[1], "token": ev[2]})
+                    rec = {"index": ev[1], "token": ev[2]}
+                    if len(ev) > 3:    # logprob engines append a field;
+                        rec["logprob"] = ev[3]   # off => byte-identical
+                    chunk(rec)
                 elif kind == "restart":
                     chunk({"event": "restart"})
                 elif kind == "done":

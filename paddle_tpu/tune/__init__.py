@@ -34,7 +34,10 @@ Front ends:
   (``benchmarks/streaming_bench.py --autotune``);
 * ``search_generation_config(build_and_time, ...)`` — the decode
   engine's slot count (`paddle_tpu.generation`;
-  ``benchmarks/generation_bench.py --autotune``).
+  ``benchmarks/generation_bench.py --autotune``);
+* ``search_rl_config(build_and_time, ...)`` — the RL feedback loop's
+  rollout-vs-train batch arbitration (`paddle_tpu.rl`;
+  ``benchmarks/rl_loop_bench.py --autotune``).
 
 Entry points: ``CompiledProgram.with_autotune()`` (Executor applies the
 tuned pipeline on first run), ``InferenceServer.autotune()``,
@@ -57,6 +60,7 @@ from .search import (  # noqa: F401
     search_flash_blocks,
     search_gemm_blocks,
     search_generation_config,
+    search_rl_config,
     search_hostemb_cache,
     search_step,
     search_train_step,
@@ -70,6 +74,7 @@ from .space import (  # noqa: F401
     flash_block_candidates,
     gemm_block_candidates,
     ladder_candidates,
+    rl_batch_candidates,
     sharding_candidates,
     train_step_candidates,
 )
@@ -88,11 +93,13 @@ __all__ = [
     "flash_block_candidates",
     "gemm_block_candidates",
     "ladder_candidates",
+    "rl_batch_candidates",
     "search",
     "search_bucket_ladder",
     "search_flash_blocks",
     "search_gemm_blocks",
     "search_hostemb_cache",
+    "search_rl_config",
     "search_step",
     "search_train_step",
     "sharding_candidates",

@@ -1208,6 +1208,30 @@ def search_step(build_and_time, variants, *, workload, mesh=None,
         cache_path=cache_path, cache_stored=bool(cache_stored))
 
 
+def search_rl_config(build_and_time, *, workload,
+                     rollout_batches=(4, 8, 16),
+                     accumulate_steps=(1, 2, 4), sync_every=(1,),
+                     mesh=None, use_cache=True, cache_dir=None,
+                     platform=None, jax_version=None):
+    """Measured search over the RL loop's rollout-vs-train batch
+    arbitration (`space.rl_batch_candidates`).
+
+    ``build_and_time(params) -> seconds-per-event`` owns building a
+    ``FeedbackLoop(rollout_batch=..., accumulate_steps=...,
+    sync_every=...)`` and running a few representative rounds
+    (`benchmarks/rl_loop_bench.py`'s harness); the tuner owns
+    enumeration, ordering, reporting, and the cache."""
+    cands = space_mod.rl_batch_candidates(
+        rollout_batches=rollout_batches,
+        accumulate_steps=accumulate_steps, sync_every=sync_every)
+    if not cands:
+        raise ValueError("no feasible rl batch candidates")
+    return search_step(
+        build_and_time, cands, workload=workload, mesh=mesh,
+        use_cache=use_cache, cache_dir=cache_dir, platform=platform,
+        jax_version=jax_version)
+
+
 def search_generation_config(build_and_time, *, workload,
                              slot_counts=(1, 4, 8, 16), max_len=None,
                              hbm_budget_bytes=None,

@@ -389,6 +389,44 @@ class SearchSpace:
         return cands
 
 
+def rl_batch_candidates(rollout_batches=(4, 8, 16),
+                        accumulate_steps=(1, 2, 4),
+                        sync_every=(1,)):
+    """Rollout-vs-train batch arbitration for `paddle_tpu.rl`
+    (`FeedbackLoop` knobs).
+
+    The loop's throughput is a tug-of-war: bigger rollout batches
+    amortize decode-step weight reads across more slots and feed the
+    trainer larger (rarer) updates; more microbatch accumulation
+    shrinks the train step's peak memory but delays the weight sync
+    the NEXT rollout generates with, aging its policy.  Freshness and
+    events/s move in opposite directions along both axes, so the
+    sweet spot is workload-dependent and MEASURED (`search_rl_config`,
+    events-per-second objective).  First candidate = the caller's
+    default (search_step baseline contract)."""
+    out, seen = [], set()
+    for rb in rollout_batches:
+        for acc in accumulate_steps:
+            for se in sync_every:
+                rb_, acc_, se_ = int(rb), int(acc), int(se)
+                if rb_ <= 0 or acc_ <= 0 or se_ <= 0:
+                    continue
+                if rb_ % acc_:
+                    continue            # microbatches must tile the batch
+                key = (rb_, acc_, se_)
+                if key in seen:
+                    continue
+                seen.add(key)
+                label = "roll%d.acc%d" % (rb_, acc_)
+                if se_ != 1:
+                    label += ".sync%d" % se_
+                out.append(Candidate(
+                    "rl", {"rollout_batch": rb_,
+                           "accumulate_steps": acc_,
+                           "sync_every": se_}, label=label))
+    return out
+
+
 def generation_config_candidates(slot_counts=(1, 4, 8, 16),
                                  max_len=None, hbm_budget_bytes=None,
                                  cache_bytes_per_slot=None):

@@ -160,6 +160,7 @@ def test_linear_chain_crf_vs_enumeration(rng):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_linear_chain_crf_grad(rng):
     B, T, N = 2, 3, 3
     inputs = {
@@ -386,6 +387,7 @@ def test_warpctc_empty_label(rng):
     np.testing.assert_allclose(outs["Loss"][0, 0], expect, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_warpctc_grad(rng):
     B, T, C, Lmax = 2, 3, 3, 2
     inputs = {

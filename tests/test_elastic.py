@@ -53,6 +53,7 @@ def _assert_drill(report):
     assert report["passed"], report["checks"]
 
 
+@pytest.mark.slow
 def test_kill_and_reshape_shrink(tmp_path):
     """SIGKILL a rank of 3 mid-epoch; resume on 2 (M < N): the resumed
     loss/param trajectory must equal the control run at the new
@@ -92,6 +93,7 @@ def test_kill_and_reshape_shrink(tmp_path):
     assert topo["loaders"]["dataloader0"]["nranks"] == 3
 
 
+@pytest.mark.slow
 def test_kill_and_reshape_grow(tmp_path):
     """SIGKILL a rank of 2 mid-epoch; resume on 4 (M > N): ranks 2 and 3
     never existed at save time — their shards and cursors come entirely

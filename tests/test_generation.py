@@ -425,3 +425,107 @@ def test_tune_generation_slot_search():
         tune.search_generation_config(
             lambda p: 1.0, workload="none", slot_counts=(64,),
             hbm_budget_bytes=1, cache_bytes_per_slot=2 ** 30)
+
+
+# ---------------------------------------------------------------------------
+# per-token logprobs (opt-in) + in-place weight hot-swap
+# ---------------------------------------------------------------------------
+
+
+class TestLogprobsAndSwap:
+    def test_logprobs_match_full_forward_rescore(self, lm):
+        """Engine logprobs are log-softmax of the RAW logits at the
+        sampled token — verified against a full causal forward over
+        (prompt + generation), the `rl.ReferenceScorer` semantics."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.fluid import framework
+        from paddle_tpu.generation.sampling import token_logprobs
+
+        eng = make_engine(lm, logprobs=True)
+        req = gen.GenerationRequest(
+            [3, 1, 4, 1, 5], max_new_tokens=5,
+            sampling=gen.SamplingParams(temperature=0.8, top_k=10,
+                                        seed=77))
+        h = eng.submit(req)
+        eng.run_until_idle()
+        toks, lps = h.result(), h.logprobs()
+        assert len(lps) == len(toks) and all(lp <= 0.0 for lp in lps)
+
+        seq = req.prompt_ids + toks
+        with dygraph.guard():
+            framework._dygraph_tracer.train_mode = False
+            for vb in lm.state_dict().values():
+                framework._dygraph_tracer.register_var(vb)
+            ids = np.asarray(seq[:-1], np.int64)[None]
+            pos = np.arange(len(seq) - 1, dtype=np.int64)[None]
+            logits = lm(dygraph.to_variable(ids),
+                        dygraph.to_variable(pos))
+        ref = np.asarray(token_logprobs(
+            jnp.asarray(logits.data)[0],
+            jnp.asarray(seq[1:], jnp.int32)))
+        g0 = len(req.prompt_ids) - 1
+        np.testing.assert_allclose(lps, ref[g0:g0 + len(toks)],
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_disabled_engine_streams_are_byte_identical(self, lm):
+        """logprobs=False (the default) is the pre-logprob engine to
+        the byte: 3-tuple token events, empty handle.logprobs(), and
+        the SAME tokens as a logprob engine at the same seeds."""
+        reqs = mixed_requests(4)
+        plain = make_engine(lm)
+        with_lp = make_engine(lm, logprobs=True)
+        ev_plain, out_plain, out_lp = [], [], []
+        for r in reqs:
+            h = plain.submit(gen.GenerationRequest(
+                r.prompt_ids, max_new_tokens=r.max_new_tokens,
+                sampling=r.sampling))
+            plain.run_until_idle()
+            ev_plain.extend(e for e in h.events(timeout=5.0)
+                            if e[0] == "token")
+            out_plain.append(h.result())
+            assert h.logprobs() == []
+        for r in reqs:
+            h = with_lp.submit(gen.GenerationRequest(
+                r.prompt_ids, max_new_tokens=r.max_new_tokens,
+                sampling=r.sampling))
+            with_lp.run_until_idle()
+            out_lp.append(h.result())
+            assert len(h.logprobs()) == len(out_lp[-1])
+        assert all(len(e) == 3 for e in ev_plain)
+        assert out_plain == out_lp
+
+    def test_swap_params_serves_new_weights_without_recompile(self, lm):
+        """Hot-swap: same shapes -> zero new executables, next request
+        decodes under the new weights; name/shape mismatches refused."""
+        eng = make_engine(lm, logprobs=True)
+        req = lambda: gen.GenerationRequest([2, 7, 1, 8], max_new_tokens=4)
+        h0 = eng.submit(req())
+        eng.run_until_idle()
+        before = h0.result()
+        snap = eng.snapshot_params()
+
+        rng = np.random.RandomState(123)
+        bumped = {k: (v + rng.normal(scale=0.5, size=v.shape)
+                      .astype(v.dtype) if v.ndim >= 2 else v)
+                  for k, v in snap.items()}
+        eng.swap_params(bumped)
+        h1 = eng.submit(req())
+        eng.run_until_idle()
+        after = h1.result()
+        assert eng._decode_cache_size() == 1
+        assert after != before          # tiny-vocab greedy path moved
+
+        eng.swap_params(snap)           # rollback restores the stream
+        h2 = eng.submit(req())
+        eng.run_until_idle()
+        assert h2.result() == before
+
+        with pytest.raises(ValueError):
+            eng.swap_params({k: v for k, v in snap.items()
+                             if k != "word.weight"})
+        bad = dict(snap)
+        name = next(k for k in bad if bad[k].ndim == 2)
+        bad[name] = bad[name][:, :-1]
+        with pytest.raises(ValueError):
+            eng.swap_params(bad)

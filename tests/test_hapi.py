@@ -204,6 +204,7 @@ def test_vision_transform_pipeline():
     assert c.shape == (3, 20, 20)
 
 
+@pytest.mark.slow
 def test_text_encoders_train():
     from paddle_tpu import hapi
     from paddle_tpu.fluid import dygraph

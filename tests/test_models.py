@@ -186,6 +186,7 @@ def test_bert_masked_positions_matches_full_head():
             gv[b], fullv[b, mpos[b]], rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_vgg_and_mobilenet_forward_and_train():
     """New vision zoo members produce logits and take a training step."""
     from paddle_tpu.fluid.dygraph import to_variable

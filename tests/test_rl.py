@@ -1,0 +1,524 @@
+"""`paddle_tpu.rl`: the rollout -> score -> train -> hot-swap loop.
+
+The load-bearing drills:
+
+* **loss oracle** — `pg_loss_jnp`'s gradients (REINFORCE, PPO clip,
+  KL k3) against hand-derived numpy formulas, and the dygraph
+  `make_rl_loss_fn` mirror against `pg_loss_jnp` through a real model;
+* **determinism** — a checkpointed loop restored into a FRESH
+  model/fleet/loop continues bit-identically to an uninterrupted
+  control (the lazy-batch design: round k's rollout always sees
+  post-round-k-1 params, so there is no prefetch skew to lose);
+* **fault** — a replica killed mid-rollout leaves the loop live with
+  an exact ledger (submitted == completed + failed, requeues counted);
+* **gates** — a poisoned candidate policy is rolled back at the verify
+  gate and the fleet keeps answering with the old weights;
+* **e2e** — on the verifiable `TokenAffinityReward`, measured reward
+  improves over the run while policies ship through
+  verify -> canary -> promote with zero failed requests and measured
+  freshness.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import models
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.fluid.optimizer import AdamOptimizer, SGDOptimizer
+from paddle_tpu.incubate.fault import FaultPlan
+
+rl = paddle_tpu.rl
+serving = paddle_tpu.serving
+gen = paddle_tpu.generation
+
+CFG = models.TransformerLMConfig.tiny()
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_model():
+    with dygraph.guard():
+        np.random.seed(0)
+        return models.TransformerLM(CFG)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return make_model()
+
+
+def make_fleet(model, replicas=1, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("prefill_buckets", [8, 16])
+    kw.setdefault("logprobs", True)
+    return serving.GenerationFleet(model, replicas=replicas, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the loss formula: jnp reference vs numpy gradient oracle
+# ---------------------------------------------------------------------------
+
+
+class TestLossOracle:
+    def _data(self, seed=0, b=3, t=6):
+        rng = np.random.RandomState(seed)
+        logp = -np.abs(rng.randn(b, t)).astype(np.float32) - 0.1
+        old = logp + rng.uniform(-0.4, 0.4, (b, t)).astype(np.float32)
+        ref = logp + rng.uniform(-0.3, 0.3, (b, t)).astype(np.float32)
+        adv = rng.randn(b, t).astype(np.float32)
+        mask = (rng.rand(b, t) > 0.3).astype(np.float32)
+        return logp, old, ref, adv, mask
+
+    def test_reinforce_grad_matches_numpy_oracle(self):
+        import jax
+
+        logp, old, ref, adv, mask = self._data()
+        z = max(mask.sum(), 1.0)
+        g = np.asarray(jax.grad(
+            lambda lp: rl.pg_loss_jnp(lp, old, ref, adv, mask,
+                                      kind="reinforce"))(logp))
+        np.testing.assert_allclose(g, -adv * mask / z, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_kl_grad_matches_numpy_oracle(self):
+        """d/dlogp of kl_coef*sum((exp(d)-d-1)*mask)/Z with
+        d = ref - logp is kl_coef*(1 - exp(ref - logp))*mask/Z."""
+        import jax
+
+        coef = 0.7
+        logp, old, ref, adv, mask = self._data(seed=1)
+        z = max(mask.sum(), 1.0)
+        g = np.asarray(jax.grad(
+            lambda lp: rl.pg_loss_jnp(lp, old, ref, adv, mask,
+                                      kind="reinforce",
+                                      kl_coef=coef))(logp))
+        oracle = (-adv * mask / z
+                  + coef * (1.0 - np.exp(ref - logp)) * mask / z)
+        np.testing.assert_allclose(g, oracle, rtol=1e-4, atol=1e-5)
+
+    def test_ppo_grad_matches_numpy_oracle(self):
+        """min(r*adv, clip(r)*adv): the active unclipped branch
+        contributes -r*adv*mask/Z, a strictly-clipped branch 0 (jax's
+        tie convention keeps the unclipped side when clip(r) == r)."""
+        import jax
+
+        eps = 0.2
+        logp, old, ref, adv, mask = self._data(seed=2)
+        z = max(mask.sum(), 1.0)
+        ratio = np.exp(logp - old)
+        unclipped = ratio * adv
+        clipped = np.clip(ratio, 1 - eps, 1 + eps) * adv
+        active = unclipped <= clipped
+        oracle = -np.where(active, ratio * adv, 0.0) * mask / z
+        g = np.asarray(jax.grad(
+            lambda lp: rl.pg_loss_jnp(lp, old, ref, adv, mask,
+                                      kind="ppo",
+                                      clip_eps=eps))(logp))
+        np.testing.assert_allclose(g, oracle, rtol=1e-4, atol=1e-5)
+
+    def test_bad_kind_refused(self):
+        with pytest.raises(ValueError):
+            rl.pg_loss_jnp(np.zeros((1, 1)), None, None,
+                           np.zeros((1, 1)), np.ones((1, 1)),
+                           kind="a2c")
+        with pytest.raises(ValueError):
+            rl.make_rl_loss_fn(kind="a2c")
+
+    @pytest.mark.parametrize("kind,kl", [("reinforce", 0.0),
+                                         ("reinforce", 0.5),
+                                         ("ppo", 0.0)])
+    def test_dygraph_mirror_matches_jnp_through_model(self, lm, kind, kl):
+        """`make_rl_loss_fn` through a real TransformerLM equals
+        `pg_loss_jnp` over the model's own logprobs."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.fluid import framework
+        from paddle_tpu.generation.sampling import token_logprobs
+
+        rng = np.random.RandomState(5)
+        samples = [
+            rl.RolloutSample([1, 2, 3], [4, 5], [-1.0, -0.8], "length", 0),
+            rl.RolloutSample([6, 7], [8, 9, 1], [-0.5, -2.0, -0.3],
+                             "length", 1),
+        ]
+        batch = rl.build_batch(samples, [0.7, -1.2],
+                               [rng.randn(5).astype(np.float32)] * 2,
+                               seq_len=6)
+        loss_fn = rl.make_rl_loss_fn(kind=kind, kl_coef=kl)
+        with dygraph.guard():
+            framework._dygraph_tracer.train_mode = False
+            for vb in lm.state_dict().values():
+                framework._dygraph_tracer.register_var(vb)
+            feed = {k: dygraph.to_variable(v) for k, v in batch.items()}
+            out = loss_fn(lm, feed)
+            got = float(np.asarray(out.data))
+
+            logits = lm(dygraph.to_variable(batch["input_ids"]),
+                        dygraph.to_variable(batch["position_ids"]))
+        lp = np.stack([
+            np.asarray(token_logprobs(
+                jnp.asarray(logits.data)[i],
+                jnp.asarray(batch["labels"][i])))
+            for i in range(2)])
+        want = float(rl.pg_loss_jnp(
+            lp, batch["old_logp"], batch["ref_logp"], batch["adv"],
+            batch["mask"], kind=kind, kl_coef=kl))
+        np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-5)
+
+
+def test_build_batch_layout():
+    s = rl.RolloutSample([5, 6, 7], [1, 2], [-0.5, -0.25], "length", 9)
+    b = rl.build_batch([s], [2.0], seq_len=6)
+    np.testing.assert_array_equal(b["input_ids"][0],
+                                  [5, 6, 7, 1, 0, 0])
+    np.testing.assert_array_equal(b["labels"][0], [6, 7, 1, 2, 0, 0])
+    np.testing.assert_array_equal(b["position_ids"][0],
+                                  [0, 1, 2, 3, 0, 0])
+    np.testing.assert_array_equal(b["mask"][0], [0, 0, 1, 1, 0, 0])
+    np.testing.assert_array_equal(b["adv"][0], [0, 0, 2, 2, 0, 0])
+    np.testing.assert_array_equal(b["old_logp"][0],
+                                  [0, 0, -0.5, -0.25, 0, 0])
+    with pytest.raises(ValueError):
+        rl.build_batch([s], [2.0], seq_len=3)
+
+
+def test_reference_scorer_matches_direct_forward(lm):
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.generation.sampling import token_logprobs
+
+    seq = [3, 1, 4, 1, 5, 9, 2]
+    scorer = rl.ReferenceScorer(lm, max_len=32)
+    got = scorer.score([seq])[0]
+    assert got.shape == (len(seq) - 1,)
+
+    with dygraph.guard():
+        framework._dygraph_tracer.train_mode = False
+        for vb in lm.state_dict().values():
+            framework._dygraph_tracer.register_var(vb)
+        ids = np.asarray(seq[:-1], np.int64)[None]
+        pos = np.arange(len(seq) - 1, dtype=np.int64)[None]
+        logits = lm(dygraph.to_variable(ids), dygraph.to_variable(pos))
+    want = np.asarray(token_logprobs(jnp.asarray(logits.data)[0],
+                                     jnp.asarray(seq[1:], jnp.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# rollout: determinism + exact accounting
+# ---------------------------------------------------------------------------
+
+
+class TestRollout:
+    def test_deterministic_and_exactly_accounted(self, lm):
+        eng = gen.GenerationEngine(lm, slots=4, max_len=32,
+                                   prefill_buckets=[8, 16],
+                                   logprobs=True)
+        ro = rl.RolloutEngine(eng, max_new_tokens=5, temperature=0.9,
+                              top_k=10)
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+        seeds = [11, 22, 33]
+        s1, a1 = ro.rollout(prompts, seeds)
+        s2, a2 = ro.rollout(prompts, seeds)
+        assert a1["submitted"] == a1["completed"] == len(prompts)
+        assert a1["failed"] == 0
+        assert a1["tokens"] == sum(len(s.tokens) for s in s1)
+        for x, y in zip(s1, s2):
+            assert x.tokens == y.tokens and x.logprobs == y.logprobs
+            assert len(x.logprobs) == len(x.tokens)
+        assert ro.submitted == 6 and ro.completed == 6
+
+    def test_engine_without_logprobs_refused(self, lm):
+        eng = gen.GenerationEngine(lm, slots=2, max_len=32,
+                                   prefill_buckets=[8])
+        with pytest.raises(ValueError):
+            rl.RolloutEngine(eng)
+
+    def test_replica_kill_mid_rollout_keeps_ledger_exact(self, lm):
+        """Fault-plan kill of replica 0 mid-rollout: affected requests
+        requeue once onto the survivor, the ledger stays exact, and the
+        loop's next rollout still works."""
+        plan = FaultPlan([], rank=0)
+        plan.add("kill_replica", replica=0, request=3)
+        fleet = make_fleet(lm, replicas=2, fault_plan=plan).start()
+        try:
+            ro = rl.RolloutEngine(fleet, max_new_tokens=6, timeout=60.0)
+            prompts = [[1 + i, 2 + i, 3 + i] for i in range(6)]
+            samples, acct = ro.rollout(prompts, list(range(6)))
+            assert acct["submitted"] == 6
+            assert acct["completed"] + acct["failed"] == 6
+            assert acct["failed"] == 0          # survivor absorbed all
+            assert acct["requeued"] >= 1
+            assert any(s.requeued for s in samples)
+            assert int(fleet._m_deaths.value) == 1
+            assert fleet.ready()
+            s2, a2 = ro.rollout([[7, 7, 7]], [99])
+            assert a2["completed"] == 1 and len(s2[0].tokens) == 6
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# checkpointing
+# ---------------------------------------------------------------------------
+
+
+def test_policy_checkpointer_full_delta_chain(tmp_path):
+    state = {"a": np.arange(4, dtype=np.float32),
+             "b": np.zeros(3, np.float32)}
+    applied = {}
+    ck = rl.PolicyCheckpointer(str(tmp_path), lambda: state,
+                               applied.update, full_every=3)
+    kinds = []
+    for i in range(5):
+        state = dict(state)
+        state["a"] = state["a"] + 1.0       # "b" never changes
+        kinds.append(ck.save(step=i, window=i))
+    assert [k for _no, k in kinds] == \
+        ["full", "delta", "delta", "full", "delta"]
+    metas = ck._saver.list_checkpoints()
+    by_no = dict(metas)
+    assert by_no[kinds[1][0]]["n_arrays"] == 1      # delta: only "a"
+    assert by_no[kinds[3][0]]["n_arrays"] == 2      # full: everything
+
+    fresh = rl.PolicyCheckpointer(str(tmp_path), lambda: {},
+                                  applied.update, full_every=3)
+    meta = fresh.restore()
+    assert meta["window"] == 4
+    np.testing.assert_array_equal(applied["a"], state["a"])
+    np.testing.assert_array_equal(applied["b"], state["b"])
+
+
+# ---------------------------------------------------------------------------
+# gated promotion
+# ---------------------------------------------------------------------------
+
+
+class TestPublisher:
+    def test_gate_failure_rolls_back_and_old_policy_serves(self, lm):
+        fleet = make_fleet(lm, replicas=2)
+        try:
+            probe = gen.GenerationRequest([2, 7, 1], max_new_tokens=4)
+            h = fleet.submit(probe)
+            for r in fleet.replicas:
+                r.engine.run_until_idle()
+            before = h.result(timeout=30)
+
+            good = fleet.snapshot_params()
+            poisoned = dict(good)
+            name = next(iter(poisoned))
+            bad = np.array(poisoned[name], copy=True)
+            bad.flat[0] = np.nan
+            poisoned[name] = bad
+            pub = rl.PolicyPublisher(fleet, lambda: poisoned,
+                                     probe_prompts=[[1, 2, 3]])
+            with pytest.raises(rl.PublishError):
+                pub.push(0)
+            assert pub.pushed == []
+            assert int(pub._m_rolled_back.value) == 1
+            assert int(pub._m_promoted.value) == 0
+
+            h = fleet.submit(gen.GenerationRequest([2, 7, 1],
+                                                   max_new_tokens=4))
+            for r in fleet.replicas:
+                r.engine.run_until_idle()
+            assert h.result(timeout=30) == before
+        finally:
+            fleet.stop()
+
+    def test_push_promotes_through_canary_with_live_at(self, lm):
+        fleet = make_fleet(lm, replicas=2)
+        try:
+            params = fleet.snapshot_params()
+            rng = np.random.RandomState(3)
+            cand = {k: (v + rng.normal(scale=0.05, size=v.shape)
+                        .astype(v.dtype) if v.ndim >= 2 else v)
+                    for k, v in params.items()}
+            pub = rl.PolicyPublisher(fleet, lambda: cand,
+                                     probe_prompts=[[1, 2, 3]],
+                                     canary_replicas=1)
+            rec = pub.push(1)
+            assert rec["live_at"] <= time.time()
+            assert len(rec["canary"]) == 1
+            assert set(rec["replicas"]) == \
+                {r.replica_id for r in fleet.replicas}
+            assert int(pub._m_promoted.value) == 1
+            for r in fleet.replicas:        # both serve the candidate
+                swapped = r.engine.snapshot_params()
+                for k in cand:
+                    np.testing.assert_array_equal(
+                        swapped[k], np.asarray(cand[k],
+                                               swapped[k].dtype))
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# the loop: resume determinism, e2e drill, control plane
+# ---------------------------------------------------------------------------
+
+
+def make_loop(root, rounds_seen_model=None, **kw):
+    model = rounds_seen_model or make_model()
+    fleet = make_fleet(model, replicas=1)
+    kw.setdefault("prompts", [[1, 2, 3], [4, 5], [6, 7, 8], [9, 10]])
+    kw.setdefault("rollout_batch", 4)
+    kw.setdefault("max_new_tokens", 4)
+    kw.setdefault("base_seed", 42)
+    kw.setdefault("checkpoint_every_windows", 1)
+    loop = rl.FeedbackLoop(model, SGDOptimizer(learning_rate=0.5),
+                           fleet, rl.TokenAffinityReward(target_ids=[7]),
+                           checkpoint_root=root, **kw)
+    return loop, fleet
+
+
+class TestFeedbackLoop:
+    def test_resume_matches_uninterrupted_control(self, tmp_path):
+        """The fixed-seed determinism drill: run 6 rounds straight;
+        run 3 rounds, then restore into a COMPLETELY fresh
+        model/fleet/loop and run 3 more — parameters, rewards and
+        round counters must match the control exactly."""
+        control, fleet_a = make_loop(str(tmp_path / "a"))
+        try:
+            control.run(rounds=6)
+        finally:
+            fleet_a.stop()
+
+        first, fleet_b = make_loop(str(tmp_path / "b"))
+        try:
+            first.run(rounds=3)
+        finally:
+            fleet_b.stop()
+
+        resumed, fleet_c = make_loop(str(tmp_path / "b"))
+        try:
+            meta = resumed.restore()
+            assert meta is not None and resumed.round == 3
+            assert resumed.baseline.value == pytest.approx(
+                first.baseline.value)
+            resumed.run(rounds=3)
+        finally:
+            fleet_c.stop()
+
+        assert resumed.round == control.round == 6
+        assert resumed.reward_history == control.reward_history[3:]
+        pc, pr = (control.session.host_params(),
+                  resumed.session.host_params())
+        assert set(pc) == set(pr)
+        for k in pc:
+            np.testing.assert_array_equal(pc[k], pr[k], err_msg=k)
+
+    def test_e2e_drill_reward_improves_and_policy_ships(self, tmp_path):
+        """The acceptance drill: measured reward improves over the run
+        while updated policies ship verify -> canary -> promote with
+        zero failed requests and measured freshness."""
+        model = make_model()
+        fleet = make_fleet(model, replicas=2)
+        loop = rl.FeedbackLoop(
+            model, AdamOptimizer(learning_rate=0.05), fleet,
+            rl.TokenAffinityReward(target_ids=[7]),
+            prompts=[[1, 2, 3], [4, 5], [6, 7, 8], [9, 10]],
+            rollout_batch=8, max_new_tokens=6,
+            checkpoint_root=str(tmp_path / "ckpt"),
+            push_every_windows=2)
+        try:
+            report = loop.run(rounds=10)
+        finally:
+            fleet.stop()
+
+        rewards = [r for _rnd, r in loop.reward_history]
+        assert len(rewards) == 10
+        assert np.mean(rewards[-3:]) > np.mean(rewards[:3]) + 0.1, rewards
+
+        led = loop.rollout_engine.stats()
+        assert led["submitted"] == report.events == 80
+        assert led["failed"] == 0                  # zero failed requests
+        assert len(report.pushes) == 5
+        for p in report.pushes:
+            assert p["freshness_oldest_s"] is not None
+            assert p["live_at"] <= time.time()
+            assert len(p["replicas"]) == 2
+        assert report.freshness_s is not None      # the headline number
+        assert int(loop.publisher._m_promoted.value) == 5
+        assert int(loop.publisher._m_rolled_back.value) == 0
+        assert [k for _no, k in report.checkpoints].count("full") >= 2
+
+    def test_control_plane_and_ctl_rc_contract(self):
+        """`serve_rl_http` + `tools/rl_ctl.py`: status/stats/start/stop
+        with the rc contract (0 ok, 1 on 409 start-while-running)."""
+        model = make_model()
+        fleet = make_fleet(model, replicas=1)
+        loop = rl.FeedbackLoop(
+            model, SGDOptimizer(learning_rate=0.5), fleet,
+            rl.TokenAffinityReward(target_ids=[7]),
+            prompts=[[1, 2, 3]], rollout_batch=2, max_new_tokens=2)
+        httpd = rl.serve_rl_http(loop, port=0, block=False)
+        port = httpd.server_address[1]
+
+        def ctl(*args):
+            return subprocess.run(
+                [sys.executable, os.path.join(REPO, "tools", "rl_ctl.py"),
+                 "--endpoint", "http://127.0.0.1:%d" % port, "--json",
+                 *args],
+                capture_output=True, text=True, timeout=120)
+
+        try:
+            p = ctl("status")
+            assert p.returncode == 0
+            st = json.loads(p.stdout)
+            assert st["healthy"] and st["ready"] and not st["running"]
+
+            assert ctl("start", "--rounds", "2").returncode == 0
+            p = ctl("start", "--rounds", "1")      # refused: 409 -> rc 1
+            assert p.returncode == 1
+            assert json.loads(p.stdout)["http"] == 409
+
+            for _ in range(240):
+                s = json.loads(ctl("stats").stdout)
+                if not s["running"]:
+                    break
+                time.sleep(0.25)
+            assert s["round"] == 2 and s["error"] is None, s
+            assert ctl("stop").returncode == 0
+        finally:
+            httpd.shutdown()
+            fleet.stop()
+
+
+def test_rl_loop_bench_skip_convention():
+    """The bench honors BENCH_FORCE_BACKEND_FAIL with the
+    {"skipped": true} rc=0 convention."""
+    env = dict(os.environ, BENCH_FORCE_BACKEND_FAIL="init",
+               JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "rl_loop_bench.py")],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert p.returncode == 0, p.stderr
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["skipped"] is True
+    assert "injected by BENCH_FORCE_BACKEND_FAIL" in out["reason"]
+
+
+def test_rl_is_lazy_and_in_api_spec():
+    """`paddle_tpu.rl` loads via PEP 562 — a fresh interpreter that
+    imports paddle_tpu does NOT pay for the rl/generation stack."""
+    p = subprocess.run(
+        [sys.executable, "-c",
+         "import sys, paddle_tpu; "
+         "assert 'paddle_tpu.rl' not in sys.modules; "
+         "assert 'paddle_tpu.generation' not in sys.modules; "
+         "m = paddle_tpu.rl; "
+         "assert 'paddle_tpu.rl' in sys.modules and "
+         "hasattr(m, 'FeedbackLoop')"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO)
+    assert p.returncode == 0, p.stderr

@@ -4,6 +4,7 @@ test_sample_logits_op.py, test_rank_attention_op.py,
 test_tree_conv_op.py, test_var_conv_2d.py, test_pyramid_hash_op.py)."""
 
 import numpy as np
+import pytest
 
 from op_test import check_grad, run_single_op
 
@@ -129,6 +130,7 @@ def _np_rank_attention(x, ro, param, max_rank):
     return out
 
 
+@pytest.mark.slow
 def test_rank_attention_matches_oracle():
     max_rank, d, p = 3, 4, 5
     # 2 pvs: ranks [2, 1] and [1, 3, 2] -> 5 instances
@@ -198,6 +200,7 @@ def _np_tree_conv(nodes, edges, w, max_depth):
     return out
 
 
+@pytest.mark.slow
 def test_tree_conv_matches_oracle():
     n, f, o, c, depth, b = 9, 3, 2, 2, 2, 2
     adj = np.array([1, 2, 1, 3, 1, 4, 2, 5, 2, 6, 4, 7, 7, 8, 7, 9],
@@ -251,6 +254,7 @@ def _np_var_conv_2d(x, rows, cols, w, kh, kw, sh, sw):
     return out
 
 
+@pytest.mark.slow
 def test_var_conv_2d_matches_oracle():
     b, c, hm, wm, o = 2, 3, 5, 6, 4
     kh, kw, sh, sw = 2, 3, 1, 2
@@ -273,6 +277,7 @@ def test_var_conv_2d_matches_oracle():
                ["Out"], ["X", "W"], rtol=2e-2, atol=1e-2)
 
 
+@pytest.mark.slow
 def test_pyramid_hash_shapes_determinism_and_masking():
     b, t, space, rand_len, num_emb = 2, 6, 256, 4, 8
     toks = rng.randint(0, 1000, (b, t)).astype(np.int32)

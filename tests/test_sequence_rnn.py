@@ -232,6 +232,7 @@ class TestRNNOps:
         np.testing.assert_allclose(outs["LastH"], lh, rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(outs["LastC"], lc, rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_lstm_grad(self, rng):
         B, T, D = 2, 3, 2
         x4 = rng.randn(B, T, 4 * D).astype(np.float64) * 0.5
@@ -274,6 +275,7 @@ class TestRNNOps:
         np.testing.assert_allclose(outs["Hidden"], hs, rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(outs["LastH"], h, rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_gru_grad(self, rng):
         x3 = rng.randn(2, 3, 6).astype(np.float64) * 0.5
         W = rng.randn(2, 6).astype(np.float64) * 0.3

@@ -251,6 +251,7 @@ def test_conv3d_transpose():
                  {"Output": ref}, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_max_pool2d_with_index_and_unpool():
     x = _rand(2, 3, 4, 4)
     outs, _ = run_single_op(
@@ -307,6 +308,7 @@ def test_max_pool3d_with_index():
         ref.reshape(1, 2, 8), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_crop_and_space_to_depth():
     x = _rand(2, 3, 6, 6)
     outs, _ = run_single_op(
@@ -351,6 +353,7 @@ def test_deformable_conv_zero_offset_matches_conv2d():
                                atol=1e-4)
 
 
+@pytest.mark.slow
 def test_deformable_conv_offset_shifts():
     """An integer offset of (0, 1) everywhere equals convolving the
     x-shifted image (interior pixels)."""

@@ -305,6 +305,7 @@ def test_dead_op_elimination_keeps_fetches():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_zoo_resnet_search_winner_not_worse_and_cached(tmp_path):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):

@@ -183,6 +183,7 @@ def _assert_state_close(a, b, rtol=2e-3, atol=1e-5, msg=""):
             rtol=rtol, atol=atol, err_msg="%s param %s" % (msg, n))
 
 
+@pytest.mark.slow
 def test_zero23_match_gspmd_oracle_and_hlo_has_reduce_scatter():
     mesh = dist.auto_mesh(8)
     cfg = _bert_cfg()
@@ -229,6 +230,7 @@ def test_comm_estimate_matches_hlo_collective_bytes():
         % (rel * 100, est["wire_bytes_total"], stats["wire_bytes_total"]))
 
 
+@pytest.mark.slow
 def test_accumulate_matches_large_batch_and_syncs_once():
     """accumulate_steps=4 == the k=1 large-batch step up to f32
     summation order (tolerance: the scan sums k microbatch means in a

@@ -59,6 +59,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.streaming",
     "paddle_tpu.tune",
     "paddle_tpu.generation",
+    "paddle_tpu.rl",
 ]
 
 

@@ -1,0 +1,140 @@
+"""Operator CLI for a `paddle_tpu.rl.FeedbackLoop` behind its control
+plane (`rl.serve_rl_http`)::
+
+    python tools/rl_ctl.py --endpoint http://host:8093 COMMAND
+
+    status                   # healthz + readyz + running flag, one line
+    stats                    # loop stats(): round, reward history tail,
+                             # baseline, rollout ledger, push records
+    start [--rounds N]       # kick off a run (rc 1 + message if one is
+                             # already active: the plane answers 409)
+    stop                     # request a graceful stop (finishes the
+                             # in-flight round, then drains)
+
+Exit code 0 on success; 1 when the plane refuses (409 start-while-
+running), the loop is unreachable, or it reports not-ready.  ``--json``
+prints machine-readable envelopes for scripting — ``status --json``
+emits ``{"healthy":..., "ready":..., "running":..., "error":...}`` so a
+promotion pipeline can gate on a single call.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import sys
+import urllib.parse
+
+
+def _request(endpoint, method, path, body=None, timeout=30.0):
+    u = urllib.parse.urlparse(endpoint)
+    conn = http.client.HTTPConnection(u.hostname, u.port or 80,
+                                      timeout=timeout)
+    try:
+        payload = None if body is None else json.dumps(body)
+        headers = {"Content-Type": "application/json"} if payload else {}
+        conn.request(method, path, payload, headers)
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            return resp.status, json.loads(raw)
+        except ValueError:
+            return resp.status, {"raw": raw.decode("utf-8", "replace")}
+    finally:
+        conn.close()
+
+
+def cmd_status(args):
+    h_code, _ = _request(args.endpoint, "GET", "/healthz",
+                         timeout=args.timeout)
+    r_code, r_body = _request(args.endpoint, "GET", "/readyz",
+                              timeout=args.timeout)
+    s_code, s_body = _request(args.endpoint, "GET", "/stats",
+                              timeout=args.timeout)
+    out = {
+        "healthy": h_code == 200,
+        "ready": r_code == 200,
+        "running": bool(s_body.get("running")) if s_code == 200 else None,
+        "round": s_body.get("round"),
+        "pushes": s_body.get("pushes"),
+        "error": s_body.get("error") or r_body.get("reason"),
+    }
+    ok = out["healthy"] and out["ready"] and not out["error"]
+    if args.json:
+        print(json.dumps(out))
+    else:
+        print("rl loop: %s, %s, %s (round %s, %s pushes)%s" % (
+            "healthy" if out["healthy"] else "UNHEALTHY",
+            "ready" if out["ready"] else "NOT READY",
+            "running" if out["running"] else "idle",
+            out["round"], out["pushes"],
+            " — error: %s" % out["error"] if out["error"] else ""))
+    return 0 if ok else 1
+
+
+def cmd_stats(args):
+    code, payload = _request(args.endpoint, "GET", "/stats",
+                             timeout=args.timeout)
+    print(json.dumps(payload) if args.json
+          else "stats (HTTP %s): %s" % (code, json.dumps(payload)))
+    return 0 if code == 200 else 1
+
+
+def cmd_start(args):
+    body = {}
+    if args.rounds is not None:
+        body["rounds"] = args.rounds
+    code, payload = _request(args.endpoint, "POST", "/start", body,
+                             timeout=args.timeout)
+    if args.json:
+        payload = dict(payload)
+        payload["http"] = code
+        print(json.dumps(payload))
+    elif code == 200:
+        print("started (rounds=%s)" % payload.get("rounds"))
+    elif code == 409:
+        print("refused: %s" % payload.get("error"), file=sys.stderr)
+    else:
+        print("HTTP %d: %s" % (code, json.dumps(payload)),
+              file=sys.stderr)
+    return 0 if code == 200 else 1
+
+
+def cmd_stop(args):
+    code, payload = _request(args.endpoint, "POST", "/stop",
+                             timeout=args.timeout)
+    if args.json:
+        payload = dict(payload)
+        payload["http"] = code
+        print(json.dumps(payload))
+    else:
+        print("stop requested (was %s)" %
+              ("running" if payload.get("stopping") else "idle"))
+    return 0 if code == 200 else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--endpoint", default="http://127.0.0.1:8093")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status")
+    sub.add_parser("stats")
+    st = sub.add_parser("start")
+    st.add_argument("--rounds", type=int, default=None)
+    sub.add_parser("stop")
+    args = ap.parse_args(argv)
+    try:
+        return {"status": cmd_status, "stats": cmd_stats,
+                "start": cmd_start, "stop": cmd_stop}[args.cmd](args)
+    except Exception as e:
+        msg = {"error": "%s: %s" % (type(e).__name__, e)}
+        print(json.dumps(msg) if args.json else msg["error"],
+              file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
