@@ -412,10 +412,13 @@ def _run_recsys():
 
 def _run_generate():
     """--generate: the autoregressive-decoding capture — tokens/s,
-    TTFT, ITL, and the KV-cache-vs-recompute-prefix A/B, via
-    benchmarks/generation_bench (one JSON line with the same
-    skip/platform/smoke_config conventions as the headline bench;
-    remaining flags pass through, e.g. --autotune / --slots N)."""
+    TTFT, ITL, the KV-cache-vs-recompute-prefix A/B, and the
+    paged-vs-dense KV A/B (block-pool bytes/occupancy, prefix-cache
+    hit rate, speculative acceptance), via benchmarks/generation_bench
+    (one JSON line with the same skip/platform/smoke_config
+    conventions as the headline bench; remaining flags pass through,
+    e.g. --autotune / --slots N / --block-size 16 / --prefix-cache /
+    --kv-dtype int8 / --draft-len 3 / --dense)."""
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
     import generation_bench

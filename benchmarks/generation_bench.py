@@ -1,5 +1,5 @@
-"""Autoregressive decoding benchmark: tokens/s, TTFT, ITL, and the
-KV-cache-vs-recompute-prefix A/B.
+"""Autoregressive decoding benchmark: tokens/s, TTFT, ITL, the
+KV-cache-vs-recompute-prefix A/B, and the PAGED-vs-dense KV A/B.
 
 Workload: a `models.TransformerLM` served by
 `generation.GenerationEngine` under a batch of concurrent requests
@@ -13,17 +13,29 @@ prefill bucket ladder).  Measurements over identical prompts/seeds:
   causal forward over the whole sequence per token, no cache) vs the
   engine's attention-over-cache decode step.  Token streams are
   checked identical before the ratio is reported;
+* **paged vs dense** — the measured engine is paged (block pool
+  auto-provisioned to the workload's MEAN sequence length unless
+  ``--kv-blocks`` pins it); a dense PR-15 engine decodes the same
+  requests, streams are checked identical, and the report carries
+  ``paged_kv_bytes`` / ``dense_kv_bytes`` / ``kv_bytes_ratio`` plus
+  block-pool occupancy (mean and peak blocks used);
+* **prefix / speculative** — ``--prefix-cache`` reports hit rate and
+  tokens served from cache; ``--draft-len k`` reports the speculative
+  acceptance rate.  ``--kv-dtype int8`` opts the pool into quantized
+  storage (documented-tolerance: the paged-vs-dense token check is
+  skipped, streams may lawfully differ);
 * **occupancy** — mean slot occupancy, the admission signal.
 
 CPU-host caveat: with JAX_PLATFORMS=cpu this is the smoke config (tiny
 model, short generations) — the numbers calibrate the harness, not the
-hardware; the TPU capture slot is reserved in PERF.md round 13.
+hardware; the TPU capture slot is reserved in PERF.md round 15.
 
 Prints ONE JSON line: {"metric": "tokens_per_s", "value": ...,
 "ttft_ms_p50": ..., "itl_ms_p50": ..., "cache_vs_recompute": ...,
-"platform": ..., "smoke_config": ...}.  On any backend failure prints
-{"skipped": true, ...} with rc 0 (bench.py convention).
-``--autotune`` adds a `tune.search_generation_config` slot search.
+"paged": {...}, "platform": ..., "smoke_config": ...}.  On any backend
+failure prints {"skipped": true, ...} with rc 0 (bench.py convention).
+``--autotune`` adds a `tune.search_generation_config` search over
+slots x block_size.
 """
 
 import argparse
@@ -115,17 +127,19 @@ def recompute_prefix_generate(model, cfg, request):
     return out
 
 
-def run_engine(model, reqs, slots, max_len, buckets, engine=None):
+def run_engine(model, reqs, slots, max_len, buckets, engine=None,
+               engine_kwargs=None):
     from paddle_tpu import generation as gen
 
     if engine is None:
         engine = gen.GenerationEngine(model, slots=slots,
                                       max_len=max_len,
                                       prefill_buckets=buckets,
-                                      max_queue=4096)
+                                      max_queue=4096,
+                                      **(engine_kwargs or {}))
     t0 = time.perf_counter()
     handles = [engine.submit(r) for r in reqs]
-    occ, step_ms = [], []
+    occ, step_ms, pool_used = [], [], []
     while True:
         before = engine.occupancy()
         steps_before = engine._decode_steps
@@ -138,6 +152,8 @@ def run_engine(model, reqs, slots, max_len, buckets, engine=None):
         if engine._decode_steps > steps_before and not prefilled:
             step_ms.append((time.perf_counter() - ts) * 1e3)
         occ.append(engine.occupancy()["active"] / max(slots, 1))
+        if engine.paged:
+            pool_used.append(engine.cache.pool.used_blocks)
         if not progressed:
             break
     wall = time.perf_counter() - t0
@@ -145,7 +161,7 @@ def run_engine(model, reqs, slots, max_len, buckets, engine=None):
     n_tokens = sum(len(r) for r in results)
     ttft = [(h.t_first_token - h.t_submit) * 1e3 for h in handles
             if h.t_first_token is not None]
-    return engine, results, {
+    m = {
         "wall_s": wall,
         "tokens": n_tokens,
         "tokens_per_s": n_tokens / wall if wall > 0 else 0.0,
@@ -153,6 +169,10 @@ def run_engine(model, reqs, slots, max_len, buckets, engine=None):
         "itl_ms_p50": _pct(step_ms, 50), "itl_ms_p99": _pct(step_ms, 99),
         "occupancy_mean": float(np.mean(occ)) if occ else 0.0,
     }
+    if pool_used:
+        m["pool_blocks_mean"] = float(np.mean(pool_used))
+        m["pool_blocks_peak"] = int(max(pool_used))
+    return engine, results, m
 
 
 def main(argv=None):
@@ -164,6 +184,19 @@ def main(argv=None):
     ap.add_argument("--autotune", action="store_true")
     ap.add_argument("--skip-ab", action="store_true",
                     help="skip the recompute-prefix A/B (slow)")
+    ap.add_argument("--dense", action="store_true",
+                    help="measure the dense PR-15 engine instead of paged")
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--kv-blocks", type=int, default=None,
+                    help="pin the pool size; default provisions for the "
+                         "workload MEAN sequence length (the paged win)")
+    ap.add_argument("--prefix-cache", action="store_true")
+    ap.add_argument("--prefill-chunk", type=int, default=None)
+    ap.add_argument("--kv-dtype", choices=["int8"], default=None)
+    ap.add_argument("--draft-len", type=int, default=0,
+                    help="speculative decoding with a tiny draft LM")
+    ap.add_argument("--skip-paged-ab", action="store_true",
+                    help="skip the paged-vs-dense A/B")
     args = ap.parse_args(argv)
 
     try:
@@ -183,6 +216,43 @@ def main(argv=None):
     buckets = [8, 16]
     reqs = make_requests(cfg, args.requests, args.max_new)
 
+    mean_seq = (float(np.mean([len(r.prompt_ids) for r in reqs]))
+                + args.max_new)
+    engine_kwargs = {}
+    if args.dense:
+        engine_kwargs["paged"] = False
+    else:
+        bs = args.block_size
+        kv_blocks = args.kv_blocks
+        if kv_blocks is None:
+            # provision for the MEAN sequence, not the worst case: the
+            # capacity win the dense [slots, max_len] layout cannot
+            # express (preemption absorbs the tail)
+            kv_blocks = args.slots * (-(-int(mean_seq) // bs) + 1) + 1
+        engine_kwargs.update(block_size=bs, kv_blocks=kv_blocks)
+        if args.prefix_cache:
+            engine_kwargs["prefix_cache"] = True
+        if args.prefill_chunk:
+            engine_kwargs["prefill_chunk"] = args.prefill_chunk
+        if args.kv_dtype:
+            engine_kwargs["kv_dtype"] = args.kv_dtype
+        if args.draft_len > 0:
+            from paddle_tpu import models
+            from paddle_tpu.fluid import dygraph
+
+            dcfg = (models.TransformerLMConfig.tiny() if smoke else
+                    models.TransformerLMConfig(
+                        vocab_size=cfg.vocab_size, hidden_size=256,
+                        num_layers=2, num_heads=4,
+                        intermediate_size=1024,
+                        max_position_embeddings=cfg.max_position_embeddings,
+                        dropout=0.0))
+            with dygraph.guard():
+                np.random.seed(23)
+                draft = models.TransformerLM(dcfg)
+            engine_kwargs.update(draft_model=draft,
+                                 draft_len=args.draft_len)
+
     from paddle_tpu.observability import install_jax_compile_hooks
     from paddle_tpu.observability.metrics import default_registry
 
@@ -198,7 +268,7 @@ def main(argv=None):
                                   max_new_tokens=2)
             for b in buckets]
     engine, _, _ = run_engine(model, warm, args.slots, args.max_len,
-                              buckets)
+                              buckets, engine_kwargs=engine_kwargs)
     c0 = reg.counter("xla_compilations_total",
                      "XLA backend compilations (jax.monitoring)").value
     engine, results, m = run_engine(model, reqs, args.slots,
@@ -227,6 +297,36 @@ def main(argv=None):
         "platform": jax.default_backend(),
         "smoke_config": smoke,
     }
+
+    if engine.paged:
+        st = engine.stats()
+        realized = [len(r.prompt_ids) + len(res)
+                    for r, res in zip(reqs, results)]
+        mean_real = float(np.mean(realized)) if realized else 0.0
+        bs = engine.block_size
+        mean_rows = max(-(-int(round(mean_real)) // bs) * bs, bs)
+        paged_info = {
+            "block_size": bs,
+            "kv_blocks": engine.cache.num_blocks,
+            "capacity_tokens": engine.cache.capacity_tokens,
+            "kv_bytes": engine.cache.nbytes,
+            "kv_dtype": args.kv_dtype or "float32",
+            "pool_blocks_mean": round(m.get("pool_blocks_mean", 0.0), 2),
+            "pool_blocks_peak": m.get("pool_blocks_peak", 0),
+            "mean_seq_len": round(mean_real, 2),
+            # sequences-per-HBM-byte vs the dense [slots, max_len]
+            # layout: dense reserves max_len rows/seq, paged reserves
+            # ceil(mean/bs)*bs — the effective-capacity multiplier
+            "effective_capacity_x": round(args.max_len / mean_rows, 2),
+            "preempted": st["preempted"],
+        }
+        if "prefix_cache" in st:
+            paged_info["prefix_cache"] = st["prefix_cache"]
+        if "speculative" in st:
+            paged_info["speculative"] = st["speculative"]
+        out["paged"] = paged_info
+    else:
+        out["paged"] = False
 
     if not args.skip_ab:
         # recompute-prefix A/B over a subset (it is O(len) per token)
@@ -259,19 +359,57 @@ def main(argv=None):
             m2["tokens_per_s"] * t_recompute / ab_tokens, 2) \
             if ab_tokens else 0.0
 
+    if engine.paged and not args.skip_paged_ab:
+        # dense PR-15 engine over the SAME prompts/seeds: token streams
+        # must match (int8 excepted — documented tolerance), and the
+        # HBM-bytes ratio is the headline paged win
+        dense_eng, _, _ = run_engine(
+            model, [gen.GenerationRequest(list(range(1, b + 1)),
+                                          max_new_tokens=2)
+                    for b in buckets],
+            args.slots, args.max_len, buckets,
+            engine_kwargs={"paged": False})
+        dense_eng, dense_results, md = run_engine(
+            model, make_requests(cfg, args.requests, args.max_new),
+            args.slots, args.max_len, buckets, engine=dense_eng)
+        if args.kv_dtype is None and args.draft_len == 0:
+            for i, (p, d) in enumerate(zip(results, dense_results)):
+                if p != d:
+                    print(json.dumps({
+                        "error": "paged/dense token mismatch on "
+                                 "request %d" % i,
+                        "paged": p, "dense": d}))
+                    return 1
+            out["paged"]["token_exact_vs_dense"] = True
+        out["paged"]["dense_kv_bytes"] = dense_eng.cache.nbytes
+        out["paged"]["kv_bytes_ratio"] = round(
+            dense_eng.cache.nbytes / max(engine.cache.nbytes, 1), 2)
+        out["paged"]["dense_tokens_per_s"] = round(md["tokens_per_s"], 2)
+        out["paged"]["paged_vs_dense_tps"] = round(
+            m["tokens_per_s"] / max(md["tokens_per_s"], 1e-9), 2)
+
     if args.autotune:
         from paddle_tpu import tune
 
         def build_and_time(params):
+            kw = {"paged": False} if args.dense else {
+                "block_size": params.get("block_size") or args.block_size}
+            if not args.dense:
+                cbs = kw["block_size"]
+                kw["kv_blocks"] = (params["slots"]
+                                   * (-(-int(mean_seq) // cbs) + 1) + 1)
             eng, _, mm = run_engine(
                 model, make_requests(cfg, args.requests, args.max_new),
-                params["slots"], args.max_len, buckets)
+                params["slots"], args.max_len, buckets,
+                engine_kwargs=kw)
             return mm["wall_s"] / max(mm["tokens"], 1)
 
         report = tune.search_generation_config(
             build_and_time, workload="generation_bench:%dx%d"
             % (args.requests, args.max_new),
-            slot_counts=(args.slots, 1, 2, 8))
+            slot_counts=(args.slots, 1, 2, 8),
+            block_sizes=None if args.dense
+            else (args.block_size, 32))
         out["autotune"] = {
             "winner": report.winner.candidate.label
             if report.winner else None,

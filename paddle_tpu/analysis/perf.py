@@ -1027,10 +1027,12 @@ class DecodeStepCost:
 
     __slots__ = ("slots", "cache_len", "flops", "kv_read_bytes",
                  "param_read_bytes", "bytes", "time_s", "bound",
-                 "tokens_per_s", "chip")
+                 "tokens_per_s", "chip", "paged", "block_size",
+                 "kv_dtype_bytes")
 
     def __init__(self, slots, cache_len, flops, kv_read_bytes,
-                 param_read_bytes, chip):
+                 param_read_bytes, chip, paged=False, block_size=None,
+                 kv_dtype_bytes=None):
         self.slots = int(slots)
         self.cache_len = int(cache_len)
         self.flops = float(flops)
@@ -1038,6 +1040,9 @@ class DecodeStepCost:
         self.param_read_bytes = float(param_read_bytes)
         self.bytes = self.kv_read_bytes + self.param_read_bytes
         self.chip = chip
+        self.paged = bool(paged)
+        self.block_size = block_size
+        self.kv_dtype_bytes = kv_dtype_bytes
         t_compute = self.flops / chip.peak_flops
         t_memory = self.bytes / chip.hbm_bw
         self.time_s = max(t_compute, t_memory)
@@ -1054,27 +1059,54 @@ class DecodeStepCost:
             "param_read_bytes": self.param_read_bytes,
             "bytes": self.bytes, "time_s": self.time_s,
             "bound": self.bound, "tokens_per_s": self.tokens_per_s,
+            "paged": self.paged, "block_size": self.block_size,
+            "kv_dtype_bytes": self.kv_dtype_bytes,
             "chip": self.chip.to_dict(),
         }
 
 
 def decode_step_cost(*, num_layers, hidden_size, num_heads, vocab_size,
                      intermediate_size=None, slots=8, cache_len=512,
-                     dtype_bytes=4, chip=None):
+                     dtype_bytes=4, chip=None, paged=False,
+                     mean_len=None, block_size=16, kv_dtype_bytes=None):
     """Static decode-step estimate (see `DecodeStepCost`).
 
     FLOPs per slot: the standard 2*N_params matmul work (QKV/out
     projections, FFN, tied LM head) + 4*cache_len*hidden attention
     work.  HBM bytes: every parameter once per STEP (amortized over
-    slots) + each slot's K and V cache rows once."""
+    slots) + each slot's K and V cache rows once.
+
+    Dense (default) charges every slot ``cache_len`` rows — the
+    provisioned worst case.  ``paged=True`` charges
+    ``ceil(mean_len / block_size) * block_size`` rows per slot (the
+    block-granular read the table-driven kernel actually streams;
+    ``mean_len`` defaults to ``cache_len``), priced at
+    ``kv_dtype_bytes`` per element (default ``dtype_bytes``; pass 1
+    for int8 KV — the per-row per-head f32 scales are charged on
+    top).  The paged-vs-dense ratio is the HBM argument ROADMAP item 1
+    banks, and `tests/test_perf_gate.py` budgets it."""
     if intermediate_size is None:
         intermediate_size = 4 * hidden_size
     h, L = float(hidden_size), int(num_layers)
     per_layer_params = 4 * h * h + 2 * h * intermediate_size
     params = L * per_layer_params + vocab_size * h
-    attn_flops = 4.0 * cache_len * h            # QK^T + PV per slot/layer
+    if paged:
+        if mean_len is None:
+            mean_len = cache_len
+        rows = -(-int(mean_len) // int(block_size)) * int(block_size)
+        kvb = dtype_bytes if kv_dtype_bytes is None else kv_dtype_bytes
+        kv_read = 2.0 * L * slots * rows * h * kvb
+        if kvb < dtype_bytes:
+            # int8 rows carry f32 per-head scales the kernel also reads
+            kv_read += 2.0 * L * slots * rows * num_heads * 4
+    else:
+        rows = cache_len
+        kvb = dtype_bytes
+        kv_read = 2.0 * L * slots * cache_len * h * dtype_bytes
+    attn_flops = 4.0 * rows * h                 # QK^T + PV per slot/layer
     flops = slots * (2.0 * params + L * attn_flops)
-    kv_read = 2.0 * L * slots * cache_len * h * dtype_bytes
     param_read = params * dtype_bytes
     return DecodeStepCost(slots, cache_len, flops, kv_read, param_read,
-                          chip or ChipSpec.detect())
+                          chip or ChipSpec.detect(), paged=paged,
+                          block_size=int(block_size) if paged else None,
+                          kv_dtype_bytes=kvb if paged else None)

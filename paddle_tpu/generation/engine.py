@@ -1,34 +1,64 @@
 """`GenerationEngine`: slot-based continuous-batching autoregressive
-decoding (Orca-style iteration-level scheduling over a fixed-shape KV
-cache).
+decoding (Orca-style iteration-level scheduling) over a PAGED KV cache.
 
-The execution model, and why it compiles exactly twice per shape:
+The execution model, and why the executable set stays enumerable:
 
 * **prefill** — a new request claims a free cache slot, its prompt is
   padded to a bucket from the prefill ladder (PR-2 discipline: a
   bounded executable set, one per bucket length), and ONE jitted
   ``prefill`` call runs the full causal forward on the flash-attention
-  path, writes every layer's K/V into the slot's cache rows, and
-  samples the first token from the last real position's logits.  The
-  first token is emitted immediately — that is the TTFT path.
+  path, scatters every layer's K/V through the slot's BLOCK TABLE into
+  the pool, and samples the first token from the last real position's
+  logits.  The first token is emitted immediately — the TTFT path.
 * **decode** — every scheduler iteration runs ONE jitted step over ALL
-  slots: one token per slot in, attention over the cache
-  (`ops.pallas.decode_attention`), one sampled token per slot out.
-  Cache arrays are donated, shapes never change, so the step compiles
-  once per (slot-count, max_len) engine config and is reused for every
-  token of every request — `_decode_cache_size()` and the PR-4 compile
-  accumulator both pin this.
-* **continuous batching** — requests finish (stop token / max tokens /
-  cache full) at different steps; their slots are freed mid-flight and
-  the next queued request prefills into the freed slot while the other
-  slots keep decoding.  Nothing ever drains the whole batch.
+  slots: one token per slot in, attention through the block table
+  (`ops.pallas.paged_attention`), one sampled token per slot out.
+  Pool arrays are donated, the table is passed as DATA, shapes never
+  change — the step compiles once per engine config and
+  `_decode_cache_size()` plus the PR-4 compile accumulator pin it.
+* **paged KV** (the PR-17 rebuild) — the store is a block pool
+  ``[L, num_blocks, block_size, H, D]`` plus a host per-slot block
+  table (`kv_cache.PagedKVCache`).  Slots allocate blocks as they
+  grow instead of reserving ``max_len`` rows up front, so the pool is
+  provisioned to the MEAN sequence length; when it runs dry the engine
+  evicts cached prefixes, then preempts the least-progressed slot
+  (restart semantics, the fleet's requeue discipline) rather than
+  crashing.  ``paged=False`` keeps the dense PR-15 layout as the A/B
+  baseline (`benchmarks/generation_bench.py`).
+* **prefix caching** — with ``prefix_cache=True``, full prompt blocks
+  are published under a token-chain hash (`kv_cache.PrefixCache`).  A
+  new request sharing a cached prefix adopts those blocks by reference
+  and prefills only the suffix — identical system prompts skip their
+  prefill entirely.  Only FULL blocks are shared, so the writable tail
+  is private and copy-on-write never arises.
+* **chunked prefill** — ``prefill_chunk=C`` feeds long prompts through
+  C-token chunks, ONE chunk per scheduler iteration, so decode steps
+  of in-flight requests interleave with a long prefill instead of
+  stalling behind it (prefix-hit suffixes ride the same path).
+* **int8 KV** — ``kv_dtype="int8"`` stores the pool quantized with
+  per-row per-head scales, quartering decode's KV-read bytes.  Opt-in
+  under the documented-tolerance policy (`PADDLE_TPU_FLASH_ACC`
+  discipline): logits move within quantization error, so token streams
+  may differ from the f32 engine.
+* **speculative decoding** — with ``draft_model``/``draft_len=k``, a
+  small draft LM (its own dense cache) proposes k greedy tokens and
+  ONE batched verify call scores all k+1 positions; greedy slots
+  accept the longest matching prefix and emit up to k+1 tokens per
+  iteration.  Greedy acceptance is distribution-exact (the emitted
+  stream is the target model's own greedy stream); sampled slots
+  accept nothing and sample row 0 with their normal key/step, so their
+  streams stay per-request-PRNG exact.  Acceptance counters live in
+  the PR-4 metrics registry.
 
 Exactness: scheduling is invisible in the tokens.  Per-request PRNG
 streams (`sampling.py`) + row-independent slot math make the engine's
 output token-for-token identical to serving the same requests one at a
-time (`sequential_oracle`), greedy or sampled — the property
-`tests/test_generation.py` drills with slots freed and refilled
-mid-run.
+time (`sequential_oracle`) — the property `tests/test_generation.py`
+drills with slots freed, refilled, and preempted mid-run.  Standard
+traffic (no prefix hit, no chunking) prefills through the same flash
+executable as the dense engine, so paged-vs-dense streams match
+token for token; chunk/verify calls use the f32 reference attention
+and are exactness-tested empirically at fixed seeds.
 """
 
 from __future__ import annotations
@@ -45,7 +75,7 @@ import numpy as np
 from ..fluid import framework
 from ..observability import trace as _trace
 from ..observability.metrics import default_registry, unique_instance_label
-from .kv_cache import KVCache
+from .kv_cache import KVCache, PagedKVCache, PoolExhausted, PrefixCache
 from .sampling import (
     SamplingParams,
     make_base_key,
@@ -118,7 +148,8 @@ class GenerationRequest:
 class RequestHandle:
     """The caller's end of one request: a stream of ``(index, token)``
     plus terminal events.  ``restart`` events reset the index stream to
-    0 (the fleet's requeue-after-replica-death path re-runs the request
+    0 (the fleet's requeue-after-replica-death path and the paged
+    engine's preempt-on-pool-exhaustion path both re-run the request
     from scratch; a consumer discards what it saw before)."""
 
     def __init__(self, request):
@@ -221,6 +252,19 @@ class _Slot:
         self.generated = 0
 
 
+class _ChunkState:
+    """A slot mid-way through chunked prefill (not yet decoding)."""
+
+    __slots__ = ("request", "handle", "pos", "key", "t0")
+
+    def __init__(self, request, handle, pos, key, t0):
+        self.request = request
+        self.handle = handle
+        self.pos = pos                 # prompt tokens already in cache
+        self.key = key
+        self.t0 = t0
+
+
 class GenerationEngine:
     """See module docstring.
 
@@ -232,12 +276,24 @@ class GenerationEngine:
     pending queue — beyond it `submit` sheds with the slot-occupancy
     signal (`ShedError` -> HTTP 503 + Retry-After upstream).
     ``step_hook(step_no)`` runs before every decode step (the fault
-    drill's kill seam)."""
+    drill's kill seam).
+
+    Paged knobs: ``paged`` (default True) selects the block-pool cache;
+    ``block_size`` is the pool's row granularity; ``kv_blocks`` sizes
+    the pool (default: dense parity — ``slots * ceil(max_len /
+    block_size) + 1``; provision BELOW that to bank the paged HBM win
+    and let preemption absorb the tail).  ``prefix_cache`` enables
+    full-block prefix reuse; ``prefill_chunk`` chunk-prefills prompts
+    C tokens per scheduler iteration; ``kv_dtype="int8"`` quantizes
+    the pool (documented-tolerance opt-in); ``draft_model`` +
+    ``draft_len`` enable speculative decoding."""
 
     def __init__(self, model, *, slots=4, max_len=256,
                  prefill_buckets=None, max_queue=64, name="gen",
                  metrics_registry=None, step_hook=None, donate=None,
-                 logprobs=False):
+                 logprobs=False, paged=True, block_size=16,
+                 kv_blocks=None, prefix_cache=False, prefill_chunk=None,
+                 kv_dtype=None, draft_model=None, draft_len=0):
         cfg = model.cfg
         self.model = model
         self.cfg = cfg
@@ -255,11 +311,43 @@ class GenerationEngine:
             raise ValueError("prefill bucket %d exceeds max_len %d"
                              % (self.prefill_buckets[-1], self.max_len))
         self.max_queue = int(max_queue)
+        self.paged = bool(paged)
+        if not self.paged and (prefix_cache or prefill_chunk
+                               or kv_dtype is not None):
+            raise ValueError("prefix_cache / prefill_chunk / kv_dtype "
+                             "require paged=True")
+        self.prefill_chunk = int(prefill_chunk) if prefill_chunk else None
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
         self._params = {k: jnp.asarray(v.data)
                         for k, v in model.state_dict().items()}
-        self.cache = KVCache(cfg.num_layers, self.slots, self.max_len,
-                             cfg.num_heads, cfg.head_dim)
         n = self.slots
+        if self.paged:
+            self.block_size = int(block_size)
+            mbps = -(-self.max_len // self.block_size)
+            if kv_blocks is None:
+                kv_blocks = n * mbps + 1        # dense-parity capacity
+            self.cache = PagedKVCache(
+                cfg.num_layers, int(kv_blocks), self.block_size,
+                cfg.num_heads, cfg.head_dim, n, self.max_len,
+                kv_dtype=kv_dtype)
+            self._slot_blocks = [[] for _ in range(n)]
+            self._prefix = (PrefixCache(self.cache.pool, self.block_size)
+                            if prefix_cache else None)
+        else:
+            self.block_size = None
+            self.cache = KVCache(cfg.num_layers, n, self.max_len,
+                                 cfg.num_heads, cfg.head_dim)
+            self._slot_blocks = None
+            self._prefix = None
+        self._nc = len(self.cache.arrays())    # donated cache operands
+        # speculative decoding: draft proposes, one verify call scores
+        self.draft_len = int(draft_len) if draft_model is not None else 0
+        self.draft_model = draft_model if self.draft_len > 0 else None
+        if draft_model is not None and draft_len < 1:
+            raise ValueError("draft_model needs draft_len >= 1")
+        if self.draft_model is not None and not self.paged:
+            raise ValueError("speculative decoding requires paged=True")
         # host mirrors of per-slot state (device state is ONLY the cache)
         self._lengths = np.zeros(n, np.int32)
         self._last_tokens = np.zeros(n, np.int32)
@@ -270,6 +358,7 @@ class GenerationEngine:
         self._top_p = np.ones(n, np.float32)
         self._active = np.zeros(n, bool)
         self._slot_state = [None] * n          # _Slot | None
+        self._chunking = [None] * n            # _ChunkState | None
         self._free = list(range(n))
         self._pending = []                     # [(request, handle)]
         self._lock = threading.RLock()
@@ -284,13 +373,44 @@ class GenerationEngine:
         # donation only where the backend implements it (CPU warns)
         if donate is None:
             donate = jax.default_backend() in ("tpu", "gpu")
-        donate_kv = (1, 2) if donate else ()
-        self._decode_step_fn = jax.jit(self._decode_fn,
+        self._donate = bool(donate)
+        donate_kv = tuple(range(1, 1 + self._nc)) if donate else ()
+        self._donate_kv = donate_kv
+        self._decode_step_fn = jax.jit(self._make_decode_fn(),
                                        donate_argnums=donate_kv)
         self._prefill_fns = {
             b: jax.jit(self._make_prefill_fn(b), donate_argnums=donate_kv)
             for b in self.prefill_buckets
         }
+        self._chunk_fns = {}           # chunk width -> jitted fn (lazy)
+        if self.draft_model is not None:
+            dcfg = self.draft_model.cfg
+            if self.max_len > dcfg.max_position_embeddings:
+                raise ValueError("draft model max_position_embeddings %d "
+                                 "< engine max_len %d"
+                                 % (dcfg.max_position_embeddings,
+                                    self.max_len))
+            self._draft_params = {
+                k: jnp.asarray(v.data)
+                for k, v in self.draft_model.state_dict().items()}
+            self._draft_cache = KVCache(
+                dcfg.num_layers, n, self.max_len, dcfg.num_heads,
+                dcfg.head_dim)
+            ddonate = (1, 2) if donate else ()
+            self._draft_decode_fn = jax.jit(
+                self._make_draft_decode_fn(), donate_argnums=ddonate)
+            self._draft_prefill_fns = {
+                b: jax.jit(self._make_draft_prefill_fn(b),
+                           donate_argnums=ddonate)
+                for b in self.prefill_buckets
+            }
+            self._verify_fn = jax.jit(self._make_verify_fn(),
+                                      donate_argnums=donate_kv)
+        else:
+            self._draft_cache = None
+            self._verify_fn = None
+            self._draft_decode_fn = None
+            self._draft_prefill_fns = {}
 
         reg = metrics_registry or default_registry()
         self.metrics_registry = reg
@@ -320,15 +440,48 @@ class GenerationEngine:
         self._m_queue = reg.gauge(
             "generation_queue_depth", "Pending (unslotted) requests",
             labelnames=lbl).labels(self._engine)
+        self._m_preempt = reg.counter(
+            "generation_preempt_total",
+            "Slots preempted on KV pool exhaustion",
+            labelnames=lbl).labels(self._engine)
+        if self.paged:
+            self._m_blocks_used = reg.gauge(
+                "generation_kv_blocks_used", "KV pool blocks in use",
+                labelnames=lbl).labels(self._engine)
+            self._m_blocks_free = reg.gauge(
+                "generation_kv_blocks_free", "KV pool blocks free",
+                labelnames=lbl).labels(self._engine)
+        if self._prefix is not None:
+            self._m_prefix_hits = reg.counter(
+                "generation_prefix_hits_total",
+                "Prefill prefix-cache hits", labelnames=lbl).labels(
+                    self._engine)
+            self._m_prefix_misses = reg.counter(
+                "generation_prefix_misses_total",
+                "Prefill prefix-cache misses", labelnames=lbl).labels(
+                    self._engine)
+            self._m_prefix_tokens = reg.counter(
+                "generation_prefix_hit_tokens_total",
+                "Prompt tokens served from the prefix cache",
+                labelnames=lbl).labels(self._engine)
+        if self.draft_model is not None:
+            self._m_spec_proposed = reg.counter(
+                "generation_spec_proposed_total",
+                "Draft tokens proposed to greedy slots",
+                labelnames=lbl).labels(self._engine)
+            self._m_spec_accepted = reg.counter(
+                "generation_spec_accepted_total",
+                "Draft tokens accepted by the verify step",
+                labelnames=lbl).labels(self._engine)
 
     # -- traced functions --------------------------------------------------
-    def _apply_model(self, params, fn):
+    def _apply_model(self, params, fn, model=None):
         """Run ``fn(model)`` with params rebound to traced arrays under
         a fresh inference-mode tracer (ShardedTrainStep's rebinding
         idiom, dropout off)."""
         from ..fluid.dygraph.tracer import Tracer
 
-        model = self.model
+        model = model if model is not None else self.model
         old = framework._dygraph_tracer
         tracer = Tracer()
         tracer.train_mode = False
@@ -351,31 +504,104 @@ class GenerationEngine:
         finally:
             framework._dygraph_tracer = old
 
-    def _decode_fn(self, params, k_stack, v_stack, lengths, tokens, keys,
-                   steps, temp, top_k, top_p):
+    def _make_decode_fn(self):
         """ONE decode step over all slots (see module docstring)."""
         from ..fluid.dygraph import to_variable
 
-        def run(model):
-            logits, caches = model(
-                to_variable(tokens[:, None].astype(jnp.int32)),
-                to_variable(lengths[:, None].astype(jnp.int32)),
-                caches=(k_stack, v_stack), cache_positions=lengths)
-            return logits.data, caches
+        nc = self._nc
+        if not self.paged:
+            def decode(params, k_stack, v_stack, lengths, tokens, keys,
+                       steps, temp, top_k, top_p):
+                def run(model):
+                    logits, caches = model(
+                        to_variable(tokens[:, None].astype(jnp.int32)),
+                        to_variable(lengths[:, None].astype(jnp.int32)),
+                        caches=(k_stack, v_stack), cache_positions=lengths)
+                    return logits.data, caches
 
-        logits, (k2, v2) = self._apply_model(params, run)
-        nxt = sample_tokens(logits[:, 0], keys, steps, temp, top_k, top_p)
-        if self.return_logprobs:
-            return k2, v2, nxt, token_logprobs(logits[:, 0], nxt)
-        return k2, v2, nxt
+                logits, (k2, v2) = self._apply_model(params, run)
+                nxt = sample_tokens(logits[:, 0], keys, steps, temp,
+                                    top_k, top_p)
+                if self.return_logprobs:
+                    return k2, v2, nxt, token_logprobs(logits[:, 0], nxt)
+                return k2, v2, nxt
+
+            return decode
+
+        bs = self.block_size
+
+        def decode(params, *args):
+            arrays = args[:nc]
+            (lengths, tokens, keys, steps, temp, top_k, top_p,
+             tables) = args[nc:]
+
+            def run(model):
+                logits, caches = model(
+                    to_variable(tokens[:, None].astype(jnp.int32)),
+                    to_variable(lengths[:, None].astype(jnp.int32)),
+                    caches=arrays, cache_positions=lengths,
+                    block_tables=tables, block_size=bs)
+                return logits.data, caches
+
+            logits, new_arrays = self._apply_model(params, run)
+            nxt = sample_tokens(logits[:, 0], keys, steps, temp,
+                                top_k, top_p)
+            if self.return_logprobs:
+                return (*new_arrays, nxt,
+                        token_logprobs(logits[:, 0], nxt))
+            return (*new_arrays, nxt)
+
+        return decode
 
     def _make_prefill_fn(self, bucket):
         from ..fluid.dygraph import to_variable
 
-        def prefill(params, k_stack, v_stack, tokens, length, slot, key,
-                    temp, top_k, top_p):
-            """tokens [1, bucket]; length/slot scalars; writes the
-            slot's cache rows and samples generated token 0."""
+        if not self.paged:
+            def prefill(params, k_stack, v_stack, tokens, length, slot,
+                        key, temp, top_k, top_p):
+                """tokens [1, bucket]; length/slot scalars; writes the
+                slot's cache rows and samples generated token 0."""
+                def run(model):
+                    pos = jnp.arange(bucket, dtype=jnp.int32)[None]
+                    logits, kvs = model(to_variable(tokens),
+                                        to_variable(pos), use_cache=True)
+                    return logits.data, kvs
+
+                logits, kvs = self._apply_model(params, run)
+                for li, (k, v) in enumerate(kvs):
+                    idx = (li, slot, 0, 0, 0)
+                    k_stack = jax.lax.dynamic_update_slice(
+                        k_stack, k.astype(k_stack.dtype)[None], idx)
+                    v_stack = jax.lax.dynamic_update_slice(
+                        v_stack, v.astype(v_stack.dtype)[None], idx)
+                last = jax.lax.dynamic_index_in_dim(
+                    logits[0], length - 1, axis=0)      # [1, V]
+                tok0 = sample_tokens(last, key[None],
+                                     jnp.zeros((1,), jnp.int32),
+                                     temp[None], top_k[None],
+                                     top_p[None])[0]
+                if self.return_logprobs:
+                    return (k_stack, v_stack, tok0,
+                            token_logprobs(last, tok0[None])[0])
+                return k_stack, v_stack, tok0
+
+            return prefill
+
+        from ..ops.pallas.paged_attention import quantize_kv
+
+        nc = self._nc
+        bs = self.block_size
+        quant = self.cache.quantized
+
+        def prefill(params, *args):
+            """Same flash forward as the dense engine's prefill (bit-
+            identical logits), but the cache write scatters through the
+            slot's table row: position p -> pool block table[p // bs],
+            row p % bs.  Padded positions past the allocated blocks hit
+            table entry 0 — the reserved garbage block."""
+            arrays = args[:nc]
+            tokens, length, table, key, temp, top_k, top_p = args[nc:]
+
             def run(model):
                 pos = jnp.arange(bucket, dtype=jnp.int32)[None]
                 logits, kvs = model(to_variable(tokens),
@@ -383,23 +609,261 @@ class GenerationEngine:
                 return logits.data, kvs
 
             logits, kvs = self._apply_model(params, run)
+            p = jnp.arange(bucket, dtype=jnp.int32)
+            logical = jnp.clip(p // bs, 0, table.shape[1] - 1)
+            bi = table[0][logical]
+            off = p % bs
+            if quant:
+                k_pool, v_pool, k_sc, v_sc = arrays
+            else:
+                k_pool, v_pool = arrays
             for li, (k, v) in enumerate(kvs):
-                idx = (li, slot, 0, 0, 0)
-                k_stack = jax.lax.dynamic_update_slice(
-                    k_stack, k.astype(k_stack.dtype)[None], idx)
-                v_stack = jax.lax.dynamic_update_slice(
-                    v_stack, v.astype(v_stack.dtype)[None], idx)
+                k_rows = k[0]                        # [bucket, H, Dh]
+                v_rows = v[0]
+                if quant:
+                    kq, ks = quantize_kv(k_rows)
+                    vq, vs = quantize_kv(v_rows)
+                    k_pool = k_pool.at[li, bi, off].set(kq)
+                    v_pool = v_pool.at[li, bi, off].set(vq)
+                    k_sc = k_sc.at[li, bi, off].set(ks)
+                    v_sc = v_sc.at[li, bi, off].set(vs)
+                else:
+                    k_pool = k_pool.at[li, bi, off].set(
+                        k_rows.astype(k_pool.dtype))
+                    v_pool = v_pool.at[li, bi, off].set(
+                        v_rows.astype(v_pool.dtype))
             last = jax.lax.dynamic_index_in_dim(
-                logits[0], length - 1, axis=0)      # [1, V]
+                logits[0], length - 1, axis=0)          # [1, V]
             tok0 = sample_tokens(last, key[None],
                                  jnp.zeros((1,), jnp.int32),
                                  temp[None], top_k[None], top_p[None])[0]
+            out = (k_pool, v_pool, k_sc, v_sc) if quant \
+                else (k_pool, v_pool)
             if self.return_logprobs:
-                return (k_stack, v_stack, tok0,
-                        token_logprobs(last, tok0[None])[0])
-            return k_stack, v_stack, tok0
+                return (*out, tok0, token_logprobs(last, tok0[None])[0])
+            return (*out, tok0)
 
         return prefill
+
+    def _make_chunk_fn(self, width):
+        """One prefill chunk for ONE slot: ``width`` prompt tokens
+        written at ``start..start+width-1`` through the slot's table
+        row, attention with per-row causal limits (the chunked-prefill
+        math in `ops.pallas.paged_attention`).  Always samples from row
+        ``last_index`` — the host ignores the sample on non-final
+        chunks, so every chunk runs the same executable."""
+        from ..fluid.dygraph import to_variable
+
+        nc = self._nc
+        bs = self.block_size
+
+        def chunk(params, *args):
+            arrays = args[:nc]
+            (tokens, start, table, last_index, key, temp, top_k,
+             top_p) = args[nc:]
+
+            def run(model):
+                pos = start + jnp.arange(width, dtype=jnp.int32)[None]
+                logits, caches = model(
+                    to_variable(tokens), to_variable(pos),
+                    caches=arrays,
+                    cache_positions=jnp.reshape(start, (1,)),
+                    block_tables=table, block_size=bs)
+                return logits.data, caches
+
+            logits, new_arrays = self._apply_model(params, run)
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], last_index, axis=0)          # [1, V]
+            tok = sample_tokens(last, key[None],
+                                jnp.zeros((1,), jnp.int32),
+                                temp[None], top_k[None], top_p[None])[0]
+            if self.return_logprobs:
+                return (*new_arrays, tok,
+                        token_logprobs(last, tok[None])[0])
+            return (*new_arrays, tok)
+
+        return chunk
+
+    def _make_verify_fn(self):
+        """Speculative verify: feed ``[last, d_1..d_k]`` per slot at
+        positions ``L..L+k`` in ONE call; row i is sampled with the
+        slot's key at ``steps + i`` so accepted tokens consume exactly
+        the PRNG states plain decode would have."""
+        from ..fluid.dygraph import to_variable
+
+        nc = self._nc
+        bs = self.block_size
+        s_len = self.draft_len + 1
+
+        def verify(params, *args):
+            arrays = args[:nc]
+            (lengths, tok_in, keys, steps, temp, top_k, top_p,
+             tables) = args[nc:]
+
+            def run(model):
+                pos = (lengths[:, None]
+                       + jnp.arange(s_len, dtype=jnp.int32)[None])
+                logits, caches = model(
+                    to_variable(tok_in), to_variable(pos),
+                    caches=arrays, cache_positions=lengths,
+                    block_tables=tables, block_size=bs)
+                return logits.data, caches
+
+            logits, new_arrays = self._apply_model(params, run)
+            toks = jnp.stack(
+                [sample_tokens(logits[:, i], keys, steps + i, temp,
+                               top_k, top_p) for i in range(s_len)],
+                axis=1)                                 # [N, S]
+            if self.return_logprobs:
+                lps = jnp.stack(
+                    [token_logprobs(logits[:, i], toks[:, i])
+                     for i in range(s_len)], axis=1)
+                return (*new_arrays, toks, lps)
+            return (*new_arrays, toks)
+
+        return verify
+
+    def _make_draft_decode_fn(self):
+        """One greedy draft-model decode step over all slots (dense
+        draft cache, PR-15 layout)."""
+        from ..fluid.dygraph import to_variable
+
+        def ddecode(params, kd, vd, lengths, tokens):
+            def run(model):
+                logits, caches = model(
+                    to_variable(tokens[:, None].astype(jnp.int32)),
+                    to_variable(lengths[:, None].astype(jnp.int32)),
+                    caches=(kd, vd), cache_positions=lengths)
+                return logits.data, caches
+
+            logits, (k2, v2) = self._apply_model(
+                params, run, model=self.draft_model)
+            return k2, v2, jnp.argmax(logits[:, 0],
+                                      axis=-1).astype(jnp.int32)
+
+        return ddecode
+
+    def _make_draft_prefill_fn(self, bucket):
+        """Write the prompt's K/V into the draft model's dense cache
+        (no sampling — the draft only ever proposes from decode)."""
+        from ..fluid.dygraph import to_variable
+
+        def dprefill(params, kd, vd, tokens, slot):
+            def run(model):
+                pos = jnp.arange(bucket, dtype=jnp.int32)[None]
+                logits, kvs = model(to_variable(tokens),
+                                    to_variable(pos), use_cache=True)
+                return logits.data, kvs
+
+            _, kvs = self._apply_model(params, run,
+                                       model=self.draft_model)
+            for li, (k, v) in enumerate(kvs):
+                idx = (li, slot, 0, 0, 0)
+                kd = jax.lax.dynamic_update_slice(
+                    kd, k.astype(kd.dtype)[None], idx)
+                vd = jax.lax.dynamic_update_slice(
+                    vd, v.astype(vd.dtype)[None], idx)
+            return kd, vd
+
+        return dprefill
+
+    # -- block accounting (paged) -----------------------------------------
+    def _set_block_gauges(self):
+        self._m_blocks_used.set(self.cache.pool.used_blocks)
+        self._m_blocks_free.set(self.cache.pool.free_blocks)
+
+    def _ensure_blocks(self, slot, n_tokens):
+        """Grow the slot's table to cover ``n_tokens`` cache rows.
+        Falls back to prefix-cache eviction under pool pressure; False
+        when the pool is dry even then (the caller preempts/sheds)."""
+        need = self.cache.blocks_for(n_tokens) - len(self._slot_blocks[slot])
+        if need <= 0:
+            return True
+        try:
+            ids = self.cache.pool.alloc(need)
+        except PoolExhausted:
+            if self._prefix is not None:
+                self._prefix.evict(need)
+            try:
+                ids = self.cache.pool.alloc(need)
+            except PoolExhausted:
+                return False
+        base = len(self._slot_blocks[slot])
+        for j, b in enumerate(ids):
+            self.cache.assign(slot, base + j, b)
+        self._slot_blocks[slot].extend(ids)
+        self._set_block_gauges()
+        return True
+
+    def _release_blocks(self, slot):
+        """Drop the slot's reference on every block it holds (shared
+        prefix blocks stay alive under the registry's reference) and
+        point its table row back at the garbage block."""
+        ids = self._slot_blocks[slot]
+        if ids:
+            self.cache.pool.decref(ids)
+            self._slot_blocks[slot] = []
+        self.cache.clear_slot(slot)
+        self._set_block_gauges()
+
+    def _preempt_slot(self, slot, why):
+        """Pool-pressure eviction of a running request: every block
+        returns to the pool and the request restarts from the front of
+        the queue (the handle's stream resets — restart semantics,
+        same contract as the fleet's requeue path)."""
+        if self._slot_state[slot] is not None:
+            st = self._slot_state[slot]
+            self._slot_state[slot] = None
+        else:
+            cs = self._chunking[slot]
+            st = _Slot(cs.request, cs.handle)
+            self._chunking[slot] = None
+        self._active[slot] = False
+        self._release_blocks(slot)
+        self._free.append(slot)
+        st.handle._restart()
+        self._pending.insert(0, (st.request, st.handle))
+        self._m_queue.set(len(self._pending))
+        self._m_preempt.inc()
+        _trace.instant("generation.preempt", cat="generation",
+                       args={"slot": int(slot), "why": why,
+                             "request_id": st.request.request_id})
+
+    def _grow_or_preempt(self, slot, n_tokens):
+        """Grow ``slot`` to ``n_tokens`` rows, preempting the least-
+        progressed OTHER slot (deterministic: fewest generated tokens,
+        lowest id) until it fits; False when no victim is left."""
+        while not self._ensure_blocks(slot, n_tokens):
+            victims = [
+                s for s in range(self.slots)
+                if s != slot and (self._slot_state[s] is not None
+                                  or self._chunking[s] is not None)
+            ]
+            if not victims:
+                return False
+            def _progress(s):
+                st = self._slot_state[s]
+                return (st.generated if st is not None else 0, s)
+            self._preempt_slot(min(victims, key=_progress),
+                               "pool_exhausted")
+        return True
+
+    def _fail_slot(self, slot, msg):
+        st = self._slot_state[slot]
+        self._slot_state[slot] = None
+        self._active[slot] = False
+        if self.paged:
+            self._release_blocks(slot)
+        self._free.append(slot)
+        st.handle._fail(msg)
+
+    def _decode_tables(self):
+        """The table operand for batched decode/verify: rows of slots
+        that are NOT actively decoding are zeroed so their dead-row
+        writes land in the reserved garbage block — a mid-chunk slot's
+        real blocks must never take a stale-position decode write."""
+        return np.where(self._active[:, None], self.cache.block_tables,
+                        0).astype(np.int32)
 
     # -- admission / submission -------------------------------------------
     def submit(self, request, _handle=None):
@@ -421,6 +885,12 @@ class GenerationEngine:
             raise ValueError(
                 "prompt + max_new_tokens = %d exceeds max_len %d"
                 % (need, self.max_len))
+        if self.paged and \
+                self.cache.blocks_for(need) > self.cache.num_blocks - 1:
+            raise ValueError(
+                "request needs %d blocks, pool has %d usable"
+                % (self.cache.blocks_for(need),
+                   self.cache.num_blocks - 1))
         with self._lock:
             if self._dead:
                 raise EngineDeadError("engine %s is dead" % self._engine)
@@ -458,18 +928,37 @@ class GenerationEngine:
 
     # -- scheduler ---------------------------------------------------------
     def step(self):
-        """One scheduler iteration: refill free slots (prefill), then
-        one decode step over the active batch.  Returns True when any
-        work happened."""
+        """One scheduler iteration: advance every mid-flight chunked
+        prefill by ONE chunk, refill free slots (prefill), then one
+        decode step over the active batch.  Returns True when any work
+        happened."""
         with self._lock:
             if self._dead:
                 raise EngineDeadError("engine %s is dead" % self._engine)
             progressed = False
+            for slot in range(self.slots):
+                if self._chunking[slot] is not None:
+                    self._chunk_step(slot)
+                    progressed = True
             while self._free and self._pending:
                 request, handle = self._pending.pop(0)
                 slot = self._free.pop(0)
                 self._m_queue.set(len(self._pending))
-                self._prefill_into(slot, request, handle)
+                if not self._prefill_into(slot, request, handle):
+                    # pool dry at admission: requeue and wait for a
+                    # running request to free blocks — unless nothing
+                    # is running, in which case it never will
+                    self._free.insert(0, slot)
+                    if self._active.any() or any(
+                            c is not None for c in self._chunking):
+                        self._pending.insert(0, (request, handle))
+                        self._m_queue.set(len(self._pending))
+                    else:
+                        handle._fail(
+                            "kv pool exhausted: request %s needs more "
+                            "blocks than the pool can ever free"
+                            % request.request_id)
+                    break
                 progressed = True
             if self._active.any():
                 self._decode_once()
@@ -492,16 +981,68 @@ class GenerationEngine:
                 return b
         raise ValueError("prompt length %d exceeds bucket ladder" % n)
 
+    # -- prefill -----------------------------------------------------------
     def _prefill_into(self, slot, request, handle):
+        """Claim blocks and start the prompt.  Standard traffic (no
+        prefix hit, no chunking) runs the whole-prompt flash prefill —
+        the SAME executable and logits as the dense engine.  A prefix
+        hit or ``prefill_chunk`` routes through the chunked path.
+        Returns False (nothing claimed) when the pool is dry."""
+        sp = request.sampling
+        n_prompt = len(request.prompt_ids)
+        key = make_base_key(sp.seed).astype(np.uint32)
+        if not self.paged:
+            self._dense_prefill(slot, request, handle, key)
+            return True
+        n_cached, shared = (self._prefix.lookup(request.prompt_ids)
+                            if self._prefix is not None else (0, []))
+        if self._prefix is not None:
+            if n_cached:
+                self._m_prefix_hits.inc()
+                self._m_prefix_tokens.inc(n_cached)
+            else:
+                self._m_prefix_misses.inc()
+        for j, b in enumerate(shared):
+            self.cache.assign(slot, j, b)
+        self._slot_blocks[slot] = list(shared)
+        if not self._ensure_blocks(slot, n_prompt):
+            self._release_blocks(slot)
+            return False
+        if n_cached > 0 or self.prefill_chunk is not None:
+            self._chunking[slot] = _ChunkState(
+                request, handle, n_cached, key, time.perf_counter())
+            self._chunk_step(slot)
+            return True
+        # whole-prompt flash prefill through the block table
+        bucket = self._bucket_for(n_prompt)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n_prompt] = request.prompt_ids
+        table = self.cache.table_row(slot)[None].astype(np.int32)
+        t0 = time.perf_counter()
+        with _trace.span("generation.prefill", cat="generation",
+                         args={"bucket": bucket, "slot": slot,
+                               "request_id": request.request_id}):
+            with _TRACE_LOCK:
+                out = self._prefill_fns[bucket](
+                    self._params, *self.cache.arrays(), tokens,
+                    np.int32(n_prompt), table, key,
+                    np.float32(sp.temperature), np.int32(sp.top_k),
+                    np.float32(sp.top_p))
+        self.cache.update(*out[:self._nc])
+        tok0 = int(out[self._nc])
+        lp0 = float(out[self._nc + 1]) if self.return_logprobs else None
+        self._m_prefill_ms.observe((time.perf_counter() - t0) * 1e3)
+        self._activate(slot, request, handle, tok0, lp0, key)
+        return True
+
+    def _dense_prefill(self, slot, request, handle, key):
         sp = request.sampling
         n_prompt = len(request.prompt_ids)
         bucket = self._bucket_for(n_prompt)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n_prompt] = request.prompt_ids
-        key = make_base_key(sp.seed).astype(np.uint32)
         t0 = time.perf_counter()
-        with _trace.span("generation.prefill",
-                         cat="generation",
+        with _trace.span("generation.prefill", cat="generation",
                          args={"bucket": bucket, "slot": slot,
                                "request_id": request.request_id}):
             with _TRACE_LOCK:
@@ -513,8 +1054,74 @@ class GenerationEngine:
         k2, v2, tok0 = out[:3]
         lp0 = float(out[3]) if self.return_logprobs else None
         self.cache.update(k2, v2)
-        tok0 = int(tok0)
         self._m_prefill_ms.observe((time.perf_counter() - t0) * 1e3)
+        self._activate(slot, request, handle, int(tok0), lp0, key)
+
+    def _chunk_step(self, slot):
+        """Advance one chunked prefill by one chunk (one executable
+        call).  Chunk width is ``prefill_chunk`` when set, else the
+        whole remaining suffix bucketed to the prefill ladder (the
+        prefix-hit suffix path)."""
+        cs = self._chunking[slot]
+        request, handle = cs.request, cs.handle
+        sp = request.sampling
+        n_prompt = len(request.prompt_ids)
+        remaining = n_prompt - cs.pos
+        width = (self.prefill_chunk if self.prefill_chunk is not None
+                 else self._bucket_for(remaining))
+        c_real = min(width, remaining)
+        if not self._grow_or_preempt(slot, cs.pos + c_real):
+            self._chunking[slot] = None
+            self._release_blocks(slot)
+            self._free.append(slot)
+            handle._fail("kv pool exhausted mid-prefill for request %s"
+                         % request.request_id)
+            return
+        if width not in self._chunk_fns:
+            self._chunk_fns[width] = jax.jit(
+                self._make_chunk_fn(width),
+                donate_argnums=self._donate_kv)
+        tokens = np.zeros((1, width), np.int32)
+        tokens[0, :c_real] = request.prompt_ids[cs.pos:cs.pos + c_real]
+        table = self.cache.table_row(slot)[None].astype(np.int32)
+        last = cs.pos + c_real >= n_prompt
+        with _trace.span("generation.prefill_chunk", cat="generation",
+                         args={"width": width, "slot": slot, "pos": cs.pos,
+                               "request_id": request.request_id}):
+            with _TRACE_LOCK:
+                out = self._chunk_fns[width](
+                    self._params, *self.cache.arrays(), tokens,
+                    np.int32(cs.pos), table, np.int32(c_real - 1),
+                    cs.key, np.float32(sp.temperature),
+                    np.int32(sp.top_k), np.float32(sp.top_p))
+        self.cache.update(*out[:self._nc])
+        cs.pos += c_real
+        if last:
+            tok0 = int(out[self._nc])
+            lp0 = (float(out[self._nc + 1]) if self.return_logprobs
+                   else None)
+            self._chunking[slot] = None
+            self._m_prefill_ms.observe(
+                (time.perf_counter() - cs.t0) * 1e3)
+            self._activate(slot, request, handle, tok0, lp0, cs.key)
+
+    def _activate(self, slot, request, handle, tok0, lp0, key):
+        """Prompt fully in cache; publish its prefix blocks, prefill
+        the draft model, arm the slot's decode state, emit token 0."""
+        sp = request.sampling
+        n_prompt = len(request.prompt_ids)
+        if self._prefix is not None:
+            self._prefix.register(request.prompt_ids,
+                                  self._slot_blocks[slot])
+        if self.draft_model is not None:
+            bucket = self._bucket_for(n_prompt)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n_prompt] = request.prompt_ids
+            with _TRACE_LOCK:
+                kd, vd = self._draft_prefill_fns[bucket](
+                    self._draft_params, *self._draft_cache.arrays(),
+                    tokens, np.int32(slot))
+            self._draft_cache.update(kd, vd)
         st = _Slot(request, handle)
         self._slot_state[slot] = st
         self._lengths[slot] = n_prompt
@@ -529,6 +1136,7 @@ class GenerationEngine:
         self._m_ttft.observe(
             (time.perf_counter() - handle.t_submit) * 1e3)
 
+    # -- decode ------------------------------------------------------------
     def _decode_once(self):
         if self._step_hook is not None:
             try:
@@ -537,21 +1145,43 @@ class GenerationEngine:
                 self._die("injected death at decode step %d"
                           % self._decode_steps)
                 raise
+        if self.draft_model is not None and self._spec_viable():
+            if self._spec_once():
+                return
+        # plain step: make room for ONE new row per active slot
+        if self.paged:
+            for slot in list(np.nonzero(self._active)[0]):
+                if not self._active[slot]:
+                    continue           # preempted as an earlier victim
+                if not self._grow_or_preempt(
+                        slot, int(self._lengths[slot]) + 1):
+                    self._fail_slot(
+                        slot, "kv pool exhausted: no preemptable slot "
+                        "left to make room")
+            if not self._active.any():
+                return
         t0 = time.perf_counter()
         with _TRACE_LOCK:
-            out = self._decode_step_fn(
-                self._params, self.cache.k, self.cache.v, self._lengths,
-                self._last_tokens, self._keys, self._steps, self._temp,
-                self._top_k, self._top_p)
-        k2, v2, nxt = out[:3]
-        lps = np.asarray(out[3]) if self.return_logprobs else None
-        self.cache.update(k2, v2)
-        nxt = np.asarray(nxt)
+            if self.paged:
+                out = self._decode_step_fn(
+                    self._params, *self.cache.arrays(), self._lengths,
+                    self._last_tokens, self._keys, self._steps,
+                    self._temp, self._top_k, self._top_p,
+                    self._decode_tables())
+            else:
+                out = self._decode_step_fn(
+                    self._params, self.cache.k, self.cache.v,
+                    self._lengths, self._last_tokens, self._keys,
+                    self._steps, self._temp, self._top_k, self._top_p)
+        self.cache.update(*out[:self._nc])
+        nxt = np.asarray(out[self._nc])
+        lps = (np.asarray(out[self._nc + 1]) if self.return_logprobs
+               else None)
         self._decode_steps += 1
         dt_ms = (time.perf_counter() - t0) * 1e3
         # the cache write in the step put every ACTIVE slot's new token
         # at lengths; advance those counters (inactive rows computed
-        # garbage nobody reads — their slot is re-prefilled on reuse)
+        # garbage nobody reads — their writes went to the garbage block)
         for slot in np.nonzero(self._active)[0]:
             self._lengths[slot] += 1
             self._steps[slot] += 1
@@ -562,6 +1192,81 @@ class GenerationEngine:
                        float(lps[slot]) if lps is not None else None)
             self._m_itl.observe(dt_ms)
 
+    # -- speculative decoding ----------------------------------------------
+    def _spec_viable(self):
+        """A verify step writes draft_len+1 rows per slot — every
+        active slot needs that much max_len headroom, and the pool must
+        cover it (otherwise this iteration falls back to plain decode,
+        which only needs one row)."""
+        active = np.nonzero(self._active)[0]
+        if len(active) == 0:
+            return False
+        s_len = self.draft_len + 1
+        if not (self._lengths[active] + s_len <= self.max_len).all():
+            return False
+        for slot in active:
+            if not self._ensure_blocks(
+                    slot, int(self._lengths[slot]) + s_len):
+                return False
+        return True
+
+    def _spec_once(self):
+        """Draft k greedy proposals, ONE batched verify, host-side
+        acceptance: greedy slots emit the longest draft prefix the
+        target agrees with plus the correction token; sampled slots
+        emit exactly their row-0 sample (their PRNG stream is
+        untouched).  Cache rows for rejected drafts are garbage past
+        the new length — later writes overwrite them."""
+        k = self.draft_len
+        n = self.slots
+        drafts = np.zeros((n, k), np.int32)
+        cur = self._last_tokens.copy()
+        kd, vd = self._draft_cache.arrays()
+        t0 = time.perf_counter()
+        with _TRACE_LOCK:
+            for i in range(k):
+                kd, vd, nxt = self._draft_decode_fn(
+                    self._draft_params, kd, vd,
+                    self._lengths + np.int32(i), cur)
+                cur = np.asarray(nxt)
+                drafts[:, i] = cur
+        self._draft_cache.update(kd, vd)
+        tok_in = np.concatenate(
+            [self._last_tokens[:, None], drafts], axis=1).astype(np.int32)
+        with _TRACE_LOCK:
+            out = self._verify_fn(
+                self._params, *self.cache.arrays(), self._lengths,
+                tok_in, self._keys, self._steps, self._temp,
+                self._top_k, self._top_p, self._decode_tables())
+        self.cache.update(*out[:self._nc])
+        toks = np.asarray(out[self._nc])               # [N, S]
+        lps = (np.asarray(out[self._nc + 1]) if self.return_logprobs
+               else None)
+        self._decode_steps += 1
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        for slot in np.nonzero(self._active)[0]:
+            greedy = self._temp[slot] <= 0.0
+            j = 0
+            if greedy:
+                while j < k and drafts[slot, j] == toks[slot, j]:
+                    j += 1
+                self._m_spec_proposed.inc(k)
+                self._m_spec_accepted.inc(j)
+            st = self._slot_state[slot]
+            for i in range(j + 1):
+                self._lengths[slot] += 1
+                self._steps[slot] += 1
+                t = int(toks[slot, i])
+                self._last_tokens[slot] = t
+                self._emit(slot, st, t,
+                           float(lps[slot, i]) if lps is not None
+                           else None)
+                if not self._active[slot]:
+                    break              # stop token / limits mid-accept
+            self._m_itl.observe(dt_ms)
+        return True
+
+    # -- token delivery ----------------------------------------------------
     def _emit(self, slot, st, token, logprob=None):
         """Deliver one generated token and apply stop conditions."""
         st.handle._emit(st.generated, token, logprob)
@@ -582,6 +1287,8 @@ class GenerationEngine:
         st.handle._finish(reason)
         self._slot_state[slot] = None
         self._active[slot] = False
+        if self.paged:
+            self._release_blocks(slot)
         self._free.append(slot)
         _trace.instant("generation.finish", cat="generation",
                        args={"slot": int(slot), "reason": reason,
@@ -595,6 +1302,11 @@ class GenerationEngine:
             if st is not None:
                 affected.append(st.handle)
                 self._slot_state[slot] = None
+            if self._chunking[slot] is not None:
+                affected.append(self._chunking[slot].handle)
+                self._chunking[slot] = None
+            if self.paged and self._slot_blocks[slot]:
+                self._release_blocks(slot)
         self._active[:] = False
         for _, handle in self._pending:
             affected.append(handle)
@@ -643,7 +1355,8 @@ class GenerationEngine:
             with self._lock:
                 if self._stop or self._dead:
                     return
-                busy = bool(self._pending) or bool(self._active.any())
+                busy = (bool(self._pending) or bool(self._active.any())
+                        or any(c is not None for c in self._chunking))
                 if not busy:
                     self._work.wait(0.05)
                     continue
@@ -701,18 +1414,23 @@ class GenerationEngine:
             self._params = staged
 
     # -- introspection -----------------------------------------------------
-    def _decode_cache_size(self):
-        """Jit-cache entries of the decode step — the compile-once pin."""
+    @staticmethod
+    def _jit_cache_size(fn):
         try:
-            return int(self._decode_step_fn._cache_size())
+            return int(fn._cache_size())
         except Exception:
             return -1
+
+    def _decode_cache_size(self):
+        """Jit-cache entries of the decode step — the compile-once pin."""
+        return self._jit_cache_size(self._decode_step_fn)
 
     def occupancy(self):
         with self._lock:
             return {
                 "slots": self.slots,
                 "active": int(self._active.sum()),
+                "chunking": sum(c is not None for c in self._chunking),
                 "free": len(self._free),
                 "pending": len(self._pending),
             }
@@ -727,7 +1445,35 @@ class GenerationEngine:
             "prefill_buckets": list(self.prefill_buckets),
             "cache": self.cache.describe(),
             "decode_executables": self._decode_cache_size(),
+            "preempted": int(self._m_preempt.value),
         })
+        ex = {
+            "decode_step": self._decode_cache_size(),
+            "prefill": {b: self._jit_cache_size(f)
+                        for b, f in self._prefill_fns.items()},
+            "chunk": {w: self._jit_cache_size(f)
+                      for w, f in self._chunk_fns.items()},
+        }
+        if self.draft_model is not None:
+            ex["verify"] = self._jit_cache_size(self._verify_fn)
+            ex["draft_decode"] = self._jit_cache_size(
+                self._draft_decode_fn)
+            ex["draft_prefill"] = {
+                b: self._jit_cache_size(f)
+                for b, f in self._draft_prefill_fns.items()}
+        occ["executables"] = ex
+        if self._prefix is not None:
+            occ["prefix_cache"] = self._prefix.stats()
+        if self.draft_model is not None:
+            proposed = int(self._m_spec_proposed.value)
+            accepted = int(self._m_spec_accepted.value)
+            occ["speculative"] = {
+                "draft_len": self.draft_len,
+                "proposed": proposed,
+                "accepted": accepted,
+                "acceptance_rate": (accepted / proposed) if proposed
+                else 0.0,
+            }
         return occ
 
     # -- convenience -------------------------------------------------------

@@ -118,12 +118,22 @@ class TransformerLM(dygraph.Layer):
         self.ln_f = dygraph.LayerNorm(cfg.hidden_size)
 
     def forward(self, input_ids, position_ids, caches=None,
-                cache_positions=None, use_cache=False):
+                cache_positions=None, use_cache=False,
+                block_tables=None, block_size=None):
         """input_ids/position_ids: [B, S] int.  With ``caches`` given
-        (decode), S must be 1 and the return is
-        ``(logits [B, 1, V], (k_stack', v_stack'))``; with
+        (decode/chunk: S tokens per row written at positions
+        ``cache_positions..+S-1``, row i attending the cache through
+        position ``cache_positions+i``), the return is
+        ``(logits [B, S, V], updated cache arrays)``; with
         ``use_cache=True`` (prefill) it is ``(logits, [(k, v), ...])``
-        per layer; otherwise just ``logits [B, S, V]``."""
+        per layer; otherwise just ``logits [B, S, V]``.
+
+        ``caches`` is dense ``(k_stack, v_stack)`` of
+        ``[L, B, T, H, Dh]`` (PR-15), or — when ``block_tables``
+        ``[B, max_blocks]`` and ``block_size`` are given — a PAGED pool
+        ``[L, NB, bs, H, Dh]`` pair, optionally followed by int8
+        per-row scale stacks ``[L, NB, bs, H]``
+        (``(k, v, k_scale, v_scale)``)."""
         s_len = int(input_ids.shape[1])
         emb = self.word(input_ids) + self.position(position_ids)
         # the lookup op squeezes Paddle's [B, 1] ids convention; decode
@@ -134,16 +144,19 @@ class TransformerLM(dygraph.Layer):
         if caches is not None:
             import jax.numpy as jnp
 
-            k_stack, v_stack = caches
-            k_stack = jnp.asarray(k_stack)
-            v_stack = jnp.asarray(v_stack)
-            k_rows, v_rows = [], []
+            stacks = [jnp.asarray(c) for c in caches]
+            out_rows = [[] for _ in stacks]
             for li, block in enumerate(self.blocks):
-                h, (k_row, v_row) = block(
-                    h, cache=(k_stack[li], v_stack[li], cache_positions))
-                k_rows.append(k_row)
-                v_rows.append(v_row)
-            out_caches = (jnp.stack(k_rows), jnp.stack(v_rows))
+                per_layer = tuple(s[li] for s in stacks)
+                if block_tables is None:
+                    cache = per_layer + (cache_positions,)
+                else:
+                    cache = per_layer + (cache_positions, block_tables,
+                                         block_size)
+                h, updated = block(h, cache=cache)
+                for rows, arr in zip(out_rows, updated):
+                    rows.append(arr)
+            out_caches = tuple(jnp.stack(rows) for rows in out_rows)
         else:
             for block in self.blocks:
                 if use_cache:

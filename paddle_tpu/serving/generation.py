@@ -19,6 +19,10 @@ slot-occupancy signal: when the chosen engine's pending queue is full,
 `ShedError` propagates (HTTP 503 + Retry-After priced in measured
 tokens/s).
 
+``/stats`` carries the paged-KV gauges per replica — block-pool
+used/free, prefix-cache hit rate, speculative acceptance, preemptions —
+the signals the capacity dashboard and the PR-17 pool-sizing loop read.
+
 `serve_generation_http` is the data plane: ``POST /generate`` with
 ``"stream": true`` answers ``application/x-ndjson`` over chunked
 transfer encoding — one JSON object per token as it is decoded (the
@@ -92,8 +96,17 @@ class GenerationReplica:
         return occ["free"] - occ["pending"]
 
     def describe(self):
-        return {"replica_id": self.replica_id, "alive": self.alive,
-                **self.engine.occupancy()}
+        st = self.engine.stats()
+        d = {"replica_id": self.replica_id, "alive": self.alive,
+             **self.engine.occupancy(),
+             # the paged-KV gauges the admission/capacity dashboards
+             # read off /stats: pool fill, prefix reuse, draft yield
+             "kv_cache": st["cache"],
+             "preempted": st["preempted"]}
+        for k in ("prefix_cache", "speculative"):
+            if k in st:
+                d[k] = st[k]
+        return d
 
 
 class GenerationFleet:

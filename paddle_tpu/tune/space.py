@@ -429,19 +429,28 @@ def rl_batch_candidates(rollout_batches=(4, 8, 16),
 
 def generation_config_candidates(slot_counts=(1, 4, 8, 16),
                                  max_len=None, hbm_budget_bytes=None,
-                                 cache_bytes_per_slot=None):
-    """Decode-engine slot-count candidates (`paddle_tpu.generation`).
+                                 cache_bytes_per_slot=None,
+                                 block_sizes=None, draft_lens=None):
+    """Decode-engine candidates (`paddle_tpu.generation`): the slot
+    count, and optionally the paged-KV block size and speculative
+    draft length.
 
     More slots amortize the per-step weight read over more tokens
     (the decode step is memory-bound — `analysis.perf
     .decode_step_cost`) but grow the KV cache linearly and the
-    per-request ITL with it; the sweet spot is workload- and
-    HBM-budget-dependent, so it is MEASURED.  The first candidate is
-    the caller's default (search_step baseline contract).  Candidates
-    whose cache would exceed ``hbm_budget_bytes`` (when both budget
-    and ``cache_bytes_per_slot`` are given) are dropped up front —
-    never compiled, like the static prune in `search`."""
+    per-request ITL with it; small blocks waste fewer tail rows but
+    fragment the pool's DMA stream; longer drafts amortize more verify
+    calls but burn more on rejection.  All workload-dependent, so they
+    are MEASURED.  The first candidate is the caller's default
+    (search_step baseline contract) — with extra axes given, the cross
+    product is ordered slots-major with the first value of each axis
+    first.  Candidates whose cache would exceed ``hbm_budget_bytes``
+    (when both budget and ``cache_bytes_per_slot`` are given) are
+    dropped up front — never compiled, like the static prune in
+    `search`."""
     out, seen = [], set()
+    bss = [None] if not block_sizes else [int(b) for b in block_sizes]
+    dls = [None] if draft_lens is None else [int(d) for d in draft_lens]
     for s in slot_counts:
         s = int(s)
         if s <= 0 or s in seen:
@@ -451,8 +460,21 @@ def generation_config_candidates(slot_counts=(1, 4, 8, 16),
                 and s * cache_bytes_per_slot > hbm_budget_bytes):
             continue
         seen.add(s)
-        params = {"slots": s}
-        if max_len is not None:
-            params["max_len"] = int(max_len)
-        out.append(Candidate("generation", params, label="slots%d" % s))
+        for bs in bss:
+            for dl in dls:
+                params = {"slots": s}
+                label = "slots%d" % s
+                if max_len is not None:
+                    params["max_len"] = int(max_len)
+                if bs is not None:
+                    if bs <= 0:
+                        continue
+                    params["block_size"] = bs
+                    label += "_bs%d" % bs
+                if dl is not None:
+                    if dl < 0:
+                        continue
+                    params["draft_len"] = dl
+                    label += "_k%d" % dl
+                out.append(Candidate("generation", params, label=label))
     return out

@@ -369,10 +369,11 @@ class TestEngine:
     def test_occupancy_and_stats(self, lm):
         eng = make_engine(lm)
         assert eng.occupancy() == {"slots": 3, "active": 0, "free": 3,
-                                   "pending": 0}
+                                   "pending": 0, "chunking": 0}
         st = eng.stats()
         assert st["decode_executables"] in (0, 1)
         assert st["cache"]["bytes"] == eng.cache.nbytes
+        assert st["cache"]["paged"] is True
 
 
 # ---------------------------------------------------------------------------
@@ -529,3 +530,487 @@ class TestLogprobsAndSwap:
         bad[name] = bad[name][:, :-1]
         with pytest.raises(ValueError):
             eng.swap_params(bad)
+
+
+# ---------------------------------------------------------------------------
+# paged KV: kernels, block pool, prefix cache (PR-17)
+# ---------------------------------------------------------------------------
+
+
+class TestPagedKernels:
+    def _pool_from_dense(self, k, v, bs, extra_blocks=2, seed=3):
+        """Scatter a dense [N, T, H, D] cache into a PERMUTED block
+        pool + table — paged reads must be layout-independent."""
+        rng = np.random.RandomState(seed)
+        n, t, h, d = k.shape
+        nb_per = t // bs
+        num_blocks = 1 + n * nb_per + extra_blocks
+        perm = 1 + rng.permutation(num_blocks - 1)[: n * nb_per]
+        k_pool = np.zeros((num_blocks, bs, h, d), np.float32)
+        v_pool = np.zeros((num_blocks, bs, h, d), np.float32)
+        tables = np.zeros((n, nb_per), np.int32)
+        for i in range(n):
+            for j in range(nb_per):
+                b = perm[i * nb_per + j]
+                tables[i, j] = b
+                k_pool[b] = k[i, j * bs:(j + 1) * bs]
+                v_pool[b] = v[i, j * bs:(j + 1) * bs]
+        return k_pool, v_pool, tables
+
+    def test_paged_reference_matches_dense_reference(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.decode_attention import (
+            decode_attention_reference,
+        )
+        from paddle_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_reference,
+        )
+
+        rng = np.random.RandomState(0)
+        n, t, h, d, bs = 3, 64, 4, 16, 16
+        q = rng.randn(n, h, d).astype(np.float32)
+        k = rng.randn(n, t, h, d).astype(np.float32)
+        v = rng.randn(n, t, h, d).astype(np.float32)
+        lens = jnp.asarray([5, 0, 64], jnp.int32)
+        dense = decode_attention_reference(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), lens)
+        k_pool, v_pool, tables = self._pool_from_dense(k, v, bs)
+        paged = paged_decode_attention_reference(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(tables), lens)
+        np.testing.assert_allclose(np.asarray(paged), np.asarray(dense),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_pallas_interpret_matches_reference(self):
+        """The scalar-prefetch kernel through the interpreter, at a
+        TPU-tileable geometry (bs % 128, d % 64), against the jnp
+        oracle — the same pin the dense decode kernel carries."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention,
+            paged_decode_attention_reference,
+        )
+
+        rng = np.random.RandomState(1)
+        n, h, d, bs, nb_per = 2, 2, 64, 128, 2
+        q = rng.randn(n, h, d).astype(np.float32)
+        k = rng.randn(n, nb_per * bs, h, d).astype(np.float32)
+        v = rng.randn(n, nb_per * bs, h, d).astype(np.float32)
+        k_pool, v_pool, tables = self._pool_from_dense(k, v, bs)
+        lens = jnp.asarray([3, 130], jnp.int32)
+        ref = paged_decode_attention_reference(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(tables), lens)
+        pal = paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(tables), lens, interpret=True)
+        np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+        # empty slot emits exact zeros through the kernel too
+        pal0 = paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(tables), jnp.asarray([0, 1], jnp.int32),
+            interpret=True)
+        assert np.all(np.asarray(pal0)[0] == 0.0)
+
+    def test_chunked_reference_c1_equals_decode_reference(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.decode_attention import (
+            decode_attention_reference,
+        )
+        from paddle_tpu.ops.pallas.paged_attention import (
+            chunked_attention_reference,
+        )
+
+        rng = np.random.RandomState(2)
+        n, t, h, d = 3, 32, 4, 16
+        q = rng.randn(n, 1, h, d).astype(np.float32)
+        k = rng.randn(n, t, h, d).astype(np.float32)
+        v = rng.randn(n, t, h, d).astype(np.float32)
+        lens = np.asarray([7, 1, 32], np.int32)
+        # decode contract: row 0 sits at position len-1 (its K/V is in)
+        chunk = chunked_attention_reference(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(lens - 1))
+        dec = decode_attention_reference(
+            jnp.asarray(q[:, 0]), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(lens))
+        np.testing.assert_allclose(np.asarray(chunk)[:, 0],
+                                   np.asarray(dec), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_chunked_reference_per_row_causal_mask(self):
+        """Row i attends exactly t <= start + i — against a literal
+        per-row numpy softmax."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.paged_attention import (
+            chunked_attention_reference,
+        )
+
+        rng = np.random.RandomState(3)
+        n, c, t, h, d = 2, 3, 16, 2, 8
+        q = rng.randn(n, c, h, d).astype(np.float32)
+        k = rng.randn(n, t, h, d).astype(np.float32)
+        v = rng.randn(n, t, h, d).astype(np.float32)
+        start = np.asarray([4, 0], np.int32)
+        out = np.asarray(chunked_attention_reference(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(start)))
+        for i in range(n):
+            for ci in range(c):
+                lim = start[i] + ci + 1
+                s = np.einsum("hd,thd->ht", q[i, ci],
+                              k[i, :lim]) * d ** -0.5
+                p = np.exp(s - s.max(-1, keepdims=True))
+                p /= p.sum(-1, keepdims=True)
+                ref = np.einsum("ht,thd->hd", p, v[i, :lim])
+                np.testing.assert_allclose(out[i, ci], ref, rtol=1e-5,
+                                           atol=1e-5)
+
+    def test_int8_roundtrip_and_zero_rows(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.paged_attention import (
+            dequantize_kv,
+            quantize_kv,
+        )
+
+        rng = np.random.RandomState(4)
+        x = rng.randn(5, 3, 4, 16).astype(np.float32)
+        q, s = quantize_kv(jnp.asarray(x))
+        assert np.asarray(q).dtype == np.int8
+        back = np.asarray(dequantize_kv(q, s))
+        # symmetric 127-level quantization: error <= scale/2 per elem
+        amax = np.abs(x).max(-1, keepdims=True)
+        assert np.all(np.abs(back - x) <= amax / 127.0 + 1e-7)
+        z, zs = quantize_kv(jnp.zeros((2, 4, 8)))
+        assert np.all(np.asarray(dequantize_kv(z, zs)) == 0.0)
+
+
+class TestBlockPool:
+    def test_alloc_free_refcount_discipline(self):
+        pool = gen.BlockPool(6)
+        assert pool.free_blocks == 5 and pool.used_blocks == 0
+        a = pool.alloc(3)
+        assert sorted(a) == [1, 2, 3]      # lowest-id-first, 0 reserved
+        assert pool.used_blocks == 3
+        pool.incref([a[0]])                # shared block: two users now
+        assert pool.refcount(a[0]) == 2
+        freed = pool.decref(a)             # first user lets go of all
+        assert freed == a[1:]              # shared block NOT freed
+        assert pool.refcount(a[0]) == 1
+        assert pool.decref([a[0]]) == [a[0]]   # last user -> freed
+        assert pool.used_blocks == 0
+
+    def test_exhaustion_and_misuse_raise(self):
+        pool = gen.BlockPool(4)
+        pool.alloc(3)
+        with pytest.raises(gen.PoolExhausted):
+            pool.alloc(1)
+        with pytest.raises(ValueError):
+            pool.decref([0])               # garbage block is pinned
+        pool.decref([3])
+        with pytest.raises(ValueError):
+            pool.decref([3])               # double free
+        with pytest.raises(ValueError):
+            pool.incref([3])               # incref on a free block
+        with pytest.raises(ValueError):
+            gen.BlockPool(1)
+
+    def test_freed_block_is_reused_lowest_first(self):
+        pool = gen.BlockPool(5)
+        a = pool.alloc(4)
+        pool.decref([a[1]])
+        assert pool.alloc(1) == [a[1]]
+
+
+class TestPrefixCache:
+    def _pc(self, num_blocks=10, bs=4):
+        pool = gen.BlockPool(num_blocks)
+        return pool, gen.PrefixCache(pool, bs)
+
+    def test_register_lookup_and_cap(self):
+        pool, pc = self._pc()
+        prompt = list(range(100, 112))          # 3 full blocks of 4
+        blocks = pool.alloc(3)
+        pc.register(prompt, blocks)
+        assert len(pc) == 3
+        # registry holds its own reference on top of the slot's
+        assert all(pool.refcount(b) == 2 for b in blocks)
+        n, got = pc.lookup(prompt)
+        # capped one token short of the prompt: 11 usable -> 2 blocks
+        assert n == 8 and got == blocks[:2]
+        assert all(pool.refcount(b) == 3 for b in blocks[:2])
+        n2, got2 = pc.lookup(prompt[:4] + [999] * 8)   # diverges at b1
+        assert n2 == 4 and got2 == blocks[:1]
+        assert pc.lookup([1, 2, 3])[0] == 0            # sub-block miss
+        st = pc.stats()
+        assert st["hits"] == 2 and st["misses"] == 1
+        assert st["hit_tokens"] == 12
+
+    def test_shared_block_frees_only_at_refcount_zero(self):
+        pool, pc = self._pc()
+        prompt = list(range(8))
+        mine = pool.alloc(2)
+        pc.register(prompt, mine)
+        pool.decref(mine)                  # slot releases -> registry holds
+        assert all(pool.refcount(b) == 1 for b in mine)
+        assert pool.used_blocks == 2       # STILL allocated (cache)
+        n, shared = pc.lookup(prompt + [7])
+        assert n == 8 and pool.refcount(shared[0]) == 2
+        # eviction cannot touch blocks with outside users
+        assert pc.evict(pool.num_blocks) == 0
+        assert pool.used_blocks == 2
+        pool.decref(shared)                # user done
+        freed = pc.evict(pool.num_blocks - 1)
+        assert freed == 2 and pool.used_blocks == 0
+        assert len(pc) == 0
+
+    def test_evict_is_lru_leaf_first(self):
+        pool, pc = self._pc(num_blocks=4)       # 3 usable blocks
+        old = pool.alloc(1)
+        new = pool.alloc(1)
+        pc.register(list(range(4)), old)        # registered earlier
+        pc.register(list(range(50, 54)), new)
+        pool.decref(old + new)                  # registry refs only
+        # touch `new` so `old` is the LRU chain
+        n, got = pc.lookup(list(range(50, 55)))
+        assert n == 4
+        pool.decref(got)
+        # pressure for 2 free (1 free now): exactly the LRU chain goes
+        assert pc.evict(2) == 1
+        assert pc.lookup(list(range(4)) + [9])[0] == 0     # old gone
+        n2, got2 = pc.lookup(list(range(50, 55)))          # new kept
+        assert n2 == 4
+        pool.decref(got2)
+
+
+def test_paged_kv_cache_shapes_bytes_and_tables():
+    c = gen.PagedKVCache(num_layers=2, num_blocks=9, block_size=16,
+                         num_heads=4, head_dim=8, slots=3, max_len=64)
+    assert c.shape == (2, 9, 16, 4, 8)
+    assert len(c.arrays()) == 2
+    assert c.nbytes == 2 * 2 * 9 * 16 * 4 * 8 * 4
+    assert c.capacity_tokens == 8 * 16
+    assert c.blocks_for(17) == 2
+    b = c.pool.alloc(2)
+    c.assign(0, 0, b[0])
+    c.assign(0, 1, b[1])
+    assert list(c.table_row(0)[:2]) == b
+    c.clear_slot(0)
+    assert np.all(c.table_row(0) == 0)
+    d = c.describe()
+    assert d["paged"] is True and d["kv_dtype"] == "float32"
+    assert d["blocks_used"] == 2
+
+    i8 = gen.PagedKVCache(num_layers=2, num_blocks=9, block_size=16,
+                          num_heads=4, head_dim=8, slots=3, max_len=64,
+                          kv_dtype="int8")
+    assert len(i8.arrays()) == 4           # + per-head scale stacks
+    assert i8.nbytes == (2 * 2 * 9 * 16 * 4 * 8 * 1
+                         + 2 * 2 * 9 * 16 * 4 * 4)
+    assert i8.nbytes < c.nbytes
+    assert i8.describe()["kv_dtype"] == "int8"
+
+
+# ---------------------------------------------------------------------------
+# paged engine drills (PR-17)
+# ---------------------------------------------------------------------------
+
+
+def _run(engine, reqs):
+    handles = [engine.submit(gen.GenerationRequest(
+        r.prompt_ids, max_new_tokens=r.max_new_tokens,
+        sampling=r.sampling, stop_token_ids=r.stop_token_ids))
+        for r in reqs]
+    engine.run_until_idle()
+    return [h.result() for h in handles]
+
+
+class TestPagedEngine:
+    def test_paged_exact_vs_dense_mixed_traffic(self, lm):
+        """The acceptance gate: the paged engine is token-for-token the
+        PR-15 dense engine under mixed continuous-batching traffic at
+        fixed seeds (7 requests over 3 slots: slots free and refill
+        mid-flight, blocks migrate between requests)."""
+        reqs = mixed_requests(7)
+        paged = _run(make_engine(lm), reqs)             # paged default
+        dense = _run(make_engine(lm, paged=False), reqs)
+        assert paged == dense
+        assert any(len(t) > 0 for t in paged)
+
+    @pytest.mark.slow
+    def test_chunked_prefill_exact_vs_dense(self, lm):
+        reqs = mixed_requests(6)
+        chunked = _run(make_engine(lm, prefill_chunk=4), reqs)
+        dense = _run(make_engine(lm, paged=False), reqs)
+        assert chunked == dense
+
+    @pytest.mark.slow
+    def test_prefix_cache_hits_and_exactness(self, lm):
+        """Shared-system-prompt traffic: round 2 serves the prefix from
+        cache (hits, hit_tokens > 0) and the streams still equal the
+        dense engine's."""
+        sysp = list(range(1, 34))
+        reqs = [gen.GenerationRequest(sysp + [40 + i], max_new_tokens=4,
+                                      request_id="p%d" % i)
+                for i in range(4)]
+        eng = make_engine(lm, prefix_cache=True,
+                          prefill_buckets=[8, 16, 40])
+        got = _run(eng, reqs)
+        dense = _run(make_engine(lm, paged=False,
+                                 prefill_buckets=[8, 16, 40]), reqs)
+        assert got == dense
+        st = eng.stats()["prefix_cache"]
+        assert st["hits"] >= 1 and st["hit_tokens"] >= 32
+        assert st["entries"] >= 2
+        assert eng.occupancy()["active"] == 0
+        # the only live pool references left are the registry's
+        assert eng.cache.pool.used_blocks == st["entries"]
+        # releasing the registry returns every block: no leaks
+        eng._prefix.evict(eng.cache.num_blocks - 1)
+        assert eng.cache.pool.used_blocks == 0
+
+    @pytest.mark.slow
+    def test_speculative_greedy_exact_vs_dense(self, lm):
+        """Draft-k speculative decoding: greedy streams equal plain
+        decode exactly (verify samples with the SAME per-step PRNG
+        states), and the acceptance counters are live."""
+        with dygraph.guard():
+            np.random.seed(7)
+            draft = models.TransformerLM(CFG)
+        reqs = mixed_requests(6)
+        eng = make_engine(lm, draft_model=draft, draft_len=3)
+        got = _run(eng, reqs)
+        dense = _run(make_engine(lm, paged=False), reqs)
+        assert got == dense
+        spec = eng.stats()["speculative"]
+        assert spec["draft_len"] == 3
+        assert spec["proposed"] > 0
+        assert 0.0 <= spec["acceptance_rate"] <= 1.0
+
+    @pytest.mark.slow
+    def test_int8_kv_opt_in_smoke(self, lm):
+        """kv_dtype='int8' is the documented-tolerance opt-in: streams
+        complete at full length (greedy may lawfully differ from f32),
+        the pool stores int8 + scales, bytes shrink ~4x."""
+        reqs = mixed_requests(5)
+        eng = make_engine(lm, kv_dtype="int8")
+        got = _run(eng, reqs)
+        assert [len(t) for t in got] == \
+            [r.max_new_tokens for r in reqs]
+        d = eng.cache.describe()
+        assert d["kv_dtype"] == "int8"
+        f32 = make_engine(lm)
+        assert eng.cache.nbytes < f32.cache.nbytes / 2
+
+    def test_midflight_death_returns_every_block(self, lm):
+        """The leak drill: an engine killed MID-GENERATION (slots full
+        of half-decoded sequences) must hand back every pool block."""
+        def hook(step_no):
+            if step_no >= 2:
+                raise gen.EngineDeadError("drill kill at step 2")
+
+        eng = make_engine(lm, step_hook=hook)
+        handles = [eng.submit(r) for r in mixed_requests(3, max_new=8)]
+        with pytest.raises(gen.EngineDeadError):
+            while eng.step():
+                pass
+        assert eng.dead
+        assert eng.cache.pool.used_blocks == 0
+        for h in handles:
+            with pytest.raises(Exception):
+                h.result(timeout=0.1)
+
+    @pytest.mark.slow
+    def test_tiny_pool_preempts_and_completes_everything(self, lm):
+        """A pool too small for all slots at once: the engine preempts
+        (restart semantics) instead of corrupting or deadlocking;
+        every request still completes at full length and the pool
+        drains to zero."""
+        eng = make_engine(lm, kv_blocks=5, block_size=16)
+        reqs = [gen.GenerationRequest(list(range(1, 15)),
+                                      max_new_tokens=8,
+                                      request_id="tp%d" % i)
+                for i in range(3)]
+        handles = [eng.submit(r) for r in reqs]
+        eng.run_until_idle()
+        got = [h.result() for h in handles]
+        assert [len(t) for t in got] == [8, 8, 8]
+        # 4 usable blocks cannot hold three 22-token sequences at once:
+        # the engine MUST have preempted at least one slot
+        assert eng.stats()["preempted"] >= 1
+        assert eng.cache.pool.used_blocks == 0
+        # exactness survives preemption: restarts replay the same
+        # per-request key streams
+        dense = _run(make_engine(lm, paged=False), reqs)
+        assert got == dense
+
+    def test_compile_pin_with_all_features_on(self, lm):
+        """The PR-17 compile gate: prefix cache + chunked prefill +
+        speculative verify all live, warmed engine, measured traffic
+        compiles ZERO executables (PR-4 accumulator)."""
+        from paddle_tpu.observability import install_jax_compile_hooks
+        from paddle_tpu.observability.metrics import default_registry
+
+        install_jax_compile_hooks()
+        ctr = default_registry().counter(
+            "xla_compilations_total",
+            "XLA backend compilations (jax.monitoring)")
+        with dygraph.guard():
+            np.random.seed(9)
+            draft = models.TransformerLM(CFG)
+        eng = make_engine(lm, prefix_cache=True, prefill_chunk=8,
+                          draft_model=draft, draft_len=2)
+        for r in mixed_requests(6):
+            eng.submit(r)
+        eng.run_until_idle()
+        c0 = ctr.value
+        for r in mixed_requests(6):        # same length mix, rides all
+            eng.submit(r)                  # warmed executables
+        eng.run_until_idle()
+        assert ctr.value == c0, (
+            "%d executables compiled in the measured run; paged + "
+            "prefix + chunk + verify must reuse the warmed set"
+            % (ctr.value - c0))
+        ex = eng.stats()["executables"]
+        assert ex["decode_step"] <= 1 and ex["verify"] == 1
+
+    def test_paged_knobs_require_paged(self, lm):
+        with pytest.raises(ValueError):
+            make_engine(lm, paged=False, prefix_cache=True)
+        with pytest.raises(ValueError):
+            make_engine(lm, paged=False, kv_dtype="int8")
+        with pytest.raises(ValueError):
+            make_engine(lm, paged=False, prefill_chunk=8)
+        with pytest.raises(ValueError):
+            make_engine(lm, kv_dtype="float16")
+        with dygraph.guard():
+            np.random.seed(11)
+            draft = models.TransformerLM(CFG)
+        with pytest.raises(ValueError):
+            make_engine(lm, draft_model=draft)     # needs draft_len
+        with pytest.raises(ValueError):
+            make_engine(lm, paged=False, draft_model=draft,
+                        draft_len=2)
+
+
+def test_tune_generation_block_and_draft_axes():
+    from paddle_tpu.tune.space import generation_config_candidates
+
+    cands = generation_config_candidates(
+        slot_counts=(4,), max_len=128, block_sizes=(16, 32),
+        draft_lens=(0, 4))
+    assert [c.label for c in cands] == [
+        "slots4_bs16_k0", "slots4_bs16_k4",
+        "slots4_bs32_k0", "slots4_bs32_k4"]
+    assert cands[0].params["block_size"] == 16
+    assert cands[1].params["draft_len"] == 4
+    # legacy call shape unchanged: no paged keys, no suffixes
+    legacy = generation_config_candidates(slot_counts=(4,), max_len=128)
+    assert legacy[0].label == "slots4"
+    assert "block_size" not in legacy[0].params

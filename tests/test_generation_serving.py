@@ -265,6 +265,13 @@ class TestHttpFront:
         conn.request("GET", "/stats")
         stats = json.loads(conn.getresponse().read())
         assert "slot_occupancy" in stats
+        # PR-17: every replica exposes its paged-KV gauges — pool fill
+        # and preemptions — the capacity dashboard's signals
+        for rep in stats["replicas"]:
+            assert rep["kv_cache"]["paged"] is True
+            assert rep["kv_cache"]["blocks_free"] >= 0
+            assert "blocks_used" in rep["kv_cache"]
+            assert rep["preempted"] >= 0
         conn.close()
 
 
@@ -318,6 +325,29 @@ class TestCtl:
             assert r.returncode == 0, r.stdout + r.stderr
             out = json.loads(r.stdout)
             assert out["ok"] and out["tokens"] == 6 * 4
+        finally:
+            httpd.shutdown()
+            fleet.stop()
+
+    def test_kv_command_reports_pool_gauges(self, lm):
+        fleet = make_fleet(lm, replicas=2).start()
+        port = free_port()
+        httpd = serving.serve_generation_http(fleet, port=port,
+                                              block=False)
+        try:
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "generation_ctl.py"),
+                 "--endpoint", "http://127.0.0.1:%d" % port, "--json",
+                 "kv"],
+                capture_output=True, text=True, timeout=60)
+            assert r.returncode == 0, r.stdout + r.stderr
+            out = json.loads(r.stdout)
+            assert len(out["replicas"]) == 2
+            for rep in out["replicas"]:
+                assert rep["paged"] is True
+                assert rep["blocks_free"] >= 0
+                assert rep["preempted"] == 0
         finally:
             httpd.shutdown()
             fleet.stop()

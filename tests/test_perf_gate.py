@@ -383,3 +383,38 @@ def test_generation_decode_step_hbm_bytes_within_budget():
         num_layers=4, hidden_size=256, num_heads=4, vocab_size=8000,
         intermediate_size=1024, slots=8, cache_len=1024, chip=chip)
     assert longer.kv_read_bytes == 2 * cost.kv_read_bytes
+
+
+def test_generation_paged_decode_kv_bytes_beat_dense():
+    """PR-17 gate: at the long-prompt/short-output mix (dense must
+    provision cache_len=max_len while live sequences average far
+    shorter), the paged decode step's KV traffic must be STRICTLY
+    below dense — the headline paged win, priced by the estimator the
+    CI runs on every platform.  int8 KV must beat f32 paged even after
+    paying the per-head scale reads."""
+    from paddle_tpu.analysis.perf import ChipSpec, decode_step_cost
+
+    chip = ChipSpec("pinned", 197e12, 819e9)
+    shape = dict(num_layers=4, hidden_size=256, num_heads=4,
+                 vocab_size=8000, intermediate_size=1024, slots=8,
+                 chip=chip)
+    dense = decode_step_cost(cache_len=512, **shape)
+    paged = decode_step_cost(cache_len=512, paged=True, mean_len=96,
+                             block_size=16, **shape)
+    assert paged.paged and not dense.paged
+    assert paged.kv_read_bytes < dense.kv_read_bytes, (
+        "paged KV read (%.2f MB) must be strictly below dense "
+        "(%.2f MB) at mean_len 96 vs cache_len 512"
+        % (paged.kv_read_bytes / 1e6, dense.kv_read_bytes / 1e6))
+    # the exact ratio: dense reads cache_len rows, paged reads
+    # ceil(mean/bs)*bs = 96 rows
+    assert paged.kv_read_bytes * 512 == dense.kv_read_bytes * 96
+    assert paged.bytes < dense.bytes
+    # int8 halves-and-then-some the paged read even with scale reads
+    i8 = decode_step_cost(cache_len=512, paged=True, mean_len=96,
+                          block_size=16, kv_dtype_bytes=1, **shape)
+    assert i8.kv_read_bytes < paged.kv_read_bytes
+    assert i8.kv_dtype_bytes == 1
+    # serialization carries the paged fields for the report pipeline
+    d = paged.to_dict()
+    assert d["paged"] is True and d["block_size"] == 16

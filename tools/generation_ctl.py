@@ -5,6 +5,9 @@ behind an HTTP front (`serving.serve_generation_http`, or
     python tools/generation_ctl.py --endpoint http://host:port COMMAND
 
     stats                                # fleet stats() (slot occupancy)
+    kv                                   # condensed paged-KV gauges per
+                                         # replica: pool fill, prefix hit
+                                         # rate, speculative acceptance
     generate --prompt "1,2,3" [--max-new N] [--temperature T]
              [--top-k K] [--top-p P] [--seed S] [--no-stream]
     smoke    [--requests N] [--max-new M] [--concurrency C]
@@ -117,6 +120,40 @@ def cmd_stats(args):
     return 0 if code == 200 else 1
 
 
+def cmd_kv(args):
+    """Condensed per-replica paged-KV view off /stats — the pool-sizing
+    signals: block-pool fill, prefix-cache hit rate, speculative
+    acceptance, preemption count."""
+    code, payload = _get_json(args.endpoint, "/stats")
+    if code != 200:
+        print(json.dumps(payload), file=sys.stderr)
+        return 1
+    rows = []
+    for r in payload.get("replicas", []):
+        cache = r.get("kv_cache") or {}
+        row = {"replica": r.get("replica_id"),
+               "paged": cache.get("paged", False),
+               "preempted": r.get("preempted", 0)}
+        if row["paged"]:
+            row.update(blocks_used=cache.get("blocks_used"),
+                       blocks_free=cache.get("blocks_free"),
+                       block_size=cache.get("block_size"),
+                       kv_dtype=cache.get("kv_dtype"))
+        if "prefix_cache" in r:
+            row["prefix_hit_rate"] = r["prefix_cache"].get("hit_rate")
+            row["prefix_hit_tokens"] = r["prefix_cache"].get("hit_tokens")
+        if "speculative" in r:
+            row["acceptance_rate"] = \
+                r["speculative"].get("acceptance_rate")
+        rows.append(row)
+    if args.json:
+        print(json.dumps({"replicas": rows}))
+    else:
+        for row in rows:
+            print(" ".join("%s=%s" % kv for kv in row.items()))
+    return 0
+
+
 def cmd_generate(args):
     body = {
         "prompt": [int(t) for t in args.prompt.split(",")],
@@ -199,6 +236,7 @@ def main(argv=None):
     ap.add_argument("--timeout", type=float, default=60.0)
     sub = ap.add_subparsers(dest="cmd", required=True)
     sub.add_parser("stats")
+    sub.add_parser("kv")
     g = sub.add_parser("generate")
     g.add_argument("--prompt", required=True,
                    help="comma-separated token ids")
@@ -215,7 +253,8 @@ def main(argv=None):
     s.add_argument("--prompt-vocab", type=int, default=100)
     args = ap.parse_args(argv)
     try:
-        return {"stats": cmd_stats, "generate": cmd_generate,
+        return {"stats": cmd_stats, "kv": cmd_kv,
+                "generate": cmd_generate,
                 "smoke": cmd_smoke}[args.cmd](args)
     except Exception as e:
         msg = {"error": "%s: %s" % (type(e).__name__, e)}
