@@ -24,7 +24,12 @@ prefill bucket ladder).  Measurements over identical prompts/seeds:
   acceptance rate.  ``--kv-dtype int8`` opts the pool into quantized
   storage (documented-tolerance: the paged-vs-dense token check is
   skipped, streams may lawfully differ);
-* **occupancy** — mean slot occupancy, the admission signal.
+* **occupancy** — mean slot occupancy, the admission signal;
+* **tensor-parallel A/B** — ``--tp N`` decodes the same requests on a
+  `tp_serving.TPGenerationEngine` over N devices: streams must match
+  the single-chip engine token-for-token, the sharded decode step must
+  compile exactly once, and the per-layer all-reduce bytes priced by
+  `analysis.comm` must equal the compiled executable's HLO exactly.
 
 CPU-host caveat: with JAX_PLATFORMS=cpu this is the smoke config (tiny
 model, short generations) — the numbers calibrate the harness, not the
@@ -197,6 +202,12 @@ def main(argv=None):
                     help="speculative decoding with a tiny draft LM")
     ap.add_argument("--skip-paged-ab", action="store_true",
                     help="skip the paged-vs-dense A/B")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel A/B: decode the same requests "
+                         "on a tp_serving.TPGenerationEngine over N "
+                         "devices, assert token exactness, and pin the "
+                         "per-layer all-reduce bytes against compiled "
+                         "HLO")
     args = ap.parse_args(argv)
 
     try:
@@ -387,6 +398,68 @@ def main(argv=None):
         out["paged"]["dense_tokens_per_s"] = round(md["tokens_per_s"], 2)
         out["paged"]["paged_vs_dense_tps"] = round(
             m["tokens_per_s"] / max(md["tokens_per_s"], 1e-9), 2)
+
+    if args.tp > 1:
+        # tensor-parallel A/B (paddle_tpu.tp_serving): identical
+        # requests through a TP engine — streams must match the
+        # single-chip engine token-for-token, the sharded decode must
+        # compile exactly once, and the per-layer all-reduce bytes the
+        # comm model prices must equal the compiled executable's
+        if len(jax.devices()) < args.tp:
+            out["tp"] = {"skipped": "tp=%d needs %d devices, have %d"
+                         % (args.tp, args.tp, len(jax.devices()))}
+        else:
+            from paddle_tpu.tp_serving import TPGenerationEngine
+
+            tp_eng = TPGenerationEngine(
+                model, tp=args.tp, slots=args.slots,
+                max_len=args.max_len, prefill_buckets=buckets,
+                max_queue=4096, **engine_kwargs)
+            tp_warm = [gen.GenerationRequest(list(range(1, b + 1)),
+                                             max_new_tokens=2)
+                       for b in buckets]
+            run_engine(model, tp_warm, args.slots, args.max_len,
+                       buckets, engine=tp_eng)
+            c1 = reg.counter("xla_compilations_total",
+                             "XLA backend compilations "
+                             "(jax.monitoring)").value
+            tp_eng, tp_results, mt = run_engine(
+                model, make_requests(cfg, args.requests, args.max_new),
+                args.slots, args.max_len, buckets, engine=tp_eng)
+            tp_compiles = reg.counter(
+                "xla_compilations_total",
+                "XLA backend compilations (jax.monitoring)").value - c1
+            if args.kv_dtype is None and args.draft_len == 0:
+                for i, (p, t) in enumerate(zip(results, tp_results)):
+                    if p != t:
+                        print(json.dumps({
+                            "error": "tp/single-chip token mismatch on "
+                                     "request %d" % i,
+                            "single": p, "tp": t}))
+                        return 1
+            commchk = tp_eng.decode_hlo_comm_check()
+            if not (commchk["count_match"] and commchk["wire_match"]):
+                print(json.dumps({
+                    "error": "comm estimate does not match compiled "
+                             "HLO", "comm": commchk}))
+                return 1
+            out["tp"] = {
+                "degree": args.tp,
+                "tokens_per_s": round(mt["tokens_per_s"], 2),
+                "tokens_per_s_tp1": out["value"],
+                "itl_ms_p50": round(mt["itl_ms_p50"], 3),
+                "token_exact_vs_tp1": (args.kv_dtype is None
+                                       and args.draft_len == 0),
+                "decode_executables": tp_eng._decode_cache_size(),
+                "compiles_in_measured_run": tp_compiles,
+                "per_layer_allreduce_bytes":
+                    commchk["per_layer_wire_bytes"],
+                "comm_bytes_per_step": commchk["comm_bytes_per_step"],
+                "hlo_all_reduce_count":
+                    commchk["hlo_all_reduce_count"],
+                "hlo_wire_bytes": commchk["hlo_wire_bytes"],
+                "comm_match": True,
+            }
 
     if args.autotune:
         from paddle_tpu import tune

@@ -31,11 +31,11 @@ def __getattr__(name):
     # lazy submodules (PEP 562): analysis is a build/debug-time tool,
     # serving is a dedicated-process front tier, tune is an offline
     # search harness, streaming is the online-learning loop, generation
-    # is the decoding engine, and rl is the feedback loop over all of
-    # them — none may tax the import of every training/serving worker
-    # process
+    # is the decoding engine, rl is the feedback loop over all of them,
+    # and tp_serving is the model-parallel inference tier — none may
+    # tax the import of every training/serving worker process
     if name in ("analysis", "serving", "tune", "streaming", "generation",
-                "rl"):
+                "rl", "tp_serving"):
         import importlib
 
         return importlib.import_module("." + name, __name__)

@@ -23,6 +23,15 @@ way the byte model upper-bounds fused HBM traffic):
 converts each instruction's RESULT buffer to a full payload first
 (a reduce-scatter's result is the 1/N shard, an all-gather's result is
 already the full tensor).
+
+all-to-all convention: ``payload`` is the PER-CHIP buffer (send and
+receive sizes are equal, so "full" here means one chip's local
+``[E, cap, d]``-style buffer, of which ``(N-1)/N`` crosses the wire —
+the ``1/N`` destined for the chip itself stays home).  This matches
+the HLO side bit-for-bit: a (tiled or tuple-form) ``all-to-all``
+instruction's result buffers sum to exactly that per-chip buffer, so
+`tp_serving.moe.ep_moe_comm_bytes` pins compiled wire bytes exactly
+(see ``tests/test_tp_serving.py``).
 """
 
 from __future__ import annotations

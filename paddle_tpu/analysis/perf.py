@@ -1023,16 +1023,23 @@ class DecodeStepCost:
 
     ``kv_read_bytes`` is per STEP (all slots); the per-token KV read is
     ``kv_read_bytes / slots``.  `tests/test_perf_gate.py` budgets it
-    the way PR-13 gates collective bytes."""
+    the way PR-13 gates collective bytes.
+
+    ``tp > 1`` (`paddle_tpu.tp_serving`) adds the ICI leg: flops and
+    HBM reads are PER CHIP (sharded weights and KV divide by ``tp``,
+    replicated embeddings/LM head do not) and ``comm_bytes`` carries
+    the per-chip all-reduce wire traffic — two ring all-reduces per
+    layer over the ``[slots, hidden]`` activations — priced against
+    ``chip.ici_bw``; ``bound`` can then be ``"ici"``."""
 
     __slots__ = ("slots", "cache_len", "flops", "kv_read_bytes",
                  "param_read_bytes", "bytes", "time_s", "bound",
                  "tokens_per_s", "chip", "paged", "block_size",
-                 "kv_dtype_bytes")
+                 "kv_dtype_bytes", "tp", "comm_bytes")
 
     def __init__(self, slots, cache_len, flops, kv_read_bytes,
                  param_read_bytes, chip, paged=False, block_size=None,
-                 kv_dtype_bytes=None):
+                 kv_dtype_bytes=None, tp=1, comm_bytes=0.0):
         self.slots = int(slots)
         self.cache_len = int(cache_len)
         self.flops = float(flops)
@@ -1043,10 +1050,19 @@ class DecodeStepCost:
         self.paged = bool(paged)
         self.block_size = block_size
         self.kv_dtype_bytes = kv_dtype_bytes
+        self.tp = int(tp)
+        self.comm_bytes = float(comm_bytes)
         t_compute = self.flops / chip.peak_flops
         t_memory = self.bytes / chip.hbm_bw
-        self.time_s = max(t_compute, t_memory)
-        self.bound = "compute" if t_compute >= t_memory else "memory"
+        t_ici = (self.comm_bytes / chip.ici_bw
+                 if self.comm_bytes and chip.ici_bw else 0.0)
+        self.time_s = max(t_compute, t_memory, t_ici)
+        if t_ici >= t_compute and t_ici >= t_memory and t_ici > 0:
+            self.bound = "ici"
+        elif t_compute >= t_memory:
+            self.bound = "compute"
+        else:
+            self.bound = "memory"
         self.tokens_per_s = (self.slots / self.time_s
                              if self.time_s > 0 else float("inf"))
 
@@ -1061,6 +1077,7 @@ class DecodeStepCost:
             "bound": self.bound, "tokens_per_s": self.tokens_per_s,
             "paged": self.paged, "block_size": self.block_size,
             "kv_dtype_bytes": self.kv_dtype_bytes,
+            "tp": self.tp, "comm_bytes": self.comm_bytes,
             "chip": self.chip.to_dict(),
         }
 
@@ -1068,7 +1085,8 @@ class DecodeStepCost:
 def decode_step_cost(*, num_layers, hidden_size, num_heads, vocab_size,
                      intermediate_size=None, slots=8, cache_len=512,
                      dtype_bytes=4, chip=None, paged=False,
-                     mean_len=None, block_size=16, kv_dtype_bytes=None):
+                     mean_len=None, block_size=16, kv_dtype_bytes=None,
+                     tp=1):
     """Static decode-step estimate (see `DecodeStepCost`).
 
     FLOPs per slot: the standard 2*N_params matmul work (QKV/out
@@ -1084,29 +1102,50 @@ def decode_step_cost(*, num_layers, hidden_size, num_heads, vocab_size,
     ``kv_dtype_bytes`` per element (default ``dtype_bytes``; pass 1
     for int8 KV — the per-row per-head f32 scales are charged on
     top).  The paged-vs-dense ratio is the HBM argument ROADMAP item 1
-    banks, and `tests/test_perf_gate.py` budgets it."""
+    banks, and `tests/test_perf_gate.py` budgets it.
+
+    ``tp > 1`` prices ONE CHIP of a `tp_serving.TPGenerationEngine`:
+    layer weights, KV reads and attention/FFN flops divide by ``tp``
+    (Megatron column/row shards + heads-sharded cache); the
+    embedding/LM-head weights stay replicated (every chip computes
+    full logits); and each layer adds two ring all-reduces over the
+    ``[slots, hidden]`` activations, so per-step
+    ``comm_bytes = 2 * L * ringfactor(tp) * slots * h * dtype`` —
+    at tp=2 the ring factor ``2*(N-1)/N`` is exactly 1 and the
+    closed form ``2*L*slots*h*dtype`` holds, the perf-gate pin."""
+    from .comm import collective_wire_bytes
+
     if intermediate_size is None:
         intermediate_size = 4 * hidden_size
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError("tp must be >= 1, got %d" % tp)
+    if tp > 1 and num_heads % tp:
+        raise ValueError("tp=%d does not divide num_heads=%d"
+                         % (tp, num_heads))
     h, L = float(hidden_size), int(num_layers)
-    per_layer_params = 4 * h * h + 2 * h * intermediate_size
+    per_layer_params = (4 * h * h + 2 * h * intermediate_size) / tp
     params = L * per_layer_params + vocab_size * h
     if paged:
         if mean_len is None:
             mean_len = cache_len
         rows = -(-int(mean_len) // int(block_size)) * int(block_size)
         kvb = dtype_bytes if kv_dtype_bytes is None else kv_dtype_bytes
-        kv_read = 2.0 * L * slots * rows * h * kvb
+        kv_read = 2.0 * L * slots * rows * h * kvb / tp
         if kvb < dtype_bytes:
             # int8 rows carry f32 per-head scales the kernel also reads
-            kv_read += 2.0 * L * slots * rows * num_heads * 4
+            kv_read += 2.0 * L * slots * rows * num_heads * 4 / tp
     else:
         rows = cache_len
         kvb = dtype_bytes
-        kv_read = 2.0 * L * slots * cache_len * h * dtype_bytes
-    attn_flops = 4.0 * rows * h                 # QK^T + PV per slot/layer
+        kv_read = 2.0 * L * slots * cache_len * h * dtype_bytes / tp
+    attn_flops = 4.0 * rows * h / tp            # QK^T + PV per slot/layer
     flops = slots * (2.0 * params + L * attn_flops)
     param_read = params * dtype_bytes
+    comm = (2.0 * L * collective_wire_bytes(
+        "all-reduce", slots * h * dtype_bytes, tp) if tp > 1 else 0.0)
     return DecodeStepCost(slots, cache_len, flops, kv_read, param_read,
                           chip or ChipSpec.detect(), paged=paged,
                           block_size=int(block_size) if paged else None,
-                          kv_dtype_bytes=kvb if paged else None)
+                          kv_dtype_bytes=kvb if paged else None,
+                          tp=tp, comm_bytes=comm)

@@ -114,6 +114,13 @@ def _shed_error(reason, retry_after_s, detail):
     return ShedError(reason, retry_after_s, detail)
 
 
+def _entry_request(entry):
+    """Pending-queue entries are raw `GenerationRequest`s or
+    `tp_serving.disagg.KVHandoff`s (which carry one)."""
+    return entry if isinstance(entry, GenerationRequest) \
+        else entry.request
+
+
 def default_prefill_buckets(max_len):
     """Power-of-two prompt-length ladder up to max_len (PR-2's default
     batch-bucket shape discipline, applied to the sequence axis)."""
@@ -915,7 +922,8 @@ class GenerationEngine:
         if rate <= 0:
             return 1
         backlog_tokens = sum(
-            r.max_new_tokens for r, _ in self._pending) or 1
+            _entry_request(e).max_new_tokens
+            for e, _ in self._pending) or 1
         return max(1.0, backlog_tokens / rate)
 
     def _tokens_per_s(self):
@@ -941,23 +949,29 @@ class GenerationEngine:
                     self._chunk_step(slot)
                     progressed = True
             while self._free and self._pending:
-                request, handle = self._pending.pop(0)
+                entry, handle = self._pending.pop(0)
                 slot = self._free.pop(0)
                 self._m_queue.set(len(self._pending))
-                if not self._prefill_into(slot, request, handle):
+                # an entry is either a raw GenerationRequest (prefill
+                # here) or a KVHandoff from a prefill worker (adopt the
+                # finished pages — decode-only workers never prefill)
+                admit = (self._prefill_into
+                         if isinstance(entry, GenerationRequest)
+                         else self._inject_into)
+                if not admit(slot, entry, handle):
                     # pool dry at admission: requeue and wait for a
                     # running request to free blocks — unless nothing
                     # is running, in which case it never will
                     self._free.insert(0, slot)
                     if self._active.any() or any(
                             c is not None for c in self._chunking):
-                        self._pending.insert(0, (request, handle))
+                        self._pending.insert(0, (entry, handle))
                         self._m_queue.set(len(self._pending))
                     else:
                         handle._fail(
                             "kv pool exhausted: request %s needs more "
                             "blocks than the pool can ever free"
-                            % request.request_id)
+                            % _entry_request(entry).request_id)
                     break
                 progressed = True
             if self._active.any():
@@ -1377,6 +1391,136 @@ class GenerationEngine:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+
+    # -- disaggregated prefill/decode (paddle_tpu.tp_serving.disagg) ------
+    def prefill_extract(self, request):
+        """PREFILL-ROLE half of the DistServe split: run ONE prefill
+        for ``request`` (whole-prompt flash path), lift the finished KV
+        pages + first token off the engine, release the slot, and
+        return the `tp_serving.disagg.KVHandoff` a decode-role engine
+        ingests with `inject_prefilled`.  Never touches the decode
+        executable — a prefill worker's executable set is its prefill
+        buckets only."""
+        from ..tp_serving.disagg import KVHandoff
+
+        if not self.paged:
+            raise ValueError("prefill_extract requires paged=True")
+        if not isinstance(request, GenerationRequest):
+            request = GenerationRequest(request)
+        sp = request.sampling
+        n_prompt = len(request.prompt_ids)
+        key = make_base_key(sp.seed).astype(np.uint32)
+        with self._lock:
+            if self._dead:
+                raise EngineDeadError("engine %s is dead" % self._engine)
+            if not self._free:
+                raise _shed_error(
+                    "slots_full", self._retry_after_locked(),
+                    "prefill worker %s has no free slot" % self._engine)
+            slot = self._free.pop(0)
+            self._slot_blocks[slot] = []
+            if not self._ensure_blocks(slot, n_prompt):
+                self._free.insert(0, slot)
+                raise _shed_error(
+                    "kv_pool_exhausted", self._retry_after_locked(),
+                    "prefill worker %s pool dry" % self._engine)
+            bucket = self._bucket_for(n_prompt)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n_prompt] = request.prompt_ids
+            table = self.cache.table_row(slot)[None].astype(np.int32)
+            t0 = time.perf_counter()
+            with _TRACE_LOCK:
+                out = self._prefill_fns[bucket](
+                    self._params, *self.cache.arrays(), tokens,
+                    np.int32(n_prompt), table, key,
+                    np.float32(sp.temperature), np.int32(sp.top_k),
+                    np.float32(sp.top_p))
+            self.cache.update(*out[:self._nc])
+            tok0 = int(out[self._nc])
+            lp0 = (float(out[self._nc + 1]) if self.return_logprobs
+                   else None)
+            self._m_prefill_ms.observe((time.perf_counter() - t0) * 1e3)
+            idx = np.asarray(self._slot_blocks[slot], np.int32)
+            pages = tuple(np.asarray(a[:, idx])
+                          for a in self.cache.arrays())
+            self._release_blocks(slot)
+            self._free.append(slot)
+        return KVHandoff(
+            request=request, n_prompt=n_prompt, tok0=tok0, lp0=lp0,
+            key=np.asarray(key), pages=pages,
+            block_size=self.block_size,
+            kv_dtype=self.cache.kv_dtype)
+
+    def inject_prefilled(self, handoff, _handle=None):
+        """DECODE-ROLE half: queue a `KVHandoff` for adoption into this
+        engine's pool (fresh block ids, table row rebuilt).  The
+        scheduler arms the slot's decode state and emits token 0 — the
+        request decodes here without this engine EVER running a prefill
+        executable (`stats()["executables"]["prefill"]` stays untraced,
+        the perf-gate pin).  Queueing mirrors `submit`: handoffs wait
+        in the same pending queue when slots are busy and shed at
+        ``max_queue``.  ``_handle`` re-attaches an existing handle on
+        the fleet requeue path."""
+        if not self.paged:
+            raise ValueError("inject_prefilled requires paged=True")
+        if handoff.block_size != self.block_size:
+            raise ValueError("handoff block_size %d != engine %d"
+                             % (handoff.block_size, self.block_size))
+        if handoff.kv_dtype != self.cache.kv_dtype:
+            raise ValueError("handoff kv_dtype %r != engine %r"
+                             % (handoff.kv_dtype, self.cache.kv_dtype))
+        shape = self.cache.shape
+        if handoff.pages[0].shape[0] != shape[0] or \
+                handoff.pages[0].shape[2:] != shape[2:]:
+            raise ValueError(
+                "handoff page geometry %r does not fit pool %r"
+                % (handoff.pages[0].shape, shape))
+        with self._lock:
+            if self._dead:
+                raise EngineDeadError("engine %s is dead" % self._engine)
+            if len(self._pending) >= self.max_queue:
+                err = _shed_error(
+                    "slots_full", self._retry_after_locked(),
+                    "decode worker %s: all %d slots busy and %d "
+                    "requests queued"
+                    % (self._engine, self.slots, len(self._pending)))
+                self._m_shed.labels(self._engine, err.reason).inc()
+                raise err
+            handle = _handle if _handle is not None \
+                else RequestHandle(handoff.request)
+            self._pending.append((handoff, handle))
+            self._m_requests.inc()
+            self._m_queue.set(len(self._pending))
+            self._work.notify_all()
+        return handle
+
+    def _inject_into(self, slot, handoff, handle):
+        """Adopt a handoff's pages under the lock: alloc fresh blocks,
+        rebuild the table row, copy pages in, arm decode.  Returns
+        False (caller requeues) when the pool is dry."""
+        self._slot_blocks[slot] = []
+        n_blocks = int(handoff.pages[0].shape[1])
+        try:
+            ids = self.cache.pool.alloc(n_blocks)
+        except PoolExhausted:
+            if self._prefix is not None:
+                self._prefix.evict(n_blocks)
+            try:
+                ids = self.cache.pool.alloc(n_blocks)
+            except PoolExhausted:
+                return False
+        for j, b in enumerate(ids):
+            self.cache.assign(slot, j, b)
+        self._slot_blocks[slot] = ids
+        self._set_block_gauges()
+        idx = np.asarray(ids, np.int32)
+        arrays = tuple(
+            jnp.asarray(a).at[:, idx].set(page)
+            for a, page in zip(self.cache.arrays(), handoff.pages))
+        self.cache.update(*arrays)
+        self._activate(slot, handoff.request, handle, handoff.tok0,
+                       handoff.lp0, handoff.key)
+        return True
 
     # -- weight hot-swap ---------------------------------------------------
     def snapshot_params(self):

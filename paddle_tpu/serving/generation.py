@@ -60,7 +60,7 @@ class GenerationReplica:
     """One engine + its scheduler thread + the fault-drill seam."""
 
     def __init__(self, model, index=0, fleet_name="genfleet",
-                 fault_plan=None, **engine_kwargs):
+                 fault_plan=None, engine_cls=None, **engine_kwargs):
         self.index = int(index)
         self.replica_id = "%s/g%d" % (fleet_name, self.index)
         if fault_plan is None:
@@ -75,7 +75,9 @@ class GenerationReplica:
                     "%s: injected death at decode step %d"
                     % (self.replica_id, step_no + 1))
 
-        self.engine = GenerationEngine(
+        # engine_cls lets a fleet run tensor-parallel replicas
+        # (tp_serving.TPGenerationEngine, with tp=/mesh= in kwargs)
+        self.engine = (engine_cls or GenerationEngine)(
             model, name=self.replica_id,
             step_hook=hook if kill_at is not None else None,
             **engine_kwargs)
@@ -103,7 +105,7 @@ class GenerationReplica:
              # read off /stats: pool fill, prefix reuse, draft yield
              "kv_cache": st["cache"],
              "preempted": st["preempted"]}
-        for k in ("prefix_cache", "speculative"):
+        for k in ("prefix_cache", "speculative", "tp"):
             if k in st:
                 d[k] = st[k]
         return d
@@ -113,7 +115,8 @@ class GenerationFleet:
     """See module docstring."""
 
     def __init__(self, model, replicas=1, *, name="genfleet",
-                 metrics_registry=None, fault_plan=None, **engine_kwargs):
+                 metrics_registry=None, fault_plan=None, engine_cls=None,
+                 **engine_kwargs):
         reg = metrics_registry or default_registry()
         self.metrics_registry = reg
         self.name = name
@@ -123,6 +126,7 @@ class GenerationFleet:
         for i in range(int(replicas)):
             r = GenerationReplica(model, index=i, fleet_name=self._fleet,
                                   fault_plan=fault_plan,
+                                  engine_cls=engine_cls,
                                   metrics_registry=reg, **engine_kwargs)
             r.engine.on_death = self._on_engine_death
             self.replicas.append(r)

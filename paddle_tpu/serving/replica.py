@@ -164,6 +164,77 @@ class InProcessReplica(Replica):
         self._dead = True
 
 
+class ShardGroupReplica(Replica):
+    """G model-parallel shard workers behind ONE replica facade — the
+    second routing dimension (`paddle_tpu.tp_serving`): the router
+    load-balances across GROUPS, and every request fans out to every
+    member of its group (all shards of a tensor-parallel executable
+    must step together).  The primary (member 0) owns the output; the
+    group is alive only while EVERY shard is — one dead shard kills
+    the group, exactly like a real TP ensemble losing a chip — and the
+    fleet's requeue-after-death drill then replays the request on
+    another group."""
+
+    kind = "shard_group"
+
+    def __init__(self, members, group_index=0, version="v"):
+        if not members:
+            raise ValueError("shard group needs at least one member")
+        super().__init__(group_index, version)
+        self.members = list(members)
+        self.replica_id = "%s/g%d" % (self.version, self.index)
+
+    @property
+    def alive(self):
+        return all(m.alive for m in self.members)
+
+    @property
+    def feed_names(self):
+        return getattr(self.members[0], "feed_names", None)
+
+    def run(self, feed):
+        self.requests_served += 1
+        outs = [m.run(feed) for m in self.members]
+        return outs[0]
+
+    def warmup(self, specs):
+        out = None
+        for m in self.members:
+            out = m.warmup(specs)
+        return out
+
+    def cost_analysis(self, feed):
+        m = self.members[0]
+        if hasattr(m, "cost_analysis"):
+            return m.cost_analysis(feed)
+        return None
+
+    def close(self):
+        for m in self.members:
+            m.close()
+
+    def describe(self):
+        return {"replica_id": self.replica_id, "kind": self.kind,
+                "alive": self.alive, "requests": self.requests_served,
+                "shard_group_size": len(self.members),
+                "members": [m.describe() for m in self.members]}
+
+
+def group_replicas(reps, group_size):
+    """Wrap consecutive runs of ``group_size`` replicas in
+    `ShardGroupReplica` facades; ``group_size<=1`` is the identity."""
+    g = int(group_size)
+    if g <= 1:
+        return list(reps)
+    if len(reps) % g:
+        raise ValueError(
+            "replicas=%d not divisible by shard_group_size=%d"
+            % (len(reps), g))
+    return [ShardGroupReplica(reps[i:i + g], group_index=i // g,
+                              version=reps[i].version)
+            for i in range(0, len(reps), g)]
+
+
 class ProcessReplica(Replica):
     """A subprocess worker over a private pipe pair.
 

@@ -55,7 +55,12 @@ from .registry import (
     DeployError,
     ModelRegistry,
 )
-from .replica import InProcessReplica, ReplicaDeadError, make_replicas
+from .replica import (
+    InProcessReplica,
+    ReplicaDeadError,
+    group_replicas,
+    make_replicas,
+)
 
 __all__ = ["Router"]
 
@@ -196,7 +201,7 @@ class Router:
 
     # -- lifecycle: deploy ------------------------------------------------
     def deploy(self, version, model_dir, replicas=1, kind="thread",
-               warmup_example=None, env=None):
+               warmup_example=None, env=None, shard_group_size=1):
         """The gated pipeline: load -> verify -> warmup -> ready.
 
         Any failure rejects the version (replicas closed, state
@@ -209,7 +214,14 @@ class Router:
         concrete shapes; WITHOUT it the gate is skipped and the version
         reaches `ready` cold — promote() then pays XLA compilation on
         the first request of every bucket shape.  `describe()['warmed']`
-        records which happened."""
+        records which happened.
+
+        ``shard_group_size=G`` (`paddle_tpu.tp_serving`) wraps each
+        consecutive run of G replicas in one `ShardGroupReplica`: the
+        router then balances across ``replicas/G`` GROUPS, each request
+        fanning out to all G shard members — the second routing
+        dimension (shard-group vs replica).  ``replicas`` must be a
+        multiple of G."""
         mv = self._registry.begin_deploy(version, model_dir)
         with self._cond:
             self._rt[mv.version] = _VersionRuntime()
@@ -218,6 +230,7 @@ class Router:
             reps = make_replicas(kind, model_dir, int(replicas), mv.version,
                                  predictor_factory=self._predictor_factory,
                                  env=env)
+            reps = group_replicas(reps, shard_group_size)
             mv.replicas = reps
             mv.feed_names = getattr(reps[0], "feed_names", None)
             self._registry.gate(mv, VERIFYING)
@@ -259,6 +272,10 @@ class Router:
         off; the fleet's cannot).  Process replicas verified the
         program in-worker during load — a corrupt model never produced
         a "ready" handshake."""
+        if hasattr(replica, "members"):      # shard group: gate every member
+            for m in replica.members:
+                self._verify_replica(mv, m)
+            return
         if not isinstance(replica, InProcessReplica):
             return
         pred = replica._pred

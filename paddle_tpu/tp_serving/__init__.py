@@ -1,0 +1,59 @@
+"""`paddle_tpu.tp_serving` — model-parallel inference: serve models
+bigger than one chip.
+
+Three organs on top of the PR-15/17 generation engine:
+
+* **tensor parallelism** (`TPGenerationEngine`) — Megatron-LM
+  column/row sharding of the TransformerLM matmuls over a
+  ``Mesh(("tp",))``, KV cache sharded over the heads axis, two
+  all-reduces per layer (one per sub-layer), token-identical to the
+  single-chip engine at fixed seeds with the compile-count pin
+  preserved (`tp_serving.engine`, `tp_serving.layout`,
+  `tp_serving.model`);
+* **expert parallelism** (`build_ep_moe`) — `models.MoEFFN` experts
+  partitioned over the mesh with explicit all-to-all dispatch/combine
+  (`tp_serving.moe`), priced wire-byte-exact by `analysis.comm`;
+* **disaggregated prefill/decode** (`tp_serving.disagg`) — prefill
+  workers stream finished KV pages + block tables to decode-only
+  workers (DistServe split), `ShardGroupFleet` routing a request to a
+  co-scheduled worker GROUP — the second routing dimension the PR-9
+  `Router` grows via ``deploy(..., shard_group_size=N)``.
+
+Costing and tuning live where they always have: `analysis.comm`
+prices the collectives against compiled HLO, `analysis.perf
+.decode_step_cost(tp=...)` adds the ICI axis to the decode roofline,
+and `tune.search_generation_config(tp_degrees=...)` arbitrates tp=1
+vs tp>1 per model size.
+"""
+
+from .disagg import (
+    DisaggPair,
+    KVHandoff,
+    ShardGroupFleet,
+    extract_prefilled,
+    inject_prefilled,
+)
+from .engine import TPGenerationEngine, tp_mesh
+from .layout import (
+    prepare_tp_params,
+    restore_tp_params,
+    tp_param_specs,
+    validate_tp,
+)
+from .moe import build_ep_moe, ep_moe_comm_bytes
+
+__all__ = [
+    "DisaggPair",
+    "KVHandoff",
+    "ShardGroupFleet",
+    "TPGenerationEngine",
+    "build_ep_moe",
+    "ep_moe_comm_bytes",
+    "extract_prefilled",
+    "inject_prefilled",
+    "prepare_tp_params",
+    "restore_tp_params",
+    "tp_mesh",
+    "tp_param_specs",
+    "validate_tp",
+]

@@ -1,0 +1,116 @@
+"""Tensor-parallel parameter layout for `models.TransformerLM`.
+
+Megatron-LM column/row sharding over a one-axis ``Mesh(("tp",))``:
+
+* ``qkv_proj``  — column-parallel.  The fused ``[D, 3D]`` weight is
+  laid out ``[q | k | v]`` with each third head-major, so a naive
+  contiguous column shard would hand shard ``s`` a slice straddling
+  the q/k boundary.  `prepare_tp_params` REGROUPS the output axis to
+  ``[q_heads(s) | k_heads(s) | v_heads(s)]`` per shard — after the
+  host reorder, shard ``s``'s contiguous ``3D/tp`` columns are exactly
+  its ``H/tp`` heads' q, k, v, and the shard-local forward slices at
+  thirds just like the single-chip model.
+* ``out_proj``  — row-parallel.  Its input rows are the attention
+  context head-major, which IS contiguous per head group — no reorder;
+  the partial product is all-reduced (`lax.psum`) and the replicated
+  bias added after.
+* ``fc1``       — column-parallel (gelu is elementwise, so the shard
+  boundary never crosses math); ``fc2`` — row-parallel with the second
+  per-layer all-reduce.
+* everything else (embeddings, LayerNorms, biases of row-parallel
+  projections) — replicated.
+
+Exactly TWO all-reduces per layer — one per sub-layer (attention
+out_proj, FFN fc2), the Megatron-minimum for this block: the two sit
+on a sequential dependency chain so XLA cannot merge them, and
+`analysis.comm` prices decode at ``2·L·B·H·dtype`` wire bytes per
+token at tp=2 (ring factor ``2(N-1)/N = 1``), which
+`tests/test_perf_gate.py` pins against the compiled HLO.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+__all__ = [
+    "prepare_tp_params",
+    "restore_tp_params",
+    "tp_param_specs",
+    "validate_tp",
+]
+
+
+def validate_tp(cfg, tp):
+    """tp must divide the head count and the FFN width (and be >= 1)."""
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError("tp must be >= 1, got %d" % tp)
+    if cfg.num_heads % tp:
+        raise ValueError("tp=%d does not divide num_heads=%d"
+                         % (tp, cfg.num_heads))
+    if cfg.intermediate_size % tp:
+        raise ValueError("tp=%d does not divide intermediate_size=%d"
+                         % (tp, cfg.intermediate_size))
+    return tp
+
+
+def tp_param_specs(param_names):
+    """``{param name -> PartitionSpec}`` for a TransformerLM state
+    dict: the shard_map in_specs tree for the weights operand."""
+    specs = {}
+    for name in param_names:
+        if name.endswith("qkv_proj.weight") or name.endswith("fc1.weight"):
+            specs[name] = P(None, "tp")          # column-parallel
+        elif name.endswith("qkv_proj.bias") or name.endswith("fc1.bias"):
+            specs[name] = P("tp")
+        elif name.endswith("out_proj.weight") or name.endswith("fc2.weight"):
+            specs[name] = P("tp", None)          # row-parallel
+        else:
+            specs[name] = P()                    # replicated
+    return specs
+
+
+def _regroup_qkv(w, heads, head_dim, tp, inverse=False):
+    """Permute the fused-qkv OUTPUT axis (the last axis) between the
+    model's ``[q | k | v]`` head-major layout and the shard-major
+    ``[shard0: q k v | shard1: q k v | ...]`` layout column sharding
+    needs.  Works for the [D, 3D] weight and the [3D] bias alike."""
+    arr = np.asarray(w)
+    lead = arr.shape[:-1]
+    hl = heads // tp
+    if inverse:
+        view = arr.reshape(lead + (tp, 3, hl, head_dim))
+        perm = tuple(range(len(lead))) + tuple(
+            len(lead) + a for a in (1, 0, 2, 3))
+    else:
+        view = arr.reshape(lead + (3, tp, hl, head_dim))
+        perm = tuple(range(len(lead))) + tuple(
+            len(lead) + a for a in (1, 0, 2, 3))
+    return np.ascontiguousarray(
+        view.transpose(perm).reshape(arr.shape))
+
+
+def _map_qkv(params, cfg, tp, inverse):
+    out = {}
+    for name, arr in params.items():
+        if name.endswith("qkv_proj.weight") or \
+                name.endswith("qkv_proj.bias"):
+            out[name] = _regroup_qkv(arr, cfg.num_heads, cfg.head_dim,
+                                     tp, inverse=inverse)
+        else:
+            out[name] = np.asarray(arr)
+    return out
+
+
+def prepare_tp_params(params, cfg, tp):
+    """Host-side relayout of a canonical TransformerLM state dict into
+    the shard-major qkv grouping (shapes unchanged).  The engine stores
+    THIS dict; `restore_tp_params` is the exact inverse so snapshots
+    hand canonical weights back to `paddle_tpu.rl`'s promotion gate."""
+    return _map_qkv(params, cfg, validate_tp(cfg, tp), inverse=False)
+
+
+def restore_tp_params(params, cfg, tp):
+    """Inverse of `prepare_tp_params` (canonical ``[q | k | v]``)."""
+    return _map_qkv(params, cfg, validate_tp(cfg, tp), inverse=True)

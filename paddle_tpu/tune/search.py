@@ -1237,27 +1237,33 @@ def search_generation_config(build_and_time, *, workload,
                              hbm_budget_bytes=None,
                              cache_bytes_per_slot=None,
                              block_sizes=None, draft_lens=None,
+                             tp_degrees=None, num_heads=None,
                              mesh=None, use_cache=True, cache_dir=None,
                              platform=None, jax_version=None):
     """Measured search over the decode engine's configuration
     (`space.generation_config_candidates`): slot count, and — when
-    ``block_sizes`` / ``draft_lens`` are given — the paged-KV block
-    size and speculative draft length.
+    ``block_sizes`` / ``draft_lens`` / ``tp_degrees`` are given — the
+    paged-KV block size, speculative draft length, and tensor-parallel
+    degree.
 
     ``build_and_time(params) -> seconds-per-token`` owns building a
     ``GenerationEngine(slots=params["slots"], ...)`` (forwarding
     ``params.get("block_size")`` / ``params.get("draft_len")`` when
-    present), running a representative request mix, and reporting time
-    per generated token (`benchmarks/generation_bench.py`'s harness);
-    the tuner owns enumeration, ordering, reporting, and the cache.
-    The first candidate is the measured baseline; candidates whose KV
-    cache would blow the HBM budget are dropped before anything
-    compiles."""
+    present, and building a ``tp_serving.TPGenerationEngine(tp=
+    params["tp"])`` when ``"tp"`` is present), running a
+    representative request mix, and reporting time per generated token
+    (`benchmarks/generation_bench.py`'s harness); the tuner owns
+    enumeration, ordering, reporting, and the cache.  The first
+    candidate is the measured baseline; candidates whose PER-CHIP KV
+    cache (divided by tp — the pool shards over heads) would blow the
+    HBM budget, or whose tp does not divide ``num_heads``, are dropped
+    before anything compiles."""
     cands = space_mod.generation_config_candidates(
         slot_counts=slot_counts, max_len=max_len,
         hbm_budget_bytes=hbm_budget_bytes,
         cache_bytes_per_slot=cache_bytes_per_slot,
-        block_sizes=block_sizes, draft_lens=draft_lens)
+        block_sizes=block_sizes, draft_lens=draft_lens,
+        tp_degrees=tp_degrees, num_heads=num_heads)
     if not cands:
         raise ValueError("no feasible slot-count candidates")
     return search_step(

@@ -430,51 +430,68 @@ def rl_batch_candidates(rollout_batches=(4, 8, 16),
 def generation_config_candidates(slot_counts=(1, 4, 8, 16),
                                  max_len=None, hbm_budget_bytes=None,
                                  cache_bytes_per_slot=None,
-                                 block_sizes=None, draft_lens=None):
+                                 block_sizes=None, draft_lens=None,
+                                 tp_degrees=None, num_heads=None):
     """Decode-engine candidates (`paddle_tpu.generation`): the slot
-    count, and optionally the paged-KV block size and speculative
-    draft length.
+    count, and optionally the paged-KV block size, speculative draft
+    length, and tensor-parallel degree (`paddle_tpu.tp_serving`).
 
     More slots amortize the per-step weight read over more tokens
     (the decode step is memory-bound — `analysis.perf
     .decode_step_cost`) but grow the KV cache linearly and the
     per-request ITL with it; small blocks waste fewer tail rows but
     fragment the pool's DMA stream; longer drafts amortize more verify
-    calls but burn more on rejection.  All workload-dependent, so they
+    calls but burn more on rejection; higher ``tp`` divides the
+    per-chip weight and KV reads but pays two all-reduces per layer on
+    ICI (`decode_step_cost(tp=...)`).  All workload-dependent, so they
     are MEASURED.  The first candidate is the caller's default
     (search_step baseline contract) — with extra axes given, the cross
     product is ordered slots-major with the first value of each axis
     first.  Candidates whose cache would exceed ``hbm_budget_bytes``
     (when both budget and ``cache_bytes_per_slot`` are given) are
     dropped up front — never compiled, like the static prune in
-    `search`."""
+    `search`; the per-chip cache footprint divides by ``tp`` (heads-
+    sharded pool).  ``tp`` degrees that do not divide ``num_heads``
+    (when given) are likewise dropped."""
     out, seen = [], set()
     bss = [None] if not block_sizes else [int(b) for b in block_sizes]
     dls = [None] if draft_lens is None else [int(d) for d in draft_lens]
+    tps = [None] if tp_degrees is None else [int(t) for t in tp_degrees]
     for s in slot_counts:
         s = int(s)
         if s <= 0 or s in seen:
             continue
-        if (hbm_budget_bytes is not None
-                and cache_bytes_per_slot is not None
-                and s * cache_bytes_per_slot > hbm_budget_bytes):
-            continue
         seen.add(s)
         for bs in bss:
             for dl in dls:
-                params = {"slots": s}
-                label = "slots%d" % s
-                if max_len is not None:
-                    params["max_len"] = int(max_len)
-                if bs is not None:
-                    if bs <= 0:
+                for tp in tps:
+                    if tp is not None:
+                        if tp <= 0:
+                            continue
+                        if num_heads is not None and num_heads % tp:
+                            continue
+                    if (hbm_budget_bytes is not None
+                            and cache_bytes_per_slot is not None
+                            and s * cache_bytes_per_slot / (tp or 1)
+                            > hbm_budget_bytes):
                         continue
-                    params["block_size"] = bs
-                    label += "_bs%d" % bs
-                if dl is not None:
-                    if dl < 0:
-                        continue
-                    params["draft_len"] = dl
-                    label += "_k%d" % dl
-                out.append(Candidate("generation", params, label=label))
+                    params = {"slots": s}
+                    label = "slots%d" % s
+                    if max_len is not None:
+                        params["max_len"] = int(max_len)
+                    if bs is not None:
+                        if bs <= 0:
+                            continue
+                        params["block_size"] = bs
+                        label += "_bs%d" % bs
+                    if dl is not None:
+                        if dl < 0:
+                            continue
+                        params["draft_len"] = dl
+                        label += "_k%d" % dl
+                    if tp is not None:
+                        params["tp"] = tp
+                        label += "_tp%d" % tp
+                    out.append(Candidate("generation", params,
+                                         label=label))
     return out

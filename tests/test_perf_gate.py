@@ -418,3 +418,81 @@ def test_generation_paged_decode_kv_bytes_beat_dense():
     # serialization carries the paged fields for the report pipeline
     d = paged.to_dict()
     assert d["paged"] is True and d["block_size"] == 16
+
+
+def test_generation_tp_decode_comm_closed_form():
+    """PR-18 gate: `decode_step_cost(tp=...)` prices one chip of the
+    tensor-parallel decode.  The per-step wire bytes are the Megatron
+    two-all-reduces-per-layer closed form
+    ``2 * L * ringfactor(tp) * slots * h * dtype`` — at tp=2 the ring
+    factor ``2(N-1)/N`` is exactly 1, so ``comm_bytes`` must equal
+    ``2*L*slots*h*dtype`` to the byte (the same number
+    `TPGenerationEngine.decode_hlo_comm_check` pins against compiled
+    HLO in tests/test_tp_serving.py)."""
+    from paddle_tpu.analysis.perf import ChipSpec, decode_step_cost
+
+    chip = ChipSpec("pinned", 197e12, 819e9, ici_bw=4.5e10)
+    shape = dict(num_layers=4, hidden_size=256, num_heads=4,
+                 vocab_size=8000, intermediate_size=1024, slots=8,
+                 cache_len=512, chip=chip)
+    base = decode_step_cost(**shape)
+    assert base.tp == 1 and base.comm_bytes == 0
+
+    tp2 = decode_step_cost(tp=2, **shape)
+    assert tp2.comm_bytes == 2 * 4 * 8 * 256 * 4       # 2·L·slots·h·4
+    # tp=4 pays the 2(N-1)/N = 1.5 ring factor on the same payload
+    tp4 = decode_step_cost(tp=4, **shape)
+    assert tp4.comm_bytes == 1.5 * tp2.comm_bytes
+    # sharding divides the per-chip KV read and layer weights...
+    assert tp2.kv_read_bytes * 2 == base.kv_read_bytes
+    assert tp2.bytes < base.bytes
+    # ...but never the replicated embedding/LM-head read
+    assert tp2.bytes > base.bytes / 2
+    # validation and serialization
+    with pytest.raises(ValueError):
+        decode_step_cost(tp=3, **shape)                # 4 heads % 3
+    d = tp2.to_dict()
+    assert d["tp"] == 2 and d["comm_bytes"] == tp2.comm_bytes
+    # an ICI-starved chip must flip the binding term to "ici"
+    starved = decode_step_cost(
+        tp=2, **{**shape, "chip": ChipSpec("starved", 197e12, 819e9,
+                                           ici_bw=1e3)})
+    assert starved.bound == "ici"
+    assert starved.time_s >= tp2.time_s
+
+
+def test_disagg_decode_worker_never_prefills():
+    """PR-18 role-separation gate: in a `tp_serving.DisaggPair`, the
+    decode worker adopts prefilled KV (`inject_prefilled`) and decodes
+    — its prefill buckets stay at jit-cache size 0 for the life of the
+    process, and the prefill worker symmetrically never traces the
+    decode step.  This is the executable-set pin the DistServe split
+    exists to buy: phase isolation you can assert, not just hope for."""
+    import numpy as np
+
+    import paddle_tpu
+    from paddle_tpu.fluid import dygraph
+
+    gen = paddle_tpu.generation
+    tps = paddle_tpu.tp_serving
+    cfg = models.TransformerLMConfig.tiny()
+    with dygraph.guard():
+        np.random.seed(0)
+        lm = models.TransformerLM(cfg)
+    kw = dict(max_len=64, prefill_buckets=[8], max_queue=32,
+              block_size=16, kv_blocks=10)
+    pair = tps.DisaggPair(gen.GenerationEngine(lm, slots=2, **kw),
+                          gen.GenerationEngine(lm, slots=2, **kw))
+    handles = [pair.submit(gen.GenerationRequest(
+        [1 + i, 2, 3], max_new_tokens=3)) for i in range(3)]
+    pair.run_until_idle()
+    for h in handles:
+        assert len(h.result(timeout=30.0)) == 3
+    dex = pair.decode.stats()["executables"]
+    assert dex["decode_step"] == 1
+    assert all(v == 0 for v in dex["prefill"].values()), (
+        "decode worker traced a prefill bucket: %r" % dex)
+    pex = pair.prefill.stats()["executables"]
+    assert pex["decode_step"] == 0, (
+        "prefill worker traced the decode step: %r" % pex)
+    assert pex["prefill"][8] == 1

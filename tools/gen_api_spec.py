@@ -60,6 +60,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.tune",
     "paddle_tpu.generation",
     "paddle_tpu.rl",
+    "paddle_tpu.tp_serving",
 ]
 
 

@@ -8,6 +8,10 @@ behind an HTTP front (`serving.serve_generation_http`, or
     kv                                   # condensed paged-KV gauges per
                                          # replica: pool fill, prefix hit
                                          # rate, speculative acceptance
+    tp                                   # model-parallel gauges: shard
+                                         # groups (membership, queue
+                                         # depth, KV-transfer bytes) and
+                                         # per-replica TP degree
     generate --prompt "1,2,3" [--max-new N] [--temperature T]
              [--top-k K] [--top-p P] [--seed S] [--no-stream]
     smoke    [--requests N] [--max-new M] [--concurrency C]
@@ -154,6 +158,57 @@ def cmd_kv(args):
     return 0
 
 
+def cmd_tp(args):
+    """Model-parallel view off /stats (`paddle_tpu.tp_serving`): one
+    row per shard group — membership (prefill/decode engine names),
+    per-group decode queue depth and headroom, cumulative KV-transfer
+    bytes, and the decode worker's prefill-executable pin — plus the
+    TP degree of any tensor-parallel replica in a plain fleet."""
+    code, payload = _get_json(args.endpoint, "/stats")
+    if code != 200:
+        print(json.dumps(payload), file=sys.stderr)
+        return 1
+    groups = []
+    for g in payload.get("shard_groups", []):
+        row = {"group": g.get("group_id"),
+               "members": g.get("members"),
+               "roles": g.get("roles"),
+               "handoffs": g.get("handoffs"),
+               "kv_transfer_bytes": g.get("kv_transfer_bytes"),
+               "queue_depth": g.get("queue_depth"),
+               "free_decode_slots": g.get("free_decode_slots"),
+               "headroom": g.get("headroom"),
+               "prefill_executables": g.get("prefill_executables")}
+        if "tp" in g:
+            row["tp"] = g["tp"].get("degree")
+        groups.append(row)
+    replicas = []
+    for r in payload.get("replicas", []):
+        if "tp" in r:
+            replicas.append({"replica": r.get("replica_id"),
+                             "tp": r["tp"].get("degree"),
+                             "kv_heads_per_shard":
+                                 r["tp"].get("kv_heads_per_shard")})
+    out = {"shard_groups": groups, "tp_replicas": replicas,
+           "kv_transfer_bytes": payload.get("kv_transfer_bytes", 0)}
+    if args.json:
+        print(json.dumps(out))
+    elif not groups and not replicas:
+        print("no shard groups or tensor-parallel replicas at %s"
+              % args.endpoint)
+    else:
+        for row in groups:
+            print("group %s: %s" % (
+                row["group"],
+                " ".join("%s=%s" % kv for kv in row.items()
+                         if kv[0] != "group")))
+        for row in replicas:
+            print("replica %s: tp=%s kv_heads_per_shard=%s"
+                  % (row["replica"], row["tp"],
+                     row["kv_heads_per_shard"]))
+    return 0
+
+
 def cmd_generate(args):
     body = {
         "prompt": [int(t) for t in args.prompt.split(",")],
@@ -237,6 +292,7 @@ def main(argv=None):
     sub = ap.add_subparsers(dest="cmd", required=True)
     sub.add_parser("stats")
     sub.add_parser("kv")
+    sub.add_parser("tp")
     g = sub.add_parser("generate")
     g.add_argument("--prompt", required=True,
                    help="comma-separated token ids")
@@ -253,7 +309,7 @@ def main(argv=None):
     s.add_argument("--prompt-vocab", type=int, default=100)
     args = ap.parse_args(argv)
     try:
-        return {"stats": cmd_stats, "kv": cmd_kv,
+        return {"stats": cmd_stats, "kv": cmd_kv, "tp": cmd_tp,
                 "generate": cmd_generate,
                 "smoke": cmd_smoke}[args.cmd](args)
     except Exception as e:
