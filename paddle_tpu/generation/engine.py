@@ -67,6 +67,7 @@ import itertools
 import queue
 import threading
 import time
+from collections import deque
 
 import jax
 import jax.numpy as jnp
@@ -159,7 +160,7 @@ class RequestHandle:
     engine's preempt-on-pool-exhaustion path both re-run the request
     from scratch; a consumer discards what it saw before)."""
 
-    def __init__(self, request):
+    def __init__(self, request, trace=None):
         self.request = request
         self._q = queue.Queue()
         self._done = threading.Event()
@@ -170,12 +171,22 @@ class RequestHandle:
         self.requeued = False          # fleet's requeue-once latch
         self.t_submit = time.perf_counter()
         self.t_first_token = None
+        # the cross-process trace context: ONE per request, created at
+        # first submission and carried by the handle thereafter — the
+        # fleet requeue path re-attaches THIS handle, so death ->
+        # requeue -> restart land on the original trace_id
+        self.trace = trace if trace is not None else _trace.TraceContext()
+        self._sink = None              # engine's per-request record sink
 
     # -- engine side ------------------------------------------------------
     def _emit(self, index, token, logprob=None):
         if index == 0:
             self.t_first_token = time.perf_counter()
         self._tokens.append(int(token))
+        tr = _trace.default_tracer()
+        if tr.enabled:
+            tr.async_instant("token", self.trace.trace_id,
+                             cat="generation", args={"index": index})
         if logprob is None:
             # logprobs disabled: the event tuple (and hence the ndjson
             # stream upstream) is byte-identical to a pre-logprob engine
@@ -187,17 +198,58 @@ class RequestHandle:
     def _restart(self):
         self._tokens = []
         self._logprobs = []
+        tr = _trace.default_tracer()
+        if tr.enabled:
+            tr.async_instant("restart", self.trace.trace_id,
+                             cat="generation")
         self._q.put(("restart", None, None))
 
     def _finish(self, reason):
         self.finish_reason = reason
+        self._record("ok", reason=reason)
+        tr = _trace.default_tracer()
+        if tr.enabled:
+            tr.async_end("request", self.trace.trace_id,
+                         cat="generation", args={"reason": reason})
         self._q.put(("done", reason, None))
         self._done.set()
 
     def _fail(self, error):
         self.error = str(error)
+        self._record("error", error=str(error))
+        tr = _trace.default_tracer()
+        if tr.enabled:
+            tr.async_end("request", self.trace.trace_id,
+                         cat="generation", args={"error": str(error)})
         self._q.put(("error", str(error), None))
         self._done.set()
+
+    def _record(self, outcome, **extra):
+        """Build + sink the per-request SLO record (`observability.slo`
+        schema).  t_submit spans requeues — TTFT after a replica death
+        is honest end-to-end latency, not the replacement's view."""
+        now = time.perf_counter()
+        n = len(self._tokens)
+        ttft = ((self.t_first_token - self.t_submit) * 1e3
+                if self.t_first_token is not None else None)
+        itl = ((now - self.t_first_token) * 1e3 / (n - 1)
+               if n > 1 and self.t_first_token is not None else None)
+        rec = {"request_id": self.request.request_id,
+               "trace_id": self.trace.trace_id,
+               "t_wall": time.time(),
+               "outcome": outcome,
+               "ttft_ms": ttft,
+               "itl_ms": itl,
+               "n_tokens": n,
+               "duration_ms": (now - self.t_submit) * 1e3}
+        rec.update(extra)
+        sink = self._sink
+        if sink is not None:
+            try:
+                sink(rec)
+            except Exception:
+                pass
+        return rec
 
     # -- caller side ------------------------------------------------------
     def events(self, timeout=30.0):
@@ -300,7 +352,8 @@ class GenerationEngine:
                  metrics_registry=None, step_hook=None, donate=None,
                  logprobs=False, paged=True, block_size=16,
                  kv_blocks=None, prefix_cache=False, prefill_chunk=None,
-                 kv_dtype=None, draft_model=None, draft_len=0):
+                 kv_dtype=None, draft_model=None, draft_len=0,
+                 request_sink=None):
         cfg = model.cfg
         self.model = model
         self.cfg = cfg
@@ -377,6 +430,11 @@ class GenerationEngine:
         self._step_hook = step_hook
         self.on_death = None           # fleet requeue hook
         self._t0 = time.perf_counter()
+        # per-request SLO records: a bounded local ring (the sentinel's
+        # live window) plus an optional forwarding sink (the fleet's
+        # SLOEngine.record)
+        self._request_sink = request_sink
+        self._recent = deque(maxlen=256)
         # donation only where the backend implements it (CPU warns)
         if donate is None:
             donate = jax.default_backend() in ("tpu", "gpu")
@@ -907,9 +965,28 @@ class GenerationEngine:
                     "all %d slots busy and %d requests queued"
                     % (self.slots, len(self._pending)))
                 self._m_shed.labels(self._engine, err.reason).inc()
+                self._record_request({
+                    "request_id": request.request_id, "trace_id": None,
+                    "t_wall": time.time(), "outcome": "shed",
+                    "ttft_ms": None, "itl_ms": None, "n_tokens": 0,
+                    "duration_ms": 0.0})
                 raise err
             handle = _handle if _handle is not None \
                 else RequestHandle(request)
+            handle._sink = self._record_request
+            tr = _trace.default_tracer()
+            if tr.enabled:
+                tid = handle.trace.trace_id
+                if _handle is not None:
+                    # requeue-after-death: SAME trace_id — the merged
+                    # timeline shows death -> requeue -> restart on one
+                    # track
+                    tr.async_instant("requeue", tid, cat="generation",
+                                     args={"engine": self._engine})
+                else:
+                    tr.async_begin("request", tid, cat="generation",
+                                   args={"request_id": request.request_id})
+                tr.async_begin("queue", tid, cat="generation")
             self._pending.append((request, handle))
             self._m_requests.inc()
             self._m_queue.set(len(self._pending))
@@ -934,6 +1011,24 @@ class GenerationEngine:
             return 0.0
         return tot / elapsed if elapsed > 0 else 0.0
 
+    def _record_request(self, rec):
+        """Sink for per-request SLO records (handles call this as their
+        ``_sink``): stamp the engine, keep a bounded local window, and
+        forward to the configured ``request_sink`` (the fleet's
+        `SLOEngine.record`).  Never raises into the serving path."""
+        rec = dict(rec, engine=self._engine)
+        self._recent.append(rec)
+        sink = self._request_sink
+        if sink is not None:
+            try:
+                sink(rec)
+            except Exception:
+                pass
+
+    def recent_requests(self):
+        """Snapshot of the bounded per-request record window."""
+        return list(self._recent)
+
     # -- scheduler ---------------------------------------------------------
     def step(self):
         """One scheduler iteration: advance every mid-flight chunked
@@ -952,6 +1047,10 @@ class GenerationEngine:
                 entry, handle = self._pending.pop(0)
                 slot = self._free.pop(0)
                 self._m_queue.set(len(self._pending))
+                tr = _trace.default_tracer()
+                if tr.enabled:
+                    tr.async_end("queue", handle.trace.trace_id,
+                                 cat="generation")
                 # an entry is either a raw GenerationRequest (prefill
                 # here) or a KVHandoff from a prefill worker (adopt the
                 # finished pages — decode-only workers never prefill)
@@ -967,6 +1066,9 @@ class GenerationEngine:
                             c is not None for c in self._chunking):
                         self._pending.insert(0, (entry, handle))
                         self._m_queue.set(len(self._pending))
+                        if tr.enabled:
+                            tr.async_begin("queue", handle.trace.trace_id,
+                                           cat="generation")
                     else:
                         handle._fail(
                             "kv pool exhausted: request %s needs more "
@@ -1023,6 +1125,12 @@ class GenerationEngine:
             self._release_blocks(slot)
             return False
         if n_cached > 0 or self.prefill_chunk is not None:
+            tr = _trace.default_tracer()
+            if tr.enabled:
+                tr.async_begin("prefill", handle.trace.trace_id,
+                               cat="generation",
+                               args={"chunked": True,
+                                     "prefix_cached": n_cached})
             self._chunking[slot] = _ChunkState(
                 request, handle, n_cached, key, time.perf_counter())
             self._chunk_step(slot)
@@ -1033,9 +1141,14 @@ class GenerationEngine:
         tokens[0, :n_prompt] = request.prompt_ids
         table = self.cache.table_row(slot)[None].astype(np.int32)
         t0 = time.perf_counter()
+        tr = _trace.default_tracer()
+        if tr.enabled:
+            tr.async_begin("prefill", handle.trace.trace_id,
+                           cat="generation", args={"bucket": bucket})
         with _trace.span("generation.prefill", cat="generation",
-                         args={"bucket": bucket, "slot": slot,
-                               "request_id": request.request_id}):
+                         args={"bucket": bucket, "slot": int(slot),
+                               "request_id": request.request_id},
+                         trace_id=handle.trace.trace_id):
             with _TRACE_LOCK:
                 out = self._prefill_fns[bucket](
                     self._params, *self.cache.arrays(), tokens,
@@ -1046,6 +1159,9 @@ class GenerationEngine:
         tok0 = int(out[self._nc])
         lp0 = float(out[self._nc + 1]) if self.return_logprobs else None
         self._m_prefill_ms.observe((time.perf_counter() - t0) * 1e3)
+        if tr.enabled:
+            tr.async_end("prefill", handle.trace.trace_id,
+                         cat="generation")
         self._activate(slot, request, handle, tok0, lp0, key)
         return True
 
@@ -1056,9 +1172,14 @@ class GenerationEngine:
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n_prompt] = request.prompt_ids
         t0 = time.perf_counter()
+        tr = _trace.default_tracer()
+        if tr.enabled:
+            tr.async_begin("prefill", handle.trace.trace_id,
+                           cat="generation", args={"bucket": bucket})
         with _trace.span("generation.prefill", cat="generation",
-                         args={"bucket": bucket, "slot": slot,
-                               "request_id": request.request_id}):
+                         args={"bucket": bucket, "slot": int(slot),
+                               "request_id": request.request_id},
+                         trace_id=handle.trace.trace_id):
             with _TRACE_LOCK:
                 out = self._prefill_fns[bucket](
                     self._params, self.cache.k, self.cache.v, tokens,
@@ -1069,6 +1190,9 @@ class GenerationEngine:
         lp0 = float(out[3]) if self.return_logprobs else None
         self.cache.update(k2, v2)
         self._m_prefill_ms.observe((time.perf_counter() - t0) * 1e3)
+        if tr.enabled:
+            tr.async_end("prefill", handle.trace.trace_id,
+                         cat="generation")
         self._activate(slot, request, handle, int(tok0), lp0, key)
 
     def _chunk_step(self, slot):
@@ -1100,7 +1224,7 @@ class GenerationEngine:
         table = self.cache.table_row(slot)[None].astype(np.int32)
         last = cs.pos + c_real >= n_prompt
         with _trace.span("generation.prefill_chunk", cat="generation",
-                         args={"width": width, "slot": slot, "pos": cs.pos,
+                         args={"width": width, "slot": int(slot), "pos": cs.pos,
                                "request_id": request.request_id}):
             with _TRACE_LOCK:
                 out = self._chunk_fns[width](
@@ -1117,6 +1241,10 @@ class GenerationEngine:
             self._chunking[slot] = None
             self._m_prefill_ms.observe(
                 (time.perf_counter() - cs.t0) * 1e3)
+            tr = _trace.default_tracer()
+            if tr.enabled:
+                tr.async_end("prefill", handle.trace.trace_id,
+                             cat="generation")
             self._activate(slot, request, handle, tok0, lp0, cs.key)
 
     def _activate(self, slot, request, handle, tok0, lp0, key):
@@ -1328,6 +1456,13 @@ class GenerationEngine:
         self._affected_on_death = affected
         _trace.instant("generation.engine_death", cat="generation",
                        args={"engine": self._engine, "why": why})
+        tr = _trace.default_tracer()
+        if tr.enabled:
+            for h in affected:
+                tr.async_instant("replica_death", h.trace.trace_id,
+                                 cat="generation",
+                                 args={"engine": self._engine,
+                                       "why": why})
         if self.on_death is not None:
             self.on_death(self, affected)
         else:
@@ -1393,20 +1528,34 @@ class GenerationEngine:
             self._thread = None
 
     # -- disaggregated prefill/decode (paddle_tpu.tp_serving.disagg) ------
-    def prefill_extract(self, request):
+    def prefill_extract(self, request, trace=None):
         """PREFILL-ROLE half of the DistServe split: run ONE prefill
         for ``request`` (whole-prompt flash path), lift the finished KV
         pages + first token off the engine, release the slot, and
         return the `tp_serving.disagg.KVHandoff` a decode-role engine
         ingests with `inject_prefilled`.  Never touches the decode
         executable — a prefill worker's executable set is its prefill
-        buckets only."""
+        buckets only.
+
+        ``trace``: optional `TraceContext` (or its wire dict) — the
+        prefill span + handoff-begin land on that request's track, and
+        the handoff carries the context to the decode worker."""
         from ..tp_serving.disagg import KVHandoff
 
         if not self.paged:
             raise ValueError("prefill_extract requires paged=True")
         if not isinstance(request, GenerationRequest):
             request = GenerationRequest(request)
+        tc = _trace.TraceContext.from_wire(trace)
+        fresh_trace = tc is None
+        if fresh_trace:
+            tc = _trace.TraceContext()
+        tr0 = _trace.default_tracer()
+        if fresh_trace and tr0.enabled:
+            # this prefill opens the request's track (no upstream front
+            # began it)
+            tr0.async_begin("request", tc.trace_id, cat="generation",
+                            args={"request_id": request.request_id})
         sp = request.sampling
         n_prompt = len(request.prompt_ids)
         key = make_base_key(sp.seed).astype(np.uint32)
@@ -1429,6 +1578,11 @@ class GenerationEngine:
             tokens[0, :n_prompt] = request.prompt_ids
             table = self.cache.table_row(slot)[None].astype(np.int32)
             t0 = time.perf_counter()
+            tr = _trace.default_tracer()
+            if tr.enabled:
+                tr.async_begin("prefill", tc.trace_id, cat="generation",
+                               args={"bucket": bucket,
+                                     "engine": self._engine})
             with _TRACE_LOCK:
                 out = self._prefill_fns[bucket](
                     self._params, *self.cache.arrays(), tokens,
@@ -1440,16 +1594,23 @@ class GenerationEngine:
             lp0 = (float(out[self._nc + 1]) if self.return_logprobs
                    else None)
             self._m_prefill_ms.observe((time.perf_counter() - t0) * 1e3)
+            if tr.enabled:
+                tr.async_end("prefill", tc.trace_id, cat="generation")
             idx = np.asarray(self._slot_blocks[slot], np.int32)
             pages = tuple(np.asarray(a[:, idx])
                           for a in self.cache.arrays())
             self._release_blocks(slot)
             self._free.append(slot)
-        return KVHandoff(
+        handoff = KVHandoff(
             request=request, n_prompt=n_prompt, tok0=tok0, lp0=lp0,
             key=np.asarray(key), pages=pages,
             block_size=self.block_size,
-            kv_dtype=self.cache.kv_dtype)
+            kv_dtype=self.cache.kv_dtype,
+            trace=tc.child("prefill").to_wire())
+        if tr.enabled:
+            tr.async_begin("handoff", tc.trace_id, cat="generation",
+                           args={"bytes": handoff.nbytes})
+        return handoff
 
     def inject_prefilled(self, handoff, _handle=None):
         """DECODE-ROLE half: queue a `KVHandoff` for adoption into this
@@ -1485,9 +1646,24 @@ class GenerationEngine:
                     "requests queued"
                     % (self._engine, self.slots, len(self._pending)))
                 self._m_shed.labels(self._engine, err.reason).inc()
+                self._record_request({
+                    "request_id": handoff.request.request_id,
+                    "trace_id": None, "t_wall": time.time(),
+                    "outcome": "shed", "ttft_ms": None, "itl_ms": None,
+                    "n_tokens": 0, "duration_ms": 0.0})
                 raise err
             handle = _handle if _handle is not None \
-                else RequestHandle(handoff.request)
+                else RequestHandle(
+                    handoff.request,
+                    trace=_trace.TraceContext.from_wire(
+                        getattr(handoff, "trace", None)))
+            handle._sink = self._record_request
+            tr = _trace.default_tracer()
+            if tr.enabled:
+                tid = handle.trace.trace_id
+                tr.async_end("handoff", tid, cat="generation",
+                             args={"engine": self._engine})
+                tr.async_begin("queue", tid, cat="generation")
             self._pending.append((handoff, handle))
             self._m_requests.inc()
             self._m_queue.set(len(self._pending))
@@ -1518,6 +1694,12 @@ class GenerationEngine:
             jnp.asarray(a).at[:, idx].set(page)
             for a, page in zip(self.cache.arrays(), handoff.pages))
         self.cache.update(*arrays)
+        tr = _trace.default_tracer()
+        if tr.enabled:
+            tr.async_instant("inject", handle.trace.trace_id,
+                             cat="generation",
+                             args={"slot": int(slot),
+                                   "blocks": n_blocks})
         self._activate(slot, handoff.request, handle, handoff.tok0,
                        handoff.lp0, handoff.key)
         return True

@@ -73,6 +73,13 @@ class FaultPlan:
           either way the router must detect the death and re-queue the
           in-flight group exactly once.  Replica events are addressed
           by replica INDEX, independent of this process's rank.
+      {"kind": "stall_replica", "replica": i, "step": n,
+       "seconds": 0.2}
+          serving latency drill: generation replica i stalls ONCE for
+          `seconds` (float) before decode step n (1-based) — the
+          injected tail-latency event the SLO engine must catch (ITL
+          alert fires) and clear once clean traffic resumes
+          (`replica_stall`).
 
     Every event also takes `"gen": g` (default 0): it fires only in
     that elastic generation, so a drill's fault does not re-fire in
@@ -160,6 +167,19 @@ class FaultPlan:
         n = self.replica_kill_request(replica_index)
         if n is not None and int(request_count) >= n:
             os.kill(os.getpid(), signal.SIGKILL)
+
+    def replica_stall(self, replica_index):
+        """The ``(decode_step, seconds)`` at which generation replica
+        `replica_index` stalls once (None: never) — the injected-
+        latency SLO drill (`serving.generation.GenerationReplica`
+        sleeps in its step hook)."""
+        for e in self.events:
+            if (e.get("kind") == "stall_replica"
+                    and int(e.get("replica", -1)) == int(replica_index)
+                    and int(e.get("gen", 0)) == self.generation):
+                return (int(e.get("step", 1)),
+                        float(e.get("seconds", 0.1)))
+        return None
 
     # -- FS-seam faults ---------------------------------------------------
     def wrap_fs(self, fs=None):

@@ -57,17 +57,23 @@ from .system import SystemMetricsSampler  # noqa: F401
 # The instrumented hot paths load the (stdlib-only) modules once at
 # first use — first timed step / Executor.run / served request — not
 # at package import.
-_LAZY_MODULES = ("trace", "flight_recorder", "xla_cost")
+_LAZY_MODULES = ("trace", "flight_recorder", "xla_cost", "slo")
 _LAZY_NAMES = {
     # name -> submodule it lives in
     "Tracer": "trace",
+    "TraceContext": "trace",
     "default_tracer": "trace",
     "enable_tracing": "trace",
     "disable_tracing": "trace",
     "tracing_enabled": "trace",
     "trace_span": "trace",
     "merge_traces": "trace",
+    "merge_fleet_trace": "trace",
     "load_trace": "trace",
+    "Objective": "slo",
+    "SLOEngine": "slo",
+    "RegressionSentinel": "slo",
+    "default_objectives": "slo",
     "FlightRecorder": "flight_recorder",
     "install_flight_recorder": "flight_recorder",
     "cost_of_jitted": "xla_cost",
@@ -107,15 +113,22 @@ __all__ = [
     "record_compile",
     "SystemMetricsSampler",
     # lazy (PEP 562): the tracing / crash-forensics / cost-attribution
-    # surface — see trace.py, flight_recorder.py, xla_cost.py
+    # / SLO surface — see trace.py, flight_recorder.py, xla_cost.py,
+    # slo.py
     "Tracer",
+    "TraceContext",
     "default_tracer",
     "enable_tracing",
     "disable_tracing",
     "tracing_enabled",
     "trace_span",
     "merge_traces",
+    "merge_fleet_trace",
     "load_trace",
+    "Objective",
+    "SLOEngine",
+    "RegressionSentinel",
+    "default_objectives",
     "FlightRecorder",
     "install_flight_recorder",
     "cost_of_jitted",

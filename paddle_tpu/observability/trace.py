@@ -45,6 +45,7 @@ from collections import deque
 
 __all__ = [
     "Tracer",
+    "TraceContext",
     "default_tracer",
     "enable_tracing",
     "disable_tracing",
@@ -56,6 +57,7 @@ __all__ = [
     "current_trace_id",
     "new_trace_id",
     "merge_traces",
+    "merge_fleet_trace",
     "load_trace",
 ]
 
@@ -428,6 +430,63 @@ def new_trace_id(prefix="tr"):
     return "%s-%d-%d" % (prefix, os.getpid(), next(_trace_seq))
 
 
+class TraceContext:
+    """The serializable trace context a request carries ACROSS
+    processes: trace id + parent span name + the originating process's
+    wall/mono anchor pair.
+
+    The anchor is what makes a cross-process timeline honest: each
+    worker stamps events on its own monotonic clock, and
+    `merge_fleet_trace` aligns shards on the wall clock via their
+    anchors — the context carries the ORIGIN anchor so even a shard
+    that never built a Tracer can be placed on the request's timeline.
+
+    Wire format (`to_wire()`) is a plain dict — JSON- and pickle-safe,
+    so it rides the replica pipe protocol, `KVHandoff`, and HTTP
+    headers alike:
+
+        {"trace_id": "req-123-7", "parent": "queue",
+         "anchor_unix_time": 1723.0, "anchor_clock": 41.2}
+    """
+
+    __slots__ = ("trace_id", "parent", "anchor")
+
+    def __init__(self, trace_id=None, parent=None, anchor=None):
+        self.trace_id = trace_id or new_trace_id("req")
+        self.parent = parent
+        self.anchor = tuple(anchor) if anchor is not None \
+            else _default.anchor
+
+    def child(self, parent):
+        """Same trace id / anchor, new parent span name — what a stage
+        hands to the next stage."""
+        return TraceContext(self.trace_id, parent=parent,
+                            anchor=self.anchor)
+
+    def to_wire(self):
+        d = {"trace_id": self.trace_id,
+             "anchor_unix_time": float(self.anchor[0]),
+             "anchor_clock": float(self.anchor[1])}
+        if self.parent is not None:
+            d["parent"] = self.parent
+        return d
+
+    @classmethod
+    def from_wire(cls, wire):
+        """None / TraceContext / wire dict -> TraceContext or None."""
+        if wire is None or isinstance(wire, cls):
+            return wire
+        anchor = None
+        if "anchor_unix_time" in wire and "anchor_clock" in wire:
+            anchor = (wire["anchor_unix_time"], wire["anchor_clock"])
+        return cls(wire.get("trace_id"), parent=wire.get("parent"),
+                   anchor=anchor)
+
+    def __repr__(self):
+        return "TraceContext(%r, parent=%r)" % (self.trace_id,
+                                                self.parent)
+
+
 # ---------------------------------------------------------------------------
 # load / merge (the fleet-timeline side)
 # ---------------------------------------------------------------------------
@@ -484,3 +543,73 @@ def merge_traces(shards, align=True):
     out.sort(key=lambda e: (e.get("ts", 0), e.get("ph") != "M"))
     return {"traceEvents": out, "displayTimeUnit": "ms",
             "metadata": {"merged_shards": len(shards)}}
+
+
+def _event_matches_trace(ev, trace_id):
+    if ev.get("ph") == "M":
+        return True              # track names stay: they label the merge
+    if ev.get("id") == trace_id:
+        return True              # async request-timeline events
+    args = ev.get("args")
+    if not args:
+        return False
+    if args.get("trace_id") == trace_id:
+        return True
+    ids = args.get("trace_ids")
+    return bool(ids) and trace_id in ids
+
+
+def merge_fleet_trace(shards, trace_id=None, out_path=None):
+    """One request, one timeline: merge per-worker shards (prefill
+    worker, decode worker, front) into a single anchor-aligned chrome
+    trace, optionally filtered to one trace id.
+
+    shards: a list whose items may be
+      * ``(pid, events, metadata)`` tuples (the `merge_traces` form),
+      * chrome-trace dicts (``Tracer.chrome_trace()`` output / what a
+        worker answers to a ``("trace",)`` pipe frame),
+      * paths to saved traces (via `load_trace`).
+    Dict/path shards use their metadata ``pid`` (falling back to the
+    shard's position) as the merged track id.
+
+    trace_id: keep only events on that request's track — async events
+    keyed by the id plus spans whose args carry ``trace_id`` /
+    ``trace_ids``; ``ph:"M"`` track metadata always survives.
+
+    Returns the merged chrome-trace object (metadata records the
+    trace_id filter and whether anchors aligned every shard); saves it
+    to `out_path` when given.
+    """
+    norm = []
+    for i, sh in enumerate(shards):
+        if isinstance(sh, tuple) and len(sh) == 3:
+            norm.append(sh)
+            continue
+        if isinstance(sh, (str, os.PathLike)):
+            events, md = load_trace(sh)
+        elif isinstance(sh, dict):
+            events, md = sh.get("traceEvents", []), sh.get("metadata") or {}
+        else:
+            raise TypeError("shard %d: expected (pid, events, metadata) "
+                            "tuple, chrome-trace dict, or path; got %r"
+                            % (i, type(sh).__name__))
+        norm.append((md.get("pid", i), events, md))
+    aligned = all(
+        md and "anchor_unix_time" in md and "anchor_clock" in md
+        for _, _, md in norm)
+    merged = merge_traces(norm, align=True)
+    if trace_id is not None:
+        merged["traceEvents"] = [
+            ev for ev in merged["traceEvents"]
+            if _event_matches_trace(ev, trace_id)]
+        merged["metadata"]["trace_id"] = trace_id
+    merged["metadata"]["aligned"] = aligned
+    if out_path is not None:
+        out_path = os.fspath(out_path)
+        d = os.path.dirname(out_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        opener = gzip.open if out_path.endswith(".gz") else open
+        with opener(out_path, "wt") as f:
+            json.dump(merged, f)
+    return merged

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 
 import numpy as np
 
@@ -51,6 +52,8 @@ from .admission import ShedError
 __all__ = [
     "GenerationFleet",
     "GenerationReplica",
+    "handle_slo",
+    "handle_trace",
     "parse_generation_request",
     "serve_generation_http",
 ]
@@ -68,8 +71,16 @@ class GenerationReplica:
 
             fault_plan = FaultPlan.from_env()
         kill_at = fault_plan.replica_kill_request(self.index)
+        stall = fault_plan.replica_stall(self.index)
+        stalled = [False]              # one-shot latch
 
         def hook(step_no):
+            if stall is not None and not stalled[0] \
+                    and step_no + 1 >= stall[0]:
+                # injected latency (the SLO drill): the decode step
+                # stalls ONCE, inflating ITL for in-flight requests
+                stalled[0] = True
+                time.sleep(stall[1])
             if kill_at is not None and step_no + 1 >= kill_at:
                 raise EngineDeadError(
                     "%s: injected death at decode step %d"
@@ -79,7 +90,8 @@ class GenerationReplica:
         # (tp_serving.TPGenerationEngine, with tp=/mesh= in kwargs)
         self.engine = (engine_cls or GenerationEngine)(
             model, name=self.replica_id,
-            step_hook=hook if kill_at is not None else None,
+            step_hook=(hook if (kill_at is not None or stall is not None)
+                       else None),
             **engine_kwargs)
 
     @property
@@ -116,12 +128,22 @@ class GenerationFleet:
 
     def __init__(self, model, replicas=1, *, name="genfleet",
                  metrics_registry=None, fault_plan=None, engine_cls=None,
-                 **engine_kwargs):
+                 slo=None, slo_objectives=None, **engine_kwargs):
         reg = metrics_registry or default_registry()
         self.metrics_registry = reg
         self.name = name
         self._fleet = unique_instance_label(name)
         self._lock = threading.RLock()
+        # the fleet's SLO engine: every replica's per-request records
+        # flow into its rolling window (GET /slo, serving_ctl slo, the
+        # regression sentinel's live summary)
+        if slo is None:
+            from ..observability.slo import SLOEngine
+
+            slo = SLOEngine(slo_objectives, registry=reg,
+                            name=self._fleet)
+        self.slo = slo
+        engine_kwargs.setdefault("request_sink", self.slo.record)
         self.replicas = []
         for i in range(int(replicas)):
             r = GenerationReplica(model, index=i, fleet_name=self._fleet,
@@ -272,6 +294,15 @@ class GenerationFleet:
             active += occ["active"]
         return (active / total) if total else 0.0
 
+    def live_summary(self):
+        """SLO-window headline numbers + the fleet's decode compile
+        count — the `RegressionSentinel.check` input."""
+        s = self.slo.live_summary()
+        s["decode_executables"] = max(
+            (r.engine._decode_cache_size() for r in self.replicas),
+            default=0)
+        return s
+
 
 # ---------------------------------------------------------------------------
 # HTTP front
@@ -367,10 +398,40 @@ def handle_generate(handler, fleet, msg):
         pass                       # client went away mid-stream
 
 
+def handle_slo(handler, slo):
+    """Answer GET /slo: evaluate the rolling window now (gauges and
+    latched alerts update as a side effect)."""
+    if slo is None:
+        handler._send(404, {"error": "no SLO engine attached"})
+        return
+    handler._send(200, slo.report())
+
+
+def handle_trace(handler, path, extra_shards=None):
+    """Answer GET /trace: this process's tracer shard (merged with any
+    ``extra_shards``, e.g. worker shards fetched over the pipe),
+    anchor-aligned, optionally filtered by ``?trace_id=``.  409 while
+    tracing is disabled — same contract as the classic InferenceServer
+    front."""
+    import urllib.parse
+
+    tr = _trace.default_tracer()
+    if not tr.enabled:
+        handler._send(409, {
+            "error": "tracing disabled; enable with "
+                     "observability.enable_tracing() or "
+                     "PADDLE_TPU_TRACE=1"})
+        return
+    qs = urllib.parse.urlparse(path).query
+    tid = (urllib.parse.parse_qs(qs).get("trace_id") or [None])[0]
+    shards = [tr.chrome_trace()] + list(extra_shards or ())
+    handler._send(200, _trace.merge_fleet_trace(shards, trace_id=tid))
+
+
 def serve_generation_http(fleet, host="127.0.0.1", port=8090, block=True):
     """The dedicated generation data plane: POST /generate (streamed or
-    not), /healthz, /readyz, /stats, /metrics.  Returns the
-    HTTPServer."""
+    not), /healthz, /readyz, /stats, /metrics, /slo, /trace.  Returns
+    the HTTPServer."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from ..inference.http_common import (
@@ -385,6 +446,12 @@ def serve_generation_http(fleet, host="127.0.0.1", port=8090, block=True):
             pass
 
         def do_GET(self):
+            if self.path.split("?", 1)[0] == "/slo":
+                handle_slo(self, getattr(fleet, "slo", None))
+                return
+            if self.path.split("?", 1)[0] == "/trace":
+                handle_trace(self, self.path)
+                return
             if not standard_get_plane(
                     self, self.path, ready_fn=fleet.ready,
                     stats_fn=fleet.stats,

@@ -13,6 +13,10 @@ Data plane (what clients and load balancers speak):
     with the reason.  This is what a fleet LB health-checks.
   * ``GET /stats`` — router.stats() JSON; ``GET /metrics`` — Prometheus
     text of the whole registry.
+  * ``GET /slo`` — the generation fleet's SLO report (404 without a
+    fleet); ``GET /trace[?trace_id=...]`` — the merged fleet timeline,
+    front-process ring + every process-worker shard, anchor-aligned
+    (409 while tracing is disabled).
 
 Admin plane (what `tools/serving_ctl.py` speaks; one JSON POST per
 lifecycle transition, GET for reads):
@@ -60,6 +64,23 @@ def serve_http(router, host="127.0.0.1", port=8080, block=True,
         install_sigterm_drain,
     )
 
+    def _worker_shards():
+        """Trace shards from every alive process-kind replica: each
+        worker answers a ("trace",) frame with its ring + anchor
+        metadata (the pipe serializes frames, so this is safe to call
+        while requests are in flight — it just queues behind them)."""
+        shards = []
+        for mv in router.registry.versions():
+            for r in mv.replicas:
+                fetch = getattr(r, "trace_shard", None)
+                if fetch is None or not r.alive:
+                    continue
+                try:
+                    shards.append(fetch())
+                except Exception:
+                    pass          # a dying worker must not 500 /trace
+        return shards
+
     class Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
         if generation_fleet is not None:
             # chunked transfer encoding needs 1.1; every plain JSON
@@ -93,6 +114,15 @@ def serve_http(router, host="127.0.0.1", port=8080, block=True,
                 self._send_text(
                     200, prometheus_text(router.metrics_registry),
                     "text/plain; version=0.0.4; charset=utf-8")
+            elif self.path.split("?", 1)[0] == "/slo":
+                from .generation import handle_slo
+
+                handle_slo(self, getattr(generation_fleet, "slo", None))
+            elif self.path.split("?", 1)[0] == "/trace":
+                from .generation import handle_trace
+
+                handle_trace(self, self.path,
+                             extra_shards=_worker_shards())
             elif self.path == "/admin/models":
                 self._send(200, router.registry.describe())
             else:

@@ -174,10 +174,44 @@ class ModelRegistry:
             return self.stable, "stable"
 
     # -- transitions (validation only; the router drives the mechanics) --
-    def promote(self, version):
+    def promote(self, version, slo_gate=None):
         """Atomic cutover: `version` becomes stable, the old stable (if
         any) moves to draining.  Returns the old stable ModelVersion or
-        None."""
+        None.
+
+        `slo_gate` (optional) is a zero-arg callable returning a verdict
+        dict — typically ``RegressionSentinel.gate(slo_engine)`` bound
+        over the canary's live window.  A verdict with a truthy
+        ``"regressed"`` or a non-empty ``"alerts"`` list REJECTS the
+        candidate (state -> rejected, `TransitionError` raised) and
+        leaves the old stable serving — the regressing-canary auto-
+        reject.  The gate runs OUTSIDE the registry lock: it may scrape
+        metrics or read SLO windows, and must not deadlock cutover.
+        """
+        if slo_gate is not None:
+            mv = self.get(version)
+            try:
+                verdict = slo_gate()
+            except Exception as e:
+                self.reject(mv, "SLO gate error: %s" % (e,))
+                raise TransitionError(
+                    "promotion of %r refused: SLO gate raised %s: %s"
+                    % (mv.version, type(e).__name__, e))
+            bad = []
+            if verdict.get("regressed"):
+                found = [f.get("metric") for f in
+                         (verdict.get("findings") or [])]
+                bad.append("regression vs baseline: %s"
+                           % (found or "see sentinel"))
+            alerts = verdict.get("alerts") or []
+            if alerts:
+                bad.append("active SLO alerts: %s" % (sorted(alerts),))
+            if bad:
+                reason = "; ".join(bad)
+                self.reject(mv, "SLO gate: %s" % reason)
+                raise TransitionError(
+                    "promotion of %r refused by SLO gate (%s); stable "
+                    "version unchanged" % (mv.version, reason))
         with self._lock:
             mv = self.get(version)
             if mv.state not in (READY,):

@@ -95,7 +95,12 @@ class Replica:
     def alive(self):
         raise NotImplementedError
 
-    def run(self, feed):
+    def run(self, feed, trace=None):
+        """Serve one padded batch.  ``trace``: optional trace wire dict
+        (a `TraceContext.to_wire()` or a batch ``{"trace_ids": [...],
+        "anchor_unix_time", "anchor_clock"}``) — process replicas ship
+        it over the pipe so the worker's spans land on the requests'
+        fleet timeline; in-process replicas share the tracer anyway."""
         raise NotImplementedError
 
     def warmup(self, specs):
@@ -134,7 +139,7 @@ class InProcessReplica(Replica):
             return list(self._pred.get_input_names())
         return None
 
-    def run(self, feed):
+    def run(self, feed, trace=None):
         if self._dead:
             raise ReplicaDeadError("%s is dead" % self.replica_id)
         self.requests_served += 1
@@ -192,9 +197,9 @@ class ShardGroupReplica(Replica):
     def feed_names(self):
         return getattr(self.members[0], "feed_names", None)
 
-    def run(self, feed):
+    def run(self, feed, trace=None):
         self.requests_served += 1
-        outs = [m.run(feed) for m in self.members]
+        outs = [m.run(feed, trace=trace) for m in self.members]
         return outs[0]
 
     def warmup(self, specs):
@@ -313,9 +318,12 @@ class ProcessReplica(Replica):
                     % (self.replica_id, self._proc.poll()))
             return reply
 
-    def run(self, feed):
+    def run(self, feed, trace=None):
         self.requests_served += 1
-        reply = self._roundtrip(("run", feed))
+        # the 2-element frame stays the wire default — a trace-less
+        # parent speaks the exact pre-trace protocol
+        msg = ("run", feed) if trace is None else ("run", feed, trace)
+        reply = self._roundtrip(msg)
         if reply[0] == "ok":
             return reply[1]
         err_type, err_msg = reply[1], reply[2]
@@ -325,6 +333,15 @@ class ProcessReplica(Replica):
 
     def warmup(self, specs):
         reply = self._roundtrip(("warmup", list(specs)))
+        if reply[0] == "ok":
+            return reply[1]
+        raise RuntimeError(reply[2])
+
+    def trace_shard(self):
+        """Fetch the worker's tracer shard (a chrome-trace dict with
+        anchor metadata) for `merge_fleet_trace` — the parent-side half
+        of the cross-process timeline."""
+        reply = self._roundtrip(("trace",))
         if reply[0] == "ok":
             return reply[1]
         raise RuntimeError(reply[2])

@@ -582,8 +582,16 @@ class Router:
         t0 = time.perf_counter()
         for r in group:
             r.t_disp = t0
+        wire = None
+        if tracer.enabled:
+            # the batch's trace wire: process-level workers span their
+            # predictor call on the requests' fleet timeline, and the
+            # anchor pair lets merge_fleet_trace align their shard
+            wire = {"trace_ids": [r.trace_id for r in group],
+                    "anchor_unix_time": tracer.anchor[0],
+                    "anchor_clock": tracer.anchor[1]}
         try:
-            outs = replica.run(feed)
+            outs = replica.run(feed, trace=wire)
         except ReplicaDeadError:
             self._on_replica_death(mv, rt, replica, group)
             return

@@ -63,6 +63,12 @@ def main(argv=None):
             pass
         return 1
 
+    # stdlib-only: arming costs nothing while tracing stays disabled
+    from paddle_tpu.observability import trace as _trace
+
+    _trace.default_tracer().set_process_name(
+        "serving-worker-%d" % replica_index)
+
     write_frame(wf, ("ready", {
         "feed_names": pred.get_input_names(),
         "fetch_names": pred.get_output_names(),
@@ -80,13 +86,29 @@ def main(argv=None):
                 # the SIGKILL drill seam: dies mid-request, frame
                 # unanswered, parent pipe EOFs
                 plan.maybe_kill_replica(replica_index, served)
-                outs = [np.asarray(o) for o in pred.run(msg[1])]
+                # 3-element frames carry the batch's trace wire: span
+                # the predictor call on the requests' fleet timeline
+                wire = msg[2] if len(msg) > 2 else None
+                if wire:
+                    args = ({"trace_ids": list(wire["trace_ids"])}
+                            if "trace_ids" in wire else None)
+                    with _trace.span("worker.run", cat="serving",
+                                     args=args,
+                                     trace_id=wire.get("trace_id")):
+                        outs = [np.asarray(o) for o in pred.run(msg[1])]
+                else:
+                    outs = [np.asarray(o) for o in pred.run(msg[1])]
                 write_frame(wf, ("ok", outs))
             elif msg[0] == "warmup":
                 n = pred.warmup(msg[1])
                 write_frame(wf, ("ok", n))
             elif msg[0] == "ping":
                 write_frame(wf, ("ok", {"served": served}))
+            elif msg[0] == "trace":
+                # the worker's shard of the fleet timeline: ring +
+                # anchor metadata, ready for merge_fleet_trace
+                write_frame(wf, ("ok",
+                                 _trace.default_tracer().chrome_trace()))
             else:
                 write_frame(wf, ("err", "ValueError",
                                  "unknown message %r" % (msg[0],)))

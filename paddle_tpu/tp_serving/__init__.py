@@ -40,7 +40,7 @@ from .layout import (
     tp_param_specs,
     validate_tp,
 )
-from .moe import build_ep_moe, ep_moe_comm_bytes
+from .moe import build_ep_moe, ep_moe_comm_bytes, record_expert_load
 
 __all__ = [
     "DisaggPair",
@@ -49,6 +49,7 @@ __all__ = [
     "TPGenerationEngine",
     "build_ep_moe",
     "ep_moe_comm_bytes",
+    "record_expert_load",
     "extract_prefilled",
     "inject_prefilled",
     "prepare_tp_params",

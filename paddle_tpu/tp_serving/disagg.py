@@ -43,13 +43,19 @@ __all__ = [
 
 
 class KVHandoff:
-    """One prefilled request's KV, in flight between workers."""
+    """One prefilled request's KV, in flight between workers.
+
+    ``trace`` is the request's serialized
+    `observability.trace.TraceContext` wire dict (or None): it crosses
+    the process boundary with the pages, so the decode worker's spans
+    land on the SAME trace_id/anchored timeline as the prefill
+    worker's."""
 
     __slots__ = ("request", "n_prompt", "tok0", "lp0", "key", "pages",
-                 "block_size", "kv_dtype")
+                 "block_size", "kv_dtype", "trace")
 
     def __init__(self, request, n_prompt, tok0, lp0, key, pages,
-                 block_size, kv_dtype):
+                 block_size, kv_dtype, trace=None):
         self.request = request
         self.n_prompt = int(n_prompt)
         self.tok0 = int(tok0)
@@ -58,6 +64,7 @@ class KVHandoff:
         self.pages = tuple(pages)
         self.block_size = int(block_size)
         self.kv_dtype = kv_dtype
+        self.trace = trace
 
     @property
     def nbytes(self):
@@ -85,9 +92,16 @@ def inject_prefilled(engine, handoff, _handle=None):
 
 
 class DisaggPair:
-    """One shard group: prefill-role engine + decode-role engine."""
+    """One shard group: prefill-role engine + decode-role engine.
 
-    def __init__(self, prefill_engine, decode_engine, group_id=0):
+    Handoff/transfer/occupancy telemetry lives in the PR-4 registry as
+    labeled families (``disagg_*`` with a unique ``group`` label) so it
+    exports via `prometheus_text` / `json_snapshot`; `stats()` reads
+    the SAME series back, keeping the ``/stats`` dict byte-compatible
+    with the pre-registry shape."""
+
+    def __init__(self, prefill_engine, decode_engine, group_id=0,
+                 metrics_registry=None):
         if not prefill_engine.paged or not decode_engine.paged:
             raise ValueError("disaggregation requires paged engines")
         if prefill_engine.block_size != decode_engine.block_size:
@@ -97,9 +111,45 @@ class DisaggPair:
         self.prefill = prefill_engine
         self.decode = decode_engine
         self.group_id = int(group_id)
-        self.kv_transfer_bytes = 0
-        self.handoffs = 0
         self._lock = threading.Lock()
+        if metrics_registry is None:
+            from ..observability.metrics import default_registry
+
+            metrics_registry = default_registry()
+        self.metrics_registry = metrics_registry
+        from ..observability.metrics import unique_instance_label
+
+        self._group_label = unique_instance_label(
+            "group%d" % self.group_id)
+        lbl = ("group",)
+        reg = metrics_registry
+        self._m_handoffs = reg.counter(
+            "disagg_handoffs_total", "KV handoffs prefill -> decode",
+            labelnames=lbl).labels(self._group_label)
+        self._m_kv_bytes = reg.counter(
+            "disagg_kv_transfer_bytes_total",
+            "Bytes of KV pages moved prefill -> decode",
+            labelnames=lbl).labels(self._group_label)
+        reg.gauge(
+            "disagg_headroom", "Free decode slots minus queued work",
+            labelnames=lbl).labels(self._group_label).set_function(
+                self.headroom)
+        reg.gauge(
+            "disagg_queue_depth", "Queued handoffs on the decode worker",
+            labelnames=lbl).labels(self._group_label).set_function(
+                lambda: len(self.decode._pending))
+        reg.gauge(
+            "disagg_free_decode_slots", "Free decode slots",
+            labelnames=lbl).labels(self._group_label).set_function(
+                self.free_decode_slots)
+
+    @property
+    def handoffs(self):
+        return int(self._m_handoffs.value)
+
+    @property
+    def kv_transfer_bytes(self):
+        return int(self._m_kv_bytes.value)
 
     def free_decode_slots(self):
         return len(self.decode._free)
@@ -109,15 +159,18 @@ class DisaggPair:
         (queued handoffs haven't taken a slot yet but will)."""
         return len(self.decode._free) - len(self.decode._pending)
 
-    def submit(self, request, _handle=None):
+    def submit(self, request, _handle=None, trace=None):
         """Prefill on the prefill worker, hand the KV over, decode on
-        the decode worker.  Returns the decode-side handle."""
+        the decode worker.  Returns the decode-side handle.  ``trace``
+        (a `TraceContext` or wire dict) pins the request's timeline id;
+        without one the prefill engine mints a fresh context that the
+        handoff carries to the decode side."""
         if not isinstance(request, GenerationRequest):
             request = GenerationRequest(request)
-        handoff = self.prefill.prefill_extract(request)
+        handoff = self.prefill.prefill_extract(request, trace=trace)
         with self._lock:
-            self.kv_transfer_bytes += handoff.nbytes
-            self.handoffs += 1
+            self._m_kv_bytes.inc(handoff.nbytes)
+            self._m_handoffs.inc()
         return self.decode.inject_prefilled(handoff, _handle=_handle)
 
     def run_until_idle(self):
@@ -160,19 +213,37 @@ class ShardGroupFleet:
             raise ValueError("need at least one shard group")
         self.groups = list(groups)
         self._lock = threading.Lock()
-        self._submitted = 0
         if metrics_registry is None:
             from ..observability.metrics import default_registry
 
             metrics_registry = default_registry()
         # the serve_generation_http mount point reads this for /metrics
         self.metrics_registry = metrics_registry
+        from ..observability.metrics import unique_instance_label
 
-    def submit(self, request):
+        self._fleet_label = unique_instance_label("shard_fleet")
+        lbl = ("fleet",)
+        self._m_submitted = metrics_registry.counter(
+            "shard_fleet_requests_total",
+            "Requests routed across shard groups",
+            labelnames=lbl).labels(self._fleet_label)
+        metrics_registry.gauge(
+            "shard_fleet_kv_transfer_bytes",
+            "Total KV bytes moved prefill -> decode, fleet-wide",
+            labelnames=lbl).labels(self._fleet_label).set_function(
+                lambda: sum(g.kv_transfer_bytes for g in self.groups))
+
+    @property
+    def _submitted(self):
+        return int(self._m_submitted.value)
+
+    def submit(self, request, trace=None):
         with self._lock:
             group = max(self.groups,
                         key=lambda g: (g.headroom(), -g.group_id))
-            self._submitted += 1
+            self._m_submitted.inc()
+        if trace is not None:       # duck-typed groups may not take it
+            return group.submit(request, trace=trace)
         return group.submit(request)
 
     def run_until_idle(self):

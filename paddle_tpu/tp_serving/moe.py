@@ -31,7 +31,8 @@ import numpy as np
 from ..fluid.core import jax_compat
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["build_ep_moe", "ep_moe_comm_bytes", "moe_params"]
+__all__ = ["build_ep_moe", "ep_moe_comm_bytes", "moe_params",
+           "record_expert_load"]
 
 AXIS = "tp"
 
@@ -61,12 +62,20 @@ def ep_moe_comm_bytes(tokens, d_model, num_experts, mesh_size, *,
             "per_a2a_wire_bytes": one, "wire_bytes": 2 * one}
 
 
-def build_ep_moe(mesh, num_experts, *, capacity_factor=1.25, top_k=1):
+def build_ep_moe(mesh, num_experts, *, capacity_factor=1.25, top_k=1,
+                 expert_stats=False):
     """Build the jitted expert-parallel MoE apply:
     ``fn(params, x) -> y`` with ``x [T, d]`` (T divisible by the mesh
     size) and params from `moe_params`.  Routing math mirrors the
     `switch_moe` lowering shard-locally; expert compute runs on the
-    chip owning the expert after the dispatch all-to-all."""
+    chip owning the expert after the dispatch all-to-all.
+
+    ``expert_stats=True`` (opt-in: the return signature changes)
+    returns ``fn(params, x) -> (y, counts)`` where ``counts`` is the
+    ``[mesh_size, E]`` per-source-chip dispatched-token counts —
+    reduced from the dispatch one-hots already in hand, so the
+    collective count stays EXACTLY two a2as (the HLO drill's pin);
+    the cross-chip sum happens on the host (`record_expert_load`)."""
     n = int(np.prod(mesh.devices.shape))
     e = int(num_experts)
     if e % n:
@@ -137,14 +146,61 @@ def build_ep_moe(mesh, num_experts, *, capacity_factor=1.25, top_k=1):
         for r in range(top_k):
             out = out + jnp.einsum("tec,ecd->td", disps[r], y) \
                 * gates[r][:, None]
-        return out.astype(x.dtype)
+        if not expert_stats:
+            return out.astype(x.dtype)
+        # per-expert tokens actually dispatched (capacity drops already
+        # zeroed in disp) — [1, E] per chip, concatenating to [N, E]
+        counts = sum(jnp.einsum("tec->e", disp) for disp in disps)
+        return out.astype(x.dtype), counts[None, :]
 
     param_specs = {
         "gate": P(),                       # replicated router
         "w1": P("tp", None, None), "b1": P("tp", None),
         "w2": P("tp", None, None), "b2": P("tp", None),
     }
+    out_specs = (P("tp", None), P("tp", None)) if expert_stats \
+        else P("tp", None)
     mapped = jax_compat.shard_map(
         body, mesh, in_specs=(param_specs, P("tp", None)),
-        out_specs=P("tp", None), check=False)
+        out_specs=out_specs, check=False)
     return jax.jit(mapped)
+
+
+def record_expert_load(counts, registry=None, name="ep_moe"):
+    """Fold one call's expert-token counts (the ``expert_stats=True``
+    second output: ``[N, E]`` per-source-chip, or an already-summed
+    ``[E]``) into the metrics registry:
+
+      * ``ep_moe_expert_tokens_total{moe,expert}`` counters, and
+      * ``ep_moe_hot_expert_imbalance{moe}`` — max/mean of this call's
+        per-expert load (1.0 = perfectly balanced; the hot-expert
+        gauge capacity tuning watches).
+
+    Returns ``{"counts": [per-expert totals], "imbalance": float}``.
+    The sum over source chips happens HERE, on the host — the device
+    graph keeps its two-a2a collective pin."""
+    c = np.asarray(counts, dtype=np.float64)
+    if c.ndim == 2:
+        c = c.sum(axis=0)
+    if c.ndim != 1:
+        raise ValueError("counts must be [E] or [N, E], got shape %r"
+                         % (np.shape(counts),))
+    if registry is None:
+        from ..observability.metrics import default_registry
+
+        registry = default_registry()
+    m_tokens = registry.counter(
+        "ep_moe_expert_tokens_total",
+        "tokens dispatched per expert (capacity drops excluded)",
+        ("moe", "expert"))
+    g_imb = registry.gauge(
+        "ep_moe_hot_expert_imbalance",
+        "max/mean per-expert load of the last recorded call",
+        ("moe",))
+    for i, v in enumerate(c):
+        if v:
+            m_tokens.labels(name, str(i)).inc(float(v))
+    mean = float(c.mean()) if c.size else 0.0
+    imbalance = float(c.max() / mean) if mean > 0 else 0.0
+    g_imb.labels(name).set(imbalance)
+    return {"counts": c.tolist(), "imbalance": imbalance}

@@ -1,0 +1,81 @@
+"""Cross-process generation trace drill worker (PR-19).
+
+One process per disaggregation role, speaking the serving pipe
+protocol (`serving.replica.write_frame`/`read_frame`) over fds passed
+in the standard worker env vars:
+
+  * role ``prefill`` — ``("prefill", request_kwargs, trace_wire)`` ->
+    ``("ok", KVHandoff)``: runs `prefill_extract` under the caller's
+    trace context; the handoff carries the child context back out.
+  * role ``decode`` — ``("decode", KVHandoff)`` -> ``("ok", tokens)``:
+    `inject_prefilled` + run to completion.
+
+Both roles answer ``("trace",)`` with their tracer shard (ring +
+anchor metadata, the `merge_fleet_trace` input) and exit on
+``("close",)`` or EOF.  Both build the SAME tiny seed-0 TransformerLM,
+so the handoff geometry matches."""
+
+import os
+import sys
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    role = argv[0]
+    assert role in ("prefill", "decode"), role
+
+    from paddle_tpu.serving.replica import (
+        WORKER_RFD_ENV,
+        WORKER_WFD_ENV,
+        read_frame,
+        write_frame,
+    )
+
+    rf = os.fdopen(int(os.environ[WORKER_RFD_ENV]), "rb")
+    wf = os.fdopen(int(os.environ[WORKER_WFD_ENV]), "wb")
+
+    import numpy as np
+
+    import paddle_tpu
+    from paddle_tpu import models
+    from paddle_tpu.fluid import dygraph
+    from paddle_tpu.observability import trace as T
+
+    gen = paddle_tpu.generation
+    tr = T.enable_tracing()
+    tr.set_process_name("gen-%s-worker" % role)
+
+    with dygraph.guard():
+        np.random.seed(0)
+        lm = models.TransformerLM(models.TransformerLMConfig.tiny())
+    eng = gen.GenerationEngine(
+        lm, slots=2, max_len=64, prefill_buckets=[8, 16], max_queue=8,
+        block_size=16, kv_blocks=14)
+
+    write_frame(wf, ("ready", os.getpid()))
+    try:
+        while True:
+            msg = read_frame(rf)
+            if msg is None or msg[0] == "close":
+                return 0
+            try:
+                if msg[0] == "prefill":
+                    req = gen.GenerationRequest(**msg[1])
+                    handoff = eng.prefill_extract(req, trace=msg[2])
+                    write_frame(wf, ("ok", handoff))
+                elif msg[0] == "decode":
+                    h = eng.inject_prefilled(msg[1])
+                    eng.run_until_idle()
+                    write_frame(wf, ("ok", h.result(timeout=60.0)))
+                elif msg[0] == "trace":
+                    write_frame(wf, ("ok", tr.chrome_trace()))
+                else:
+                    write_frame(wf, ("err", "unknown %r" % (msg[0],)))
+            except Exception as e:
+                write_frame(wf, ("err", "%s: %s" % (type(e).__name__, e)))
+    except BrokenPipeError:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -461,6 +461,79 @@ def test_generation_tp_decode_comm_closed_form():
     assert starved.time_s >= tp2.time_s
 
 
+def test_serving_observability_layer_within_step_budget():
+    """PR-19 gate: what the observability layer adds to the serving hot
+    path — one disabled-tracer check per emitted token and one
+    `SLOEngine.record` per finished request (both O(1): an attribute
+    read, a locked deque append) — must cost under 2%% of a measured
+    bare decode step, generously assuming EVERY slot both emits a token
+    AND completes a request in the same step.  Percentiles, burn rates
+    and alert edges run in `evaluate()`, which only the /slo scrape and
+    the cron probe call — never the decode loop."""
+    import time
+
+    import numpy as np
+
+    import paddle_tpu
+    from paddle_tpu.fluid import dygraph
+    from paddle_tpu.observability import trace as T
+    from paddle_tpu.observability.metrics import MetricsRegistry
+    from paddle_tpu.observability.slo import SLOEngine
+
+    gen = paddle_tpu.generation
+    T.disable_tracing()
+    try:
+        with dygraph.guard():
+            np.random.seed(0)
+            lm = models.TransformerLM(models.TransformerLMConfig.tiny())
+        slots = 4
+        eng = gen.GenerationEngine(lm, slots=slots, max_len=64,
+                                   prefill_buckets=[8], max_queue=16)
+        for i in range(slots):
+            eng.submit(gen.GenerationRequest([1 + i, 2, 3],
+                                             max_new_tokens=48))
+        for _ in range(8):          # warm prefill bucket + decode step
+            eng.step()
+        n_steps = 24                # 8 + 24 < 48: slots stay occupied
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            eng.step()
+        step_s = (time.perf_counter() - t0) / n_steps
+        eng.run_until_idle()
+
+        def per_call(fn, n=20000):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                fn()
+            return (time.perf_counter() - t0) / n
+
+        slo = SLOEngine(registry=MetricsRegistry(), window=512)
+        sample = {"request_id": "r0", "trace_id": "t0", "t_wall": 1.0,
+                  "outcome": "ok", "ttft_ms": 50.0, "itl_ms": 5.0,
+                  "n_tokens": 8, "duration_ms": 90.0}
+        cost_record = per_call(lambda: slo.record(sample))
+
+        tr = T.default_tracer()
+        assert not tr.enabled
+
+        def token_guard():              # the engine's per-token check
+            if tr.enabled:
+                tr.async_instant("token", "t0", cat="generation")
+        cost_guard = per_call(token_guard)
+
+        budget = 0.02 * step_s
+        per_step = slots * (cost_guard + cost_record)
+        assert per_step < budget, (
+            "observability hot path costs %.3fus/step against a %.3fus "
+            "budget (2%% of a %.3fms bare step)"
+            % (per_step * 1e6, budget * 1e6, step_s * 1e3))
+        # binds-check: the same predicate must FAIL for a cost that is
+        # obviously not O(1) bookkeeping (1ms per slot per step)
+        assert slots * 1e-3 > budget
+    finally:
+        T.disable_tracing()
+
+
 def test_disagg_decode_worker_never_prefills():
     """PR-18 role-separation gate: in a `tp_serving.DisaggPair`, the
     decode worker adopts prefilled KV (`inject_prefilled`) and decodes

@@ -1,0 +1,847 @@
+"""Fleet-wide generation observability (PR-19): the token-level SLO
+engine against hand oracles, the regression sentinel (platform
+matching + canary auto-reject through `ModelRegistry.promote`),
+cross-process trace context + the merged per-request fleet timeline,
+the injected-stall alert drill, the requeue-keeps-the-trace fix, and
+the EP-MoE expert-load stats."""
+
+import json
+import http.client
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.tp_serving as tps
+from paddle_tpu import models
+from paddle_tpu.analysis import comm as comm_mod
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.incubate.fault import FaultPlan
+from paddle_tpu.observability import trace as T
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.observability.slo import (
+    Objective,
+    RegressionSentinel,
+    SLOEngine,
+    default_objectives,
+    percentile,
+)
+from paddle_tpu.serving.registry import (
+    READY,
+    REJECTED,
+    ModelRegistry,
+    TransitionError,
+)
+
+gen = paddle_tpu.generation
+serving = paddle_tpu.serving
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+CFG = models.TransformerLMConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def lm():
+    with dygraph.guard():
+        np.random.seed(0)
+        model = models.TransformerLM(CFG)
+    return model
+
+
+@pytest.fixture
+def tracer():
+    tr = T.enable_tracing()
+    tr.clear()
+    yield tr
+    T.disable_tracing()
+    T.default_tracer().clear()
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def rec(i, outcome="ok", ttft=50.0, itl=5.0, n_tokens=8, dur=90.0,
+        t_wall=1000.0):
+    r = {"request_id": "r%d" % i, "trace_id": "req-0-%d" % i,
+         "t_wall": t_wall, "outcome": outcome, "ttft_ms": None,
+         "itl_ms": None, "n_tokens": 0, "duration_ms": None}
+    if outcome == "ok":
+        r.update(ttft_ms=ttft, itl_ms=itl, n_tokens=n_tokens,
+                 duration_ms=dur)
+    return r
+
+
+def sample_requests(n, max_new=6):
+    rng = np.random.RandomState(7)
+    return [gen.GenerationRequest(
+        rng.randint(0, CFG.vocab_size, int(rng.randint(2, 12))),
+        max_new_tokens=max_new, request_id="slo%d" % i)
+        for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# percentile + SLO math vs hand oracles
+# ---------------------------------------------------------------------------
+
+
+class TestPercentile:
+    def test_nearest_rank_oracle(self):
+        vs = list(range(1, 11))              # 1..10
+        assert percentile(vs, 50) == 5       # ceil(0.5*10) = 5th
+        assert percentile(vs, 90) == 9
+        assert percentile(vs, 99) == 10
+        assert percentile(vs, 0) == 1
+        assert percentile(vs, 100) == 10
+        assert percentile([42.0], 99) == 42.0
+        assert percentile([], 99) is None
+
+    def test_order_independent(self):
+        rng = np.random.RandomState(0)
+        vs = list(rng.randn(37))
+        shuffled = list(vs)
+        rng.shuffle(shuffled)
+        for q in (1, 25, 50, 75, 99):
+            assert percentile(vs, q) == percentile(shuffled, q)
+
+
+class TestSLOEngine:
+    def _engine(self, objectives=None, **kw):
+        kw.setdefault("registry", MetricsRegistry())
+        kw.setdefault("clock", lambda: 1000.0)
+        return SLOEngine(objectives, **kw)
+
+    def test_objective_values_match_hand_oracle(self):
+        slo = self._engine(default_objectives(
+            ttft_ms_p99=100.0, itl_ms_p99=10.0))
+        # 10 ok records, ttft 10..100ms; 1 shed; 1 error
+        for i in range(10):
+            slo.record(rec(i, ttft=10.0 * (i + 1), itl=float(i + 1)))
+        slo.record(rec(10, outcome="shed"))
+        slo.record(rec(11, outcome="error"))
+        rep = slo.evaluate(now=1000.0)
+        by = {o["name"]: o for o in rep["objectives"]}
+        assert by["ttft_p99"]["value"] == 100.0       # p99 of 10 = max
+        assert by["ttft_p99"]["ok"] is True
+        assert by["itl_p99"]["value"] == 10.0
+        assert by["shed_rate"]["value"] == pytest.approx(1 / 12)
+        assert by["error_rate"]["value"] == pytest.approx(1 / 12)
+        assert rep["window"] == 12
+
+    def test_goodput_counts_per_request_not_percentile(self):
+        """Goodput is per-request: 2 of 10 okay requests over the TTFT
+        threshold cost goodput even while the p50 objective passes."""
+        slo = self._engine([Objective("ttft_p50", "ttft_ms", 100.0,
+                                      percentile=50.0)])
+        for i in range(8):
+            slo.record(rec(i, ttft=50.0))
+        slo.record(rec(8, ttft=500.0))
+        slo.record(rec(9, ttft=500.0))
+        rep = slo.evaluate(now=1000.0)
+        assert rep["objectives"][0]["ok"] is True     # p50 = 50ms
+        assert rep["goodput"] == pytest.approx(0.8)
+
+    def test_burn_rate_hand_oracle(self):
+        """burn = bad_fraction(window) / (1 - target).  target 0.9,
+        short window holds 2 bad of 4 -> 0.5/0.1 = 5.0; long window 2
+        bad of 8 -> 0.25/0.1 = 2.5."""
+        slo = self._engine(
+            default_objectives(ttft_ms_p99=100.0, itl_ms_p99=1e9,
+                               shed_rate=1.0, error_rate=1.0),
+            target=0.9, burn_windows=(60.0, 600.0))
+        now = 1000.0
+        for i in range(4):                   # old traffic, all good
+            slo.record(rec(i, ttft=50.0, t_wall=now - 300.0))
+        for i in range(4, 8):                # recent: half bad
+            slo.record(rec(i, ttft=(500.0 if i % 2 else 50.0),
+                           t_wall=now - 10.0))
+        rep = slo.evaluate(now=now)
+        assert rep["burn_rate"]["60s"] == pytest.approx(5.0)
+        assert rep["burn_rate"]["600s"] == pytest.approx(2.5)
+
+    def test_empty_window_is_vacuously_met(self):
+        slo = self._engine()
+        rep = slo.evaluate()
+        assert all(o["ok"] for o in rep["objectives"])
+        assert rep["goodput"] is None
+        assert rep["alerts"] == []
+
+    def test_alert_latches_fires_once_and_clears(self, tracer):
+        """The alert counter counts EDGES, not evaluations; the firing
+        and clearing instants land in the tracer ring."""
+        mr = MetricsRegistry()
+        slo = self._engine([Objective("ttft_p99", "ttft_ms", 100.0)],
+                           registry=mr, window=8)
+        for i in range(8):
+            slo.record(rec(i, ttft=500.0))
+        slo.evaluate(now=1000.0)
+        slo.evaluate(now=1001.0)             # still bad: no re-fire
+        assert slo.alerts() == ["ttft_p99"]
+        fired = mr.counter("slo_alerts_total", "",
+                           ("slo", "objective"))
+        assert fired.labels(slo.name, "ttft_p99").value == 1
+        ok_g = mr.gauge("slo_objective_ok", "", ("slo", "objective"))
+        assert ok_g.labels(slo.name, "ttft_p99").value == 0.0
+        # clean traffic rolls the bad records out of the window
+        for i in range(8):
+            slo.record(rec(100 + i, ttft=10.0))
+        rep = slo.evaluate(now=1002.0)
+        assert rep["alerts"] == []
+        assert fired.labels(slo.name, "ttft_p99").value == 1
+        assert ok_g.labels(slo.name, "ttft_p99").value == 1.0
+        names = [e["name"] for e in tracer.events()]
+        assert "slo.alert" in names and "slo.alert_cleared" in names
+
+    def test_live_summary_units(self):
+        slo = self._engine()
+        for i in range(10):
+            slo.record(rec(i, ttft=10.0 * (i + 1), itl=2.0, n_tokens=10,
+                           dur=100.0))
+        s = slo.live_summary()
+        assert s["window"] == 10
+        assert s["ttft_ms_p99"] == 100.0
+        assert s["itl_ms_p99"] == 2.0
+        # 100 tokens over 10 * 100ms = 1s -> 100 tok/s
+        assert s["tokens_per_s"] == pytest.approx(100.0)
+
+    def test_shed_and_error_records_excluded_from_latency_math(self):
+        slo = self._engine([Objective("ttft_p99", "ttft_ms", 100.0)])
+        slo.record(rec(0, ttft=50.0))
+        for i in range(1, 9):
+            slo.record(rec(i, outcome="shed"))
+        rep = slo.evaluate()
+        assert rep["objectives"][0]["value"] == 50.0
+
+
+# ---------------------------------------------------------------------------
+# regression sentinel + canary auto-reject
+# ---------------------------------------------------------------------------
+
+
+class _FakeReplica:
+    alive = True
+
+
+def _ready_version(reg, name):
+    mv = reg.begin_deploy(name, "/dev/null")
+    mv.state = READY
+    mv.replicas = [_FakeReplica()]
+    return mv
+
+
+class TestRegressionSentinel:
+    BASE = {"platform": "cpu", "ttft_ms_p99": 100.0, "itl_ms_p99": 10.0,
+            "tokens_per_s": 1000.0, "decode_executables": 1}
+
+    def _sentinel(self, mr=None, **kw):
+        kw.setdefault("platform", "cpu")
+        return RegressionSentinel(dict(self.BASE),
+                                  registry=mr or MetricsRegistry(), **kw)
+
+    def test_within_tolerance_passes(self):
+        mr = MetricsRegistry()
+        s = self._sentinel(mr)
+        v = s.check({"ttft_ms_p99": 120.0, "itl_ms_p99": 12.0,
+                     "tokens_per_s": 900.0, "decode_executables": 1})
+        assert v == {"checked": True, "regressed": False, "findings": [],
+                     "platform": "cpu"}
+        g = mr.gauge("serving_regression", "", ("sentinel",))
+        assert g.labels(s.name).value == 0.0
+
+    @pytest.mark.parametrize("live,metric", [
+        ({"ttft_ms_p99": 130.0}, "ttft_ms_p99"),        # > 100 * 1.25
+        ({"itl_ms_p99": 13.0}, "itl_ms_p99"),
+        ({"tokens_per_s": 700.0}, "tokens_per_s"),      # < 1000 * 0.75
+        ({"decode_executables": 2}, "decode_executables"),  # ANY growth
+    ])
+    def test_each_rule_fires(self, live, metric, tracer):
+        mr = MetricsRegistry()
+        s = self._sentinel(mr)
+        v = s.check(live)
+        assert v["regressed"] and \
+            [f["metric"] for f in v["findings"]] == [metric]
+        assert mr.gauge("serving_regression", "",
+                        ("sentinel",)).labels(s.name).value == 1.0
+        assert any(e["name"] == "sentinel.regression"
+                   for e in tracer.events())
+        # recovery clears the gauge
+        s.check({metric: self.BASE[metric]})
+        assert mr.gauge("serving_regression", "",
+                        ("sentinel",)).labels(s.name).value == 0.0
+
+    def test_platform_mismatch_never_gates(self):
+        """A CPU smoke baseline can NOT judge a TPU fleet: the check is
+        skipped, gauge untouched."""
+        mr = MetricsRegistry()
+        s = RegressionSentinel(dict(self.BASE), registry=mr,
+                               platform="tpu")
+        v = s.check({"ttft_ms_p99": 9999.0})
+        assert v["checked"] is False and v["regressed"] is False
+        assert "cpu" in v["skipped"] and "tpu" in v["skipped"]
+        checks = mr.counter("serving_regression_checks_total", "",
+                            ("sentinel", "verdict"))
+        assert checks.labels(s.name, "skipped").value == 1
+
+    def test_from_bench_file(self, tmp_path):
+        p = tmp_path / "BENCH_r99.json"
+        p.write_text(json.dumps([
+            {"metric": "ttft_ms_p99", "value": 80.0, "platform": "cpu"},
+            {"metric": "tokens_per_s", "value": 500.0, "platform": "cpu"},
+            {"metric": "unrelated", "value": 1.0, "platform": "cpu"},
+        ]))
+        s = RegressionSentinel.from_bench_file(
+            str(p), registry=MetricsRegistry(), platform="cpu")
+        assert s.baseline == {"platform": "cpu", "ttft_ms_p99": 80.0,
+                              "tokens_per_s": 500.0}
+        assert s.check({"ttft_ms_p99": 79.0})["regressed"] is False
+        assert s.check({"ttft_ms_p99": 200.0})["regressed"] is True
+
+    def test_bench_records_without_platform_default_tpu(self, tmp_path):
+        p = tmp_path / "BENCH_r04.json"
+        p.write_text(json.dumps([{"metric": "itl_ms_p99", "value": 5.0}]))
+        s = RegressionSentinel.from_bench_file(
+            str(p), registry=MetricsRegistry(), platform="cpu")
+        assert s.baseline["platform"] == "tpu"
+        assert s.check({"itl_ms_p99": 9999.0})["checked"] is False
+
+    def test_promote_gate_rejects_regressing_canary(self):
+        """The acceptance drill: a canary burning the budget auto-
+        rejects at promote; the stable pointer never moves."""
+        reg = ModelRegistry()
+        stable = _ready_version(reg, "v1")
+        reg.promote("v1")
+        canary = _ready_version(reg, "v2")
+        mr = MetricsRegistry()
+        slo = SLOEngine(registry=mr, name="canary",
+                        clock=lambda: 1000.0)
+        for i in range(16):
+            slo.record(rec(i, ttft=400.0))   # 4x the baseline TTFT
+        s = self._sentinel(mr, name="canary")
+        with pytest.raises(TransitionError, match="SLO gate"):
+            reg.promote("v2", slo_gate=s.gate(slo.live_summary))
+        assert reg.stable == "v1" and stable.state == "serving"
+        assert canary.state == REJECTED
+        assert "ttft_ms_p99" in canary.error
+
+    def test_promote_gate_passes_healthy_canary(self):
+        reg = ModelRegistry()
+        _ready_version(reg, "v1")
+        reg.promote("v1")
+        canary = _ready_version(reg, "v2")
+        mr = MetricsRegistry()
+        slo = SLOEngine(registry=mr, clock=lambda: 1000.0)
+        for i in range(16):
+            # dur chosen so tokens_per_s clears the throughput rule too
+            slo.record(rec(i, ttft=50.0, itl=5.0, dur=8.0))
+        s = self._sentinel(mr)
+        old = reg.promote("v2", slo_gate=s.gate(slo.live_summary))
+        assert reg.stable == "v2" and canary.state == "serving"
+        assert old is not None and old.version == "v1"
+
+    def test_promote_gate_raising_rejects(self):
+        reg = ModelRegistry()
+        _ready_version(reg, "v2")
+
+        def broken():
+            raise RuntimeError("scrape failed")
+
+        with pytest.raises(TransitionError, match="gate raised"):
+            reg.promote("v2", slo_gate=broken)
+        assert reg.get("v2").state == REJECTED
+
+    def test_promote_gate_rejects_on_active_alerts(self):
+        reg = ModelRegistry()
+        _ready_version(reg, "v2")
+        with pytest.raises(TransitionError, match="active SLO alerts"):
+            reg.promote("v2", slo_gate=lambda: {
+                "regressed": False, "alerts": ["itl_p99"]})
+
+
+# ---------------------------------------------------------------------------
+# trace context + merged fleet timeline
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_wire_roundtrip(self):
+        tc = T.TraceContext()
+        wire = tc.to_wire()
+        assert set(wire) == {"trace_id", "anchor_unix_time",
+                             "anchor_clock"}
+        json.dumps(wire)                      # JSON-safe by contract
+        back = T.TraceContext.from_wire(wire)
+        assert back.trace_id == tc.trace_id
+        assert back.anchor == tc.anchor
+
+    def test_child_carries_parent(self):
+        tc = T.TraceContext(trace_id="req-1-1")
+        ch = tc.child("prefill")
+        assert ch.trace_id == "req-1-1" and ch.parent == "prefill"
+        assert "parent" in ch.to_wire()
+
+    def test_from_wire_none_and_passthrough(self):
+        assert T.TraceContext.from_wire(None) is None
+        tc = T.TraceContext()
+        assert T.TraceContext.from_wire(tc) is tc
+
+
+def _shard(pid, events, anchor):
+    md = {"process_name": "p%d" % pid, "pid": pid}
+    if anchor is not None:
+        md.update(anchor_unix_time=anchor[0], anchor_clock=anchor[1])
+    return {"traceEvents": events, "metadata": md}
+
+
+def _async_ev(ph, name, tid, pid, ts):
+    return {"ph": ph, "name": name, "id": tid, "cat": "generation",
+            "pid": pid, "tid": 1, "ts": ts}
+
+
+class TestMergeFleetTrace:
+    def test_filters_to_one_request_and_aligns(self):
+        """Two process shards with different anchors merge onto ONE
+        clock; ?trace_id keeps only that request's events."""
+        a = _shard(1, [_async_ev("b", "prefill", "req-1-1", 1, 0),
+                       _async_ev("e", "prefill", "req-1-1", 1, 50),
+                       _async_ev("b", "prefill", "req-1-2", 1, 60)],
+                   anchor=(100.0, 0.0))
+        # pid 2's clock started 1s later: its ts 0 is 1e6us after pid 1's
+        b = _shard(2, [_async_ev("b", "handoff", "req-1-1", 2, 0)],
+                   anchor=(101.0, 0.0))
+        merged = T.merge_fleet_trace([a, b], trace_id="req-1-1")
+        assert merged["metadata"]["trace_id"] == "req-1-1"
+        assert merged["metadata"]["aligned"] is True
+        evs = [e for e in merged["traceEvents"] if e["ph"] != "M"]
+        assert all(e["id"] == "req-1-1" for e in evs)
+        by = {(e["pid"], e["ph"], e["name"]): e["ts"] for e in evs}
+        assert by[(2, "b", "handoff")] - by[(1, "b", "prefill")] \
+            == 1_000_000
+
+    def test_anchorless_shard_disables_alignment(self):
+        a = _shard(1, [_async_ev("b", "x", "t", 1, 0)], anchor=(5.0, 0.0))
+        b = _shard(2, [_async_ev("b", "y", "t", 2, 0)], anchor=None)
+        merged = T.merge_fleet_trace([a, b])
+        assert merged["metadata"]["aligned"] is False
+
+    def test_save_roundtrip(self, tmp_path):
+        a = _shard(1, [_async_ev("n", "token", "t", 1, 3)],
+                   anchor=(5.0, 0.0))
+        out = tmp_path / "fleet_trace.json"
+        T.merge_fleet_trace([a], out_path=str(out))
+        assert json.loads(out.read_text())["traceEvents"]
+
+
+def async_events(evs, trace_id=None):
+    out = [(e["ph"], e["name"]) for e in evs
+           if e.get("ph") in ("b", "e", "n")
+           and (trace_id is None or e.get("id") == trace_id)]
+    return out
+
+
+class TestRequestTimeline:
+    def _engine(self, lm, **kw):
+        kw.setdefault("slots", 2)
+        kw.setdefault("max_len", 64)
+        kw.setdefault("prefill_buckets", [8, 16])
+        kw.setdefault("max_queue", 16)
+        return gen.GenerationEngine(lm, **kw)
+
+    def test_one_request_one_ordered_track(self, lm, tracer):
+        """queue -> prefill -> per-token decode -> end, all under the
+        handle's trace_id, schema-valid."""
+        from test_trace import validate_chrome_trace
+
+        eng = self._engine(lm).start()
+        try:
+            h = eng.submit(gen.GenerationRequest([1, 2, 3, 4],
+                                                 max_new_tokens=4))
+            h.result(timeout=30.0)
+        finally:
+            eng.stop()
+        tid = h.trace.trace_id
+        seq = async_events(tracer.events(), tid)
+        assert seq[0] == ("b", "request")
+        assert seq[-1] == ("e", "request")
+        assert ("b", "queue") in seq and ("e", "queue") in seq
+        assert seq.index(("b", "prefill")) < seq.index(("e", "prefill"))
+        assert seq.count(("n", "token")) == 4
+        assert seq.index(("e", "prefill")) \
+            < seq.index(("n", "token"))
+        validate_chrome_trace(tracer.chrome_trace())
+
+    def test_disagg_handoff_rides_the_same_trace(self, lm, tracer):
+        """The DistServe split: prefill engine -> KVHandoff -> decode
+        engine, ONE timeline — handoff b/e brackets the inject, tokens
+        follow, all on one trace_id."""
+        prefill = self._engine(lm, block_size=16, kv_blocks=10)
+        decode = self._engine(lm, block_size=16, kv_blocks=14)
+        pair = tps.DisaggPair(prefill, decode, group_id=0)
+        h = pair.submit(gen.GenerationRequest([1, 2, 3, 4, 5],
+                                              max_new_tokens=3))
+        pair.run_until_idle()
+        assert len(h.result(timeout=30.0)) == 3
+        tid = h.trace.trace_id
+        assert getattr(h.trace, "parent", None) == "prefill"
+        seq = async_events(tracer.events(), tid)
+        for marker in [("b", "request"), ("b", "prefill"),
+                       ("e", "prefill"), ("b", "handoff"),
+                       ("e", "handoff"), ("n", "inject"),
+                       ("n", "token"), ("e", "request")]:
+            assert marker in seq, (marker, seq)
+        assert seq.index(("e", "prefill")) < seq.index(("b", "handoff"))
+        assert seq.index(("e", "handoff")) < seq.index(("n", "inject"))
+        merged = T.merge_fleet_trace([tracer.chrome_trace()],
+                                     trace_id=tid)
+        assert merged["metadata"]["aligned"] is True
+        assert all(e["ph"] == "M" or e.get("id") == tid
+                   or e.get("args", {}).get("trace_id") == tid
+                   for e in merged["traceEvents"])
+
+    def test_requeue_after_death_keeps_original_trace(self, lm, tracer):
+        """Satellite (b): the replacement replica's spans carry the
+        ORIGINAL trace — death, requeue and restart are instants on the
+        same track, not a fresh anonymous trace."""
+        plan = FaultPlan([], rank=0)
+        plan.add("kill_replica", replica=0, request=3)
+        fleet = serving.GenerationFleet(
+            lm, replicas=2, fault_plan=plan, slots=2, max_len=64,
+            prefill_buckets=[8, 16], max_queue=32).start()
+        try:
+            handles = [fleet.submit(r)
+                       for r in sample_requests(4, max_new=8)]
+            for h in handles:
+                h.result(timeout=60.0)
+        finally:
+            fleet.stop()
+        requeued = [h for h in handles if h.requeued]
+        assert requeued, "the dead replica held in-flight requests"
+        for h in requeued:
+            tid = h.trace.trace_id
+            seq = async_events(tracer.events(), tid)
+            assert ("n", "replica_death") in seq, seq
+            assert ("n", "requeue") in seq, seq
+            assert ("n", "restart") in seq, seq
+            # one request track: exactly one b/e pair, re-queued between
+            assert seq.count(("b", "request")) == 1
+            assert seq.count(("e", "request")) == 1
+            assert seq.count(("b", "queue")) == 2
+            # token indices restart at 0 on the replacement replica
+            toks = [e["args"]["index"] for e in tracer.events()
+                    if e.get("ph") == "n" and e.get("id") == tid
+                    and e["name"] == "token"]
+            assert toks.count(0) == 2
+
+
+# ---------------------------------------------------------------------------
+# injected stall -> alert fires -> clean traffic clears it
+# ---------------------------------------------------------------------------
+
+
+class TestStallDrill:
+    def test_stall_fires_and_clears_itl_alert(self, lm):
+        """A 900ms decode stall on replica 0 blows a 50ms ITL p99
+        objective; once clean traffic rolls the stalled requests out of
+        the (small) window, the alert clears."""
+        plan = FaultPlan([], rank=0)
+        plan.add("stall_replica", replica=0, step=2, seconds=0.9)
+        mr = MetricsRegistry()
+        slo = SLOEngine(
+            [Objective("itl_p99", "itl_ms", 50.0)],
+            registry=mr, window=8, name="drill")
+        fleet = serving.GenerationFleet(
+            lm, replicas=1, fault_plan=plan, slo=slo, slots=2,
+            max_len=64, prefill_buckets=[8, 16], max_queue=32,
+            metrics_registry=mr).start()
+        try:
+            for h in [fleet.submit(r)
+                      for r in sample_requests(2, max_new=6)]:
+                h.result(timeout=60.0)
+            rep = fleet.slo.report()
+            assert rep["alerts"] == ["itl_p99"], rep
+            assert rep["objectives"][0]["value"] > 50.0
+            # clean traffic: the stall was one-shot, window rolls over
+            for h in [fleet.submit(r)
+                      for r in sample_requests(8, max_new=4)]:
+                h.result(timeout=60.0)
+            rep = fleet.slo.report()
+            assert rep["alerts"] == [], rep
+        finally:
+            fleet.stop()
+        fired = mr.counter("slo_alerts_total", "", ("slo", "objective"))
+        assert fired.labels("drill", "itl_p99").value == 1
+
+
+# ---------------------------------------------------------------------------
+# /slo + /trace endpoints and serving_ctl contracts
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    return resp.status, body
+
+
+def _ctl(port, *argv):
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serving_ctl.py"),
+         "--endpoint", "http://127.0.0.1:%d" % port, "--json"] +
+        list(argv),
+        capture_output=True, text=True, timeout=120)
+    out = json.loads(p.stdout) if p.stdout.strip() else None
+    return p.returncode, out
+
+
+class TestHTTPAndCtl:
+    @pytest.fixture()
+    def fleet_server(self, lm):
+        # latency thresholds sky-high: CPU compile time must not flake
+        # the rc contracts (the error-rate objective does the alerting)
+        fleet = serving.GenerationFleet(
+            lm, replicas=1, slots=2, max_len=64,
+            prefill_buckets=[8, 16], max_queue=32,
+            slo_objectives=default_objectives(
+                ttft_ms_p99=1e9, itl_ms_p99=1e9)).start()
+        port = free_port()
+        httpd = serving.serve_generation_http(
+            fleet, port=port, block=False)
+        yield fleet, port
+        httpd.shutdown()
+        fleet.stop()
+
+    def test_slo_and_trace_endpoints(self, fleet_server, tracer):
+        fleet, port = fleet_server
+        for h in [fleet.submit(r) for r in sample_requests(3)]:
+            h.result(timeout=60.0)
+        code, rep = _get(port, "/slo")
+        assert code == 200 and rep["window"] == 3
+        assert rep["goodput"] == 1.0 and rep["alerts"] == []
+        tid = None
+        for e in T.default_tracer().events():
+            if e.get("ph") == "b" and e["name"] == "request":
+                tid = e["id"]
+        code, tr = _get(port, "/trace?trace_id=%s" % tid)
+        assert code == 200
+        assert tr["metadata"]["trace_id"] == tid
+        assert tr["metadata"]["aligned"] is True
+        assert any(e.get("ph") == "n" and e["name"] == "token"
+                   for e in tr["traceEvents"])
+
+    def test_trace_409_when_disabled(self, fleet_server):
+        _, port = fleet_server
+        code, body = _get(port, "/trace")
+        assert code == 409 and "tracing disabled" in body["error"]
+        rc, _out = _ctl(port, "trace")
+        assert rc == 1
+
+    def test_ctl_slo_rc_contract(self, fleet_server):
+        fleet, port = fleet_server
+        for h in [fleet.submit(r) for r in sample_requests(2)]:
+            h.result(timeout=60.0)
+        rc, out = _ctl(port, "slo")
+        assert rc == 0 and out["response"]["window"] == 2
+        # active alert -> rc 1 (the cron probe pages by exit code)
+        fleet.slo.record(rec(99, ttft=1e9, itl=1e9))
+        fleet.slo.record(rec(100, outcome="error"))
+        fleet.slo.evaluate()
+        rc, out = _ctl(port, "slo")
+        assert rc == 1 and out["response"]["alerts"]
+
+    def test_ctl_trace_out_writes_merged_json(self, fleet_server,
+                                              tracer, tmp_path):
+        from test_trace import validate_chrome_trace
+
+        fleet, port = fleet_server
+        for h in [fleet.submit(r) for r in sample_requests(1)]:
+            h.result(timeout=60.0)
+        out = tmp_path / "trace.json"
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "serving_ctl.py"),
+             "--endpoint", "http://127.0.0.1:%d" % port,
+             "trace", "--out", str(out)],
+            capture_output=True, text=True, timeout=120)
+        assert p.returncode == 0, p.stderr
+        validate_chrome_trace(json.loads(out.read_text()))
+
+
+# ---------------------------------------------------------------------------
+# EP-MoE expert-load stats (satellite a)
+# ---------------------------------------------------------------------------
+
+
+class TestExpertStats:
+    def _build(self, e=8, d=16, h=32, top_k=2):
+        with dygraph.guard():
+            np.random.seed(3)
+            moe = models.MoEFFN(d, h, num_experts=e,
+                                capacity_factor=8.0, top_k=top_k)
+            params = tps.moe.moe_params(moe)
+        x = np.random.RandomState(5).randn(32, d).astype(np.float32)
+        return params, x
+
+    def test_counts_opt_in_and_output_identical(self):
+        params, x = self._build()
+        mesh = tps.tp_mesh(4)
+        y0 = np.asarray(tps.build_ep_moe(
+            mesh, 8, capacity_factor=8.0, top_k=2)(params, x))
+        y1, counts = tps.build_ep_moe(
+            mesh, 8, capacity_factor=8.0, top_k=2,
+            expert_stats=True)(params, x)
+        np.testing.assert_allclose(np.asarray(y1), y0, rtol=1e-6)
+        counts = np.asarray(counts)
+        assert counts.shape == (4, 8)        # [source chip, expert]
+        # ample capacity: every token * top_k dispatched somewhere
+        assert counts.sum() == 32 * 2
+
+    def test_collective_pin_survives_expert_stats(self):
+        """The counts reduce the one-hots already in hand: the compiled
+        module still holds EXACTLY two all-to-alls."""
+        params, x = self._build()
+        mesh = tps.tp_mesh(4)
+        fn = tps.build_ep_moe(mesh, 8, capacity_factor=8.0, top_k=2,
+                              expert_stats=True)
+        hlo = fn.lower(params, x).compile().as_text()
+        stats = comm_mod.hlo_collective_stats(hlo, 4)
+        assert stats["all-to-all"]["count"] == 2
+
+    def test_record_expert_load_registry_series(self):
+        mr = MetricsRegistry()
+        out = tps.record_expert_load([[4.0, 0.0], [2.0, 2.0]],
+                                     registry=mr, name="m0")
+        assert out["counts"] == [6.0, 2.0]
+        assert out["imbalance"] == pytest.approx(1.5)   # 6 / mean(4)
+        c = mr.counter("ep_moe_expert_tokens_total", "",
+                       ("moe", "expert"))
+        assert c.labels("m0", "0").value == 6.0
+        assert c.labels("m0", "1").value == 2.0
+        g = mr.gauge("ep_moe_hot_expert_imbalance", "", ("moe",))
+        assert g.labels("m0").value == pytest.approx(1.5)
+        with pytest.raises(ValueError):
+            tps.record_expert_load(np.zeros((2, 2, 2)), registry=mr)
+
+
+# ---------------------------------------------------------------------------
+# cross-process drill: prefill worker -> KVHandoff -> decode worker,
+# ONE anchored timeline (slow: two real subprocesses load the model)
+# ---------------------------------------------------------------------------
+
+
+class _DrillWorker:
+    """Parent end of one gen_trace_worker.py subprocess, speaking the
+    serving pipe protocol over a private fd pair."""
+
+    def __init__(self, role):
+        from paddle_tpu.serving.replica import (
+            WORKER_RFD_ENV,
+            WORKER_WFD_ENV,
+            read_frame,
+            write_frame,
+        )
+
+        self._read_frame, self._write_frame = read_frame, write_frame
+        c2w_r, c2w_w = os.pipe()
+        w2c_r, w2c_w = os.pipe()
+        env = dict(os.environ)
+        env[WORKER_RFD_ENV] = str(c2w_r)
+        env[WORKER_WFD_ENV] = str(w2c_w)
+        env.setdefault("PYTHONPATH", REPO)
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "gen_trace_worker.py"),
+             role],
+            env=env, pass_fds=(c2w_r, w2c_w), close_fds=True)
+        os.close(c2w_r)
+        os.close(w2c_w)
+        self.w = os.fdopen(c2w_w, "wb")
+        self.r = os.fdopen(w2c_r, "rb")
+        kind, self.pid = self._read_frame(self.r)
+        assert kind == "ready"
+
+    def call(self, *msg):
+        self._write_frame(self.w, msg)
+        reply = self._read_frame(self.r)
+        assert reply is not None and reply[0] == "ok", reply
+        return reply[1]
+
+    def close(self):
+        try:
+            self._write_frame(self.w, ("close",))
+        except Exception:
+            pass
+        self.proc.wait(timeout=30)
+
+
+@pytest.mark.slow
+class TestCrossProcessDrill:
+    def test_one_request_one_anchored_timeline_across_pids(self, tracer):
+        """The tentpole acceptance drill: a disaggregated request whose
+        prefill and decode run in DIFFERENT processes merges into ONE
+        anchor-aligned timeline — handoff begins on the prefill pid,
+        ends on the decode pid, tokens follow in order."""
+        from test_trace import validate_chrome_trace
+
+        prefill = _DrillWorker("prefill")
+        decode = _DrillWorker("decode")
+        try:
+            tc = T.TraceContext()
+            with T.span("drill.submit", cat="generation",
+                        trace_id=tc.trace_id):
+                handoff = prefill.call(
+                    "prefill",
+                    {"prompt_ids": [1, 2, 3, 4, 5],
+                     "max_new_tokens": 4, "request_id": "xp0"},
+                    tc.to_wire())
+            # the handoff crossed the pipe carrying the SAME trace
+            assert handoff.trace["trace_id"] == tc.trace_id
+            assert handoff.trace["parent"] == "prefill"
+            tokens = decode.call("decode", handoff)
+            assert len(tokens) == 4
+            shard_p = prefill.call("trace")
+            shard_d = decode.call("trace")
+        finally:
+            prefill.close()
+            decode.close()
+
+        merged = T.merge_fleet_trace(
+            [tracer.chrome_trace(), shard_p, shard_d],
+            trace_id=tc.trace_id)
+        assert merged["metadata"]["trace_id"] == tc.trace_id
+        assert merged["metadata"]["aligned"] is True
+        validate_chrome_trace(merged)
+        evs = [e for e in merged["traceEvents"]
+               if e.get("ph") in ("b", "e", "n")]
+        assert {e["id"] for e in evs} == {tc.trace_id}
+        assert {e["pid"] for e in evs} == {prefill.pid, decode.pid}
+
+        def ts(ph, name, pid):
+            hits = [e["ts"] for e in evs
+                    if e["ph"] == ph and e["name"] == name
+                    and e["pid"] == pid]
+            assert hits, (ph, name, pid, evs)
+            return hits[0]
+
+        # the phase chain, on the ALIGNED clock, hopping processes:
+        assert ts("b", "prefill", prefill.pid) \
+            <= ts("e", "prefill", prefill.pid) \
+            <= ts("b", "handoff", prefill.pid) \
+            <= ts("e", "handoff", decode.pid) \
+            <= ts("n", "inject", decode.pid)
+        toks = sorted(
+            (e["ts"], e["args"]["index"]) for e in evs
+            if e["ph"] == "n" and e["name"] == "token")
+        assert [i for _, i in toks] == [0, 1, 2, 3]
+        assert toks[0][0] >= ts("n", "inject", decode.pid)

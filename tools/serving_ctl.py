@@ -14,6 +14,8 @@ Speaks the /admin plane of `serving.serve_http`::
     shadow  [-v V | --off]                # mirror traffic (never returned)
     retire  -v V                          # drain + close replicas
     drain   -v V                          # alias of retire
+    slo                                   # GET /slo; rc 1 on active alerts
+    trace   [--trace-id ID] [--out FILE]  # GET /trace (merged timeline)
 
 Exit codes: 0 on success; **1 on a refused transition** (HTTP 409 —
 promote a non-ready version, retire the stable one, rollback with no
@@ -151,6 +153,56 @@ def cmd_shadow(args):
     return rc
 
 
+def cmd_slo(args):
+    code, payload = _call(args.endpoint, "/slo")
+    rc = _emit(args, code, payload)
+    if code == 200 and not args.json:
+        print("slo %s: window %d, goodput %s" % (
+            payload.get("slo"), payload.get("window", 0),
+            ("%.4f" % payload["goodput"])
+            if payload.get("goodput") is not None else "n/a"))
+        for obj in payload.get("objectives", []):
+            v = obj.get("value")
+            print("  %-12s %-12s %s  (<= %g)  %s" % (
+                obj["name"], obj["metric"],
+                "n/a" if v is None else "%.4g" % v,
+                obj["threshold"], "ok" if obj["ok"] else "ALERT"))
+        for w, r in sorted((payload.get("burn_rate") or {}).items()):
+            print("  burn %-8s %.3f" % (w, r))
+    # active alerts fail the invocation even on HTTP 200: `serving_ctl
+    # slo` is the CI/cron probe, rc!=0 IS the page
+    if rc == 0 and payload.get("alerts"):
+        if not args.json:
+            print("active alerts: %s" % ", ".join(payload["alerts"]),
+                  file=sys.stderr)
+        return 1
+    return rc
+
+
+def cmd_trace(args):
+    path = "/trace"
+    if args.trace_id:
+        path += "?trace_id=%s" % args.trace_id
+    code, payload = _call(args.endpoint, path)
+    if code == 200 and args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f)
+        if not args.json:
+            print("wrote %d events to %s"
+                  % (len(payload.get("traceEvents", [])), args.out))
+            return 0
+    rc = _emit(args, code, payload)
+    if rc == 0 and not args.json and not args.out:
+        evs = payload.get("traceEvents", [])
+        md = payload.get("metadata", {})
+        print("%d events%s%s" % (
+            len(evs),
+            ", trace_id %s" % md["trace_id"]
+            if md.get("trace_id") else "",
+            ", anchor-aligned" if md.get("aligned") else ""))
+    return rc
+
+
 def cmd_retire(args):
     code, payload = _call(args.endpoint, "/admin/retire",
                           {"version": args.version})
@@ -207,6 +259,20 @@ def build_parser():
         r = sub.add_parser(alias)
         r.add_argument("-v", "--version", required=True)
         r.set_defaults(fn=cmd_retire)
+
+    sl = sub.add_parser(
+        "slo", help="GET /slo — rc 1 on active alerts (the cron probe)")
+    sl.set_defaults(fn=cmd_slo)
+
+    t = sub.add_parser(
+        "trace", help="GET /trace — merged fleet timeline (rc 1 while "
+                      "tracing is disabled: HTTP 409)")
+    t.add_argument("--trace-id", default=None,
+                   help="filter to one request's timeline")
+    t.add_argument("--out", default=None, metavar="FILE",
+                   help="write the chrome-trace JSON here (open in "
+                        "Perfetto) instead of printing a summary")
+    t.set_defaults(fn=cmd_trace)
     return p
 
 
