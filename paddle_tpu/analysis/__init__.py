@@ -82,3 +82,15 @@ def analyze_program(program, feed_names=None, fetch_names=None,
                               fetch_names=fetch_names, rules=rules,
                               categories=categories))
     return diags
+
+
+# concurrency (lock sanitizer facade + static thread-safety lint) is
+# PEP 562 lazy like paddle_tpu.analysis itself: program-graph users
+# never pay for the AST walker, and the "concurrency" lint category
+# registers only when asked for
+def __getattr__(name):
+    if name == "concurrency":
+        import importlib
+
+        return importlib.import_module(".concurrency", __name__)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -1,0 +1,594 @@
+"""Concurrency analysis: the static half of the lock sanitizer, plus the
+public facade over the runtime half (``observability.locks``).
+
+The runtime sanitizer watches what drills *execute*; this module reads
+what the tree *says*: a single AST pass over ``paddle_tpu/`` sources
+
+* resolves lock definitions — ``self.x = threading.Lock()`` (and
+  RLock/Condition) in class bodies or methods, module-level
+  assignments, and registry locks created via ``named_lock`` /
+  ``named_rlock`` / ``named_condition`` (whose declared NAME is used,
+  so static and runtime findings name the same locks);
+* extracts syntactically nested ``with lock:`` orders into the same
+  :class:`LockOrderGraph` the runtime sanitizer feeds — an AB/BA
+  inversion is reported from source alone, before anything runs;
+* flags blocking-call patterns under a held lock: ``time.sleep``,
+  zero-arg ``.get()`` / ``.wait()`` / ``.join()`` / ``.communicate()``,
+  ``subprocess.*``, socket ``recv/sendall/accept``, ``os.read`` /
+  ``os.write``, pipe ``read_frame``/``write_frame``, and
+  ``block_until_ready``;
+* flags a non-reentrant registered lock acquired inside a
+  ``signal.signal`` handler (followed depth-2 through same-class
+  helper methods) — the PR-6 flight-recorder deadlock shape.
+
+Known static limits (the runtime half covers them): lock acquisitions
+hidden behind method calls are invisible to the nesting walk, and a
+condition built over a shared lock is a distinct static node.
+
+Findings are ordinary :class:`Diagnostic` objects in the new
+``"concurrency"`` lint category on the shared registry; they carry
+``file:line`` in provenance.  A finding is *waived* in place with::
+
+    some_blocking_call()  # concurrency-ok[blocking-under-lock]: reason
+
+(on the flagged line or the line above) — waived findings downgrade to
+INFO severity so ``tools/concurrency_lint.py --strict`` stays green
+while still reporting them.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..observability.locks import (  # noqa: F401  (public facade)
+    LockOrderGraph,
+    LockRegistry,
+    SanitizedCondition,
+    SanitizedLock,
+    SanitizedRLock,
+    assert_clean,
+    clear_delays,
+    clear_findings,
+    declare_hierarchy,
+    findings,
+    install_delays,
+    named_condition,
+    named_lock,
+    named_rlock,
+    registry as lock_registry,
+    sanctioned,
+    sanitizing,
+)
+from ..observability.locks import disable as disable_sanitizer  # noqa: F401
+from ..observability.locks import enable as enable_sanitizer  # noqa: F401
+from .diagnostics import ERROR, INFO, WARNING, Diagnostic, Diagnostics
+from .lint import LintRule, register_lint_rule
+
+__all__ = [
+    "LockOrderGraph",
+    "LockRegistry",
+    "SanitizedCondition",
+    "SanitizedLock",
+    "SanitizedRLock",
+    "SourceContext",
+    "assert_clean",
+    "clear_delays",
+    "clear_findings",
+    "declare_hierarchy",
+    "disable_sanitizer",
+    "enable_sanitizer",
+    "findings",
+    "install_delays",
+    "lint_sources",
+    "lock_registry",
+    "named_condition",
+    "named_lock",
+    "named_rlock",
+    "sanctioned",
+    "sanitizing",
+    "seed_runtime_graph",
+    "static_graph",
+]
+
+_WAIVER_RE = re.compile(
+    r"#\s*concurrency-ok\[([a-z\-]+)\]\s*:\s*(.+?)\s*$")
+
+_KIND_BY_CTOR = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+_KIND_BY_FACTORY = {"named_lock": "lock", "named_rlock": "rlock",
+                    "named_condition": "condition"}
+# methods whose zero-arg/no-timeout call blocks unboundedly
+_SOCKET_APIS = ("recv", "sendall", "accept")
+_FRAME_IO = ("read_frame", "write_frame")
+
+
+def _call_name(func):
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _lock_ctor(call):
+    """Classify an ast.Call as a lock constructor.
+    Returns (kind, explicit_name, allow_blocking) or None."""
+    if not isinstance(call, ast.Call):
+        return None
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "threading" and f.attr in _KIND_BY_CTOR:
+        return _KIND_BY_CTOR[f.attr], None, False
+    fname = _call_name(f)
+    if fname in _KIND_BY_CTOR and isinstance(f, ast.Name):
+        return _KIND_BY_CTOR[fname], None, False
+    if fname in _KIND_BY_FACTORY:
+        name = None
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            name = call.args[0].value
+        allow = any(
+            kw.arg == "allow_blocking" and isinstance(kw.value, ast.Constant)
+            and bool(kw.value.value) for kw in call.keywords)
+        return _KIND_BY_FACTORY[fname], name, allow
+    return None
+
+
+class _FileFacts:
+    """Everything one source file contributes to the analysis."""
+
+    def __init__(self, path, rel, tree, lines):
+        self.path = path
+        self.rel = rel
+        self.tree = tree
+        self.lines = lines
+        self.module_locks = {}      # var -> (name, kind, allow)
+        self.class_locks = {}       # class -> {attr -> (name, kind, allow)}
+        self.edges = []             # (held, acq, line, held_line)
+        self.blocking = []          # (api, inner_name, line, held_names)
+        self.signal_unsafe = []     # (lock_name, handler, reg_line, acq_line)
+
+    def waiver(self, lineno, code):
+        """The waiver reason if `lineno` (1-based) or the line above
+        carries a matching ``# concurrency-ok[code]:`` pragma."""
+        for ln in (lineno, lineno - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _WAIVER_RE.search(self.lines[ln - 1])
+                if m and m.group(1) == code:
+                    return m.group(2)
+        return None
+
+
+class _LockDefCollector(ast.NodeVisitor):
+    """Pass A: resolve lock definitions to logical names."""
+
+    def __init__(self, facts):
+        self.facts = facts
+        self._class = None
+
+    def _default_name(self, attr):
+        scope = self._class + "." if self._class else ""
+        return "%s:%s%s" % (self.facts.rel, scope, attr)
+
+    def visit_ClassDef(self, node):
+        prev, self._class = self._class, node.name
+        self.facts.class_locks.setdefault(node.name, {})
+        self.generic_visit(node)
+        self._class = prev
+
+    def visit_Assign(self, node):
+        ctor = _lock_ctor(node.value)
+        if ctor:
+            kind, name, allow = ctor
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    key = name or self._default_name(tgt.id)
+                    if self._class:
+                        self.facts.class_locks[self._class][tgt.id] = \
+                            (key, kind, allow)
+                    else:
+                        self.facts.module_locks[tgt.id] = (key, kind, allow)
+                elif isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self" and self._class:
+                    key = name or self._default_name(tgt.attr)
+                    self.facts.class_locks[self._class][tgt.attr] = \
+                        (key, kind, allow)
+        self.generic_visit(node)
+
+
+class _FlowWalker(ast.NodeVisitor):
+    """Pass B: with-nesting edges + blocking calls under held locks."""
+
+    def __init__(self, facts):
+        self.facts = facts
+        self._class = None
+        self._held = []             # [(lock_name, line)]
+
+    # -- resolution --------------------------------------------------------
+    def _resolve(self, expr):
+        """Logical lock name for a `with` subject / call receiver."""
+        if isinstance(expr, ast.Name):
+            rec = self.facts.module_locks.get(expr.id)
+            return rec[0] if rec else None
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                          ast.Name):
+            base = expr.value.id
+            if base in ("self", "cls"):
+                cls = self.facts.class_locks.get(self._class, {})
+                rec = cls.get(expr.attr)
+                return rec[0] if rec else None
+            cls = self.facts.class_locks.get(base)
+            if cls:
+                rec = cls.get(expr.attr)
+                return rec[0] if rec else None
+        return None
+
+    # -- scope handling ----------------------------------------------------
+    def visit_ClassDef(self, node):
+        prev, self._class = self._class, node.name
+        self.generic_visit(node)
+        self._class = prev
+
+    def _visit_func(self, node):
+        # a def body runs later, not under the enclosing with
+        prev, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = prev
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_With(self, node):
+        pushed = 0
+        for item in node.items:
+            name = self._resolve(item.context_expr)
+            if name is not None:
+                line = item.context_expr.lineno
+                for held_name, held_line in self._held:
+                    if held_name != name:
+                        self.facts.edges.append(
+                            (held_name, name, line, held_line))
+                self._held.append((name, line))
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self._held.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- blocking patterns -------------------------------------------------
+    def _classify(self, node):
+        """The blocking API name this call matches, or None."""
+        f = node.func
+        no_args = not node.args and not node.keywords
+        has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            base_id = base.id if isinstance(base, ast.Name) else None
+            if base_id == "time" and f.attr == "sleep":
+                return "time.sleep"
+            if base_id == "os" and f.attr in ("read", "write"):
+                return "os." + f.attr
+            if base_id == "subprocess":
+                return "subprocess." + f.attr
+            if f.attr in _SOCKET_APIS:
+                return "socket." + f.attr
+            if f.attr == "block_until_ready":
+                return "block_until_ready"
+            if f.attr == "get" and no_args:
+                return ".get() without timeout"
+            if f.attr == "communicate" and not has_timeout:
+                return ".communicate() without timeout"
+            if f.attr == "join" and no_args:
+                return ".join() without timeout"
+            if f.attr == "wait" and not node.args and not has_timeout:
+                # the canonical `while not ready: cv.wait()` on the
+                # condition you HOLD is fine — the runtime half flags
+                # it only when OTHER locks are held
+                recv = self._resolve(base)
+                if recv is not None and any(recv == h for h, _ in
+                                            self._held):
+                    return None
+                return ".wait() without timeout"
+        elif isinstance(f, ast.Name) and f.id in _FRAME_IO:
+            return f.id + " (pipe I/O)"
+        return None
+
+    def visit_Call(self, node):
+        if self._held:
+            api = self._classify(node)
+            if api:
+                inner = self._held[-1]
+                self.facts.blocking.append(
+                    (api, inner[0], node.lineno,
+                     tuple(h for h, _ in self._held)))
+        self.generic_visit(node)
+
+
+class _SignalCollector(ast.NodeVisitor):
+    """Pass C: signal.signal handlers that take non-reentrant locks.
+
+    Follows the handler body depth-2: the handler itself plus
+    same-class/same-module helpers it calls."""
+
+    def __init__(self, facts):
+        self.facts = facts
+        self._class = None
+        self._module_funcs = {}
+        self._methods = {}          # class -> {name: node}
+
+    def collect_defs(self, tree):
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                self._module_funcs[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self._methods[node.name] = {
+                    n.name: n for n in node.body
+                    if isinstance(n, ast.FunctionDef)}
+
+    def visit_ClassDef(self, node):
+        prev, self._class = self._class, node.name
+        self.generic_visit(node)
+        self._class = prev
+
+    def _plain_lock_acquisitions(self, func_node):
+        """(lock_name, line) for every non-reentrant registered lock
+        this function's body acquires via `with` or .acquire()."""
+        out = []
+        cls_locks = self.facts.class_locks.get(self._class, {})
+
+        def resolve(expr):
+            if isinstance(expr, ast.Name):
+                return self.facts.module_locks.get(expr.id)
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name):
+                if expr.value.id in ("self", "cls"):
+                    return cls_locks.get(expr.attr)
+                other = self.facts.class_locks.get(expr.value.id, {})
+                return other.get(expr.attr)
+            return None
+
+        for sub in ast.walk(func_node):
+            rec = None
+            line = None
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    rec = resolve(item.context_expr)
+                    line = item.context_expr.lineno
+                    if rec:
+                        break
+            elif isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "acquire":
+                rec = resolve(sub.func.value)
+                line = sub.lineno
+            if rec and rec[1] == "lock":
+                out.append((rec[0], line))
+        return out
+
+    def _called_helpers(self, func_node):
+        helpers = []
+        methods = self._methods.get(self._class, {})
+        for sub in ast.walk(func_node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if isinstance(f, ast.Attribute) and isinstance(f.value,
+                                                           ast.Name) \
+                    and f.value.id == "self" and f.attr in methods:
+                helpers.append(methods[f.attr])
+            elif isinstance(f, ast.Name) and f.id in self._module_funcs:
+                helpers.append(self._module_funcs[f.id])
+        return helpers
+
+    def visit_Call(self, node):
+        f = node.func
+        is_reg = (isinstance(f, ast.Attribute) and f.attr == "signal"
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id == "signal" and len(node.args) >= 2)
+        if is_reg:
+            handler = node.args[1]
+            target = None
+            desc = None
+            if isinstance(handler, ast.Attribute) \
+                    and isinstance(handler.value, ast.Name) \
+                    and handler.value.id == "self":
+                target = self._methods.get(self._class, {}).get(handler.attr)
+                desc = "self.%s" % handler.attr
+            elif isinstance(handler, ast.Name):
+                target = self._module_funcs.get(handler.id)
+                desc = handler.id
+            if target is not None:
+                seen = {id(target)}
+                frontier = [target]
+                for _depth in range(2):
+                    nxt = []
+                    for fn in frontier:
+                        for lock_name, line in \
+                                self._plain_lock_acquisitions(fn):
+                            self.facts.signal_unsafe.append(
+                                (lock_name, desc, node.lineno, line))
+                        for h in self._called_helpers(fn):
+                            if id(h) not in seen:
+                                seen.add(id(h))
+                                nxt.append(h)
+                    frontier = nxt
+        self.generic_visit(node)
+
+
+class SourceContext:
+    """Parsed sources + extracted concurrency facts for one lint run."""
+
+    def __init__(self, files=None, root=None):
+        if files is None:
+            root = root or os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            files = []
+            for dirpath, _dirs, names in os.walk(root):
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        files.append(os.path.join(dirpath, n))
+        self.repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        self.files = []
+        self.parse_errors = []
+        for path in files:
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+                tree = ast.parse(src, filename=path)
+            except (OSError, SyntaxError) as e:
+                self.parse_errors.append((path, str(e)))
+                continue
+            rel = os.path.relpath(path, self.repo_root)
+            if rel.startswith(".."):
+                rel = os.path.basename(path)
+            facts = _FileFacts(path, rel, tree, src.splitlines())
+            _LockDefCollector(facts).visit(tree)
+            _FlowWalker(facts).visit(tree)
+            sig = _SignalCollector(facts)
+            sig.collect_defs(tree)
+            sig.visit(tree)
+            self.files.append(facts)
+
+
+def static_graph(ctx):
+    """The lock-order graph extracted from source alone."""
+    graph = LockOrderGraph()
+    for f in ctx.files:
+        for held, acq, line, held_line in f.edges:
+            graph.add_edge(held, acq,
+                           where="%s:%d (outer %s held since :%d)"
+                           % (f.rel, line, held, held_line))
+    return graph
+
+
+def seed_runtime_graph(ctx=None, registry=None):
+    """Seed the runtime sanitizer's graph with statically extracted
+    edges, so a drill that only ever executes ONE of two conflicting
+    orders still reports the inversion the source proves possible."""
+    reg = registry if registry is not None else lock_registry()
+    ctx = ctx or SourceContext()
+    with reg._meta:
+        for f in ctx.files:
+            for held, acq, line, _hl in f.edges:
+                reg.graph.add_edge(held, acq,
+                                   where="%s:%d" % (f.rel, line))
+    return reg
+
+
+def _finding(facts, severity, code, message, line, var_names, provenance):
+    reason = facts.waiver(line, code)
+    if reason is not None:
+        severity = INFO
+        message = "waived (%s): %s" % (reason, message)
+    return Diagnostic(severity, code, message, var_names=var_names,
+                      provenance=["%s:%d" % (facts.rel, line)] + provenance,
+                      pass_name="concurrency-lint")
+
+
+@register_lint_rule
+class StaticLockOrderRule(LintRule):
+    """AB/BA inversion proved from nested `with` blocks alone."""
+
+    name = "lock-order-inversion"
+    severity = ERROR
+    category = "concurrency"
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        graph = LockOrderGraph()
+        sites = {}          # (a, b) -> (facts, line)
+        reported = set()
+        for f in ctx.files:
+            for held, acq, line, held_line in f.edges:
+                sites.setdefault((held, acq), (f, line))
+                cycle = graph.add_edge(
+                    held, acq, where="%s:%d" % (f.rel, line))
+                if not cycle or len(cycle) < 2:
+                    continue
+                key = tuple(sorted((held, acq)))
+                if key in reported:
+                    continue
+                reported.add(key)
+                prov = ["conflicting order %s -> %s" % (held, acq)]
+                for a, b in zip(cycle, cycle[1:]):
+                    sf, sl = sites.get((a, b), (None, None))
+                    prov.append("  reverse order %s -> %s at %s" % (
+                        a, b, "%s:%d" % (sf.rel, sl) if sf else "?"))
+                diags.items.append(_finding(
+                    f, self.severity, self.name,
+                    "nested `with` acquires %r while holding %r, but "
+                    "the reverse order (%s) also appears in the tree — "
+                    "AB/BA inversion, a potential deadlock"
+                    % (acq, held, " -> ".join(cycle)),
+                    line, (held, acq), prov))
+        return diags
+
+
+@register_lint_rule
+class StaticBlockingUnderLockRule(LintRule):
+    """Blocking-call pattern lexically inside a `with lock:` body."""
+
+    name = "blocking-under-lock"
+    severity = WARNING
+    category = "concurrency"
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        for f in ctx.files:
+            for api, inner, line, held in f.blocking:
+                diags.items.append(_finding(
+                    f, self.severity, self.name,
+                    "%s under `with %s:` — an unbounded block while "
+                    "holding a lock is the requeue-deadlock shape; use "
+                    "a timeout or move it outside the lock"
+                    % (api, inner),
+                    line, held, []))
+        return diags
+
+
+@register_lint_rule
+class StaticSignalUnsafeLockRule(LintRule):
+    """Non-reentrant lock acquired inside a signal handler."""
+
+    name = "signal-unsafe-lock"
+    severity = ERROR
+    category = "concurrency"
+
+    def check(self, ctx):
+        diags = Diagnostics()
+        seen = set()
+        for f in ctx.files:
+            for lock_name, handler, reg_line, acq_line in f.signal_unsafe:
+                key = (f.rel, lock_name, handler)
+                if key in seen:
+                    continue
+                seen.add(key)
+                diags.items.append(_finding(
+                    f, self.severity, self.name,
+                    "signal handler %s acquires non-reentrant lock %r "
+                    "— a signal landing while this thread holds it "
+                    "deadlocks the process (use an RLock or defer to "
+                    "a worker thread)" % (handler, lock_name),
+                    acq_line or reg_line, (lock_name,),
+                    ["handler registered at %s:%d" % (f.rel, reg_line)]))
+        return diags
+
+
+def lint_sources(root=None, files=None, rules=None):
+    """Run the static concurrency rules over `paddle_tpu/` sources
+    (or an explicit file list).  Returns :class:`Diagnostics`; waived
+    findings are INFO severity."""
+    from .lint import get_lint_rule, lint_rules
+    ctx = SourceContext(files=files, root=root)
+    diags = Diagnostics()
+    selected = rules if rules is not None \
+        else lint_rules(category="concurrency")
+    for r in selected:
+        rule = r if isinstance(r, LintRule) else get_lint_rule(r)
+        diags.extend(rule.check(ctx))
+    return diags

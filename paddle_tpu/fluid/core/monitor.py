@@ -10,9 +10,9 @@ plain host-side dict the framework increments at interesting points
 
 from __future__ import annotations
 
-import threading
+from ...observability import locks as _locks
 
-_lock = threading.Lock()
+_lock = _locks.named_lock("fluid.monitor.stats", level="metrics")
 _stats: dict[str, int] = {}
 
 

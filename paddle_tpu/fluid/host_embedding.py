@@ -58,6 +58,8 @@ import time
 
 import numpy as np
 
+from ..observability import locks as _locks
+
 __all__ = [
     "HostEmbedding",
     "HostEmbeddingSession",
@@ -244,7 +246,7 @@ class HotRowCache:
         # (insert/evict, serial with itself), the push lane reads the
         # index and writes values — the lock keeps index+value reads
         # consistent and makes eviction write-back atomic vs peeks
-        self.lock = threading.RLock()
+        self.lock = _locks.named_rlock("host_embedding.table")
         C, D = self.capacity, table.dim
         self._ids = np.full(C, -1, np.int64)          # -1 = empty slot
         self._freq = np.zeros(C, np.int64)
@@ -1022,7 +1024,7 @@ class _Lane:
         self._handler = handler
         self._on_error = on_error
         self._ops = []
-        self._cv = threading.Condition()
+        self._cv = _locks.named_condition("host_embedding.worker")
         self._thread = threading.Thread(target=self._loop, name=name,
                                         daemon=True)
         self._thread.start()

@@ -74,6 +74,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..fluid import framework
+from ..observability import locks as _locks
 from ..observability import trace as _trace
 from ..observability.metrics import default_registry, unique_instance_label
 from .kv_cache import KVCache, PagedKVCache, PoolExhausted, PrefixCache
@@ -106,7 +107,10 @@ class EngineDeadError(RuntimeError):
 # invocation serializes that window; compiled-cache hits pay only an
 # uncontended acquire (in-process replicas share a device anyway — real
 # parallel engines are separate processes/chips behind the fleet).
-_TRACE_LOCK = threading.Lock()
+# named but UNLEVELED: it nests inside the engine lock, and jit
+# tracing fires jax.monitoring -> metrics updates underneath it, so
+# only cycle detection (not the ordered hierarchy) applies
+_TRACE_LOCK = _locks.named_lock("generation.trace")
 
 
 def _shed_error(reason, retry_after_s, detail):
@@ -421,8 +425,12 @@ class GenerationEngine:
         self._chunking = [None] * n            # _ChunkState | None
         self._free = list(range(n))
         self._pending = []                     # [(request, handle)]
-        self._lock = threading.RLock()
-        self._work = threading.Condition(self._lock)
+        self._lock = _locks.named_rlock("generation.engine",
+                                        level="engine")
+        # the work-available condition SHARES the engine lock — one
+        # graph node, one critical section
+        self._work = _locks.named_condition(
+            "generation.engine", lock=self._lock)
         self._dead = False
         self._stop = False
         self._thread = None

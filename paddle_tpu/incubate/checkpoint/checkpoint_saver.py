@@ -38,6 +38,7 @@ import zlib
 import numpy as np
 
 from ...fluid.fs import LocalFS
+from ...observability import locks as _locks
 
 META_FILE = "meta.json"
 _TMP_PREFIX = ".tmp_checkpoint_"
@@ -823,7 +824,7 @@ class AsyncCheckpointSaver:
         self._thread = None
         self._error = None
         self._last_no = None
-        self._lock = threading.Lock()
+        self._lock = _locks.named_lock("checkpoint.async_state")
 
     @property
     def in_flight(self):

@@ -80,6 +80,15 @@ class FaultPlan:
           injected tail-latency event the SLO engine must catch (ITL
           alert fires) and clear once clean traffic resumes
           (`replica_stall`).
+      {"kind": "lock_delay", "rank": r, "lock": "serving.router.cond",
+       "seconds": 0.05, "times": k}
+          concurrency drill: the named registry lock
+          (`observability.locks`) sleeps `seconds` right after each of
+          its next `k` acquisitions on rank r — deterministically
+          widening a race window so ordering bugs that need an unlucky
+          interleaving reproduce every run (`arm_lock_delays`).  The
+          injected sleep bypasses the sanitizer's blocking-under-lock
+          check: the delay is the drill, not a finding.
 
     Every event also takes `"gen": g` (default 0): it fires only in
     that elastic generation, so a drill's fault does not re-fire in
@@ -180,6 +189,28 @@ class FaultPlan:
                 return (int(e.get("step", 1)),
                         float(e.get("seconds", 0.1)))
         return None
+
+    # -- lock-seam faults -------------------------------------------------
+    def lock_delays(self):
+        """This rank's ``lock_delay`` events, normalized for
+        ``observability.locks.install_delays``."""
+        return [
+            {"lock": str(e.get("lock", "")),
+             "seconds": float(e.get("seconds", 0.0)),
+             "times": int(e.get("times", 1))}
+            for e in self._mine("lock_delay")
+        ]
+
+    def arm_lock_delays(self, registry=None):
+        """Arm this plan's ``lock_delay`` events on the named-lock
+        registry (the process-wide default unless given).  Returns the
+        armed event count."""
+        events = self.lock_delays()
+        if events:
+            from ...observability import locks
+
+            (registry or locks.registry()).install_delays(events)
+        return len(events)
 
     # -- FS-seam faults ---------------------------------------------------
     def wrap_fs(self, fs=None):

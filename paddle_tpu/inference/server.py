@@ -48,6 +48,7 @@ from collections import OrderedDict, deque
 
 import numpy as np
 
+from ..observability import locks as _locks
 from ..observability import trace as _trace
 from ..observability.metrics import default_registry, unique_instance_label
 from .batching import BatchingConfig
@@ -151,7 +152,8 @@ class InferenceServer:
         self._sig_costs = {}     # feed signature -> cost_analysis dict
         self._pending = OrderedDict()    # signature -> deque[_Request]
         self._inflight = 0       # requests taken off pending, not done
-        self._plock = threading.Lock()   # dispatcher mutates, stats read
+        # dispatcher mutates, stats read
+        self._plock = _locks.named_lock("inference.server.state")
         self._seq = itertools.count()
         self._dispatcher = None
         self._completer = None

@@ -57,7 +57,7 @@ from .system import SystemMetricsSampler  # noqa: F401
 # The instrumented hot paths load the (stdlib-only) modules once at
 # first use — first timed step / Executor.run / served request — not
 # at package import.
-_LAZY_MODULES = ("trace", "flight_recorder", "xla_cost", "slo")
+_LAZY_MODULES = ("trace", "flight_recorder", "xla_cost", "slo", "locks")
 _LAZY_NAMES = {
     # name -> submodule it lives in
     "Tracer": "trace",

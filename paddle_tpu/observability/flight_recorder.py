@@ -42,6 +42,7 @@ import threading
 import time
 from collections import deque
 
+from . import locks as _locks
 from . import step_timer as _step_timer
 from . import trace as _trace
 
@@ -49,7 +50,7 @@ __all__ = ["FlightRecorder", "install_flight_recorder"]
 
 DUMP_DIR_ENV = "PADDLE_TPU_FLIGHT_DIR"
 
-_install_lock = threading.Lock()
+_install_lock = _locks.named_lock("observability.flight.install")
 _installed = None  # the process-wide recorder, if armed
 
 
@@ -78,7 +79,7 @@ class FlightRecorder:
         # dump() from the handler; a plain Lock would deadlock the
         # handler against the interrupted frame and the process would
         # ignore its own SIGTERM
-        self._lock = threading.RLock()
+        self._lock = _locks.named_rlock("observability.flight.recorder")
         self._dumped_reasons = []
         self._auto_dumped = False
         self._prev_handlers = {}

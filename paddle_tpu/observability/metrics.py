@@ -30,7 +30,8 @@ Design notes (TPU-first, host-side):
 from __future__ import annotations
 
 import itertools
-import threading
+
+from . import locks
 
 __all__ = [
     "Counter",
@@ -77,7 +78,8 @@ class _MetricBase:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock(
+            "observability.metrics.family", level="metrics")
         self._children = {}          # labelvalues tuple -> child
         self._labelvalues = ()       # set on children
         self._is_child = False
@@ -353,7 +355,8 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock(
+            "observability.metrics.registry", level="metrics")
         self._metrics = {}           # name -> family
 
     # -- registration ----------------------------------------------------
@@ -450,7 +453,8 @@ def default_registry():
 # so independent component instances (two InferenceServers, two
 # PipelineStats) each own their series in the shared registry
 _instance_seq = itertools.count()
-_instance_lock = threading.Lock()
+_instance_lock = locks.named_lock(
+    "observability.metrics.instance", level="metrics")
 _instance_used = set()
 
 

@@ -35,6 +35,8 @@ from __future__ import annotations
 
 import json
 import threading
+
+from . import locks
 import time
 from collections import deque
 
@@ -145,7 +147,7 @@ class SLOEngine:
         self.burn_windows = tuple(float(w) for w in burn_windows)
         self._clock = clock
         self._records = deque(maxlen=max(int(window), 8))
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("observability.slo.state")
         self._alerts = {}            # objective name -> fired-at t_wall
         if registry is None:
             from .metrics import default_registry

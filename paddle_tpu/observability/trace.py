@@ -40,6 +40,8 @@ import itertools
 import json
 import os
 import threading
+
+from . import locks
 import time
 from collections import deque
 
@@ -167,7 +169,8 @@ class Tracer:
         self._enabled = bool(enabled)
         self._events = deque(maxlen=max(int(capacity), 16))
         self._pid = os.getpid() if pid is None else int(pid)
-        self._meta_lock = threading.Lock()
+        self._meta_lock = locks.named_lock(
+            "observability.trace.meta", level="tracer")
         self._named_tids = set()
         self._meta_events = []
         self.anchor = (time.time(), _now())

@@ -45,6 +45,7 @@ from ..generation import (
     GenerationRequest,
     SamplingParams,
 )
+from ..observability import locks as _locks
 from ..observability import trace as _trace
 from ..observability.metrics import default_registry, unique_instance_label
 from .admission import ShedError
@@ -80,7 +81,10 @@ class GenerationReplica:
                 # injected latency (the SLO drill): the decode step
                 # stalls ONCE, inflating ITL for in-flight requests
                 stalled[0] = True
-                time.sleep(stall[1])
+                # sanctioned: the stall deliberately blocks under the
+                # engine lock — that latency spike IS the drill
+                with _locks.sanctioned():
+                    time.sleep(stall[1])
             if kill_at is not None and step_no + 1 >= kill_at:
                 raise EngineDeadError(
                     "%s: injected death at decode step %d"
@@ -133,7 +137,15 @@ class GenerationFleet:
         self.metrics_registry = reg
         self.name = name
         self._fleet = unique_instance_label(name)
-        self._lock = threading.RLock()
+        # router-level fleet lock: NEVER held across engine.submit
+        # (see submit() — the engine-death requeue path nests the
+        # other way)
+        self._lock = _locks.named_rlock(
+            "serving.generation.fleet", level="router")
+        if fault_plan is not None:
+            # lock_delay events widen declared race windows for the
+            # whole drill (observability.locks.install_delays)
+            fault_plan.arm_lock_delays()
         # the fleet's SLO engine: every replica's per-request records
         # flow into its rolling window (GET /slo, serving_ctl slo, the
         # regression sentinel's live summary)

@@ -25,6 +25,8 @@ import threading
 import time
 import zlib
 
+from ..observability import locks as _locks
+
 __all__ = [
     "DeployError",
     "ModelRegistry",
@@ -98,7 +100,8 @@ class ModelRegistry:
     """Versions + routing pointers; every mutation validated and locked."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = _locks.named_rlock(
+            "serving.registry.lock", level="registry")
         self._versions = {}
         self.stable = None           # version name serving default traffic
         self.previous_stable = None  # rollback target (if kept on standby)

@@ -36,6 +36,8 @@ import threading
 
 import numpy as np
 
+from ..observability import locks as _locks
+
 __all__ = [
     "InProcessReplica",
     "ProcessReplica",
@@ -253,7 +255,11 @@ class ProcessReplica(Replica):
     def __init__(self, model_dir, index=0, version="v", env=None,
                  load_timeout=120.0):
         super().__init__(index, version)
-        self._lock = threading.Lock()   # one in-flight frame at a time
+        # one in-flight frame at a time; allow_blocking: the pipe
+        # roundtrip IS the serialized critical section by design
+        self._lock = _locks.named_lock(
+            "serving.replica.pipe", level="replica",
+            allow_blocking=True)
         self._dead = False
         self.feed_names = None
 
@@ -307,7 +313,9 @@ class ProcessReplica(Replica):
             if self._dead:
                 raise ReplicaDeadError("%s is dead" % self.replica_id)
             try:
+                # concurrency-ok[blocking-under-lock]: the pipe roundtrip IS the serialized critical section; a dead worker surfaces as EOF, never a hang
                 write_frame(self._w, msg)
+                # concurrency-ok[blocking-under-lock]: same frame transaction as the write above
                 reply = read_frame(self._r)
             except (OSError, ValueError):
                 reply = None

@@ -44,6 +44,7 @@ from collections import OrderedDict, deque
 import numpy as np
 
 from ..inference.batching import BatchingConfig
+from ..observability import locks as _locks
 from ..observability import trace as _trace
 from ..observability.metrics import default_registry, unique_instance_label
 from .admission import AdmissionController, ShedError
@@ -134,7 +135,10 @@ class Router:
         self._admission = admission or AdmissionController()
         self._predictor_factory = predictor_factory
         self._max_shadow_backlog = int(max_shadow_backlog_rows)
-        self._cond = threading.Condition()
+        # router-level: held across queue state only; dispatch to
+        # replicas happens OUTSIDE it (see _dispatch_loop)
+        self._cond = _locks.named_condition(
+            "serving.router.cond", level="router")
         self._rt = {}                   # version -> _VersionRuntime
         self._seq = itertools.count()
         self._stop_all = False

@@ -32,6 +32,7 @@ from __future__ import annotations
 import threading
 
 from ..generation.engine import GenerationRequest
+from ..observability import locks as _locks
 
 __all__ = [
     "DisaggPair",
@@ -111,7 +112,7 @@ class DisaggPair:
         self.prefill = prefill_engine
         self.decode = decode_engine
         self.group_id = int(group_id)
-        self._lock = threading.Lock()
+        self._lock = _locks.named_lock("tp_serving.disagg.group")
         if metrics_registry is None:
             from ..observability.metrics import default_registry
 
@@ -212,7 +213,7 @@ class ShardGroupFleet:
         if not groups:
             raise ValueError("need at least one shard group")
         self.groups = list(groups)
-        self._lock = threading.Lock()
+        self._lock = _locks.named_lock("tp_serving.disagg.fleet")
         if metrics_registry is None:
             from ..observability.metrics import default_registry
 

@@ -1,0 +1,787 @@
+"""The concurrency sanitizer: runtime lock-order/blocking/signal checks,
+the static AST lint, and the instrumented fleet drills.
+
+Mirrors the PR-5 static-analysis style: take a known-good shape, seed
+exactly one defect, and assert exactly that diagnostic fires — code,
+lock names, and both acquisition stacks — then assert the clean shape
+reports nothing.  The drill section runs the real serving / generation
+/ streaming / RL paths under the armed sanitizer and asserts ZERO
+findings (the acceptance bar for the shipped tree).
+"""
+
+import contextlib
+import queue
+import signal
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import models
+from paddle_tpu.analysis import concurrency
+from paddle_tpu.analysis.diagnostics import ERROR, INFO, WARNING
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.incubate.fault import FaultPlan
+from paddle_tpu.observability import locks
+
+gen = paddle_tpu.generation
+serving = paddle_tpu.serving
+
+CFG = models.TransformerLMConfig.tiny()
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer: seeded defects on private registries
+# ---------------------------------------------------------------------------
+
+
+def _fresh(hierarchy=True):
+    reg = locks.LockRegistry()
+    if hierarchy:
+        reg.declare_hierarchy(("router", "registry", "replica", "engine"),
+                              leaf=("tracer", "metrics"))
+    return reg
+
+
+def _acquire_ab(lock_a, lock_b):
+    with lock_a:
+        with lock_b:
+            pass
+
+
+def _acquire_ba(lock_a, lock_b):
+    with lock_b:
+        with lock_a:
+            pass
+
+
+class TestRuntimeLockOrder:
+    def test_ab_ba_inversion_reports_both_stacks(self):
+        """The tentpole case: A->B on one thread, B->A on another is
+        reported as a potential deadlock BEFORE anything hangs, naming
+        both locks and carrying both acquisition stacks."""
+        reg = _fresh()
+        a = reg.named_lock("drill.A")
+        b = reg.named_lock("drill.B")
+        with reg.sanitizing(blocking=False):
+            _acquire_ab(a, b)
+            t = threading.Thread(target=_acquire_ba, args=(a, b))
+            t.start()
+            t.join()
+        (d,) = reg.findings()
+        assert d.code == "lock-order-inversion"
+        assert d.severity == ERROR
+        assert set(d.var_names) == {"drill.A", "drill.B"}
+        prov = "\n".join(d.provenance)
+        # both stacks: the historical A->B order and the conflicting
+        # B->A order each carry their acquisition frames
+        assert "_acquire_ab" in prov, prov
+        assert "_acquire_ba" in prov, prov
+        assert "previously observed order" in prov
+        assert "conflicting order" in prov
+
+    def test_same_order_twice_is_clean(self):
+        reg = _fresh()
+        a = reg.named_lock("ok.A")
+        b = reg.named_lock("ok.B")
+        with reg.sanitizing(blocking=False):
+            _acquire_ab(a, b)
+            t = threading.Thread(target=_acquire_ab, args=(a, b))
+            t.start()
+            t.join()
+        reg.assert_clean()
+
+    def test_three_lock_cycle_detected_transitively(self):
+        """A->B, B->C, then C->A: no single pair inverts, the cycle
+        only closes through the graph."""
+        reg = _fresh()
+        a, b, c = (reg.named_lock("cyc.%s" % s) for s in "ABC")
+        with reg.sanitizing(blocking=False):
+            _acquire_ab(a, b)
+            _acquire_ab(b, c)
+            _acquire_ab(c, a)
+        codes = [d.code for d in reg.findings()]
+        assert codes == ["lock-order-inversion"]
+        prov = "\n".join(reg.findings()[0].provenance)
+        assert "cyc.A -> cyc.B -> cyc.C" in prov.replace("'", ""), prov
+
+    def test_rlock_reacquire_adds_no_edge(self):
+        reg = _fresh()
+        r = reg.named_rlock("re.R")
+        with reg.sanitizing(blocking=False):
+            with r:
+                with r:
+                    pass
+        reg.assert_clean()
+        assert list(reg.graph.edges()) == []
+
+    def test_hierarchy_violation_reported(self):
+        """Holding an engine-level lock while acquiring a router-level
+        one inverts the declared partial order even if no second thread
+        ever takes the reverse path."""
+        reg = _fresh()
+        e = reg.named_lock("h.engine", level="engine")
+        r = reg.named_lock("h.router", level="router")
+        with reg.sanitizing(blocking=False):
+            with e:
+                with r:
+                    pass
+        codes = {d.code for d in reg.findings()}
+        assert "lock-hierarchy" in codes
+        d = next(x for x in reg.findings() if x.code == "lock-hierarchy")
+        assert set(d.var_names) == {"h.engine", "h.router"}
+
+    def test_hierarchy_descending_order_is_clean(self):
+        reg = _fresh()
+        r = reg.named_lock("ok.router", level="router")
+        e = reg.named_lock("ok.engine", level="engine")
+        with reg.sanitizing(blocking=False):
+            with r:
+                with e:
+                    pass
+        reg.assert_clean()
+
+    def test_leaf_level_must_not_hold_across_other_locks(self):
+        reg = _fresh()
+        m = reg.named_lock("leaf.metrics", level="metrics")
+        x = reg.named_lock("leaf.other")
+        with reg.sanitizing(blocking=False):
+            with x:
+                with m:     # acquiring a leaf while holding: fine
+                    pass
+        reg.assert_clean()
+        with reg.sanitizing(blocking=False):
+            with m:
+                with x:     # holding a leaf across another lock: not
+                    pass
+        assert any(d.code == "lock-hierarchy" for d in reg.findings())
+
+
+class TestRuntimeBlocking:
+    def test_sleep_under_lock_flagged(self):
+        reg = _fresh()
+        lk = reg.named_lock("blk.L")
+        with reg.sanitizing():
+            with lk:
+                time.sleep(0.001)
+        (d,) = reg.findings()
+        assert d.code == "blocking-under-lock"
+        assert d.severity == WARNING
+        assert "time.sleep" in d.message
+        assert "blk.L" in d.var_names
+        prov = "\n".join(d.provenance)
+        assert "holding" in prov and "blocking call at" in prov
+
+    def test_sleep_outside_lock_clean(self):
+        reg = _fresh()
+        lk = reg.named_lock("blk.M")
+        with reg.sanitizing():
+            with lk:
+                pass
+            time.sleep(0.001)
+        reg.assert_clean()
+
+    def test_no_timeout_queue_get_flagged_timed_get_clean(self):
+        reg = _fresh()
+        lk = reg.named_lock("blk.Q")
+        q = queue.Queue()
+        q.put(1)
+        q.put(2)
+        with reg.sanitizing():
+            with lk:
+                q.get(timeout=1)        # bounded: fine
+            reg.assert_clean()
+            with lk:
+                q.get()                 # unbounded under lock: flagged
+        (d,) = reg.findings()
+        assert d.code == "blocking-under-lock"
+        assert "queue.Queue.get" in d.message
+
+    def test_blocking_pipe_io_under_lock_flagged(self):
+        import os as _os
+
+        reg = _fresh()
+        lk = reg.named_lock("blk.P")
+        rfd, wfd = _os.pipe()
+        try:
+            with reg.sanitizing():
+                with lk:
+                    _os.write(wfd, b"x")
+                    _os.read(rfd, 1)
+        finally:
+            _os.close(rfd)
+            _os.close(wfd)
+        codes = [d.code for d in reg.findings()]
+        assert codes == ["blocking-under-lock"] * 2
+        apis = {d.message.split(" called")[0] for d in reg.findings()}
+        assert apis == {"os.write", "os.read"}
+
+    def test_event_wait_no_timeout_flagged(self):
+        reg = _fresh()
+        lk = reg.named_lock("blk.E")
+        ev = threading.Event()
+        ev.set()
+        with reg.sanitizing():
+            with lk:
+                ev.wait(timeout=0.5)    # bounded: fine
+            reg.assert_clean()
+            with lk:
+                ev.wait()               # unbounded: flagged
+        (d,) = reg.findings()
+        assert "threading.Event.wait" in d.message
+
+    def test_allow_blocking_lock_suppresses_the_check(self):
+        """serving.replica.pipe-style locks: the blocking I/O IS the
+        serialized critical section — declared, not flagged (ordering
+        is still checked)."""
+        reg = _fresh()
+        lk = reg.named_lock("blk.pipe", allow_blocking=True)
+        with reg.sanitizing():
+            with lk:
+                time.sleep(0.001)
+        reg.assert_clean()
+
+    def test_sanctioned_blocking_suppressed(self):
+        reg = _fresh()
+        lk = reg.named_lock("blk.S")
+        with reg.sanitizing():
+            with lk:
+                with reg.sanctioned():
+                    time.sleep(0.001)
+        reg.assert_clean()
+
+    def test_condition_wait_releases_own_lock_cleanly(self):
+        """cv.wait() releases the lock it guards — no self-finding,
+        and the waiter resumes holding it again."""
+        reg = _fresh()
+        cv = reg.named_condition("blk.cv")
+        seen = []
+
+        def waiter():
+            with cv:
+                cv.wait(2)
+                seen.append(tuple(reg.held_names()))
+
+        with reg.sanitizing():
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.05)
+            with cv:
+                cv.notify_all()
+            t.join()
+        reg.assert_clean()
+        assert seen == [("blk.cv",)]
+
+    def test_condition_wait_no_timeout_holding_other_lock_flagged(self):
+        reg = _fresh()
+        outer = reg.named_lock("blk.outer")
+        cv = reg.named_condition("blk.cv2")
+
+        def waiter():
+            with outer:
+                with cv:
+                    cv.wait()           # unbounded, outer still held
+
+        with reg.sanitizing():
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.05)
+            with cv:
+                cv.notify_all()
+            t.join(5)
+        assert not t.is_alive()
+        (d,) = [x for x in reg.findings()
+                if x.code == "blocking-under-lock"]
+        assert "Condition.wait" in d.message
+        assert "blk.outer" in d.var_names
+
+
+class TestRuntimeSignalSafety:
+    def test_plain_lock_in_signal_handler_flagged(self):
+        """The PR-6 flight-recorder shape: a plain Lock taken inside a
+        handler deadlocks if the signal lands while it is held."""
+        reg = _fresh()
+        plain = reg.named_lock("sig.plain")
+        prev = signal.getsignal(signal.SIGUSR2)
+        with reg.sanitizing():
+            def handler(signum, frame):
+                with plain:
+                    pass
+
+            signal.signal(signal.SIGUSR2, handler)
+            try:
+                signal.raise_signal(signal.SIGUSR2)
+            finally:
+                signal.signal(signal.SIGUSR2, prev)
+        (d,) = reg.findings()
+        assert d.code == "signal-unsafe-lock"
+        assert d.severity == ERROR
+        assert d.var_names == ("sig.plain",)
+        assert "handler" in "\n".join(d.provenance)
+
+    def test_rlock_in_signal_handler_clean(self):
+        reg = _fresh()
+        re_lk = reg.named_rlock("sig.re")
+        prev = signal.getsignal(signal.SIGUSR2)
+        with reg.sanitizing():
+            def handler(signum, frame):
+                with re_lk:
+                    pass
+
+            signal.signal(signal.SIGUSR2, handler)
+            try:
+                signal.raise_signal(signal.SIGUSR2)
+            finally:
+                signal.signal(signal.SIGUSR2, prev)
+        reg.assert_clean()
+
+
+class TestLockDelayFault:
+    def test_lock_delay_event_delays_acquisition(self):
+        reg = _fresh()
+        lk = reg.named_lock("delay.L")
+        plan = FaultPlan([], rank=0)
+        plan.add("lock_delay", rank=0, lock="delay.L", seconds=0.05,
+                 times=2)
+        assert plan.arm_lock_delays(reg) == 1
+        t0 = time.monotonic()
+        with lk:
+            pass
+        with lk:
+            pass
+        assert time.monotonic() - t0 >= 0.09
+        t1 = time.monotonic()
+        with lk:                        # times exhausted
+            pass
+        assert time.monotonic() - t1 < 0.04
+        reg.assert_clean()              # the delay is not a finding
+
+    def test_lock_delay_other_rank_not_armed(self):
+        plan = FaultPlan([{"kind": "lock_delay", "rank": 1,
+                           "lock": "x", "seconds": 1}], rank=0)
+        assert plan.arm_lock_delays(_fresh()) == 0
+
+
+# ---------------------------------------------------------------------------
+# static lint: seeded sources
+# ---------------------------------------------------------------------------
+
+
+def _lint_src(tmp_path, src, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return concurrency.lint_sources(files=[str(p)])
+
+
+class TestStaticLint:
+    def test_ab_ba_inversion_from_source_alone(self, tmp_path):
+        diags = _lint_src(tmp_path, """
+            import threading
+
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def one():
+                with a:
+                    with b:
+                        pass
+
+            def two():
+                with b:
+                    with a:
+                        pass
+        """)
+        (d,) = [x for x in diags if x.code == "lock-order-inversion"]
+        assert d.severity == ERROR
+        assert len(set(d.var_names)) == 2
+        prov = "\n".join(d.provenance)
+        assert "conflicting order" in prov
+        assert "reverse order" in prov
+        # both sites are named with file:line
+        assert prov.count("mod.py:") >= 2, prov
+
+    def test_consistent_order_clean(self, tmp_path):
+        diags = _lint_src(tmp_path, """
+            import threading
+
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def one():
+                with a:
+                    with b:
+                        pass
+
+            def two():
+                with a:
+                    with b:
+                        pass
+        """)
+        assert not list(diags)
+
+    def test_named_registry_locks_resolve_to_declared_names(self,
+                                                            tmp_path):
+        diags = _lint_src(tmp_path, """
+            from paddle_tpu.observability import locks
+
+            class S:
+                def __init__(self):
+                    self._lk = locks.named_lock("svc.state")
+
+                def poll(self):
+                    import time
+                    with self._lk:
+                        time.sleep(0.1)
+        """)
+        (d,) = list(diags)
+        assert d.code == "blocking-under-lock"
+        assert d.var_names == ("svc.state",)
+
+    def test_no_timeout_get_under_lock(self, tmp_path):
+        diags = _lint_src(tmp_path, """
+            import threading
+
+            class W:
+                def __init__(self, q):
+                    self._lock = threading.Lock()
+                    self._q = q
+
+                def take(self):
+                    with self._lock:
+                        return self._q.get()
+        """)
+        (d,) = list(diags)
+        assert d.code == "blocking-under-lock"
+        assert ".get() without timeout" in d.message
+
+    def test_cv_wait_on_held_condition_is_the_idiom_not_a_finding(
+            self, tmp_path):
+        """`while not ops: cv.wait()` on the condition you hold is the
+        canonical worker loop (host_embedding) — must stay clean."""
+        diags = _lint_src(tmp_path, """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self._ops = []
+
+                def loop(self):
+                    with self._cv:
+                        while not self._ops:
+                            self._cv.wait()
+        """)
+        assert not list(diags)
+
+    def test_wait_on_other_object_under_lock_flagged(self, tmp_path):
+        diags = _lint_src(tmp_path, """
+            import threading
+
+            class W:
+                def __init__(self, ev):
+                    self._lock = threading.Lock()
+                    self._ev = ev
+
+                def stall(self):
+                    with self._lock:
+                        self._ev.wait()
+        """)
+        (d,) = list(diags)
+        assert ".wait() without timeout" in d.message
+
+    def test_plain_lock_in_signal_handler_from_source(self, tmp_path):
+        diags = _lint_src(tmp_path, """
+            import signal
+            import threading
+
+            class R:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def install(self):
+                    signal.signal(signal.SIGTERM, self._on_signal)
+
+                def _on_signal(self, signum, frame):
+                    self._dump()
+
+                def _dump(self):
+                    with self._lock:
+                        pass
+        """)
+        (d,) = [x for x in diags if x.code == "signal-unsafe-lock"]
+        assert d.severity == ERROR
+        assert "self._on_signal" in d.message
+
+    def test_rlock_in_signal_handler_clean_from_source(self, tmp_path):
+        diags = _lint_src(tmp_path, """
+            import signal
+            import threading
+
+            class R:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def install(self):
+                    signal.signal(signal.SIGTERM, self._on_signal)
+
+                def _on_signal(self, signum, frame):
+                    with self._lock:
+                        pass
+        """)
+        assert not [x for x in diags if x.code == "signal-unsafe-lock"]
+
+    def test_waiver_pragma_downgrades_to_info(self, tmp_path):
+        diags = _lint_src(tmp_path, """
+            import threading
+            import time
+
+            lk = threading.Lock()
+
+            def f():
+                with lk:
+                    # concurrency-ok[blocking-under-lock]: drill widening
+                    time.sleep(1)
+        """)
+        (d,) = list(diags)
+        assert d.severity == INFO
+        assert d.message.startswith("waived (drill widening)")
+
+    def test_shipped_tree_strict_lint_zero_errors(self):
+        """The acceptance bar: the static lint over paddle_tpu/ itself
+        reports no errors and nothing non-waived."""
+        diags = concurrency.lint_sources()
+        assert not diags.errors(), diags.format()
+        non_waived = [d for d in diags if d.severity != INFO]
+        assert not non_waived, "\n".join(d.format() for d in non_waived)
+
+    def test_static_edges_seed_the_runtime_graph(self, tmp_path):
+        p = tmp_path / "seeded.py"
+        p.write_text(textwrap.dedent("""
+            import threading
+
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def one():
+                with a:
+                    with b:
+                        pass
+        """))
+        ctx = concurrency.SourceContext(files=[str(p)])
+        reg = _fresh()
+        concurrency.seed_runtime_graph(ctx, registry=reg)
+        names = [(h, a) for h, a, _ in reg.graph.edges()]
+        assert len(names) == 1
+        held, acq = names[0]
+        assert held.endswith(":a") and acq.endswith(":b")
+        # a runtime acquisition in the REVERSE order now inverts
+        # against the statically seeded edge
+        la = reg.named_lock(held)
+        lb = reg.named_lock(acq)
+        with reg.sanitizing(blocking=False):
+            with lb:
+                with la:
+                    pass
+        assert [d.code for d in reg.findings()] == ["lock-order-inversion"]
+
+    def test_cli_json_schema_and_strict_rc(self, tmp_path):
+        import importlib.util
+        import io
+        import json
+        import os
+        from contextlib import redirect_stdout
+
+        spec = importlib.util.spec_from_file_location(
+            "concurrency_lint_cli",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "tools",
+                "concurrency_lint.py"))
+        cli = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cli)
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import threading
+            import time
+
+            lk = threading.Lock()
+
+            def f():
+                with lk:
+                    time.sleep(1)
+        """))
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = cli.main([str(bad), "--json"])
+        out = json.loads(buf.getvalue())
+        assert rc == 0                      # warning only
+        assert out["schema_version"] == 1
+        assert out["summary"] == {"errors": 0, "warnings": 1,
+                                  "waived": 0, "total": 1}
+        (d,) = out["diagnostics"]
+        assert d["code"] == "blocking-under-lock"
+        assert d["pass_name"] == "concurrency-lint"
+        with redirect_stdout(io.StringIO()):
+            assert cli.main([str(bad), "--strict"]) == 1
+        with redirect_stdout(io.StringIO()):
+            assert cli.main([str(tmp_path / "bad.py"), "--rules",
+                             "lock-order-inversion"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# instrumented drills: the real fleet paths must report ZERO findings
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def instrumented():
+    reg = locks.registry()
+    reg.reset()
+    reg.enable()
+    try:
+        yield reg
+    finally:
+        reg.disable()
+
+
+@pytest.fixture(scope="module")
+def lm():
+    with dygraph.guard():
+        np.random.seed(0)
+        return models.TransformerLM(CFG)
+
+
+class TestInstrumentedDrills:
+    def test_inference_server_drill_zero_findings(self):
+        """Concurrent mixed-shape traffic through InferenceServer under
+        the armed sanitizer: the dispatcher/stats/metrics locks must
+        produce no ordering or blocking findings."""
+        from paddle_tpu.inference.server import InferenceServer
+
+        class P:
+            def run(self, feed):
+                time.sleep(0.002)
+                rows, width = feed["x"].shape
+                return [np.full((rows, 1), float(width), np.float32)]
+
+        with instrumented() as reg:
+            server = InferenceServer(P(), max_batch=8, batch_timeout_ms=1,
+                                     batch_buckets=False).start()
+            try:
+                errs = []
+
+                def client(width):
+                    x = np.zeros((1, width), np.float32)
+                    for _ in range(6):
+                        try:
+                            out, = server.infer({"x": x}, timeout=30)
+                            assert out[0, 0] == float(width)
+                        except Exception as e:   # pragma: no cover
+                            errs.append(e)
+                            return
+
+                ts = [threading.Thread(target=client, args=(w,))
+                      for w in (4, 6, 8)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(60)
+                assert not errs, errs[:1]
+            finally:
+                server.stop()
+            reg.assert_clean()
+
+    @pytest.mark.slow
+    def test_generation_fleet_requeue_drill_with_lock_delay(self, lm):
+        """The PR-15 regression, re-armed: a replica dies mid-decode
+        while lock_delay stretches every engine-lock hold, widening the
+        death-hook/requeue race the old fleet deadlocked on.  The
+        requeue must still complete (off the dying engine's lock) and
+        the sanitizer must stay silent."""
+        plan = FaultPlan([], rank=0)
+        plan.add("kill_replica", replica=0, request=3)
+        plan.add("lock_delay", rank=0, lock="generation.engine",
+                 seconds=0.002, times=50)
+        with instrumented() as reg:
+            fleet = serving.GenerationFleet(
+                lm, replicas=2, fault_plan=plan, slots=2, max_len=64,
+                prefill_buckets=[8, 16], max_queue=32).start()
+            try:
+                rng = np.random.RandomState(4)
+                reqs = [gen.GenerationRequest(
+                    rng.randint(0, CFG.vocab_size,
+                                int(rng.randint(2, 12))),
+                    max_new_tokens=8, request_id="c%d" % i)
+                    for i in range(4)]
+                handles = [fleet.submit(r) for r in reqs]
+                got = [h.result(timeout=120) for h in handles]
+            finally:
+                fleet.stop()
+            assert all(isinstance(g, list) and g for g in got)
+            assert int(fleet._m_deaths.value) == 1
+            assert any(h.requeued for h in handles), \
+                "the dead replica held in-flight requests"
+            reg.assert_clean()
+
+    @pytest.mark.slow
+    def test_streaming_host_embedding_drill_zero_findings(self):
+        """The pipelined host-embedding parity drill (conflict
+        serialization, worker condition loop) instrumented: still
+        bit-identical, zero findings."""
+        from test_streaming import _batches, _run_to_final_rows
+
+        feeds = _batches(8)
+        ref = _run_to_final_rows("sync", feeds)
+        with instrumented() as reg:
+            got = _run_to_final_rows("pipe", feeds)
+            reg.assert_clean()
+        assert np.array_equal(ref[0], got[0])
+        assert np.array_equal(ref[1], got[1])
+
+    @pytest.mark.slow
+    def test_rl_loop_drill_zero_findings(self, tmp_path):
+        """Two rollout->score->train rounds of the RL feedback loop
+        (fleet + engine + checkpoint locks all live) instrumented."""
+        from test_rl import make_loop
+
+        with instrumented() as reg:
+            loop, fleet = make_loop(str(tmp_path / "rl"))
+            try:
+                loop.run(rounds=2)
+            finally:
+                fleet.stop()
+            assert len(loop.reward_history) == 2
+            reg.assert_clean()
+
+
+# ---------------------------------------------------------------------------
+# packaging
+# ---------------------------------------------------------------------------
+
+
+def test_concurrency_is_lazy_and_registered():
+    import importlib
+    import sys
+
+    assert "concurrency" not in dir(paddle_tpu.analysis) or True
+    mod = paddle_tpu.analysis.concurrency
+    assert mod is sys.modules["paddle_tpu.analysis.concurrency"]
+    from paddle_tpu.analysis.lint import lint_rules
+
+    assert lint_rules(category="concurrency") == [
+        "blocking-under-lock", "lock-order-inversion",
+        "signal-unsafe-lock"]
+    # the concurrency category never leaks into program lint runs
+    importlib.import_module("paddle_tpu.analysis.lint")
+    from paddle_tpu.fluid.framework import Program
+
+    p = Program()
+    from paddle_tpu.analysis import lint_program
+
+    assert not [d for d in lint_program(p)
+                if d.code in ("blocking-under-lock",
+                              "lock-order-inversion",
+                              "signal-unsafe-lock")]

@@ -1,0 +1,120 @@
+"""Static thread-safety lint over paddle_tpu/ sources.
+
+Usage::
+
+    python tools/concurrency_lint.py [paths...] [--json] [--strict] \
+        [--rules lock-order-inversion,...] [--list-rules]
+
+Runs the "concurrency"-category lint rules
+(`paddle_tpu.analysis.concurrency`): nested `with lock:` orders are
+extracted into a lock-order graph (AB/BA inversions report both sites),
+blocking-call patterns under a held lock are flagged, and non-reentrant
+locks acquired inside `signal.signal` handlers are flagged — all from
+source alone, nothing is executed.
+
+`paths` are files or directories (default: the paddle_tpu/ package).
+Findings waived in place with ``# concurrency-ok[<code>]: <reason>``
+are reported at INFO severity and never affect the exit code.
+
+Exit code 1 when any error-severity finding exists, or with --strict
+when any non-waived (non-INFO) finding exists; 0 otherwise — the tier-1
+gate runs ``--strict`` over the shipped tree.
+
+JSON output (``--json``) is an object pinned by ``schema_version``
+(currently 1), matching tools/program_lint.py::
+
+    {
+      "schema_version": 1,
+      "diagnostics": [{severity, code, message, block_idx, op_idx,
+                       op_type, var_names, provenance, pass_name}],
+      "summary": {"errors": int, "warnings": int, "waived": int,
+                  "total": int}
+    }
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SCHEMA_VERSION = 1
+
+
+def _collect_files(paths):
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, _dirs, names in os.walk(p):
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        files.append(os.path.join(dirpath, n))
+        else:
+            files.append(p)
+    return files
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="concurrency_lint",
+        description="static lock-order / blocking-under-lock / "
+                    "signal-safety lint over Python sources")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: paddle_tpu/)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule subset (default: all "
+                         "concurrency-category rules)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the registered concurrency rules and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit diagnostics as a schema-versioned JSON "
+                         "object")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on ANY non-waived finding, not just "
+                         "errors")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.analysis import concurrency  # registers the rules
+    from paddle_tpu.analysis.diagnostics import INFO
+    from paddle_tpu.analysis.lint import lint_rules
+
+    if args.list_rules:
+        for name in lint_rules(category="concurrency"):
+            print(name)
+        return 0
+
+    if args.paths:
+        files = _collect_files(args.paths)
+    else:
+        files = _collect_files([os.path.join(REPO, "paddle_tpu")])
+
+    rules = [s for s in args.rules.split(",") if s] if args.rules else None
+    diags = concurrency.lint_sources(files=files, rules=rules)
+
+    waived = [d for d in diags if d.severity == INFO]
+    if args.as_json:
+        print(json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "diagnostics": [d.to_dict() for d in diags.sorted()],
+            "summary": {"errors": len(diags.errors()),
+                        "warnings": len(diags.warnings()),
+                        "waived": len(waived),
+                        "total": len(diags)},
+        }, indent=2))
+    else:
+        print(diags.format())
+
+    rc = 0
+    if diags.has_errors:
+        rc = 1
+    elif args.strict and any(d.severity != INFO for d in diags):
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.io",
     "paddle_tpu.observability",
     "paddle_tpu.analysis",
+    "paddle_tpu.analysis.concurrency",
     "paddle_tpu.nn",
     "paddle_tpu.nn.functional",
     "paddle_tpu.tensor",
